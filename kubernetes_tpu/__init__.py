@@ -1,0 +1,22 @@
+"""kubernetes_tpu — a TPU-native cluster orchestrator with Kubernetes' capabilities.
+
+A from-scratch, TPU-first framework (JAX / XLA / Pallas / pjit) re-providing the
+capabilities of Kubernetes (reference: kubernetes/kubernetes ~v1.33): a declarative typed
+API store with watch semantics, reconciling controllers, a binding surface, and — as its
+core — a pod scheduler that reframes kube-scheduler's per-pod Filter/Score loop
+(reference: pkg/scheduler/schedule_one.go) as a batched pods x nodes assignment problem
+solved on a TPU mesh.
+
+Layer map (mirrors SURVEY.md §1, redesigned TPU-first):
+  api/        L0: typed object model (Pod, Node, labels, quantities)
+  store/      L1-L2: in-memory versioned store with watch bus (etcd+apiserver fusion)
+  scheduler/  L5: framework extension points, serial oracle, queue, cache, batch driver
+  snapshot/   cluster state as struct-of-arrays + incremental device mirroring
+  ops/        vectorized filter/score plugins -> feasibility/cost tensors (jit)
+  parallel/   mesh construction, shard_map'd solvers, collectives over ICI
+  models/     end-to-end "solver models" (greedy / auction / sinkhorn assignment)
+  controllers/ L4: reconciling control loops (workload controllers, node lifecycle)
+  utils/      clocks, backoff, misc
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,39 @@
+"""L0 — typed API object model (reference: staging/src/k8s.io/api + apimachinery)."""
+
+from .labels import (  # noqa: F401
+    NodeSelector,
+    NodeSelectorTerm,
+    PreferredSchedulingTerm,
+    Requirement,
+    Selector,
+)
+from .resources import (  # noqa: F401
+    Resource,
+    compute_pod_resource_request,
+    parse_quantity_milli,
+    quantity_milli_value,
+    quantity_value,
+)
+from .types import (  # noqa: F401
+    Affinity,
+    Binding,
+    Container,
+    ContainerImage,
+    ContainerPort,
+    Namespace,
+    Node,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    PodCondition,
+    PodSpec,
+    PodStatus,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+    find_matching_untolerated_taint,
+    new_uid,
+)

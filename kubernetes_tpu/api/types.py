@@ -1,0 +1,498 @@
+"""Typed object model: ObjectMeta, Pod, Node, Binding.
+
+Re-provides (subset of) the k8s core/v1 API surface relevant to scheduling and
+control loops (reference: staging/src/k8s.io/api/core/v1/types.go — Pod, PodSpec,
+Node, Taint, Toleration, Affinity, TopologySpreadConstraint) and ObjectMeta
+(reference: staging/src/k8s.io/apimachinery/pkg/apis/meta/v1/types.go).
+
+Objects parse from / serialize to k8s-style camelCase dicts so standard manifests
+round-trip. Construction helpers keep tests fluent (mirroring the reference's
+st.MakePod() builders in pkg/scheduler/testing/wrappers.go).
+"""
+
+from __future__ import annotations
+
+import itertools
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional, Tuple
+
+from .labels import (
+    NodeSelector,
+    PreferredSchedulingTerm,
+    Selector,
+)
+
+# Well-known label keys (reference: staging/src/k8s.io/api/core/v1/well_known_labels.go)
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+LABEL_ZONE = "topology.kubernetes.io/zone"
+LABEL_REGION = "topology.kubernetes.io/region"
+
+# Taint effects
+TAINT_NO_SCHEDULE = "NoSchedule"
+TAINT_PREFER_NO_SCHEDULE = "PreferNoSchedule"
+TAINT_NO_EXECUTE = "NoExecute"
+
+# Pod phases
+PENDING = "Pending"
+RUNNING = "Running"
+SUCCEEDED = "Succeeded"
+FAILED = "Failed"
+
+DEFAULT_SCHEDULER_NAME = "default-scheduler"
+
+_uid_counter = itertools.count(1)
+
+
+def new_uid() -> str:
+    return f"uid-{next(_uid_counter)}-{uuid.uuid4().hex[:8]}"
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = ""
+    resource_version: int = 0
+    generation: int = 0
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    creation_timestamp: float = 0.0
+    deletion_timestamp: Optional[float] = None
+    owner_references: List[Dict[str, Any]] = field(default_factory=list)
+    finalizers: List[str] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "ObjectMeta":
+        return ObjectMeta(
+            name=d.get("name", ""),
+            namespace=d.get("namespace", "default"),
+            uid=d.get("uid", ""),
+            resource_version=int(d.get("resourceVersion", 0) or 0),
+            generation=int(d.get("generation", 0) or 0),
+            labels=dict(d.get("labels") or {}),
+            annotations=dict(d.get("annotations") or {}),
+            creation_timestamp=float(d.get("creationTimestamp", 0.0) or 0.0),
+            deletion_timestamp=d.get("deletionTimestamp"),
+            owner_references=list(d.get("ownerReferences") or []),
+            finalizers=list(d.get("finalizers") or []),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "name": self.name,
+            "namespace": self.namespace,
+            "uid": self.uid,
+            "resourceVersion": self.resource_version,
+        }
+        if self.generation:
+            d["generation"] = self.generation
+        if self.labels:
+            d["labels"] = dict(self.labels)
+        if self.annotations:
+            d["annotations"] = dict(self.annotations)
+        if self.creation_timestamp:
+            d["creationTimestamp"] = self.creation_timestamp
+        if self.deletion_timestamp is not None:
+            d["deletionTimestamp"] = self.deletion_timestamp
+        if self.owner_references:
+            d["ownerReferences"] = self.owner_references
+        if self.finalizers:
+            d["finalizers"] = self.finalizers
+        return d
+
+
+@dataclass(frozen=True)
+class ContainerPort:
+    container_port: int
+    host_port: int = 0
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass
+class Container:
+    name: str = ""
+    image: str = ""
+    resources: Dict[str, Dict[str, Any]] = field(default_factory=dict)  # requests/limits
+    ports: List[ContainerPort] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Container":
+        return Container(
+            name=d.get("name", ""),
+            image=d.get("image", ""),
+            resources=dict(d.get("resources") or {}),
+            ports=[
+                ContainerPort(
+                    container_port=int(p["containerPort"]),
+                    host_port=int(p.get("hostPort", 0) or 0),
+                    protocol=p.get("protocol", "TCP"),
+                    host_ip=p.get("hostIP", ""),
+                )
+                for p in d.get("ports") or []
+            ],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name}
+        if self.image:
+            d["image"] = self.image
+        if self.resources:
+            d["resources"] = self.resources
+        if self.ports:
+            d["ports"] = [
+                {
+                    "containerPort": p.container_port,
+                    **({"hostPort": p.host_port} if p.host_port else {}),
+                    "protocol": p.protocol,
+                    **({"hostIP": p.host_ip} if p.host_ip else {}),
+                }
+                for p in self.ports
+            ]
+        return d
+
+
+@dataclass(frozen=True)
+class Toleration:
+    """reference: staging/src/k8s.io/api/core/v1/types.go Toleration."""
+
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" matches all effects
+    toleration_seconds: Optional[int] = None
+
+    def tolerates(self, taint: "Taint") -> bool:
+        """ToleratesTaint semantics (reference:
+        staging/src/k8s.io/api/core/v1/toleration.go:38)."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator in ("", "Equal"):
+            return self.value == taint.value
+        return self.operator == "Exists"
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Toleration":
+        return Toleration(
+            key=d.get("key", ""),
+            operator=d.get("operator", "Equal"),
+            value=d.get("value", ""),
+            effect=d.get("effect", ""),
+            toleration_seconds=d.get("tolerationSeconds"),
+        )
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: str = TAINT_NO_SCHEDULE
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Taint":
+        return Taint(key=d["key"], value=d.get("value", ""), effect=d.get("effect", TAINT_NO_SCHEDULE))
+
+
+def find_matching_untolerated_taint(taints, tolerations, effects=(TAINT_NO_SCHEDULE, TAINT_NO_EXECUTE)):
+    """reference: staging/src/k8s.io/component-helpers/scheduling/corev1/helpers.go
+    FindMatchingUntoleratedTaint filtered to DoNotSchedule effects."""
+    for taint in taints:
+        if taint.effect not in effects:
+            continue
+        if not any(t.tolerates(taint) for t in tolerations):
+            return taint
+    return None
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    """reference: staging/src/k8s.io/api/core/v1/types.go PodAffinityTerm."""
+
+    topology_key: str
+    selector: Optional[Selector]  # label_selector over pods; None matches nothing
+    namespaces: Tuple[str, ...] = ()
+    namespace_selector: Optional[Selector] = None  # over namespace labels; empty matches all
+    match_label_keys: Tuple[str, ...] = ()
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "PodAffinityTerm":
+        return PodAffinityTerm(
+            topology_key=d.get("topologyKey", ""),
+            selector=Selector.from_label_selector(d.get("labelSelector")),
+            namespaces=tuple(d.get("namespaces") or ()),
+            namespace_selector=Selector.from_label_selector(d.get("namespaceSelector")),
+            match_label_keys=tuple(d.get("matchLabelKeys") or ()),
+        )
+
+
+@dataclass(frozen=True)
+class WeightedPodAffinityTerm:
+    weight: int
+    term: PodAffinityTerm
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "WeightedPodAffinityTerm":
+        return WeightedPodAffinityTerm(int(d["weight"]), PodAffinityTerm.from_dict(d["podAffinityTerm"]))
+
+
+@dataclass
+class Affinity:
+    node_affinity_required: Optional[NodeSelector] = None
+    node_affinity_preferred: List[PreferredSchedulingTerm] = field(default_factory=list)
+    pod_affinity_required: List[PodAffinityTerm] = field(default_factory=list)
+    pod_affinity_preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity_required: List[PodAffinityTerm] = field(default_factory=list)
+    pod_anti_affinity_preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Optional[Mapping]) -> Optional["Affinity"]:
+        if not d:
+            return None
+        a = Affinity()
+        na = d.get("nodeAffinity") or {}
+        a.node_affinity_required = NodeSelector.from_dict(
+            na.get("requiredDuringSchedulingIgnoredDuringExecution")
+        )
+        a.node_affinity_preferred = [
+            PreferredSchedulingTerm.from_dict(t)
+            for t in na.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+        ]
+        pa = d.get("podAffinity") or {}
+        a.pod_affinity_required = [
+            PodAffinityTerm.from_dict(t)
+            for t in pa.get("requiredDuringSchedulingIgnoredDuringExecution") or []
+        ]
+        a.pod_affinity_preferred = [
+            WeightedPodAffinityTerm.from_dict(t)
+            for t in pa.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+        ]
+        paa = d.get("podAntiAffinity") or {}
+        a.pod_anti_affinity_required = [
+            PodAffinityTerm.from_dict(t)
+            for t in paa.get("requiredDuringSchedulingIgnoredDuringExecution") or []
+        ]
+        a.pod_anti_affinity_preferred = [
+            WeightedPodAffinityTerm.from_dict(t)
+            for t in paa.get("preferredDuringSchedulingIgnoredDuringExecution") or []
+        ]
+        return a
+
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    """reference: staging/src/k8s.io/api/core/v1/types.go TopologySpreadConstraint."""
+
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str  # DoNotSchedule | ScheduleAnyway
+    selector: Optional[Selector]
+    min_domains: Optional[int] = None
+    node_affinity_policy: str = "Honor"  # Honor | Ignore
+    node_taints_policy: str = "Ignore"  # Honor | Ignore
+    match_label_keys: Tuple[str, ...] = ()
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "TopologySpreadConstraint":
+        return TopologySpreadConstraint(
+            max_skew=int(d["maxSkew"]),
+            topology_key=d["topologyKey"],
+            when_unsatisfiable=d["whenUnsatisfiable"],
+            selector=Selector.from_label_selector(d.get("labelSelector")),
+            min_domains=d.get("minDomains"),
+            node_affinity_policy=d.get("nodeAffinityPolicy", "Honor"),
+            node_taints_policy=d.get("nodeTaintsPolicy", "Ignore"),
+            match_label_keys=tuple(d.get("matchLabelKeys") or ()),
+        )
+
+
+@dataclass
+class PodSpec:
+    node_name: str = ""
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread_constraints: List[TopologySpreadConstraint] = field(default_factory=list)
+    priority: int = 0
+    priority_class_name: str = ""
+    preemption_policy: str = "PreemptLowerPriority"  # or "Never"
+    scheduling_gates: List[str] = field(default_factory=list)
+    overhead: Optional[Dict[str, Any]] = None
+    host_network: bool = False
+    restart_policy: str = "Always"
+    termination_grace_period_seconds: int = 30
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "PodSpec":
+        return PodSpec(
+            node_name=d.get("nodeName", ""),
+            scheduler_name=d.get("schedulerName", DEFAULT_SCHEDULER_NAME),
+            containers=[Container.from_dict(c) for c in d.get("containers") or []],
+            init_containers=[Container.from_dict(c) for c in d.get("initContainers") or []],
+            node_selector=dict(d.get("nodeSelector") or {}),
+            affinity=Affinity.from_dict(d.get("affinity")),
+            tolerations=[Toleration.from_dict(t) for t in d.get("tolerations") or []],
+            topology_spread_constraints=[
+                TopologySpreadConstraint.from_dict(t)
+                for t in d.get("topologySpreadConstraints") or []
+            ],
+            priority=int(d.get("priority", 0) or 0),
+            priority_class_name=d.get("priorityClassName", ""),
+            preemption_policy=d.get("preemptionPolicy", "PreemptLowerPriority"),
+            scheduling_gates=[g["name"] if isinstance(g, Mapping) else g for g in d.get("schedulingGates") or []],
+            overhead=d.get("overhead"),
+            host_network=bool(d.get("hostNetwork", False)),
+            restart_policy=d.get("restartPolicy", "Always"),
+            termination_grace_period_seconds=int(d.get("terminationGracePeriodSeconds", 30) or 30),
+        )
+
+
+@dataclass
+class PodCondition:
+    type: str
+    status: str
+    reason: str = ""
+    message: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class PodStatus:
+    phase: str = PENDING
+    conditions: List[PodCondition] = field(default_factory=list)
+    nominated_node_name: str = ""
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    kind = "Pod"
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Pod":
+        st = d.get("status") or {}
+        return Pod(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=PodSpec.from_dict(d.get("spec") or {}),
+            status=PodStatus(
+                phase=st.get("phase", PENDING),
+                conditions=[
+                    PodCondition(
+                        type=c.get("type", ""),
+                        status=c.get("status", ""),
+                        reason=c.get("reason", ""),
+                        message=c.get("message", ""),
+                        last_transition_time=float(c.get("lastTransitionTime", 0.0) or 0.0),
+                    )
+                    for c in st.get("conditions") or []
+                ],
+                nominated_node_name=st.get("nominatedNodeName", ""),
+            ),
+        )
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    def is_terminal(self) -> bool:
+        return self.status.phase in (SUCCEEDED, FAILED)
+
+
+@dataclass(frozen=True)
+class ContainerImage:
+    names: Tuple[str, ...]
+    size_bytes: int = 0
+
+
+@dataclass
+class NodeSpec:
+    unschedulable: bool = False
+    taints: List[Taint] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "NodeSpec":
+        return NodeSpec(
+            unschedulable=bool(d.get("unschedulable", False)),
+            taints=[Taint.from_dict(t) for t in d.get("taints") or []],
+        )
+
+
+@dataclass
+class NodeCondition:
+    type: str
+    status: str
+    reason: str = ""
+    last_heartbeat_time: float = 0.0
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class NodeStatus:
+    capacity: Dict[str, Any] = field(default_factory=dict)
+    allocatable: Dict[str, Any] = field(default_factory=dict)
+    images: List[ContainerImage] = field(default_factory=list)
+    conditions: List[NodeCondition] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "NodeStatus":
+        return NodeStatus(
+            capacity=dict(d.get("capacity") or {}),
+            allocatable=dict(d.get("allocatable") or d.get("capacity") or {}),
+            images=[
+                ContainerImage(tuple(i.get("names") or ()), int(i.get("sizeBytes", 0) or 0))
+                for i in d.get("images") or []
+            ],
+            conditions=[
+                NodeCondition(
+                    type=c.get("type", ""),
+                    status=c.get("status", ""),
+                    reason=c.get("reason", ""),
+                    last_heartbeat_time=float(c.get("lastHeartbeatTime", 0.0) or 0.0),
+                    last_transition_time=float(c.get("lastTransitionTime", 0.0) or 0.0),
+                )
+                for c in d.get("conditions") or []
+            ],
+        )
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    kind = "Node"
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Node":
+        return Node(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=NodeSpec.from_dict(d.get("spec") or {}),
+            status=NodeStatus.from_dict(d.get("status") or {}),
+        )
+
+
+@dataclass
+class Namespace:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    kind = "Namespace"
+
+
+@dataclass
+class Binding:
+    """Pod->Node binding subresource (reference:
+    staging/src/k8s.io/api/core/v1/types.go Binding; handled by BindingREST.Create,
+    pkg/registry/core/pod/storage/storage.go:149)."""
+
+    pod_namespace: str
+    pod_name: str
+    node_name: str

@@ -1,0 +1,14 @@
+from .store import (  # noqa: F401
+    ADDED,
+    BOOKMARK,
+    DELETED,
+    MODIFIED,
+    AlreadyBoundError,
+    AlreadyExistsError,
+    APIStore,
+    ConflictError,
+    Event,
+    NotFoundError,
+    ResourceVersionTooOldError,
+    Watch,
+)

@@ -1,0 +1,279 @@
+"""In-memory versioned object store with watch semantics.
+
+Fuses the roles of etcd3 + the apiserver registry + the watch cache into one
+process-local component (reference: staging/src/k8s.io/apiserver/pkg/storage/etcd3/store.go,
+storage/cacher/cacher.go:261, endpoints/handlers/watch.go:187). Semantics preserved:
+
+  - A single monotonically increasing resourceVersion across all writes
+    (etcd revision analog); every object carries the RV of its last write.
+  - Optimistic concurrency: update/delete fail on RV conflict
+    (reference: apiserver GuaranteedUpdate precondition behavior).
+  - LIST returns a consistent snapshot + the RV it is current to; WATCH from that RV
+    streams every subsequent event exactly once, in order — the List+Watch contract
+    client-go's Reflector relies on (reference: tools/cache/reflector.go:394).
+  - Transactional pod binding: sets spec.nodeName iff still unset
+    (reference: BindingREST.Create, pkg/registry/core/pod/storage/storage.go:149).
+
+The store is thread-safe. Watch delivery is via per-subscriber unbounded queues;
+a slow watcher never blocks writers (the reference's Cacher drops/terminates slow
+watchers; we buffer instead — acceptable in-process).
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+BOOKMARK = "BOOKMARK"
+
+
+@dataclass(frozen=True)
+class Event:
+    type: str
+    kind: str
+    obj: Any
+    resource_version: int
+
+
+class ConflictError(Exception):
+    pass
+
+
+class ResourceVersionTooOldError(Exception):
+    """Watch requested from an RV older than retained history — the client must
+    relist (reference: apiserver 'too old resource version' / 410 Gone)."""
+
+
+class NotFoundError(Exception):
+    pass
+
+
+class AlreadyExistsError(Exception):
+    pass
+
+
+class AlreadyBoundError(Exception):
+    pass
+
+
+class Watch:
+    """A single watch subscription. Iterate or .get(timeout). Call .stop() to end."""
+
+    def __init__(self, store: "APIStore", kind: Optional[str]):
+        self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
+        self._store = store
+        self._kind = kind
+        self._stopped = False
+
+    def _deliver(self, ev: Event) -> None:
+        if self._kind is None or ev.kind == self._kind:
+            self._q.put(ev)
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Event]:
+        try:
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def drain(self) -> List[Event]:
+        out = []
+        while True:
+            try:
+                ev = self._q.get_nowait()
+            except queue.Empty:
+                return out
+            if ev is not None:
+                out.append(ev)
+
+    def __iter__(self):
+        while not self._stopped:
+            ev = self._q.get()
+            if ev is None:
+                return
+            yield ev
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._store._unsubscribe(self)
+        self._q.put(None)
+
+
+class APIStore:
+    """The hub every component is a client of (SURVEY.md §1)."""
+
+    def __init__(self, deep_copy_on_write: bool = True):
+        self._lock = threading.RLock()
+        self._rv = 0
+        # kind -> {"namespace/name" or "name": obj}
+        self._objects: Dict[str, Dict[str, Any]] = {}
+        # bounded event history for watch replay (RV-ordered)
+        self._history: List[Event] = []
+        self._history_limit = 200_000
+        # all events with rv > _history_floor_rv are retained
+        self._history_floor_rv = 0
+        self._watchers: List[Watch] = []
+        self._deep_copy = deep_copy_on_write
+
+    # -- helpers ---------------------------------------------------------------
+
+    @staticmethod
+    def object_key(obj) -> str:
+        meta = obj.metadata
+        ns = getattr(meta, "namespace", None)
+        return f"{ns}/{meta.name}" if ns else meta.name
+
+    def _copy(self, obj):
+        return copy.deepcopy(obj) if self._deep_copy else obj
+
+    def _emit(self, etype: str, kind: str, obj) -> None:
+        ev = Event(etype, kind, obj, self._rv)
+        self._history.append(ev)
+        if len(self._history) > self._history_limit:
+            drop = self._history_limit // 4
+            self._history_floor_rv = self._history[drop - 1].resource_version
+            del self._history[:drop]
+        for w in self._watchers:
+            w._deliver(ev)
+
+    # -- CRUD ------------------------------------------------------------------
+
+    def create(self, kind: str, obj) -> Any:
+        with self._lock:
+            objs = self._objects.setdefault(kind, {})
+            key = self.object_key(obj)
+            if key in objs:
+                raise AlreadyExistsError(f"{kind} {key} already exists")
+            obj = self._copy(obj)
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            objs[key] = obj
+            self._emit(ADDED, kind, obj)
+            return obj
+
+    def get(self, kind: str, key: str) -> Any:
+        """Returns a copy (when deep_copy_on_write) — like a REST GET, each read is a
+        fresh decode, so caller mutation can never corrupt stored state."""
+        with self._lock:
+            try:
+                return self._copy(self._objects.get(kind, {})[key])
+            except KeyError:
+                raise NotFoundError(f"{kind} {key} not found") from None
+
+    def update(self, kind: str, obj, check_rv: bool = True) -> Any:
+        with self._lock:
+            objs = self._objects.setdefault(kind, {})
+            key = self.object_key(obj)
+            if key not in objs:
+                raise NotFoundError(f"{kind} {key} not found")
+            if check_rv and objs[key].metadata.resource_version != obj.metadata.resource_version:
+                raise ConflictError(
+                    f"{kind} {key}: rv {obj.metadata.resource_version} != "
+                    f"{objs[key].metadata.resource_version}"
+                )
+            obj = self._copy(obj)
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            objs[key] = obj
+            self._emit(MODIFIED, kind, obj)
+            return obj
+
+    def guaranteed_update(self, kind: str, key: str, mutate: Callable[[Any], Any], max_retries: int = 16) -> Any:
+        """Read-modify-write with conflict retry (reference: etcd3 GuaranteedUpdate)."""
+        for _ in range(max_retries):
+            cur = self.get(kind, key)
+            updated = mutate(copy.deepcopy(cur))
+            try:
+                return self.update(kind, updated)
+            except ConflictError:
+                continue
+        raise ConflictError(f"{kind} {key}: too many conflicts")
+
+    def delete(self, kind: str, key: str) -> Any:
+        with self._lock:
+            objs = self._objects.get(kind, {})
+            if key not in objs:
+                raise NotFoundError(f"{kind} {key} not found")
+            obj = self._copy(objs.pop(key))
+            self._rv += 1
+            # The DELETED event carries the object at its post-delete RV (client-go
+            # convention: watchers track progress from obj.metadata.resourceVersion).
+            obj.metadata.resource_version = self._rv
+            self._emit(DELETED, kind, obj)
+            return obj
+
+    def list(self, kind: str, predicate: Optional[Callable[[Any], bool]] = None) -> Tuple[List[Any], int]:
+        """Consistent snapshot + the RV it is current to. Items are copies (when
+        deep_copy_on_write), like a REST LIST response."""
+        with self._lock:
+            items = list(self._objects.get(kind, {}).values())
+            if predicate is not None:
+                items = [o for o in items if predicate(o)]
+            return [self._copy(o) for o in items], self._rv
+
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._rv
+
+    # -- watch -----------------------------------------------------------------
+
+    def watch(self, kind: Optional[str] = None, since_rv: int = -1) -> Watch:
+        """Subscribe to events. since_rv >= 0 replays history events with rv > since_rv
+        first (the Reflector resume contract); since_rv == -1 means 'from now'.
+        Raises ResourceVersionTooOldError if since_rv predates retained history —
+        the caller must relist (410 Gone analog)."""
+        with self._lock:
+            if 0 <= since_rv < self._history_floor_rv:
+                raise ResourceVersionTooOldError(
+                    f"rv {since_rv} is older than retained history (floor "
+                    f"{self._history_floor_rv}); relist required"
+                )
+            w = Watch(self, kind)
+            if since_rv >= 0:
+                for ev in self._history:
+                    if ev.resource_version > since_rv:
+                        w._deliver(ev)
+            self._watchers.append(w)
+            return w
+
+    def _unsubscribe(self, w: Watch) -> None:
+        with self._lock:
+            try:
+                self._watchers.remove(w)
+            except ValueError:
+                pass
+
+    # -- scheduling-specific transactional surfaces ----------------------------
+
+    def bind(self, namespace: str, name: str, node_name: str) -> Any:
+        """Atomic pod->node binding (reference: BindingREST.Create,
+        pkg/registry/core/pod/storage/storage.go:149 — guaranteed-update that fails
+        if the pod is already bound to a different node)."""
+        with self._lock:
+            key = f"{namespace}/{name}"
+            pod = self.get("pods", key)
+            if pod.spec.node_name:
+                raise AlreadyBoundError(f"pod {key} is already bound to {pod.spec.node_name}")
+            pod = self._copy(pod)
+            pod.spec.node_name = node_name
+            self._rv += 1
+            pod.metadata.resource_version = self._rv
+            self._objects["pods"][key] = pod
+            self._emit(MODIFIED, "pods", pod)
+            return pod
+
+    def update_pod_status(self, namespace: str, name: str, mutate_status: Callable[[Any], None]) -> Any:
+        with self._lock:
+            key = f"{namespace}/{name}"
+            pod = self._copy(self.get("pods", key))
+            mutate_status(pod.status)
+            self._rv += 1
+            pod.metadata.resource_version = self._rv
+            self._objects["pods"][key] = pod
+            self._emit(MODIFIED, "pods", pod)
+            return pod

@@ -1,0 +1,13 @@
+"""Test config: force an 8-device virtual CPU platform BEFORE jax is imported
+anywhere, so mesh/sharding tests exercise real multi-device paths without TPU
+hardware (the driver's dryrun does the same)."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

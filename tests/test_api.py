@@ -1,0 +1,232 @@
+"""Unit tests for the typed object model: quantities, selectors, tolerations.
+
+Mirrors the reference's table-driven tests for apimachinery quantity parsing and
+label selector matching (SURVEY.md §4 unit tier)."""
+
+import pytest
+
+from kubernetes_tpu.api import (
+    Resource,
+    Selector,
+    NodeSelector,
+    Taint,
+    Toleration,
+    compute_pod_resource_request,
+    find_matching_untolerated_taint,
+    parse_quantity_milli,
+    quantity_milli_value,
+    quantity_value,
+)
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+@pytest.mark.parametrize(
+    "s,milli",
+    [
+        ("100m", 100),
+        ("1", 1000),
+        ("0.5", 500),
+        ("2", 2000),
+        ("1Ki", 1024 * 1000),
+        ("1Mi", 1024**2 * 1000),
+        ("1Gi", 1024**3 * 1000),
+        ("1k", 1000 * 1000),
+        ("1M", 10**6 * 1000),
+        ("1e3", 1000 * 1000),
+        ("1.5Gi", 1024**3 * 1500),
+        ("0", 0),
+        (2, 2000),
+        (0.25, 250),
+    ],
+)
+def test_parse_quantity(s, milli):
+    assert parse_quantity_milli(s) == milli
+
+
+def test_quantity_value_rounds_up():
+    assert quantity_value("100m") == 1  # ceil(0.1)
+    assert quantity_value("1900m") == 2
+    assert quantity_milli_value("1900m") == 1900
+
+
+def test_invalid_quantity():
+    with pytest.raises(ValueError):
+        parse_quantity_milli("abc")
+    with pytest.raises(ValueError):
+        parse_quantity_milli("1Qi")
+
+
+def test_pod_resource_request_aggregation():
+    # max(sum(containers), max(init)) — fit.go:218 computePodResourceRequest
+    pod = (
+        MakePod()
+        .req({"cpu": "500m", "memory": "1Gi"})
+        .req({"cpu": "250m", "memory": "512Mi"})
+        .init_req({"cpu": "2", "memory": "256Mi"})
+        .obj()
+    )
+    r = compute_pod_resource_request(pod)
+    assert r.milli_cpu == 2000  # init container dominates cpu
+    assert r.memory == 1024**3 + 512 * 1024**2  # sum dominates memory
+
+
+def test_non_zero_request_defaults():
+    pod = MakePod().req({}).obj()
+    r = compute_pod_resource_request(pod, non_zero=True)
+    assert r.milli_cpu == 100
+    assert r.memory == 200 * 1024 * 1024
+    r0 = compute_pod_resource_request(pod)
+    assert r0.milli_cpu == 0 and r0.memory == 0
+
+
+def test_resource_from_list_extended():
+    r = Resource.from_resource_list({"cpu": "2", "memory": "4Gi", "nvidia.com/gpu": "2", "pods": "110"})
+    assert r.milli_cpu == 2000
+    assert r.memory == 4 * 1024**3
+    assert r.scalar["nvidia.com/gpu"] == 2
+    assert r.allowed_pod_number == 110
+
+
+class TestSelectors:
+    def test_match_labels(self):
+        s = Selector.from_label_selector({"matchLabels": {"app": "web"}})
+        assert s.matches({"app": "web", "x": "y"})
+        assert not s.matches({"app": "db"})
+
+    def test_nil_vs_empty(self):
+        assert Selector.from_label_selector(None) is None
+        s = Selector.from_label_selector({})
+        assert s is not None and s.matches({})
+
+    def test_expressions(self):
+        s = Selector.from_label_selector(
+            {"matchExpressions": [
+                {"key": "env", "operator": "In", "values": ["prod", "staging"]},
+                {"key": "canary", "operator": "DoesNotExist"},
+            ]}
+        )
+        assert s.matches({"env": "prod"})
+        assert not s.matches({"env": "dev"})
+        assert not s.matches({"env": "prod", "canary": "true"})
+
+    def test_not_in_matches_absent_key(self):
+        s = Selector.from_label_selector(
+            {"matchExpressions": [{"key": "env", "operator": "NotIn", "values": ["prod"]}]}
+        )
+        assert s.matches({})
+        assert s.matches({"env": "dev"})
+        assert not s.matches({"env": "prod"})
+
+    def test_gt_lt(self):
+        s = Selector.from_label_selector(
+            {"matchExpressions": [{"key": "cores", "operator": "Gt", "values": ["4"]}]}
+        )
+        assert s.matches({"cores": "8"})
+        assert not s.matches({"cores": "4"})
+        assert not s.matches({"cores": "abc"})
+        assert not s.matches({})
+
+
+class TestNodeSelector:
+    def test_terms_are_ored(self):
+        ns = NodeSelector.from_dict({"nodeSelectorTerms": [
+            {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["a"]}]},
+            {"matchExpressions": [{"key": "zone", "operator": "In", "values": ["b"]}]},
+        ]})
+        node_a = MakeNode("n1").labels({"zone": "a"}).obj()
+        node_c = MakeNode("n2").labels({"zone": "c"}).obj()
+        assert ns.matches(node_a)
+        assert not ns.matches(node_c)
+
+    def test_empty_term_matches_nothing(self):
+        ns = NodeSelector.from_dict({"nodeSelectorTerms": [{}]})
+        assert not ns.matches(MakeNode("n1").obj())
+
+    def test_match_fields(self):
+        ns = NodeSelector.from_dict({"nodeSelectorTerms": [
+            {"matchFields": [{"key": "metadata.name", "operator": "In", "values": ["n1"]}]},
+        ]})
+        assert ns.matches(MakeNode("n1").obj())
+        assert not ns.matches(MakeNode("n2").obj())
+
+
+class TestTolerations:
+    # Table mirrors toleration.go:38 ToleratesTaint rules.
+    def test_equal(self):
+        t = Toleration(key="k", operator="Equal", value="v", effect="NoSchedule")
+        assert t.tolerates(Taint("k", "v", "NoSchedule"))
+        assert not t.tolerates(Taint("k", "w", "NoSchedule"))
+
+    def test_exists_matches_all_values(self):
+        t = Toleration(key="k", operator="Exists")
+        assert t.tolerates(Taint("k", "anything", "NoExecute"))
+
+    def test_empty_key_exists_matches_everything(self):
+        t = Toleration(operator="Exists")
+        assert t.tolerates(Taint("any", "x", "NoSchedule"))
+
+    def test_effect_must_match_when_set(self):
+        t = Toleration(key="k", operator="Exists", effect="NoSchedule")
+        assert not t.tolerates(Taint("k", "", "NoExecute"))
+
+    def test_find_untolerated(self):
+        taints = [Taint("a", "1", "NoSchedule"), Taint("b", "2", "PreferNoSchedule")]
+        # PreferNoSchedule is not a DoNotSchedule effect -> ignored by filter
+        assert find_matching_untolerated_taint(taints, [Toleration(key="a", operator="Exists")]) is None
+        got = find_matching_untolerated_taint(taints, [])
+        assert got is not None and got.key == "a"
+
+
+def test_pod_from_dict_roundtrip_basics():
+    from kubernetes_tpu.api import Pod
+
+    pod = Pod.from_dict({
+        "metadata": {"name": "web-1", "namespace": "prod", "labels": {"app": "web"}},
+        "spec": {
+            "schedulerName": "default-scheduler",
+            "containers": [{
+                "name": "c",
+                "image": "nginx:1.25",
+                "resources": {"requests": {"cpu": "250m", "memory": "64Mi"}},
+                "ports": [{"containerPort": 80, "hostPort": 8080}],
+            }],
+            "nodeSelector": {"disk": "ssd"},
+            "tolerations": [{"key": "k", "operator": "Exists", "effect": "NoSchedule"}],
+            "topologySpreadConstraints": [{
+                "maxSkew": 1,
+                "topologyKey": "topology.kubernetes.io/zone",
+                "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": "web"}},
+            }],
+            "priority": 100,
+        },
+    })
+    assert pod.key == "prod/web-1"
+    assert pod.spec.containers[0].ports[0].host_port == 8080
+    assert pod.spec.topology_spread_constraints[0].max_skew == 1
+    assert pod.spec.priority == 100
+
+
+def test_init_container_non_zero_defaults():
+    # Non-zero defaults apply to init containers too (types.go:1131-1146).
+    pod = MakePod().req({"cpu": "50m"}).init_req({}).obj()
+    r = compute_pod_resource_request(pod, non_zero=True)
+    assert r.milli_cpu == 100  # best-effort init dominates 50m app container
+
+
+def test_node_selector_rejects_bad_operator():
+    with pytest.raises(ValueError):
+        NodeSelector.from_dict({"nodeSelectorTerms": [
+            {"matchExpressions": [{"key": "zone", "operator": "in", "values": ["a"]}]}]})
+
+
+def test_conditions_parsed():
+    from kubernetes_tpu.api import Node, Pod
+
+    n = Node.from_dict({"metadata": {"name": "n"}, "status": {
+        "conditions": [{"type": "Ready", "status": "False", "reason": "KubeletDown"}]}})
+    assert n.status.conditions[0].type == "Ready"
+    assert n.status.conditions[0].status == "False"
+    p = Pod.from_dict({"metadata": {"name": "p"}, "status": {
+        "conditions": [{"type": "PodScheduled", "status": "True"}]}})
+    assert p.status.conditions[0].type == "PodScheduled"

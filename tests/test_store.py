@@ -1,0 +1,167 @@
+"""Store tests: versioning, optimistic concurrency, List+Watch contract, binding.
+
+Pins the semantics client-go's Reflector depends on (reference:
+tools/cache/reflector.go:394 ListAndWatch; BindingREST storage.go:149)."""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.store import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyBoundError,
+    APIStore,
+    ConflictError,
+    NotFoundError,
+)
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def test_create_assigns_monotonic_rv():
+    s = APIStore()
+    p1 = s.create("pods", MakePod("a").obj())
+    p2 = s.create("pods", MakePod("b").obj())
+    assert 0 < p1.metadata.resource_version < p2.metadata.resource_version
+
+
+def test_update_conflict_detection():
+    s = APIStore()
+    p = s.create("pods", MakePod("a").obj())
+    stale = MakePod("a").obj()
+    stale.metadata.resource_version = p.metadata.resource_version - 1  # stale rv
+    with pytest.raises(ConflictError):
+        s.update("pods", stale)
+    p.spec.priority = 5
+    updated = s.update("pods", p)
+    assert updated.spec.priority == 5
+
+
+def test_guaranteed_update_retries():
+    s = APIStore()
+    s.create("pods", MakePod("a").obj())
+
+    def mutate(pod):
+        pod.metadata.labels["x"] = "y"
+        return pod
+
+    out = s.guaranteed_update("pods", "default/a", mutate)
+    assert out.metadata.labels["x"] == "y"
+
+
+def test_list_watch_contract():
+    """Every event after LIST's rv is seen exactly once, in order."""
+    s = APIStore()
+    s.create("pods", MakePod("a").obj())
+    items, rv = s.list("pods")
+    assert len(items) == 1
+
+    w = s.watch("pods", since_rv=rv)
+    s.create("pods", MakePod("b").obj())
+    s.delete("pods", "default/a")
+
+    ev1 = w.get(timeout=1)
+    ev2 = w.get(timeout=1)
+    assert ev1.type == ADDED and ev1.obj.metadata.name == "b"
+    assert ev2.type == DELETED and ev2.obj.metadata.name == "a"
+    assert ev1.resource_version < ev2.resource_version
+    w.stop()
+
+
+def test_watch_replay_from_history():
+    s = APIStore()
+    s.create("pods", MakePod("a").obj())
+    s.create("pods", MakePod("b").obj())
+    w = s.watch("pods", since_rv=0)
+    evs = [w.get(timeout=1), w.get(timeout=1)]
+    assert [e.obj.metadata.name for e in evs] == ["a", "b"]
+    w.stop()
+
+
+def test_watch_filters_kind():
+    s = APIStore()
+    w = s.watch("pods")
+    s.create("nodes", MakeNode("n1").obj())
+    s.create("pods", MakePod("a").obj())
+    ev = w.get(timeout=1)
+    assert ev.kind == "pods"
+    w.stop()
+
+
+def test_bind_transactional():
+    s = APIStore()
+    s.create("pods", MakePod("a").obj())
+    s.bind("default", "a", "node-1")
+    assert s.get("pods", "default/a").spec.node_name == "node-1"
+    with pytest.raises(AlreadyBoundError):
+        s.bind("default", "a", "node-2")
+
+
+def test_store_copies_on_write():
+    s = APIStore()
+    pod = MakePod("a").obj()
+    s.create("pods", pod)
+    pod.spec.priority = 99  # caller mutation must not leak into the store
+    assert s.get("pods", "default/a").spec.priority == 0
+
+
+def test_not_found():
+    s = APIStore()
+    with pytest.raises(NotFoundError):
+        s.get("pods", "default/missing")
+    with pytest.raises(NotFoundError):
+        s.delete("pods", "default/missing")
+
+
+def test_concurrent_writers_unique_rvs():
+    s = APIStore()
+    errs = []
+
+    def writer(i):
+        try:
+            for j in range(50):
+                s.create("pods", MakePod(f"p-{i}-{j}").obj())
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    assert not errs
+    items, rv = s.list("pods")
+    assert len(items) == 400
+    rvs = [o.metadata.resource_version for o in items]
+    assert len(set(rvs)) == 400 and max(rvs) <= rv
+
+
+def test_get_returns_copy():
+    """Caller mutation of a fetched object must not corrupt the store."""
+    s = APIStore()
+    s.create("pods", MakePod("a").obj())
+    p = s.get("pods", "default/a")
+    p.spec.node_name = "sneaky"
+    assert s.get("pods", "default/a").spec.node_name == ""
+    s.bind("default", "a", "n1")  # must not see "sneaky"
+
+
+def test_delete_event_carries_post_delete_rv():
+    s = APIStore()
+    s.create("pods", MakePod("a").obj())
+    w = s.watch("pods", since_rv=s.resource_version())
+    s.delete("pods", "default/a")
+    ev = w.get(timeout=1)
+    assert ev.type == DELETED
+    assert ev.obj.metadata.resource_version == ev.resource_version
+    w.stop()
+
+
+def test_watch_too_old_rv_raises():
+    from kubernetes_tpu.store import ResourceVersionTooOldError
+
+    s = APIStore()
+    s._history_limit = 8  # force trimming
+    for i in range(20):
+        s.create("pods", MakePod(f"p{i}").obj())
+    with pytest.raises(ResourceVersionTooOldError):
+        s.watch("pods", since_rv=1)
