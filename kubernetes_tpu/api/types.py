@@ -471,10 +471,15 @@ class Node:
 
     kind = "Node"
 
+    def __post_init__(self):
+        self.metadata.namespace = ""  # cluster-scoped: one store key scheme
+
     @staticmethod
     def from_dict(d: Mapping) -> "Node":
+        meta = ObjectMeta.from_dict(d.get("metadata") or {})
+        meta.namespace = ""  # Nodes are cluster-scoped
         return Node(
-            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            metadata=meta,
             spec=NodeSpec.from_dict(d.get("spec") or {}),
             status=NodeStatus.from_dict(d.get("status") or {}),
         )
@@ -485,6 +490,15 @@ class Namespace:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
 
     kind = "Namespace"
+
+    def __post_init__(self):
+        self.metadata.namespace = ""  # cluster-scoped
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Namespace":
+        meta = ObjectMeta.from_dict(d.get("metadata") or {})
+        meta.namespace = ""  # Namespaces are cluster-scoped
+        return Namespace(metadata=meta)
 
 
 @dataclass

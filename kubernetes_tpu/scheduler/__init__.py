@@ -1,0 +1,16 @@
+"""L5 — the scheduler: framework, serial oracle, queue, cache, batch TPU driver."""
+
+from .cache import Cache  # noqa: F401
+from .framework import (  # noqa: F401
+    MAX_NODE_SCORE,
+    Code,
+    CycleState,
+    NodeInfo,
+    PodInfo,
+    PreFilterResult,
+    Snapshot,
+    Status,
+)
+from .queue import QueuedPodInfo, SchedulingQueue  # noqa: F401
+from .runtime import DEFAULT_WEIGHTS, Framework  # noqa: F401
+from .serial import ScheduleResult, Scheduler, num_feasible_nodes_to_find  # noqa: F401

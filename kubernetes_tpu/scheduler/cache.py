@@ -1,0 +1,222 @@
+"""Scheduler cache: authoritative in-scheduler cluster state with assumed-pod
+lifecycle and generation-based incremental snapshotting.
+
+reference: pkg/scheduler/backend/cache/cache.go — cacheImpl :58 (recency-ordered
+node list :71-73), UpdateSnapshot :186 (copies only NodeInfos whose Generation
+is newer than the snapshot's — the diff stream the TPU tensorizer mirrors into
+HBM), AssumePod :361, FinishBinding :376, ForgetPod :404, expiry of assumed pods
+(scheduler.go:57-59 durationToExpireAssumedPod).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from ..api import Node, Pod
+from ..utils import Clock
+from .framework import NodeInfo, PodInfo, Snapshot
+
+
+class Cache:
+    def __init__(self, clock: Optional[Clock] = None, ttl: float = 15.0):
+        self._lock = threading.RLock()
+        self._clock = clock or Clock()
+        self._ttl = ttl
+        self._generation = 0
+        self._nodes: Dict[str, NodeInfo] = {}
+        # pod key -> node name for every known (added or assumed) pod
+        self._pod_nodes: Dict[str, str] = {}
+        self._assumed: Dict[str, float] = {}  # pod key -> deadline (0 = no expiry yet)
+        self._snapshot_generation = -1
+        self._snapshot: Optional[Snapshot] = None
+        # image name -> shared ImageStateSummary (num_nodes mutated in place)
+        self._image_entries: Dict[str, object] = {}
+
+    def _next_gen(self) -> int:
+        self._generation += 1
+        return self._generation
+
+    def _touch(self, ni: NodeInfo) -> None:
+        ni.generation = self._next_gen()
+
+    # -- nodes -----------------------------------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        with self._lock:
+            ni = self._nodes.get(node.metadata.name)
+            if ni is None:
+                ni = NodeInfo()
+                self._nodes[node.metadata.name] = ni
+            elif ni.node is not None:
+                self._remove_image_counts(ni.node)
+            ni.set_node(node)
+            ni.image_states = self._add_image_counts(node)
+            self._touch(ni)
+
+    def update_node(self, node: Node) -> None:
+        self.add_node(node)
+
+    def remove_node(self, name: str) -> None:
+        with self._lock:
+            ni = self._nodes.get(name)
+            if ni is None:
+                return
+            if ni.node is not None:
+                self._remove_image_counts(ni.node)
+            if ni.pods:
+                # Bound pods still reference this node: keep the NodeInfo as a
+                # placeholder (node=None) so their accounting survives a node
+                # flap (reference: cache.go RemoveNode keeps nodeInfo until the
+                # last pod is removed). Snapshots skip placeholder nodes.
+                ni.node = None
+                self._touch(ni)
+            else:
+                self._nodes.pop(name, None)
+            self._generation += 1  # force snapshot rebuild to drop the node
+
+    # Image-state bookkeeping mirrors cache.go's shared imageStates map: one
+    # ImageStateSummary object per image, shared by every NodeInfo that has it,
+    # with NumNodes mutated in place — O(images of changed node) per event
+    # instead of a full-cluster recount.
+
+    def _add_image_counts(self, node: Node):
+        from .framework import ImageStateSummary
+
+        states = {}
+        for img in node.status.images:
+            for nm in img.names:
+                entry = self._image_entries.get(nm)
+                if entry is None:
+                    entry = ImageStateSummary(size=img.size_bytes, num_nodes=0)
+                    self._image_entries[nm] = entry
+                entry.num_nodes += 1
+                entry.size = img.size_bytes
+                states[nm] = entry
+        return states
+
+    def _remove_image_counts(self, node: Node) -> None:
+        for img in node.status.images:
+            for nm in img.names:
+                entry = self._image_entries.get(nm)
+                if entry is not None:
+                    entry.num_nodes -= 1
+                    if entry.num_nodes <= 0:
+                        self._image_entries.pop(nm, None)
+
+    # -- pods ------------------------------------------------------------------
+
+    def add_pod(self, pod: Pod) -> None:
+        """A bound pod was observed (informer ADD). Confirms an assumed pod."""
+        with self._lock:
+            key = pod.key
+            if key in self._assumed:
+                # confirmation: informer caught up with our optimistic assume
+                self._assumed.pop(key, None)
+                if self._pod_nodes.get(key) == pod.spec.node_name:
+                    return  # already accounted
+                self._remove_pod_internal(key)
+            elif key in self._pod_nodes:
+                return
+            self._add_pod_internal(pod)
+
+    def _add_pod_internal(self, pod: Pod) -> None:
+        node_name = pod.spec.node_name
+        if not node_name:
+            return
+        ni = self._nodes.get(node_name)
+        if ni is None:
+            ni = NodeInfo()  # node not yet observed; pods land on a placeholder
+            self._nodes[node_name] = ni
+        ni.add_pod(PodInfo(pod))
+        self._pod_nodes[pod.key] = node_name
+        self._touch(ni)
+
+    def _remove_pod_internal(self, key: str) -> None:
+        node_name = self._pod_nodes.pop(key, None)
+        if node_name is None:
+            return
+        ni = self._nodes.get(node_name)
+        if ni is None:
+            return
+        ns, name = key.split("/", 1)
+        for pi in ni.pods:
+            if pi.pod.metadata.namespace == ns and pi.pod.metadata.name == name:
+                ni.remove_pod(pi.pod)
+                break
+        self._touch(ni)
+
+    def update_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._remove_pod_internal(pod.key)
+            self._add_pod_internal(pod)
+
+    def remove_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._assumed.pop(pod.key, None)
+            self._remove_pod_internal(pod.key)
+
+    # -- assumed pod lifecycle (cache.go:361-420) ------------------------------
+
+    def assume_pod(self, pod: Pod, node_name: str) -> None:
+        with self._lock:
+            key = pod.key
+            if key in self._pod_nodes:
+                raise ValueError(f"pod {key} is already in the cache")
+            pod.spec.node_name = node_name
+            self._add_pod_internal(pod)
+            self._assumed[key] = 0.0  # no expiry until binding finishes
+
+    def finish_binding(self, pod: Pod) -> None:
+        with self._lock:
+            if pod.key in self._assumed:
+                self._assumed[pod.key] = self._clock.now() + self._ttl
+
+    def forget_pod(self, pod: Pod) -> None:
+        with self._lock:
+            self._assumed.pop(pod.key, None)
+            self._remove_pod_internal(pod.key)
+
+    def is_assumed(self, key: str) -> bool:
+        with self._lock:
+            return key in self._assumed
+
+    def cleanup_expired_assumed_pods(self) -> List[str]:
+        with self._lock:
+            now = self._clock.now()
+            expired = [k for k, dl in self._assumed.items() if dl and dl < now]
+            for key in expired:
+                self._assumed.pop(key, None)
+                self._remove_pod_internal(key)
+            return expired
+
+    # -- snapshotting (cache.go:186 UpdateSnapshot) ----------------------------
+
+    def update_snapshot(self) -> Snapshot:
+        """Incremental: clone only NodeInfos newer than the last snapshot."""
+        with self._lock:
+            if self._snapshot is not None and self._snapshot_generation == self._generation:
+                return self._snapshot
+            prev = self._snapshot.node_info_map if self._snapshot is not None else {}
+            new_map: Dict[str, NodeInfo] = {}
+            for name, ni in self._nodes.items():
+                if ni.node is None:
+                    continue  # placeholder without a real Node yet
+                old = prev.get(name)
+                if old is not None and old.generation == ni.generation:
+                    new_map[name] = old
+                else:
+                    new_map[name] = ni.clone()
+            snap = Snapshot(new_map)
+            snap.generation = self._generation
+            self._snapshot = snap
+            self._snapshot_generation = self._generation
+            return snap
+
+    def node_count(self) -> int:
+        with self._lock:
+            return sum(1 for ni in self._nodes.values() if ni.node is not None)
+
+    def pod_count(self) -> int:
+        with self._lock:
+            return len(self._pod_nodes)

@@ -1,0 +1,305 @@
+"""Scheduler framework: plugin contract, statuses, CycleState, NodeInfo.
+
+Re-provides the Scheduler Framework plugin API (reference:
+pkg/scheduler/framework/interface.go — the 11 extension points PreEnqueue,
+QueueSort, PreFilter, Filter, PostFilter, PreScore, Score(+Normalize), Reserve,
+Permit, PreBind, Bind, PostBind), the Status/code vocabulary (interface.go:186-293),
+CycleState (cycle_state.go:48), and NodeInfo/PodInfo (types.go:734/:412).
+
+The serial implementations in scheduler/plugins are the *correctness oracle and
+CPU fallback*; the TPU path (ops/) vectorizes the same semantics into
+feasibility/cost tensors and is parity-tested against these.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from ..api import (
+    Pod,
+    Resource,
+    compute_pod_resource_request,
+)
+
+MAX_NODE_SCORE = 100  # interface.go:255
+MIN_NODE_SCORE = 0
+
+
+class Code(enum.Enum):
+    """Status codes (reference: interface.go:186)."""
+
+    SUCCESS = 0
+    ERROR = 1
+    UNSCHEDULABLE = 2
+    UNSCHEDULABLE_AND_UNRESOLVABLE = 3
+    WAIT = 4
+    SKIP = 5
+    PENDING = 6
+
+
+@dataclass
+class Status:
+    code: Code = Code.SUCCESS
+    reasons: Tuple[str, ...] = ()
+    plugin: str = ""
+
+    def is_success(self) -> bool:
+        return self.code == Code.SUCCESS
+
+    def is_skip(self) -> bool:
+        return self.code == Code.SKIP
+
+    def is_rejected(self) -> bool:
+        return self.code in (Code.UNSCHEDULABLE, Code.UNSCHEDULABLE_AND_UNRESOLVABLE, Code.PENDING)
+
+    def message(self) -> str:
+        return "; ".join(self.reasons)
+
+    @staticmethod
+    def success() -> "Status":
+        return Status()
+
+    @staticmethod
+    def unschedulable(*reasons: str, plugin: str = "") -> "Status":
+        return Status(Code.UNSCHEDULABLE, tuple(reasons), plugin)
+
+    @staticmethod
+    def unresolvable(*reasons: str, plugin: str = "") -> "Status":
+        return Status(Code.UNSCHEDULABLE_AND_UNRESOLVABLE, tuple(reasons), plugin)
+
+    @staticmethod
+    def error(*reasons: str, plugin: str = "") -> "Status":
+        return Status(Code.ERROR, tuple(reasons), plugin)
+
+    @staticmethod
+    def skip(plugin: str = "") -> "Status":
+        return Status(Code.SKIP, (), plugin)
+
+
+SUCCESS = Status.success()
+
+
+class CycleState:
+    """Per-scheduling-cycle typed KV store (reference: cycle_state.go:48)."""
+
+    def __init__(self):
+        self._data: Dict[str, Any] = {}
+        self.skip_filter_plugins: Set[str] = set()
+        self.skip_score_plugins: Set[str] = set()
+
+    def write(self, key: str, value: Any) -> None:
+        self._data[key] = value
+
+    def read(self, key: str) -> Any:
+        return self._data[key]
+
+    def read_or_none(self, key: str) -> Any:
+        return self._data.get(key)
+
+    def clone(self) -> "CycleState":
+        cs = CycleState()
+        cs._data = {k: (v.clone() if hasattr(v, "clone") else v) for k, v in self._data.items()}
+        cs.skip_filter_plugins = set(self.skip_filter_plugins)
+        cs.skip_score_plugins = set(self.skip_score_plugins)
+        return cs
+
+
+@dataclass
+class PreFilterResult:
+    """Optional node-subset fast path (reference: interface.go:841)."""
+
+    node_names: Optional[Set[str]] = None  # None = all nodes
+
+    def merge(self, other: "PreFilterResult") -> "PreFilterResult":
+        if self.node_names is None:
+            return PreFilterResult(None if other.node_names is None else set(other.node_names))
+        if other.node_names is None:
+            return PreFilterResult(set(self.node_names))
+        return PreFilterResult(self.node_names & other.node_names)
+
+    def all_nodes(self) -> bool:
+        return self.node_names is None
+
+
+class PodInfo:
+    """Pod + precomputed scheduling-relevant state (reference: types.go:412)."""
+
+    __slots__ = (
+        "pod",
+        "request",
+        "non_zero_request",
+        "required_affinity_terms",
+        "required_anti_affinity_terms",
+        "preferred_affinity_terms",
+        "preferred_anti_affinity_terms",
+    )
+
+    def __init__(self, pod: Pod):
+        self.pod = pod
+        self.request: Resource = compute_pod_resource_request(pod)
+        self.non_zero_request: Resource = compute_pod_resource_request(pod, non_zero=True)
+        aff = pod.spec.affinity
+        self.required_affinity_terms = tuple(aff.pod_affinity_required) if aff else ()
+        self.required_anti_affinity_terms = tuple(aff.pod_anti_affinity_required) if aff else ()
+        self.preferred_affinity_terms = tuple(aff.pod_affinity_preferred) if aff else ()
+        self.preferred_anti_affinity_terms = tuple(aff.pod_anti_affinity_preferred) if aff else ()
+
+
+@dataclass
+class ImageStateSummary:
+    """reference: types.go ImageStateSummary {Size, NumNodes}."""
+
+    size: int
+    num_nodes: int
+
+
+class NodeInfo:
+    """Aggregated per-node scheduling state (reference: types.go:734).
+
+    Generation increments on every mutation and drives incremental snapshotting
+    (cache.go:186) — the same diff stream the TPU tensorizer consumes.
+    """
+
+    __slots__ = (
+        "node",
+        "pods",
+        "pods_with_affinity",
+        "pods_with_required_anti_affinity",
+        "requested",
+        "non_zero_requested",
+        "allocatable",
+        "used_ports",
+        "image_states",
+        "generation",
+    )
+
+    def __init__(self, node=None):
+        self.node = None
+        self.pods: List[PodInfo] = []
+        self.pods_with_affinity: List[PodInfo] = []
+        self.pods_with_required_anti_affinity: List[PodInfo] = []
+        self.requested = Resource()
+        self.non_zero_requested = Resource()
+        self.allocatable = Resource()
+        self.used_ports: Set[Tuple[str, str, int]] = set()  # (hostIP, proto, port)
+        self.image_states: Dict[str, ImageStateSummary] = {}
+        self.generation = 0
+        if node is not None:
+            self.set_node(node)
+
+    def set_node(self, node) -> None:
+        self.node = node
+        self.allocatable = Resource.from_resource_list(node.status.allocatable)
+        # Per-node view of image states; the Cache overwrites num_nodes with the
+        # cluster-wide spread count (cache.go createImageStateSummary).
+        if node.status.images and not self.image_states:
+            self.image_states = {
+                nm: ImageStateSummary(size=img.size_bytes, num_nodes=1)
+                for img in node.status.images
+                for nm in img.names
+            }
+
+    def add_pod(self, pod_info: PodInfo) -> None:
+        self.pods.append(pod_info)
+        if pod_info.required_affinity_terms or pod_info.preferred_affinity_terms or \
+           pod_info.required_anti_affinity_terms or pod_info.preferred_anti_affinity_terms:
+            self.pods_with_affinity.append(pod_info)
+        if pod_info.required_anti_affinity_terms:
+            self.pods_with_required_anti_affinity.append(pod_info)
+        self.requested.add(pod_info.request)
+        self.non_zero_requested.add(pod_info.non_zero_request)
+        for port in _host_ports(pod_info.pod):
+            self.used_ports.add(port)
+
+    def remove_pod(self, pod: Pod) -> bool:
+        uid = pod.metadata.uid
+        for i, pi in enumerate(self.pods):
+            if pi.pod.metadata.uid == uid:
+                self.pods.pop(i)
+                self.pods_with_affinity = [p for p in self.pods_with_affinity if p.pod.metadata.uid != uid]
+                self.pods_with_required_anti_affinity = [
+                    p for p in self.pods_with_required_anti_affinity if p.pod.metadata.uid != uid
+                ]
+                self.requested.sub(pi.request)
+                self.non_zero_requested.sub(pi.non_zero_request)
+                for port in _host_ports(pi.pod):
+                    self.used_ports.discard(port)
+                return True
+        return False
+
+    def clone(self) -> "NodeInfo":
+        ni = NodeInfo()
+        ni.node = self.node
+        ni.pods = list(self.pods)
+        ni.pods_with_affinity = list(self.pods_with_affinity)
+        ni.pods_with_required_anti_affinity = list(self.pods_with_required_anti_affinity)
+        ni.requested = self.requested.clone()
+        ni.non_zero_requested = self.non_zero_requested.clone()
+        ni.allocatable = self.allocatable.clone()
+        ni.used_ports = set(self.used_ports)
+        ni.image_states = dict(self.image_states)
+        ni.generation = self.generation
+        return ni
+
+
+def _host_ports(pod: Pod) -> Iterable[Tuple[str, str, int]]:
+    for c in pod.spec.containers:
+        for p in c.ports:
+            if p.host_port > 0:
+                yield (p.host_ip or "0.0.0.0", p.protocol or "TCP", p.host_port)
+
+
+class Snapshot:
+    """Immutable per-cycle view of cluster state (reference: backend/cache/snapshot.go:198)."""
+
+    def __init__(self, node_infos: Optional[Dict[str, NodeInfo]] = None):
+        self.node_info_map: Dict[str, NodeInfo] = node_infos or {}
+        self.node_info_list: List[NodeInfo] = list(self.node_info_map.values())
+        self.have_pods_with_affinity_list: List[NodeInfo] = [
+            n for n in self.node_info_list if n.pods_with_affinity
+        ]
+        self.have_pods_with_required_anti_affinity_list: List[NodeInfo] = [
+            n for n in self.node_info_list if n.pods_with_required_anti_affinity
+        ]
+        self.generation = 0
+
+    def get(self, name: str) -> Optional[NodeInfo]:
+        return self.node_info_map.get(name)
+
+    def __len__(self) -> int:
+        return len(self.node_info_list)
+
+
+# ---------------------------------------------------------------------------
+# Plugin base classes. A plugin implements any subset; the framework runtime
+# dispatches by hasattr on these method names.
+# ---------------------------------------------------------------------------
+
+
+class Plugin:
+    name: str = "Plugin"
+
+    # PreEnqueue(pod) -> Status
+    # pre_filter(state, pod, snapshot) -> (PreFilterResult|None, Status)
+    # filter(state, pod, node_info) -> Status
+    # post_filter(state, pod, statuses) -> (nominated_node|None, Status)
+    # pre_score(state, pod, nodes) -> Status
+    # score(state, pod, node_info) -> (int, Status)
+    # normalize_score(state, pod, scores: dict) -> Status
+    # reserve/unreserve, permit, pre_bind, bind, post_bind
+    # add_pod/remove_pod: PreFilterExtensions for incremental state updates
+
+
+def default_normalize_score(max_priority: int, reverse: bool, scores: Dict[str, int]) -> None:
+    """reference: plugins/helper/normalize_score.go DefaultNormalizeScore."""
+    max_count = max(scores.values(), default=0)
+    if max_count == 0:
+        if reverse:
+            for k in scores:
+                scores[k] = max_priority
+        return
+    for k, v in scores.items():
+        s = max_priority * v // max_count
+        scores[k] = max_priority - s if reverse else s

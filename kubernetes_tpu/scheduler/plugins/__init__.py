@@ -1,0 +1,37 @@
+"""Serial plugin implementations — the correctness oracle for the TPU path.
+
+Default enabled set mirrors apis/config/v1/default_plugins.go:30-56 (minus the
+volume plugins, which gate on a volume subsystem this build adds later).
+"""
+
+from .fit import BalancedAllocation, NodeResourcesFit  # noqa: F401
+from .interpod_affinity import InterPodAffinity  # noqa: F401
+from .node_plugins import (  # noqa: F401
+    ImageLocality,
+    NodeAffinity,
+    NodeName,
+    NodePorts,
+    NodeUnschedulable,
+    PrioritySort,
+    SchedulingGates,
+    TaintToleration,
+)
+from .topology_spread import PodTopologySpread  # noqa: F401
+
+
+def default_plugins():
+    """Registry + default ordering (plugins/registry.go:64, default_plugins.go:30)."""
+    return [
+        PrioritySort(),
+        SchedulingGates(),
+        NodeUnschedulable(),
+        NodeName(),
+        TaintToleration(),
+        NodeAffinity(),
+        NodePorts(),
+        NodeResourcesFit(),
+        PodTopologySpread(),
+        InterPodAffinity(),
+        BalancedAllocation(),
+        ImageLocality(),
+    ]

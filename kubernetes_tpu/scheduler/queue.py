@@ -1,0 +1,250 @@
+"""3-tier scheduling queue: activeQ + backoffQ + unschedulablePods.
+
+reference: pkg/scheduler/backend/queue/scheduling_queue.go — PriorityQueue :154,
+AddUnschedulableIfNotPresent :741, flushBackoffQCompleted :790, Pop :829 (blocks),
+MoveAllToActiveOrBackoffQueue :1028; backoff_queue.go:64 (initial 1s, max 10s);
+flush cadence: backoff every 1s, unschedulable every 30s (:350).
+
+QueueingHints are simplified to event-kind gating: on a cluster event, all
+unschedulable pods move to backoff/active (the pre-hints behavior); per-plugin
+hint functions can be layered on later without changing this interface.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api import Pod
+from ..utils import Clock
+
+DEFAULT_POD_INITIAL_BACKOFF = 1.0  # seconds (scheduler.go:252)
+DEFAULT_POD_MAX_BACKOFF = 10.0  # seconds (scheduler.go:253)
+FLUSH_UNSCHEDULABLE_TIMEOUT = 30.0  # scheduling_queue.go:91
+
+
+class _LessItem:
+    """Adapts a QueueSort plugin's less(a, b) into a heap sort key."""
+
+    __slots__ = ("qp", "less")
+
+    def __init__(self, qp, less):
+        self.qp = qp
+        self.less = less
+
+    def __lt__(self, other):
+        return self.less(self.qp, other.qp)
+
+    def __eq__(self, other):
+        return not self.less(self.qp, other.qp) and not self.less(other.qp, self.qp)
+
+
+@dataclass
+class QueuedPodInfo:
+    """reference: framework types.go:362 QueuedPodInfo."""
+
+    pod: Pod
+    timestamp: float = 0.0
+    attempts: int = 0
+    unschedulable_plugins: Tuple[str, ...] = ()
+
+    @property
+    def key(self) -> str:
+        return self.pod.key
+
+
+class SchedulingQueue:
+    def __init__(self, clock: Optional[Clock] = None,
+                 initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
+                 max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
+                 less=None):
+        self._clock = clock or Clock()
+        self._initial_backoff = initial_backoff
+        self._max_backoff = max_backoff
+        self._less = less  # (QueuedPodInfo, QueuedPodInfo) -> bool; default priority desc
+        self._lock = threading.Condition()
+        self._seq = itertools.count()
+        # activeQ: heap of (sort_key, seq, QueuedPodInfo)
+        self._active: List[Tuple] = []
+        self._backoff: List[Tuple[float, int, QueuedPodInfo]] = []
+        self._unschedulable: Dict[str, QueuedPodInfo] = {}
+        self._in_active: Dict[str, QueuedPodInfo] = {}
+        self._closed = False
+
+    # -- ordering --------------------------------------------------------------
+
+    def _sort_key(self, qp: QueuedPodInfo):
+        # default QueueSort: priority desc, then timestamp asc (priority_sort.go).
+        # A custom QueueSort plugin's less() overrides via _LessItem comparison.
+        if self._less is not None:
+            return _LessItem(qp, self._less)
+        return (-qp.pod.spec.priority, qp.timestamp)
+
+    # -- add paths -------------------------------------------------------------
+
+    def add(self, pod: Pod) -> None:
+        with self._lock:
+            qp = QueuedPodInfo(pod=pod, timestamp=self._clock.now())
+            self._push_active(qp)
+            self._lock.notify()
+
+    def _push_active(self, qp: QueuedPodInfo) -> None:
+        self._unschedulable.pop(qp.key, None)
+        if qp.key in self._in_active:
+            return
+        self._in_active[qp.key] = qp
+        heapq.heappush(self._active, (self._sort_key(qp), next(self._seq), qp))
+
+    def add_unschedulable(self, qp: QueuedPodInfo) -> None:
+        """AddUnschedulableIfNotPresent (:741): failed pods wait for an event
+        (unschedulable map) — backoff applies when they are moved back."""
+        with self._lock:
+            qp.timestamp = self._clock.now()
+            self._unschedulable[qp.key] = qp
+
+    def _backoff_duration(self, attempts: int) -> float:
+        d = self._initial_backoff * (2 ** max(attempts - 1, 0))
+        return min(d, self._max_backoff)
+
+    def move_all_to_active_or_backoff(self) -> None:
+        """MoveAllToActiveOrBackoffQueue (:1028) on a cluster event."""
+        with self._lock:
+            for key, qp in list(self._unschedulable.items()):
+                self._unschedulable.pop(key)
+                remaining = self._backoff_remaining(qp)
+                if remaining > 0:
+                    heapq.heappush(self._backoff, (self._clock.now() + remaining, next(self._seq), qp))
+                else:
+                    self._push_active(qp)
+            self._lock.notify_all()
+
+    def _backoff_remaining(self, qp: QueuedPodInfo) -> float:
+        if qp.attempts == 0:
+            return 0.0
+        expiry = qp.timestamp + self._backoff_duration(qp.attempts)
+        return max(0.0, expiry - self._clock.now())
+
+    # -- flush loops (queue.Run :350) ------------------------------------------
+
+    def flush_backoff_completed(self) -> None:
+        with self._lock:
+            now = self._clock.now()
+            moved = False
+            while self._backoff and self._backoff[0][0] <= now:
+                _, _, qp = heapq.heappop(self._backoff)
+                self._push_active(qp)
+                moved = True
+            if moved:
+                self._lock.notify_all()
+
+    def flush_unschedulable_left_over(self) -> None:
+        """Pods stuck unschedulable longer than 30s get requeued (:350)."""
+        with self._lock:
+            now = self._clock.now()
+            moved = False
+            for key, qp in list(self._unschedulable.items()):
+                if now - qp.timestamp > FLUSH_UNSCHEDULABLE_TIMEOUT:
+                    self._unschedulable.pop(key)
+                    self._push_active(qp)
+                    moved = True
+            if moved:
+                self._lock.notify_all()
+
+    # -- pop -------------------------------------------------------------------
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[QueuedPodInfo]:
+        with self._lock:
+            while not self._active and not self._closed:
+                if not self._lock.wait(timeout=timeout):
+                    return None
+            if self._closed and not self._active:
+                return None
+            _, _, qp = heapq.heappop(self._active)
+            self._in_active.pop(qp.key, None)
+            qp.attempts += 1
+            return qp
+
+    def pop_batch(self, max_n: int, timeout: Optional[float] = None) -> List[QueuedPodInfo]:
+        """Drain up to max_n pods for a batched TPU solve (the batching analog of
+        the one-pod Pop the serial loop uses)."""
+        out: List[QueuedPodInfo] = []
+        first = self.pop(timeout=timeout)
+        if first is None:
+            return out
+        out.append(first)
+        with self._lock:
+            while self._active and len(out) < max_n:
+                _, _, qp = heapq.heappop(self._active)
+                self._in_active.pop(qp.key, None)
+                qp.attempts += 1
+                out.append(qp)
+        return out
+
+    # -- removal / updates -----------------------------------------------------
+
+    def update(self, pod: Pod) -> bool:
+        """Pod MODIFIED while queued. Only a spec change can affect schedulability
+        (reference: eventhandlers.go updatePodInSchedulingQueue + util.PodChanged);
+        status-only patches (e.g. our own PodScheduled condition write) must NOT
+        requeue, or every failure would loop pod->patch->event->retry forever.
+        Returns True if the pod was known to the queue."""
+        with self._lock:
+            key = pod.key
+            tracked = None
+            if key in self._in_active:
+                tracked = self._in_active[key]
+            else:
+                for _, _, qp in self._backoff:
+                    if qp.key == key:
+                        tracked = qp
+                        break
+                if tracked is None:
+                    tracked = self._unschedulable.get(key)
+            if tracked is None:
+                return False
+            spec_changed = tracked.pod.spec != pod.spec
+            tracked.pod = pod
+            if spec_changed:
+                if key in self._unschedulable:
+                    self._unschedulable.pop(key)
+                    remaining = self._backoff_remaining(tracked)
+                    if remaining > 0:
+                        heapq.heappush(self._backoff, (self._clock.now() + remaining,
+                                                       next(self._seq), tracked))
+                    else:
+                        self._push_active(tracked)
+                        self._lock.notify()
+                elif key in self._in_active:
+                    # Re-sort: the heap key was computed at push time; a spec
+                    # change (e.g. priority) must change pop order.
+                    self._in_active.pop(key)
+                    self._active = [(k, s, q) for k, s, q in self._active if q.key != key]
+                    heapq.heapify(self._active)
+                    self._push_active(tracked)
+                    self._lock.notify()
+            return True
+
+    def delete(self, pod: Pod) -> None:
+        with self._lock:
+            key = pod.key
+            self._unschedulable.pop(key, None)
+            if key in self._in_active:
+                self._in_active.pop(key)
+                self._active = [(k, s, qp) for k, s, qp in self._active if qp.key != key]
+                heapq.heapify(self._active)
+            self._backoff = [(t, s, qp) for t, s, qp in self._backoff if qp.key != key]
+            heapq.heapify(self._backoff)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._lock.notify_all()
+
+    # -- introspection ---------------------------------------------------------
+
+    def lengths(self) -> Tuple[int, int, int]:
+        with self._lock:
+            return len(self._active), len(self._backoff), len(self._unschedulable)

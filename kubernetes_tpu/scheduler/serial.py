@@ -1,0 +1,339 @@
+"""Serial scheduler — the CPU oracle/fallback loop.
+
+reference: pkg/scheduler/schedule_one.go — ScheduleOne :65, schedulingCycle :138,
+schedulePod :410, findNodesThatFitPod :462, findNodesThatPassFilters :590,
+numFeasibleNodesToFind :675 (adaptive 50 - nodes/125 %, floor 5%, min 100),
+prioritizeNodes :754, selectHost :872, assume :945, bind :967,
+handleSchedulingFailure :1022.
+
+Semantics-identical to the reference's default-plugin pipeline; used as the
+parity oracle for the TPU batch path. One deliberate divergence: selectHost
+breaks score ties by lowest node index (deterministic) instead of reservoir
+sampling — the TPU argmax does the same, making parity exact.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..api import Pod
+from ..store import ADDED, DELETED, MODIFIED, APIStore
+from ..utils import Clock
+from .cache import Cache
+from .framework import CycleState, NodeInfo, Snapshot, Status
+from .queue import QueuedPodInfo, SchedulingQueue
+from .runtime import Framework
+
+MIN_FEASIBLE_NODES_TO_FIND = 100  # schedule_one.go:52
+MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # schedule_one.go:57
+
+
+def num_feasible_nodes_to_find(num_all_nodes: int, percentage: int = 0) -> int:
+    """schedule_one.go:675-701."""
+    if num_all_nodes < MIN_FEASIBLE_NODES_TO_FIND:
+        return num_all_nodes
+    if percentage == 0:
+        percentage = int(50 - num_all_nodes / 125)
+        if percentage < MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND:
+            percentage = MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND
+    if percentage >= 100:
+        return num_all_nodes
+    num = num_all_nodes * percentage // 100
+    return max(num, MIN_FEASIBLE_NODES_TO_FIND)
+
+
+@dataclass
+class ScheduleResult:
+    suggested_host: str = ""
+    evaluated_nodes: int = 0
+    feasible_nodes: int = 0
+    status: Status = field(default_factory=Status.success)
+    # node name -> failure status for PostFilter/preemption
+    failed_nodes: Dict[str, Status] = field(default_factory=dict)
+    scores: Dict[str, int] = field(default_factory=dict)
+    # the cycle's state, threaded through Reserve/Permit/Bind (one CycleState
+    # per cycle — the reference passes the same state end to end)
+    state: Optional[CycleState] = None
+
+
+class Scheduler:
+    """Wires store watch -> cache + queue -> scheduling loop -> bind writes."""
+
+    def __init__(self, store: APIStore, framework: Framework,
+                 clock: Optional[Clock] = None,
+                 percentage_of_nodes_to_score: int = 100):
+        self.store = store
+        self.framework = framework
+        self.clock = clock or Clock()
+        self.cache = Cache(clock=self.clock)
+        # Wire the QueueSort plugin. The default PrioritySort is special-cased to
+        # the queue's fast tuple sort key (identical ordering, cheaper heap ops).
+        from .plugins.node_plugins import PrioritySort
+
+        qs = framework.queue_sort_plugin
+        self.queue = SchedulingQueue(
+            clock=self.clock,
+            less=qs.less if qs is not None and not isinstance(qs, PrioritySort) else None,
+        )
+        self.percentage = percentage_of_nodes_to_score
+        self._watch = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.scheduled_count = 0
+        self.failed_count = 0
+        # ns labels for InterPodAffinity namespaceSelector
+        self._ns_labels: Dict[str, Dict[str, str]] = {}
+
+    # -- informer-equivalent event handling (eventhandlers.go:364) -------------
+
+    def sync(self) -> None:
+        """Initial LIST: populate cache with nodes + bound pods, queue with
+        pending pods; then start WATCH from that RV. All kinds are listed under
+        one consistent RV so no event can fall between list and watch."""
+        lists, rv = self.store.list_many(("nodes", "pods", "namespaces"))
+        for n in lists["nodes"]:
+            self.cache.add_node(n)
+        for p in lists["pods"]:
+            self._handle_pod(ADDED, p)
+        for ns in lists["namespaces"]:
+            self._ns_labels[ns.metadata.name] = dict(ns.metadata.labels)
+        self._push_ns_labels()
+        self._watch = self.store.watch(since_rv=rv)
+
+    def _push_ns_labels(self):
+        for p in self.framework.plugins:
+            if hasattr(p, "set_namespace_labels"):
+                p.set_namespace_labels(self._ns_labels)
+
+    def pump_events(self, max_events: int = 10_000) -> int:
+        """Drain pending watch events into cache/queue (deterministic test path;
+        the run loop calls this between cycles)."""
+        if self._watch is None:
+            return 0
+        n = 0
+        for ev in self._watch.drain():
+            self._handle_event(ev)
+            n += 1
+            if n >= max_events:
+                break
+        return n
+
+    def _handle_event(self, ev) -> None:
+        if ev.kind == "nodes":
+            if ev.type == DELETED:
+                self.cache.remove_node(ev.obj.metadata.name)
+            else:
+                self.cache.add_node(ev.obj)
+            self.queue.move_all_to_active_or_backoff()
+        elif ev.kind == "pods":
+            self._handle_pod(ev.type, ev.obj)
+        elif ev.kind == "namespaces":
+            self._ns_labels[ev.obj.metadata.name] = dict(ev.obj.metadata.labels)
+
+    def _handle_pod(self, etype: str, pod: Pod) -> None:
+        # Pod informer filters terminal pods (scheduler.go:582).
+        if pod.is_terminal():
+            if pod.spec.node_name:
+                self.cache.remove_pod(pod)
+            return
+        if etype == DELETED:
+            if pod.spec.node_name:
+                self.cache.remove_pod(pod)
+                self.queue.move_all_to_active_or_backoff()
+            else:
+                self.queue.delete(pod)
+            return
+        if pod.spec.node_name:
+            if self.cache.is_assumed(pod.key):
+                self.cache.add_pod(pod)  # confirm assumed
+            else:
+                self.cache.add_pod(pod)
+                self.queue.move_all_to_active_or_backoff()
+        else:
+            if etype == MODIFIED and self.queue.update(pod):
+                return  # status-only updates of queued pods don't requeue
+            st = self.framework.run_pre_enqueue(pod)
+            if st.is_success():
+                self.queue.add(pod)
+            else:
+                self.queue.add_unschedulable(QueuedPodInfo(pod=pod, timestamp=self.clock.now(),
+                                                           unschedulable_plugins=(st.plugin,)))
+
+    # -- core scheduling (schedule_one.go) -------------------------------------
+
+    def schedule_pod(self, pod: Pod, snapshot: Optional[Snapshot] = None) -> ScheduleResult:
+        """schedulePod :410 — snapshot, prefilter, filter, score, select."""
+        if snapshot is None:
+            snapshot = self.cache.update_snapshot()
+        res = ScheduleResult()
+        if len(snapshot) == 0:
+            res.status = Status.unschedulable("no nodes available to schedule pods")
+            return res
+        state = CycleState()
+        res.state = state
+        pre_res, st = self.framework.run_pre_filter(state, pod, snapshot)
+        if not st.is_success():
+            res.status = st
+            if st.is_rejected():
+                # all nodes failed at prefilter
+                res.failed_nodes = {ni.node.metadata.name: st for ni in snapshot.node_info_list}
+            return res
+
+        nodes = snapshot.node_info_list
+        if pre_res.node_names is not None:
+            nodes = [ni for ni in nodes if ni.node.metadata.name in pre_res.node_names]
+
+        # Nominated-node fast path (:492): try the nominated node first.
+        if pod.status.nominated_node_name:
+            ni = snapshot.get(pod.status.nominated_node_name)
+            if ni is not None and self.framework.run_filter(state, pod, ni).is_success():
+                nodes_to_score = [ni]
+                res.evaluated_nodes = 1
+                return self._score_and_select(state, pod, nodes_to_score, res)
+
+        limit = num_feasible_nodes_to_find(len(nodes), 0 if self.percentage == 0 else self.percentage)
+        feasible: List[NodeInfo] = []
+        for ni in nodes:
+            st = self.framework.run_filter(state, pod, ni)
+            res.evaluated_nodes += 1
+            if st.is_success():
+                feasible.append(ni)
+                if len(feasible) >= limit:
+                    break
+            else:
+                res.failed_nodes[ni.node.metadata.name] = st
+        res.feasible_nodes = len(feasible)
+        if not feasible:
+            res.status = Status.unschedulable(
+                f"0/{len(snapshot)} nodes are available", plugin="")
+            return res
+        return self._score_and_select(state, pod, feasible, res)
+
+    def _score_and_select(self, state: CycleState, pod, feasible: List[NodeInfo],
+                          res: ScheduleResult) -> ScheduleResult:
+        res.feasible_nodes = len(feasible)
+        if len(feasible) == 1:
+            res.suggested_host = feasible[0].node.metadata.name
+            return res
+        st = self.framework.run_pre_score(state, pod, feasible)
+        if not st.is_success():
+            res.status = st
+            return res
+        totals = self.framework.run_score(state, pod, feasible)
+        res.scores = totals
+        # selectHost :872 — deterministic: max score, lowest list index on ties.
+        best_name, best_score = None, None
+        for ni in feasible:
+            name = ni.node.metadata.name
+            s = totals[name]
+            if best_score is None or s > best_score:
+                best_name, best_score = name, s
+        res.suggested_host = best_name
+        return res
+
+    # -- the loop --------------------------------------------------------------
+
+    def schedule_one(self, timeout: Optional[float] = 0.1) -> bool:
+        """One ScheduleOne iteration. Returns False when no pod was popped."""
+        self.pump_events()
+        qp = self.queue.pop(timeout=timeout)
+        if qp is None:
+            return False
+        pod = qp.pod
+        result = self.schedule_pod(pod)
+        if not result.suggested_host:
+            self._handle_failure(qp, result.status)
+            return True
+        # assume (:945) then bind (:967). Serial path binds synchronously.
+        # The assumed pod is a deep copy (schedule_one.go:148 DeepCopy) — the
+        # queued/informer object must never be mutated.
+        import copy as _copy
+
+        assumed = _copy.deepcopy(pod)
+        try:
+            self.cache.assume_pod(assumed, result.suggested_host)
+        except ValueError:
+            self._handle_failure(qp, Status.error("pod already in cache"))
+            return True
+        state = result.state if result.state is not None else CycleState()
+        st = self.framework.run_reserve(state, assumed, result.suggested_host)
+        if not st.is_success():
+            self.cache.forget_pod(assumed)
+            self._handle_failure(qp, st)
+            return True
+        st = self.framework.run_permit(state, assumed, result.suggested_host)
+        if not st.is_success():
+            self.framework.run_unreserve(state, assumed, result.suggested_host)
+            self.cache.forget_pod(assumed)
+            self._handle_failure(qp, st)
+            return True
+        try:
+            st = self.framework.run_pre_bind(state, assumed, result.suggested_host)
+            if not st.is_success():
+                raise RuntimeError(f"prebind: {st.message()}")
+            self.store.bind(pod.metadata.namespace, pod.metadata.name, result.suggested_host)
+            self.cache.finish_binding(assumed)
+            self.framework.run_post_bind(state, assumed, result.suggested_host)
+            self.scheduled_count += 1
+        except Exception as e:
+            # handleBindingCycleError (:344): Unreserve + ForgetPod + requeue
+            self.framework.run_unreserve(state, assumed, result.suggested_host)
+            self.cache.forget_pod(assumed)
+            self._handle_failure(qp, Status.error(str(e)))
+        return True
+
+    def _handle_failure(self, qp: QueuedPodInfo, status: Status) -> None:
+        """handleSchedulingFailure :1022 — requeue + patch PodScheduled condition."""
+        self.failed_count += 1
+        self.queue.add_unschedulable(qp)
+        try:
+            def set_cond(st):
+                st.phase = "Pending"
+                from ..api.types import PodCondition
+
+                st.conditions = [c for c in st.conditions if c.type != "PodScheduled"]
+                st.conditions.append(PodCondition(
+                    type="PodScheduled", status="False", reason="Unschedulable",
+                    message=status.message()))
+
+            self.store.update_pod_status(qp.pod.metadata.namespace, qp.pod.metadata.name, set_cond)
+        except Exception:
+            pass
+
+    def run_until_idle(self, max_cycles: int = 100_000) -> int:
+        """Drive the loop until the active queue drains (test/bench harness)."""
+        n = 0
+        while n < max_cycles:
+            if not self.schedule_one(timeout=0.0):
+                self.pump_events()
+                if not self.schedule_one(timeout=0.0):
+                    break
+            n += 1
+        return n
+
+    def start(self) -> None:
+        """Background loop (wait.UntilWithContext(sched.ScheduleOne, 0))."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                if not self.schedule_one(timeout=0.05):
+                    self.queue.flush_backoff_completed()
+                    self.queue.flush_unschedulable_left_over()
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        if self._watch is not None:
+            self._watch.stop()
+            self._watch = None

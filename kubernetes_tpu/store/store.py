@@ -132,7 +132,10 @@ class APIStore:
         return copy.deepcopy(obj) if self._deep_copy else obj
 
     def _emit(self, etype: str, kind: str, obj) -> None:
-        ev = Event(etype, kind, obj, self._rv)
+        # Events carry a copy, never the stored object: a watcher that mutates an
+        # event object (the client-go mutation-detector failure mode) must not be
+        # able to corrupt store state. One copy per write, shared by watchers.
+        ev = Event(etype, kind, self._copy(obj), self._rv)
         self._history.append(ev)
         if len(self._history) > self._history_limit:
             drop = self._history_limit // 4
@@ -215,6 +218,14 @@ class APIStore:
             if predicate is not None:
                 items = [o for o in items if predicate(o)]
             return [self._copy(o) for o in items], self._rv
+
+    def list_many(self, kinds: Iterable[str]) -> Tuple[Dict[str, List[Any]], int]:
+        """Consistent multi-kind snapshot under one RV — the safe way to seed an
+        informer over several kinds (a per-kind list+watch would race: an object
+        created between two lists is in neither the lists nor the replay)."""
+        with self._lock:
+            out = {k: [self._copy(o) for o in self._objects.get(k, {}).values()] for k in kinds}
+            return out, self._rv
 
     def resource_version(self) -> int:
         with self._lock:

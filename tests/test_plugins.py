@@ -1,0 +1,406 @@
+"""Plugin semantics tables — pins the oracle to the reference's formulas.
+
+Mirrors the reference's per-plugin unit tests (e.g. noderesources/fit_test.go,
+balanced_allocation_test.go, podtopologyspread/filtering_test.go,
+interpodaffinity/filtering_test.go) in compressed table form."""
+
+import pytest
+
+from kubernetes_tpu.scheduler import CycleState, NodeInfo, PodInfo, Snapshot
+from kubernetes_tpu.scheduler.plugins import (
+    BalancedAllocation,
+    ImageLocality,
+    InterPodAffinity,
+    NodeAffinity,
+    NodeName,
+    NodePorts,
+    NodeResourcesFit,
+    NodeUnschedulable,
+    PodTopologySpread,
+    TaintToleration,
+)
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def make_node_info(node, pods=()):
+    ni = NodeInfo(node)
+    for p in pods:
+        ni.add_pod(PodInfo(p))
+    return ni
+
+
+def snapshot_of(*node_infos):
+    return Snapshot({ni.node.metadata.name: ni for ni in node_infos})
+
+
+def run_filter(plugin, pod, node_info, snapshot=None):
+    state = CycleState()
+    if snapshot is None:
+        snapshot = snapshot_of(node_info)
+    if hasattr(plugin, "pre_filter"):
+        _, st = plugin.pre_filter(state, pod, snapshot)
+        if not st.is_success() and not st.is_skip():
+            return st
+    return plugin.filter(state, pod, node_info)
+
+
+class TestNodeResourcesFit:
+    def setup_method(self):
+        self.plugin = NodeResourcesFit()
+        self.node = MakeNode("n1").capacity({"cpu": "2", "memory": "4Gi", "pods": "10"}).obj()
+
+    def test_fits(self):
+        ni = make_node_info(self.node)
+        pod = MakePod().req({"cpu": "1", "memory": "2Gi"}).obj()
+        assert run_filter(self.plugin, pod, ni).is_success()
+
+    def test_insufficient_cpu(self):
+        ni = make_node_info(self.node, [MakePod("existing").req({"cpu": "1500m"}).obj()])
+        pod = MakePod().req({"cpu": "1"}).obj()
+        st = run_filter(self.plugin, pod, ni)
+        assert not st.is_success() and "Insufficient cpu" in st.reasons
+
+    def test_insufficient_memory_and_cpu_both_reported(self):
+        ni = make_node_info(self.node, [MakePod("e").req({"cpu": "1500m", "memory": "3Gi"}).obj()])
+        pod = MakePod().req({"cpu": "1", "memory": "2Gi"}).obj()
+        st = run_filter(self.plugin, pod, ni)
+        assert set(st.reasons) == {"Insufficient cpu", "Insufficient memory"}
+
+    def test_too_many_pods(self):
+        node = MakeNode("n1").capacity({"cpu": "100", "memory": "100Gi", "pods": "1"}).obj()
+        ni = make_node_info(node, [MakePod("e").req({}).obj()])
+        st = run_filter(self.plugin, MakePod().req({}).obj(), ni)
+        assert "Too many pods" in st.reasons
+
+    def test_scalar_resource(self):
+        node = MakeNode("n1").capacity({"cpu": "2", "memory": "4Gi", "nvidia.com/gpu": "2"}).obj()
+        ni = make_node_info(node, [MakePod("e").req({"nvidia.com/gpu": "2"}).obj()])
+        st = run_filter(self.plugin, MakePod().req({"nvidia.com/gpu": "1"}).obj(), ni)
+        assert "Insufficient nvidia.com/gpu" in st.reasons
+
+    def test_zero_request_always_fits_resources(self):
+        ni = make_node_info(self.node, [MakePod("e").req({"cpu": "2", "memory": "4Gi"}).obj()])
+        assert run_filter(self.plugin, MakePod().req({}).obj(), ni).is_success()
+
+    def test_least_allocated_score(self):
+        # leastRequestedScore: ((capacity-requested)*100)/capacity, mean of cpu+mem
+        # cpu: (2000-1000)*100/2000 = 50; mem: (4Gi-2Gi)*100/4Gi = 50 -> 50
+        ni = make_node_info(self.node)
+        pod = MakePod().req({"cpu": "1", "memory": "2Gi"}).obj()
+        state = CycleState()
+        self.plugin.pre_filter(state, pod, snapshot_of(ni))
+        score, st = self.plugin.score(state, pod, ni)
+        assert st.is_success() and score == 50
+
+    def test_least_allocated_uses_nonzero_requests(self):
+        # best-effort pod scores with 100m/200Mi defaults, not 0
+        ni = make_node_info(self.node)
+        pod = MakePod().req({}).obj()
+        state = CycleState()
+        score, _ = self.plugin.score(state, pod, ni)
+        # cpu: (2000-100)*100/2000 = 95; mem: (4096Mi-200Mi)*100/4096Mi = 95 -> 95
+        assert score == 95
+
+    def test_most_allocated_score(self):
+        plugin = NodeResourcesFit(strategy="MostAllocated")
+        ni = make_node_info(self.node)
+        pod = MakePod().req({"cpu": "1", "memory": "2Gi"}).obj()
+        state = CycleState()
+        score, _ = plugin.score(state, pod, ni)
+        assert score == 50
+
+
+class TestBalancedAllocation:
+    def test_two_resource_shortcut(self):
+        # fractions: cpu 1000/2000=0.5, mem 1Gi/4Gi=0.25 -> std=|0.5-0.25|/2=0.125
+        # score = (1-0.125)*100 = 87
+        node = MakeNode("n1").capacity({"cpu": "2", "memory": "4Gi"}).obj()
+        ni = make_node_info(node)
+        pod = MakePod().req({"cpu": "1", "memory": "1Gi"}).obj()
+        plugin = BalancedAllocation()
+        state = CycleState()
+        plugin.pre_score(state, pod, [ni])
+        score, _ = plugin.score(state, pod, ni)
+        assert score == 87
+
+    def test_perfectly_balanced(self):
+        node = MakeNode("n1").capacity({"cpu": "2", "memory": "4Gi"}).obj()
+        ni = make_node_info(node)
+        pod = MakePod().req({"cpu": "1", "memory": "2Gi"}).obj()
+        plugin = BalancedAllocation()
+        state = CycleState()
+        plugin.pre_score(state, pod, [ni])
+        score, _ = plugin.score(state, pod, ni)
+        assert score == 100
+
+    def test_best_effort_skipped(self):
+        plugin = BalancedAllocation()
+        st = plugin.pre_score(CycleState(), MakePod().req({}).obj(), [])
+        assert st.is_skip()
+
+
+class TestNodeAffinityPlugin:
+    def test_node_selector_mismatch(self):
+        plugin = NodeAffinity()
+        pod = MakePod().node_selector({"disk": "ssd"}).obj()
+        ni = make_node_info(MakeNode("n1").labels({"disk": "hdd"}).obj())
+        assert not run_filter(plugin, pod, ni).is_success()
+
+    def test_required_affinity(self):
+        plugin = NodeAffinity()
+        pod = MakePod().node_affinity_in("zone", ["a", "b"]).obj()
+        assert run_filter(plugin, pod, make_node_info(MakeNode("n1").labels({"zone": "a"}).obj())).is_success()
+        assert not run_filter(plugin, pod, make_node_info(MakeNode("n2").labels({"zone": "c"}).obj())).is_success()
+
+    def test_preferred_score_normalized(self):
+        plugin = NodeAffinity()
+        pod = MakePod().preferred_node_affinity(10, "zone", ["a"]) \
+                       .preferred_node_affinity(5, "disk", ["ssd"]).obj()
+        ni_a = make_node_info(MakeNode("n1").labels({"zone": "a", "disk": "ssd"}).obj())
+        ni_b = make_node_info(MakeNode("n2").labels({"zone": "a"}).obj())
+        ni_c = make_node_info(MakeNode("n3").obj())
+        state = CycleState()
+        scores = {}
+        for ni in (ni_a, ni_b, ni_c):
+            s, _ = plugin.score(state, pod, ni)
+            scores[ni.node.metadata.name] = s
+        assert scores == {"n1": 15, "n2": 10, "n3": 0}
+        plugin.normalize_score(state, pod, scores)
+        assert scores == {"n1": 100, "n2": 66, "n3": 0}
+
+
+class TestTaintToleration:
+    def test_untolerated_no_schedule(self):
+        plugin = TaintToleration()
+        ni = make_node_info(MakeNode("n1").taints([{"key": "k", "value": "v", "effect": "NoSchedule"}]).obj())
+        assert not run_filter(plugin, MakePod().obj(), ni).is_success()
+        pod = MakePod().toleration("k", "v", effect="NoSchedule").obj()
+        assert run_filter(plugin, pod, ni).is_success()
+
+    def test_prefer_no_schedule_not_filtered_but_scored(self):
+        plugin = TaintToleration()
+        ni_tainted = make_node_info(
+            MakeNode("n1").taints([{"key": "k", "value": "v", "effect": "PreferNoSchedule"}]).obj())
+        ni_clean = make_node_info(MakeNode("n2").obj())
+        pod = MakePod().obj()
+        assert run_filter(plugin, pod, ni_tainted).is_success()
+        state = CycleState()
+        plugin.pre_score(state, pod, [ni_tainted, ni_clean])
+        scores = {}
+        for ni in (ni_tainted, ni_clean):
+            s, _ = plugin.score(state, pod, ni)
+            scores[ni.node.metadata.name] = s
+        plugin.normalize_score(state, pod, scores)
+        assert scores["n2"] == 100 and scores["n1"] < 100
+
+
+class TestNodePortsAndMisc:
+    def test_port_conflict(self):
+        plugin = NodePorts()
+        existing = MakePod("e").req({}, host_port=8080).obj()
+        ni = make_node_info(MakeNode("n1").capacity({"cpu": "4"}).obj(), [existing])
+        pod = MakePod().req({}, host_port=8080).obj()
+        assert not run_filter(plugin, pod, ni).is_success()
+        pod2 = MakePod().req({}, host_port=8081).obj()
+        assert run_filter(plugin, pod2, ni).is_success()
+
+    def test_node_name(self):
+        plugin = NodeName()
+        pod = MakePod().node("n2").obj()
+        pod.spec.node_name = ""  # node() sets binding; use explicit requested name
+        pod.spec.node_name = "n2"
+        # NodeName filter reads spec.node_name as the *requested* node
+        assert not run_filter(plugin, pod, make_node_info(MakeNode("n1").obj())).is_success()
+        assert run_filter(plugin, pod, make_node_info(MakeNode("n2").obj())).is_success()
+
+    def test_unschedulable_node(self):
+        plugin = NodeUnschedulable()
+        ni = make_node_info(MakeNode("n1").unschedulable().obj())
+        assert not run_filter(plugin, MakePod().obj(), ni).is_success()
+        tolerating = MakePod().toleration("node.kubernetes.io/unschedulable",
+                                          operator="Exists", effect="NoSchedule").obj()
+        assert run_filter(plugin, tolerating, ni).is_success()
+
+    def test_image_locality(self):
+        plugin = ImageLocality()
+        big = 500 * 1024 * 1024
+        ni_with = make_node_info(MakeNode("n1").images({"nginx:latest": big}).obj())
+        ni_without = make_node_info(MakeNode("n2").obj())
+        pod = MakePod().container("nginx").obj()
+        state = CycleState()
+        state.write("TotalNodes", 2)
+        s_with, _ = plugin.score(state, pod, ni_with)
+        s_without, _ = plugin.score(state, pod, ni_without)
+        assert s_with > s_without == 0
+
+
+class TestPodTopologySpread:
+    def _cluster(self):
+        # 2 zones x 2 nodes
+        nodes = []
+        for i in range(4):
+            zone = "a" if i < 2 else "b"
+            nodes.append(MakeNode(f"n{i}").labels({"topology.kubernetes.io/zone": zone}).obj())
+        return nodes
+
+    def test_filter_skew(self):
+        plugin = PodTopologySpread()
+        nodes = self._cluster()
+        # 2 matching pods in zone a, 0 in zone b; maxSkew 1
+        existing = [MakePod(f"e{i}").labels({"app": "web"}).obj() for i in range(2)]
+        nis = [make_node_info(nodes[0], existing), make_node_info(nodes[1]),
+               make_node_info(nodes[2]), make_node_info(nodes[3])]
+        snap = snapshot_of(*nis)
+        pod = MakePod().labels({"app": "web"}).topology_spread(
+            1, "topology.kubernetes.io/zone", "DoNotSchedule", {"app": "web"}).obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod, snap)
+        # zone a has 2, zone b has 0, min=0; placing in zone a -> skew 3 > 1
+        assert not plugin.filter(state, pod, nis[0]).is_success()
+        # placing in zone b -> skew 1 <= 1
+        assert plugin.filter(state, pod, nis[2]).is_success()
+
+    def test_filter_missing_topology_key_unresolvable(self):
+        plugin = PodTopologySpread()
+        pod = MakePod().labels({"app": "w"}).topology_spread(
+            1, "topology.kubernetes.io/zone", "DoNotSchedule", {"app": "w"}).obj()
+        ni = make_node_info(MakeNode("plain").obj())
+        snap = snapshot_of(ni)
+        state = CycleState()
+        plugin.pre_filter(state, pod, snap)
+        st = plugin.filter(state, pod, ni)
+        from kubernetes_tpu.scheduler import Code
+
+        assert st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE
+
+    def test_min_domains(self):
+        plugin = PodTopologySpread()
+        nodes = self._cluster()[:2]  # only zone a
+        nis = [make_node_info(n) for n in nodes]
+        snap = snapshot_of(*nis)
+        # minDomains=2 but only 1 domain exists -> minMatchNum=0 ->
+        # placing first pod in zone a: matchNum(0)+1-0 = 1 <= 1 OK
+        pod = MakePod().labels({"app": "w"}).topology_spread(
+            1, "topology.kubernetes.io/zone", "DoNotSchedule", {"app": "w"}, min_domains=2).obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod, snap)
+        assert plugin.filter(state, pod, nis[0]).is_success()
+        # with one matching pod already in zone a: 1+1-0 = 2 > 1 -> fail
+        nis2 = [make_node_info(nodes[0], [MakePod("e").labels({"app": "w"}).obj()]),
+                make_node_info(nodes[1])]
+        snap2 = snapshot_of(*nis2)
+        state2 = CycleState()
+        plugin.pre_filter(state2, pod, snap2)
+        assert not plugin.filter(state2, pod, nis2[0]).is_success()
+
+    def test_score_prefers_less_loaded_zone(self):
+        plugin = PodTopologySpread()
+        nodes = self._cluster()
+        existing = [MakePod(f"e{i}").labels({"app": "web"}).obj() for i in range(3)]
+        nis = [make_node_info(nodes[0], existing), make_node_info(nodes[1]),
+               make_node_info(nodes[2], [MakePod("e9").labels({"app": "web"}).obj()]),
+               make_node_info(nodes[3])]
+        pod = MakePod().labels({"app": "web"}).topology_spread(
+            1, "topology.kubernetes.io/zone", "ScheduleAnyway", {"app": "web"}).obj()
+        state = CycleState()
+        state.write("Snapshot", snapshot_of(*nis))
+        plugin.pre_score(state, pod, nis)
+        scores = {}
+        for ni in nis:
+            s, _ = plugin.score(state, pod, ni)
+            scores[ni.node.metadata.name] = s
+        plugin.normalize_score(state, pod, scores)
+        # zone b (1 pod) must outrank zone a (3 pods)
+        assert scores["n2"] > scores["n0"]
+
+
+class TestInterPodAffinity:
+    def _zone_nodes(self):
+        na = MakeNode("na").labels({"topology.kubernetes.io/zone": "a"}).obj()
+        nb = MakeNode("nb").labels({"topology.kubernetes.io/zone": "b"}).obj()
+        return na, nb
+
+    def test_required_affinity(self):
+        plugin = InterPodAffinity()
+        na, nb = self._zone_nodes()
+        ni_a = make_node_info(na, [MakePod("svc").labels({"app": "db"}).obj()])
+        ni_b = make_node_info(nb)
+        snap = snapshot_of(ni_a, ni_b)
+        pod = MakePod().pod_affinity("topology.kubernetes.io/zone", {"app": "db"}).obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod, snap)
+        assert plugin.filter(state, pod, ni_a).is_success()
+        assert not plugin.filter(state, pod, ni_b).is_success()
+
+    def test_first_pod_self_affinity(self):
+        plugin = InterPodAffinity()
+        na, nb = self._zone_nodes()
+        ni_a, ni_b = make_node_info(na), make_node_info(nb)
+        snap = snapshot_of(ni_a, ni_b)
+        # pod matches its own affinity selector; empty cluster -> allowed
+        pod = MakePod().labels({"app": "db"}).pod_affinity(
+            "topology.kubernetes.io/zone", {"app": "db"}).obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod, snap)
+        assert plugin.filter(state, pod, ni_a).is_success()
+        # pod NOT matching own selector -> still unschedulable
+        pod2 = MakePod().pod_affinity("topology.kubernetes.io/zone", {"app": "db"}).obj()
+        state2 = CycleState()
+        plugin.pre_filter(state2, pod2, snap)
+        assert not plugin.filter(state2, pod2, ni_a).is_success()
+
+    def test_required_anti_affinity(self):
+        plugin = InterPodAffinity()
+        na, nb = self._zone_nodes()
+        ni_a = make_node_info(na, [MakePod("w1").labels({"app": "web"}).obj()])
+        ni_b = make_node_info(nb)
+        snap = snapshot_of(ni_a, ni_b)
+        pod = MakePod().labels({"app": "web"}).pod_anti_affinity(
+            "topology.kubernetes.io/zone", {"app": "web"}).obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod, snap)
+        assert not plugin.filter(state, pod, ni_a).is_success()
+        assert plugin.filter(state, pod, ni_b).is_success()
+
+    def test_existing_anti_affinity_symmetry(self):
+        plugin = InterPodAffinity()
+        na, nb = self._zone_nodes()
+        # existing pod has anti-affinity to app=web; incoming pod IS app=web
+        existing = MakePod("grumpy").pod_anti_affinity(
+            "topology.kubernetes.io/zone", {"app": "web"}).obj()
+        ni_a = make_node_info(na, [existing])
+        ni_b = make_node_info(nb)
+        snap = snapshot_of(ni_a, ni_b)
+        pod = MakePod().labels({"app": "web"}).obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod, snap)
+        assert not plugin.filter(state, pod, ni_a).is_success()
+        assert plugin.filter(state, pod, ni_b).is_success()
+
+    def test_namespace_isolation(self):
+        plugin = InterPodAffinity()
+        na, nb = self._zone_nodes()
+        other_ns_pod = MakePod("svc", namespace="other").labels({"app": "db"}).obj()
+        ni_a = make_node_info(na, [other_ns_pod])
+        snap = snapshot_of(ni_a, make_node_info(nb))
+        # term defaults to the incoming pod's namespace -> other-ns pod invisible
+        pod = MakePod().pod_affinity("topology.kubernetes.io/zone", {"app": "db"}).obj()
+        state = CycleState()
+        plugin.pre_filter(state, pod, snap)
+        assert not plugin.filter(state, pod, ni_a).is_success()
+
+    def test_preferred_affinity_score(self):
+        plugin = InterPodAffinity()
+        na, nb = self._zone_nodes()
+        ni_a = make_node_info(na, [MakePod("svc").labels({"app": "db"}).obj()])
+        ni_b = make_node_info(nb)
+        pod = MakePod().preferred_pod_affinity(
+            10, "topology.kubernetes.io/zone", {"app": "db"}).obj()
+        state = CycleState()
+        state.write("Snapshot", snapshot_of(ni_a, ni_b))
+        plugin.pre_score(state, pod, [ni_a, ni_b])
+        sa, _ = plugin.score(state, pod, ni_a)
+        sb, _ = plugin.score(state, pod, ni_b)
+        scores = {"na": sa, "nb": sb}
+        plugin.normalize_score(state, pod, scores)
+        assert scores["na"] == 100 and scores["nb"] == 0

@@ -1,0 +1,298 @@
+"""Serial scheduler end-to-end + queue + cache tests.
+
+Mirrors the structure of the reference's schedule_one_test.go and
+backend/queue,cache tests (SURVEY.md §4): fake clock, fluent builders,
+store-backed integration without any node agents (pods just become Bound)."""
+
+import pytest
+
+from kubernetes_tpu.scheduler import (
+    Cache,
+    Framework,
+    QueuedPodInfo,
+    Scheduler,
+    SchedulingQueue,
+    num_feasible_nodes_to_find,
+)
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+from kubernetes_tpu.utils import FakeClock
+
+
+def make_scheduler(store, **kw):
+    return Scheduler(store, Framework(default_plugins()), **kw)
+
+
+class TestQueue:
+    def test_priority_ordering(self):
+        clock = FakeClock()
+        q = SchedulingQueue(clock=clock)
+        q.add(MakePod("low").priority(1).obj())
+        q.add(MakePod("high").priority(10).obj())
+        q.add(MakePod("mid").priority(5).obj())
+        names = [q.pop().pod.metadata.name for _ in range(3)]
+        assert names == ["high", "mid", "low"]
+
+    def test_fifo_within_priority(self):
+        clock = FakeClock()
+        q = SchedulingQueue(clock=clock)
+        for i in range(3):
+            q.add(MakePod(f"p{i}").obj())
+            clock.step(1)
+        names = [q.pop().pod.metadata.name for _ in range(3)]
+        assert names == ["p0", "p1", "p2"]
+
+    def test_unschedulable_backoff_flow(self):
+        clock = FakeClock()
+        q = SchedulingQueue(clock=clock)
+        q.add(MakePod("p").obj())
+        qp = q.pop()
+        assert qp.attempts == 1
+        q.add_unschedulable(qp)
+        assert q.lengths() == (0, 0, 1)
+        # cluster event moves it to backoff (1 attempt -> 1s backoff)
+        q.move_all_to_active_or_backoff()
+        assert q.lengths() == (0, 1, 0)
+        assert q.pop(timeout=0) is None
+        clock.step(1.1)
+        q.flush_backoff_completed()
+        assert q.pop(timeout=0) is not None
+
+    def test_backoff_exponential_capped(self):
+        clock = FakeClock()
+        q = SchedulingQueue(clock=clock)
+        assert q._backoff_duration(1) == 1.0
+        assert q._backoff_duration(3) == 4.0
+        assert q._backoff_duration(10) == 10.0  # capped
+
+    def test_flush_unschedulable_after_timeout(self):
+        clock = FakeClock()
+        q = SchedulingQueue(clock=clock)
+        q.add(MakePod("p").obj())
+        qp = q.pop()
+        q.add_unschedulable(qp)
+        clock.step(31)
+        q.flush_unschedulable_left_over()
+        assert q.pop(timeout=0) is not None
+
+
+class TestCache:
+    def test_assume_confirm_lifecycle(self):
+        clock = FakeClock()
+        c = Cache(clock=clock)
+        c.add_node(MakeNode("n1").capacity({"cpu": "4"}).obj())
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        c.assume_pod(pod, "n1")
+        snap = c.update_snapshot()
+        assert snap.get("n1").requested.milli_cpu == 1000
+        c.finish_binding(pod)
+        # informer confirms
+        bound = MakePod("p").req({"cpu": "1"}).obj()
+        bound.metadata.uid = pod.metadata.uid
+        bound.spec.node_name = "n1"
+        c.add_pod(bound)
+        assert not c.is_assumed(pod.key)
+        assert c.update_snapshot().get("n1").requested.milli_cpu == 1000
+
+    def test_assumed_pod_expiry(self):
+        clock = FakeClock()
+        c = Cache(clock=clock, ttl=15.0)
+        c.add_node(MakeNode("n1").capacity({"cpu": "4"}).obj())
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        c.assume_pod(pod, "n1")
+        c.finish_binding(pod)
+        clock.step(16)
+        expired = c.cleanup_expired_assumed_pods()
+        assert expired == [pod.key]
+        assert c.update_snapshot().get("n1").requested.milli_cpu == 0
+
+    def test_forget_pod(self):
+        c = Cache(clock=FakeClock())
+        c.add_node(MakeNode("n1").capacity({"cpu": "4"}).obj())
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        c.assume_pod(pod, "n1")
+        c.forget_pod(pod)
+        assert c.update_snapshot().get("n1").requested.milli_cpu == 0
+
+    def test_incremental_snapshot_reuses_unchanged_nodeinfos(self):
+        c = Cache(clock=FakeClock())
+        c.add_node(MakeNode("n1").capacity({"cpu": "4"}).obj())
+        c.add_node(MakeNode("n2").capacity({"cpu": "4"}).obj())
+        s1 = c.update_snapshot()
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        pod.spec.node_name = "n1"
+        c.add_pod(pod)
+        s2 = c.update_snapshot()
+        # n2 untouched -> same object reused (generation diffing, cache.go:186)
+        assert s2.get("n2") is s1.get("n2")
+        assert s2.get("n1") is not s1.get("n1")
+
+    def test_snapshot_cached_when_no_changes(self):
+        c = Cache(clock=FakeClock())
+        c.add_node(MakeNode("n1").obj())
+        assert c.update_snapshot() is c.update_snapshot()
+
+
+def test_num_feasible_nodes_to_find():
+    # schedule_one.go:675: <100 nodes -> all; adaptive percentage above
+    assert num_feasible_nodes_to_find(50) == 50
+    assert num_feasible_nodes_to_find(100) == 100  # 50-0.8 = 49% -> 49 -> min 100
+    assert num_feasible_nodes_to_find(1000) == 420  # 50-8=42%
+    assert num_feasible_nodes_to_find(5000) == 500  # 50-40=10%
+    assert num_feasible_nodes_to_find(6000) == 300  # floor 5%
+    assert num_feasible_nodes_to_find(1000, percentage=100) == 1000
+
+
+class TestEndToEnd:
+    def test_schedule_pending_pods(self):
+        store = APIStore()
+        for i in range(4):
+            store.create("nodes", MakeNode(f"n{i}").capacity({"cpu": "4", "memory": "8Gi"}).obj())
+        for i in range(8):
+            store.create("pods", MakePod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+        sched = make_scheduler(store)
+        sched.sync()
+        cycles = sched.run_until_idle()
+        assert sched.scheduled_count == 8
+        pods, _ = store.list("pods")
+        assert all(p.spec.node_name for p in pods)
+        # LeastAllocated + BalancedAllocation spread 8 pods evenly over 4 nodes
+        per_node = {}
+        for p in pods:
+            per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+        assert sorted(per_node.values()) == [2, 2, 2, 2]
+
+    def test_unschedulable_pod_gets_condition(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "1"}).obj())
+        store.create("pods", MakePod("big").req({"cpu": "4"}).obj())
+        sched = make_scheduler(store)
+        sched.sync()
+        sched.run_until_idle()
+        assert sched.scheduled_count == 0 and sched.failed_count >= 1
+        pod = store.get("pods", "default/big")
+        conds = {c.type: c for c in pod.status.conditions}
+        assert conds["PodScheduled"].status == "False"
+        assert conds["PodScheduled"].reason == "Unschedulable"
+
+    def test_pod_becomes_schedulable_on_node_add(self):
+        store = APIStore()
+        store.create("pods", MakePod("p").req({"cpu": "1"}).obj())
+        sched = make_scheduler(store)
+        sched.sync()
+        sched.run_until_idle()
+        assert sched.scheduled_count == 0
+        # node arrives -> cluster event moves pod out of unschedulable
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "4"}).obj())
+        sched.pump_events()
+        sched.queue.flush_backoff_completed()  # backoff is wall-clock; force
+        import time
+
+        time.sleep(1.1)  # real clock backoff (1 attempt -> 1s)
+        sched.queue.flush_backoff_completed()
+        sched.run_until_idle()
+        assert sched.scheduled_count == 1
+
+    def test_scheduling_gates_hold_pod(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "4"}).obj())
+        store.create("pods", MakePod("gated").req({"cpu": "1"}).scheduling_gate("wait").obj())
+        sched = make_scheduler(store)
+        sched.sync()
+        sched.run_until_idle()
+        assert sched.scheduled_count == 0
+        assert sched.queue.lengths() == (0, 0, 1)
+
+    def test_priority_scheduled_first_under_scarcity(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "1", "pods": "10"}).obj())
+        store.create("pods", MakePod("low").priority(1).req({"cpu": "1"}).obj())
+        store.create("pods", MakePod("high").priority(100).req({"cpu": "1"}).obj())
+        sched = make_scheduler(store)
+        sched.sync()
+        sched.run_until_idle()
+        assert store.get("pods", "default/high").spec.node_name == "n0"
+        assert store.get("pods", "default/low").spec.node_name == ""
+
+    def test_topology_spread_end_to_end(self):
+        store = APIStore()
+        for i in range(4):
+            zone = "a" if i < 2 else "b"
+            store.create("nodes", MakeNode(f"n{i}").labels(
+                {"topology.kubernetes.io/zone": zone}).capacity({"cpu": "8"}).obj())
+        for i in range(6):
+            store.create("pods", MakePod(f"w{i}").labels({"app": "web"}).req({"cpu": "100m"})
+                         .topology_spread(1, "topology.kubernetes.io/zone", "DoNotSchedule",
+                                          {"app": "web"}).obj())
+        sched = make_scheduler(store)
+        sched.sync()
+        sched.run_until_idle()
+        assert sched.scheduled_count == 6
+        pods, _ = store.list("pods")
+        zone_counts = {"a": 0, "b": 0}
+        for p in pods:
+            n = store.get("nodes", p.spec.node_name)
+            zone_counts[n.metadata.labels["topology.kubernetes.io/zone"]] += 1
+        assert zone_counts == {"a": 3, "b": 3}
+
+    def test_anti_affinity_end_to_end(self):
+        store = APIStore()
+        for i in range(3):
+            store.create("nodes", MakeNode(f"n{i}").capacity({"cpu": "8"}).obj())
+        for i in range(3):
+            store.create("pods", MakePod(f"w{i}").labels({"app": "web"}).req({"cpu": "100m"})
+                         .pod_anti_affinity("kubernetes.io/hostname", {"app": "web"}).obj())
+        sched = make_scheduler(store)
+        sched.sync()
+        sched.run_until_idle()
+        pods, _ = store.list("pods")
+        hosts = {p.spec.node_name for p in pods}
+        assert len(hosts) == 3  # one per node
+
+    def test_binding_visible_via_watch(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "4"}).obj())
+        w = store.watch("pods", since_rv=store.resource_version())
+        store.create("pods", MakePod("p").req({"cpu": "1"}).obj())
+        sched = make_scheduler(store)
+        sched.sync()
+        sched.run_until_idle()
+        evs = w.drain()
+        assert any(ev.type == "MODIFIED" and ev.obj.spec.node_name == "n0" for ev in evs)
+        w.stop()
+
+
+class TestReviewRegressions:
+    def test_node_flap_keeps_pod_accounting(self):
+        """Node delete + re-add must not lose bound pods' resource usage
+        (cache.go RemoveNode keeps the NodeInfo while pods remain)."""
+        c = Cache(clock=FakeClock())
+        c.add_node(MakeNode("n1").capacity({"cpu": "4"}).obj())
+        pod = MakePod("p").req({"cpu": "3"}).obj()
+        pod.spec.node_name = "n1"
+        c.add_pod(pod)
+        c.remove_node("n1")
+        assert c.update_snapshot().get("n1") is None  # gone from snapshots
+        c.add_node(MakeNode("n1").capacity({"cpu": "4"}).obj())  # kubelet flap
+        ni = c.update_snapshot().get("n1")
+        assert ni is not None and ni.requested.milli_cpu == 3000
+
+    def test_queue_priority_update_resorts(self):
+        clock = FakeClock()
+        q = SchedulingQueue(clock=clock)
+        q.add(MakePod("a").priority(5).obj())
+        q.add(MakePod("b").priority(1).obj())
+        boosted = MakePod("b").priority(100).obj()
+        assert q.update(boosted)
+        assert q.pop().pod.metadata.name == "b"
+
+    def test_image_counts_incremental(self):
+        c = Cache(clock=FakeClock())
+        big = 500 * 1024 * 1024
+        c.add_node(MakeNode("n1").images({"img:1": big}).capacity({"cpu": "1"}).obj())
+        c.add_node(MakeNode("n2").images({"img:1": big}).capacity({"cpu": "1"}).obj())
+        snap = c.update_snapshot()
+        assert snap.get("n1").image_states["img:1"].num_nodes == 2
+        c.remove_node("n2")
+        assert snap.get("n1").image_states["img:1"].num_nodes == 1  # shared entry

@@ -165,3 +165,16 @@ def test_watch_too_old_rv_raises():
         s.create("pods", MakePod(f"p{i}").obj())
     with pytest.raises(ResourceVersionTooOldError):
         s.watch("pods", since_rv=1)
+
+
+def test_watch_event_objects_are_copies():
+    """Mutating an event object must not corrupt the store (the client-go
+    mutation-detector failure mode that bit the scheduler's assume path)."""
+    s = APIStore()
+    w = s.watch("pods", since_rv=0)
+    s.create("pods", MakePod("a").obj())
+    ev = w.get(timeout=1)
+    ev.obj.spec.node_name = "sneaky"
+    assert s.get("pods", "default/a").spec.node_name == ""
+    s.bind("default", "a", "n1")  # must succeed
+    w.stop()
