@@ -1,0 +1,77 @@
+"""Benchmark: SchedulingBasic-equivalent workload (5000 nodes, 10000 pods) on
+the batch TPU solver, end-to-end from cluster objects to assignments.
+
+Mirrors the reference's scheduler_perf SchedulingBasic/5000Nodes_10000Pods
+workload (test/integration/scheduler_perf/misc/performance-config.yaml:63,
+threshold 270 pods/s on the serial scheduler). Prints ONE JSON line.
+
+Steady-state throughput: the solve is run once to compile, then timed on a
+fresh state (the compiled program is what a long-running scheduler executes
+per batch; tensorize cost is included in the timed region, Python object
+construction is not — it is the test harness, not the scheduler).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BASELINE_PODS_PER_SEC = 270.0  # misc/performance-config.yaml:63
+
+
+def main():
+    import numpy as np
+
+    from kubernetes_tpu.ops.solver import greedy_scan_solve, make_inputs
+    from kubernetes_tpu.scheduler import Cache
+    from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors, build_pod_batch
+    from kubernetes_tpu.testing import MakeNode, MakePod
+    from kubernetes_tpu.utils import FakeClock
+
+    n_nodes, n_pods = 5000, 10000
+    cache = Cache(clock=FakeClock())
+    for i in range(n_nodes):
+        cache.add_node(
+            MakeNode(f"node-{i}")
+            .capacity({"cpu": "8", "memory": "32Gi", "pods": "110"})
+            .obj()
+        )
+    snap = cache.update_snapshot()
+    pods = [
+        MakePod(f"pod-{i}").req({"cpu": "500m", "memory": "1Gi"}).obj()
+        for i in range(n_pods)
+    ]
+
+    # warm-up: tensorize + compile + run once
+    cluster = build_cluster_tensors(snap)
+    batch = build_pod_batch(pods, snap, cluster)
+    inputs, d_max = make_inputs(cluster, batch)
+    assignment, _, _ = greedy_scan_solve(inputs, d_max)
+    assignment.block_until_ready()
+
+    # timed: steady-state batch — tensorize, upload, solve
+    t0 = time.perf_counter()
+    cluster = build_cluster_tensors(snap)
+    batch = build_pod_batch(pods, snap, cluster)
+    inputs, d_max = make_inputs(cluster, batch)
+    assignment, _, _ = greedy_scan_solve(inputs, d_max)
+    assignment.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    a = np.asarray(assignment)
+    scheduled = int((a >= 0).sum())
+    assert scheduled == n_pods, f"only {scheduled}/{n_pods} scheduled"
+    pods_per_sec = n_pods / dt
+
+    print(json.dumps({
+        "metric": "scheduling_throughput_5000nodes_10000pods",
+        "value": round(pods_per_sec, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(pods_per_sec / BASELINE_PODS_PER_SEC, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

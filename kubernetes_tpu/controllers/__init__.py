@@ -1,0 +1,1 @@
+"""L4 — reconciling control loops."""

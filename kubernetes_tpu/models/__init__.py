@@ -1,0 +1,1 @@
+"""End-to-end solver models (greedy scan / auction / sinkhorn assignment)."""

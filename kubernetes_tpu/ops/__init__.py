@@ -1,0 +1,1 @@
+"""Vectorized (jitted) plugin semantics and batch solvers."""

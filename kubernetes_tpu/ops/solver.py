@@ -1,0 +1,292 @@
+"""Jitted batch solvers over the pods x nodes tensors.
+
+The TPU replacement for prioritizeNodes()/the per-pod loop (reference:
+pkg/scheduler/schedule_one.go:65,754 — the north-star site). Two solvers:
+
+  greedy_scan  — lax.scan over the priority-ordered pod batch; each step runs
+                 ALL filters+scores vectorized over nodes, argmaxes, and updates
+                 capacity/spread state. Bit-compatible with the serial oracle
+                 (same order, same integer formulas, lowest-index tie-break),
+                 so parity is exact.
+  (auction/sinkhorn solvers land in models/ in a later milestone)
+
+All arithmetic is int32 (matching Go's integer score math) except
+BalancedAllocation (float, like balanced_allocation.go).
+
+Score composition mirrors runtime.RunScorePlugins with the default weights
+(default_plugins.go:30): Fit(Least)x1 + Balancedx1 + NodeAffinityx2(norm) +
+TaintTolerationx3(rev-norm) + PodTopologySpreadx2(special norm) + ImageLocalityx1.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..scheduler.framework import MAX_NODE_SCORE
+
+INT_MIN = jnp.int32(-(2**31) + 1)
+
+
+class SolverInputs(NamedTuple):
+    """Device-resident view of ClusterTensors + PodBatchTensors (all jnp)."""
+
+    # node state
+    alloc: jnp.ndarray  # [N, R] int32
+    used: jnp.ndarray  # [N, R]
+    used_nz: jnp.ndarray  # [N, R]
+    pod_count: jnp.ndarray  # [N]
+    max_pods: jnp.ndarray  # [N]
+    # class tables
+    filter_ok: jnp.ndarray  # [C, N] bool
+    aff_ok: jnp.ndarray  # [C, N] bool
+    napref_raw: jnp.ndarray  # [C, N] int32
+    has_napref: jnp.ndarray  # [C] bool
+    taint_cnt: jnp.ndarray  # [C, N] int32
+    img_score: jnp.ndarray  # [C, N] int32
+    class_ports: jnp.ndarray  # [C, Pt] bool
+    node_ports: jnp.ndarray  # [N, Pt] bool (existing usage; dynamic state seeds)
+    # topology
+    topo_id: jnp.ndarray  # [Kk, N] int32
+    selcls_count: jnp.ndarray  # [SC, N] int32
+    class_matches_selcls: jnp.ndarray  # [C, SC] int32
+    # constraints (padded to >=1 with class=-1 sentinels)
+    ct_class: jnp.ndarray
+    ct_key: jnp.ndarray
+    ct_sel: jnp.ndarray
+    ct_max_skew: jnp.ndarray
+    ct_min_domains: jnp.ndarray
+    ct_self_match: jnp.ndarray
+    st_class: jnp.ndarray
+    st_key: jnp.ndarray
+    st_sel: jnp.ndarray
+    st_max_skew: jnp.ndarray
+    st_self_match: jnp.ndarray
+    # pod batch
+    req: jnp.ndarray  # [P, R]
+    req_nz: jnp.ndarray  # [P, R]
+    class_of_pod: jnp.ndarray  # [P]
+    balanced_active: jnp.ndarray  # [P] bool
+
+
+def _pad_ct(*arrays, sentinel_class=-1):
+    """Ensure constraint arrays are non-empty (jit-stable shapes)."""
+    if arrays[0].size:
+        return [jnp.asarray(a, dtype=jnp.int32) for a in arrays]
+    out = [jnp.full((1,), sentinel_class, dtype=jnp.int32)]
+    out += [jnp.zeros((1,), dtype=jnp.int32) for _ in arrays[1:]]
+    return out
+
+
+def make_inputs(cluster, batch) -> Tuple[SolverInputs, int]:
+    """numpy -> device arrays. Returns (inputs, D_max)."""
+    t = batch.tables
+    kk = max(cluster.topo_id.shape[0], 1)
+    n = cluster.n
+    topo_id = cluster.topo_id if cluster.topo_id.size else np.full((1, n), -1, np.int32)
+    selcls = cluster.selcls_count if cluster.selcls_count.size else np.zeros((1, n), np.int32)
+    cms = batch.class_matches_selcls
+    if cms.shape[1] == 0:
+        cms = np.zeros((cms.shape[0], 1), np.int32)
+    d_max = int(cluster.num_domains.max()) if cluster.num_domains.size else 1
+
+    ct = _pad_ct(batch.ct_class, batch.ct_key, batch.ct_sel, batch.ct_max_skew,
+                 batch.ct_min_domains, batch.ct_self_match)
+    st = _pad_ct(batch.st_class, batch.st_key, batch.st_sel, batch.st_max_skew,
+                 batch.st_self_match)
+
+    inputs = SolverInputs(
+        alloc=jnp.asarray(cluster.alloc), used=jnp.asarray(cluster.used),
+        used_nz=jnp.asarray(cluster.used_nz), pod_count=jnp.asarray(cluster.pod_count),
+        max_pods=jnp.asarray(cluster.max_pods),
+        filter_ok=jnp.asarray(t.filter_ok), aff_ok=jnp.asarray(t.aff_ok),
+        napref_raw=jnp.asarray(t.napref_raw), has_napref=jnp.asarray(t.has_napref),
+        taint_cnt=jnp.asarray(t.taint_cnt), img_score=jnp.asarray(t.img_score),
+        class_ports=jnp.asarray(t.class_ports), node_ports=jnp.asarray(t.node_ports),
+        topo_id=jnp.asarray(topo_id), selcls_count=jnp.asarray(selcls),
+        class_matches_selcls=jnp.asarray(cms),
+        ct_class=ct[0], ct_key=ct[1], ct_sel=ct[2], ct_max_skew=ct[3],
+        ct_min_domains=ct[4], ct_self_match=ct[5],
+        st_class=st[0], st_key=st[1], st_sel=st[2], st_max_skew=st[3],
+        st_self_match=st[4],
+        req=jnp.asarray(batch.req), req_nz=jnp.asarray(batch.req_nz),
+        class_of_pod=jnp.asarray(batch.class_of_pod),
+        balanced_active=jnp.asarray(batch.balanced_active),
+    )
+    return inputs, d_max
+
+
+# ---------------------------------------------------------------------------
+# vectorized plugin pieces (each mirrors a serial plugin formula exactly)
+# ---------------------------------------------------------------------------
+
+
+def fit_feasible(alloc, used, pod_count, max_pods, req):
+    """NodeResourcesFit Filter (fit.go:499): req <= alloc - used per resource
+    (zero requests always fit) AND pod count headroom."""
+    ok = jnp.all((req[None, :] == 0) | (req[None, :] <= alloc - used), axis=1)
+    return ok & (pod_count + 1 <= max_pods)
+
+
+def least_allocated_score(alloc2, used2, req2):
+    """leastResourceScorer over cpu+memory (least_allocated.go:30), int math."""
+    u = used2 + req2[None, :]
+    per = jnp.where(
+        (alloc2 > 0) & (u <= alloc2),
+        (alloc2 - u) * MAX_NODE_SCORE // jnp.maximum(alloc2, 1),
+        0,
+    )
+    wsum = jnp.maximum(jnp.sum((alloc2 > 0).astype(jnp.int32), axis=1), 1)
+    return jnp.sum(per * (alloc2 > 0), axis=1) // wsum
+
+
+def balanced_score(alloc2, used2, req2, active):
+    """balancedResourceScorer 2-resource shortcut (balanced_allocation.go:145)."""
+    u = (used2 + req2[None, :]).astype(jnp.float32)
+    a = alloc2.astype(jnp.float32)
+    frac = jnp.where(a > 0, jnp.minimum(u / jnp.maximum(a, 1.0), 1.0), 0.0)
+    n_frac = jnp.sum((a > 0).astype(jnp.int32), axis=1)
+    std2 = jnp.abs(frac[:, 0] - frac[:, 1]) / 2.0
+    std = jnp.where(n_frac == 2, std2, 0.0)
+    score = ((1.0 - std) * MAX_NODE_SCORE).astype(jnp.int32)
+    return jnp.where(active, score, 0)
+
+
+def default_normalize(raw, feasible, reverse: bool):
+    """DefaultNormalizeScore over the feasible (scored) set (normalize_score.go)."""
+    mx = jnp.max(jnp.where(feasible, raw, 0))
+    scaled = jnp.where(mx > 0, MAX_NODE_SCORE * raw // jnp.maximum(mx, 1), 0)
+    if reverse:
+        out = jnp.where(mx > 0, MAX_NODE_SCORE - scaled, MAX_NODE_SCORE)
+    else:
+        out = scaled
+    return out
+
+
+def pts_counts(aff_row, dyn_selcls, topo_row, sel_idx, d_max):
+    """Per-domain matching-pod counts for one constraint: segment-sum of the
+    per-node counts over counting-eligible nodes (filtering.go calPreFilterState)."""
+    per_node = jnp.where(aff_row & (topo_row >= 0), dyn_selcls[sel_idx], 0)
+    seg = jnp.where(topo_row >= 0, topo_row, d_max)  # park missing in overflow slot
+    return jax.ops.segment_sum(per_node, seg, num_segments=d_max + 1)[:d_max]
+
+
+def pts_domain_valid(aff_row, topo_row, d_max):
+    has = jnp.where(aff_row & (topo_row >= 0), 1, 0)
+    seg = jnp.where(topo_row >= 0, topo_row, d_max)
+    return jax.ops.segment_max(has, seg, num_segments=d_max + 1)[:d_max] > 0
+
+
+# ---------------------------------------------------------------------------
+# the greedy scan solver
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("d_max",))
+def greedy_scan_solve(inp: SolverInputs, d_max: int):
+    """Sequential-within-batch greedy assignment, one lax.scan step per pod.
+
+    Exactly the serial pipeline: filter -> score -> argmax (lowest index wins
+    ties) -> commit. Returns assignment[P] int32 node index (-1 unschedulable)
+    and the final node state.
+    """
+
+    def step(state, pod):
+        used, used_nz, pod_count, dyn_selcls, port_used = state
+        req, req_nz, cls, bal_active = pod
+        cls = jnp.maximum(cls, 0)
+
+        feas = inp.filter_ok[cls]
+        feas &= fit_feasible(inp.alloc, used, pod_count, inp.max_pods, req)
+        # NodePorts (node_ports.go), dynamic: in-batch placements claim ports
+        feas &= ~jnp.any(port_used & inp.class_ports[cls][None, :], axis=1)
+
+        aff_row = inp.aff_ok[cls]
+
+        # --- PodTopologySpread DoNotSchedule (filtering.go:340) ---
+        def ct_feas(ct_c, ct_k, ct_s, ct_skew, ct_mind, ct_self):
+            active = ct_c == cls
+            topo_row = inp.topo_id[ct_k]
+            dc = pts_counts(aff_row, dyn_selcls, topo_row, ct_s, d_max)
+            valid = pts_domain_valid(aff_row, topo_row, d_max)
+            n_valid = jnp.sum(valid.astype(jnp.int32))
+            mmn = jnp.min(jnp.where(valid, dc, 2**30))
+            mmn = jnp.where((ct_mind > 0) & (ct_mind > n_valid), 0, mmn)
+            mmn = jnp.where(n_valid == 0, 0, mmn)
+            node_dc = jnp.where(topo_row >= 0, dc[jnp.clip(topo_row, 0, d_max - 1)], 0)
+            skew = node_dc + ct_self - mmn
+            ok = (topo_row >= 0) & (skew <= ct_skew)
+            return jnp.where(active, ok, True)
+
+        ct_ok = jax.vmap(ct_feas)(inp.ct_class, inp.ct_key, inp.ct_sel,
+                                  inp.ct_max_skew, inp.ct_min_domains, inp.ct_self_match)
+        feas &= jnp.all(ct_ok, axis=0)
+
+        # --- scores ---
+        alloc2 = inp.alloc[:, :2]
+        least = least_allocated_score(alloc2, used_nz[:, :2], req_nz[:2])
+        bal = balanced_score(alloc2, used[:, :2], req[:2], bal_active)
+        napref = jnp.where(inp.has_napref[cls],
+                           default_normalize(inp.napref_raw[cls], feas, reverse=False), 0)
+        taint = default_normalize(inp.taint_cnt[cls], feas, reverse=True)
+        img = inp.img_score[cls]
+
+        # --- PTS ScheduleAnyway score (scoring.go) ---
+        def st_score(st_c, st_k, st_s, st_skew, st_self):
+            active = st_c == cls
+            topo_row = inp.topo_id[st_k]
+            dc = pts_counts(aff_row, dyn_selcls, topo_row, st_s, d_max)
+            # domain set/size from the *feasible* nodes (initPreScoreState)
+            valid_feas = pts_domain_valid(feas, topo_row, d_max)
+            size = jnp.sum(valid_feas.astype(jnp.int32))
+            w = jnp.log(size.astype(jnp.float32) + 2.0)
+            node_dc = jnp.where(topo_row >= 0, dc[jnp.clip(topo_row, 0, d_max - 1)], 0)
+            contrib = node_dc.astype(jnp.float32) * w + (st_skew - 1).astype(jnp.float32)
+            # nodes missing the topology key are "IgnoredNodes" (scoring.go:121)
+            ignored_n = active & (topo_row < 0)
+            return jnp.where(active, contrib, 0.0), ignored_n, active
+
+        st_contrib, st_ignored, st_active = jax.vmap(st_score)(
+            inp.st_class, inp.st_key, inp.st_sel, inp.st_max_skew, inp.st_self_match)
+        any_st = jnp.any(st_active)
+        ignored = jnp.any(st_ignored, axis=0)  # [N]
+        pts_raw = jnp.round(jnp.sum(st_contrib, axis=0)).astype(jnp.int32)
+        # NormalizeScore: MAX*(max+min-s)//max over feasible, non-ignored nodes;
+        # ignored nodes score 0 (scoring.go:256)
+        norm_mask = feas & ~ignored
+        pmx = jnp.max(jnp.where(norm_mask, pts_raw, -(2**30)))
+        pmn = jnp.min(jnp.where(norm_mask, pts_raw, 2**30))
+        pts = jnp.where(
+            pmx > 0,
+            MAX_NODE_SCORE * (pmx + pmn - pts_raw) // jnp.maximum(pmx, 1),
+            MAX_NODE_SCORE,
+        )
+        pts = jnp.where(any_st & ~ignored & jnp.any(norm_mask), pts, 0)
+
+        total = least + bal + 2 * napref + 3 * taint + 2 * pts + img
+
+        # --- selectHost: deterministic argmax (lowest index on ties) ---
+        masked = jnp.where(feas, total, INT_MIN)
+        best = jnp.argmax(masked).astype(jnp.int32)
+        ok = feas[best]
+        node = jnp.where(ok, best, -1)
+
+        # --- commit ---
+        onehot = (jnp.arange(used.shape[0]) == node)
+        used = used + jnp.where(ok, onehot[:, None] * req[None, :], 0).astype(jnp.int32)
+        used_nz = used_nz + jnp.where(ok, onehot[:, None] * req_nz[None, :], 0).astype(jnp.int32)
+        pod_count = pod_count + jnp.where(ok, onehot.astype(jnp.int32), 0)
+        bump = inp.class_matches_selcls[cls][:, None] * onehot[None, :].astype(jnp.int32)
+        dyn_selcls = dyn_selcls + jnp.where(ok, bump, 0)
+        port_used = port_used | (ok & onehot)[:, None] & inp.class_ports[cls][None, :]
+        return (used, used_nz, pod_count, dyn_selcls, port_used), node
+
+    init = (inp.used, inp.used_nz, inp.pod_count, inp.selcls_count, inp.node_ports)
+    (used, used_nz, pod_count, dyn_selcls, port_used), assignment = jax.lax.scan(
+        step, init, (inp.req, inp.req_nz, inp.class_of_pod, inp.balanced_active)
+    )
+    return assignment, used, pod_count

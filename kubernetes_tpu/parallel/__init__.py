@@ -1,0 +1,1 @@
+"""Device mesh construction and sharded solvers (ICI-scale node/pod axes)."""

@@ -1,0 +1,121 @@
+"""Batch TPU scheduler — drains whole pending-pod batches and solves them jointly.
+
+The batching analog of ScheduleOne (SURVEY.md §2.4 'Pod-level serialization'):
+pods are popped in queue (priority) order, tensorized against the current cache
+snapshot, solved on device with the greedy scan kernel (ops/solver.py), and the
+resulting assignments are assumed + bound through the same store surface the
+serial path uses. Classes with features the device path doesn't cover yet
+(inter-pod affinity, non-default PTS inclusion policies) fall back to the serial
+oracle pod-by-pod — the framework-gating stance of the north star (solver
+behind the same extension surface, serial path always available).
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional
+
+import numpy as np
+
+from ..snapshot.tensorizer import build_cluster_tensors, build_pod_batch
+from ..store import APIStore
+from .framework import Status
+from .queue import QueuedPodInfo
+from .runtime import Framework
+from .serial import Scheduler
+
+
+class BatchScheduler(Scheduler):
+    def __init__(self, store: APIStore, framework: Framework, batch_size: int = 4096, **kw):
+        super().__init__(store, framework, **kw)
+        self.batch_size = batch_size
+        self.batches_solved = 0
+
+    def schedule_batch(self, timeout: Optional[float] = 0.0) -> int:
+        """Drain up to batch_size pods, solve jointly, bind. Returns #pods handled."""
+        from ..ops.solver import greedy_scan_solve, make_inputs
+
+        self.pump_events()
+        qps = self.queue.pop_batch(self.batch_size, timeout=timeout)
+        if not qps:
+            return 0
+        snapshot = self.cache.update_snapshot()
+        if len(snapshot) == 0:
+            for qp in qps:
+                self._handle_failure(qp, Status.unschedulable("no nodes available to schedule pods"))
+            return len(qps)
+
+        cluster = build_cluster_tensors(snapshot)
+        pods = [qp.pod for qp in qps]
+        batch = build_pod_batch(pods, snapshot, cluster)
+
+        fallback_mask = batch.fallback_class[batch.class_of_pod]
+        device_idx = np.nonzero(~fallback_mask)[0]
+        fallback_idx = np.nonzero(fallback_mask)[0]
+
+        if device_idx.size:
+            sub = _subset_batch(batch, device_idx)
+            inputs, d_max = make_inputs(cluster, sub)
+            assignment, _, _ = greedy_scan_solve(inputs, d_max)
+            assignment = np.asarray(assignment)
+            for j, pi in enumerate(device_idx):
+                qp = qps[pi]
+                nidx = int(assignment[j])
+                if nidx < 0:
+                    self._handle_failure(qp, Status.unschedulable(
+                        f"0/{len(snapshot)} nodes are available (batch solver)"))
+                else:
+                    self._bind_assignment(qp, cluster.node_names[nidx])
+
+        # Serial fallback, in original priority order among themselves.
+        for pi in fallback_idx:
+            self._serial_one(qps[pi])
+
+        self.batches_solved += 1
+        return len(qps)
+
+    def _bind_assignment(self, qp: QueuedPodInfo, node_name: str) -> None:
+        assumed = copy.deepcopy(qp.pod)
+        try:
+            self.cache.assume_pod(assumed, node_name)
+        except ValueError as e:
+            self._handle_failure(qp, Status.error(str(e)))
+            return
+        try:
+            self.store.bind(qp.pod.metadata.namespace, qp.pod.metadata.name, node_name)
+            self.cache.finish_binding(assumed)
+            self.scheduled_count += 1
+        except Exception as e:
+            self.cache.forget_pod(assumed)
+            self._handle_failure(qp, Status.error(str(e)))
+
+    def _serial_one(self, qp: QueuedPodInfo) -> None:
+        result = self.schedule_pod(qp.pod)
+        if not result.suggested_host:
+            self._handle_failure(qp, result.status)
+            return
+        self._bind_assignment(qp, result.suggested_host)
+
+    def run_until_idle(self, max_cycles: int = 10_000) -> int:
+        n = 0
+        while n < max_cycles:
+            if self.schedule_batch(timeout=0.0) == 0:
+                self.pump_events()
+                if self.schedule_batch(timeout=0.0) == 0:
+                    break
+            n += 1
+        return n
+
+
+def _subset_batch(batch, idx):
+    """View of a PodBatchTensors restricted to pod rows idx (class tables shared)."""
+    import dataclasses
+
+    return dataclasses.replace(
+        batch,
+        pods=[batch.pods[i] for i in idx],
+        class_of_pod=batch.class_of_pod[idx],
+        req=batch.req[idx],
+        req_nz=batch.req_nz[idx],
+        balanced_active=batch.balanced_active[idx],
+    )

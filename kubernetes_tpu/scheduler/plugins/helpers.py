@@ -32,17 +32,22 @@ def term_namespaces_match(term: PodAffinityTerm, source_ns: str, target_ns: str,
     return False
 
 
-def effective_selector(term: PodAffinityTerm, source_pod) -> Optional[Selector]:
-    """Merge matchLabelKeys values from the source pod into the term selector
-    (reference: interpodaffinity matchLabelKeys handling)."""
-    sel = term.selector
-    if not term.match_label_keys or sel is None:
+def _merge_match_label_keys(sel: Optional[Selector], match_label_keys,
+                            source_pod) -> Optional[Selector]:
+    """matchLabelKeys merge shared by InterPodAffinity terms and PTS constraints:
+    the source pod's value for each listed key is appended as an In requirement."""
+    if not match_label_keys or sel is None:
         return sel
     extra = []
-    for k in term.match_label_keys:
+    for k in match_label_keys:
         if k in source_pod.metadata.labels:
             extra.append(Requirement(k, IN, (source_pod.metadata.labels[k],)))
     return Selector(sel.requirements + tuple(extra))
+
+
+def effective_selector(term: PodAffinityTerm, source_pod) -> Optional[Selector]:
+    """reference: interpodaffinity matchLabelKeys handling."""
+    return _merge_match_label_keys(term.selector, term.match_label_keys, source_pod)
 
 
 def term_matches_pod(term: PodAffinityTerm, source_pod, target_pod,
@@ -58,14 +63,7 @@ def term_matches_pod(term: PodAffinityTerm, source_pod, target_pod,
 
 def pts_effective_selector(constraint, pod) -> Optional[Selector]:
     """PTS matchLabelKeys merge (reference: podtopologyspread/common.go)."""
-    sel = constraint.selector
-    if not constraint.match_label_keys or sel is None:
-        return sel
-    extra = []
-    for k in constraint.match_label_keys:
-        if k in pod.metadata.labels:
-            extra.append(Requirement(k, IN, (pod.metadata.labels[k],)))
-    return Selector(sel.requirements + tuple(extra))
+    return _merge_match_label_keys(constraint.selector, constraint.match_label_keys, pod)
 
 
 def count_pods_match_selector(pod_infos, selector: Optional[Selector], ns: str) -> int:

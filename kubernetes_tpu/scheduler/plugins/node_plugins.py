@@ -45,26 +45,20 @@ class NodePorts(Plugin):
     _KEY = "PreFilterNodePorts"
 
     def pre_filter(self, state: CycleState, pod, snapshot):
-        ports = [
-            (p.host_ip or "0.0.0.0", p.protocol or "TCP", p.host_port)
-            for c in pod.spec.containers
-            for p in c.ports
-            if p.host_port > 0
-        ]
+        from ..framework import _host_ports
+
+        ports = list(_host_ports(pod))
         state.write(self._KEY, ports)
         if not ports:
             return None, Status.skip(plugin=self.name)
         return None, SUCCESS
 
     def filter(self, state: CycleState, pod, node_info: NodeInfo) -> Status:
+        from ..framework import _host_ports
+
         ports = state.read_or_none(self._KEY)
         if ports is None:
-            ports = [
-                (p.host_ip or "0.0.0.0", p.protocol or "TCP", p.host_port)
-                for c in pod.spec.containers
-                for p in c.ports
-                if p.host_port > 0
-            ]
+            ports = list(_host_ports(pod))
         for ip, proto, port in ports:
             for uip, uproto, uport in node_info.used_ports:
                 if port == uport and proto == uproto and (

@@ -60,11 +60,15 @@ class SchedulingQueue:
     def __init__(self, clock: Optional[Clock] = None,
                  initial_backoff: float = DEFAULT_POD_INITIAL_BACKOFF,
                  max_backoff: float = DEFAULT_POD_MAX_BACKOFF,
-                 less=None):
+                 less=None, pre_enqueue=None):
         self._clock = clock or Clock()
         self._initial_backoff = initial_backoff
         self._max_backoff = max_backoff
         self._less = less  # (QueuedPodInfo, QueuedPodInfo) -> bool; default priority desc
+        # pre_enqueue(pod) -> bool: re-checked on every promotion into activeQ
+        # (the reference re-runs PreEnqueue in moveToActiveQ — a gated pod must
+        # never reach the active queue via an unrelated cluster event)
+        self._pre_enqueue = pre_enqueue
         self._lock = threading.Condition()
         self._seq = itertools.count()
         # activeQ: heap of (sort_key, seq, QueuedPodInfo)
@@ -94,6 +98,9 @@ class SchedulingQueue:
     def _push_active(self, qp: QueuedPodInfo) -> None:
         self._unschedulable.pop(qp.key, None)
         if qp.key in self._in_active:
+            return
+        if self._pre_enqueue is not None and not self._pre_enqueue(qp.pod):
+            self._unschedulable[qp.key] = qp  # still gated: stay parked
             return
         self._in_active[qp.key] = qp
         heapq.heappush(self._active, (self._sort_key(qp), next(self._seq), qp))

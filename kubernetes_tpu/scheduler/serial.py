@@ -77,6 +77,7 @@ class Scheduler:
         self.queue = SchedulingQueue(
             clock=self.clock,
             less=qs.less if qs is not None and not isinstance(qs, PrioritySort) else None,
+            pre_enqueue=lambda pod: framework.run_pre_enqueue(pod).is_success(),
         )
         self.percentage = percentage_of_nodes_to_score
         self._watch = None
@@ -134,10 +135,13 @@ class Scheduler:
             self._ns_labels[ev.obj.metadata.name] = dict(ev.obj.metadata.labels)
 
     def _handle_pod(self, etype: str, pod: Pod) -> None:
-        # Pod informer filters terminal pods (scheduler.go:582).
+        # Pod informer filters terminal pods (scheduler.go:582); a queued pod
+        # turning terminal generates a queue delete (predicate stops matching).
         if pod.is_terminal():
             if pod.spec.node_name:
                 self.cache.remove_pod(pod)
+            else:
+                self.queue.delete(pod)
             return
         if etype == DELETED:
             if pod.spec.node_name:
@@ -149,6 +153,10 @@ class Scheduler:
         if pod.spec.node_name:
             if self.cache.is_assumed(pod.key):
                 self.cache.add_pod(pod)  # confirm assumed
+            elif etype == MODIFIED:
+                # keep labels/requests fresh — affinity/spread counts read them
+                self.cache.update_pod(pod)
+                self.queue.move_all_to_active_or_backoff()
             else:
                 self.cache.add_pod(pod)
                 self.queue.move_all_to_active_or_backoff()

@@ -1,0 +1,4 @@
+"""Snapshot/tensorizer — cluster state as struct-of-arrays for the device."""
+
+from .class_compiler import ClassTables, NodeColumns, compile_class_tables, pod_class_signature  # noqa: F401
+from .tensorizer import ClusterTensors, PodBatchTensors, build_cluster_tensors, build_pod_batch  # noqa: F401

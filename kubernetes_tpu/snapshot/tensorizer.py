@@ -1,0 +1,291 @@
+"""Cluster snapshot -> struct-of-arrays tensors for the TPU solver.
+
+SURVEY.md §7 step 2: NodeInfo-equivalent struct-of-arrays (allocatable/requested
+[N,R], dictionary-encoded labels, topology-value ids, per-constraint count
+tensors), mirroring the generation-diff stream of cache.go:186.
+
+Quantization (device int32 everywhere — exact, no float rounding at feasibility
+boundaries):
+  cpu               -> millicores
+  memory, ephemeral -> MiB; allocatable floors, requests ceil, so the device
+                       view is conservative: it never admits a pod the byte-
+                       exact oracle would reject (it may rarely reject one the
+                       oracle admits, by < 1MiB).
+  scalar resources  -> raw integer counts
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..api import Pod, Resource, compute_pod_resource_request
+from ..api.resources import CPU, EPHEMERAL_STORAGE, MEMORY
+from ..scheduler.framework import Snapshot
+from .class_compiler import (
+    ClassTables,
+    NodeColumns,
+    compile_class_tables,
+    pod_class_signature,
+)
+
+MI = 1024 * 1024
+
+
+def _quantize(r: Resource, resource_dims: Sequence[str], is_request: bool) -> List[int]:
+    out = []
+    for name in resource_dims:
+        if name == CPU:
+            out.append(r.milli_cpu)
+        elif name == MEMORY:
+            v = r.memory
+            out.append(-(-v // MI) if is_request else v // MI)
+        elif name == EPHEMERAL_STORAGE:
+            v = r.ephemeral_storage
+            out.append(-(-v // MI) if is_request else v // MI)
+        else:
+            out.append(r.scalar.get(name, 0))
+    return out
+
+
+@dataclass
+class ClusterTensors:
+    """Node-axis tensors + class tables + topology-spread tensors (all numpy;
+    ops/ moves them to device)."""
+
+    node_names: List[str]
+    resource_dims: List[str]  # dim meaning; [cpu, memory, ephemeral-storage, *extended]
+    alloc: np.ndarray  # [N, R] int32
+    used: np.ndarray  # [N, R] int32 (Requested)
+    used_nz: np.ndarray  # [N, R] int32 (NonZeroRequested)
+    pod_count: np.ndarray  # [N] int32
+    max_pods: np.ndarray  # [N] int32
+    cols: NodeColumns
+
+    # topology keys in use: key -> row in topo_id
+    topo_keys: List[str]
+    topo_id: np.ndarray  # [Kk, N] int32 domain id per node (-1 = label missing)
+    num_domains: np.ndarray  # [Kk] int32
+
+    # selector-classes for PTS counting: (namespace, selector) -> row
+    selcls_count: np.ndarray  # [SC, N] int32 existing matching pods per node
+
+    @property
+    def n(self) -> int:
+        return len(self.node_names)
+
+
+@dataclass
+class PodBatchTensors:
+    """Pod-axis tensors for one batch + the class tables they index into."""
+
+    pods: List[Pod]
+    class_of_pod: np.ndarray  # [P] int32
+    req: np.ndarray  # [P, R] int32
+    req_nz: np.ndarray  # [P, R] int32
+    # balanced-allocation activity: all-zero plain request => skip (Skip status)
+    balanced_active: np.ndarray  # [P] bool
+    tables: ClassTables
+
+    # flattened DoNotSchedule topology-spread constraints across classes:
+    ct_class: np.ndarray  # [CT] int32 (owning class)
+    ct_key: np.ndarray  # [CT] int32 (row into topo_id)
+    ct_sel: np.ndarray  # [CT] int32 (row into selcls_count)
+    ct_max_skew: np.ndarray  # [CT] int32
+    ct_min_domains: np.ndarray  # [CT] int32 (0 = unset)
+    ct_self_match: np.ndarray  # [CT] int32 (pod matches own constraint selector)
+    # ScheduleAnyway constraints (scored), same layout:
+    st_class: np.ndarray
+    st_key: np.ndarray
+    st_sel: np.ndarray
+    st_max_skew: np.ndarray
+    st_self_match: np.ndarray
+    # cross-matching: does a pod of class c match selector-class sc?
+    class_matches_selcls: np.ndarray  # [C, SC] int32
+
+    # classes whose pods cannot be batch-solved (unsupported features) — the
+    # batch driver routes these to the serial fallback
+    fallback_class: np.ndarray  # [C] bool
+
+    @property
+    def p(self) -> int:
+        return len(self.pods)
+
+    @property
+    def c(self) -> int:
+        return len(self.tables.rep_pods)
+
+
+def build_cluster_tensors(snapshot: Snapshot, extra_resource_dims: Sequence[str] = ()) -> ClusterTensors:
+    node_infos = snapshot.node_info_list
+    n = len(node_infos)
+    # resource dims: core three + every extended resource present in allocatable
+    extended = set(extra_resource_dims)
+    for ni in node_infos:
+        extended.update(ni.allocatable.scalar.keys())
+    resource_dims = [CPU, MEMORY, EPHEMERAL_STORAGE] + sorted(extended)
+    r = len(resource_dims)
+
+    alloc = np.zeros((n, r), dtype=np.int64)
+    used = np.zeros((n, r), dtype=np.int64)
+    used_nz = np.zeros((n, r), dtype=np.int64)
+    pod_count = np.zeros(n, dtype=np.int32)
+    max_pods = np.zeros(n, dtype=np.int32)
+    for i, ni in enumerate(node_infos):
+        alloc[i] = _quantize(ni.allocatable, resource_dims, is_request=False)
+        used[i] = _quantize(ni.requested, resource_dims, is_request=True)
+        used_nz[i] = _quantize(ni.non_zero_requested, resource_dims, is_request=True)
+        pod_count[i] = len(ni.pods)
+        max_pods[i] = ni.allocatable.allowed_pod_number
+
+    cols = NodeColumns(node_infos)
+    return ClusterTensors(
+        node_names=[ni.node.metadata.name for ni in node_infos],
+        resource_dims=resource_dims,
+        alloc=alloc.astype(np.int32),
+        used=used.astype(np.int32),
+        used_nz=used_nz.astype(np.int32),
+        pod_count=pod_count,
+        max_pods=max_pods,
+        cols=cols,
+        topo_keys=[],
+        topo_id=np.zeros((0, n), dtype=np.int32),
+        num_domains=np.zeros(0, dtype=np.int32),
+        selcls_count=np.zeros((0, n), dtype=np.int32),
+    )
+
+
+def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
+                    cluster: ClusterTensors) -> PodBatchTensors:
+    """Group pods into classes, compile class tables, build PTS tensors."""
+    sig_to_class: Dict[tuple, int] = {}
+    rep_pods: List[Pod] = []
+    class_of_pod = np.zeros(len(pods), dtype=np.int32)
+    for pi, pod in enumerate(pods):
+        sig = pod_class_signature(pod)
+        ci = sig_to_class.get(sig)
+        if ci is None:
+            ci = len(rep_pods)
+            sig_to_class[sig] = ci
+            rep_pods.append(pod)
+        class_of_pod[pi] = ci
+
+    tables = compile_class_tables(rep_pods, cluster.cols)
+
+    r = len(cluster.resource_dims)
+    req = np.zeros((len(pods), r), dtype=np.int64)
+    req_nz = np.zeros((len(pods), r), dtype=np.int64)
+    balanced_active = np.zeros(len(pods), dtype=bool)
+    for pi, pod in enumerate(pods):
+        pr = compute_pod_resource_request(pod)
+        prnz = compute_pod_resource_request(pod, non_zero=True)
+        req[pi] = _quantize(pr, cluster.resource_dims, is_request=True)
+        req_nz[pi] = _quantize(prnz, cluster.resource_dims, is_request=True)
+        # BalancedAllocation PreScore skip rule: best-effort over configured
+        # resources (cpu+memory) (balanced_allocation.go PreScore)
+        balanced_active[pi] = (pr.milli_cpu != 0 or pr.memory != 0)
+
+    # -- topology keys + selector classes over the classes' TSCs ----------------
+    topo_key_idx: Dict[str, int] = {k: i for i, k in enumerate(cluster.topo_keys)}
+    selcls_idx: Dict[tuple, int] = {}
+    selcls_defs: List[Tuple[str, object]] = []  # (namespace, Selector)
+
+    def topo_row(key: str) -> int:
+        if key not in topo_key_idx:
+            topo_key_idx[key] = len(topo_key_idx)
+            cluster.topo_keys.append(key)
+            vocab, ids = cluster.cols.val_ids(key)
+            row = ids[None, :].astype(np.int32)
+            cluster.topo_id = np.concatenate([cluster.topo_id, row], axis=0) \
+                if cluster.topo_id.size else row
+            nd = np.array([max(len(vocab), 1)], dtype=np.int32)
+            cluster.num_domains = np.concatenate([cluster.num_domains, nd])
+        return topo_key_idx[key]
+
+    def selcls_row(namespace: str, selector) -> int:
+        key = (namespace, selector)
+        if key not in selcls_idx:
+            selcls_idx[key] = len(selcls_idx)
+            selcls_defs.append(key)
+        return selcls_idx[key]
+
+    from ..scheduler.plugins.helpers import pts_effective_selector
+
+    ct_rows, st_rows = [], []
+    fallback_class = np.zeros(len(rep_pods), dtype=bool)
+    for ci, pod in enumerate(rep_pods):
+        aff = pod.spec.affinity
+        if aff and (aff.pod_affinity_required or aff.pod_anti_affinity_required
+                    or aff.pod_affinity_preferred or aff.pod_anti_affinity_preferred):
+            # InterPodAffinity lands on device in the next milestone; until then
+            # these classes go through the serial oracle.
+            fallback_class[ci] = True
+        for c in pod.spec.topology_spread_constraints:
+            sel = pts_effective_selector(c, pod)
+            if sel is None:
+                continue
+            if c.node_affinity_policy != "Honor" or c.node_taints_policy != "Ignore":
+                fallback_class[ci] = True  # non-default inclusion policies: serial
+                continue
+            row = (
+                ci,
+                topo_row(c.topology_key),
+                selcls_row(pod.metadata.namespace, sel),
+                c.max_skew,
+                c.min_domains or 0,
+                1 if sel.matches(pod.metadata.labels) else 0,
+            )
+            if c.when_unsatisfiable == "DoNotSchedule":
+                ct_rows.append(row)
+            else:
+                st_rows.append(row)
+
+    # existing matching-pod counts per (selector-class, node)
+    sc = len(selcls_defs)
+    selcls_count = np.zeros((sc, cluster.n), dtype=np.int32)
+    for si, (ns, sel) in enumerate(selcls_defs):
+        for nidx, ni in enumerate(snapshot.node_info_list):
+            cnt = 0
+            for pinfo in ni.pods:
+                p = pinfo.pod
+                if p.metadata.namespace == ns and p.metadata.deletion_timestamp is None \
+                        and sel.matches(p.metadata.labels):
+                    cnt += 1
+            selcls_count[si, nidx] = cnt
+    cluster.selcls_count = selcls_count
+
+    # cross-match: placing a pod of class c bumps counts of selector-class sc?
+    class_matches = np.zeros((len(rep_pods), max(sc, 1)), dtype=np.int32)
+    for ci, pod in enumerate(rep_pods):
+        for si, (ns, sel) in enumerate(selcls_defs):
+            if pod.metadata.namespace == ns and sel.matches(pod.metadata.labels):
+                class_matches[ci, si] = 1
+
+    def rows_to_arrays(rows, with_min_domains):
+        if not rows:
+            z = np.zeros(0, dtype=np.int32)
+            return (z, z, z, z, z, z) if with_min_domains else (z, z, z, z, z)
+        a = np.array(rows, dtype=np.int32)
+        if with_min_domains:
+            return a[:, 0], a[:, 1], a[:, 2], a[:, 3], a[:, 4], a[:, 5]
+        return a[:, 0], a[:, 1], a[:, 2], a[:, 3], a[:, 5]
+
+    ct_class, ct_key, ct_sel, ct_max_skew, ct_min_domains, ct_self = rows_to_arrays(ct_rows, True)
+    st_class, st_key, st_sel, st_max_skew, st_self = rows_to_arrays(st_rows, False)
+
+    return PodBatchTensors(
+        pods=list(pods),
+        class_of_pod=class_of_pod,
+        req=req.astype(np.int32),
+        req_nz=req_nz.astype(np.int32),
+        balanced_active=balanced_active,
+        tables=tables,
+        ct_class=ct_class, ct_key=ct_key, ct_sel=ct_sel,
+        ct_max_skew=ct_max_skew, ct_min_domains=ct_min_domains, ct_self_match=ct_self,
+        st_class=st_class, st_key=st_key, st_sel=st_sel,
+        st_max_skew=st_max_skew, st_self_match=st_self,
+        class_matches_selcls=class_matches,
+        fallback_class=fallback_class,
+    )

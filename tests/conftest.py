@@ -5,9 +5,15 @@ hardware (the driver's dryrun does the same)."""
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # unconditional: tests never touch the TPU
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment's sitecustomize may have force-registered a hardware PJRT
+# plugin before this conftest ran; the config update (pre-backend-init) wins.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -296,3 +296,49 @@ class TestReviewRegressions:
         assert snap.get("n1").image_states["img:1"].num_nodes == 2
         c.remove_node("n2")
         assert snap.get("n1").image_states["img:1"].num_nodes == 1  # shared entry
+
+    def test_gated_pod_survives_cluster_events(self):
+        """A cluster event must not promote a gated pod into activeQ
+        (PreEnqueue re-runs on promotion, like moveToActiveQ)."""
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "4"}).obj())
+        store.create("pods", MakePod("gated").req({"cpu": "1"}).scheduling_gate("wait").obj())
+        sched = make_scheduler(store)
+        sched.sync()
+        sched.run_until_idle()
+        store.create("nodes", MakeNode("n1").capacity({"cpu": "4"}).obj())  # event
+        sched.pump_events()
+        sched.queue.flush_unschedulable_left_over()
+        sched.run_until_idle()
+        assert sched.scheduled_count == 0
+        assert store.get("pods", "default/gated").spec.node_name == ""
+
+    def test_terminal_queued_pod_not_scheduled(self):
+        store = APIStore()
+        store.create("pods", MakePod("doomed").req({"cpu": "1"}).obj())
+        sched = make_scheduler(store)
+        sched.sync()
+
+        def fail_it(st):
+            st.phase = "Failed"
+
+        store.update_pod_status("default", "doomed", fail_it)
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "4"}).obj())
+        sched.run_until_idle()
+        assert sched.scheduled_count == 0
+        assert store.get("pods", "default/doomed").spec.node_name == ""
+
+    def test_bound_pod_label_update_reaches_cache(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "4"}).obj())
+        store.create("pods", MakePod("p").labels({"app": "old"}).req({"cpu": "1"}).obj())
+        sched = make_scheduler(store)
+        sched.sync()
+        sched.run_until_idle()
+        pod = store.get("pods", "default/p")
+        pod.metadata.labels["app"] = "new"
+        store.update("pods", pod)
+        sched.pump_events()
+        snap = sched.cache.update_snapshot()
+        labels = [pi.pod.metadata.labels["app"] for pi in snap.get("n0").pods]
+        assert labels == ["new"]
