@@ -1,0 +1,184 @@
+"""Water-filling batch solver — the fast path for constraint-light batches.
+
+Greedy scheduling of identical pods is a water-filling process: each placement
+takes the current-best node, whose score then decreases. For a group of
+identical pods (same equivalence class AND same resource vector), the j-th
+placement on node n has a computable marginal score s[n, j] — so the whole
+greedy sequence collapses into ONE top-k over the [N, J] marginal-score matrix
+instead of P sequential steps. This replaces the per-pod loop with a handful of
+fully-parallel device ops: the MXU/VPU-friendly formulation of
+prioritizeNodes() (reference: schedule_one.go:754).
+
+Exactness: scores are evaluated against group-start normalization and made
+monotone by a running cummin, so selections have the prefix property (if slot
+(n, j) is chosen, all (n, i<j) are too). For score compositions that are
+monotone per node (LeastAllocated + static scores — the SchedulingBasic /
+NodeAffinity / Taint workloads), this equals the serial greedy assignment
+*counts* per node; BalancedAllocation's non-monotone hump is handled by the
+cummin (pessimistic, may diverge from serial by small score-epsilon choices).
+Filter correctness is exact: a selected slot always fits.
+
+Batches containing PodTopologySpread or InterPodAffinity constraints are routed
+to the exact scan solver by the driver (solver='auto').
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.solver import (
+    SolverInputs,
+    default_normalize,
+    INT_MIN,
+)
+from ..scheduler.framework import MAX_NODE_SCORE
+
+
+@functools.partial(jax.jit, static_argnames=("j_max", "k_slots"))
+def waterfill_group(
+    alloc, used, used_nz, pod_count, max_pods,
+    filter_ok_row, port_conflict_row, has_port,
+    napref_row, has_napref, taint_row, img_row,
+    req, req_nz, bal_active, group_size,
+    j_max: int, k_slots: int,
+):
+    """Place `group_size` (dynamic, <= k_slots) identical pods. k_slots is the
+    static top-k width — bucketed to powers of two by the caller so batch-size
+    changes don't recompile. Returns (k_per_node [N] int32, placement node ids
+    [k_slots] int32 in greedy order, -1 beyond group_size)."""
+    n = alloc.shape[0]
+    # J_n: how many of this pod fit on node n right now
+    free = alloc - used
+    with_req = jnp.where(req[None, :] > 0, free // jnp.maximum(req[None, :], 1), j_max)
+    j_cap = jnp.min(with_req, axis=1).astype(jnp.int32)
+    j_cap = jnp.minimum(j_cap, max_pods - pod_count)
+    j_cap = jnp.where(filter_ok_row, j_cap, 0)
+    # a class with host ports can hold at most one pod per node, and zero where
+    # the port is already taken
+    j_cap = jnp.where(has_port, jnp.where(port_conflict_row, 0, jnp.minimum(j_cap, 1)), j_cap)
+    j_cap = jnp.clip(j_cap, 0, j_max)
+
+    # static (per-node) score components, normalized over the group-start
+    # feasible set
+    feas0 = j_cap > 0
+    napref = jnp.where(has_napref, default_normalize(napref_row, feas0, reverse=False), 0)
+    taint = default_normalize(taint_row, feas0, reverse=True)
+    static = 2 * napref + 3 * taint + img_row  # int32 [N]
+
+    # dynamic components as a function of j = pods already added (0..j_max-1)
+    js = jnp.arange(j_max, dtype=jnp.int32)  # [J]
+    alloc2 = alloc[:, :2]  # cpu, memory — the configured scoring resources
+    u_nz = used_nz[:, :2][:, None, :] + (js[None, :, None] + 1) * req_nz[None, None, :2]
+    a2 = alloc2[:, None, :]
+    per = jnp.where((a2 > 0) & (u_nz <= a2),
+                    (a2 - u_nz) * MAX_NODE_SCORE // jnp.maximum(a2, 1), 0)
+    wsum = jnp.maximum(jnp.sum((alloc2 > 0).astype(jnp.int32), axis=1), 1)
+    least = jnp.sum(per * (a2 > 0), axis=2) // wsum[:, None]  # [N, J]
+
+    u_pl = used[:, :2][:, None, :].astype(jnp.float32) \
+        + (js[None, :, None] + 1).astype(jnp.float32) * req[None, None, :2].astype(jnp.float32)
+    a2f = alloc2[:, None, :].astype(jnp.float32)
+    frac = jnp.where(a2f > 0, jnp.minimum(u_pl / jnp.maximum(a2f, 1.0), 1.0), 0.0)
+    n_frac = jnp.sum((alloc2 > 0).astype(jnp.int32), axis=1)
+    std = jnp.where(n_frac[:, None] == 2, jnp.abs(frac[..., 0] - frac[..., 1]) / 2.0, 0.0)
+    bal = jnp.where(bal_active, ((1.0 - std) * MAX_NODE_SCORE).astype(jnp.int32), 0)
+
+    score = least + bal + static[:, None]  # [N, J]
+    # prefix property: make marginal scores non-increasing in j
+    score = jax.lax.associative_scan(jnp.minimum, score, axis=1)
+    # mask slots beyond capacity
+    score = jnp.where(js[None, :] < j_cap[:, None], score, INT_MIN)
+
+    # greedy order = sort by (score desc, node asc, j asc). Encoded into one
+    # int32 sort key: key = score * (n*j_max+1) - slot_rank. Valid while
+    # max_score * slots < 2^31 — i.e. up to ~3M slots (scores are <= ~700);
+    # callers cap j_max / shard nodes beyond that.
+    slots = n * j_max
+    flat_score = score.reshape(-1)
+    # row-major flat index IS the (node asc, j asc) tie-break rank
+    slot_rank = jnp.arange(slots, dtype=jnp.int32)
+    sentinel = jnp.int32(-(2**31) + 1)
+    key = flat_score * (slots + 1) - slot_rank
+    key = jnp.where(flat_score <= INT_MIN, sentinel, key)
+    top_keys, top_idx = jax.lax.top_k(key, k_slots)
+    chosen = (top_keys > sentinel) & (jnp.arange(k_slots) < group_size)
+    chosen_nodes = jnp.where(chosen, (top_idx // j_max).astype(jnp.int32), -1)
+
+    k_per_node = jax.ops.segment_sum(
+        chosen.astype(jnp.int32),
+        jnp.where(chosen, top_idx // j_max, n).astype(jnp.int32),
+        num_segments=n + 1,
+    )[:n]
+    return k_per_node, chosen_nodes
+
+
+def waterfill_solve(inp: SolverInputs, groups: List[Tuple[np.ndarray, int]]):
+    """Solve a batch as a sequence of identical-pod groups (few device calls).
+
+    groups: list of (member_pod_indices (queue-ordered), class_id). Produces
+    assignment[P] int32 like greedy_scan_solve, or None when the problem shape
+    exceeds the fast path's int32 sort-key range (caller falls back to scan).
+    """
+    p = inp.req.shape[0]
+    n = inp.alloc.shape[0]
+    # j_max must cover every node's remaining pod headroom, or schedulable pods
+    # would be silently clipped; the int32 sort key bounds slots at ~2.6M
+    # (max_total_score 800 * slots < 2^31)
+    j_max = max(1, int(np.asarray(inp.max_pods - inp.pod_count).max(initial=1)))
+    if n * j_max > 2_600_000:
+        return None
+    assignment = np.full(p, -1, dtype=np.int32)
+    used = inp.used
+    used_nz = inp.used_nz
+    pod_count = inp.pod_count
+    port_taken = inp.node_ports
+
+    for members, cls in groups:
+        pi0 = int(members[0])
+        has_port = bool(np.asarray(inp.class_ports[cls]).any())
+        port_conflict = jnp.any(port_taken & inp.class_ports[cls][None, :], axis=1)
+        # pow2 bucket keeps the jit key stable across batch sizes; never wider
+        # than the slot count (top_k requires k <= size)
+        k_slots = min(1 << (len(members) - 1).bit_length(), n * j_max)
+        k_per_node, chosen_nodes = waterfill_group(
+            inp.alloc, used, used_nz, pod_count, inp.max_pods,
+            inp.filter_ok[cls], port_conflict, has_port,
+            inp.napref_raw[cls], inp.has_napref[cls], inp.taint_cnt[cls],
+            inp.img_score[cls],
+            inp.req[pi0], inp.req_nz[pi0], inp.balanced_active[pi0],
+            jnp.int32(len(members)),
+            j_max=j_max, k_slots=k_slots,
+        )
+        chosen = np.full(len(members), -1, dtype=np.int32)
+        got = np.asarray(chosen_nodes)[: len(members)]
+        chosen[: len(got)] = got  # k_slots may be < group size: overflow stays -1
+        assignment[np.asarray(members)] = chosen
+        # commit group effects
+        placed = jnp.asarray(k_per_node)
+        used = used + placed[:, None] * inp.req[pi0][None, :]
+        used_nz = used_nz + placed[:, None] * inp.req_nz[pi0][None, :]
+        pod_count = pod_count + placed
+        if has_port:
+            port_taken = port_taken | ((placed > 0)[:, None] & inp.class_ports[cls][None, :])
+
+    return assignment
+
+
+def make_groups(batch) -> List[Tuple[np.ndarray, int]]:
+    """Group batch pods by (class, resource vector), preserving queue order of
+    first appearance (the fast path's priority approximation)."""
+    keys = {}
+    order = []
+    for i in range(len(batch.pods)):
+        k = (int(batch.class_of_pod[i]), batch.req[i].tobytes(), batch.req_nz[i].tobytes(),
+             bool(batch.balanced_active[i]))
+        if k not in keys:
+            keys[k] = []
+            order.append(k)
+        keys[k].append(i)
+    return [(np.array(keys[k], dtype=np.int64), k[0]) for k in order]

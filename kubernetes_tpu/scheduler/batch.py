@@ -26,9 +26,15 @@ from .serial import Scheduler
 
 
 class BatchScheduler(Scheduler):
-    def __init__(self, store: APIStore, framework: Framework, batch_size: int = 4096, **kw):
+    """solver: 'exact' (scan, bit-parity with serial), 'fast' (water-filling),
+    or 'auto' (fast when the batch has no topology-spread constraints, exact
+    otherwise)."""
+
+    def __init__(self, store: APIStore, framework: Framework, batch_size: int = 4096,
+                 solver: str = "exact", **kw):
         super().__init__(store, framework, **kw)
         self.batch_size = batch_size
+        self.solver = solver
         self.batches_solved = 0
 
     def schedule_batch(self, timeout: Optional[float] = 0.0) -> int:
@@ -56,7 +62,20 @@ class BatchScheduler(Scheduler):
         if device_idx.size:
             sub = _subset_batch(batch, device_idx)
             inputs, d_max = make_inputs(cluster, sub)
-            assignment, _, _ = greedy_scan_solve(inputs, d_max)
+            # 'fast' means fast-when-legal: the water-fill kernel has no
+            # topology-spread handling, so constrained batches always take the
+            # exact scan path regardless of mode.
+            use_fast = (
+                self.solver in ("fast", "auto")
+                and batch.ct_class.size == 0 and batch.st_class.size == 0
+            )
+            assignment = None
+            if use_fast:
+                from ..models.waterfill import make_groups, waterfill_solve
+
+                assignment = waterfill_solve(inputs, make_groups(sub))
+            if assignment is None:
+                assignment, _, _ = greedy_scan_solve(inputs, d_max)
             assignment = np.asarray(assignment)
             for j, pi in enumerate(device_idx):
                 qp = qps[pi]

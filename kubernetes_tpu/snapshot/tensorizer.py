@@ -178,14 +178,28 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
     req = np.zeros((len(pods), r), dtype=np.int64)
     req_nz = np.zeros((len(pods), r), dtype=np.int64)
     balanced_active = np.zeros(len(pods), dtype=bool)
+    # memoize by container-resources signature: template-stamped pods (the
+    # overwhelmingly common case) compute their request vectors exactly once
+    req_cache: Dict[tuple, Tuple[List[int], List[int], bool]] = {}
     for pi, pod in enumerate(pods):
-        pr = compute_pod_resource_request(pod)
-        prnz = compute_pod_resource_request(pod, non_zero=True)
-        req[pi] = _quantize(pr, cluster.resource_dims, is_request=True)
-        req_nz[pi] = _quantize(prnz, cluster.resource_dims, is_request=True)
-        # BalancedAllocation PreScore skip rule: best-effort over configured
-        # resources (cpu+memory) (balanced_allocation.go PreScore)
-        balanced_active[pi] = (pr.milli_cpu != 0 or pr.memory != 0)
+        sig = (
+            tuple(repr(c.resources) for c in pod.spec.containers),
+            tuple(repr(c.resources) for c in pod.spec.init_containers),
+            repr(pod.spec.overhead) if pod.spec.overhead else "",
+        )
+        got = req_cache.get(sig)
+        if got is None:
+            pr = compute_pod_resource_request(pod)
+            prnz = compute_pod_resource_request(pod, non_zero=True)
+            got = (
+                _quantize(pr, cluster.resource_dims, is_request=True),
+                _quantize(prnz, cluster.resource_dims, is_request=True),
+                # BalancedAllocation PreScore skip rule: best-effort over the
+                # configured resources (balanced_allocation.go PreScore)
+                pr.milli_cpu != 0 or pr.memory != 0,
+            )
+            req_cache[sig] = got
+        req[pi], req_nz[pi], balanced_active[pi] = got
 
     # -- topology keys + selector classes over the classes' TSCs ----------------
     topo_key_idx: Dict[str, int] = {k: i for i, k in enumerate(cluster.topo_keys)}

@@ -1,0 +1,128 @@
+"""Water-filling fast solver: validity always, count-parity with serial greedy
+for monotone score compositions."""
+
+import numpy as np
+
+from kubernetes_tpu.models.waterfill import make_groups, waterfill_solve
+from kubernetes_tpu.ops.solver import greedy_scan_solve, make_inputs
+from kubernetes_tpu.scheduler import Cache, Framework, Scheduler
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors, build_pod_batch
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+from kubernetes_tpu.utils import FakeClock
+
+
+def solve_both(nodes, pods):
+    cache = Cache(clock=FakeClock())
+    for n in nodes:
+        cache.add_node(n)
+    snap = cache.update_snapshot()
+    cluster = build_cluster_tensors(snap)
+    batch = build_pod_batch(pods, snap, cluster)
+    inputs, d_max = make_inputs(cluster, batch)
+    scan, _, _ = greedy_scan_solve(inputs, d_max)
+    fast = waterfill_solve(inputs, make_groups(batch))
+    return np.asarray(scan), np.asarray(fast), cluster
+
+
+def test_identical_pods_match_scan_exactly():
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj()
+             for i in range(7)]
+    pods = [MakePod(f"p{i}").req({"cpu": "1", "memory": "2Gi"}).obj() for i in range(20)]
+    scan, fast, _ = solve_both(nodes, pods)
+    np.testing.assert_array_equal(scan, fast)
+
+
+def test_capacity_respected_and_leftovers_unassigned():
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "2", "pods": "110"}).obj() for i in range(3)]
+    pods = [MakePod(f"p{i}").req({"cpu": "1500m"}).obj() for i in range(6)]
+    scan, fast, cluster = solve_both(nodes, pods)
+    assert (fast >= 0).sum() == 3 == (scan >= 0).sum()
+    # validity: one pod per node (1500m each, 2 CPUs)
+    placed = fast[fast >= 0]
+    assert len(set(placed.tolist())) == len(placed)
+
+
+def test_mixed_groups_and_affinity():
+    nodes = []
+    for i in range(6):
+        nodes.append(MakeNode(f"n{i}").labels({"disk": "ssd" if i % 2 == 0 else "hdd"})
+                     .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj())
+    pods = [MakePod(f"ssd{i}").node_selector({"disk": "ssd"}).req({"cpu": "1"}).obj()
+            for i in range(6)]
+    pods += [MakePod(f"any{i}").req({"cpu": "500m", "memory": "1Gi"}).obj() for i in range(8)]
+    scan, fast, cluster = solve_both(nodes, pods)
+    # ssd pods on even nodes in both solvers
+    for j in range(6):
+        assert fast[j] % 2 == 0
+    # both fully place
+    assert (fast >= 0).all() and (scan >= 0).all()
+
+
+def test_host_ports_one_per_node():
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "8", "pods": "110"}).obj() for i in range(3)]
+    pods = [MakePod(f"p{i}").req({"cpu": "100m"}, host_port=8080).obj() for i in range(5)]
+    scan, fast, _ = solve_both(nodes, pods)
+    assert (fast >= 0).sum() == 3
+    placed = fast[fast >= 0]
+    assert len(set(placed.tolist())) == 3
+
+
+def test_auto_mode_end_to_end():
+    store = APIStore()
+    for i in range(10):
+        store.create("nodes", MakeNode(f"n{i}").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": "110"}).obj())
+    for i in range(40):
+        store.create("pods", MakePod(f"p{i}").req({"cpu": "500m", "memory": "1Gi"}).obj())
+    sched = BatchScheduler(store, Framework(default_plugins()), solver="auto")
+    sched.sync()
+    sched.run_until_idle()
+    assert sched.scheduled_count == 40
+    pods, _ = store.list("pods")
+    per_node = {}
+    for p in pods:
+        per_node[p.spec.node_name] = per_node.get(p.spec.node_name, 0) + 1
+    assert sorted(per_node.values()) == [4] * 10  # perfectly spread
+
+
+def test_small_cluster_large_group_no_crash():
+    """k_slots pow2 bucket must clamp to the slot count (2 nodes, 300 pods)."""
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "100", "pods": "110"}).obj() for i in range(2)]
+    pods = [MakePod(f"p{i}").req({"cpu": "100m"}).obj() for i in range(300)]
+    scan, fast, _ = solve_both(nodes, pods)
+    assert (fast >= 0).sum() == 220  # 2 nodes x 110 max_pods
+    assert (scan >= 0).sum() == 220
+
+
+def test_j_max_covers_node_headroom():
+    """A node able to hold >110 pods of a group must not be clipped."""
+    nodes = [MakeNode("big").capacity({"cpu": "64", "pods": "200"}).obj(),
+             MakeNode("small").capacity({"cpu": "1", "pods": "200"}).obj()]
+    pods = [MakePod(f"p{i}").req({"cpu": "100m"}).obj() for i in range(128)]
+    scan, fast, _ = solve_both(nodes, pods)
+    assert (fast >= 0).sum() == 128 == (scan >= 0).sum()
+
+
+def test_fast_mode_still_exact_for_spread_constraints():
+    """solver='fast' must not bypass hard topology-spread constraints."""
+    store = APIStore()
+    for i in range(4):
+        store.create("nodes", MakeNode(f"n{i}").labels(
+            {"topology.kubernetes.io/zone": "a" if i < 2 else "b"})
+            .capacity({"cpu": "64", "pods": "110"}).obj())
+    for i in range(8):
+        store.create("pods", MakePod(f"w{i}").labels({"app": "w"}).req({"cpu": "100m"})
+                     .topology_spread(1, "topology.kubernetes.io/zone", "DoNotSchedule",
+                                      {"app": "w"}).obj())
+    sched = BatchScheduler(store, Framework(default_plugins()), solver="fast")
+    sched.sync()
+    sched.run_until_idle()
+    assert sched.scheduled_count == 8
+    pods, _ = store.list("pods")
+    zones = {"a": 0, "b": 0}
+    for p in pods:
+        zones["a" if int(p.spec.node_name[1:]) < 2 else "b"] += 1
+    assert zones == {"a": 4, "b": 4}  # skew respected
