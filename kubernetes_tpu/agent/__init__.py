@@ -1,0 +1,3 @@
+"""L6 — node agent (hollow/kubemark-style kubelet)."""
+
+from .hollow import HollowCluster, HollowKubelet  # noqa: F401

@@ -1,0 +1,161 @@
+"""Hollow node agent — the kubemark analog.
+
+reference: pkg/kubemark/hollow_kubelet.go:63,104 (real kubelet logic against
+containertest.FakeOS / fake CRI) and the kubelet syncLoop shape
+(pkg/kubelet/kubelet.go:2410): watch pods bound to this node, 'run' them by
+flipping status to Running, handle deletes, renew the node Lease heartbeat and
+keep NodeStatus fresh. Lets scale/churn tests run thousands of nodes in-process
+without machines — the same trick kubemark uses for 10k-node clusters.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ..api import Node
+from ..api.workloads import Lease
+from ..api.types import ObjectMeta, RUNNING, new_uid
+from ..store import APIStore, AlreadyExistsError, ConflictError, NotFoundError
+from ..utils import Clock
+
+LEASE_NAMESPACE = "kube-node-lease"
+
+
+class HollowKubelet:
+    def __init__(self, store: APIStore, node_name: str, capacity: Optional[Dict] = None,
+                 labels: Optional[Dict[str, str]] = None, clock: Optional[Clock] = None):
+        self.store = store
+        self.node_name = node_name
+        self.capacity = capacity or {"cpu": "8", "memory": "32Gi", "pods": "110"}
+        self.labels = labels or {}
+        self.clock = clock or Clock()
+        self._watch = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.running_pods: Dict[str, str] = {}  # pod key -> phase
+
+    # -- registration + heartbeat (kubelet nodestatus + Lease) -----------------
+
+    def register(self) -> None:
+        labels = {"kubernetes.io/hostname": self.node_name, **self.labels}
+        node = Node(metadata=ObjectMeta(name=self.node_name, namespace="", uid=new_uid(),
+                                        labels=labels))
+        node.status.capacity = dict(self.capacity)
+        node.status.allocatable = dict(self.capacity)
+        try:
+            self.store.create("nodes", node)
+        except AlreadyExistsError:
+            pass
+        self.heartbeat()
+        _, rv = self.store.list("pods")
+        self._watch = self.store.watch("pods", since_rv=rv)
+        # adopt pods already bound to us
+        pods, _ = self.store.list("pods", lambda p: p.spec.node_name == self.node_name)
+        for p in pods:
+            self._run_pod(p)
+
+    def heartbeat(self) -> None:
+        key = f"{LEASE_NAMESPACE}/{self.node_name}"
+        now = self.clock.now()
+        try:
+            def renew(lease: Lease) -> Lease:
+                lease.renew_time = now
+                lease.holder_identity = self.node_name
+                return lease
+
+            self.store.guaranteed_update("leases", key, renew)
+        except NotFoundError:
+            lease = Lease(metadata=ObjectMeta(name=self.node_name, namespace=LEASE_NAMESPACE,
+                                              uid=new_uid()),
+                          holder_identity=self.node_name, acquire_time=now, renew_time=now)
+            try:
+                self.store.create("leases", lease)
+            except AlreadyExistsError:
+                pass
+
+    # -- the syncLoop (fake CRI: phase flips instead of containers) ------------
+
+    def pump(self) -> int:
+        """Process pending pod events for this node (syncLoopIteration analog)."""
+        if self._watch is None:
+            return 0
+        n = 0
+        for ev in self._watch.drain():
+            pod = ev.obj
+            if pod.spec.node_name != self.node_name:
+                continue
+            if ev.type == "DELETED":
+                self.running_pods.pop(pod.key, None)
+            elif not pod.is_terminal() and pod.key not in self.running_pods:
+                self._run_pod(pod)
+            n += 1
+        return n
+
+    def _run_pod(self, pod) -> None:
+        self.running_pods[pod.key] = RUNNING
+        try:
+            self.store.update_pod_status(
+                pod.metadata.namespace, pod.metadata.name,
+                lambda st: setattr(st, "phase", RUNNING),
+            )
+        except (NotFoundError, ConflictError):
+            self.running_pods.pop(pod.key, None)
+
+    # -- daemon mode -----------------------------------------------------------
+
+    def start(self, heartbeat_interval: float = 10.0) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            last_beat = 0.0
+            while not self._stop.is_set():
+                self.pump()
+                now = self.clock.now()
+                if now - last_beat >= heartbeat_interval:
+                    self.heartbeat()
+                    last_beat = now
+                self.clock.sleep(0.05)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        if self._watch is not None:
+            self._watch.stop()
+            self._watch = None
+
+
+class HollowCluster:
+    """Convenience: n hollow nodes driven manually (tests) or as daemons."""
+
+    def __init__(self, store: APIStore, n_nodes: int, clock: Optional[Clock] = None,
+                 capacity: Optional[Dict] = None, zone_count: int = 0):
+        self.kubelets = []
+        for i in range(n_nodes):
+            labels = {}
+            if zone_count:
+                labels["topology.kubernetes.io/zone"] = f"zone-{i % zone_count}"
+            k = HollowKubelet(store, f"hollow-{i}", capacity=capacity, labels=labels, clock=clock)
+            self.kubelets.append(k)
+
+    def register_all(self) -> None:
+        for k in self.kubelets:
+            k.register()
+
+    def pump_all(self) -> int:
+        return sum(k.pump() for k in self.kubelets)
+
+    def heartbeat_all(self) -> None:
+        for k in self.kubelets:
+            k.heartbeat()
+
+    def stop_all(self) -> None:
+        for k in self.kubelets:
+            k.stop()

@@ -1,0 +1,148 @@
+"""Workload + coordination API types: ReplicaSet, Deployment, Lease.
+
+reference: staging/src/k8s.io/api/apps/v1/types.go (ReplicaSet, Deployment),
+staging/src/k8s.io/api/coordination/v1/types.go (Lease).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, Mapping, Optional
+
+from .labels import Selector
+from .types import ObjectMeta, Pod, PodSpec
+
+
+@dataclass
+class PodTemplateSpec:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "PodTemplateSpec":
+        return PodTemplateSpec(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=PodSpec.from_dict(d.get("spec") or {}),
+        )
+
+    def make_pod(self, name: str, namespace: str, owner: Optional[Dict[str, Any]] = None) -> Pod:
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=name,
+                namespace=namespace,
+                labels=dict(self.metadata.labels),
+                annotations=dict(self.metadata.annotations),
+            ),
+            spec=copy.deepcopy(self.spec),
+        )
+        from .types import new_uid
+
+        pod.metadata.uid = new_uid()
+        if owner:
+            pod.metadata.owner_references = [owner]
+        return pod
+
+
+@dataclass
+class ReplicaSetSpec:
+    replicas: int = 1
+    selector: Optional[Selector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+
+
+@dataclass
+class ReplicaSetStatus:
+    replicas: int = 0
+    ready_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class ReplicaSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ReplicaSetSpec = field(default_factory=ReplicaSetSpec)
+    status: ReplicaSetStatus = field(default_factory=ReplicaSetStatus)
+
+    kind = "ReplicaSet"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "ReplicaSet":
+        sp = d.get("spec") or {}
+        return ReplicaSet(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=ReplicaSetSpec(
+                replicas=int(sp.get("replicas", 1)),
+                selector=Selector.from_label_selector(sp.get("selector")),
+                template=PodTemplateSpec.from_dict(sp.get("template") or {}),
+            ),
+        )
+
+
+@dataclass
+class DeploymentSpec:
+    replicas: int = 1
+    selector: Optional[Selector] = None
+    template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    strategy: str = "RollingUpdate"  # or "Recreate"
+    max_surge: int = 1
+    max_unavailable: int = 0
+
+
+@dataclass
+class DeploymentStatus:
+    replicas: int = 0
+    updated_replicas: int = 0
+    ready_replicas: int = 0
+    observed_generation: int = 0
+
+
+@dataclass
+class Deployment:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: DeploymentSpec = field(default_factory=DeploymentSpec)
+    status: DeploymentStatus = field(default_factory=DeploymentStatus)
+
+    kind = "Deployment"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Deployment":
+        sp = d.get("spec") or {}
+        strat = sp.get("strategy") or {}
+        ru = strat.get("rollingUpdate") or {}
+        return Deployment(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=DeploymentSpec(
+                replicas=int(sp.get("replicas", 1)),
+                selector=Selector.from_label_selector(sp.get("selector")),
+                template=PodTemplateSpec.from_dict(sp.get("template") or {}),
+                strategy=strat.get("type", "RollingUpdate"),
+                max_surge=int(ru.get("maxSurge", 1) or 0),
+                max_unavailable=int(ru.get("maxUnavailable", 0) or 0),
+            ),
+        )
+
+
+@dataclass
+class Lease:
+    """coordination/v1 Lease — node heartbeats and leader election locks."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""
+    lease_duration_seconds: int = 40
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+
+    kind = "Lease"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"

@@ -1,1 +1,6 @@
-"""L4 — reconciling control loops."""
+"""L4 — reconciling control loops (reference: pkg/controller)."""
+
+from .base import Controller  # noqa: F401
+from .deployment import DeploymentController  # noqa: F401
+from .node_lifecycle import NodeLifecycleController  # noqa: F401
+from .replicaset import ReplicaSetController  # noqa: F401

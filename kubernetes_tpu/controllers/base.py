@@ -1,0 +1,121 @@
+"""Controller base: the informer -> workqueue -> sync(key) reconcile pattern.
+
+reference: pkg/controller (e.g. replicaset/replica_set.go:116,150,677) and
+client-go's SharedIndexInformer + rate-limited workqueue. One reconcile loop
+per resource kind; level-triggered: sync() reads desired+actual from the store
+and converges, so replays and missed events are harmless.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Optional, Set
+
+from ..store import APIStore
+from ..utils import Clock
+
+
+class Controller:
+    """Subclasses define `watch_kinds`, `key_of(event) -> sync key or None`,
+    and `sync(key)`. Drive with pump()+process() (tests) or start() (daemon)."""
+
+    watch_kinds: tuple = ()
+
+    def __init__(self, store: APIStore, clock: Optional[Clock] = None):
+        self.store = store
+        self.clock = clock or Clock()
+        self._watch = None
+        self._dirty: Set[str] = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.sync_errors = 0
+
+    # -- event intake ----------------------------------------------------------
+
+    def sync_all(self) -> None:
+        """Initial LIST: mark every existing object of the primary kind dirty."""
+        lists, rv = self.store.list_many(self.watch_kinds)
+        for kind in self.watch_kinds:
+            for obj in lists[kind]:
+                key = self.key_of_object(kind, obj)
+                if key:
+                    self._mark(key)
+        self._watch = self.store.watch(since_rv=rv)
+
+    def pump(self, max_events: int = 10_000) -> int:
+        if self._watch is None:
+            return 0
+        n = 0
+        for ev in self._watch.drain():
+            if ev.kind in self.watch_kinds:
+                key = self.key_of_object(ev.kind, ev.obj)
+                if key:
+                    self._mark(key)
+                n += 1
+            if n >= max_events:
+                break
+        return n
+
+    def _mark(self, key: str) -> None:
+        with self._lock:
+            self._dirty.add(key)
+
+    # -- processing ------------------------------------------------------------
+
+    def process(self, max_keys: int = 10_000) -> int:
+        """Drain the dirty set through sync(). Returns #keys processed."""
+        with self._lock:
+            keys = list(self._dirty)[:max_keys]
+            for k in keys:
+                self._dirty.discard(k)
+        for key in keys:
+            try:
+                self.sync(key)
+            except Exception:
+                self.sync_errors += 1
+                traceback.print_exc()
+                self._mark(key)  # retry (rate limiting elided)
+        return len(keys)
+
+    def reconcile_once(self) -> int:
+        self.pump()
+        return self.process()
+
+    def run_until_stable(self, max_rounds: int = 50) -> None:
+        for _ in range(max_rounds):
+            if self.reconcile_once() == 0:
+                return
+
+    # -- daemon mode -----------------------------------------------------------
+
+    def start(self, interval: float = 0.05) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                if self.reconcile_once() == 0:
+                    self.clock.sleep(interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        if self._watch is not None:
+            self._watch.stop()
+            self._watch = None
+
+    # -- to implement ----------------------------------------------------------
+
+    def key_of_object(self, kind: str, obj) -> Optional[str]:
+        raise NotImplementedError
+
+    def sync(self, key: str) -> None:
+        raise NotImplementedError

@@ -70,25 +70,21 @@ def waterfill_group(
     taint = default_normalize(taint_row, feas0, reverse=True)
     static = 2 * napref + 3 * taint + img_row  # int32 [N]
 
-    # dynamic components as a function of j = pods already added (0..j_max-1)
+    # dynamic components as a function of j = pods already added (0..j_max-1),
+    # via the SAME formula helpers the scan solver uses (one source of truth
+    # for score parity), vmapped over the j axis
+    from ..ops.solver import balanced_score, least_allocated_score
+
     js = jnp.arange(j_max, dtype=jnp.int32)  # [J]
     alloc2 = alloc[:, :2]  # cpu, memory — the configured scoring resources
-    u_nz = used_nz[:, :2][:, None, :] + (js[None, :, None] + 1) * req_nz[None, None, :2]
-    a2 = alloc2[:, None, :]
-    per = jnp.where((a2 > 0) & (u_nz <= a2),
-                    (a2 - u_nz) * MAX_NODE_SCORE // jnp.maximum(a2, 1), 0)
-    wsum = jnp.maximum(jnp.sum((alloc2 > 0).astype(jnp.int32), axis=1), 1)
-    least = jnp.sum(per * (a2 > 0), axis=2) // wsum[:, None]  # [N, J]
 
-    u_pl = used[:, :2][:, None, :].astype(jnp.float32) \
-        + (js[None, :, None] + 1).astype(jnp.float32) * req[None, None, :2].astype(jnp.float32)
-    a2f = alloc2[:, None, :].astype(jnp.float32)
-    frac = jnp.where(a2f > 0, jnp.minimum(u_pl / jnp.maximum(a2f, 1.0), 1.0), 0.0)
-    n_frac = jnp.sum((alloc2 > 0).astype(jnp.int32), axis=1)
-    std = jnp.where(n_frac[:, None] == 2, jnp.abs(frac[..., 0] - frac[..., 1]) / 2.0, 0.0)
-    bal = jnp.where(bal_active, ((1.0 - std) * MAX_NODE_SCORE).astype(jnp.int32), 0)
+    def at_j(j):
+        least_j = least_allocated_score(alloc2, used_nz[:, :2] + j * req_nz[None, :2],
+                                        req_nz[:2])
+        bal_j = balanced_score(alloc2, used[:, :2] + j * req[None, :2], req[:2], bal_active)
+        return least_j + bal_j
 
-    score = least + bal + static[:, None]  # [N, J]
+    score = jax.vmap(at_j)(js).T + static[:, None]  # [N, J]
     # prefix property: make marginal scores non-increasing in j
     score = jax.lax.associative_scan(jnp.minimum, score, axis=1)
     # mask slots beyond capacity
@@ -128,10 +124,14 @@ def waterfill_solve(inp: SolverInputs, groups: List[Tuple[np.ndarray, int]]):
     n = inp.alloc.shape[0]
     # j_max must cover every node's remaining pod headroom, or schedulable pods
     # would be silently clipped; the int32 sort key bounds slots at ~2.6M
-    # (max_total_score 800 * slots < 2^31)
-    j_max = max(1, int(np.asarray(inp.max_pods - inp.pod_count).max(initial=1)))
+    # (max_total_score 800 * slots < 2^31). Bucketed to the next power of two
+    # so a cluster gradually filling up doesn't recompile per headroom value.
+    headroom = max(1, int(np.asarray(inp.max_pods - inp.pod_count).max(initial=1)))
+    j_max = 1 << (headroom - 1).bit_length()
     if n * j_max > 2_600_000:
-        return None
+        if n * headroom > 2_600_000:
+            return None
+        j_max = headroom
     assignment = np.full(p, -1, dtype=np.int32)
     used = inp.used
     used_nz = inp.used_nz

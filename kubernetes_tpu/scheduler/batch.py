@@ -77,14 +77,21 @@ class BatchScheduler(Scheduler):
             if assignment is None:
                 assignment, _, _ = greedy_scan_solve(inputs, d_max)
             assignment = np.asarray(assignment)
+            # Two phases: bind every device assignment FIRST, then re-run the
+            # rejected pods serially. The serial fallback reads the live cache;
+            # running it mid-loop would see capacity still promised to not-yet-
+            # bound assignments and double-book nodes.
+            rejected = []
             for j, pi in enumerate(device_idx):
-                qp = qps[pi]
                 nidx = int(assignment[j])
                 if nidx < 0:
-                    self._handle_failure(qp, Status.unschedulable(
-                        f"0/{len(snapshot)} nodes are available (batch solver)"))
+                    rejected.append(qps[pi])
                 else:
-                    self._bind_assignment(qp, cluster.node_names[nidx])
+                    self._bind_assignment(qps[pi], cluster.node_names[nidx])
+            for qp in rejected:
+                # produces per-node failure statuses so PostFilter/preemption
+                # can run (schedule_one.go:175)
+                self._serial_one(qp)
 
         # Serial fallback, in original priority order among themselves.
         for pi in fallback_idx:
@@ -111,6 +118,7 @@ class BatchScheduler(Scheduler):
     def _serial_one(self, qp: QueuedPodInfo) -> None:
         result = self.schedule_pod(qp.pod)
         if not result.suggested_host:
+            self._maybe_preempt(qp, result)
             self._handle_failure(qp, result.status)
             return
         self._bind_assignment(qp, result.suggested_host)

@@ -4,6 +4,7 @@ Default enabled set mirrors apis/config/v1/default_plugins.go:30-56 (minus the
 volume plugins, which gate on a volume subsystem this build adds later).
 """
 
+from .default_preemption import DefaultPreemption  # noqa: F401
 from .fit import BalancedAllocation, NodeResourcesFit  # noqa: F401
 from .interpod_affinity import InterPodAffinity  # noqa: F401
 from .node_plugins import (  # noqa: F401
@@ -34,4 +35,5 @@ def default_plugins():
         InterPodAffinity(),
         BalancedAllocation(),
         ImageLocality(),
+        DefaultPreemption(),
     ]

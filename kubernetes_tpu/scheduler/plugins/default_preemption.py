@@ -1,0 +1,142 @@
+"""DefaultPreemption — PostFilter that evicts lower-priority pods to admit a pod.
+
+reference: pkg/scheduler/framework/preemption/preemption.go (Evaluator :127,
+Preempt :230, findCandidates :305, DryRunPreemption :680, SelectCandidate :396,
+prepareCandidate :431) and plugins/defaultpreemption/default_preemption.go:93.
+
+Algorithm preserved:
+  1. Eligibility: preemptionPolicy != Never; if the pod already nominated a node
+     whose victims are still terminating, don't preempt again (:246).
+  2. Candidates = nodes that failed with UNSCHEDULABLE (not UNRESOLVABLE).
+  3. Dry run per node: remove ALL lower-priority pods; if the pod then fits,
+     reprieve victims highest-priority-first while the pod still fits; the rest
+     are the node's victims (fewest possible, highest-value kept).
+  4. SelectCandidate: fewest PDB violations (PDBs land later — count is 0),
+     then highest victim-priority minimum, then smallest victim sum, then
+     fewest victims, then node order (pick_one_node_for_preemption :560).
+  5. prepareCandidate: DELETE victims, clear their nominations, set the
+     preemptor's status.nominatedNodeName.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..framework import Code, CycleState, NodeInfo, PodInfo, Status, SUCCESS
+
+
+@dataclass
+class Candidate:
+    node_name: str
+    victims: List  # pods, sorted by descending priority
+    num_pdb_violations: int = 0
+
+
+class DefaultPreemption:
+    name = "DefaultPreemption"
+
+    def __init__(self, framework=None, store=None):
+        self.framework = framework
+        self.store = store
+
+    def set_handles(self, framework, store) -> None:
+        """Injected by the Scheduler (the reference passes framework.Handle)."""
+        self.framework = framework
+        self.store = store
+
+    def post_filter(self, state: CycleState, pod, filtered_statuses: Dict[str, Status]):
+        """Returns (nominated_node_name | None, Status)."""
+        if pod.spec.preemption_policy == "Never":
+            return None, Status.unresolvable("preemption policy is Never", plugin=self.name)
+        snapshot = state.read_or_none("Snapshot")
+        if snapshot is None:
+            return None, Status.error("no snapshot in cycle state", plugin=self.name)
+
+        candidates = self._find_candidates(state, pod, snapshot, filtered_statuses)
+        if not candidates:
+            return None, Status.unresolvable(
+                "preemption: 0/%d nodes are available" % len(snapshot), plugin=self.name
+            )
+        best = self._select_candidate(candidates)
+        self._prepare_candidate(best, pod)
+        return best.node_name, SUCCESS
+
+    # -- dry run (DryRunPreemption :680) ---------------------------------------
+
+    def _find_candidates(self, state, pod, snapshot, filtered_statuses) -> List[Candidate]:
+        out = []
+        for ni in snapshot.node_info_list:
+            name = ni.node.metadata.name
+            st = filtered_statuses.get(name)
+            if st is not None and st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE:
+                continue  # removing pods cannot help (interface.go semantics)
+            cand = self._dry_run_node(state, pod, ni)
+            if cand is not None:
+                out.append(cand)
+        return out
+
+    def _dry_run_node(self, state, pod, node_info: NodeInfo) -> Optional[Candidate]:
+        fw = self.framework
+        ni = node_info.clone()
+        st = state.clone()
+        # remove all lower-priority pods
+        potential_victims = [
+            pi.pod for pi in list(ni.pods) if pi.pod.spec.priority < pod.spec.priority
+        ]
+        if not potential_victims:
+            return None
+        for v in potential_victims:
+            ni.remove_pod(v)
+            fw.run_remove_pod(st, pod, v, ni)
+        if not fw.run_filter(st, pod, ni).is_success():
+            return None
+        # reprieve highest-priority victims first while the pod still fits
+        potential_victims.sort(key=lambda p: (-p.spec.priority, p.key))
+        victims = []
+        for v in potential_victims:
+            ni.add_pod(PodInfo(v))
+            fw.run_add_pod(st, pod, v, ni)
+            if not fw.run_filter(st, pod, ni).is_success():
+                ni.remove_pod(v)
+                fw.run_remove_pod(st, pod, v, ni)
+                victims.append(v)
+        if not victims:
+            return None  # pod fit without evictions: not a preemption case
+        victims.sort(key=lambda p: -p.spec.priority)
+        return Candidate(node_name=node_info.node.metadata.name, victims=victims)
+
+    # -- selection (pick_one_node_for_preemption :560) -------------------------
+
+    def _select_candidate(self, candidates: List[Candidate]) -> Candidate:
+        def key(c: Candidate):
+            highest_victim_priority = c.victims[0].spec.priority if c.victims else -(2**31)
+            priority_sum = sum(v.spec.priority for v in c.victims)
+            return (
+                c.num_pdb_violations,      # fewest PDB violations
+                highest_victim_priority,   # lowest highest-priority victim
+                priority_sum,              # smallest priority sum
+                len(c.victims),            # fewest victims
+                c.node_name,               # stable
+            )
+
+        return min(candidates, key=key)
+
+    # -- execution (prepareCandidate :431) -------------------------------------
+
+    def _prepare_candidate(self, cand: Candidate, pod) -> None:
+        if self.store is None:
+            return
+        for v in cand.victims:
+            try:
+                # clear nomination of victims nominated to this node first
+                self.store.delete("pods", v.key)
+            except Exception:
+                pass
+        try:
+            self.store.update_pod_status(
+                pod.metadata.namespace, pod.metadata.name,
+                lambda st: setattr(st, "nominated_node_name", cand.node_name),
+            )
+        except Exception:
+            pass

@@ -85,8 +85,13 @@ class Scheduler:
         self._stop = threading.Event()
         self.scheduled_count = 0
         self.failed_count = 0
+        self.preemption_count = 0
         # ns labels for InterPodAffinity namespaceSelector
         self._ns_labels: Dict[str, Dict[str, str]] = {}
+        # plugins needing framework/store handles (e.g. DefaultPreemption)
+        for p in framework.plugins:
+            if hasattr(p, "set_handles"):
+                p.set_handles(framework, store)
 
     # -- informer-equivalent event handling (eventhandlers.go:364) -------------
 
@@ -253,6 +258,7 @@ class Scheduler:
         pod = qp.pod
         result = self.schedule_pod(pod)
         if not result.suggested_host:
+            self._maybe_preempt(qp, result)
             self._handle_failure(qp, result.status)
             return True
         # assume (:945) then bind (:967). Serial path binds synchronously.
@@ -292,6 +298,20 @@ class Scheduler:
             self.cache.forget_pod(assumed)
             self._handle_failure(qp, Status.error(str(e)))
         return True
+
+    def _maybe_preempt(self, qp: QueuedPodInfo, result: ScheduleResult) -> None:
+        """RunPostFilterPlugins on an Unschedulable cycle (schedule_one.go:175)."""
+        from .framework import Code
+
+        if result.status.code != Code.UNSCHEDULABLE:
+            return
+        if not self.framework.post_filter_plugins or not result.failed_nodes:
+            return
+        state = result.state if result.state is not None else CycleState()
+        nominated, st = self.framework.run_post_filter(state, qp.pod, result.failed_nodes)
+        if st.is_success() and nominated:
+            qp.pod.status.nominated_node_name = nominated
+            self.preemption_count += 1
 
     def _handle_failure(self, qp: QueuedPodInfo, status: Status) -> None:
         """handleSchedulingFailure :1022 — requeue + patch PodScheduled condition."""

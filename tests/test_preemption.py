@@ -1,0 +1,119 @@
+"""Preemption tests (mirrors test/integration/scheduler/preemption structure)."""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.scheduler import Framework, Scheduler
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.store import APIStore, NotFoundError
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def drive(sched, rounds=4):
+    """Run to idle, flushing backoff between rounds (preemption needs a requeue)."""
+    for _ in range(rounds):
+        sched.run_until_idle()
+        time.sleep(1.1)
+        sched.queue.flush_backoff_completed()
+        sched.queue.flush_unschedulable_left_over()
+    sched.run_until_idle()
+
+
+class TestPreemption:
+    def test_basic_preemption(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "2", "pods": "10"}).obj())
+        store.create("pods", MakePod("low").priority(1).req({"cpu": "2"}).obj())
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        sched.run_until_idle()
+        assert store.get("pods", "default/low").spec.node_name == "n0"
+
+        store.create("pods", MakePod("high").priority(100).req({"cpu": "2"}).obj())
+        drive(sched)
+        # low was evicted, high runs
+        with pytest.raises(NotFoundError):
+            store.get("pods", "default/low")
+        assert store.get("pods", "default/high").spec.node_name == "n0"
+        assert sched.preemption_count >= 1
+
+    def test_fewest_victims_selected(self):
+        store = APIStore()
+        # n0 holds two low-priority 1cpu pods; n1 holds one low-priority 2cpu pod
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "2", "pods": "10"}).obj())
+        store.create("nodes", MakeNode("n1").capacity({"cpu": "2", "pods": "10"}).obj())
+        for i in range(2):
+            p = MakePod(f"small{i}").priority(1).req({"cpu": "1"}).obj()
+            p.spec.node_name = "n0"
+            store.create("pods", p)
+        p = MakePod("bigv").priority(1).req({"cpu": "2"}).obj()
+        p.spec.node_name = "n1"
+        store.create("pods", p)
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        store.create("pods", MakePod("high").priority(100).req({"cpu": "2"}).obj())
+        drive(sched)
+        # one victim (bigv on n1) beats two victims (n0)
+        assert store.get("pods", "default/high").spec.node_name == "n1"
+        with pytest.raises(NotFoundError):
+            store.get("pods", "default/bigv")
+        assert store.get("pods", "default/small0").spec.node_name == "n0"
+
+    def test_equal_priority_not_preempted(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "2", "pods": "10"}).obj())
+        store.create("pods", MakePod("a").priority(50).req({"cpu": "2"}).obj())
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        sched.run_until_idle()
+        store.create("pods", MakePod("b").priority(50).req({"cpu": "2"}).obj())
+        drive(sched, rounds=2)
+        assert store.get("pods", "default/a").spec.node_name == "n0"
+        assert store.get("pods", "default/b").spec.node_name == ""
+
+    def test_preemption_policy_never(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "2", "pods": "10"}).obj())
+        store.create("pods", MakePod("low").priority(1).req({"cpu": "2"}).obj())
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        sched.run_until_idle()
+        humble = MakePod("humble").priority(100).req({"cpu": "2"}).obj()
+        humble.spec.preemption_policy = "Never"
+        store.create("pods", humble)
+        drive(sched, rounds=2)
+        assert store.get("pods", "default/low").spec.node_name == "n0"
+        assert store.get("pods", "default/humble").spec.node_name == ""
+
+    def test_reprieve_keeps_highest_priority_victims(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "3", "pods": "10"}).obj())
+        for name, prio in (("v1", 1), ("v2", 2), ("v3", 3)):
+            p = MakePod(name).priority(prio).req({"cpu": "1"}).obj()
+            p.spec.node_name = "n0"
+            store.create("pods", p)
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        store.create("pods", MakePod("high").priority(100).req({"cpu": "2"}).obj())
+        drive(sched)
+        # needs 2 cpu: evict v1 and v2 (lowest priorities), keep v3
+        assert store.get("pods", "default/high").spec.node_name == "n0"
+        assert store.get("pods", "default/v3").spec.node_name == "n0"
+        for gone in ("v1", "v2"):
+            with pytest.raises(NotFoundError):
+                store.get("pods", f"default/{gone}")
+
+    def test_batch_scheduler_preempts(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "2", "pods": "10"}).obj())
+        store.create("pods", MakePod("low").priority(1).req({"cpu": "2"}).obj())
+        sched = BatchScheduler(store, Framework(default_plugins()), solver="auto")
+        sched.sync()
+        sched.run_until_idle()
+        store.create("pods", MakePod("high").priority(100).req({"cpu": "2"}).obj())
+        drive(sched)
+        assert store.get("pods", "default/high").spec.node_name == "n0"
+        with pytest.raises(NotFoundError):
+            store.get("pods", "default/low")

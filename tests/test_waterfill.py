@@ -126,3 +126,21 @@ def test_fast_mode_still_exact_for_spread_constraints():
     for p in pods:
         zones["a" if int(p.spec.node_name[1:]) < 2 else "b"] += 1
     assert zones == {"a": 4, "b": 4}  # skew respected
+
+
+def test_rejected_pods_no_double_booking():
+    """Serial fallback for waterfill-rejected pods must run AFTER all device
+    assignments are bound (reviewer repro: interleaved groups on a full node)."""
+    store = APIStore()
+    store.create("nodes", MakeNode("n0").capacity({"cpu": "2", "memory": "8Gi", "pods": "10"}).obj())
+    store.create("pods", MakePod("a0").req({"cpu": "1"}).obj())
+    store.create("pods", MakePod("b1").req({"cpu": "1", "memory": "1Gi"}).obj())
+    store.create("pods", MakePod("b2").req({"cpu": "1", "memory": "1Gi"}).obj())
+    store.create("pods", MakePod("a3").req({"cpu": "1"}).obj())
+    sched = BatchScheduler(store, Framework(default_plugins()), solver="fast")
+    sched.sync()
+    sched.run_until_idle()
+    pods, _ = store.list("pods")
+    bound_cpu = sum(1000 for p in pods if p.spec.node_name)
+    assert bound_cpu <= 2000, f"overcommitted: {bound_cpu}m bound on a 2-cpu node"
+    assert sum(1 for p in pods if p.spec.node_name) == 2
