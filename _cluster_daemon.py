@@ -1,0 +1,23 @@
+"""A live mini-cluster behind the HTTP server, for CLI verification."""
+import sys, time
+from kubernetes_tpu.agent import HollowCluster
+from kubernetes_tpu.controllers import DeploymentController, ReplicaSetController, NodeLifecycleController
+from kubernetes_tpu.scheduler import Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.server import APIServer
+from kubernetes_tpu.store import APIStore
+
+store = APIStore()
+srv = APIServer(store, port=18080).start()
+cluster = HollowCluster(store, n_nodes=3)
+cluster.register_all()
+for k in cluster.kubelets:
+    k.start(heartbeat_interval=2.0)
+sched = BatchScheduler(store, Framework(default_plugins()), solver="auto")
+sched.sync(); sched.start()
+dc, rsc = DeploymentController(store), ReplicaSetController(store)
+for c in (dc, rsc):
+    c.sync_all(); c.start()
+print("READY", srv.url, flush=True)
+time.sleep(600)

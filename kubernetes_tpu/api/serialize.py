@@ -1,0 +1,305 @@
+"""Object <-> k8s-style camelCase dict serialization for every API type.
+
+The wire format matches kubernetes manifests (reference: the JSON forms of
+staging/src/k8s.io/api types), so standard YAML round-trips through the HTTP
+server and CLI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict
+from typing import Any, Dict
+
+from .labels import (
+    NodeSelector,
+    NodeSelectorTerm,
+    PreferredSchedulingTerm,
+    Requirement,
+    Selector,
+)
+from .types import (
+    Affinity,
+    Namespace,
+    Node,
+    Pod,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from .workloads import Deployment, Lease, ReplicaSet
+
+KIND_TO_RESOURCE = {
+    "Pod": "pods",
+    "Node": "nodes",
+    "Namespace": "namespaces",
+    "ReplicaSet": "replicasets",
+    "Deployment": "deployments",
+    "Lease": "leases",
+}
+RESOURCE_TO_TYPE = {
+    "pods": Pod,
+    "nodes": Node,
+    "namespaces": Namespace,
+    "replicasets": ReplicaSet,
+    "deployments": Deployment,
+    "leases": Lease,
+}
+CLUSTER_SCOPED = {"nodes", "namespaces"}
+GROUP_PREFIX = {
+    "pods": "/api/v1",
+    "nodes": "/api/v1",
+    "namespaces": "/api/v1",
+    "replicasets": "/apis/apps/v1",
+    "deployments": "/apis/apps/v1",
+    "leases": "/apis/coordination.k8s.io/v1",
+}
+
+
+def from_dict(resource: str, d: Dict) -> Any:
+    t = RESOURCE_TO_TYPE[resource]
+    if hasattr(t, "from_dict"):
+        return t.from_dict(d)
+    raise ValueError(f"cannot deserialize {resource}")
+
+
+def _requirements_to_list(reqs) -> list:
+    out = []
+    for r in reqs:
+        e: Dict[str, Any] = {"key": r.key, "operator": r.op}
+        if r.values:
+            e["values"] = list(r.values)
+        out.append(e)
+    return out
+
+
+def _selector_to_dict(sel: Selector) -> Dict:
+    return {"matchExpressions": _requirements_to_list(sel.requirements)} if sel.requirements else {}
+
+
+def _node_selector_to_dict(ns: NodeSelector) -> Dict:
+    return {"nodeSelectorTerms": [
+        {
+            **({"matchExpressions": _requirements_to_list(t.match_expressions)}
+               if t.match_expressions else {}),
+            **({"matchFields": _requirements_to_list(t.match_fields)}
+               if t.match_fields else {}),
+        }
+        for t in ns.terms
+    ]}
+
+
+def _pod_affinity_term_to_dict(t: PodAffinityTerm) -> Dict:
+    d: Dict[str, Any] = {"topologyKey": t.topology_key}
+    if t.selector is not None:
+        d["labelSelector"] = _selector_to_dict(t.selector)
+    if t.namespaces:
+        d["namespaces"] = list(t.namespaces)
+    if t.namespace_selector is not None:
+        d["namespaceSelector"] = _selector_to_dict(t.namespace_selector)
+    if t.match_label_keys:
+        d["matchLabelKeys"] = list(t.match_label_keys)
+    return d
+
+
+def _affinity_to_dict(a: Affinity) -> Dict:
+    d: Dict[str, Any] = {}
+    na: Dict[str, Any] = {}
+    if a.node_affinity_required is not None:
+        na["requiredDuringSchedulingIgnoredDuringExecution"] = _node_selector_to_dict(
+            a.node_affinity_required)
+    if a.node_affinity_preferred:
+        na["preferredDuringSchedulingIgnoredDuringExecution"] = [
+            {"weight": p.weight, "preference": {
+                **({"matchExpressions": _requirements_to_list(p.term.match_expressions)}
+                   if p.term.match_expressions else {}),
+                **({"matchFields": _requirements_to_list(p.term.match_fields)}
+                   if p.term.match_fields else {}),
+            }}
+            for p in a.node_affinity_preferred
+        ]
+    if na:
+        d["nodeAffinity"] = na
+    for attr, key in (("pod_affinity_required", "podAffinity"),
+                      ("pod_anti_affinity_required", "podAntiAffinity")):
+        terms = getattr(a, attr)
+        pref = getattr(a, attr.replace("_required", "_preferred"))
+        sub: Dict[str, Any] = {}
+        if terms:
+            sub["requiredDuringSchedulingIgnoredDuringExecution"] = [
+                _pod_affinity_term_to_dict(t) for t in terms]
+        if pref:
+            sub["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                {"weight": w.weight, "podAffinityTerm": _pod_affinity_term_to_dict(w.term)}
+                for w in pref]
+        if sub:
+            d[key] = sub
+    return d
+
+
+def pod_to_dict(pod: Pod) -> Dict:
+    spec: Dict[str, Any] = {
+        "containers": [c.to_dict() for c in pod.spec.containers],
+    }
+    if pod.spec.init_containers:
+        spec["initContainers"] = [c.to_dict() for c in pod.spec.init_containers]
+    if pod.spec.node_name:
+        spec["nodeName"] = pod.spec.node_name
+    if pod.spec.scheduler_name != "default-scheduler":
+        spec["schedulerName"] = pod.spec.scheduler_name
+    if pod.spec.node_selector:
+        spec["nodeSelector"] = dict(pod.spec.node_selector)
+    if pod.spec.affinity:
+        aff = _affinity_to_dict(pod.spec.affinity)
+        if aff:
+            spec["affinity"] = aff
+    if pod.spec.tolerations:
+        spec["tolerations"] = [
+            {k: v for k, v in (("key", t.key), ("operator", t.operator), ("value", t.value),
+                               ("effect", t.effect), ("tolerationSeconds", t.toleration_seconds))
+             if v not in ("", None)}
+            for t in pod.spec.tolerations
+        ]
+    if pod.spec.topology_spread_constraints:
+        spec["topologySpreadConstraints"] = [
+            {
+                "maxSkew": c.max_skew,
+                "topologyKey": c.topology_key,
+                "whenUnsatisfiable": c.when_unsatisfiable,
+                **({"labelSelector": _selector_to_dict(c.selector)} if c.selector is not None else {}),
+                **({"minDomains": c.min_domains} if c.min_domains else {}),
+                **({"matchLabelKeys": list(c.match_label_keys)} if c.match_label_keys else {}),
+            }
+            for c in pod.spec.topology_spread_constraints
+        ]
+    if pod.spec.priority:
+        spec["priority"] = pod.spec.priority
+    if pod.spec.priority_class_name:
+        spec["priorityClassName"] = pod.spec.priority_class_name
+    if pod.spec.scheduling_gates:
+        spec["schedulingGates"] = [{"name": g} for g in pod.spec.scheduling_gates]
+    if pod.spec.overhead:
+        spec["overhead"] = pod.spec.overhead
+    status: Dict[str, Any] = {"phase": pod.status.phase}
+    if pod.status.nominated_node_name:
+        status["nominatedNodeName"] = pod.status.nominated_node_name
+    if pod.status.conditions:
+        status["conditions"] = [
+            {"type": c.type, "status": c.status,
+             **({"reason": c.reason} if c.reason else {}),
+             **({"message": c.message} if c.message else {})}
+            for c in pod.status.conditions
+        ]
+    return {"apiVersion": "v1", "kind": "Pod", "metadata": pod.metadata.to_dict(),
+            "spec": spec, "status": status}
+
+
+def node_to_dict(node: Node) -> Dict:
+    spec: Dict[str, Any] = {}
+    if node.spec.unschedulable:
+        spec["unschedulable"] = True
+    if node.spec.taints:
+        spec["taints"] = [
+            {"key": t.key, **({"value": t.value} if t.value else {}), "effect": t.effect}
+            for t in node.spec.taints
+        ]
+    status: Dict[str, Any] = {
+        "capacity": dict(node.status.capacity),
+        "allocatable": dict(node.status.allocatable),
+    }
+    if node.status.images:
+        status["images"] = [{"names": list(i.names), "sizeBytes": i.size_bytes}
+                            for i in node.status.images]
+    if node.status.conditions:
+        status["conditions"] = [
+            {"type": c.type, "status": c.status,
+             **({"reason": c.reason} if c.reason else {})}
+            for c in node.status.conditions
+        ]
+    meta = node.metadata.to_dict()
+    meta.pop("namespace", None)
+    return {"apiVersion": "v1", "kind": "Node", "metadata": meta, "spec": spec, "status": status}
+
+
+def _template_to_dict(t) -> Dict:
+    pod = Pod(metadata=t.metadata, spec=t.spec)
+    d = pod_to_dict(pod)
+    return {"metadata": {k: v for k, v in d["metadata"].items()
+                         if k in ("labels", "annotations", "name")},
+            "spec": d["spec"]}
+
+
+def replicaset_to_dict(rs: ReplicaSet) -> Dict:
+    return {
+        "apiVersion": "apps/v1", "kind": "ReplicaSet",
+        "metadata": rs.metadata.to_dict(),
+        "spec": {
+            "replicas": rs.spec.replicas,
+            **({"selector": _selector_to_dict(rs.spec.selector)}
+               if rs.spec.selector is not None else {}),
+            "template": _template_to_dict(rs.spec.template),
+        },
+        "status": {
+            "replicas": rs.status.replicas,
+            "readyReplicas": rs.status.ready_replicas,
+            "observedGeneration": rs.status.observed_generation,
+        },
+    }
+
+
+def deployment_to_dict(dep: Deployment) -> Dict:
+    return {
+        "apiVersion": "apps/v1", "kind": "Deployment",
+        "metadata": dep.metadata.to_dict(),
+        "spec": {
+            "replicas": dep.spec.replicas,
+            **({"selector": _selector_to_dict(dep.spec.selector)}
+               if dep.spec.selector is not None else {}),
+            "template": _template_to_dict(dep.spec.template),
+            "strategy": {"type": dep.spec.strategy,
+                         **({"rollingUpdate": {"maxSurge": dep.spec.max_surge,
+                                               "maxUnavailable": dep.spec.max_unavailable}}
+                            if dep.spec.strategy == "RollingUpdate" else {})},
+        },
+        "status": {
+            "replicas": dep.status.replicas,
+            "updatedReplicas": dep.status.updated_replicas,
+            "readyReplicas": dep.status.ready_replicas,
+            "observedGeneration": dep.status.observed_generation,
+        },
+    }
+
+
+def lease_to_dict(lease: Lease) -> Dict:
+    return {
+        "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
+        "metadata": lease.metadata.to_dict(),
+        "spec": {
+            "holderIdentity": lease.holder_identity,
+            "leaseDurationSeconds": lease.lease_duration_seconds,
+            "acquireTime": lease.acquire_time,
+            "renewTime": lease.renew_time,
+        },
+    }
+
+
+def namespace_to_dict(ns: Namespace) -> Dict:
+    meta = ns.metadata.to_dict()
+    meta.pop("namespace", None)
+    return {"apiVersion": "v1", "kind": "Namespace", "metadata": meta}
+
+
+_SERIALIZERS = {
+    Pod: pod_to_dict,
+    Node: node_to_dict,
+    ReplicaSet: replicaset_to_dict,
+    Deployment: deployment_to_dict,
+    Lease: lease_to_dict,
+    Namespace: namespace_to_dict,
+}
+
+
+def to_dict(obj: Any) -> Dict:
+    fn = _SERIALIZERS.get(type(obj))
+    if fn is None:
+        raise ValueError(f"cannot serialize {type(obj).__name__}")
+    return fn(obj)

@@ -146,3 +146,26 @@ class Lease:
     @property
     def key(self) -> str:
         return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Lease":
+        sp = d.get("spec") or {}
+        return Lease(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            holder_identity=sp.get("holderIdentity", ""),
+            lease_duration_seconds=int(sp.get("leaseDurationSeconds", 40) or 40),
+            acquire_time=_parse_time(sp.get("acquireTime")),
+            renew_time=_parse_time(sp.get("renewTime")),
+        )
+
+
+def _parse_time(v) -> float:
+    """Seconds-float internally; accepts the RFC3339 MicroTime strings real
+    coordination/v1 manifests carry."""
+    if v in (None, ""):
+        return 0.0
+    if isinstance(v, (int, float)):
+        return float(v)
+    from datetime import datetime
+
+    return datetime.fromisoformat(str(v).replace("Z", "+00:00")).timestamp()

@@ -1,0 +1,1 @@
+"""L8 — ktl, the kubectl-equivalent CLI."""

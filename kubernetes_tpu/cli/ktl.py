@@ -1,0 +1,343 @@
+"""ktl — the kubectl-equivalent CLI (L8).
+
+reference: staging/src/k8s.io/kubectl/pkg/cmd (the command set, not the code).
+Talks HTTP to the API server (KTL_SERVER env or --server).
+
+Commands: get, describe, create -f, apply -f, delete, scale, cordon, uncordon,
+taint, drain, top nodes, version, api-resources.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+from ..api.serialize import GROUP_PREFIX, KIND_TO_RESOURCE, RESOURCE_TO_TYPE
+from ..server.client import APIError, RESTClient
+
+ALIASES = {
+    "po": "pods", "pod": "pods",
+    "no": "nodes", "node": "nodes",
+    "ns": "namespaces", "namespace": "namespaces",
+    "rs": "replicasets", "replicaset": "replicasets",
+    "deploy": "deployments", "deployment": "deployments",
+    "lease": "leases",
+}
+
+
+def resolve_resource(name: str) -> str:
+    r = ALIASES.get(name, name)
+    if r not in RESOURCE_TO_TYPE:
+        raise SystemExit(f"error: unknown resource type {name!r}")
+    return r
+
+
+def load_manifests(path: str) -> List[Dict]:
+    """YAML (if available) or JSON manifests; multi-document supported."""
+    if path == "-":
+        raw = sys.stdin.read()
+    else:
+        with open(path) as f:
+            raw = f.read()
+    try:
+        import yaml  # type: ignore
+
+        docs = [d for d in yaml.safe_load_all(raw) if d]
+        if docs:
+            return docs
+    except ImportError:
+        pass
+    raw = raw.strip()
+    if raw.startswith("["):
+        return json.loads(raw)
+    return [json.loads(raw)]
+
+
+def fmt_table(headers: List[str], rows: List[List[str]]) -> str:
+    widths = [max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+              for i, h in enumerate(headers)]
+    out = ["  ".join(h.ljust(w) for h, w in zip(headers, widths))]
+    for r in rows:
+        out.append("  ".join(c.ljust(w) for c, w in zip(r, widths)))
+    return "\n".join(out)
+
+
+# -- command implementations ---------------------------------------------------
+
+
+def cmd_get(client: RESTClient, args) -> int:
+    resource = resolve_resource(args.resource)
+    ns = None if resource in ("nodes", "namespaces") else (args.namespace or "default")
+    if args.name:
+        obj = client.get(resource, args.name, ns)
+        if args.output == "json":
+            print(json.dumps(obj, indent=2))
+        elif args.output == "yaml":
+            _print_yaml(obj)
+        else:
+            print(fmt_table(*_rows(resource, [obj])))
+        return 0
+    items, _ = client.list(resource, None if args.all_namespaces else ns)
+    if args.output == "json":
+        print(json.dumps(items, indent=2))
+    elif args.output == "yaml":
+        _print_yaml({"items": items})
+    else:
+        print(fmt_table(*_rows(resource, items)))
+    return 0
+
+
+def _print_yaml(obj) -> None:
+    try:
+        import yaml  # type: ignore
+
+        print(yaml.safe_dump(obj, sort_keys=False))
+    except ImportError:
+        print(json.dumps(obj, indent=2))
+
+
+def _rows(resource: str, items: List[Dict]):
+    if resource == "pods":
+        headers = ["NAMESPACE", "NAME", "STATUS", "NODE", "PRIORITY"]
+        rows = [[
+            (o["metadata"].get("namespace") or ""),
+            o["metadata"]["name"],
+            (o.get("status") or {}).get("phase", ""),
+            (o.get("spec") or {}).get("nodeName", "<none>") or "<pending>",
+            str((o.get("spec") or {}).get("priority", 0)),
+        ] for o in items]
+    elif resource == "nodes":
+        headers = ["NAME", "STATUS", "TAINTS", "CPU", "MEMORY"]
+        rows = []
+        for o in items:
+            conds = {c["type"]: c["status"] for c in (o.get("status") or {}).get("conditions", [])}
+            ready = "Ready" if conds.get("Ready", "True") == "True" else "NotReady"
+            if (o.get("spec") or {}).get("unschedulable"):
+                ready += ",SchedulingDisabled"
+            taints = ",".join(t["key"] for t in (o.get("spec") or {}).get("taints", [])) or "<none>"
+            cap = (o.get("status") or {}).get("allocatable", {})
+            rows.append([o["metadata"]["name"], ready, taints,
+                         str(cap.get("cpu", "")), str(cap.get("memory", ""))])
+    elif resource in ("replicasets", "deployments"):
+        headers = ["NAMESPACE", "NAME", "DESIRED", "CURRENT", "READY"]
+        rows = [[
+            o["metadata"].get("namespace") or "",
+            o["metadata"]["name"],
+            str((o.get("spec") or {}).get("replicas", 0)),
+            str((o.get("status") or {}).get("replicas", 0)),
+            str((o.get("status") or {}).get("readyReplicas", 0)),
+        ] for o in items]
+    else:
+        headers = ["NAMESPACE", "NAME"]
+        rows = [[o["metadata"].get("namespace") or "", o["metadata"]["name"]] for o in items]
+    return headers, rows
+
+
+def cmd_create(client: RESTClient, args) -> int:
+    rc = 0
+    for doc in load_manifests(args.filename):
+        kind = doc.get("kind", "")
+        resource = KIND_TO_RESOURCE.get(kind)
+        if resource is None:
+            print(f"error: unsupported kind {kind!r}", file=sys.stderr)
+            rc = 1
+            continue
+        ns = args.namespace or (doc.get("metadata") or {}).get("namespace") or "default"
+        try:
+            out = client.create(resource, doc, None if resource in ("nodes", "namespaces") else ns)
+            print(f"{resource}/{out['metadata']['name']} created")
+        except APIError as e:
+            print(f"error: {e}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_apply(client: RESTClient, args) -> int:
+    rc = 0
+    for doc in load_manifests(args.filename):
+        kind = doc.get("kind", "")
+        resource = KIND_TO_RESOURCE.get(kind)
+        if resource is None:
+            print(f"error: unsupported kind {kind!r}", file=sys.stderr)
+            rc = 1
+            continue
+        meta = doc.get("metadata") or {}
+        ns = args.namespace or meta.get("namespace") or "default"
+        ns_arg = None if resource in ("nodes", "namespaces") else ns
+        try:
+            try:
+                current = client.get(resource, meta["name"], ns_arg)
+                doc.setdefault("metadata", {})["resourceVersion"] = \
+                    current["metadata"]["resourceVersion"]
+                client.update(resource, doc, ns_arg)
+                print(f"{resource}/{meta['name']} configured")
+            except APIError as e:
+                if e.code != 404:
+                    raise
+                client.create(resource, doc, ns_arg)
+                print(f"{resource}/{meta['name']} created")
+        except APIError as e:
+            print(f"error: {e}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_delete(client: RESTClient, args) -> int:
+    resource = resolve_resource(args.resource)
+    ns = None if resource in ("nodes", "namespaces") else (args.namespace or "default")
+    try:
+        client.delete(resource, args.name, ns)
+        print(f"{resource}/{args.name} deleted")
+        return 0
+    except APIError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+def cmd_scale(client: RESTClient, args) -> int:
+    resource = resolve_resource(args.resource)
+    ns = args.namespace or "default"
+    obj = client.get(resource, args.name, ns)
+    obj["spec"]["replicas"] = args.replicas
+    client.update(resource, obj, ns)
+    print(f"{resource}/{args.name} scaled to {args.replicas}")
+    return 0
+
+
+def _patch_node(client: RESTClient, name: str, mutate) -> Dict:
+    node = client.get("nodes", name, None)
+    mutate(node)
+    return client.update("nodes", node, None)
+
+
+def cmd_cordon(client: RESTClient, args) -> int:
+    _patch_node(client, args.name, lambda n: n.setdefault("spec", {}).__setitem__("unschedulable", True))
+    print(f"node/{args.name} cordoned")
+    return 0
+
+
+def cmd_uncordon(client: RESTClient, args) -> int:
+    _patch_node(client, args.name, lambda n: n.setdefault("spec", {}).__setitem__("unschedulable", False))
+    print(f"node/{args.name} uncordoned")
+    return 0
+
+
+def cmd_taint(client: RESTClient, args) -> int:
+    # ktl taint nodes NAME key=value:Effect  (key:Effect- to remove)
+    spec = args.taint
+    removing = spec.endswith("-")
+    spec = spec.rstrip("-")
+    if "=" in spec:
+        key, rest = spec.split("=", 1)
+        value, _, effect = rest.partition(":")
+    else:
+        key, _, effect = spec.partition(":")
+        value = ""
+
+    def mutate(n):
+        taints = n.setdefault("spec", {}).setdefault("taints", [])
+        taints[:] = [t for t in taints if not (t["key"] == key and t.get("effect") == effect)]
+        if not removing:
+            taints.append({"key": key, **({"value": value} if value else {}), "effect": effect})
+
+    _patch_node(client, args.name, mutate)
+    print(f"node/{args.name} {'untainted' if removing else 'tainted'}")
+    return 0
+
+
+def cmd_drain(client: RESTClient, args) -> int:
+    cmd_cordon(client, args)
+    pods, _ = client.list("pods")
+    for p in pods:
+        if (p.get("spec") or {}).get("nodeName") == args.name:
+            ns = p["metadata"].get("namespace") or "default"
+            client.delete("pods", p["metadata"]["name"], ns)
+            print(f"pod/{p['metadata']['name']} evicted")
+    return 0
+
+
+def cmd_describe(client: RESTClient, args) -> int:
+    resource = resolve_resource(args.resource)
+    ns = None if resource in ("nodes", "namespaces") else (args.namespace or "default")
+    obj = client.get(resource, args.name, ns)
+    _print_yaml(obj)
+    return 0
+
+
+def cmd_api_resources(client: RESTClient, args) -> int:
+    rows = [[r, GROUP_PREFIX[r].split("/")[-2] if "apis" in GROUP_PREFIX[r] else "v1"]
+            for r in sorted(RESOURCE_TO_TYPE)]
+    print(fmt_table(["NAME", "APIVERSION"], rows))
+    return 0
+
+
+def cmd_version(client: RESTClient, args) -> int:
+    out = client.request("GET", "/version")
+    print(f"Client: kubernetes-tpu v0.1.0\nServer: {out.get('gitVersion', 'unknown')}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="ktl", description="kubernetes-tpu CLI")
+    parser.add_argument("--server", default=os.environ.get("KTL_SERVER", "http://127.0.0.1:8001"))
+    parser.add_argument("-n", "--namespace", default=None)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("get")
+    p.add_argument("resource")
+    p.add_argument("name", nargs="?")
+    p.add_argument("-o", "--output", choices=["wide", "json", "yaml"], default="wide")
+    p.add_argument("-A", "--all-namespaces", action="store_true")
+    p.set_defaults(fn=cmd_get)
+
+    p = sub.add_parser("describe")
+    p.add_argument("resource")
+    p.add_argument("name")
+    p.set_defaults(fn=cmd_describe)
+
+    for name, fn in (("create", cmd_create), ("apply", cmd_apply)):
+        p = sub.add_parser(name)
+        p.add_argument("-f", "--filename", required=True)
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("delete")
+    p.add_argument("resource")
+    p.add_argument("name")
+    p.set_defaults(fn=cmd_delete)
+
+    p = sub.add_parser("scale")
+    p.add_argument("resource")
+    p.add_argument("name")
+    p.add_argument("--replicas", type=int, required=True)
+    p.set_defaults(fn=cmd_scale)
+
+    for name, fn in (("cordon", cmd_cordon), ("uncordon", cmd_uncordon), ("drain", cmd_drain)):
+        p = sub.add_parser(name)
+        p.add_argument("name")
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("taint")
+    p.add_argument("resource_kw")  # "nodes"
+    p.add_argument("name")
+    p.add_argument("taint")
+    p.set_defaults(fn=cmd_taint)
+
+    p = sub.add_parser("api-resources")
+    p.set_defaults(fn=cmd_api_resources)
+    p = sub.add_parser("version")
+    p.set_defaults(fn=cmd_version)
+
+    args = parser.parse_args(argv)
+    client = RESTClient(args.server)
+    try:
+        return args.fn(client, args)
+    except APIError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,3 @@
+"""scheduler_perf-equivalent benchmark DSL."""
+
+from .dsl import WorkloadResult, WorkloadRunner, run_config  # noqa: F401

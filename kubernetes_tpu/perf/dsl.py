@@ -1,0 +1,165 @@
+"""scheduler_perf-equivalent workload DSL.
+
+reference: test/integration/scheduler_perf/scheduler_perf.go:79-94 (opcode
+union), :477, :1493-1497 (SchedulingThroughput threshold check) and the YAML
+shape of misc/performance-config.yaml. Supported opcodes:
+
+  createNodes   {count, nodeTemplate?, zones?}
+  createPods    {count, podTemplate?, collectMetrics?, namespace?}
+  churn         {number, intervalMilliseconds?, templatePaths? -> inline templates}
+  barrier       {}   (wait until no pending pods)
+  sleep         {durationMilliseconds}
+
+A workload runs against an in-process store + scheduler (integration style: no
+kubelets, pods just become Bound — SURVEY.md §4). Throughput = pods scheduled
+per second during collectMetrics createPods phases; a run fails its threshold
+like the reference's CI gate.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..api import Node, Pod
+from ..scheduler import Framework
+from ..scheduler.batch import BatchScheduler
+from ..scheduler.plugins import default_plugins
+from ..store import APIStore
+
+DEFAULT_NODE = {
+    "metadata": {"name": "node-{i}"},
+    "status": {"capacity": {"cpu": "8", "memory": "32Gi", "pods": "110"}},
+}
+DEFAULT_POD = {
+    "metadata": {"name": "pod-{i}"},
+    "spec": {"containers": [{"name": "c", "resources": {
+        "requests": {"cpu": "500m", "memory": "1Gi"}}}]},
+}
+
+
+@dataclass
+class ThroughputSample:
+    pods: int
+    seconds: float
+
+    @property
+    def pods_per_second(self) -> float:
+        return self.pods / self.seconds if self.seconds > 0 else 0.0
+
+
+@dataclass
+class WorkloadResult:
+    name: str
+    samples: List[ThroughputSample] = field(default_factory=list)
+    threshold: float = 0.0
+
+    @property
+    def throughput(self) -> float:
+        pods = sum(s.pods for s in self.samples)
+        secs = sum(s.seconds for s in self.samples)
+        return pods / secs if secs else 0.0
+
+    @property
+    def passed(self) -> bool:
+        # 30% error margin like scheduler_perf.go:1493
+        return self.threshold == 0 or self.throughput >= self.threshold * 0.7
+
+
+def _fill(template: Dict, i: int, prefix: str = "") -> Dict:
+    import json
+
+    raw = json.dumps(template)
+    raw = raw.replace("{i}", str(i)).replace("{prefix}", prefix)
+    return json.loads(raw)
+
+
+class WorkloadRunner:
+    def __init__(self, solver: str = "auto", percentage_of_nodes_to_score: int = 100):
+        self.store = APIStore(deep_copy_on_write=False)  # perf harness mode
+        self.sched = BatchScheduler(
+            self.store, Framework(default_plugins()), solver=solver,
+            percentage_of_nodes_to_score=percentage_of_nodes_to_score,
+        )
+        self._synced = False
+        self._pod_seq = 0
+
+    def run(self, workload: Dict) -> WorkloadResult:
+        result = WorkloadResult(
+            name=workload.get("name", "workload"),
+            threshold=float(workload.get("threshold", 0)),
+        )
+        for op in workload.get("workloadTemplate", []):
+            self._run_op(op, result)
+        return result
+
+    def _ensure_synced(self):
+        if not self._synced:
+            self.sched.sync()
+            self._synced = True
+
+    def _run_op(self, op: Dict, result: WorkloadResult) -> None:
+        code = op["opcode"]
+        if code == "createNodes":
+            template = op.get("nodeTemplate", DEFAULT_NODE)
+            zones = op.get("zones", 0)
+            for i in range(op["count"]):
+                d = _fill(template, i)
+                if zones:
+                    d.setdefault("metadata", {}).setdefault("labels", {})[
+                        "topology.kubernetes.io/zone"] = f"zone-{i % zones}"
+                self.store.create("nodes", Node.from_dict(d))
+        elif code == "createPods":
+            template = op.get("podTemplate", DEFAULT_POD)
+            count = op["count"]
+            ns = op.get("namespace", "default")
+            pods = []
+            for _ in range(count):
+                d = _fill(template, self._pod_seq, prefix=ns)
+                d.setdefault("metadata", {})["namespace"] = ns
+                self._pod_seq += 1
+                pods.append(Pod.from_dict(d))
+            self._ensure_synced()
+            collect = op.get("collectMetrics", False)
+            t0 = time.perf_counter()
+            for p in pods:
+                self.store.create("pods", p)
+            before = self.sched.scheduled_count
+            self.sched.run_until_idle()
+            dt = time.perf_counter() - t0
+            if collect:
+                result.samples.append(ThroughputSample(
+                    pods=self.sched.scheduled_count - before, seconds=dt))
+        elif code == "churn":
+            self._ensure_synced()
+            number = op.get("number", 100)
+            interval = op.get("intervalMilliseconds", 0) / 1000.0
+            template = op.get("podTemplate", DEFAULT_POD)
+            for i in range(number):
+                d = _fill(template, self._pod_seq)
+                self._pod_seq += 1
+                pod = self.store.create("pods", Pod.from_dict(d))
+                self.sched.run_until_idle()
+                try:
+                    self.store.delete("pods", pod.key)
+                except Exception:
+                    pass
+                if interval:
+                    time.sleep(interval)
+        elif code == "barrier":
+            self._ensure_synced()
+            self.sched.run_until_idle()
+        elif code == "sleep":
+            time.sleep(op.get("durationMilliseconds", 0) / 1000.0)
+        else:
+            raise ValueError(f"unknown opcode {code!r}")
+
+
+def run_config(config: List[Dict], solver: str = "auto") -> List[WorkloadResult]:
+    """Run a performance-config list: [{name, workloadTemplate, threshold}]."""
+    out = []
+    for workload in config:
+        runner = WorkloadRunner(solver=solver)
+        out.append(runner.run(workload))
+    return out

@@ -1,0 +1,5 @@
+"""L2/L3 — HTTP API server + client (apiserver/client-go analogs)."""
+
+from .client import APIError, Informer, RESTClient  # noqa: F401
+from .metrics import Registry, global_registry  # noqa: F401
+from .rest import APIServer  # noqa: F401

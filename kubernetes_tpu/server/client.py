@@ -1,0 +1,157 @@
+"""HTTP client for the API server — the client-go analog (typed REST + watch).
+
+reference: staging/src/k8s.io/client-go/rest + tools/cache/reflector.go
+(ListAndWatch with resourceVersion resume).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from ..api.serialize import GROUP_PREFIX, CLUSTER_SCOPED, from_dict
+
+
+class APIError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(f"{code}: {message}")
+        self.code = code
+
+
+class RESTClient:
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def _path(self, resource: str, namespace: Optional[str], name: Optional[str] = None,
+              subresource: Optional[str] = None) -> str:
+        prefix = GROUP_PREFIX[resource]
+        if resource in CLUSTER_SCOPED or namespace is None:
+            p = f"{prefix}/{resource}"
+        else:
+            p = f"{prefix}/namespaces/{namespace}/{resource}"
+        if name:
+            p += f"/{name}"
+        if subresource:
+            p += f"/{subresource}"
+        return p
+
+    def request(self, method: str, path: str, body: Optional[Dict] = None,
+                timeout: Optional[float] = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(self.base_url + path, data=data, method=method,
+                                     headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=timeout or self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read() or b"{}")
+                msg = payload.get("message", str(e))
+            except Exception:
+                msg = str(e)
+            raise APIError(e.code, msg) from None
+
+    # -- typed operations ------------------------------------------------------
+
+    def create(self, resource: str, obj_dict: Dict, namespace: Optional[str] = None):
+        ns = namespace or (obj_dict.get("metadata") or {}).get("namespace") or "default"
+        return self.request("POST", self._path(resource, ns), obj_dict)
+
+    def get(self, resource: str, name: str, namespace: Optional[str] = "default") -> Dict:
+        return self.request("GET", self._path(resource, namespace, name))
+
+    def list(self, resource: str, namespace: Optional[str] = None) -> Tuple[List[Dict], int]:
+        out = self.request("GET", self._path(resource, namespace))
+        return out["items"], out["metadata"]["resourceVersion"]
+
+    def update(self, resource: str, obj_dict: Dict, namespace: Optional[str] = None) -> Dict:
+        meta = obj_dict.get("metadata") or {}
+        ns = namespace or meta.get("namespace") or "default"
+        return self.request("PUT", self._path(resource, ns, meta["name"]), obj_dict)
+
+    def delete(self, resource: str, name: str, namespace: Optional[str] = "default") -> Dict:
+        return self.request("DELETE", self._path(resource, namespace, name))
+
+    def bind(self, namespace: str, pod_name: str, node_name: str) -> Dict:
+        return self.request("POST", self._path("pods", namespace, pod_name, "binding"),
+                            {"target": {"kind": "Node", "name": node_name}})
+
+    def watch(self, resource: str, since_rv: int = -1,
+              namespace: Optional[str] = None) -> Iterator[Tuple[str, Dict]]:
+        """Yields (event_type, object_dict); blocks on the streaming response."""
+        path = self._path(resource, namespace) + f"?watch=true&resourceVersion={since_rv}"
+        req = urllib.request.Request(self.base_url + path)
+        resp = urllib.request.urlopen(req, timeout=3600)
+        for raw in resp:
+            raw = raw.strip()
+            if not raw:
+                continue
+            ev = json.loads(raw)
+            yield ev["type"], ev["object"]
+
+
+class Informer:
+    """List+watch a resource into a local cache with handlers — the
+    SharedIndexInformer analog over HTTP."""
+
+    def __init__(self, client: RESTClient, resource: str,
+                 on_event: Optional[Callable[[str, Any], None]] = None):
+        self.client = client
+        self.resource = resource
+        self.cache: Dict[str, Any] = {}
+        self.on_event = on_event
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _key(self, obj_dict: Dict) -> str:
+        meta = obj_dict.get("metadata") or {}
+        ns = meta.get("namespace")
+        return f"{ns}/{meta['name']}" if ns else meta["name"]
+
+    def start(self) -> "Informer":
+        items, rv = self.client.list(self.resource)
+        for it in items:
+            self.cache[self._key(it)] = from_dict(self.resource, it)
+
+        def loop():
+            nonlocal rv
+            while not self._stop.is_set():
+                try:
+                    for etype, obj_dict in self.client.watch(self.resource, since_rv=rv):
+                        if self._stop.is_set():
+                            return
+                        obj = from_dict(self.resource, obj_dict)
+                        key = self._key(obj_dict)
+                        rv = int((obj_dict.get("metadata") or {}).get("resourceVersion", rv))
+                        if etype == "DELETED":
+                            self.cache.pop(key, None)
+                        else:
+                            self.cache[key] = obj
+                        if self.on_event:
+                            self.on_event(etype, obj)
+                except Exception:
+                    if self._stop.is_set():
+                        return
+                    import time
+
+                    time.sleep(0.2)
+                    # Reflector contract: RELIST then rewatch — retrying the
+                    # stale rv after a 410 Expired would loop forever and
+                    # freeze the cache.
+                    try:
+                        items, rv = self.client.list(self.resource)
+                        fresh = {self._key(it): from_dict(self.resource, it) for it in items}
+                        self.cache.clear()
+                        self.cache.update(fresh)
+                    except Exception:
+                        pass  # server unreachable: retry the whole cycle
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,122 @@
+"""Minimal Prometheus-style metrics registry (component-base/metrics analog).
+
+reference: staging/src/k8s.io/component-base/metrics — counters, gauges, and
+histograms with a text exposition at /metrics. The scheduler records the same
+key series the reference does (pkg/scheduler/metrics/metrics.go:171,226).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._values: Dict[Tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
+        with self._lock:
+            for key, v in sorted(self._values.items()):
+                lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                out.append(f"{self.name}{{{lbl}}} {v}" if lbl else f"{self.name} {v}")
+        return out
+
+
+class Gauge(Counter):
+    def set(self, value: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = value
+
+    def render(self) -> List[str]:
+        out = super().render()
+        out[1] = f"# TYPE {self.name} gauge"
+        return out
+
+
+class Histogram:
+    DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 30)
+
+    def __init__(self, name: str, help_: str = "", buckets: Sequence[float] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets)
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._total = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        with self._lock:
+            self._sum += value
+            self._total += 1
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+        with self._lock:
+            cum = 0
+            for i, b in enumerate(self.buckets):
+                cum += self._counts[i]
+                out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
+            out.append(f'{self.name}_bucket{{le="+Inf"}} {self._total}')
+            out.append(f"{self.name}_sum {self._sum}")
+            out.append(f"{self.name}_count {self._total}")
+        return out
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: List = []
+        self._lock = threading.Lock()
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._add(Counter(name, help_))
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._add(Gauge(name, help_))
+
+    def histogram(self, name: str, help_: str = "", buckets=Histogram.DEFAULT_BUCKETS) -> Histogram:
+        return self._add(Histogram(name, help_, buckets))
+
+    def _add(self, m):
+        with self._lock:
+            self._metrics.append(m)
+        return m
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics)
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+global_registry = Registry()
+
+# the scheduler's key series (metrics/metrics.go)
+scheduling_attempts = global_registry.counter(
+    "scheduler_schedule_attempts_total", "Scheduling attempts by result")
+scheduling_attempt_duration = global_registry.histogram(
+    "scheduler_scheduling_attempt_duration_seconds", "Scheduling attempt latency")
+pending_pods = global_registry.gauge(
+    "scheduler_pending_pods", "Pending pods by queue")
+batch_solve_duration = global_registry.histogram(
+    "scheduler_batch_solve_duration_seconds", "TPU batch solve latency")
+batch_size_gauge = global_registry.gauge(
+    "scheduler_batch_size", "Pods in the last solved batch")

@@ -1,0 +1,289 @@
+"""HTTP REST front-end over the APIStore — the apiserver surface (L2).
+
+reference: staging/src/k8s.io/apiserver/pkg/endpoints/handlers/{get,create,
+update,delete,watch}.go and handlers/watch.go:187 WatchServer. Paths follow the
+kubernetes URL scheme:
+
+  GET/POST        /api/v1/namespaces/{ns}/pods[?watch=true&resourceVersion=N]
+  GET/PUT/DELETE  /api/v1/namespaces/{ns}/pods/{name}
+  POST            /api/v1/namespaces/{ns}/pods/{name}/binding   (BindingREST)
+  GET/POST        /api/v1/nodes ... (cluster-scoped)
+  GET             /healthz /readyz /metrics
+
+Watches stream newline-delimited JSON events over a chunked response, exactly
+the client-go wire shape: {"type": "ADDED", "object": {...}}.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from ..api.serialize import (
+    CLUSTER_SCOPED,
+    RESOURCE_TO_TYPE,
+    from_dict,
+    to_dict,
+)
+from ..store import (
+    AlreadyBoundError,
+    AlreadyExistsError,
+    APIStore,
+    ConflictError,
+    NotFoundError,
+    ResourceVersionTooOldError,
+)
+
+
+def _parse_path(path: str) -> Optional[Tuple[str, Optional[str], Optional[str], Optional[str]]]:
+    """-> (resource, namespace, name, subresource) or None."""
+    parts = [p for p in path.split("/") if p]
+    # /api/v1/... or /apis/{group}/{version}/...
+    if not parts or parts[0] not in ("api", "apis"):
+        return None
+    parts = parts[2:] if parts[0] == "api" else parts[3:]
+    if not parts:
+        return None
+    if parts[0] == "namespaces" and len(parts) >= 3:
+        ns, resource = parts[1], parts[2]
+        name = parts[3] if len(parts) > 3 else None
+        sub = parts[4] if len(parts) > 4 else None
+        return resource, ns, name, sub
+    if parts[0] == "namespaces" and len(parts) == 2:
+        return "namespaces", None, parts[1], None
+    resource = parts[0]
+    name = parts[1] if len(parts) > 1 else None
+    sub = parts[2] if len(parts) > 2 else None
+    return resource, None, name, sub
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kubernetes-tpu-apiserver"
+
+    # quiet by default
+    def log_message(self, fmt, *args):
+        if self.server.verbose:  # type: ignore[attr-defined]
+            super().log_message(fmt, *args)
+
+    @property
+    def store(self) -> APIStore:
+        return self.server.store  # type: ignore[attr-defined]
+
+    def _send_json(self, code: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, message: str, reason: str = "") -> None:
+        self._send_json(code, {"kind": "Status", "status": "Failure",
+                               "message": message, "reason": reason, "code": code})
+
+    def _key(self, resource, ns, name) -> str:
+        return f"{ns}/{name}" if resource not in CLUSTER_SCOPED else name
+
+    def _read_body(self):
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        raw = self.rfile.read(length) if length else b"{}"
+        return json.loads(raw or b"{}")
+
+    # ---- GET: get / list / watch / health / metrics --------------------------
+
+    def do_GET(self):
+        url = urlparse(self.path)
+        if url.path == "/healthz" or url.path == "/readyz":
+            self._send_json(200, {"status": "ok"})
+            return
+        if url.path == "/metrics":
+            self._metrics()
+            return
+        if url.path == "/version":
+            self._send_json(200, {"gitVersion": "v0.1.0-kubernetes-tpu"})
+            return
+        parsed = _parse_path(url.path)
+        if parsed is None:
+            self._error(404, f"unknown path {url.path}")
+            return
+        resource, ns, name, _sub = parsed
+        if resource not in RESOURCE_TO_TYPE:
+            self._error(404, f"unknown resource {resource}")
+            return
+        q = parse_qs(url.query)
+        if name is None and q.get("watch", ["false"])[0] == "true":
+            self._watch(resource, ns, int(q.get("resourceVersion", ["-1"])[0]))
+            return
+        try:
+            if name is not None:
+                obj = self.store.get(resource, self._key(resource, ns, name))
+                self._send_json(200, to_dict(obj))
+            else:
+                pred = (lambda o: o.metadata.namespace == ns) if ns else None
+                items, rv = self.store.list(resource, pred)
+                self._send_json(200, {
+                    "kind": "List",
+                    "metadata": {"resourceVersion": rv},
+                    "items": [to_dict(o) for o in items],
+                })
+        except NotFoundError as e:
+            self._error(404, str(e), "NotFound")
+
+    def _watch(self, resource: str, ns: Optional[str], since_rv: int) -> None:
+        try:
+            w = self.store.watch(resource, since_rv=since_rv)
+        except ResourceVersionTooOldError as e:
+            self._error(410, str(e), "Expired")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        try:
+            while True:
+                ev = w.get(timeout=1.0)
+                if ev is None:
+                    if self.server.shutting_down:  # type: ignore[attr-defined]
+                        break
+                    continue
+                if ns and getattr(ev.obj.metadata, "namespace", "") != ns:
+                    continue
+                line = json.dumps({"type": ev.type, "object": to_dict(ev.obj)}).encode() + b"\n"
+                self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            w.stop()
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+            except Exception:
+                pass
+
+    def _metrics(self) -> None:
+        from .metrics import global_registry
+
+        body = global_registry.render().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    # ---- POST: create / binding ----------------------------------------------
+
+    def do_POST(self):
+        parsed = _parse_path(urlparse(self.path).path)
+        if parsed is None:
+            self._error(404, "unknown path")
+            return
+        resource, ns, name, sub = parsed
+        try:
+            body = self._read_body()
+        except json.JSONDecodeError as e:
+            self._error(400, f"invalid JSON: {e}")
+            return
+        if sub == "binding" and resource == "pods":
+            target = (body.get("target") or {}).get("name", "")
+            if not target:
+                self._error(400, "binding requires target.name")
+                return
+            try:
+                self.store.bind(ns, name, target)
+                self._send_json(201, {"kind": "Status", "status": "Success"})
+            except NotFoundError as e:
+                self._error(404, str(e), "NotFound")
+            except AlreadyBoundError as e:
+                self._error(409, str(e), "Conflict")
+            return
+        if resource not in RESOURCE_TO_TYPE:
+            self._error(404, f"unknown resource {resource}")
+            return
+        try:
+            obj = from_dict(resource, body)
+        except Exception as e:
+            self._error(400, f"cannot parse {resource}: {e}")
+            return
+        if ns and resource not in CLUSTER_SCOPED:
+            obj.metadata.namespace = ns
+        try:
+            created = self.store.create(resource, obj)
+            self._send_json(201, to_dict(created))
+        except AlreadyExistsError as e:
+            self._error(409, str(e), "AlreadyExists")
+
+    # ---- PUT / DELETE --------------------------------------------------------
+
+    def do_PUT(self):
+        parsed = _parse_path(urlparse(self.path).path)
+        if parsed is None or parsed[2] is None:
+            self._error(404, "unknown path")
+            return
+        resource, ns, name, _ = parsed
+        try:
+            body = self._read_body()
+            obj = from_dict(resource, body)
+        except Exception as e:
+            self._error(400, f"cannot parse: {e}")
+            return
+        # the URL is authoritative for namespace/name (the body may omit them)
+        if ns and resource not in CLUSTER_SCOPED:
+            obj.metadata.namespace = ns
+        if obj.metadata.name and obj.metadata.name != name:
+            self._error(400, f"name mismatch: URL {name!r} vs body {obj.metadata.name!r}")
+            return
+        obj.metadata.name = name
+        try:
+            updated = self.store.update(resource, obj)
+            self._send_json(200, to_dict(updated))
+        except NotFoundError as e:
+            self._error(404, str(e), "NotFound")
+        except ConflictError as e:
+            self._error(409, str(e), "Conflict")
+
+    def do_DELETE(self):
+        parsed = _parse_path(urlparse(self.path).path)
+        if parsed is None or parsed[2] is None:
+            self._error(404, "unknown path")
+            return
+        resource, ns, name, _ = parsed
+        try:
+            obj = self.store.delete(resource, self._key(resource, ns, name))
+            self._send_json(200, to_dict(obj))
+        except NotFoundError as e:
+            self._error(404, str(e), "NotFound")
+
+
+class APIServer:
+    """Embeds the store behind HTTP. start() binds a port; .url for clients."""
+
+    def __init__(self, store: APIStore, host: str = "127.0.0.1", port: int = 0,
+                 verbose: bool = False):
+        self.store = store
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.store = store  # type: ignore[attr-defined]
+        self._httpd.verbose = verbose  # type: ignore[attr-defined]
+        self._httpd.shutting_down = False  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "APIServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutting_down = True  # type: ignore[attr-defined]
+        self._httpd.shutdown()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None

@@ -1,1 +1,2 @@
 from .clock import Clock, FakeClock  # noqa: F401
+from .leaderelection import LeaderElector  # noqa: F401

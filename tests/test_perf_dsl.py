@@ -1,0 +1,123 @@
+"""Perf DSL + leader election tests."""
+
+from kubernetes_tpu.perf import WorkloadRunner, run_config
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.utils import FakeClock, LeaderElector
+
+
+def test_basic_workload_meets_scaled_threshold():
+    # scaled-down SchedulingBasic: 100 nodes / 200 pods, threshold 270 pods/s —
+    # the CPU-mesh solver must beat the reference's serial threshold even tiny.
+    # First run pays jit compile; the steady-state (second) run is thresholded,
+    # matching how the reference measures sustained throughput.
+    config = [{
+        "name": "SchedulingBasicSmall",
+        "threshold": 270,
+        "workloadTemplate": [
+            {"opcode": "createNodes", "count": 100},
+            {"opcode": "createPods", "count": 50},
+            {"opcode": "createPods", "count": 200, "collectMetrics": True},
+        ],
+    }]
+    run_config(config)  # warm-up/compile
+    result = run_config(config)[0]
+    assert result.samples and result.samples[0].pods == 200
+    assert result.passed, f"throughput {result.throughput:.0f} < threshold"
+
+
+def test_topology_spread_workload():
+    result = run_config([{
+        "name": "TopologySpreadSmall",
+        "workloadTemplate": [
+            {"opcode": "createNodes", "count": 30, "zones": 3},
+            {"opcode": "createPods", "count": 60, "collectMetrics": True,
+             "podTemplate": {
+                 "metadata": {"name": "spread-{i}", "labels": {"app": "web"}},
+                 "spec": {"containers": [{"name": "c", "resources": {
+                     "requests": {"cpu": "100m"}}}],
+                     "topologySpreadConstraints": [{
+                         "maxSkew": 1,
+                         "topologyKey": "topology.kubernetes.io/zone",
+                         "whenUnsatisfiable": "DoNotSchedule",
+                         "labelSelector": {"matchLabels": {"app": "web"}}}]},
+             }},
+            {"opcode": "barrier"},
+        ],
+    }])[0]
+    assert result.samples[0].pods == 60
+
+
+def test_churn_opcode():
+    runner = WorkloadRunner()
+    result = runner.run({
+        "name": "churn",
+        "workloadTemplate": [
+            {"opcode": "createNodes", "count": 5},
+            {"opcode": "churn", "number": 10},
+            {"opcode": "barrier"},
+        ],
+    })
+    pods, _ = runner.store.list("pods")
+    assert pods == []  # churned pods deleted
+
+
+class TestLeaderElection:
+    def test_single_leader(self):
+        clock = FakeClock()
+        store = APIStore()
+        a = LeaderElector(store, "scheduler", "instance-a", clock=clock)
+        b = LeaderElector(store, "scheduler", "instance-b", clock=clock)
+        assert a.try_acquire_or_renew() is True
+        assert b.try_acquire_or_renew() is False
+        assert a.is_leader and not b.is_leader
+
+    def test_failover_after_lease_expiry(self):
+        clock = FakeClock()
+        store = APIStore()
+        events = []
+        a = LeaderElector(store, "scheduler", "a", lease_duration=15, clock=clock,
+                          on_stopped_leading=lambda: events.append("a-stopped"))
+        b = LeaderElector(store, "scheduler", "b", lease_duration=15, clock=clock,
+                          on_started_leading=lambda: events.append("b-started"))
+        assert a.try_acquire_or_renew()
+        clock.step(16)  # a dies silently
+        assert b.try_acquire_or_renew() is True
+        assert events == ["b-started"]
+        # a comes back: must observe b's leadership
+        clock.step(1)
+        assert a.try_acquire_or_renew() is False
+        assert events == ["b-started", "a-stopped"]
+
+    def test_graceful_release(self):
+        clock = FakeClock()
+        store = APIStore()
+        a = LeaderElector(store, "s", "a", clock=clock)
+        b = LeaderElector(store, "s", "b", clock=clock)
+        assert a.try_acquire_or_renew()
+        a.release()
+        assert b.try_acquire_or_renew() is True
+
+    def test_no_split_brain_on_concurrent_seize(self):
+        """Two standbys observing an expired holder must not both win
+        (liveness is re-checked inside the retrying update)."""
+        clock = FakeClock()
+        store = APIStore()
+        a = LeaderElector(store, "s", "a", lease_duration=15, clock=clock)
+        b = LeaderElector(store, "s", "b", lease_duration=15, clock=clock)
+        c = LeaderElector(store, "s", "c", lease_duration=15, clock=clock)
+        assert a.try_acquire_or_renew()
+        clock.step(16)  # a expires
+        assert b.try_acquire_or_renew() is True
+        # c raced: observed a expired before b's seize; fresh re-check must lose
+        assert c.try_acquire_or_renew() is False
+        assert b.is_leader and not c.is_leader
+
+    def test_rfc3339_lease_manifest(self):
+        from kubernetes_tpu.api.workloads import Lease
+
+        lease = Lease.from_dict({
+            "metadata": {"name": "x", "namespace": "kube-system"},
+            "spec": {"holderIdentity": "h", "leaseDurationSeconds": 15,
+                     "renewTime": "2026-07-29T10:00:00.000000Z"},
+        })
+        assert lease.renew_time > 1.7e9

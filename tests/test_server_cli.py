@@ -1,0 +1,244 @@
+"""API server + client + CLI tests: the HTTP surface end to end."""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.cli.ktl import main as ktl_main
+from kubernetes_tpu.server import APIError, APIServer, Informer, RESTClient
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+@pytest.fixture()
+def server():
+    store = APIStore()
+    srv = APIServer(store).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient(server.url)
+
+
+class TestRESTServer:
+    def test_create_get_list_delete(self, server, client):
+        client.create("pods", {
+            "metadata": {"name": "web", "namespace": "default"},
+            "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "500m"}}}]},
+        })
+        got = client.get("pods", "web")
+        assert got["metadata"]["name"] == "web"
+        assert got["spec"]["containers"][0]["resources"]["requests"]["cpu"] == "500m"
+        items, rv = client.list("pods")
+        assert len(items) == 1 and rv > 0
+        client.delete("pods", "web")
+        with pytest.raises(APIError) as e:
+            client.get("pods", "web")
+        assert e.value.code == 404
+
+    def test_cluster_scoped_nodes(self, server, client):
+        client.create("nodes", {
+            "metadata": {"name": "n1"},
+            "status": {"capacity": {"cpu": "8", "memory": "32Gi", "pods": "110"}},
+        })
+        got = client.get("nodes", "n1", namespace=None)
+        assert got["status"]["allocatable"]["cpu"] == "8"
+
+    def test_binding_subresource(self, server, client):
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        client.bind("default", "p", "node-9")
+        assert client.get("pods", "p")["spec"]["nodeName"] == "node-9"
+        with pytest.raises(APIError) as e:
+            client.bind("default", "p", "node-2")
+        assert e.value.code == 409
+
+    def test_conflict_on_stale_update(self, server, client):
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        obj = client.get("pods", "p")
+        client.update("pods", obj)  # bumps rv
+        with pytest.raises(APIError) as e:
+            client.update("pods", obj)  # stale rv
+        assert e.value.code == 409
+
+    def test_watch_streams_events(self, server, client):
+        _, rv = client.list("pods")
+        events = []
+        import threading
+
+        def consume():
+            for etype, obj in client.watch("pods", since_rv=rv):
+                events.append((etype, obj["metadata"]["name"]))
+                if len(events) >= 2:
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        client.create("pods", {"metadata": {"name": "a"}, "spec": {"containers": [{"name": "c"}]}})
+        client.delete("pods", "a")
+        t.join(timeout=5)
+        assert events == [("ADDED", "a"), ("DELETED", "a")]
+
+    def test_healthz_and_metrics(self, server, client):
+        assert client.request("GET", "/healthz")["status"] == "ok"
+        import urllib.request
+
+        body = urllib.request.urlopen(server.url + "/metrics").read().decode()
+        assert "scheduler_schedule_attempts_total" in body
+
+    def test_informer_cache(self, server, client):
+        client.create("pods", {"metadata": {"name": "a"}, "spec": {"containers": [{"name": "c"}]}})
+        inf = Informer(client, "pods").start()
+        assert "default/a" in inf.cache
+        client.create("pods", {"metadata": {"name": "b"}, "spec": {"containers": [{"name": "c"}]}})
+        import time
+
+        deadline = time.time() + 5
+        while time.time() < deadline and "default/b" not in inf.cache:
+            time.sleep(0.05)
+        assert "default/b" in inf.cache
+        inf.stop()
+
+
+class TestCLI:
+    def run(self, server, *argv):
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = ktl_main(["--server", server.url, *argv])
+        return rc, buf.getvalue()
+
+    def test_create_from_manifest_and_get(self, server, tmp_path):
+        manifest = tmp_path / "pod.yaml"
+        manifest.write_text(json.dumps({
+            "kind": "Pod",
+            "metadata": {"name": "cli-pod"},
+            "spec": {"containers": [{"name": "c", "resources": {"requests": {"cpu": "1"}}}]},
+        }))
+        rc, out = self.run(server, "create", "-f", str(manifest))
+        assert rc == 0 and "pods/cli-pod created" in out
+        rc, out = self.run(server, "get", "pods")
+        assert rc == 0 and "cli-pod" in out and "<none>" in out
+
+    def test_apply_updates(self, server, tmp_path):
+        manifest = tmp_path / "rs.yaml"
+        doc = {
+            "kind": "ReplicaSet",
+            "metadata": {"name": "web"},
+            "spec": {"replicas": 2, "selector": {"matchLabels": {"app": "web"}},
+                     "template": {"metadata": {"labels": {"app": "web"}},
+                                  "spec": {"containers": [{"name": "c"}]}}},
+        }
+        manifest.write_text(json.dumps(doc))
+        rc, out = self.run(server, "apply", "-f", str(manifest))
+        assert rc == 0 and "created" in out
+        doc["spec"]["replicas"] = 5
+        manifest.write_text(json.dumps(doc))
+        rc, out = self.run(server, "apply", "-f", str(manifest))
+        assert rc == 0 and "configured" in out
+        rc, out = self.run(server, "get", "rs", "web", "-o", "json")
+        assert json.loads(out)["spec"]["replicas"] == 5
+
+    def test_scale(self, server, tmp_path):
+        manifest = tmp_path / "rs.json"
+        manifest.write_text(json.dumps({
+            "kind": "ReplicaSet", "metadata": {"name": "web"},
+            "spec": {"replicas": 1, "template": {"spec": {"containers": [{"name": "c"}]}}},
+        }))
+        self.run(server, "create", "-f", str(manifest))
+        rc, out = self.run(server, "scale", "rs", "web", "--replicas", "7")
+        assert rc == 0
+        rc, out = self.run(server, "get", "rs", "web", "-o", "json")
+        assert json.loads(out)["spec"]["replicas"] == 7
+
+    def test_cordon_taint_drain(self, server, client):
+        client.create("nodes", {"metadata": {"name": "n1"},
+                                "status": {"capacity": {"cpu": "8"}}})
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        client.bind("default", "p", "n1")
+        rc, _ = self.run(server, "taint", "nodes", "n1", "gpu=true:NoSchedule")
+        assert rc == 0
+        node = client.get("nodes", "n1", namespace=None)
+        assert node["spec"]["taints"] == [{"key": "gpu", "value": "true", "effect": "NoSchedule"}]
+        rc, out = self.run(server, "drain", "n1")
+        assert rc == 0 and "pod/p evicted" in out
+        node = client.get("nodes", "n1", namespace=None)
+        assert node["spec"]["unschedulable"] is True
+        with pytest.raises(APIError):
+            client.get("pods", "p")
+
+    def test_get_nodes_shows_status(self, server, client):
+        client.create("nodes", {"metadata": {"name": "n1"},
+                                "status": {"capacity": {"cpu": "8", "memory": "32Gi"}}})
+        rc, out = self.run(server, "get", "nodes")
+        assert rc == 0 and "n1" in out and "Ready" in out
+
+    def test_version_and_api_resources(self, server):
+        rc, out = self.run(server, "version")
+        assert rc == 0 and "kubernetes-tpu" in out
+        rc, out = self.run(server, "api-resources")
+        assert rc == 0 and "deployments" in out
+
+
+def test_serialization_roundtrip_via_server(server):
+    """Pod with every scheduling feature survives HTTP round-trip."""
+    client = RESTClient(server.url)
+    doc = {
+        "kind": "Pod",
+        "metadata": {"name": "full", "labels": {"app": "x"}},
+        "spec": {
+            "containers": [{"name": "c", "image": "img:1",
+                            "resources": {"requests": {"cpu": "500m", "memory": "1Gi"}},
+                            "ports": [{"containerPort": 80, "hostPort": 8080}]}],
+            "nodeSelector": {"disk": "ssd"},
+            "affinity": {
+                "nodeAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [{"matchExpressions": [
+                        {"key": "zone", "operator": "In", "values": ["a"]}]}]}},
+                "podAntiAffinity": {"requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"topologyKey": "kubernetes.io/hostname",
+                     "labelSelector": {"matchLabels": {"app": "x"}}}]},
+            },
+            "tolerations": [{"key": "k", "operator": "Exists", "effect": "NoSchedule"}],
+            "topologySpreadConstraints": [{
+                "maxSkew": 1, "topologyKey": "zone", "whenUnsatisfiable": "DoNotSchedule",
+                "labelSelector": {"matchLabels": {"app": "x"}}}],
+            "priority": 10,
+        },
+    }
+    client.create("pods", doc)
+    got = client.get("pods", "full")
+    assert got["spec"]["nodeSelector"] == {"disk": "ssd"}
+    assert got["spec"]["tolerations"][0]["operator"] == "Exists"
+    assert got["spec"]["topologySpreadConstraints"][0]["maxSkew"] == 1
+    aff = got["spec"]["affinity"]
+    assert aff["nodeAffinity"]["requiredDuringSchedulingIgnoredDuringExecution"][
+        "nodeSelectorTerms"][0]["matchExpressions"][0]["values"] == ["a"]
+    assert aff["podAntiAffinity"]["requiredDuringSchedulingIgnoredDuringExecution"][0][
+        "topologyKey"] == "kubernetes.io/hostname"
+    # and it re-parses into an equivalent Pod
+    from kubernetes_tpu.api import Pod
+
+    pod = Pod.from_dict(got)
+    assert pod.spec.affinity.pod_anti_affinity_required[0].topology_key == "kubernetes.io/hostname"
+    assert pod.spec.priority == 10
+
+
+def test_put_honors_url_namespace(server, client):
+    client.create("pods", {"metadata": {"name": "web", "namespace": "prod"},
+                           "spec": {"containers": [{"name": "c"}]}}, namespace="prod")
+    obj = client.get("pods", "web", "prod")
+    del obj["metadata"]["namespace"]  # body omits ns; URL must win
+    obj["metadata"]["labels"] = {"touched": "yes"}
+    client.update("pods", obj, namespace="prod")
+    assert client.get("pods", "web", "prod")["metadata"]["labels"] == {"touched": "yes"}
+    with pytest.raises(APIError) as e:
+        client.get("pods", "web", "default")
+    assert e.value.code == 404
