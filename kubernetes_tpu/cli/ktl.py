@@ -15,7 +15,12 @@ import os
 import sys
 from typing import Dict, List, Optional
 
-from ..api.serialize import GROUP_PREFIX, KIND_TO_RESOURCE, RESOURCE_TO_TYPE
+from ..api.serialize import (
+    CLUSTER_SCOPED,
+    GROUP_PREFIX,
+    KIND_TO_RESOURCE,
+    RESOURCE_TO_TYPE,
+)
 from ..server.client import APIError, RESTClient
 
 ALIASES = {
@@ -70,7 +75,7 @@ def fmt_table(headers: List[str], rows: List[List[str]]) -> str:
 
 def cmd_get(client: RESTClient, args) -> int:
     resource = resolve_resource(args.resource)
-    ns = None if resource in ("nodes", "namespaces") else (args.namespace or "default")
+    ns = None if resource in CLUSTER_SCOPED else (args.namespace or "default")
     if args.name:
         obj = client.get(resource, args.name, ns)
         if args.output == "json":
@@ -147,7 +152,7 @@ def cmd_create(client: RESTClient, args) -> int:
             continue
         ns = args.namespace or (doc.get("metadata") or {}).get("namespace") or "default"
         try:
-            out = client.create(resource, doc, None if resource in ("nodes", "namespaces") else ns)
+            out = client.create(resource, doc, None if resource in CLUSTER_SCOPED else ns)
             print(f"{resource}/{out['metadata']['name']} created")
         except APIError as e:
             print(f"error: {e}", file=sys.stderr)
@@ -166,7 +171,7 @@ def cmd_apply(client: RESTClient, args) -> int:
             continue
         meta = doc.get("metadata") or {}
         ns = args.namespace or meta.get("namespace") or "default"
-        ns_arg = None if resource in ("nodes", "namespaces") else ns
+        ns_arg = None if resource in CLUSTER_SCOPED else ns
         try:
             try:
                 current = client.get(resource, meta["name"], ns_arg)
@@ -187,7 +192,7 @@ def cmd_apply(client: RESTClient, args) -> int:
 
 def cmd_delete(client: RESTClient, args) -> int:
     resource = resolve_resource(args.resource)
-    ns = None if resource in ("nodes", "namespaces") else (args.namespace or "default")
+    ns = None if resource in CLUSTER_SCOPED else (args.namespace or "default")
     try:
         client.delete(resource, args.name, ns)
         print(f"{resource}/{args.name} deleted")
@@ -261,7 +266,7 @@ def cmd_drain(client: RESTClient, args) -> int:
 
 def cmd_describe(client: RESTClient, args) -> int:
     resource = resolve_resource(args.resource)
-    ns = None if resource in ("nodes", "namespaces") else (args.namespace or "default")
+    ns = None if resource in CLUSTER_SCOPED else (args.namespace or "default")
     obj = client.get(resource, args.name, ns)
     _print_yaml(obj)
     return 0

@@ -181,6 +181,26 @@ def pts_domain_valid(aff_row, topo_row, d_max):
     return jax.ops.segment_max(has, seg, num_segments=d_max + 1)[:d_max] > 0
 
 
+def pod_row_feasibility_score(inp: SolverInputs, req, req_nz, cls, bal_active):
+    """F[N], C[N] for one pod against the *initial* snapshot state (no
+    intra-batch dynamics): the shared row formula for the extender surface,
+    the 2D-sharded F/C kernel, and the group-level transport solvers. Score
+    composition = default weights (default_plugins.go:30) minus the dynamic
+    PTS/IPA terms (callers route those batches to the scan solver)."""
+    cls = jnp.maximum(cls, 0)
+    feas = inp.filter_ok[cls]
+    feas &= fit_feasible(inp.alloc, inp.used, inp.pod_count, inp.max_pods, req)
+    feas &= ~jnp.any(inp.node_ports & inp.class_ports[cls][None, :], axis=1)
+    alloc2 = inp.alloc[:, :2]
+    least = least_allocated_score(alloc2, inp.used_nz[:, :2], req_nz[:2])
+    bal = balanced_score(alloc2, inp.used[:, :2], req[:2], bal_active)
+    napref = jnp.where(inp.has_napref[cls],
+                       default_normalize(inp.napref_raw[cls], feas, reverse=False), 0)
+    taint = default_normalize(inp.taint_cnt[cls], feas, reverse=True)
+    total = least + bal + 2 * napref + 3 * taint + inp.img_score[cls]
+    return feas, total
+
+
 # ---------------------------------------------------------------------------
 # the greedy scan solver
 # ---------------------------------------------------------------------------

@@ -99,26 +99,10 @@ def feasibility_cost_matrices(inp: SolverInputs, d_max: int):
     HostPriority lists, reference: extender/v1/types.go) and the 2D (dp x nodes)
     sharded kernel. Scores use the same default-weight composition as the
     solver."""
-    from ..ops.solver import (
-        balanced_score,
-        default_normalize,
-        fit_feasible,
-        least_allocated_score,
-    )
+    from ..ops.solver import pod_row_feasibility_score
 
     def per_pod(req, req_nz, cls, bal_active):
-        cls = jnp.maximum(cls, 0)
-        feas = inp.filter_ok[cls]
-        feas &= fit_feasible(inp.alloc, inp.used, inp.pod_count, inp.max_pods, req)
-        feas &= ~jnp.any(inp.node_ports & inp.class_ports[cls][None, :], axis=1)
-        alloc2 = inp.alloc[:, :2]
-        least = least_allocated_score(alloc2, inp.used_nz[:, :2], req_nz[:2])
-        bal = balanced_score(alloc2, inp.used[:, :2], req[:2], bal_active)
-        napref = jnp.where(inp.has_napref[cls],
-                           default_normalize(inp.napref_raw[cls], feas, reverse=False), 0)
-        taint = default_normalize(inp.taint_cnt[cls], feas, reverse=True)
-        total = least + bal + 2 * napref + 3 * taint + inp.img_score[cls]
-        return feas, total
+        return pod_row_feasibility_score(inp, req, req_nz, cls, bal_active)
 
     return jax.vmap(per_pod)(inp.req, inp.req_nz, inp.class_of_pod, inp.balanced_active)
 

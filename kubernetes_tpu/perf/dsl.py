@@ -84,6 +84,7 @@ class WorkloadRunner:
         )
         self._synced = False
         self._pod_seq = 0
+        self._node_seq = 0
 
     def run(self, workload: Dict) -> WorkloadResult:
         result = WorkloadResult(
@@ -105,7 +106,8 @@ class WorkloadRunner:
             template = op.get("nodeTemplate", DEFAULT_NODE)
             zones = op.get("zones", 0)
             for i in range(op["count"]):
-                d = _fill(template, i)
+                d = _fill(template, self._node_seq)
+                self._node_seq += 1
                 if zones:
                     d.setdefault("metadata", {}).setdefault("labels", {})[
                         "topology.kubernetes.io/zone"] = f"zone-{i % zones}"

@@ -27,8 +27,9 @@ from .serial import Scheduler
 
 class BatchScheduler(Scheduler):
     """solver: 'exact' (scan, bit-parity with serial), 'fast' (water-filling),
-    or 'auto' (fast when the batch has no topology-spread constraints, exact
-    otherwise)."""
+    'auction' / 'sinkhorn' (global transportation solvers with warm-started
+    duals — models/transport.py), or 'auto' (fast when the batch has no
+    topology-spread constraints, exact otherwise)."""
 
     def __init__(self, store: APIStore, framework: Framework, batch_size: int = 4096,
                  solver: str = "exact", **kw):
@@ -36,15 +37,21 @@ class BatchScheduler(Scheduler):
         self.batch_size = batch_size
         self.solver = solver
         self.batches_solved = 0
+        self.transport_state = None  # warm duals carried across batches
 
     def schedule_batch(self, timeout: Optional[float] = 0.0) -> int:
         """Drain up to batch_size pods, solve jointly, bind. Returns #pods handled."""
+        import time
+
         from ..ops.solver import greedy_scan_solve, make_inputs
+        from ..server import metrics as m
 
         self.pump_events()
         qps = self.queue.pop_batch(self.batch_size, timeout=timeout)
         if not qps:
             return 0
+        t_batch = time.perf_counter()
+        m.batch_size_gauge.set(len(qps))
         snapshot = self.cache.update_snapshot()
         if len(snapshot) == 0:
             for qp in qps:
@@ -65,11 +72,20 @@ class BatchScheduler(Scheduler):
             # 'fast' means fast-when-legal: the water-fill kernel has no
             # topology-spread handling, so constrained batches always take the
             # exact scan path regardless of mode.
-            use_fast = (
-                self.solver in ("fast", "auto")
-                and batch.ct_class.size == 0 and batch.st_class.size == 0
-            )
+            constraint_free = batch.ct_class.size == 0 and batch.st_class.size == 0
+            use_fast = self.solver in ("fast", "auto") and constraint_free
+            use_transport = self.solver in ("auction", "sinkhorn") and constraint_free
             assignment = None
+            if use_transport:
+                from ..models.transport import transport_solve
+                from ..models.waterfill import make_groups
+
+                solved = transport_solve(
+                    inputs, make_groups(sub), method=self.solver,
+                    state=self.transport_state, node_names=cluster.node_names,
+                )
+                if solved is not None:
+                    assignment, self.transport_state = solved
             if use_fast:
                 from ..models.waterfill import make_groups, waterfill_solve
 
@@ -98,6 +114,7 @@ class BatchScheduler(Scheduler):
             self._serial_one(qps[pi])
 
         self.batches_solved += 1
+        m.batch_solve_duration.observe(time.perf_counter() - t_batch)
         return len(qps)
 
     def _bind_assignment(self, qp: QueuedPodInfo, node_name: str) -> None:

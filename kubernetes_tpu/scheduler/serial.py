@@ -251,12 +251,22 @@ class Scheduler:
 
     def schedule_one(self, timeout: Optional[float] = 0.1) -> bool:
         """One ScheduleOne iteration. Returns False when no pod was popped."""
+        from ..server import metrics as m
+
         self.pump_events()
         qp = self.queue.pop(timeout=timeout)
         if qp is None:
             return False
+        t0 = time.perf_counter()
         pod = qp.pod
         result = self.schedule_pod(pod)
+        m.scheduling_attempts.inc(
+            result="scheduled" if result.suggested_host else "unschedulable")
+        m.scheduling_attempt_duration.observe(time.perf_counter() - t0)
+        active, backoff, unsched = self.queue.lengths()
+        m.pending_pods.set(active, queue="active")
+        m.pending_pods.set(backoff, queue="backoff")
+        m.pending_pods.set(unsched, queue="unschedulable")
         if not result.suggested_host:
             self._maybe_preempt(qp, result)
             self._handle_failure(qp, result.status)

@@ -123,6 +123,11 @@ class Informer:
                     for etype, obj_dict in self.client.watch(self.resource, since_rv=rv):
                         if self._stop.is_set():
                             return
+                        if etype == "BOOKMARK":
+                            # rv checkpoint only (reflector.go:156) — no object
+                            rv = int((obj_dict.get("metadata") or {}).get(
+                                "resourceVersion", rv))
+                            continue
                         obj = from_dict(self.resource, obj_dict)
                         key = self._key(obj_dict)
                         rv = int((obj_dict.get("metadata") or {}).get("resourceVersion", rv))
@@ -144,8 +149,19 @@ class Informer:
                     try:
                         items, rv = self.client.list(self.resource)
                         fresh = {self._key(it): from_dict(self.resource, it) for it in items}
+                        # synthetic deltas for changes missed during the outage
+                        # (informers emit ADDED/MODIFIED/DELETED on cache
+                        # replace — tools/cache shared_informer semantics)
+                        old = dict(self.cache)
                         self.cache.clear()
                         self.cache.update(fresh)
+                        if self.on_event:
+                            for k in set(old) - set(fresh):
+                                self.on_event("DELETED", old[k])
+                            for k in set(fresh) - set(old):
+                                self.on_event("ADDED", fresh[k])
+                            for k in set(fresh) & set(old):
+                                self.on_event("MODIFIED", fresh[k])
                     except Exception:
                         pass  # server unreachable: retry the whole cycle
 

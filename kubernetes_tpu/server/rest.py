@@ -144,12 +144,27 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         try:
+            idle = 0.0
             while True:
                 ev = w.get(timeout=1.0)
                 if ev is None:
                     if self.server.shutting_down:  # type: ignore[attr-defined]
                         break
+                    # periodic BOOKMARK on quiet streams (reflector.go:156
+                    # bookmark events): doubles as a liveness probe so a dead
+                    # client fails the write and the watch thread is reaped
+                    # instead of leaking in store._watchers forever.
+                    idle += 1.0
+                    if idle >= 5.0:
+                        idle = 0.0
+                        line = json.dumps(
+                            {"type": "BOOKMARK",
+                             "object": {"metadata": {"resourceVersion": str(self.store.rv)}}}
+                        ).encode() + b"\n"
+                        self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                        self.wfile.flush()
                     continue
+                idle = 0.0
                 if ns and getattr(ev.obj.metadata, "namespace", "") != ns:
                     continue
                 line = json.dumps({"type": ev.type, "object": to_dict(ev.obj)}).encode() + b"\n"

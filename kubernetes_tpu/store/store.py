@@ -109,7 +109,7 @@ class APIStore:
 
     def __init__(self, deep_copy_on_write: bool = True):
         self._lock = threading.RLock()
-        self._rv = 0
+        self._rv = 0  # monotonic resourceVersion, read via .rv
         # kind -> {"namespace/name" or "name": obj}
         self._objects: Dict[str, Dict[str, Any]] = {}
         # bounded event history for watch replay (RV-ordered)
@@ -121,6 +121,12 @@ class APIStore:
         self._deep_copy = deep_copy_on_write
 
     # -- helpers ---------------------------------------------------------------
+
+    @property
+    def rv(self) -> int:
+        """Current (highest committed) resourceVersion."""
+        with self._lock:
+            return self._rv
 
     @staticmethod
     def object_key(obj) -> str:

@@ -1,0 +1,222 @@
+"""Auction/Sinkhorn transportation solvers: feasibility always holds, capacity
+is never violated, utility is near the greedy scan's, and warm-started duals
+carry across churn (the incremental re-solve path)."""
+
+import numpy as np
+
+from kubernetes_tpu.models.transport import (
+    assignment_from_plan,
+    auction_solve,
+    build_group_problem,
+    repair_plan,
+    round_plan,
+    sinkhorn_solve,
+    transport_solve,
+)
+from kubernetes_tpu.models.waterfill import make_groups
+from kubernetes_tpu.ops.solver import greedy_scan_solve, make_inputs
+from kubernetes_tpu.scheduler import Cache, Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors, build_pod_batch
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+from kubernetes_tpu.utils import FakeClock
+
+
+def problem_inputs(nodes, pods):
+    cache = Cache(clock=FakeClock())
+    for n in nodes:
+        cache.add_node(n)
+    snap = cache.update_snapshot()
+    cluster = build_cluster_tensors(snap)
+    batch = build_pod_batch(pods, snap, cluster)
+    inputs, d_max = make_inputs(cluster, batch)
+    return inputs, d_max, cluster, batch
+
+
+def check_valid(inputs, assignment):
+    """No capacity/pod-count violation under exact integer arithmetic."""
+    a = np.asarray(assignment)
+    alloc = np.asarray(inputs.alloc, np.int64)
+    used = np.asarray(inputs.used, np.int64).copy()
+    cnt = np.asarray(inputs.pod_count, np.int64).copy()
+    maxp = np.asarray(inputs.max_pods, np.int64)
+    req = np.asarray(inputs.req, np.int64)
+    for p, n in enumerate(a):
+        if n < 0:
+            continue
+        used[n] += req[p]
+        cnt[n] += 1
+    assert (used <= alloc).all(), "resource over-commit"
+    assert (cnt <= maxp).all(), "pod-count over-commit"
+
+
+def total_utility(inputs, d_max, assignment):
+    from kubernetes_tpu.parallel.sharded import feasibility_cost_matrices
+
+    f, c = feasibility_cost_matrices(inputs, d_max)
+    c = np.asarray(c)
+    a = np.asarray(assignment)
+    return sum(int(c[p, n]) for p, n in enumerate(a) if n >= 0)
+
+
+def make_cluster(n_nodes=12, cpu="8", mem="16Gi"):
+    return [
+        MakeNode(f"n{i}").capacity({"cpu": cpu, "memory": mem, "pods": "110"}).obj()
+        for i in range(n_nodes)
+    ]
+
+
+def test_auction_places_all_when_capacity_ample():
+    nodes = make_cluster()
+    pods = [MakePod(f"p{i}").req({"cpu": "1", "memory": "2Gi"}).obj() for i in range(30)]
+    inputs, d_max, cluster, batch = problem_inputs(nodes, pods)
+    out = transport_solve(inputs, make_groups(batch), method="auction",
+                          node_names=cluster.node_names)
+    assert out is not None
+    a, state = out
+    assert (a >= 0).all()
+    check_valid(inputs, a)
+    assert state.iterations > 0
+
+
+def test_auction_utility_close_to_greedy():
+    nodes = make_cluster(8)
+    pods = [MakePod(f"a{i}").req({"cpu": "2", "memory": "4Gi"}).obj() for i in range(8)]
+    pods += [MakePod(f"b{i}").req({"cpu": "1", "memory": "1Gi"}).obj() for i in range(12)]
+    inputs, d_max, cluster, batch = problem_inputs(nodes, pods)
+    scan, _, _ = greedy_scan_solve(inputs, d_max)
+    a, _ = transport_solve(inputs, make_groups(batch), method="auction",
+                           node_names=cluster.node_names)
+    check_valid(inputs, a)
+    assert (a >= 0).sum() == (np.asarray(scan) >= 0).sum()
+    # joint objective (initial-state utility) should be at least greedy's
+    assert total_utility(inputs, d_max, a) >= 0.95 * total_utility(inputs, d_max, scan)
+
+
+def test_auction_respects_scarce_capacity():
+    nodes = [MakeNode(f"n{i}").capacity({"cpu": "2", "pods": "110"}).obj() for i in range(3)]
+    pods = [MakePod(f"p{i}").req({"cpu": "1500m"}).obj() for i in range(6)]
+    inputs, d_max, cluster, batch = problem_inputs(nodes, pods)
+    a, _ = transport_solve(inputs, make_groups(batch), method="auction",
+                           node_names=cluster.node_names)
+    check_valid(inputs, a)
+    assert (a >= 0).sum() == 3  # one 1500m pod per 2-cpu node
+
+
+def test_sinkhorn_places_and_respects_capacity():
+    nodes = make_cluster(6, cpu="4", mem="8Gi")
+    pods = [MakePod(f"p{i}").req({"cpu": "1", "memory": "2Gi"}).obj() for i in range(20)]
+    inputs, d_max, cluster, batch = problem_inputs(nodes, pods)
+    a, state = transport_solve(inputs, make_groups(batch), method="sinkhorn",
+                               node_names=cluster.node_names)
+    check_valid(inputs, a)
+    # 6 nodes x 4 cpu = 24 slots of 1cpu, but memory caps at 4/node = 24; all fit
+    assert (a >= 0).sum() == 20
+
+
+def test_heterogeneous_node_selector_groups():
+    nodes = []
+    for i in range(6):
+        nodes.append(MakeNode(f"n{i}").labels({"disk": "ssd" if i % 2 == 0 else "hdd"})
+                     .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj())
+    pods = [MakePod(f"ssd{i}").node_selector({"disk": "ssd"}).req({"cpu": "1"}).obj()
+            for i in range(6)]
+    pods += [MakePod(f"any{i}").req({"cpu": "500m", "memory": "1Gi"}).obj() for i in range(8)]
+    inputs, d_max, cluster, batch = problem_inputs(nodes, pods)
+    for method in ("auction", "sinkhorn"):
+        a, _ = transport_solve(inputs, make_groups(batch), method=method,
+                               node_names=cluster.node_names)
+        check_valid(inputs, a)
+        for j in range(6):  # ssd pods only on even nodes
+            assert a[j] >= 0 and a[j] % 2 == 0, (method, j, a[j])
+        assert (a >= 0).all()
+
+
+def test_warm_start_carries_prices_across_churn():
+    nodes = make_cluster(10)
+    pods = [MakePod(f"p{i}").req({"cpu": "1", "memory": "2Gi"}).obj() for i in range(20)]
+    inputs, d_max, cluster, batch = problem_inputs(nodes, pods)
+    problem = build_group_problem(inputs, make_groups(batch))
+    _, cold = auction_solve(problem, node_names=cluster.node_names)
+
+    # churn: drop two nodes, add three new ones; same pod batch
+    nodes2 = nodes[2:] + make_cluster(3, cpu="16")[:3]
+    for i, n in enumerate(nodes2[-3:]):
+        n.metadata.name = f"new{i}"
+    inputs2, d2, cluster2, batch2 = problem_inputs(nodes2, pods)
+    problem2 = build_group_problem(inputs2, make_groups(batch2))
+    x_warm, warm = auction_solve(problem2, state=cold, node_names=cluster2.node_names)
+    x2 = repair_plan(problem2, x_warm)
+    a = assignment_from_plan(problem2, x2, len(pods))
+    check_valid(inputs2, a)
+    assert (a >= 0).all()
+    # price vector remapped by name: surviving nodes keep non-negative prices
+    assert warm.price.shape == (len(nodes2),)
+
+
+def test_round_plan_respects_caps():
+    nodes = make_cluster(4, cpu="3")
+    pods = [MakePod(f"p{i}").req({"cpu": "1"}).obj() for i in range(12)]
+    inputs, d_max, cluster, batch = problem_inputs(nodes, pods)
+    problem = build_group_problem(inputs, make_groups(batch))
+    frac, _ = sinkhorn_solve(problem, node_names=cluster.node_names)
+    x = round_plan(problem, frac)
+    assert (x.sum(axis=0) <= np.asarray(problem.slots)).all()
+    assert (x <= np.asarray(problem.jcap)).all()
+    x = repair_plan(problem, x)
+    a = assignment_from_plan(problem, x, len(pods))
+    check_valid(inputs, a)
+
+
+def test_batch_scheduler_auction_end_to_end():
+    store = APIStore()
+    for i in range(8):
+        store.create("nodes", MakeNode(f"n{i}")
+                     .capacity({"cpu": "8", "memory": "16Gi", "pods": "110"}).obj())
+    for i in range(24):
+        store.create("pods", MakePod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+    sched = BatchScheduler(store, Framework(default_plugins()), solver="auction",
+                           clock=FakeClock())
+    sched.sync()
+    sched.run_until_idle()
+    bound = [p for p in store.list("pods")[0] if p.spec.node_name]
+    assert len(bound) == 24
+    assert sched.transport_state is not None  # duals retained for next batch
+
+
+def test_batch_scheduler_sinkhorn_end_to_end():
+    store = APIStore()
+    for i in range(6):
+        store.create("nodes", MakeNode(f"n{i}")
+                     .capacity({"cpu": "4", "memory": "8Gi", "pods": "110"}).obj())
+    for i in range(12):
+        store.create("pods", MakePod(f"p{i}").req({"cpu": "1", "memory": "1Gi"}).obj())
+    sched = BatchScheduler(store, Framework(default_plugins()), solver="sinkhorn",
+                           clock=FakeClock())
+    sched.sync()
+    sched.run_until_idle()
+    bound = [p for p in store.list("pods")[0] if p.spec.node_name]
+    assert len(bound) == 12
+
+
+def test_host_ports_fall_back_from_transport():
+    """Classes with host ports aren't transport-eligible; build returns None
+    and the batch driver falls through to the scan solver."""
+    nodes = make_cluster(4)
+    pods = [MakePod(f"p{i}").req({"cpu": "1"}, host_port=8080).obj() for i in range(4)]
+    inputs, d_max, cluster, batch = problem_inputs(nodes, pods)
+    assert build_group_problem(inputs, make_groups(batch)) is None
+    store = APIStore()
+    for n in make_cluster(4):
+        store.create("nodes", n)
+    for i in range(4):
+        store.create("pods", MakePod(f"p{i}").req({"cpu": "1"}, host_port=8080).obj())
+    sched = BatchScheduler(store, Framework(default_plugins()), solver="auction",
+                           clock=FakeClock())
+    sched.sync()
+    sched.run_until_idle()
+    bound = [p for p in store.list("pods")[0] if p.spec.node_name]
+    assert len(bound) == 4
+    assert len({p.spec.node_name for p in bound}) == 4  # one per node (port)
