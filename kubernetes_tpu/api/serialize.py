@@ -26,6 +26,7 @@ from .types import (
     TopologySpreadConstraint,
     WeightedPodAffinityTerm,
 )
+from .storage import CSINode, PersistentVolume, PersistentVolumeClaim, StorageClass
 from .workloads import Deployment, Lease, ReplicaSet
 
 KIND_TO_RESOURCE = {
@@ -35,6 +36,10 @@ KIND_TO_RESOURCE = {
     "ReplicaSet": "replicasets",
     "Deployment": "deployments",
     "Lease": "leases",
+    "PersistentVolume": "persistentvolumes",
+    "PersistentVolumeClaim": "persistentvolumeclaims",
+    "StorageClass": "storageclasses",
+    "CSINode": "csinodes",
 }
 RESOURCE_TO_TYPE = {
     "pods": Pod,
@@ -43,8 +48,12 @@ RESOURCE_TO_TYPE = {
     "replicasets": ReplicaSet,
     "deployments": Deployment,
     "leases": Lease,
+    "persistentvolumes": PersistentVolume,
+    "persistentvolumeclaims": PersistentVolumeClaim,
+    "storageclasses": StorageClass,
+    "csinodes": CSINode,
 }
-CLUSTER_SCOPED = {"nodes", "namespaces"}
+CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses", "csinodes"}
 GROUP_PREFIX = {
     "pods": "/api/v1",
     "nodes": "/api/v1",
@@ -52,6 +61,10 @@ GROUP_PREFIX = {
     "replicasets": "/apis/apps/v1",
     "deployments": "/apis/apps/v1",
     "leases": "/apis/coordination.k8s.io/v1",
+    "persistentvolumes": "/api/v1",
+    "persistentvolumeclaims": "/api/v1",
+    "storageclasses": "/apis/storage.k8s.io/v1",
+    "csinodes": "/apis/storage.k8s.io/v1",
 }
 
 
@@ -179,6 +192,8 @@ def pod_to_dict(pod: Pod) -> Dict:
         spec["schedulingGates"] = [{"name": g} for g in pod.spec.scheduling_gates]
     if pod.spec.overhead:
         spec["overhead"] = pod.spec.overhead
+    if pod.spec.volumes:
+        spec["volumes"] = [v.to_dict() for v in pod.spec.volumes]
     status: Dict[str, Any] = {"phase": pod.status.phase}
     if pod.status.nominated_node_name:
         status["nominatedNodeName"] = pod.status.nominated_node_name
@@ -301,5 +316,7 @@ _SERIALIZERS = {
 def to_dict(obj: Any) -> Dict:
     fn = _SERIALIZERS.get(type(obj))
     if fn is None:
+        if hasattr(obj, "to_dict"):
+            return obj.to_dict()
         raise ValueError(f"cannot serialize {type(obj).__name__}")
     return fn(obj)

@@ -154,6 +154,66 @@ class Container:
 
 
 @dataclass(frozen=True)
+class Volume:
+    """Pod volume source (reference: staging/src/k8s.io/api/core/v1/types.go Volume;
+    only the sources the scheduler inspects: PVC references and the shared-disk
+    sources VolumeRestrictions checks for conflicts)."""
+
+    name: str
+    pvc_claim_name: str = ""  # persistentVolumeClaim.claimName
+    pvc_read_only: bool = False
+    gce_pd: str = ""  # gcePersistentDisk.pdName
+    gce_read_only: bool = False
+    aws_ebs: str = ""  # awsElasticBlockStore.volumeID
+    rbd: str = ""  # rbd.image
+    rbd_read_only: bool = False
+    iscsi: str = ""  # iscsi "iqn/lun"
+    iscsi_read_only: bool = False
+    ephemeral: bool = False  # ephemeral.volumeClaimTemplate (claim name = pod-volname)
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Volume":
+        pvc = d.get("persistentVolumeClaim") or {}
+        gce = d.get("gcePersistentDisk") or {}
+        ebs = d.get("awsElasticBlockStore") or {}
+        rbd = d.get("rbd") or {}
+        iscsi = d.get("iscsi") or {}
+        return Volume(
+            name=d.get("name", ""),
+            pvc_claim_name=pvc.get("claimName", ""),
+            pvc_read_only=bool(pvc.get("readOnly", False)),
+            gce_pd=gce.get("pdName", ""),
+            gce_read_only=bool(gce.get("readOnly", False)),
+            aws_ebs=ebs.get("volumeID", ""),
+            rbd=rbd.get("image", ""),
+            rbd_read_only=bool(rbd.get("readOnly", False)),
+            iscsi=(f"{iscsi.get('iqn', '')}/{iscsi.get('lun', 0)}" if iscsi else ""),
+            iscsi_read_only=bool(iscsi.get("readOnly", False)),
+            ephemeral="ephemeral" in d,
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"name": self.name}
+        if self.pvc_claim_name:
+            d["persistentVolumeClaim"] = {"claimName": self.pvc_claim_name,
+                                          **({"readOnly": True} if self.pvc_read_only else {})}
+        if self.gce_pd:
+            d["gcePersistentDisk"] = {"pdName": self.gce_pd,
+                                      **({"readOnly": True} if self.gce_read_only else {})}
+        if self.aws_ebs:
+            d["awsElasticBlockStore"] = {"volumeID": self.aws_ebs}
+        if self.rbd:
+            d["rbd"] = {"image": self.rbd, **({"readOnly": True} if self.rbd_read_only else {})}
+        if self.iscsi:
+            iqn, _, lun = self.iscsi.rpartition("/")
+            d["iscsi"] = {"iqn": iqn, "lun": int(lun or 0),
+                          **({"readOnly": True} if self.iscsi_read_only else {})}
+        if self.ephemeral:
+            d["ephemeral"] = {"volumeClaimTemplate": {}}
+        return d
+
+
+@dataclass(frozen=True)
 class Toleration:
     """reference: staging/src/k8s.io/api/core/v1/types.go Toleration."""
 
@@ -326,6 +386,7 @@ class PodSpec:
     host_network: bool = False
     restart_policy: str = "Always"
     termination_grace_period_seconds: int = 30
+    volumes: List[Volume] = field(default_factory=list)
 
     @staticmethod
     def from_dict(d: Mapping) -> "PodSpec":
@@ -349,6 +410,7 @@ class PodSpec:
             host_network=bool(d.get("hostNetwork", False)),
             restart_policy=d.get("restartPolicy", "Always"),
             termination_grace_period_seconds=int(d.get("terminationGracePeriodSeconds", 30) or 30),
+            volumes=[Volume.from_dict(v) for v in d.get("volumes") or []],
         )
 
 

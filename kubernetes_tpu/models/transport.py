@@ -259,8 +259,6 @@ def auction_solve(
     eps = eps_start if eps_start is not None else max(util_range / 8.0, eps_final)
     price = jnp.asarray(price0)
     free = problem.alloc - problem.used
-    x = jnp.zeros((g, n), jnp.int32)
-    level = jnp.full((g, n), NEG_INF)
     total_rounds = 0
     while True:
         x, price, level, rounds = _auction_phase(

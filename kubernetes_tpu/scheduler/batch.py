@@ -138,7 +138,9 @@ class BatchScheduler(Scheduler):
             self._maybe_preempt(qp, result)
             self._handle_failure(qp, result.status)
             return
-        self._bind_assignment(qp, result.suggested_host)
+        # Full commit chain (Reserve/Permit/PreBind/PostBind) — fallback pods
+        # (volumes, inter-pod affinity) depend on those extension points.
+        self._commit_cycle(qp, result)
 
     def run_until_idle(self, max_cycles: int = 10_000) -> int:
         n = 0

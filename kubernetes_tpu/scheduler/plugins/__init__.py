@@ -1,7 +1,6 @@
 """Serial plugin implementations — the correctness oracle for the TPU path.
 
-Default enabled set mirrors apis/config/v1/default_plugins.go:30-56 (minus the
-volume plugins, which gate on a volume subsystem this build adds later).
+Default enabled set mirrors apis/config/v1/default_plugins.go:30-56.
 """
 
 from .default_preemption import DefaultPreemption  # noqa: F401
@@ -18,10 +17,18 @@ from .node_plugins import (  # noqa: F401
     TaintToleration,
 )
 from .topology_spread import PodTopologySpread  # noqa: F401
+from .volume import (  # noqa: F401
+    NodeVolumeLimits,
+    VolumeBinding,
+    VolumeLister,
+    VolumeRestrictions,
+    VolumeZone,
+)
 
 
-def default_plugins():
+def default_plugins(volume_lister=None):
     """Registry + default ordering (plugins/registry.go:64, default_plugins.go:30)."""
+    vl = volume_lister if volume_lister is not None else VolumeLister()
     return [
         PrioritySort(),
         SchedulingGates(),
@@ -31,6 +38,10 @@ def default_plugins():
         NodeAffinity(),
         NodePorts(),
         NodeResourcesFit(),
+        VolumeRestrictions(vl),
+        NodeVolumeLimits(vl),
+        VolumeBinding(vl),
+        VolumeZone(vl),
         PodTopologySpread(),
         InterPodAffinity(),
         BalancedAllocation(),

@@ -27,6 +27,10 @@ from .framework import CycleState, NodeInfo, Snapshot, Status
 from .queue import QueuedPodInfo, SchedulingQueue
 from .runtime import Framework
 
+# storage kinds mirrored into the volume plugins' VolumeLister handles
+STORAGE_KINDS = ("persistentvolumeclaims", "persistentvolumes",
+                 "storageclasses", "csinodes")
+
 MIN_FEASIBLE_NODES_TO_FIND = 100  # schedule_one.go:52
 MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # schedule_one.go:57
 
@@ -92,6 +96,15 @@ class Scheduler:
         for p in framework.plugins:
             if hasattr(p, "set_handles"):
                 p.set_handles(framework, store)
+        # volume plugins share VolumeLister handles fed from the store's
+        # storage kinds (the reference reaches these via shared informers)
+        self._volume_listers = []
+        seen = set()
+        for p in framework.plugins:
+            lister = getattr(p, "lister", None)
+            if lister is not None and id(lister) not in seen and hasattr(lister, "add"):
+                seen.add(id(lister))
+                self._volume_listers.append(lister)
 
     # -- informer-equivalent event handling (eventhandlers.go:364) -------------
 
@@ -99,13 +112,18 @@ class Scheduler:
         """Initial LIST: populate cache with nodes + bound pods, queue with
         pending pods; then start WATCH from that RV. All kinds are listed under
         one consistent RV so no event can fall between list and watch."""
-        lists, rv = self.store.list_many(("nodes", "pods", "namespaces"))
+        lists, rv = self.store.list_many(
+            ("nodes", "pods", "namespaces") + STORAGE_KINDS)
         for n in lists["nodes"]:
             self.cache.add_node(n)
         for p in lists["pods"]:
             self._handle_pod(ADDED, p)
         for ns in lists["namespaces"]:
             self._ns_labels[ns.metadata.name] = dict(ns.metadata.labels)
+        for kind in STORAGE_KINDS:
+            for obj in lists[kind]:
+                for lister in self._volume_listers:
+                    lister.add(obj)
         self._push_ns_labels()
         self._watch = self.store.watch(since_rv=rv)
 
@@ -138,6 +156,14 @@ class Scheduler:
             self._handle_pod(ev.type, ev.obj)
         elif ev.kind == "namespaces":
             self._ns_labels[ev.obj.metadata.name] = dict(ev.obj.metadata.labels)
+        elif ev.kind in STORAGE_KINDS:
+            for lister in self._volume_listers:
+                if ev.type == DELETED:
+                    lister.remove(ev.obj)
+                else:
+                    lister.add(ev.obj)
+            # a new/changed PV or class can unblock pending claims
+            self.queue.move_all_to_active_or_backoff()
 
     def _handle_pod(self, etype: str, pod: Pod) -> None:
         # Pod informer filters terminal pods (scheduler.go:582); a queued pod
@@ -271,29 +297,36 @@ class Scheduler:
             self._maybe_preempt(qp, result)
             self._handle_failure(qp, result.status)
             return True
-        # assume (:945) then bind (:967). Serial path binds synchronously.
-        # The assumed pod is a deep copy (schedule_one.go:148 DeepCopy) — the
-        # queued/informer object must never be mutated.
+        self._commit_cycle(qp, result)
+        return True
+
+    def _commit_cycle(self, qp: QueuedPodInfo, result: ScheduleResult) -> bool:
+        """assume (:945) -> Reserve -> Permit -> PreBind -> bind (:967) ->
+        PostBind; binds synchronously. The assumed pod is a deep copy
+        (schedule_one.go:148 DeepCopy) — the queued/informer object must never
+        be mutated. Shared by the serial loop and the batch scheduler's serial
+        fallback (fallback pods rely on these extension points)."""
         import copy as _copy
 
+        pod = qp.pod
         assumed = _copy.deepcopy(pod)
         try:
             self.cache.assume_pod(assumed, result.suggested_host)
         except ValueError:
             self._handle_failure(qp, Status.error("pod already in cache"))
-            return True
+            return False
         state = result.state if result.state is not None else CycleState()
         st = self.framework.run_reserve(state, assumed, result.suggested_host)
         if not st.is_success():
             self.cache.forget_pod(assumed)
             self._handle_failure(qp, st)
-            return True
+            return False
         st = self.framework.run_permit(state, assumed, result.suggested_host)
         if not st.is_success():
             self.framework.run_unreserve(state, assumed, result.suggested_host)
             self.cache.forget_pod(assumed)
             self._handle_failure(qp, st)
-            return True
+            return False
         try:
             st = self.framework.run_pre_bind(state, assumed, result.suggested_host)
             if not st.is_success():
@@ -307,6 +340,7 @@ class Scheduler:
             self.framework.run_unreserve(state, assumed, result.suggested_host)
             self.cache.forget_pod(assumed)
             self._handle_failure(qp, Status.error(str(e)))
+            return False
         return True
 
     def _maybe_preempt(self, qp: QueuedPodInfo, result: ScheduleResult) -> None:

@@ -211,6 +211,7 @@ def pod_class_signature(pod: Pod) -> tuple:
         ports,
         images,
         len(spec.containers) + len(spec.init_containers),
+        tuple(spec.volumes),
     )
 
 

@@ -236,6 +236,11 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
             # InterPodAffinity lands on device in the next milestone; until then
             # these classes go through the serial oracle.
             fallback_class[ci] = True
+        if pod.spec.volumes:
+            # Volume constraints (binding/zone/limits/conflicts) are not dense-
+            # encoded; these pods take the serial path where the volume plugins
+            # run with Reserve/PreBind semantics.
+            fallback_class[ci] = True
         for c in pod.spec.topology_spread_constraints:
             sel = pts_effective_selector(c, pod)
             if sel is None:

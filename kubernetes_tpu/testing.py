@@ -154,6 +154,21 @@ class MakePod:
         self._pod.status.phase = phase
         return self
 
+    def pvc(self, claim_name: str, read_only: bool = False) -> "MakePod":
+        from .api.types import Volume
+
+        self._pod.spec.volumes.append(
+            Volume(name=f"vol-{len(self._pod.spec.volumes)}",
+                   pvc_claim_name=claim_name, pvc_read_only=read_only))
+        return self
+
+    def volume(self, **kwargs) -> "MakePod":
+        from .api.types import Volume
+
+        kwargs.setdefault("name", f"vol-{len(self._pod.spec.volumes)}")
+        self._pod.spec.volumes.append(Volume(**kwargs))
+        return self
+
     def _affinity(self) -> Affinity:
         if self._pod.spec.affinity is None:
             self._pod.spec.affinity = Affinity()

@@ -1,0 +1,475 @@
+"""Volume plugin semantics — mirrors the reference's volumebinding,
+volumerestrictions, volumezone and nodevolumelimits plugin unit tests."""
+
+from kubernetes_tpu.api.storage import (
+    BINDING_IMMEDIATE,
+    BINDING_WAIT_FOR_FIRST_CONSUMER,
+    CLAIM_BOUND,
+    CSINode,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    READ_WRITE_ONCE_POD,
+    StorageClass,
+    VOLUME_BOUND,
+)
+from kubernetes_tpu.api.labels import NodeSelector
+from kubernetes_tpu.api.types import ObjectMeta
+from kubernetes_tpu.scheduler import CycleState, NodeInfo, PodInfo, Snapshot
+from kubernetes_tpu.scheduler.plugins import (
+    NodeVolumeLimits,
+    VolumeBinding,
+    VolumeLister,
+    VolumeRestrictions,
+    VolumeZone,
+)
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def make_pvc(name, request=100, modes=("ReadWriteOnce",), sc="std", volume="",
+             ns="default", phase=None):
+    pvc = PersistentVolumeClaim(metadata=ObjectMeta(name=name, namespace=ns))
+    pvc.spec.access_modes = list(modes)
+    pvc.spec.request = request
+    pvc.spec.storage_class_name = sc
+    pvc.spec.volume_name = volume
+    pvc.phase = phase or (CLAIM_BOUND if volume else "Pending")
+    return pvc
+
+
+def make_pv(name, capacity=100, modes=("ReadWriteOnce",), sc="std",
+            zone=None, node_affinity=None, claim_ref="", csi_driver=""):
+    pv = PersistentVolume(metadata=ObjectMeta(name=name))
+    pv.spec.capacity = capacity
+    pv.spec.access_modes = list(modes)
+    pv.spec.storage_class_name = sc
+    pv.spec.claim_ref = claim_ref
+    pv.spec.csi_driver = csi_driver
+    if claim_ref:
+        pv.phase = VOLUME_BOUND
+    if zone:
+        pv.metadata.labels["topology.kubernetes.io/zone"] = zone
+    if node_affinity:
+        key, values = node_affinity
+        pv.spec.node_affinity = NodeSelector.from_dict({"nodeSelectorTerms": [
+            {"matchExpressions": [{"key": key, "operator": "In", "values": values}]}
+        ]})
+    return pv
+
+
+def make_class(name, mode=BINDING_WAIT_FOR_FIRST_CONSUMER, provisioner="csi.example.com",
+               topo=None):
+    sc = StorageClass(metadata=ObjectMeta(name=name))
+    sc.provisioner = provisioner
+    sc.volume_binding_mode = mode
+    if topo:
+        key, values = topo
+        sc.allowed_topologies = NodeSelector.from_dict({"nodeSelectorTerms": [
+            {"matchExpressions": [{"key": key, "operator": "In", "values": values}]}
+        ]})
+    return sc
+
+
+def node_info(node, pods=()):
+    ni = NodeInfo(node)
+    for p in pods:
+        ni.add_pod(PodInfo(p))
+    return ni
+
+
+def snap_of(*nis):
+    return Snapshot({ni.node.metadata.name: ni for ni in nis})
+
+
+def run(plugin, pod, ni, snap=None):
+    state = CycleState()
+    snap = snap or snap_of(ni)
+    state.write("Snapshot", snap)
+    if hasattr(plugin, "pre_filter"):
+        _, st = plugin.pre_filter(state, pod, snap)
+        if not st.is_success() and not st.is_skip():
+            return state, st
+    return state, plugin.filter(state, pod, ni)
+
+
+class TestVolumeBinding:
+    def test_no_volumes_skips(self):
+        plugin = VolumeBinding(VolumeLister())
+        pod = MakePod().obj()
+        state = CycleState()
+        _, st = plugin.pre_filter(state, pod, snap_of())
+        assert st.is_skip()
+
+    def test_missing_pvc_unresolvable(self):
+        plugin = VolumeBinding(VolumeLister())
+        pod = MakePod().pvc("missing").obj()
+        _, st = plugin.pre_filter(CycleState(), pod, snap_of())
+        assert st.is_rejected() and "not found" in st.message()
+
+    def test_unbound_immediate_rejected(self):
+        lister = VolumeLister()
+        lister.add(make_class("std", mode=BINDING_IMMEDIATE))
+        lister.add(make_pvc("claim", sc="std"))
+        plugin = VolumeBinding(lister)
+        pod = MakePod().pvc("claim").obj()
+        _, st = plugin.pre_filter(CycleState(), pod, snap_of())
+        assert st.is_rejected() and "unbound immediate" in st.message()
+
+    def test_bound_pv_node_affinity(self):
+        lister = VolumeLister()
+        lister.add(make_pv("pv1", node_affinity=("zone", ["a"]), claim_ref="default/claim"))
+        lister.add(make_pvc("claim", volume="pv1"))
+        plugin = VolumeBinding(lister)
+        pod = MakePod().pvc("claim").obj()
+        good = node_info(MakeNode("n1").labels({"zone": "a"}).obj())
+        bad = node_info(MakeNode("n2").labels({"zone": "b"}).obj())
+        assert run(plugin, pod, good)[1].is_success()
+        _, st = run(plugin, pod, bad)
+        assert st.is_rejected() and "affinity conflict" in st.message()
+
+    def test_wfc_static_binding_and_prebind(self):
+        lister = VolumeLister()
+        lister.add(make_class("std"))
+        lister.add(make_pv("pv-small", capacity=50, node_affinity=("zone", ["a"])))
+        lister.add(make_pv("pv-big", capacity=500, node_affinity=("zone", ["a"])))
+        pvc = make_pvc("claim", request=40)
+        lister.add(pvc)
+        plugin = VolumeBinding(lister)
+        pod = MakePod().pvc("claim").obj()
+        ni = node_info(MakeNode("n1").labels({"zone": "a"}).obj())
+        state, st = run(plugin, pod, ni)
+        assert st.is_success()
+        assert plugin.reserve(state, pod, "n1").is_success()
+        assert plugin.pre_bind(state, pod, "n1").is_success()
+        # smallest fitting PV chosen, binding committed both ways
+        assert pvc.spec.volume_name == "pv-small"
+        assert pvc.phase == CLAIM_BOUND
+        assert lister.pvs["pv-small"].spec.claim_ref == "default/claim"
+
+    def test_wfc_no_pv_no_class_topology_rejected(self):
+        lister = VolumeLister()
+        lister.add(make_class("std", topo=("zone", ["a"])))
+        lister.add(make_pvc("claim"))
+        plugin = VolumeBinding(lister)
+        pod = MakePod().pvc("claim").obj()
+        ni_bad = node_info(MakeNode("n2").labels({"zone": "b"}).obj())
+        _, st = run(plugin, pod, ni_bad)
+        assert st.is_rejected()
+
+    def test_wfc_provisioning_creates_pv(self):
+        lister = VolumeLister()
+        lister.add(make_class("std", topo=("zone", ["a"])))
+        pvc = make_pvc("claim", request=77)
+        lister.add(pvc)
+        plugin = VolumeBinding(lister)
+        pod = MakePod().pvc("claim").obj()
+        ni = node_info(MakeNode("n1").labels({"zone": "a"}).obj())
+        state, st = run(plugin, pod, ni)
+        assert st.is_success()
+        assert plugin.reserve(state, pod, "n1").is_success()
+        assert plugin.pre_bind(state, pod, "n1").is_success()
+        assert pvc.spec.volume_name and pvc.phase == CLAIM_BOUND
+        assert lister.pvs[pvc.spec.volume_name].spec.capacity == 77
+
+    def test_score_prefers_tight_fit(self):
+        lister = VolumeLister()
+        lister.add(make_class("std"))
+        lister.add(make_pv("pv-tight", capacity=100, node_affinity=("h", ["n1"])))
+        lister.add(make_pv("pv-loose", capacity=1000, node_affinity=("h", ["n2"])))
+        lister.add(make_pvc("claim", request=90))
+        plugin = VolumeBinding(lister)
+        pod = MakePod().pvc("claim").obj()
+        ni1 = node_info(MakeNode("n1").labels({"h": "n1"}).obj())
+        ni2 = node_info(MakeNode("n2").labels({"h": "n2"}).obj())
+        state, st = run(plugin, pod, ni1, snap_of(ni1, ni2))
+        assert st.is_success()
+        s1, _ = plugin.score(state, pod, ni1)
+        s2, _ = plugin.score(state, pod, ni2)
+        assert s1 > s2
+
+
+class TestVolumeRestrictions:
+    def test_gce_pd_conflict(self):
+        plugin = VolumeRestrictions()
+        existing = MakePod("other").volume(gce_pd="disk1").obj()
+        ni = node_info(MakeNode("n1").obj(), [existing])
+        pod = MakePod().volume(gce_pd="disk1").obj()
+        _, st = run(plugin, pod, ni)
+        assert st.is_rejected()
+
+    def test_gce_pd_both_read_only_ok(self):
+        plugin = VolumeRestrictions()
+        existing = MakePod("other").volume(gce_pd="disk1", gce_read_only=True).obj()
+        ni = node_info(MakeNode("n1").obj(), [existing])
+        pod = MakePod().volume(gce_pd="disk1", gce_read_only=True).obj()
+        _, st = run(plugin, pod, ni)
+        assert st.is_success()
+
+    def test_ebs_always_conflicts(self):
+        plugin = VolumeRestrictions()
+        existing = MakePod("other").volume(aws_ebs="vol-1").obj()
+        ni = node_info(MakeNode("n1").obj(), [existing])
+        pod = MakePod().volume(aws_ebs="vol-1").obj()
+        _, st = run(plugin, pod, ni)
+        assert st.is_rejected()
+
+    def test_rwop_conflict_cluster_wide(self):
+        lister = VolumeLister()
+        lister.add(make_pvc("claim", modes=(READ_WRITE_ONCE_POD,), volume="pv1"))
+        plugin = VolumeRestrictions(lister)
+        user = MakePod("user").pvc("claim").obj()
+        other_node = node_info(MakeNode("n2").obj(), [user])
+        this_node = node_info(MakeNode("n1").obj())
+        pod = MakePod("newpod").pvc("claim").obj()
+        _, st = run(plugin, pod, this_node, snap_of(this_node, other_node))
+        assert st.is_rejected() and "ReadWriteOncePod" in st.message()
+
+
+class TestVolumeZone:
+    def test_zone_conflict(self):
+        lister = VolumeLister()
+        lister.add(make_pvc("claim", volume="pv1"))
+        lister.add(make_pv("pv1", zone="us-a", claim_ref="default/claim"))
+        plugin = VolumeZone(lister)
+        pod = MakePod().pvc("claim").obj()
+        good = node_info(MakeNode("n1").labels(
+            {"topology.kubernetes.io/zone": "us-a"}).obj())
+        bad = node_info(MakeNode("n2").labels(
+            {"topology.kubernetes.io/zone": "us-b"}).obj())
+        _, st = run(plugin, pod, good)
+        assert st.is_success()
+        _, st = run(plugin, pod, bad)
+        assert st.is_rejected()
+
+    def test_multi_zone_pv_label(self):
+        lister = VolumeLister()
+        lister.add(make_pvc("claim", volume="pv1"))
+        lister.add(make_pv("pv1", zone="us-a__us-b", claim_ref="default/claim"))
+        plugin = VolumeZone(lister)
+        pod = MakePod().pvc("claim").obj()
+        ni = node_info(MakeNode("n1").labels(
+            {"topology.kubernetes.io/zone": "us-b"}).obj())
+        _, st = run(plugin, pod, ni)
+        assert st.is_success()
+
+
+class TestNodeVolumeLimits:
+    def _lister(self, limit=2):
+        lister = VolumeLister()
+        lister.add(CSINode(metadata=ObjectMeta(name="n1"),
+                           drivers={"csi.example.com": limit}))
+        for i in range(3):
+            lister.add(make_pvc(f"claim{i}", volume=f"pv{i}"))
+            lister.add(make_pv(f"pv{i}", csi_driver="csi.example.com",
+                               claim_ref=f"default/claim{i}"))
+        return lister
+
+    def test_under_limit(self):
+        lister = self._lister(limit=2)
+        plugin = NodeVolumeLimits(lister)
+        existing = MakePod("other").pvc("claim0").obj()
+        ni = node_info(MakeNode("n1").obj(), [existing])
+        pod = MakePod().pvc("claim1").obj()
+        _, st = run(plugin, pod, ni)
+        assert st.is_success()
+
+    def test_over_limit(self):
+        lister = self._lister(limit=2)
+        plugin = NodeVolumeLimits(lister)
+        ni = node_info(MakeNode("n1").obj(),
+                       [MakePod("a").pvc("claim0").obj(), MakePod("b").pvc("claim1").obj()])
+        pod = MakePod().pvc("claim2").obj()
+        _, st = run(plugin, pod, ni)
+        assert st.is_rejected() and "max volume count" in st.message()
+
+    def test_nil_allocatable_count_means_no_limit(self):
+        """A registered driver without allocatable.count is unenforced
+        (nil Allocatable.Count in nodevolumelimits/csi.go)."""
+        lister = self._lister(limit=2)
+        csinode = CSINode.from_dict({
+            "metadata": {"name": "n1"},
+            "spec": {"drivers": [{"name": "csi.example.com"}]},
+        })
+        assert csinode.drivers == {"csi.example.com": None}
+        assert CSINode.from_dict(csinode.to_dict()).drivers == csinode.drivers
+        lister.csinodes["n1"] = csinode
+        plugin = NodeVolumeLimits(lister)
+        ni = node_info(MakeNode("n1").obj(),
+                       [MakePod("a").pvc("claim0").obj(), MakePod("b").pvc("claim1").obj()])
+        pod = MakePod().pvc("claim2").obj()
+        _, st = run(plugin, pod, ni)
+        assert st.is_success()
+
+    def test_no_csinode_no_limit(self):
+        lister = self._lister(limit=0)
+        lister.csinodes.clear()
+        plugin = NodeVolumeLimits(lister)
+        ni = node_info(MakeNode("n1").obj(),
+                       [MakePod("a").pvc("claim0").obj()])
+        pod = MakePod().pvc("claim1").obj()
+        _, st = run(plugin, pod, ni)
+        assert st.is_success()
+
+
+class TestStoreWiring:
+    def test_scheduler_feeds_lister_from_store_and_persists_binding(self):
+        """Storage objects created in the API store reach the plugins' lister
+        via sync(), and PreBind writes the PVC/PV binding back to the store."""
+        from kubernetes_tpu.scheduler.plugins import default_plugins
+        from kubernetes_tpu.scheduler.runtime import Framework
+        from kubernetes_tpu.scheduler.serial import Scheduler
+        from kubernetes_tpu.store import APIStore
+
+        store = APIStore()
+        store.create("nodes", MakeNode("n1").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": "10"}).obj())
+        store.create("storageclasses", make_class("std"))
+        store.create("persistentvolumeclaims", make_pvc("claim", request=10))
+        store.create("persistentvolumes",
+                     make_pv("pv1", capacity=20,
+                             node_affinity=("kubernetes.io/hostname", ["n1"])))
+        store.create("pods", MakePod("p").req({"cpu": "1"}).pvc("claim").obj())
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        assert sched.schedule_one()
+        assert store.get("pods", "default/p").spec.node_name == "n1"
+        pvc = store.get("persistentvolumeclaims", "default/claim")
+        assert pvc.spec.volume_name == "pv1" and pvc.phase == CLAIM_BOUND
+        assert store.get("persistentvolumes", "pv1").spec.claim_ref == "default/claim"
+
+    def test_pv_created_after_sync_unblocks_pod(self):
+        from kubernetes_tpu.scheduler.plugins import default_plugins
+        from kubernetes_tpu.scheduler.runtime import Framework
+        from kubernetes_tpu.scheduler.serial import Scheduler
+        from kubernetes_tpu.store import APIStore
+
+        store = APIStore()
+        store.create("nodes", MakeNode("n1").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": "10"}).obj())
+        store.create("storageclasses", make_class("std", provisioner=""))
+        store.create("persistentvolumeclaims", make_pvc("claim", request=10))
+        store.create("pods", MakePod("p").req({"cpu": "1"}).pvc("claim").obj())
+        from kubernetes_tpu.utils import FakeClock
+
+        clock = FakeClock()
+        sched = Scheduler(store, Framework(default_plugins()), clock=clock)
+        sched.sync()
+        sched.schedule_one()  # no PV, no provisioner -> unschedulable
+        assert store.get("pods", "default/p").spec.node_name == ""
+        store.create("persistentvolumes", make_pv("pv1", capacity=20))
+        sched.pump_events()
+        clock.step(11)  # past max backoff so the requeued pod is poppable
+        sched.queue.flush_backoff_completed()
+        assert sched.schedule_one()
+        assert store.get("pods", "default/p").spec.node_name == "n1"
+
+    def test_pv_node_affinity_roundtrip(self):
+        from kubernetes_tpu.api.serialize import from_dict, to_dict
+
+        pv = make_pv("pv1", node_affinity=("zone", ["a", "b"]))
+        pv2 = from_dict("persistentvolumes", to_dict(pv))
+        assert pv2.spec.node_affinity is not None
+        assert to_dict(pv2) == to_dict(pv)
+        node_a = MakeNode("n1").labels({"zone": "a"}).obj()
+        node_c = MakeNode("n2").labels({"zone": "c"}).obj()
+        assert pv2.spec.node_affinity.matches(node_a)
+        assert not pv2.spec.node_affinity.matches(node_c)
+
+    def test_default_class_resolution_in_matching(self):
+        """A PVC without an explicit class must only match PVs of the cluster
+        default class (volume_binding.go findMatchingVolumes)."""
+        lister = VolumeLister()
+        default_sc = make_class("fast")
+        default_sc.is_default = True
+        lister.add(default_sc)
+        lister.add(make_class("slow"))
+        lister.add(make_pv("pv-slow", sc="slow"))
+        pvc = make_pvc("claim", sc=None)
+        lister.add(pvc)
+        plugin = VolumeBinding(lister)
+        pod = MakePod().pvc("claim").obj()
+        ni = node_info(MakeNode("n1").obj())
+        _, st = run(plugin, pod, ni)
+        # only a 'slow' PV exists; the claim resolves to default class 'fast'
+        # whose provisioner can still provision -> feasible via provisioning
+        assert st.is_success()
+        state = CycleState()
+        snap = snap_of(ni)
+        state.write("Snapshot", snap)
+        plugin.pre_filter(state, pod, snap)
+        binding, _ = plugin._node_binding(state, pod, ni.node)
+        assert not binding.static and len(binding.provision) == 1
+
+    def test_batch_scheduler_commits_volume_binding(self):
+        """End to end through BatchScheduler: the volume pod takes the serial
+        fallback and its PVC/PV binding is committed via Reserve/PreBind."""
+        from kubernetes_tpu.scheduler.batch import BatchScheduler
+        from kubernetes_tpu.scheduler.plugins import default_plugins
+        from kubernetes_tpu.scheduler.runtime import Framework
+        from kubernetes_tpu.store import APIStore
+
+        store = APIStore()
+        for name in ("n1", "n2"):
+            store.create("nodes", MakeNode(name).capacity(
+                {"cpu": "8", "memory": "16Gi", "pods": "20"}).obj())
+        store.create("storageclasses", make_class("std"))
+        store.create("persistentvolumeclaims", make_pvc("claim", request=10))
+        store.create("persistentvolumes",
+                     make_pv("pv1", capacity=20,
+                             node_affinity=("kubernetes.io/hostname", ["n2"])))
+        store.create("pods", MakePod("vol").req({"cpu": "1"}).pvc("claim").obj())
+        for i in range(4):
+            store.create("pods", MakePod(f"plain-{i}").req({"cpu": "1"}).obj())
+        sched = BatchScheduler(store, Framework(default_plugins()), solver="scan")
+        sched.sync()
+        sched.run_until_idle()
+        assert store.get("pods", "default/vol").spec.node_name == "n2"
+        pvc = store.get("persistentvolumeclaims", "default/claim")
+        assert pvc.spec.volume_name == "pv1" and pvc.phase == CLAIM_BOUND
+        assert store.get("persistentvolumes", "pv1").spec.claim_ref == "default/claim"
+        for i in range(4):
+            assert store.get("pods", f"default/plain-{i}").spec.node_name
+
+    def test_batch_scheduler_routes_volume_pods_to_serial(self):
+        """Pods with volumes must take the serial fallback (volume constraints
+        are not dense-encoded), so PV affinity is honored and PreBind runs."""
+        from kubernetes_tpu.snapshot.tensorizer import build_pod_batch, build_cluster_tensors
+        from kubernetes_tpu.scheduler import Cache
+        from kubernetes_tpu.utils import FakeClock
+
+        cache = Cache(clock=FakeClock())
+        for name in ("n1", "n2"):
+            cache.add_node(MakeNode(name).capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": "10"}).obj())
+        snap = cache.update_snapshot()
+        cluster = build_cluster_tensors(snap)
+        pods = [MakePod("vol").req({"cpu": "1"}).pvc("claim").obj(),
+                MakePod("plain").req({"cpu": "1"}).obj()]
+        batch = build_pod_batch(pods, snap, cluster)
+        fallback = batch.fallback_class[batch.class_of_pod]
+        assert list(fallback) == [True, False]
+
+
+class TestEndToEndSerial:
+    def test_serial_scheduler_binds_wfc_claim(self):
+        from kubernetes_tpu.scheduler.plugins import default_plugins
+        from kubernetes_tpu.scheduler.runtime import Framework
+        from kubernetes_tpu.scheduler.serial import Scheduler
+        from kubernetes_tpu.store import APIStore
+
+        lister = VolumeLister()
+        lister.add(make_class("std"))
+        pvc = make_pvc("claim", request=10)
+        lister.add(pvc)
+        lister.add(make_pv("pv1", capacity=20, node_affinity=(
+            "kubernetes.io/hostname", ["n1"])))
+        store = APIStore()
+        for name in ("n1", "n2"):
+            store.create("nodes", MakeNode(name).capacity(
+                {"cpu": "4", "memory": "8Gi", "pods": "10"}).obj())
+        store.create("pods", MakePod("p").req({"cpu": "1"}).pvc("claim").obj())
+        sched = Scheduler(store, Framework(default_plugins(volume_lister=lister)))
+        sched.sync()
+        assert sched.schedule_one()
+        bound = store.get("pods", "default/p")
+        assert bound.spec.node_name == "n1"  # only n1 satisfies the PV affinity
+        assert pvc.spec.volume_name == "pv1" and pvc.phase == CLAIM_BOUND
