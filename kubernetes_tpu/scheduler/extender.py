@@ -1,0 +1,224 @@
+"""Scheduler extender: the HTTP webhook alternative to in-process plugins.
+
+Wire types mirror staging/src/k8s.io/kube-scheduler/extender/v1/types.go
+(ExtenderArgs :73, ExtenderFilterResult :88, ExtenderBindingArgs :106,
+HostPriority :124); the client mirrors pkg/scheduler/extender.go (HTTPExtender
+:43) and its call sites in schedule_one.go (findNodesThatPassExtenders :703,
+prioritize merge :798-856, extendersBinding :981).
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..api import Pod
+from ..api.serialize import pod_to_dict
+
+MAX_EXTENDER_PRIORITY = 10  # extender/v1/types.go MaxExtenderPriority
+MAX_NODE_SCORE = 100  # framework/interface.go:255
+
+
+# -- wire types. JSON tags follow extender/v1/types.go exactly: "pod",
+# "nodenames", "failedNodes", "failedAndUnresolvable", "error" — a stock Go
+# extender must be able to decode/encode these bodies. Parsing also accepts
+# Go-field casing for tolerance.
+
+
+def _get(d: Dict, *keys, default=None):
+    for k in keys:
+        if k in d:
+            return d[k]
+    return default
+
+
+def extender_args(pod: Pod, node_names: Sequence[str]) -> Dict:
+    """ExtenderArgs in node-cache-capable form (nodenames, not full nodes)."""
+    return {"pod": pod_to_dict(pod), "nodenames": list(node_names)}
+
+
+@dataclass
+class FilterResult:
+    """Parsed ExtenderFilterResult."""
+
+    node_names: List[str] = field(default_factory=list)
+    failed_nodes: Dict[str, str] = field(default_factory=dict)
+    failed_and_unresolvable: Dict[str, str] = field(default_factory=dict)
+    error: str = ""
+
+    @staticmethod
+    def from_dict(d: Dict) -> "FilterResult":
+        names = _get(d, "nodenames", "NodeNames", "nodeNames")
+        nodes = _get(d, "nodes", "Nodes")
+        if names is None and nodes:
+            names = [n["metadata"]["name"] for n in (nodes.get("items") or [])]
+        return FilterResult(
+            node_names=list(names or []),
+            failed_nodes=dict(_get(d, "failedNodes", "FailedNodes") or {}),
+            failed_and_unresolvable=dict(
+                _get(d, "failedAndUnresolvable", "FailedAndUnresolvableNodes") or {}),
+            error=_get(d, "error", "Error") or "",
+        )
+
+
+@dataclass
+class ExtenderConfig:
+    """KubeSchedulerConfiguration .extenders[] entry
+    (apis/config/types.go Extender)."""
+
+    url_prefix: str = ""
+    filter_verb: str = "filter"
+    prioritize_verb: str = "prioritize"
+    bind_verb: str = ""
+    preempt_verb: str = ""
+    weight: int = 1
+    ignorable: bool = False  # scheduling proceeds if the extender is down
+    node_cache_capable: bool = True
+    managed_resources: List[str] = field(default_factory=list)
+    timeout_seconds: float = 5.0
+
+    @staticmethod
+    def from_dict(d: Dict) -> "ExtenderConfig":
+        return ExtenderConfig(
+            url_prefix=d.get("urlPrefix", ""),
+            filter_verb=d.get("filterVerb", ""),
+            prioritize_verb=d.get("prioritizeVerb", ""),
+            bind_verb=d.get("bindVerb", ""),
+            preempt_verb=d.get("preemptVerb", ""),
+            weight=int(d.get("weight", 1) or 1),
+            ignorable=bool(d.get("ignorable", False)),
+            node_cache_capable=bool(d.get("nodeCacheCapable", True)),
+            managed_resources=[r["name"] if isinstance(r, dict) else r
+                               for r in d.get("managedResources") or []],
+            timeout_seconds=float(d.get("httpTimeout", 5.0) or 5.0),
+        )
+
+
+class ExtenderError(Exception):
+    pass
+
+
+class HTTPExtender:
+    """POSTs ExtenderArgs JSON to urlPrefix/<verb> (extender.go:43 send())."""
+
+    def __init__(self, config: ExtenderConfig):
+        self.config = config
+
+    @property
+    def name(self) -> str:
+        return self.config.url_prefix
+
+    @property
+    def weight(self) -> int:
+        return self.config.weight
+
+    @property
+    def is_binder(self) -> bool:
+        return bool(self.config.bind_verb)
+
+    @property
+    def is_ignorable(self) -> bool:
+        return self.config.ignorable
+
+    def is_interested(self, pod: Pod) -> bool:
+        """extender.go IsInterested: no managed resources = all pods; else only
+        pods requesting one of them."""
+        if not self.config.managed_resources:
+            return True
+        managed = set(self.config.managed_resources)
+        for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+            for section in ("requests", "limits"):
+                if managed & set((c.resources.get(section) or {}).keys()):
+                    return True
+        return False
+
+    def _post(self, verb: str, payload: Dict) -> Dict:
+        url = f"{self.config.url_prefix.rstrip('/')}/{verb}"
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.config.timeout_seconds) as resp:
+                return json.loads(resp.read().decode())
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+            raise ExtenderError(f"extender {url}: {e}") from e
+
+    def filter(self, pod: Pod, node_names: Sequence[str]) -> FilterResult:
+        if not self.config.filter_verb:
+            return FilterResult(node_names=list(node_names))
+        result = FilterResult.from_dict(
+            self._post(self.config.filter_verb, extender_args(pod, node_names)))
+        if result.error:
+            raise ExtenderError(result.error)
+        return result
+
+    def prioritize(self, pod: Pod, node_names: Sequence[str]) -> Dict[str, int]:
+        """Returns host -> raw score (0..MaxExtenderPriority). The wire body is
+        a bare HostPriorityList JSON array (extender/v1/types.go:124)."""
+        if not self.config.prioritize_verb:
+            return {}
+        out = self._post(self.config.prioritize_verb, extender_args(pod, node_names))
+        priorities = out if isinstance(out, list) else (
+            _get(out or {}, "hostPriorityList") or [])
+        return {_get(e, "host", "Host"): int(_get(e, "score", "Score", default=0) or 0)
+                for e in priorities}
+
+    def bind(self, pod: Pod, node_name: str) -> None:
+        payload = {"podName": pod.metadata.name,
+                   "podNamespace": pod.metadata.namespace,
+                   "podUID": pod.metadata.uid,
+                   "node": node_name}
+        out = self._post(self.config.bind_verb, payload)
+        err = _get(out or {}, "error", "Error")
+        if err:
+            raise ExtenderError(err)
+
+
+def find_nodes_that_pass_extenders(
+    extenders: Sequence[HTTPExtender], pod: Pod, feasible: List[str],
+    failed_nodes: Dict[str, object],
+) -> Tuple[List[str], Optional[str]]:
+    """schedule_one.go findNodesThatPassExtenders :703 — sequential filtering;
+    an ignorable extender's failure is skipped, otherwise it aborts the cycle.
+    Mutates failed_nodes with per-node extender rejections (message strings)."""
+    for ext in extenders:
+        if not feasible:
+            break
+        if not ext.is_interested(pod):
+            continue
+        try:
+            result = ext.filter(pod, feasible)
+        except ExtenderError as e:
+            if ext.is_ignorable:
+                continue
+            return feasible, str(e)
+        for name, msg in result.failed_nodes.items():
+            failed_nodes.setdefault(name, f"extender: {msg}")
+        for name, msg in result.failed_and_unresolvable.items():
+            failed_nodes[name] = f"extender (unresolvable): {msg}"
+        feasible = [n for n in feasible if n in set(result.node_names)]
+    return feasible, None
+
+
+def merge_extender_priorities(
+    extenders: Sequence[HTTPExtender], pod: Pod, node_names: Sequence[str],
+    totals: Dict[str, int],
+) -> None:
+    """schedule_one.go :798-856 — extender score x weight, rescaled from the
+    0..10 extender range onto the 0..100 plugin range
+    (MaxNodeScore/MaxExtenderPriority), added onto the plugin totals. Extender
+    failures during Prioritize are tolerated (score 0)."""
+    rescale = MAX_NODE_SCORE // MAX_EXTENDER_PRIORITY
+    for ext in extenders:
+        if not ext.is_interested(pod) or not ext.config.prioritize_verb:
+            continue
+        try:
+            scores = ext.prioritize(pod, node_names)
+        except ExtenderError:
+            continue
+        for name, score in scores.items():
+            if name in totals:
+                totals[name] += score * ext.weight * rescale

@@ -40,23 +40,32 @@ DEFAULT_WEIGHTS = {
 
 
 class Framework:
-    def __init__(self, plugins: Sequence[Plugin], weights: Optional[Dict[str, int]] = None):
+    def __init__(self, plugins: Sequence[Plugin], weights: Optional[Dict[str, int]] = None,
+                 disabled_points: Optional[set] = None):
         self.plugins = list(plugins)
         self.weights = dict(DEFAULT_WEIGHTS)
         if weights:
             self.weights.update(weights)
-        self.pre_enqueue_plugins = [p for p in self.plugins if hasattr(p, "pre_enqueue")]
-        self.pre_filter_plugins = [p for p in self.plugins if hasattr(p, "pre_filter")]
-        self.filter_plugins = [p for p in self.plugins if hasattr(p, "filter")]
-        self.post_filter_plugins = [p for p in self.plugins if hasattr(p, "post_filter")]
-        self.pre_score_plugins = [p for p in self.plugins if hasattr(p, "pre_score")]
-        self.score_plugins = [p for p in self.plugins if hasattr(p, "score")]
-        self.reserve_plugins = [p for p in self.plugins if hasattr(p, "reserve")]
-        self.permit_plugins = [p for p in self.plugins if hasattr(p, "permit")]
-        self.pre_bind_plugins = [p for p in self.plugins if hasattr(p, "pre_bind")]
-        self.bind_plugins = [p for p in self.plugins if hasattr(p, "bind")]
-        self.post_bind_plugins = [p for p in self.plugins if hasattr(p, "post_bind")]
-        self.queue_sort_plugin = next((p for p in self.plugins if hasattr(p, "less")), None)
+        # (plugin name, method name) pairs a profile disabled at one extension
+        # point (apis/config/types.go PluginSet.Disabled)
+        disabled = disabled_points or set()
+
+        def at(method: str):
+            return [p for p in self.plugins
+                    if hasattr(p, method) and (p.name, method) not in disabled]
+
+        self.pre_enqueue_plugins = at("pre_enqueue")
+        self.pre_filter_plugins = at("pre_filter")
+        self.filter_plugins = at("filter")
+        self.post_filter_plugins = at("post_filter")
+        self.pre_score_plugins = at("pre_score")
+        self.score_plugins = at("score")
+        self.reserve_plugins = at("reserve")
+        self.permit_plugins = at("permit")
+        self.pre_bind_plugins = at("pre_bind")
+        self.bind_plugins = at("bind")
+        self.post_bind_plugins = at("post_bind")
+        self.queue_sort_plugin = next(iter(at("less")), None)
 
     # -- PreEnqueue ------------------------------------------------------------
 

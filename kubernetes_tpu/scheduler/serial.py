@@ -66,22 +66,48 @@ class ScheduleResult:
 class Scheduler:
     """Wires store watch -> cache + queue -> scheduling loop -> bind writes."""
 
-    def __init__(self, store: APIStore, framework: Framework,
+    def __init__(self, store: APIStore, framework: Optional[Framework] = None,
                  clock: Optional[Clock] = None,
-                 percentage_of_nodes_to_score: int = 100):
+                 percentage_of_nodes_to_score: int = 100,
+                 profiles: Optional[Dict[str, Framework]] = None,
+                 extenders: Optional[List] = None,
+                 pod_initial_backoff: Optional[float] = None,
+                 pod_max_backoff: Optional[float] = None):
+        from ..api.types import DEFAULT_SCHEDULER_NAME
+
         self.store = store
-        self.framework = framework
+        # Profiles: one framework per pod.Spec.SchedulerName (profile/profile.go);
+        # a bare framework is a single default profile.
+        if profiles is None:
+            if framework is None:
+                raise ValueError("need framework or profiles")
+            profiles = {DEFAULT_SCHEDULER_NAME: framework}
+        elif framework is not None:
+            raise ValueError("pass framework or profiles, not both")
+        self.profiles = profiles
+        self.framework = (profiles.get(DEFAULT_SCHEDULER_NAME)
+                          or next(iter(profiles.values())))
+        self.extenders = list(extenders or [])
         self.clock = clock or Clock()
         self.cache = Cache(clock=self.clock)
-        # Wire the QueueSort plugin. The default PrioritySort is special-cased to
-        # the queue's fast tuple sort key (identical ordering, cheaper heap ops).
+        # Wire the QueueSort plugin (from the default profile; the reference
+        # requires all profiles share one QueueSort — validation.go). The
+        # default PrioritySort is special-cased to the queue's fast tuple sort
+        # key (identical ordering, cheaper heap ops).
         from .plugins.node_plugins import PrioritySort
 
-        qs = framework.queue_sort_plugin
+        qs = self.framework.queue_sort_plugin
+        backoff_kw = {}
+        if pod_initial_backoff is not None:
+            backoff_kw["initial_backoff"] = pod_initial_backoff
+        if pod_max_backoff is not None:
+            backoff_kw["max_backoff"] = pod_max_backoff
         self.queue = SchedulingQueue(
             clock=self.clock,
             less=qs.less if qs is not None and not isinstance(qs, PrioritySort) else None,
-            pre_enqueue=lambda pod: framework.run_pre_enqueue(pod).is_success(),
+            pre_enqueue=lambda pod: (self._fw(pod) or self.framework
+                                     ).run_pre_enqueue(pod).is_success(),
+            **backoff_kw,
         )
         self.percentage = percentage_of_nodes_to_score
         self._watch = None
@@ -93,18 +119,40 @@ class Scheduler:
         # ns labels for InterPodAffinity namespaceSelector
         self._ns_labels: Dict[str, Dict[str, str]] = {}
         # plugins needing framework/store handles (e.g. DefaultPreemption)
-        for p in framework.plugins:
-            if hasattr(p, "set_handles"):
-                p.set_handles(framework, store)
+        for fw in self.profiles.values():
+            for p in fw.plugins:
+                if hasattr(p, "set_handles"):
+                    p.set_handles(fw, store)
         # volume plugins share VolumeLister handles fed from the store's
         # storage kinds (the reference reaches these via shared informers)
         self._volume_listers = []
         seen = set()
-        for p in framework.plugins:
-            lister = getattr(p, "lister", None)
-            if lister is not None and id(lister) not in seen and hasattr(lister, "add"):
-                seen.add(id(lister))
-                self._volume_listers.append(lister)
+        for fw in self.profiles.values():
+            for p in fw.plugins:
+                lister = getattr(p, "lister", None)
+                if lister is not None and id(lister) not in seen and hasattr(lister, "add"):
+                    seen.add(id(lister))
+                    self._volume_listers.append(lister)
+
+    def _fw(self, pod: Pod) -> Optional[Framework]:
+        """frameworkForPod (schedule_one.go:378): profile by SchedulerName."""
+        return self.profiles.get(pod.spec.scheduler_name)
+
+    @classmethod
+    def from_config(cls, store: APIStore, config=None, clock: Optional[Clock] = None,
+                    volume_lister=None) -> "Scheduler":
+        """Build from a KubeSchedulerConfiguration (dict or object): profiles,
+        extenders, backoff, percentage (cmd/kube-scheduler/app/server.go Setup)."""
+        from .config import KubeSchedulerConfiguration, build_profiles
+
+        if config is None or isinstance(config, dict):
+            config = KubeSchedulerConfiguration.from_dict(config)
+        profiles, extenders = build_profiles(config, volume_lister)
+        # 0 = adaptive percentage (numFeasibleNodesToFind, schedule_one.go:675)
+        return cls(store, clock=clock, profiles=profiles, extenders=extenders,
+                   percentage_of_nodes_to_score=config.percentage_of_nodes_to_score,
+                   pod_initial_backoff=config.pod_initial_backoff_seconds,
+                   pod_max_backoff=config.pod_max_backoff_seconds)
 
     # -- informer-equivalent event handling (eventhandlers.go:364) -------------
 
@@ -128,9 +176,10 @@ class Scheduler:
         self._watch = self.store.watch(since_rv=rv)
 
     def _push_ns_labels(self):
-        for p in self.framework.plugins:
-            if hasattr(p, "set_namespace_labels"):
-                p.set_namespace_labels(self._ns_labels)
+        for fw in self.profiles.values():
+            for p in fw.plugins:
+                if hasattr(p, "set_namespace_labels"):
+                    p.set_namespace_labels(self._ns_labels)
 
     def pump_events(self, max_events: int = 10_000) -> int:
         """Drain pending watch events into cache/queue (deterministic test path;
@@ -166,6 +215,10 @@ class Scheduler:
             self.queue.move_all_to_active_or_backoff()
 
     def _handle_pod(self, etype: str, pod: Pod) -> None:
+        # Unassigned pods of a scheduler we have no profile for are not ours
+        # (eventhandlers.go responsibleForPod); bound pods still feed the cache.
+        if not pod.spec.node_name and self._fw(pod) is None:
+            return
         # Pod informer filters terminal pods (scheduler.go:582); a queued pod
         # turning terminal generates a queue delete (predicate stops matching).
         if pod.is_terminal():
@@ -194,7 +247,7 @@ class Scheduler:
         else:
             if etype == MODIFIED and self.queue.update(pod):
                 return  # status-only updates of queued pods don't requeue
-            st = self.framework.run_pre_enqueue(pod)
+            st = (self._fw(pod) or self.framework).run_pre_enqueue(pod)
             if st.is_success():
                 self.queue.add(pod)
             else:
@@ -211,9 +264,10 @@ class Scheduler:
         if len(snapshot) == 0:
             res.status = Status.unschedulable("no nodes available to schedule pods")
             return res
+        framework = self._fw(pod) or self.framework
         state = CycleState()
         res.state = state
-        pre_res, st = self.framework.run_pre_filter(state, pod, snapshot)
+        pre_res, st = framework.run_pre_filter(state, pod, snapshot)
         if not st.is_success():
             res.status = st
             if st.is_rejected():
@@ -225,18 +279,30 @@ class Scheduler:
         if pre_res.node_names is not None:
             nodes = [ni for ni in nodes if ni.node.metadata.name in pre_res.node_names]
 
-        # Nominated-node fast path (:492): try the nominated node first.
+        # Nominated-node fast path (:492): try the nominated node first —
+        # extenders must also pass it (evaluateNominatedNode runs the full
+        # findNodesThatFitPod including findNodesThatPassExtenders).
         if pod.status.nominated_node_name:
             ni = snapshot.get(pod.status.nominated_node_name)
-            if ni is not None and self.framework.run_filter(state, pod, ni).is_success():
-                nodes_to_score = [ni]
-                res.evaluated_nodes = 1
-                return self._score_and_select(state, pod, nodes_to_score, res)
+            if ni is not None and framework.run_filter(state, pod, ni).is_success():
+                ok = True
+                if self.extenders:
+                    from .extender import find_nodes_that_pass_extenders
 
-        limit = num_feasible_nodes_to_find(len(nodes), 0 if self.percentage == 0 else self.percentage)
+                    names, err = find_nodes_that_pass_extenders(
+                        self.extenders, pod, [ni.node.metadata.name], {})
+                    ok = err is None and bool(names)
+                if ok:
+                    res.evaluated_nodes = 1
+                    return self._score_and_select(state, pod, [ni], res)
+
+        percentage = getattr(framework, "percentage_of_nodes_to_score", None)
+        if percentage is None:
+            percentage = self.percentage
+        limit = num_feasible_nodes_to_find(len(nodes), percentage)
         feasible: List[NodeInfo] = []
         for ni in nodes:
-            st = self.framework.run_filter(state, pod, ni)
+            st = framework.run_filter(state, pod, ni)
             res.evaluated_nodes += 1
             if st.is_success():
                 feasible.append(ni)
@@ -244,6 +310,21 @@ class Scheduler:
                     break
             else:
                 res.failed_nodes[ni.node.metadata.name] = st
+        # findNodesThatPassExtenders (:703) — HTTP round trip per extender.
+        if feasible and self.extenders:
+            from .extender import find_nodes_that_pass_extenders
+
+            ext_failed: Dict[str, str] = {}
+            names = [ni.node.metadata.name for ni in feasible]
+            names, err = find_nodes_that_pass_extenders(
+                self.extenders, pod, names, ext_failed)
+            if err is not None:
+                res.status = Status.error(err)
+                return res
+            for name, msg in ext_failed.items():
+                res.failed_nodes.setdefault(name, Status.unschedulable(msg))
+            keep = set(names)
+            feasible = [ni for ni in feasible if ni.node.metadata.name in keep]
         res.feasible_nodes = len(feasible)
         if not feasible:
             res.status = Status.unschedulable(
@@ -253,15 +334,22 @@ class Scheduler:
 
     def _score_and_select(self, state: CycleState, pod, feasible: List[NodeInfo],
                           res: ScheduleResult) -> ScheduleResult:
+        framework = self._fw(pod) or self.framework
         res.feasible_nodes = len(feasible)
-        if len(feasible) == 1:
+        if len(feasible) == 1 and not self.extenders:
             res.suggested_host = feasible[0].node.metadata.name
             return res
-        st = self.framework.run_pre_score(state, pod, feasible)
+        st = framework.run_pre_score(state, pod, feasible)
         if not st.is_success():
             res.status = st
             return res
-        totals = self.framework.run_score(state, pod, feasible)
+        totals = framework.run_score(state, pod, feasible)
+        if self.extenders:
+            from .extender import merge_extender_priorities
+
+            merge_extender_priorities(
+                self.extenders, pod,
+                [ni.node.metadata.name for ni in feasible], totals)
         res.scores = totals
         # selectHost :872 — deterministic: max score, lowest list index on ties.
         best_name, best_score = None, None
@@ -309,6 +397,7 @@ class Scheduler:
         import copy as _copy
 
         pod = qp.pod
+        framework = self._fw(pod) or self.framework
         assumed = _copy.deepcopy(pod)
         try:
             self.cache.assume_pod(assumed, result.suggested_host)
@@ -316,32 +405,41 @@ class Scheduler:
             self._handle_failure(qp, Status.error("pod already in cache"))
             return False
         state = result.state if result.state is not None else CycleState()
-        st = self.framework.run_reserve(state, assumed, result.suggested_host)
+        st = framework.run_reserve(state, assumed, result.suggested_host)
         if not st.is_success():
             self.cache.forget_pod(assumed)
             self._handle_failure(qp, st)
             return False
-        st = self.framework.run_permit(state, assumed, result.suggested_host)
+        st = framework.run_permit(state, assumed, result.suggested_host)
         if not st.is_success():
-            self.framework.run_unreserve(state, assumed, result.suggested_host)
+            framework.run_unreserve(state, assumed, result.suggested_host)
             self.cache.forget_pod(assumed)
             self._handle_failure(qp, st)
             return False
         try:
-            st = self.framework.run_pre_bind(state, assumed, result.suggested_host)
+            st = framework.run_pre_bind(state, assumed, result.suggested_host)
             if not st.is_success():
                 raise RuntimeError(f"prebind: {st.message()}")
-            self.store.bind(pod.metadata.namespace, pod.metadata.name, result.suggested_host)
+            self._bind(pod, result.suggested_host)
             self.cache.finish_binding(assumed)
-            self.framework.run_post_bind(state, assumed, result.suggested_host)
+            framework.run_post_bind(state, assumed, result.suggested_host)
             self.scheduled_count += 1
         except Exception as e:
             # handleBindingCycleError (:344): Unreserve + ForgetPod + requeue
-            self.framework.run_unreserve(state, assumed, result.suggested_host)
+            framework.run_unreserve(state, assumed, result.suggested_host)
             self.cache.forget_pod(assumed)
             self._handle_failure(qp, Status.error(str(e)))
             return False
         return True
+
+    def _bind(self, pod: Pod, node_name: str) -> None:
+        """extendersBinding (:981): a binder extender interested in the pod
+        binds it; otherwise the default binder POSTs the Binding subresource."""
+        for ext in self.extenders:
+            if getattr(ext, "is_binder", False) and ext.is_interested(pod):
+                ext.bind(pod, node_name)
+                return
+        self.store.bind(pod.metadata.namespace, pod.metadata.name, node_name)
 
     def _maybe_preempt(self, qp: QueuedPodInfo, result: ScheduleResult) -> None:
         """RunPostFilterPlugins on an Unschedulable cycle (schedule_one.go:175)."""
@@ -349,10 +447,11 @@ class Scheduler:
 
         if result.status.code != Code.UNSCHEDULABLE:
             return
-        if not self.framework.post_filter_plugins or not result.failed_nodes:
+        framework = self._fw(qp.pod) or self.framework
+        if not framework.post_filter_plugins or not result.failed_nodes:
             return
         state = result.state if result.state is not None else CycleState()
-        nominated, st = self.framework.run_post_filter(state, qp.pod, result.failed_nodes)
+        nominated, st = framework.run_post_filter(state, qp.pod, result.failed_nodes)
         if st.is_success() and nominated:
             qp.pod.status.nominated_node_name = nominated
             self.preemption_count += 1

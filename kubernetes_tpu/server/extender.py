@@ -1,0 +1,171 @@
+"""Batch extender server: the TPU feasibility/score kernel behind the
+scheduler-extender webhook protocol.
+
+Serves the same JSON verbs a kube-scheduler's HTTPExtender POSTs to
+(pkg/scheduler/extender.go:43; wire types
+staging/src/k8s.io/kube-scheduler/extender/v1/types.go), so a stock scheduler
+configured with `extenders: [{urlPrefix: http://this, filterVerb: filter,
+prioritizeVerb: prioritize}]` gets its Filter/Score computed by the dense
+TPU row kernel (ops/solver.py pod_row_feasibility_score) instead of the
+per-node plugin loop.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from ..api import Pod
+from ..scheduler.extender import MAX_EXTENDER_PRIORITY
+
+
+class BatchExtenderServer:
+    """ThreadingHTTPServer with POST /filter, /prioritize, /bind.
+
+    snapshot_provider returns the current scheduler Snapshot (typically
+    `cache.update_snapshot`); cluster tensors are rebuilt only when the
+    snapshot object changes. bind_fn, when given, makes /bind available
+    (delegating to the API store's Binding write).
+    """
+
+    def __init__(self, snapshot_provider: Callable, host: str = "127.0.0.1",
+                 port: int = 0, bind_fn: Optional[Callable] = None):
+        self.snapshot_provider = snapshot_provider
+        self.bind_fn = bind_fn
+        self._tensor_cache: Dict[int, object] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _reply(self, payload: Dict, code: int = 200) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0) or 0)
+                try:
+                    args = json.loads(self.rfile.read(length).decode() or "{}")
+                except json.JSONDecodeError as e:
+                    self._reply({"error": f"bad json: {e}"}, code=400)
+                    return
+                verb = self.path.strip("/")
+                try:
+                    if verb == "filter":
+                        self._reply(outer.handle_filter(args))
+                    elif verb == "prioritize":
+                        self._reply(outer.handle_prioritize(args))
+                    elif verb == "bind" and outer.bind_fn is not None:
+                        self._reply(outer.handle_bind(args))
+                    else:
+                        self._reply({"error": f"unknown verb {verb!r}"}, code=404)
+                except Exception as e:  # surfaces as ExtenderFilterResult.error
+                    self._reply({"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "BatchExtenderServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- solver plumbing -------------------------------------------------------
+
+    def _row(self, pod: Pod):
+        """(node_names, F[N] bool, C[N] int) for the pod against the current
+        snapshot, or (node_names, None, None) when the pod's class needs the
+        serial path (volumes / inter-pod affinity: not dense-encoded)."""
+        from ..ops.solver import make_inputs, pod_row_feasibility_score
+        from ..snapshot.tensorizer import build_cluster_tensors, build_pod_batch
+
+        snapshot = self.snapshot_provider()
+        with self._lock:
+            cluster = self._tensor_cache.get(id(snapshot))
+            if cluster is None:
+                cluster = build_cluster_tensors(snapshot)
+                self._tensor_cache = {id(snapshot): cluster}  # keep only newest
+        batch = build_pod_batch([pod], snapshot, cluster)
+        if bool(batch.fallback_class[batch.class_of_pod[0]]):
+            return cluster.node_names, None, None
+        inputs, _d_max = make_inputs(cluster, batch)
+        feas, score = pod_row_feasibility_score(
+            inputs, batch.req[0], batch.req_nz[0],
+            batch.class_of_pod[0], batch.balanced_active[0])
+        n = len(cluster.node_names)
+        return cluster.node_names, np.asarray(feas)[:n], np.asarray(score)[:n]
+
+    # -- verbs -----------------------------------------------------------------
+
+    @staticmethod
+    def _parse_args(args: Dict):
+        pod = Pod.from_dict(args.get("pod") or args.get("Pod") or {})
+        requested = args.get("nodenames")
+        if requested is None:
+            requested = args.get("NodeNames")
+        return pod, requested
+
+    def handle_filter(self, args: Dict) -> Dict:
+        pod, requested = self._parse_args(args)
+        node_names, feas, _score = self._row(pod)
+        universe = list(requested) if requested is not None else list(node_names)
+        if feas is None:
+            # pass-through: this pod's constraints need the serial plugin path;
+            # the calling scheduler's own plugins still apply
+            return {"nodenames": universe, "failedNodes": {}}
+        index = {name: i for i, name in enumerate(node_names)}
+        ok, failed = [], {}
+        for name in universe:
+            i = index.get(name)
+            if i is not None and bool(feas[i]):
+                ok.append(name)
+            else:
+                failed[name] = "batch solver: infeasible"
+        return {"nodenames": ok, "failedNodes": failed}
+
+    def handle_prioritize(self, args: Dict):
+        """Returns a bare HostPriorityList array, the protocol's response body
+        for prioritize (extender/v1/types.go:124)."""
+        pod, requested = self._parse_args(args)
+        node_names, feas, score = self._row(pod)
+        universe = list(requested) if requested is not None else list(node_names)
+        if score is None:
+            return [{"host": n, "score": 0} for n in universe]
+        index = {name: i for i, name in enumerate(node_names)}
+        raw = {n: (int(score[index[n]]) if index.get(n) is not None and bool(feas[index[n]])
+                   else 0)
+               for n in universe}
+        top = max(raw.values(), default=0)
+        # scale to 0..MaxExtenderPriority (extender/v1/types.go:124)
+        return [{"host": n, "score": (r * MAX_EXTENDER_PRIORITY // top) if top else 0}
+                for n, r in raw.items()]
+
+    def handle_bind(self, args: Dict) -> Dict:
+        try:
+            self.bind_fn(args.get("podNamespace") or args.get("PodNamespace") or "default",
+                         args.get("podName") or args.get("PodName"),
+                         args.get("node") or args.get("Node"))
+            return {}
+        except Exception as e:
+            return {"error": str(e)}

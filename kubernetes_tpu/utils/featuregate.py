@@ -1,0 +1,110 @@
+"""Feature gates: named on/off switches with Alpha/Beta/GA lifecycle.
+
+reference: staging/src/k8s.io/component-base/featuregate/feature_gate.go and
+the gate catalog in pkg/features/kube_features.go (140 gates). The subset
+registered here covers the behaviors this build implements; components read
+gates via `FeatureGates.enabled(name)` and operators set them with the same
+`--feature-gates=Name=true,Other=false` syntax.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, Iterable, Mapping, Optional
+
+ALPHA = "ALPHA"
+BETA = "BETA"
+GA = "GA"
+DEPRECATED = "DEPRECATED"
+
+
+@dataclass(frozen=True)
+class FeatureSpec:
+    default: bool
+    stage: str = ALPHA
+    lock_to_default: bool = False  # GA-locked gates cannot be turned off
+
+
+class FeatureGates:
+    """Thread-safe gate registry (featuregate.go featureGate)."""
+
+    def __init__(self, specs: Optional[Mapping[str, FeatureSpec]] = None):
+        self._lock = threading.Lock()
+        self._specs: Dict[str, FeatureSpec] = dict(specs or {})
+        self._overrides: Dict[str, bool] = {}
+
+    def add(self, name: str, spec: FeatureSpec) -> None:
+        with self._lock:
+            if name in self._specs:
+                raise ValueError(f"feature gate {name!r} already registered")
+            self._specs[name] = spec
+
+    def known(self) -> Iterable[str]:
+        with self._lock:
+            return sorted(self._specs)
+
+    def enabled(self, name: str) -> bool:
+        with self._lock:
+            spec = self._specs.get(name)
+            if spec is None:
+                raise KeyError(f"unknown feature gate {name!r}")
+            return self._overrides.get(name, spec.default)
+
+    def set(self, name: str, value: bool) -> None:
+        with self._lock:
+            spec = self._specs.get(name)
+            if spec is None:
+                raise KeyError(f"unknown feature gate {name!r}")
+            if spec.lock_to_default and value != spec.default:
+                raise ValueError(
+                    f"cannot set feature gate {name} to {value}: locked to "
+                    f"{spec.default}")
+            self._overrides[name] = value
+
+    def set_from_map(self, overrides: Mapping[str, bool]) -> None:
+        for name, value in overrides.items():
+            self.set(name, value)
+
+    def parse(self, flag_value: str) -> None:
+        """--feature-gates=A=true,B=false (featuregate.go Set)."""
+        if not flag_value:
+            return
+        for pair in flag_value.split(","):
+            if not pair.strip():
+                continue
+            name, sep, raw = pair.partition("=")
+            if not sep:
+                raise ValueError(f"missing '=' in feature gate spec {pair!r}")
+            raw = raw.strip().lower()
+            if raw not in ("true", "false"):
+                raise ValueError(f"invalid bool {raw!r} for gate {name!r}")
+            self.set(name.strip(), raw == "true")
+
+    def snapshot(self) -> Dict[str, bool]:
+        with self._lock:
+            return {name: self._overrides.get(name, spec.default)
+                    for name, spec in sorted(self._specs.items())}
+
+
+# The build's gate catalog (scheduler gates: plugins/registry.go:45-60).
+DEFAULT_FEATURE_GATES = {
+    "SchedulerQueueingHints": FeatureSpec(True, BETA),
+    "SchedulerAsyncPreemption": FeatureSpec(False, ALPHA),
+    "DynamicResourceAllocation": FeatureSpec(False, BETA),
+    "VolumeCapacityPriority": FeatureSpec(False, ALPHA),
+    "PodSchedulingReadiness": FeatureSpec(True, GA, lock_to_default=True),
+    "NodeInclusionPolicyInPodTopologySpread": FeatureSpec(True, BETA),
+    "MatchLabelKeysInPodTopologySpread": FeatureSpec(True, BETA),
+    # TPU-build-specific gates (the batch path is this build's headline)
+    "TPUBatchScheduling": FeatureSpec(True, BETA),
+    "TPUTransportSolvers": FeatureSpec(True, ALPHA),
+}
+
+
+def default_feature_gates() -> FeatureGates:
+    return FeatureGates(DEFAULT_FEATURE_GATES)
+
+
+# process-wide default instance (pkg/features DefaultFeatureGate)
+feature_gates = default_feature_gates()

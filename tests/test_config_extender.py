@@ -1,0 +1,337 @@
+"""ComponentConfig + profiles + feature gates + extender protocol tests.
+
+Mirrors the reference's apis/config/validation tests, profile tests, and
+extender tests (pkg/scheduler/extender_test.go uses a fake extender; here the
+fake is a real HTTP server since the protocol is the surface)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu.scheduler.config import (
+    KubeSchedulerConfiguration,
+    build_profiles,
+)
+from kubernetes_tpu.scheduler.extender import (
+    ExtenderConfig,
+    HTTPExtender,
+    find_nodes_that_pass_extenders,
+)
+from kubernetes_tpu.scheduler.runtime import Framework
+from kubernetes_tpu.scheduler.serial import Scheduler
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+from kubernetes_tpu.utils.featuregate import (
+    FeatureGates,
+    FeatureSpec,
+    default_feature_gates,
+)
+
+
+class TestComponentConfig:
+    def test_defaults(self):
+        cfg = KubeSchedulerConfiguration.from_dict({})
+        assert cfg.parallelism == 16
+        assert cfg.pod_initial_backoff_seconds == 1.0
+        assert cfg.pod_max_backoff_seconds == 10.0
+        assert len(cfg.profiles) == 1
+        assert cfg.profiles[0].scheduler_name == "default-scheduler"
+        cfg.validate()
+
+    @pytest.mark.parametrize("patch,msg", [
+        ({"parallelism": 0}, "parallelism"),
+        ({"percentageOfNodesToScore": 150}, "percentageOfNodesToScore"),
+        ({"podInitialBackoffSeconds": 0}, "podInitialBackoffSeconds"),
+        ({"podInitialBackoffSeconds": 20}, "podMaxBackoffSeconds"),
+        ({"profiles": [{"schedulerName": "a"}, {"schedulerName": "a"}]}, "duplicate"),
+        ({"profiles": [{"schedulerName": "a",
+                        "plugins": {"score": {"enabled": [{"name": "NoSuch"}]}}}]},
+         "unknown plugin"),
+        ({"extenders": [{"weight": 1}]}, "urlPrefix"),
+    ])
+    def test_validation_rejects(self, patch, msg):
+        cfg = KubeSchedulerConfiguration.from_dict(patch)
+        with pytest.raises(ValueError, match=msg):
+            cfg.validate()
+
+    def test_profile_disable_and_weight(self):
+        cfg = KubeSchedulerConfiguration.from_dict({"profiles": [
+            {"schedulerName": "custom",
+             "plugins": {
+                 "score": {"disabled": [{"name": "ImageLocality"}],
+                           "enabled": [{"name": "TaintToleration", "weight": 7}]},
+                 "filter": {"disabled": [{"name": "NodePorts"}]},
+             }},
+        ]})
+        profiles, extenders = build_profiles(cfg)
+        fw = profiles["custom"]
+        score_names = {p.name for p in fw.score_plugins}
+        assert "ImageLocality" not in score_names
+        assert "TaintToleration" in score_names
+        filter_names = {p.name for p in fw.filter_plugins}
+        assert "NodePorts" not in filter_names
+        assert "NodeResourcesFit" in filter_names  # untouched defaults remain
+        assert fw.weights["TaintToleration"] == 7
+        assert not extenders
+
+    def test_disable_star(self):
+        cfg = KubeSchedulerConfiguration.from_dict({"profiles": [
+            {"schedulerName": "scores-off",
+             "plugins": {"score": {"disabled": [{"name": "*"}]}}},
+        ]})
+        profiles, _ = build_profiles(cfg)
+        assert profiles["scores-off"].score_plugins == []
+        assert profiles["scores-off"].filter_plugins  # other points untouched
+
+    def test_scheduler_routes_by_profile(self):
+        cfg = KubeSchedulerConfiguration.from_dict({"profiles": [
+            {"schedulerName": "default-scheduler"},
+            {"schedulerName": "quiet",
+             "plugins": {"score": {"disabled": [{"name": "*"}]}}},
+        ]})
+        profiles, _ = build_profiles(cfg)
+        store = APIStore()
+        store.create("nodes", MakeNode("n1").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": "10"}).obj())
+        store.create("pods", MakePod("a").req({"cpu": "1"}).obj())
+        quiet = MakePod("b").req({"cpu": "1"}).obj()
+        quiet.spec.scheduler_name = "quiet"
+        store.create("pods", quiet)
+        other = MakePod("c").req({"cpu": "1"}).obj()
+        other.spec.scheduler_name = "not-ours"
+        store.create("pods", other)
+        sched = Scheduler(store, profiles=profiles)
+        sched.sync()
+        while sched.schedule_one(timeout=0):
+            pass
+        assert store.get("pods", "default/a").spec.node_name == "n1"
+        assert store.get("pods", "default/b").spec.node_name == "n1"
+        # not-ours is ignored entirely (eventhandlers responsibleForPod)
+        assert store.get("pods", "default/c").spec.node_name == ""
+
+
+class TestFromConfig:
+    def test_scheduler_from_config_dict(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("n1").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": "10"}).obj())
+        store.create("pods", MakePod("a").req({"cpu": "1"}).obj())
+        sched = Scheduler.from_config(store, {
+            "podInitialBackoffSeconds": 2,
+            "podMaxBackoffSeconds": 20,
+            "profiles": [{"schedulerName": "default-scheduler"}],
+        })
+        assert sched.queue._initial_backoff == 2
+        assert sched.queue._max_backoff == 20
+        sched.sync()
+        assert sched.schedule_one()
+        assert store.get("pods", "default/a").spec.node_name == "n1"
+
+
+class TestFeatureGates:
+    def test_defaults_and_parse(self):
+        gates = default_feature_gates()
+        assert gates.enabled("SchedulerQueueingHints") is True
+        assert gates.enabled("SchedulerAsyncPreemption") is False
+        gates.parse("SchedulerAsyncPreemption=true,SchedulerQueueingHints=false")
+        assert gates.enabled("SchedulerAsyncPreemption") is True
+        assert gates.enabled("SchedulerQueueingHints") is False
+
+    def test_unknown_and_locked(self):
+        gates = FeatureGates({"Locked": FeatureSpec(True, "GA", lock_to_default=True)})
+        with pytest.raises(KeyError):
+            gates.enabled("NoSuch")
+        with pytest.raises(ValueError):
+            gates.set("Locked", False)
+        gates.set("Locked", True)  # same as default: allowed
+
+    def test_parse_errors(self):
+        gates = default_feature_gates()
+        with pytest.raises(ValueError):
+            gates.parse("SchedulerQueueingHints")
+        with pytest.raises(ValueError):
+            gates.parse("SchedulerQueueingHints=maybe")
+
+
+def _fake_extender_server(filter_fn=None, prioritize_fn=None, bind_calls=None):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            args = json.loads(self.rfile.read(length).decode() or "{}")
+            if self.path.endswith("/filter"):
+                payload = filter_fn(args)
+            elif self.path.endswith("/prioritize"):
+                payload = prioritize_fn(args)
+            elif self.path.endswith("/bind"):
+                bind_calls.append(args)
+                payload = {}
+            else:
+                payload = {"Error": "bad verb"}
+            body = json.dumps(payload).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+class TestHTTPExtender:
+    def test_filter_and_prioritize_merge(self):
+        """Fake extender speaks the Go wire tags exactly: args carry 'pod' and
+        'nodenames'; the filter reply uses 'nodenames'/'failedNodes'; the
+        prioritize reply is a bare [{'host','score'}] array."""
+        def filt(args):
+            assert "pod" in args and "nodenames" in args
+            names = args["nodenames"]
+            return {"nodenames": [n for n in names if n != "n2"],
+                    "failedNodes": {"n2": "extender says no"}}
+
+        def prio(args):
+            return [{"host": n, "score": 10 if n == "n3" else 0}
+                    for n in args["nodenames"]]
+
+        httpd, url = _fake_extender_server(filt, prio)
+        try:
+            ext = HTTPExtender(ExtenderConfig(url_prefix=url, weight=5))
+            pod = MakePod("p").obj()
+            failed = {}
+            feasible, err = find_nodes_that_pass_extenders(
+                [ext], pod, ["n1", "n2", "n3"], failed)
+            assert err is None
+            assert feasible == ["n1", "n3"]
+            assert "n2" in failed
+            totals = {"n1": 50, "n3": 50}
+            from kubernetes_tpu.scheduler.extender import merge_extender_priorities
+
+            merge_extender_priorities([ext], pod, feasible, totals)
+            # 10 (raw) * 5 (weight) * 10 (MaxNodeScore/MaxExtenderPriority)
+            assert totals == {"n1": 50, "n3": 550}
+        finally:
+            httpd.shutdown()
+
+    def test_unreachable_ignorable_vs_fatal(self):
+        pod = MakePod("p").obj()
+        down = ExtenderConfig(url_prefix="http://127.0.0.1:1", timeout_seconds=0.2)
+        ext = HTTPExtender(down)
+        feasible, err = find_nodes_that_pass_extenders([ext], pod, ["n1"], {})
+        assert err is not None  # non-ignorable extender failure aborts
+        down_ok = ExtenderConfig(url_prefix="http://127.0.0.1:1",
+                                 ignorable=True, timeout_seconds=0.2)
+        feasible, err = find_nodes_that_pass_extenders(
+            [HTTPExtender(down_ok)], pod, ["n1"], {})
+        assert err is None and feasible == ["n1"]
+
+    def test_managed_resources_interest(self):
+        ext = HTTPExtender(ExtenderConfig(
+            url_prefix="http://x", managed_resources=["example.com/gpu"]))
+        plain = MakePod("p").req({"cpu": "1"}).obj()
+        gpu = MakePod("g").req({"cpu": "1", "example.com/gpu": "2"}).obj()
+        assert not ext.is_interested(plain)
+        assert ext.is_interested(gpu)
+
+    def test_scheduler_with_extender_end_to_end(self):
+        """Serial scheduler consults the extender: it vetoes n1, so the pod
+        lands on n2; the binder verb receives the binding."""
+        bind_calls = []
+
+        def filt(args):
+            names = args["nodenames"]
+            return {"nodenames": [n for n in names if n != "n1"],
+                    "failedNodes": {n: "no" for n in names if n == "n1"}}
+
+        httpd, url = _fake_extender_server(filt, lambda a: [], bind_calls)
+        try:
+            store = APIStore()
+            for name in ("n1", "n2"):
+                store.create("nodes", MakeNode(name).capacity(
+                    {"cpu": "4", "memory": "8Gi", "pods": "10"}).obj())
+            store.create("pods", MakePod("p").req({"cpu": "1"}).obj())
+            ext = HTTPExtender(ExtenderConfig(url_prefix=url))
+            sched = Scheduler(store, Framework(default_plugins()), extenders=[ext])
+            sched.sync()
+            assert sched.schedule_one()
+            assert store.get("pods", "default/p").spec.node_name == "n2"
+        finally:
+            httpd.shutdown()
+
+
+class TestNominatedNodeExtender:
+    def test_nominated_node_must_pass_extenders(self):
+        """A nominated node an extender rejects must not be used
+        (evaluateNominatedNode runs findNodesThatPassExtenders too)."""
+        def filt(args):
+            names = args["nodenames"]
+            return {"nodenames": [n for n in names if n != "n1"],
+                    "failedNodes": {n: "no" for n in names if n == "n1"}}
+
+        httpd, url = _fake_extender_server(filt, lambda a: [])
+        try:
+            store = APIStore()
+            for name in ("n1", "n2"):
+                store.create("nodes", MakeNode(name).capacity(
+                    {"cpu": "4", "memory": "8Gi", "pods": "10"}).obj())
+            pod = MakePod("p").req({"cpu": "1"}).obj()
+            pod.status.nominated_node_name = "n1"
+            store.create("pods", pod)
+            ext = HTTPExtender(ExtenderConfig(url_prefix=url))
+            sched = Scheduler(store, Framework(default_plugins()), extenders=[ext])
+            sched.sync()
+            assert sched.schedule_one()
+            assert store.get("pods", "default/p").spec.node_name == "n2"
+        finally:
+            httpd.shutdown()
+
+
+class TestBatchExtenderServer:
+    def test_tpu_row_behind_extender_protocol(self):
+        """A stock scheduler's HTTPExtender against the TPU batch extender:
+        full nodes are rejected, scores prefer the emptier node."""
+        from kubernetes_tpu.scheduler import Cache
+        from kubernetes_tpu.server.extender import BatchExtenderServer
+        from kubernetes_tpu.utils import FakeClock
+
+        cache = Cache(clock=FakeClock())
+        cache.add_node(MakeNode("full").capacity(
+            {"cpu": "1", "memory": "1Gi", "pods": "10"}).obj())
+        cache.add_node(MakeNode("busy").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": "10"}).obj())
+        cache.add_node(MakeNode("empty").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": "10"}).obj())
+        cache.add_pod(MakePod("hog").req({"cpu": "6"}).node("busy").obj())
+        server = BatchExtenderServer(cache.update_snapshot).start()
+        try:
+            ext = HTTPExtender(ExtenderConfig(url_prefix=server.url))
+            pod = MakePod("p").req({"cpu": "2", "memory": "2Gi"}).obj()
+            result = ext.filter(pod, ["full", "busy", "empty"])
+            assert result.node_names == ["busy", "empty"]
+            assert "full" in result.failed_nodes
+            scores = ext.prioritize(pod, ["busy", "empty"])
+            assert scores["empty"] > scores["busy"]
+        finally:
+            server.stop()
+
+    def test_fallback_class_passes_through(self):
+        from kubernetes_tpu.scheduler import Cache
+        from kubernetes_tpu.server.extender import BatchExtenderServer
+        from kubernetes_tpu.utils import FakeClock
+
+        cache = Cache(clock=FakeClock())
+        cache.add_node(MakeNode("n1").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": "10"}).obj())
+        server = BatchExtenderServer(cache.update_snapshot).start()
+        try:
+            ext = HTTPExtender(ExtenderConfig(url_prefix=server.url))
+            pod = MakePod("p").req({"cpu": "1"}).pvc("claim").obj()
+            result = ext.filter(pod, ["n1"])
+            assert result.node_names == ["n1"]  # pass-through, no veto
+        finally:
+            server.stop()
