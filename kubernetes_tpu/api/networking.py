@@ -1,0 +1,153 @@
+"""Networking API types: Service, EndpointSlice.
+
+reference: staging/src/k8s.io/api/core/v1/types.go (Service, ServicePort) and
+staging/src/k8s.io/api/discovery/v1/types.go (EndpointSlice, Endpoint).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Mapping, Optional
+
+from .types import ObjectMeta
+
+
+@dataclass(frozen=True)
+class ServicePort:
+    name: str = ""
+    port: int = 0
+    target_port: int = 0  # 0 = same as port
+    protocol: str = "TCP"
+    node_port: int = 0
+
+    def resolved_target(self) -> int:
+        return self.target_port or self.port
+
+
+@dataclass
+class ServiceSpec:
+    selector: Dict[str, str] = field(default_factory=dict)
+    ports: List[ServicePort] = field(default_factory=list)
+    cluster_ip: str = ""
+    type: str = "ClusterIP"  # ClusterIP | NodePort | LoadBalancer | ExternalName
+    external_name: str = ""
+    session_affinity: str = "None"
+
+
+@dataclass
+class Service:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: ServiceSpec = field(default_factory=ServiceSpec)
+
+    kind = "Service"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Service":
+        sp = d.get("spec") or {}
+        return Service(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=ServiceSpec(
+                selector=dict(sp.get("selector") or {}),
+                ports=[ServicePort(
+                    name=p.get("name", ""),
+                    port=int(p.get("port", 0) or 0),
+                    target_port=int(p.get("targetPort", 0) or 0),
+                    protocol=p.get("protocol", "TCP"),
+                    node_port=int(p.get("nodePort", 0) or 0),
+                ) for p in sp.get("ports") or []],
+                cluster_ip=sp.get("clusterIP", ""),
+                type=sp.get("type", "ClusterIP"),
+                external_name=sp.get("externalName", ""),
+                session_affinity=sp.get("sessionAffinity", "None"),
+            ),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": self.metadata.to_dict(),
+            "spec": {
+                **({"selector": dict(self.spec.selector)} if self.spec.selector else {}),
+                "ports": [
+                    {**({"name": p.name} if p.name else {}),
+                     "port": p.port,
+                     **({"targetPort": p.target_port} if p.target_port else {}),
+                     "protocol": p.protocol,
+                     **({"nodePort": p.node_port} if p.node_port else {})}
+                    for p in self.spec.ports
+                ],
+                **({"clusterIP": self.spec.cluster_ip} if self.spec.cluster_ip else {}),
+                "type": self.spec.type,
+                **({"externalName": self.spec.external_name}
+                   if self.spec.external_name else {}),
+            },
+        }
+
+
+@dataclass
+class Endpoint:
+    addresses: List[str] = field(default_factory=list)
+    ready: bool = True
+    node_name: str = ""
+    target_ref: str = ""  # "ns/pod-name"
+
+
+@dataclass
+class EndpointSlice:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    address_type: str = "IPv4"
+    endpoints: List[Endpoint] = field(default_factory=list)
+    ports: List[ServicePort] = field(default_factory=list)
+
+    kind = "EndpointSlice"
+
+    LABEL_SERVICE_NAME = "kubernetes.io/service-name"
+    MAX_ENDPOINTS = 100  # discovery default maxEndpointsPerSlice
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "EndpointSlice":
+        return EndpointSlice(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            address_type=d.get("addressType", "IPv4"),
+            endpoints=[Endpoint(
+                addresses=list(e.get("addresses") or []),
+                ready=bool((e.get("conditions") or {}).get("ready", True)),
+                node_name=e.get("nodeName", ""),
+                target_ref=(f"{(e.get('targetRef') or {}).get('namespace', 'default')}/"
+                            f"{(e.get('targetRef') or {}).get('name', '')}"
+                            if e.get("targetRef") else ""),
+            ) for e in d.get("endpoints") or []],
+            ports=[ServicePort(
+                name=p.get("name", ""),
+                port=int(p.get("port", 0) or 0),
+                protocol=p.get("protocol", "TCP"),
+            ) for p in d.get("ports") or []],
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": "discovery.k8s.io/v1", "kind": "EndpointSlice",
+            "metadata": self.metadata.to_dict(),
+            "addressType": self.address_type,
+            "endpoints": [
+                {"addresses": list(e.addresses),
+                 "conditions": {"ready": e.ready},
+                 **({"nodeName": e.node_name} if e.node_name else {}),
+                 **({"targetRef": {"kind": "Pod",
+                                   "namespace": e.target_ref.split("/", 1)[0],
+                                   "name": e.target_ref.split("/", 1)[1]}}
+                    if e.target_ref else {})}
+                for e in self.endpoints
+            ],
+            "ports": [{**({"name": p.name} if p.name else {}),
+                       "port": p.port, "protocol": p.protocol}
+                      for p in self.ports],
+        }

@@ -26,8 +26,23 @@ from .types import (
     TopologySpreadConstraint,
     WeightedPodAffinityTerm,
 )
+from .networking import EndpointSlice, Service
+from .policy import (
+    HorizontalPodAutoscaler,
+    LimitRange,
+    PodDisruptionBudget,
+    ResourceQuota,
+)
 from .storage import CSINode, PersistentVolume, PersistentVolumeClaim, StorageClass
-from .workloads import Deployment, Lease, ReplicaSet
+from .workloads import (
+    CronJob,
+    DaemonSet,
+    Deployment,
+    Job,
+    Lease,
+    ReplicaSet,
+    StatefulSet,
+)
 
 KIND_TO_RESOURCE = {
     "Pod": "pods",
@@ -35,11 +50,21 @@ KIND_TO_RESOURCE = {
     "Namespace": "namespaces",
     "ReplicaSet": "replicasets",
     "Deployment": "deployments",
+    "StatefulSet": "statefulsets",
+    "DaemonSet": "daemonsets",
+    "Job": "jobs",
+    "CronJob": "cronjobs",
     "Lease": "leases",
     "PersistentVolume": "persistentvolumes",
     "PersistentVolumeClaim": "persistentvolumeclaims",
     "StorageClass": "storageclasses",
     "CSINode": "csinodes",
+    "Service": "services",
+    "EndpointSlice": "endpointslices",
+    "ResourceQuota": "resourcequotas",
+    "LimitRange": "limitranges",
+    "HorizontalPodAutoscaler": "horizontalpodautoscalers",
+    "PodDisruptionBudget": "poddisruptionbudgets",
 }
 RESOURCE_TO_TYPE = {
     "pods": Pod,
@@ -47,11 +72,21 @@ RESOURCE_TO_TYPE = {
     "namespaces": Namespace,
     "replicasets": ReplicaSet,
     "deployments": Deployment,
+    "statefulsets": StatefulSet,
+    "daemonsets": DaemonSet,
+    "jobs": Job,
+    "cronjobs": CronJob,
     "leases": Lease,
     "persistentvolumes": PersistentVolume,
     "persistentvolumeclaims": PersistentVolumeClaim,
     "storageclasses": StorageClass,
     "csinodes": CSINode,
+    "services": Service,
+    "endpointslices": EndpointSlice,
+    "resourcequotas": ResourceQuota,
+    "limitranges": LimitRange,
+    "horizontalpodautoscalers": HorizontalPodAutoscaler,
+    "poddisruptionbudgets": PodDisruptionBudget,
 }
 CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses", "csinodes"}
 GROUP_PREFIX = {
@@ -60,11 +95,21 @@ GROUP_PREFIX = {
     "namespaces": "/api/v1",
     "replicasets": "/apis/apps/v1",
     "deployments": "/apis/apps/v1",
+    "statefulsets": "/apis/apps/v1",
+    "daemonsets": "/apis/apps/v1",
+    "jobs": "/apis/batch/v1",
+    "cronjobs": "/apis/batch/v1",
     "leases": "/apis/coordination.k8s.io/v1",
     "persistentvolumes": "/api/v1",
     "persistentvolumeclaims": "/api/v1",
     "storageclasses": "/apis/storage.k8s.io/v1",
     "csinodes": "/apis/storage.k8s.io/v1",
+    "services": "/api/v1",
+    "endpointslices": "/apis/discovery.k8s.io/v1",
+    "resourcequotas": "/api/v1",
+    "limitranges": "/api/v1",
+    "horizontalpodautoscalers": "/apis/autoscaling/v2",
+    "poddisruptionbudgets": "/apis/policy/v1",
 }
 
 
@@ -284,6 +329,99 @@ def deployment_to_dict(dep: Deployment) -> Dict:
     }
 
 
+def job_to_dict(job: Job) -> Dict:
+    spec: Dict[str, Any] = {
+        "parallelism": job.spec.parallelism,
+        "backoffLimit": job.spec.backoff_limit,
+        "template": _template_to_dict(job.spec.template),
+    }
+    if job.spec.completions is not None:
+        spec["completions"] = job.spec.completions
+    if job.spec.active_deadline_seconds is not None:
+        spec["activeDeadlineSeconds"] = job.spec.active_deadline_seconds
+    if job.spec.completion_mode != "NonIndexed":
+        spec["completionMode"] = job.spec.completion_mode
+    if job.spec.selector is not None:
+        spec["selector"] = _selector_to_dict(job.spec.selector)
+    if job.spec.suspend:
+        spec["suspend"] = True
+    if job.spec.ttl_seconds_after_finished is not None:
+        spec["ttlSecondsAfterFinished"] = job.spec.ttl_seconds_after_finished
+    status: Dict[str, Any] = {
+        "active": job.status.active,
+        "succeeded": job.status.succeeded,
+        "failed": job.status.failed,
+    }
+    if job.status.conditions:
+        status["conditions"] = job.status.conditions
+    return {"apiVersion": "batch/v1", "kind": "Job",
+            "metadata": job.metadata.to_dict(), "spec": spec, "status": status}
+
+
+def cronjob_to_dict(cj: CronJob) -> Dict:
+    job_spec = job_to_dict(Job(spec=cj.spec.job_template))["spec"]
+    status: Dict[str, Any] = {}
+    if cj.status.last_schedule_time is not None:
+        status["lastScheduleTime"] = cj.status.last_schedule_time
+    return {
+        "apiVersion": "batch/v1", "kind": "CronJob",
+        "metadata": cj.metadata.to_dict(),
+        "spec": {
+            "schedule": cj.spec.schedule,
+            "concurrencyPolicy": cj.spec.concurrency_policy,
+            **({"suspend": True} if cj.spec.suspend else {}),
+            **({"startingDeadlineSeconds": cj.spec.starting_deadline_seconds}
+               if cj.spec.starting_deadline_seconds is not None else {}),
+            "successfulJobsHistoryLimit": cj.spec.successful_jobs_history_limit,
+            "failedJobsHistoryLimit": cj.spec.failed_jobs_history_limit,
+            "jobTemplate": {"spec": job_spec},
+        },
+        "status": status,
+    }
+
+
+def statefulset_to_dict(sts: StatefulSet) -> Dict:
+    return {
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": sts.metadata.to_dict(),
+        "spec": {
+            "replicas": sts.spec.replicas,
+            **({"selector": _selector_to_dict(sts.spec.selector)}
+               if sts.spec.selector is not None else {}),
+            "serviceName": sts.spec.service_name,
+            "podManagementPolicy": sts.spec.pod_management_policy,
+            "template": _template_to_dict(sts.spec.template),
+            **({"volumeClaimTemplates": sts.spec.volume_claim_templates}
+               if sts.spec.volume_claim_templates else {}),
+        },
+        "status": {
+            "replicas": sts.status.replicas,
+            "readyReplicas": sts.status.ready_replicas,
+            "currentReplicas": sts.status.current_replicas,
+            "observedGeneration": sts.status.observed_generation,
+        },
+    }
+
+
+def daemonset_to_dict(ds: DaemonSet) -> Dict:
+    return {
+        "apiVersion": "apps/v1", "kind": "DaemonSet",
+        "metadata": ds.metadata.to_dict(),
+        "spec": {
+            **({"selector": _selector_to_dict(ds.spec.selector)}
+               if ds.spec.selector is not None else {}),
+            "template": _template_to_dict(ds.spec.template),
+        },
+        "status": {
+            "desiredNumberScheduled": ds.status.desired_number_scheduled,
+            "currentNumberScheduled": ds.status.current_number_scheduled,
+            "numberReady": ds.status.number_ready,
+            "numberMisscheduled": ds.status.number_misscheduled,
+            "observedGeneration": ds.status.observed_generation,
+        },
+    }
+
+
 def lease_to_dict(lease: Lease) -> Dict:
     return {
         "apiVersion": "coordination.k8s.io/v1", "kind": "Lease",
@@ -308,6 +446,10 @@ _SERIALIZERS = {
     Node: node_to_dict,
     ReplicaSet: replicaset_to_dict,
     Deployment: deployment_to_dict,
+    StatefulSet: statefulset_to_dict,
+    DaemonSet: daemonset_to_dict,
+    Job: job_to_dict,
+    CronJob: cronjob_to_dict,
     Lease: lease_to_dict,
     Namespace: namespace_to_dict,
 }

@@ -1,6 +1,15 @@
 """L4 — reconciling control loops (reference: pkg/controller)."""
 
 from .base import Controller  # noqa: F401
+from .daemonset import DaemonSetController  # noqa: F401
 from .deployment import DeploymentController  # noqa: F401
+from .endpointslice import EndpointSliceController  # noqa: F401
+from .garbagecollector import GarbageCollector  # noqa: F401
+from .job import CronJobController, JobController  # noqa: F401
+from .namespace import NamespaceController  # noqa: F401
 from .node_lifecycle import NodeLifecycleController  # noqa: F401
+from .podautoscaler import HorizontalPodAutoscalerController  # noqa: F401
 from .replicaset import ReplicaSetController  # noqa: F401
+from .resourcequota import ResourceQuotaController  # noqa: F401
+from .statefulset import StatefulSetController  # noqa: F401
+from .tainteviction import TaintEvictionController  # noqa: F401

@@ -1,0 +1,242 @@
+"""Job + CronJob controllers.
+
+reference: pkg/controller/job/job_controller.go (syncJob: pod counting,
+parallelism/completions, backoffLimit -> Failed condition) and
+pkg/controller/cronjob/cronjob_controllerv2.go (syncCronJob: unmet schedule
+times, concurrencyPolicy, history limits).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..api import Pod
+from ..api.workloads import CronJob, Job, JobSpec, PodTemplateSpec
+from ..store import AlreadyExistsError, NotFoundError
+from ..utils.cron import CronSchedule
+from .base import Controller
+
+JOB_NAME_LABEL = "job-name"
+
+
+def job_owner_ref(job: Job) -> dict:
+    return {"apiVersion": "batch/v1", "kind": "Job", "name": job.metadata.name,
+            "uid": job.metadata.uid, "controller": True}
+
+
+def _owned_by_job(pod: Pod, job: Job) -> bool:
+    return any(r.get("kind") == "Job" and r.get("uid") == job.metadata.uid
+               for r in pod.metadata.owner_references)
+
+
+class JobController(Controller):
+    watch_kinds = ("jobs", "pods")
+
+    def key_of_object(self, kind: str, obj) -> Optional[str]:
+        if kind == "jobs":
+            return obj.key
+        for ref in obj.metadata.owner_references:
+            if ref.get("kind") == "Job":
+                return f"{obj.metadata.namespace}/{ref['name']}"
+        return None
+
+    def sync(self, key: str) -> None:
+        try:
+            job: Job = self.store.get("jobs", key)
+        except NotFoundError:
+            self._delete_owned_pods(key)
+            return
+        pods, _ = self.store.list(
+            "pods", lambda p: p.metadata.namespace == job.metadata.namespace
+            and _owned_by_job(p, job))
+        active = [p for p in pods if not p.is_terminal()
+                  and p.metadata.deletion_timestamp is None]
+        succeeded = sum(1 for p in pods if p.status.phase == "Succeeded")
+        failed = sum(1 for p in pods if p.status.phase == "Failed")
+        completions = job.spec.completions if job.spec.completions is not None else 1
+
+        condition = None
+        want_active = len(active)
+        if job.is_finished():
+            pass  # terminal; pods are left for TTL/GC (job_controller.go)
+        elif failed > job.spec.backoff_limit:
+            condition = {"type": "Failed", "status": "True", "reason": "BackoffLimitExceeded"}
+            for p in active:
+                self._try_delete_pod(p)
+            want_active = 0
+        elif succeeded >= completions:
+            condition = {"type": "Complete", "status": "True"}
+        elif job.spec.suspend:
+            for p in active:
+                self._try_delete_pod(p)
+            want_active = 0
+        else:
+            # wantActive (job_controller.go manageJob): bounded by parallelism
+            # and by the completions still owed; scales down as well as up
+            want_active = min(job.spec.parallelism, completions - succeeded)
+            for _ in range(max(0, want_active - len(active))):
+                self._create_pod(job)
+            for p in active[want_active:] if want_active < len(active) else []:
+                self._try_delete_pod(p)
+
+        def mutate(obj: Job) -> Job:
+            obj.status.active = want_active
+            obj.status.succeeded = succeeded
+            obj.status.failed = failed
+            if obj.status.start_time is None and not job.spec.suspend:
+                obj.status.start_time = self.clock.now()
+            if condition is not None and not obj.status.conditions:
+                obj.status.conditions = [condition]
+                if condition["type"] == "Complete":
+                    obj.status.completion_time = self.clock.now()
+            return obj
+
+        try:
+            self.store.guaranteed_update("jobs", key, mutate)
+        except NotFoundError:
+            pass
+
+    def _create_pod(self, job: Job) -> None:
+        import uuid
+
+        template = job.spec.template
+        name = f"{job.metadata.name}-{uuid.uuid4().hex[:5]}"
+        pod = template.make_pod(name, job.metadata.namespace, job_owner_ref(job))
+        pod.metadata.labels[JOB_NAME_LABEL] = job.metadata.name
+        if pod.spec.restart_policy == "Always":
+            # job pods may not be Always (batch/validation); default to Never
+            pod.spec.restart_policy = "Never"
+        try:
+            self.store.create("pods", pod)
+        except AlreadyExistsError:
+            pass
+
+    def _try_delete_pod(self, pod: Pod) -> None:
+        try:
+            self.store.delete("pods", pod.key)
+        except NotFoundError:
+            pass
+
+    def _delete_owned_pods(self, key: str) -> None:
+        ns, name = key.split("/", 1)
+        pods, _ = self.store.list(
+            "pods", lambda p: p.metadata.namespace == ns and any(
+                r.get("kind") == "Job" and r.get("name") == name
+                for r in p.metadata.owner_references))
+        for p in pods:
+            self._try_delete_pod(p)
+
+
+def cronjob_owner_ref(cj: CronJob) -> dict:
+    return {"apiVersion": "batch/v1", "kind": "CronJob", "name": cj.metadata.name,
+            "uid": cj.metadata.uid, "controller": True}
+
+
+def _owned_by_cronjob(job: Job, cj: CronJob) -> bool:
+    return any(r.get("kind") == "CronJob" and r.get("uid") == cj.metadata.uid
+               for r in job.metadata.owner_references)
+
+
+class CronJobController(Controller):
+    """Time-based Job creation. Time comes from the injected clock, so tests
+    step a FakeClock through schedule boundaries (cronjob_controllerv2.go
+    now()-injection)."""
+
+    watch_kinds = ("cronjobs", "jobs")
+
+    def key_of_object(self, kind: str, obj) -> Optional[str]:
+        if kind == "cronjobs":
+            return obj.key
+        for ref in obj.metadata.owner_references:
+            if ref.get("kind") == "CronJob":
+                return f"{obj.metadata.namespace}/{ref['name']}"
+        return None
+
+    def resync_due(self) -> None:
+        """Mark every CronJob dirty (the reference requeues at next schedule
+        time; the daemon loop calls this each tick)."""
+        cjs, _ = self.store.list("cronjobs")
+        for cj in cjs:
+            self._mark(cj.key)
+
+    def sync(self, key: str) -> None:
+        try:
+            cj: CronJob = self.store.get("cronjobs", key)
+        except NotFoundError:
+            return
+        jobs, _ = self.store.list(
+            "jobs", lambda j: j.metadata.namespace == cj.metadata.namespace
+            and _owned_by_cronjob(j, cj))
+        active = [j for j in jobs if not j.is_finished()]
+        self._prune_history(cj, jobs)
+        if cj.spec.suspend:
+            return
+        now = self.clock.now()
+        schedule = CronSchedule(cj.spec.schedule)
+        # earliestTime: lastScheduleTime, else creationTimestamp (getRecentUnmet
+        # ScheduleTimes); an object with no creation stamp starts counting now.
+        since = cj.status.last_schedule_time
+        if since is None:
+            since = cj.metadata.creation_timestamp or now
+        due = schedule.times_between(since, now)
+        if not due:
+            return
+        scheduled_time = due[-1]
+        if (cj.spec.starting_deadline_seconds is not None
+                and now - scheduled_time > cj.spec.starting_deadline_seconds):
+            return  # missed the window (syncCronJob tooLate)
+        if active:
+            if cj.spec.concurrency_policy == "Forbid":
+                return
+            if cj.spec.concurrency_policy == "Replace":
+                for j in active:
+                    self._delete_job(j)
+        self._create_job(cj, scheduled_time)
+
+        def mutate(obj: CronJob) -> CronJob:
+            obj.status.last_schedule_time = scheduled_time
+            return obj
+
+        try:
+            self.store.guaranteed_update("cronjobs", key, mutate)
+        except NotFoundError:
+            pass
+
+    def _create_job(self, cj: CronJob, scheduled_time: float) -> None:
+        import copy
+
+        # deterministic name from the minute stamp (getJobName)
+        name = f"{cj.metadata.name}-{int(scheduled_time) // 60}"
+        spec: JobSpec = copy.deepcopy(cj.spec.job_template)
+        job = Job(spec=spec)
+        job.metadata.name = name
+        job.metadata.namespace = cj.metadata.namespace
+        job.metadata.owner_references = [cronjob_owner_ref(cj)]
+        from ..api.types import new_uid
+
+        job.metadata.uid = new_uid()
+        job.metadata.creation_timestamp = self.clock.now()
+        try:
+            self.store.create("jobs", job)
+        except AlreadyExistsError:
+            pass  # already created for this schedule time
+
+    def _delete_job(self, job: Job) -> None:
+        # cascade: the JobController's NotFound path deletes the pods
+        try:
+            self.store.delete("jobs", job.key)
+        except NotFoundError:
+            pass
+
+    def _prune_history(self, cj: CronJob, jobs: List[Job]) -> None:
+        finished = [j for j in jobs if j.is_finished()]
+        ok = sorted((j for j in finished if any(
+            c.get("type") == "Complete" and c.get("status") == "True"
+            for c in j.status.conditions)), key=lambda j: j.metadata.creation_timestamp)
+        bad = sorted((j for j in finished if any(
+            c.get("type") == "Failed" and c.get("status") == "True"
+            for c in j.status.conditions)), key=lambda j: j.metadata.creation_timestamp)
+        for j in ok[:max(0, len(ok) - cj.spec.successful_jobs_history_limit)]:
+            self._delete_job(j)
+        for j in bad[:max(0, len(bad) - cj.spec.failed_jobs_history_limit)]:
+            self._delete_job(j)

@@ -102,10 +102,14 @@ class BatchExtenderServer:
 
         snapshot = self.snapshot_provider()
         with self._lock:
-            cluster = self._tensor_cache.get(id(snapshot))
-            if cluster is None:
+            # cache holds (snapshot, tensors): keeping the snapshot referenced
+            # makes the identity check sound (no id() reuse after GC)
+            cached = self._tensor_cache.get("latest")
+            if cached is not None and cached[0] is snapshot:
+                cluster = cached[1]
+            else:
                 cluster = build_cluster_tensors(snapshot)
-                self._tensor_cache = {id(snapshot): cluster}  # keep only newest
+                self._tensor_cache = {"latest": (snapshot, cluster)}
         batch = build_pod_batch([pod], snapshot, cluster)
         if bool(batch.fallback_class[batch.class_of_pod[0]]):
             return cluster.node_names, None, None

@@ -237,6 +237,11 @@ class APIStore:
         with self._lock:
             return self._rv
 
+    def kinds(self) -> List[str]:
+        """Kinds that currently hold at least one object (discovery-equivalent)."""
+        with self._lock:
+            return [k for k, objs in self._objects.items() if objs]
+
     # -- watch -----------------------------------------------------------------
 
     def watch(self, kind: Optional[str] = None, since_rv: int = -1) -> Watch:

@@ -1,0 +1,374 @@
+"""Job, CronJob, StatefulSet, DaemonSet controller tests.
+
+Mirrors the reference's pkg/controller/{job,cronjob,statefulset,daemon} unit
+tests in compressed form: controllers run against the in-memory store with a
+stepped fake clock; pod phase transitions stand in for kubelet runs."""
+
+from kubernetes_tpu.api.workloads import CronJob, DaemonSet, Job, StatefulSet
+from kubernetes_tpu.controllers import (
+    CronJobController,
+    DaemonSetController,
+    JobController,
+    StatefulSetController,
+)
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+from kubernetes_tpu.utils import FakeClock
+from kubernetes_tpu.utils.cron import CronSchedule
+
+
+def make_job(name="j", parallelism=2, completions=3, backoff_limit=2, **kw):
+    job = Job.from_dict({
+        "metadata": {"name": name},
+        "spec": {
+            "parallelism": parallelism,
+            "completions": completions,
+            "backoffLimit": backoff_limit,
+            "template": {"metadata": {"labels": {"app": name}},
+                         "spec": {"containers": [{"name": "c", "image": "worker"}]}},
+            **kw,
+        },
+    })
+    from kubernetes_tpu.api.types import new_uid
+
+    job.metadata.uid = new_uid()
+    return job
+
+
+def set_phase(store, key, phase):
+    def mutate(p):
+        p.status.phase = phase
+        return p
+
+    store.guaranteed_update("pods", key, mutate)
+
+
+class TestCron:
+    def test_parse_and_next(self):
+        s = CronSchedule("*/15 * * * *")
+        # 1970-01-01 00:00 UTC epoch: next quarter hour boundaries
+        assert s.next_after(0) == 15 * 60
+        assert s.next_after(15 * 60) == 30 * 60
+        assert s.times_between(0, 3600) == (900.0, 1800.0, 2700.0, 3600.0)
+
+    def test_macros_and_fields(self):
+        assert CronSchedule("@hourly").next_after(1) == 3600
+        daily = CronSchedule("30 6 * * *")
+        assert daily.next_after(0) == 6 * 3600 + 30 * 60
+
+    def test_invalid(self):
+        import pytest
+
+        for bad in ("* * * *", "61 * * * *", "*/0 * * * *", "* * * * 8"):
+            with pytest.raises(ValueError):
+                CronSchedule(bad)
+
+    def test_sunday_as_seven(self):
+        # dow 7 aliases Sunday (robfig/cron); 1970-01-04 was a Sunday
+        s7 = CronSchedule("0 0 * * 7")
+        s0 = CronSchedule("0 0 * * 0")
+        assert s7.next_after(0) == s0.next_after(0) == 3 * 86400
+
+
+class TestJobController:
+    def _setup(self, **kw):
+        store = APIStore()
+        clock = FakeClock(start=1000.0)
+        job = make_job(**kw)
+        store.create("jobs", job)
+        ctl = JobController(store, clock=clock)
+        ctl.sync_all()
+        return store, clock, ctl, job
+
+    def _pods(self, store):
+        pods, _ = store.list("pods")
+        return sorted(pods, key=lambda p: p.metadata.name)
+
+    def test_creates_parallelism_pods(self):
+        store, _, ctl, job = self._setup(parallelism=2, completions=3)
+        ctl.process()
+        active = [p for p in self._pods(store) if not p.is_terminal()]
+        assert len(active) == 2
+        assert all(p.metadata.labels["job-name"] == "j" for p in active)
+        assert store.get("jobs", "default/j").status.active == 2
+
+    def test_completion_flow(self):
+        store, _, ctl, job = self._setup(parallelism=2, completions=2)
+        ctl.process()
+        for p in self._pods(store):
+            set_phase(store, p.key, "Succeeded")
+        ctl.reconcile_once()
+        j = store.get("jobs", "default/j")
+        assert j.status.succeeded == 2
+        assert j.is_finished()
+        assert any(c["type"] == "Complete" for c in j.status.conditions)
+        # finished: no new pods created
+        ctl.reconcile_once()
+        assert len(self._pods(store)) == 2
+
+    def test_failure_backoff_limit(self):
+        store, _, ctl, job = self._setup(parallelism=1, completions=1, backoff_limit=1)
+        ctl.process()
+        set_phase(store, self._pods(store)[0].key, "Failed")
+        ctl.reconcile_once()  # failed=1 <= backoffLimit: retry pod created
+        active = [p for p in self._pods(store) if not p.is_terminal()]
+        assert len(active) == 1
+        set_phase(store, active[0].key, "Failed")
+        ctl.reconcile_once()
+        j = store.get("jobs", "default/j")
+        assert any(c["type"] == "Failed" for c in j.status.conditions)
+        assert not [p for p in self._pods(store) if not p.is_terminal()]
+
+    def test_parallelism_zero_runs_nothing(self):
+        store, _, ctl, job = self._setup(parallelism=0, completions=1)
+        ctl.process()
+        assert not self._pods(store)
+        assert store.get("jobs", "default/j").status.active == 0
+
+    def test_parallelism_scale_down_deletes_excess(self):
+        store, _, ctl, job = self._setup(parallelism=3, completions=5)
+        ctl.process()
+        assert len(self._pods(store)) == 3
+
+        def shrink(j):
+            j.spec.parallelism = 1
+            return j
+
+        store.guaranteed_update("jobs", "default/j", shrink)
+        ctl.reconcile_once()
+        active = [p for p in self._pods(store) if not p.is_terminal()]
+        assert len(active) == 1
+
+    def test_job_pod_restart_policy_never(self):
+        store, _, ctl, job = self._setup(parallelism=1)
+        ctl.process()
+        assert self._pods(store)[0].spec.restart_policy == "Never"
+
+    def test_job_deletion_cascades(self):
+        store, _, ctl, job = self._setup()
+        ctl.process()
+        store.delete("jobs", "default/j")
+        ctl.reconcile_once()
+        assert not self._pods(store)
+
+
+class TestCronJobController:
+    def _setup(self, schedule="*/10 * * * *", **kw):
+        store = APIStore()
+        clock = FakeClock(start=1000.0)
+        cj = CronJob.from_dict({
+            "metadata": {"name": "tick", "creationTimestamp": 1000.0},
+            "spec": {"schedule": schedule,
+                     "jobTemplate": {"spec": {
+                         "template": {"spec": {"containers": [{"name": "c"}]}}}},
+                     **kw},
+        })
+        from kubernetes_tpu.api.types import new_uid
+
+        cj.metadata.uid = new_uid()
+        store.create("cronjobs", cj)
+        ctl = CronJobController(store, clock=clock)
+        ctl.sync_all()
+        return store, clock, ctl
+
+    def test_creates_job_on_schedule(self):
+        store, clock, ctl = self._setup()
+        ctl.process()
+        assert not store.list("jobs")[0]  # not due yet (created at t=1000)
+        clock.step(201)  # t=1201; the */10 boundary 1200 has passed
+        ctl.resync_due()
+        ctl.process()
+        jobs, _ = store.list("jobs")
+        assert len(jobs) == 1
+        assert jobs[0].metadata.name == "tick-20"
+        assert store.get("cronjobs", "default/tick").status.last_schedule_time == 1200.0
+        # same window, no duplicate
+        ctl.resync_due()
+        ctl.process()
+        assert len(store.list("jobs")[0]) == 1
+
+    def test_forbid_concurrency(self):
+        store, clock, ctl = self._setup(concurrencyPolicy="Forbid")
+        clock.step(201)
+        ctl.resync_due()
+        ctl.process()
+        clock.step(600)
+        ctl.resync_due()
+        ctl.process()
+        assert len(store.list("jobs")[0]) == 1  # first job still active
+
+    def test_replace_concurrency(self):
+        store, clock, ctl = self._setup(concurrencyPolicy="Replace")
+        clock.step(201)
+        ctl.resync_due()
+        ctl.process()
+        clock.step(600)
+        ctl.resync_due()
+        ctl.process()
+        jobs, _ = store.list("jobs")
+        assert len(jobs) == 1 and jobs[0].metadata.name == "tick-30"
+
+    def test_suspend(self):
+        store, clock, ctl = self._setup(suspend=True)
+        clock.step(3600)
+        ctl.resync_due()
+        ctl.process()
+        assert not store.list("jobs")[0]
+
+    def test_history_pruned(self):
+        store, clock, ctl = self._setup(successfulJobsHistoryLimit=1)
+        for i in range(3):
+            clock.step(600)
+            ctl.resync_due()
+            ctl.process()
+            jobs, _ = store.list("jobs", lambda j: not j.is_finished())
+            for j in jobs:
+                def mutate(obj):
+                    obj.status.conditions = [{"type": "Complete", "status": "True"}]
+                    return obj
+
+                store.guaranteed_update("jobs", j.key, mutate)
+        ctl.resync_due()
+        ctl.process()
+        finished = [j for j in store.list("jobs")[0] if j.is_finished()]
+        assert len(finished) <= 1
+
+
+class TestStatefulSetController:
+    def _setup(self, replicas=3, policy="OrderedReady", claims=()):
+        store = APIStore()
+        sts = StatefulSet.from_dict({
+            "metadata": {"name": "db"},
+            "spec": {"replicas": replicas,
+                     "podManagementPolicy": policy,
+                     "serviceName": "db",
+                     "template": {"metadata": {"labels": {"app": "db"}},
+                                  "spec": {"containers": [{"name": "c"}]}},
+                     "volumeClaimTemplates": [
+                         {"metadata": {"name": c},
+                          "spec": {"accessModes": ["ReadWriteOnce"],
+                                   "resources": {"requests": {"storage": "1Gi"}}}}
+                         for c in claims]},
+        })
+        from kubernetes_tpu.api.types import new_uid
+
+        sts.metadata.uid = new_uid()
+        store.create("statefulsets", sts)
+        ctl = StatefulSetController(store, clock=FakeClock())
+        ctl.sync_all()
+        return store, ctl
+
+    def test_ordered_rollout(self):
+        store, ctl = self._setup(replicas=3)
+        ctl.process()
+        pods, _ = store.list("pods")
+        assert [p.metadata.name for p in pods] == ["db-0"]  # gated on readiness
+        set_phase(store, "default/db-0", "Running")
+        ctl.reconcile_once()
+        names = sorted(p.metadata.name for p in store.list("pods")[0])
+        assert names == ["db-0", "db-1"]
+        set_phase(store, "default/db-1", "Running")
+        ctl.reconcile_once()
+        assert len(store.list("pods")[0]) == 3
+
+    def test_parallel_rollout(self):
+        store, ctl = self._setup(replicas=3, policy="Parallel")
+        ctl.process()
+        names = sorted(p.metadata.name for p in store.list("pods")[0])
+        assert names == ["db-0", "db-1", "db-2"]
+
+    def test_scale_down_highest_first(self):
+        store, ctl = self._setup(replicas=3, policy="Parallel")
+        ctl.process()
+        for p in store.list("pods")[0]:
+            set_phase(store, p.key, "Running")
+
+        def mutate(obj):
+            obj.spec.replicas = 1
+            return obj
+
+        store.guaranteed_update("statefulsets", "default/db", mutate)
+        ctl.reconcile_once()
+        ctl.reconcile_once()
+        names = sorted(p.metadata.name for p in store.list("pods")[0])
+        assert names == ["db-0"]
+
+    def test_pvcs_created_and_retained(self):
+        store, ctl = self._setup(replicas=1, claims=("data",))
+        ctl.process()
+        pvc = store.get("persistentvolumeclaims", "default/data-db-0")
+        assert pvc.spec.request == 1024 ** 3
+        pod = store.get("pods", "default/db-0")
+        assert pod.spec.volumes[0].pvc_claim_name == "data-db-0"
+        # pod replaced in place: same identity, PVC retained
+        set_phase(store, "default/db-0", "Failed")
+        ctl.reconcile_once()
+        ctl.reconcile_once()
+        pod = store.get("pods", "default/db-0")
+        assert not pod.is_terminal()
+        assert store.get("persistentvolumeclaims", "default/data-db-0")
+
+
+class TestDaemonSetController:
+    def _setup(self, nodes=3):
+        store = APIStore()
+        for i in range(nodes):
+            store.create("nodes", MakeNode(f"n{i}").capacity({"cpu": "4"}).obj())
+        ds = DaemonSet.from_dict({
+            "metadata": {"name": "agent"},
+            "spec": {"template": {"metadata": {"labels": {"app": "agent"}},
+                                  "spec": {"containers": [{"name": "c"}]}}},
+        })
+        from kubernetes_tpu.api.types import new_uid
+
+        ds.metadata.uid = new_uid()
+        store.create("daemonsets", ds)
+        ctl = DaemonSetController(store, clock=FakeClock())
+        ctl.sync_all()
+        return store, ctl
+
+    def test_one_pod_per_node(self):
+        store, ctl = self._setup(nodes=3)
+        ctl.process()
+        pods, _ = store.list("pods")
+        assert sorted(p.spec.node_name for p in pods) == ["n0", "n1", "n2"]
+        st = store.get("daemonsets", "default/agent").status
+        assert st.desired_number_scheduled == 3
+
+    def test_new_node_gets_pod(self):
+        store, ctl = self._setup(nodes=1)
+        ctl.process()
+        store.create("nodes", MakeNode("n9").capacity({"cpu": "4"}).obj())
+        ctl.reconcile_once()
+        pods, _ = store.list("pods")
+        assert sorted(p.spec.node_name for p in pods) == ["n0", "n9"]
+
+    def test_tainted_node_skipped_unless_tolerated(self):
+        from kubernetes_tpu.api.types import Taint
+
+        store, ctl = self._setup(nodes=1)
+        store.create("nodes", MakeNode("gpu").capacity({"cpu": "4"}).taints(
+            [Taint(key="gpu", value="true", effect="NoSchedule")]).obj())
+        ctl.reconcile_once()
+        pods, _ = store.list("pods")
+        assert sorted(p.spec.node_name for p in pods) == ["n0"]
+
+    def test_node_selector_respected(self):
+        store, ctl = self._setup(nodes=1)
+
+        def mutate(ds):
+            ds.spec.template.spec.node_selector = {"role": "special"}
+            return ds
+
+        store.guaranteed_update("daemonsets", "default/agent", mutate)
+        ctl.reconcile_once()
+        ctl.reconcile_once()
+        assert not store.list("pods")[0]  # n0 lacks the label; old pod removed
+
+    def test_node_deletion_removes_pod(self):
+        store, ctl = self._setup(nodes=2)
+        ctl.process()
+        store.delete("nodes", "n1")
+        ctl.reconcile_once()
+        pods, _ = store.list("pods")
+        assert sorted(p.spec.node_name for p in pods) == ["n0"]
