@@ -1,0 +1,11 @@
+"""L7 — Service dataplane programming (reference: pkg/proxy)."""
+
+from .proxier import (  # noqa: F401
+    BoundedFrequencyRunner,
+    FakeBackend,
+    IptablesBackend,
+    NftablesBackend,
+    Proxier,
+    RuleSet,
+    ServicePortRule,
+)

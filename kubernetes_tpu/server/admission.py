@@ -1,0 +1,276 @@
+"""Admission chain: mutating then validating plugins between authn and storage.
+
+reference: staging/src/k8s.io/apiserver/pkg/admission (chain execution) and
+plugin/pkg/admission/* — the subset carried here: NamespaceLifecycle,
+LimitRanger, ResourceQuota, PodTolerationRestriction, NodeRestriction, plus
+metadata defaulting. The REST server runs the chain on every create/update;
+direct store writes (tests, controllers) bypass it, mirroring how controllers
+with etcd access bypass admission in the reference only in the sense that the
+chain lives in the apiserver handler path.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, List, Optional, Sequence
+
+from ..api.policy import LimitRange, ResourceQuota
+from ..api.resources import quantity_milli_value, quantity_value
+from ..api.types import Toleration, new_uid
+from ..store import APIStore, NotFoundError
+
+CREATE = "CREATE"
+UPDATE = "UPDATE"
+
+# namespaces that always exist (kube-apiserver bootstraps them)
+BOOTSTRAP_NAMESPACES = ("default", "kube-system", "kube-public", "kube-node-lease")
+
+
+class AdmissionError(Exception):
+    def __init__(self, message: str, code: int = 403, reason: str = "Forbidden"):
+        super().__init__(message)
+        self.code = code
+        self.reason = reason
+
+
+class AdmissionPlugin:
+    name = "AdmissionPlugin"
+
+    def admit(self, store: APIStore, resource: str, operation: str, obj,
+              user: str = "") -> None:
+        """Mutating pass: modify obj in place or raise AdmissionError."""
+
+    def validate(self, store: APIStore, resource: str, operation: str, obj,
+                 user: str = "") -> None:
+        """Validating pass: raise AdmissionError to reject."""
+
+
+class MetadataDefaulter(AdmissionPlugin):
+    """uid + creationTimestamp defaulting (the registry strategies'
+    PrepareForCreate in the reference)."""
+
+    name = "MetadataDefaulter"
+
+    def __init__(self, now: Optional[Callable[[], float]] = None):
+        import time
+
+        self._now = now or time.time
+
+    def admit(self, store, resource, operation, obj, user="") -> None:
+        if operation != CREATE:
+            return
+        if not obj.metadata.uid:
+            obj.metadata.uid = new_uid()
+        if not obj.metadata.creation_timestamp:
+            obj.metadata.creation_timestamp = self._now()
+
+
+class NamespaceLifecycle(AdmissionPlugin):
+    """Rejects writes into missing or terminating namespaces
+    (plugin/pkg/admission/namespace/lifecycle)."""
+
+    name = "NamespaceLifecycle"
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        ns = getattr(obj.metadata, "namespace", "")
+        if not ns or resource == "namespaces" or operation == "DELETE":
+            return  # deletes must work even when the namespace is already gone
+        if ns in BOOTSTRAP_NAMESPACES:
+            return
+        try:
+            namespace = store.get("namespaces", ns)
+        except NotFoundError:
+            raise AdmissionError(f'namespace "{ns}" not found', code=404,
+                                 reason="NotFound")
+        if namespace.metadata.deletion_timestamp is not None and operation == CREATE:
+            raise AdmissionError(
+                f'namespace "{ns}" is terminating: no new objects allowed')
+
+
+class LimitRanger(AdmissionPlugin):
+    """Applies LimitRange container defaults and enforces min/max
+    (plugin/pkg/admission/limitranger)."""
+
+    name = "LimitRanger"
+
+    def admit(self, store, resource, operation, obj, user="") -> None:
+        if resource != "pods" or operation != CREATE:
+            return
+        ranges, _ = store.list(
+            "limitranges", lambda lr: lr.metadata.namespace == obj.metadata.namespace)
+        for lr in ranges:
+            for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+                # a manifest may carry "resources": {"requests": null}
+                if not isinstance(c.resources, dict):
+                    c.resources = {}
+                if not isinstance(c.resources.get("requests"), dict):
+                    c.resources["requests"] = {}
+                if not isinstance(c.resources.get("limits"), dict):
+                    c.resources["limits"] = {}
+                for key, val in lr.default_requests.items():
+                    c.resources["requests"].setdefault(key, val)
+                for key, val in lr.default_limits.items():
+                    c.resources["limits"].setdefault(key, val)
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        if resource != "pods" or operation != CREATE:
+            return
+        ranges, _ = store.list(
+            "limitranges", lambda lr: lr.metadata.namespace == obj.metadata.namespace)
+        for lr in ranges:
+            for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+                requests = (c.resources or {}).get("requests") or {}
+                for key, cap in lr.max.items():
+                    have = requests.get(key)
+                    if have is not None and _cmp(key, have) > _cmp(key, cap):
+                        raise AdmissionError(
+                            f"maximum {key} usage per Container is {cap}, but "
+                            f"request is {have}")
+                for key, floor in lr.min.items():
+                    have = requests.get(key)
+                    if have is not None and _cmp(key, have) < _cmp(key, floor):
+                        raise AdmissionError(
+                            f"minimum {key} usage per Container is {floor}, but "
+                            f"request is {have}")
+
+
+def _cmp(key: str, value) -> int:
+    return quantity_milli_value(value) if key == "cpu" else quantity_value(value)
+
+
+class ResourceQuotaAdmission(AdmissionPlugin):
+    """Rejects pod creates that would exceed any ResourceQuota hard limit
+    (plugin/pkg/admission/resourcequota). Usage is recomputed live so the
+    check does not depend on the quota controller's status lag."""
+
+    name = "ResourceQuota"
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        if resource != "pods" or operation != CREATE:
+            return
+        ns = obj.metadata.namespace
+        quotas, _ = store.list("resourcequotas", lambda q: q.metadata.namespace == ns)
+        if not quotas:
+            return
+        pods, _ = store.list(
+            "pods", lambda p: p.metadata.namespace == ns and not p.is_terminal())
+        used_cpu = sum(
+            quantity_milli_value((c.resources.get("requests") or {}).get("cpu", 0))
+            for p in pods for c in list(p.spec.containers) + list(p.spec.init_containers))
+        used_mem = sum(
+            quantity_value((c.resources.get("requests") or {}).get("memory", 0))
+            for p in pods for c in list(p.spec.containers) + list(p.spec.init_containers))
+        new_cpu = sum(
+            quantity_milli_value((c.resources.get("requests") or {}).get("cpu", 0))
+            for c in list(obj.spec.containers) + list(obj.spec.init_containers))
+        new_mem = sum(
+            quantity_value((c.resources.get("requests") or {}).get("memory", 0))
+            for c in list(obj.spec.containers) + list(obj.spec.init_containers))
+        for quota in quotas:
+            for key, hard in quota.hard.items():
+                if key in ("requests.cpu", "cpu"):
+                    if used_cpu + new_cpu > quantity_milli_value(hard):
+                        self._reject(quota, key, hard)
+                elif key in ("requests.memory", "memory"):
+                    if used_mem + new_mem > quantity_value(hard):
+                        self._reject(quota, key, hard)
+                elif key == "pods":
+                    if len(pods) + 1 > int(hard):
+                        self._reject(quota, key, hard)
+
+    @staticmethod
+    def _reject(quota: ResourceQuota, key: str, hard) -> None:
+        raise AdmissionError(
+            f"exceeded quota: {quota.metadata.name}, limited: {key}={hard}")
+
+
+class PodTolerationRestriction(AdmissionPlugin):
+    """Merges namespace default tolerations into pods
+    (plugin/pkg/admission/podtolerationrestriction; annotation
+    scheduler.alpha.kubernetes.io/defaultTolerations)."""
+
+    name = "PodTolerationRestriction"
+    DEFAULT_KEY = "scheduler.alpha.kubernetes.io/defaultTolerations"
+    WHITELIST_KEY = "scheduler.alpha.kubernetes.io/tolerationsWhitelist"
+
+    def admit(self, store, resource, operation, obj, user="") -> None:
+        if resource != "pods" or operation != CREATE:
+            return
+        ns = self._namespace(store, obj)
+        if ns is None:
+            return
+        raw = ns.metadata.annotations.get(self.DEFAULT_KEY)
+        if raw:
+            for t in json.loads(raw):
+                tol = Toleration.from_dict(t)
+                if tol not in obj.spec.tolerations:
+                    obj.spec.tolerations.append(tol)
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        if resource != "pods" or operation != CREATE:
+            return
+        ns = self._namespace(store, obj)
+        if ns is None:
+            return
+        raw = ns.metadata.annotations.get(self.WHITELIST_KEY)
+        if not raw:
+            return
+        allowed = [Toleration.from_dict(t) for t in json.loads(raw)]
+        for tol in obj.spec.tolerations:
+            if tol not in allowed:
+                raise AdmissionError(
+                    f"pod toleration {tol.key!r} not in the namespace whitelist")
+
+    @staticmethod
+    def _namespace(store, obj):
+        try:
+            return store.get("namespaces", obj.metadata.namespace)
+        except NotFoundError:
+            return None
+
+
+class NodeRestriction(AdmissionPlugin):
+    """A node identity (system:node:<name>) may only modify its own Node object
+    and pods bound to it (plugin/pkg/admission/noderestriction)."""
+
+    name = "NodeRestriction"
+    PREFIX = "system:node:"
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        if not user.startswith(self.PREFIX):
+            return
+        node_name = user[len(self.PREFIX):]
+        if resource == "nodes" and obj.metadata.name != node_name:
+            raise AdmissionError(
+                f"node {node_name!r} may not modify node {obj.metadata.name!r}")
+        if resource == "pods" and obj.spec.node_name != node_name:
+            raise AdmissionError(
+                f"node {node_name!r} may only write pods bound to itself")
+
+
+class AdmissionChain:
+    """All mutators in order, then all validators (apiserver/pkg/admission
+    chainAdmissionHandler)."""
+
+    def __init__(self, plugins: Sequence[AdmissionPlugin]):
+        self.plugins = list(plugins)
+
+    def run(self, store: APIStore, resource: str, operation: str, obj,
+            user: str = "") -> None:
+        for p in self.plugins:
+            p.admit(store, resource, operation, obj, user)
+        for p in self.plugins:
+            p.validate(store, resource, operation, obj, user)
+
+
+def default_admission_chain() -> AdmissionChain:
+    """The default plugin set, in the reference's recommended order
+    (kubeapiserver/options/plugins.go)."""
+    return AdmissionChain([
+        MetadataDefaulter(),
+        NamespaceLifecycle(),
+        LimitRanger(),
+        PodTolerationRestriction(),
+        NodeRestriction(),
+        ResourceQuotaAdmission(),
+    ])

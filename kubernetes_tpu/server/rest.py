@@ -225,11 +225,34 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if ns and resource not in CLUSTER_SCOPED:
             obj.metadata.namespace = ns
+        # admission + create under one store transaction: concurrent creates
+        # cannot both pass a quota check they jointly exceed
+        with self.store.transaction():
+            if not self._admit(resource, "CREATE", obj):
+                return
+            try:
+                created = self.store.create(resource, obj)
+            except AlreadyExistsError as e:
+                self._error(409, str(e), "AlreadyExists")
+                return
+        self._send_json(201, to_dict(created))
+
+    def _admit(self, resource: str, operation: str, obj) -> bool:
+        """Run the admission chain; False = rejected (response already sent).
+        Identity comes from the X-Remote-User header (authenticating-proxy
+        convention) — node agents send system:node:<name>."""
+        chain = getattr(self.server, "admission", None)
+        if chain is None:
+            return True
+        from .admission import AdmissionError
+
+        user = self.headers.get("X-Remote-User", "")
         try:
-            created = self.store.create(resource, obj)
-            self._send_json(201, to_dict(created))
-        except AlreadyExistsError as e:
-            self._error(409, str(e), "AlreadyExists")
+            chain.run(self.store, resource, operation, obj, user=user)
+            return True
+        except AdmissionError as e:
+            self._error(e.code, str(e), e.reason)
+            return False
 
     # ---- PUT / DELETE --------------------------------------------------------
 
@@ -252,6 +275,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, f"name mismatch: URL {name!r} vs body {obj.metadata.name!r}")
             return
         obj.metadata.name = name
+        if not self._admit(resource, "UPDATE", obj):
+            return
         try:
             updated = self.store.update(resource, obj)
             self._send_json(200, to_dict(updated))
@@ -266,23 +291,39 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, "unknown path")
             return
         resource, ns, name, _ = parsed
-        try:
-            obj = self.store.delete(resource, self._key(resource, ns, name))
-            self._send_json(200, to_dict(obj))
-        except NotFoundError as e:
-            self._error(404, str(e), "NotFound")
+        key = self._key(resource, ns, name)
+        with self.store.transaction():
+            try:
+                existing = self.store.get(resource, key)
+            except NotFoundError as e:
+                self._error(404, str(e), "NotFound")
+                return
+            # deletes go through admission too (noderestriction covers DELETE)
+            if not self._admit(resource, "DELETE", existing):
+                return
+            try:
+                obj = self.store.delete(resource, key)
+            except NotFoundError as e:
+                self._error(404, str(e), "NotFound")
+                return
+        self._send_json(200, to_dict(obj))
 
 
 class APIServer:
     """Embeds the store behind HTTP. start() binds a port; .url for clients."""
 
     def __init__(self, store: APIStore, host: str = "127.0.0.1", port: int = 0,
-                 verbose: bool = False):
+                 verbose: bool = False, admission="default"):
         self.store = store
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.store = store  # type: ignore[attr-defined]
         self._httpd.verbose = verbose  # type: ignore[attr-defined]
         self._httpd.shutting_down = False  # type: ignore[attr-defined]
+        if admission == "default":
+            from .admission import default_admission_chain
+
+            admission = default_admission_chain()
+        self._httpd.admission = admission  # type: ignore[attr-defined]
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 

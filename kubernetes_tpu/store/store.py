@@ -242,6 +242,12 @@ class APIStore:
         with self._lock:
             return [k for k, objs in self._objects.items() if objs]
 
+    def transaction(self):
+        """Hold the store lock across several operations (reentrant), making a
+        read-check-write sequence atomic against other threads — the stand-in
+        for the reference's etcd txn around quota check+create."""
+        return self._lock
+
     # -- watch -----------------------------------------------------------------
 
     def watch(self, kind: Optional[str] = None, since_rv: int = -1) -> Watch:

@@ -1,0 +1,352 @@
+"""Admission chain + kube-proxy equivalent tests.
+
+Mirrors plugin/pkg/admission/{limitranger,resourcequota,namespace/lifecycle,
+podtolerationrestriction,noderestriction} tests and pkg/proxy/iptables
+proxier_test.go (rendered-rule assertions)."""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.api.networking import Service
+from kubernetes_tpu.api.policy import LimitRange, ResourceQuota
+from kubernetes_tpu.api.types import Namespace, ObjectMeta
+from kubernetes_tpu.controllers import EndpointSliceController
+from kubernetes_tpu.proxy import (
+    BoundedFrequencyRunner,
+    FakeBackend,
+    IptablesBackend,
+    NftablesBackend,
+    Proxier,
+)
+from kubernetes_tpu.server.admission import (
+    AdmissionError,
+    default_admission_chain,
+)
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+from kubernetes_tpu.utils import FakeClock
+from kubernetes_tpu.api.types import new_uid
+
+
+def admit(store, obj, resource="pods", op="CREATE", user=""):
+    default_admission_chain().run(store, resource, op, obj, user=user)
+
+
+class TestNamespaceLifecycle:
+    def test_missing_namespace_rejected(self):
+        store = APIStore()
+        pod = MakePod("p", namespace="ghost").obj()
+        with pytest.raises(AdmissionError, match="not found"):
+            admit(store, pod)
+
+    def test_bootstrap_namespaces_allowed(self):
+        store = APIStore()
+        admit(store, MakePod("p", namespace="default").obj())
+        admit(store, MakePod("p", namespace="kube-system").obj())
+
+    def test_terminating_namespace_rejects_creates(self):
+        store = APIStore()
+        ns = Namespace(metadata=ObjectMeta(name="dying"))
+        ns.metadata.deletion_timestamp = 123.0
+        store.create("namespaces", ns)
+        with pytest.raises(AdmissionError, match="terminating"):
+            admit(store, MakePod("p", namespace="dying").obj())
+
+
+class TestLimitRanger:
+    def _store(self):
+        store = APIStore()
+        store.create("limitranges", LimitRange.from_dict({
+            "metadata": {"name": "lr", "namespace": "default"},
+            "spec": {"limits": [{"type": "Container",
+                                 "defaultRequest": {"cpu": "100m", "memory": "64Mi"},
+                                 "default": {"cpu": "200m"},
+                                 "max": {"cpu": "2"},
+                                 "min": {"memory": "16Mi"}}]},
+        }))
+        return store
+
+    def test_defaults_applied(self):
+        store = self._store()
+        pod = MakePod("p").container("img").obj()
+        admit(store, pod)
+        res = pod.spec.containers[0].resources
+        assert res["requests"] == {"cpu": "100m", "memory": "64Mi"}
+        assert res["limits"] == {"cpu": "200m"}
+
+    def test_explicit_request_kept(self):
+        store = self._store()
+        pod = MakePod("p").req({"cpu": "500m"}).obj()
+        admit(store, pod)
+        assert pod.spec.containers[0].resources["requests"]["cpu"] == "500m"
+
+    def test_max_enforced(self):
+        store = self._store()
+        pod = MakePod("p").req({"cpu": "4"}).obj()
+        with pytest.raises(AdmissionError, match="maximum cpu"):
+            admit(store, pod)
+
+    def test_min_enforced(self):
+        store = self._store()
+        pod = MakePod("p").req({"memory": "8Mi"}).obj()
+        with pytest.raises(AdmissionError, match="minimum memory"):
+            admit(store, pod)
+
+
+class TestResourceQuotaAdmission:
+    def test_quota_enforced_live(self):
+        store = APIStore()
+        store.create("resourcequotas", ResourceQuota.from_dict({
+            "metadata": {"name": "q", "namespace": "default"},
+            "spec": {"hard": {"requests.cpu": "1", "pods": "2"}}}))
+        admit(store, MakePod("a").req({"cpu": "600m"}).obj())
+        store.create("pods", MakePod("a").req({"cpu": "600m"}).obj())
+        with pytest.raises(AdmissionError, match="limited: requests.cpu"):
+            admit(store, MakePod("b").req({"cpu": "600m"}).obj())
+        admit(store, MakePod("c").req({"cpu": "100m"}).obj())
+        store.create("pods", MakePod("c").req({"cpu": "100m"}).obj())
+        with pytest.raises(AdmissionError, match="limited: pods"):
+            admit(store, MakePod("d").obj())
+
+
+class TestPodTolerationRestriction:
+    def test_namespace_default_tolerations_merged(self):
+        store = APIStore()
+        ns = Namespace(metadata=ObjectMeta(name="batch"))
+        ns.metadata.annotations["scheduler.alpha.kubernetes.io/defaultTolerations"] = \
+            json.dumps([{"key": "dedicated", "operator": "Equal",
+                         "value": "batch", "effect": "NoSchedule"}])
+        store.create("namespaces", ns)
+        pod = MakePod("p", namespace="batch").obj()
+        admit(store, pod)
+        assert any(t.key == "dedicated" and t.value == "batch"
+                   for t in pod.spec.tolerations)
+
+    def test_whitelist_enforced(self):
+        store = APIStore()
+        ns = Namespace(metadata=ObjectMeta(name="strict"))
+        ns.metadata.annotations["scheduler.alpha.kubernetes.io/tolerationsWhitelist"] = \
+            json.dumps([{"key": "ok", "operator": "Exists"}])
+        store.create("namespaces", ns)
+        bad = MakePod("p", namespace="strict").toleration("forbidden", operator="Exists").obj()
+        with pytest.raises(AdmissionError, match="whitelist"):
+            admit(store, bad)
+
+
+class TestNodeRestriction:
+    def test_node_cannot_touch_other_node(self):
+        store = APIStore()
+        other = MakeNode("n2").obj()
+        with pytest.raises(AdmissionError, match="may not modify"):
+            admit(store, other, resource="nodes", op="UPDATE", user="system:node:n1")
+        admit(store, MakeNode("n1").obj(), resource="nodes", op="UPDATE",
+              user="system:node:n1")
+
+    def test_node_cannot_write_foreign_pods(self):
+        store = APIStore()
+        pod = MakePod("p").node("n2").obj()
+        with pytest.raises(AdmissionError, match="bound to itself"):
+            admit(store, pod, op="UPDATE", user="system:node:n1")
+
+    def test_non_node_identity_unrestricted(self):
+        store = APIStore()
+        admit(store, MakeNode("n2").obj(), resource="nodes", op="UPDATE",
+              user="admin")
+
+    def test_delete_restricted_over_http(self):
+        import urllib.request
+
+        from kubernetes_tpu.server import APIServer
+
+        store = APIStore()
+        store.create("pods", MakePod("p").node("n2").obj())
+        srv = APIServer(store, port=0).start()
+        try:
+            req = urllib.request.Request(
+                f"{srv.url}/api/v1/namespaces/default/pods/p", method="DELETE",
+                headers={"X-Remote-User": "system:node:n1"})
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(req)
+            assert e.value.code == 403
+            req2 = urllib.request.Request(
+                f"{srv.url}/api/v1/namespaces/default/pods/p", method="DELETE",
+                headers={"X-Remote-User": "system:node:n2"})
+            with urllib.request.urlopen(req2) as resp:
+                assert resp.status == 200
+        finally:
+            srv.stop()
+
+
+class TestAdmissionOverHTTP:
+    def test_rest_create_runs_chain(self):
+        import urllib.request
+
+        from kubernetes_tpu.server import APIServer
+
+        store = APIStore()
+        store.create("resourcequotas", ResourceQuota.from_dict({
+            "metadata": {"name": "q", "namespace": "default"},
+            "spec": {"hard": {"pods": "0"}}}))
+        srv = APIServer(store, port=0).start()
+        try:
+            body = json.dumps({"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}}).encode()
+            req = urllib.request.Request(
+                f"{srv.url}/api/v1/namespaces/default/pods", data=body,
+                headers={"Content-Type": "application/json"}, method="POST")
+            with pytest.raises(urllib.error.HTTPError) as exc_info:
+                urllib.request.urlopen(req)
+            assert exc_info.value.code == 403
+            payload = json.loads(exc_info.value.read().decode())
+            assert "exceeded quota" in payload["message"]
+        finally:
+            srv.stop()
+
+    def test_uid_defaulted_over_http(self):
+        import urllib.request
+
+        from kubernetes_tpu.server import APIServer
+
+        store = APIStore()
+        srv = APIServer(store, port=0).start()
+        try:
+            body = json.dumps({"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}}).encode()
+            req = urllib.request.Request(
+                f"{srv.url}/api/v1/namespaces/default/pods", data=body,
+                headers={"Content-Type": "application/json"}, method="POST")
+            with urllib.request.urlopen(req) as resp:
+                out = json.loads(resp.read().decode())
+            assert out["metadata"]["uid"]
+        finally:
+            srv.stop()
+
+
+def _cluster_with_service():
+    store = APIStore()
+    svc = Service.from_dict({
+        "metadata": {"name": "web", "namespace": "default"},
+        "spec": {"selector": {"app": "web"},
+                 "ports": [{"name": "http", "port": 80, "targetPort": 8080}]},
+    })
+    svc.metadata.uid = new_uid()
+    store.create("services", svc)
+    for i in range(3):
+        pod = (MakePod(f"w{i}").labels({"app": "web"}).node(f"n{i}")
+               .phase("Running").obj())
+        store.create("pods", pod)
+    es = EndpointSliceController(store, clock=FakeClock())
+    es.sync_all()
+    es.process()
+    return store
+
+
+class TestProxier:
+    def test_rules_built_from_services_and_slices(self):
+        store = _cluster_with_service()
+        proxier = Proxier(store, backend=FakeBackend(), clock=FakeClock())
+        proxier.sync_all()
+        rs = proxier.sync_proxy_rules()
+        assert len(rs.rules) == 1
+        rule = rs.rules[0]
+        assert rule.port == 80
+        assert len(rule.endpoints) == 3
+        assert all(ep.port == 8080 for ep in rule.endpoints)
+        assert rule.cluster_ip.startswith("172.16.")
+
+    def test_unready_endpoints_excluded(self):
+        store = _cluster_with_service()
+
+        def not_ready(p):
+            p.status.phase = "Pending"
+            return p
+
+        store.guaranteed_update("pods", "default/w0", not_ready)
+        es = EndpointSliceController(store, clock=FakeClock())
+        es.sync_all()
+        es.process()
+        proxier = Proxier(store, clock=FakeClock())
+        proxier.sync_all()
+        rs = proxier.sync_proxy_rules()
+        assert len(rs.rules[0].endpoints) == 2
+
+    def test_iptables_render_shape(self):
+        store = _cluster_with_service()
+        backend = IptablesBackend()
+        proxier = Proxier(store, backend=backend, clock=FakeClock())
+        proxier.sync_all()
+        proxier.sync_proxy_rules()
+        text = backend.render()
+        assert "*nat" in text and "COMMIT" in text
+        assert text.count("-j DNAT --to-destination") == 3
+        assert "--mode random" in text  # balanced split
+        assert 'comment "default/web:http cluster IP"' in text
+
+    def test_nftables_render_shape(self):
+        store = _cluster_with_service()
+        backend = NftablesBackend()
+        proxier = Proxier(store, backend=backend, clock=FakeClock())
+        proxier.sync_all()
+        proxier.sync_proxy_rules()
+        text = backend.render()
+        assert "table ip kube-proxy" in text
+        assert "numgen random mod 3" in text
+        assert text.count("dnat to") == 3
+
+    def test_watch_driven_resync(self):
+        store = _cluster_with_service()
+        proxier = Proxier(store, clock=FakeClock())
+        proxier.sync_all()
+        proxier.process()
+        first = proxier.syncs
+        store.delete("pods", "default/w2")
+        es = EndpointSliceController(store, clock=FakeClock())
+        es.sync_all()
+        es.process()
+        proxier.reconcile_once()
+        assert proxier.syncs > first
+        assert len(proxier.backend.current.rules[0].endpoints) == 2
+
+    def test_throttled_sync_retried_on_next_reconcile(self):
+        clock = FakeClock(start=0.0)
+        store = _cluster_with_service()
+        proxier = Proxier(store, clock=clock, min_sync_interval=1.0)
+        proxier.sync_all()
+        proxier.process()  # first sync at t=0
+        store.delete("pods", "default/w2")
+        es = EndpointSliceController(store, clock=clock)
+        es.sync_all()
+        es.process()
+        proxier.reconcile_once()  # throttled: pending
+        assert len(proxier.backend.current.rules[0].endpoints) == 3  # stale
+        clock.step(1.1)
+        proxier.reconcile_once()  # no new events, but pending retry fires
+        assert len(proxier.backend.current.rules[0].endpoints) == 2
+
+    def test_limitranger_tolerates_null_resources(self):
+        store = APIStore()
+        store.create("limitranges", LimitRange.from_dict({
+            "metadata": {"name": "lr", "namespace": "default"},
+            "spec": {"limits": [{"type": "Container",
+                                 "defaultRequest": {"cpu": "100m"}}]}}))
+        from kubernetes_tpu.api.types import Pod
+
+        pod = Pod.from_dict({"metadata": {"name": "p"},
+                             "spec": {"containers": [
+                                 {"name": "c", "resources": {"requests": None}}]}})
+        admit(store, pod)
+        assert pod.spec.containers[0].resources["requests"]["cpu"] == "100m"
+
+    def test_bounded_frequency(self):
+        clock = FakeClock(start=0.0)
+        calls = []
+        runner = BoundedFrequencyRunner(lambda: calls.append(clock.now()),
+                                        min_interval=10.0, clock=clock)
+        assert runner.run()
+        assert not runner.run()  # throttled
+        clock.step(5)
+        assert not runner.retry_pending()
+        clock.step(6)
+        assert runner.retry_pending()
+        assert calls == [0.0, 11.0]

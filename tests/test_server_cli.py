@@ -232,6 +232,8 @@ def test_serialization_roundtrip_via_server(server):
 
 
 def test_put_honors_url_namespace(server, client):
+    # NamespaceLifecycle admission requires the namespace to exist
+    client.create("namespaces", {"metadata": {"name": "prod"}})
     client.create("pods", {"metadata": {"name": "web", "namespace": "prod"},
                            "spec": {"containers": [{"name": "c"}]}}, namespace="prod")
     obj = client.get("pods", "web", "prod")
