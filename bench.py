@@ -77,6 +77,19 @@ def main():
     print(f"exact scan solver: {n_pods / dt2:.0f} pods/s "
           f"({int((a2 >= 0).sum())}/{n_pods} placed)", file=sys.stderr)
 
+    from kubernetes_tpu.native import native_available, native_greedy_solve
+    from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors, build_pod_batch
+
+    if native_available():
+        t0 = time.perf_counter()
+        cluster = build_cluster_tensors(snap)
+        batch = build_pod_batch(pods, snap, cluster)
+        a3, placed = native_greedy_solve(cluster, batch)
+        dt3 = time.perf_counter() - t0
+        print(f"native C++ engine (CPU fallback, scan parity): "
+              f"{n_pods / dt3:.0f} pods/s ({placed}/{n_pods} placed)",
+              file=sys.stderr)
+
     print(json.dumps({
         "metric": "scheduling_throughput_5000nodes_10000pods",
         "value": round(pods_per_sec, 1),

@@ -1,0 +1,14 @@
+"""Native host runtime: C++ scheduler engine behind a ctypes boundary.
+
+The compute path of this build is JAX/XLA on TPU; the host runtime around it —
+here, the CPU-fallback batch engine mirroring ops/solver.py's scan solver —
+is native C++ (hostsched.cpp), compiled on first use with the toolchain's g++
+and loaded via ctypes. `native_available()` gates callers; everything degrades
+to the JAX/numpy paths when no compiler is present.
+"""
+
+from .hostsched import (  # noqa: F401
+    native_available,
+    native_greedy_solve,
+    native_solvable,
+)

@@ -1,0 +1,135 @@
+"""ctypes loader + wrapper for the C++ host scheduler engine (hostsched.cpp).
+
+Compiles the shared object on first use (g++ -O3, cached beside the source,
+rebuilt when the source is newer) and exposes `native_greedy_solve`, which
+matches ops/solver.py greedy_scan_solve's assignment semantics for batches
+without topology-spread constraints (`native_solvable` checks that).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "hostsched.cpp")
+_SO = os.path.join(_HERE, "_hostsched.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_build_error: Optional[str] = None
+
+
+def _build() -> Optional[str]:
+    """Compile the .so if missing/stale. Returns an error string or None."""
+    try:
+        if (os.path.exists(_SO)
+                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+            return None
+        # per-process temp name: concurrent builds (pytest workers, daemon +
+        # bench on a fresh checkout) must not interleave writes into one file
+        tmp = f"{_SO}.tmp{os.getpid()}"
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+        if proc.returncode != 0:
+            return f"g++ failed: {proc.stderr[-500:]}"
+        os.replace(tmp, _SO)  # atomic: a concurrent loader sees old or new
+        return None
+    except (OSError, subprocess.SubprocessError) as e:
+        return str(e)
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        err = _build()
+        if err is not None:
+            _build_error = err
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+            u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+            lib.greedy_assign.restype = ctypes.c_int64
+            lib.greedy_assign.argtypes = [
+                i32p, i32p, i32p, i32p, i32p,  # alloc, used, used_nz, pod_count, max_pods
+                u8p, i32p, u8p, i32p, i32p,  # static_ok, napref, has_napref, taint, img
+                u8p, u8p,  # class_ports, node_ports
+                i32p, i32p, i32p, u8p,  # class_of_pod, req, req_nz, bal_active
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                u8p, i32p,  # feas_buf, assignment
+            ]
+        except (OSError, AttributeError) as e:
+            # corrupt/incompatible .so: degrade, never raise from available()
+            _build_error = f"load failed: {e}"
+            return None
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+def native_solvable(batch) -> bool:
+    """The native engine covers batches with no topology-spread constraints
+    and no fallback-class pods (those carry semantics it does not model)."""
+    return (batch.ct_class.size == 0 and batch.st_class.size == 0
+            and not batch.fallback_class[batch.class_of_pod].any())
+
+
+def native_greedy_solve(cluster, batch) -> Tuple[np.ndarray, int]:
+    """Run the C++ engine on numpy ClusterTensors + PodBatchTensors.
+
+    Returns (assignment[P] int32 with -1 for unschedulable, placed count).
+    Raises RuntimeError when the native library is unavailable or the batch
+    needs features the engine does not model (check native_solvable first).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native engine unavailable: {_build_error}")
+    if not native_solvable(batch):
+        raise RuntimeError("batch needs topology-spread/fallback semantics")
+    t = batch.tables
+    n = cluster.n
+    p = batch.p
+    r = len(cluster.resource_dims)
+    used = np.ascontiguousarray(cluster.used, np.int32).copy()
+    used_nz = np.ascontiguousarray(cluster.used_nz, np.int32).copy()
+    pod_count = np.ascontiguousarray(cluster.pod_count, np.int32).copy()
+    node_ports = np.ascontiguousarray(t.node_ports, np.uint8).copy()
+    class_ports = np.ascontiguousarray(t.class_ports, np.uint8)
+    pt = class_ports.shape[1] if class_ports.size else 0
+    if pt == 0:
+        class_ports = np.zeros((max(t.filter_ok.shape[0], 1), 1), np.uint8)
+        node_ports = np.zeros((n, 1), np.uint8)
+        pt = 0  # engine skips port checks when pt == 0
+    assignment = np.full(p, -1, np.int32)
+    feas_buf = np.zeros(n, np.uint8)
+    placed = lib.greedy_assign(
+        np.ascontiguousarray(cluster.alloc, np.int32), used, used_nz,
+        pod_count, np.ascontiguousarray(cluster.max_pods, np.int32),
+        np.ascontiguousarray(t.filter_ok, np.uint8),
+        np.ascontiguousarray(t.napref_raw, np.int32),
+        np.ascontiguousarray(t.has_napref, np.uint8),
+        np.ascontiguousarray(t.taint_cnt, np.int32),
+        np.ascontiguousarray(t.img_score, np.int32),
+        class_ports, node_ports,
+        np.ascontiguousarray(batch.class_of_pod, np.int32),
+        np.ascontiguousarray(batch.req, np.int32),
+        np.ascontiguousarray(batch.req_nz, np.int32),
+        np.ascontiguousarray(batch.balanced_active, np.uint8),
+        p, n, r, pt, feas_buf, assignment)
+    return assignment, int(placed)

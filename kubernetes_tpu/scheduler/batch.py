@@ -28,8 +28,9 @@ from .serial import Scheduler
 class BatchScheduler(Scheduler):
     """solver: 'exact' (scan, bit-parity with serial), 'fast' (water-filling),
     'auction' / 'sinkhorn' (global transportation solvers with warm-started
-    duals — models/transport.py), or 'auto' (fast when the batch has no
-    topology-spread constraints, exact otherwise)."""
+    duals — models/transport.py), 'native' (the C++ host engine — scan parity
+    for constraint-free batches; native/hostsched.cpp), or 'auto' (fast when
+    the batch has no topology-spread constraints, exact otherwise)."""
 
     def __init__(self, store: APIStore, framework: Framework, batch_size: int = 4096,
                  solver: str = "exact", **kw):
@@ -68,7 +69,6 @@ class BatchScheduler(Scheduler):
 
         if device_idx.size:
             sub = _subset_batch(batch, device_idx)
-            inputs, d_max = make_inputs(cluster, sub)
             # 'fast' means fast-when-legal: the water-fill kernel has no
             # topology-spread handling, so constrained batches always take the
             # exact scan path regardless of mode.
@@ -76,6 +76,15 @@ class BatchScheduler(Scheduler):
             use_fast = self.solver in ("fast", "auto") and constraint_free
             use_transport = self.solver in ("auction", "sinkhorn") and constraint_free
             assignment = None
+            if self.solver == "native" and constraint_free:
+                from ..native import native_available, native_greedy_solve
+
+                if native_available():
+                    assignment, _ = native_greedy_solve(cluster, sub)
+            # device upload happens only for paths that consume it
+            inputs = d_max = None
+            if assignment is None:
+                inputs, d_max = make_inputs(cluster, sub)
             if use_transport:
                 from ..models.transport import transport_solve
                 from ..models.waterfill import make_groups
