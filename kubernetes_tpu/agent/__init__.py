@@ -1,3 +1,13 @@
 """L6 — node agent (hollow/kubemark-style kubelet)."""
 
+from .cri import CRIRuntime, FakeRuntime  # noqa: F401
 from .hollow import HollowCluster, HollowKubelet  # noqa: F401
+from .kubelet import (  # noqa: F401
+    CheckpointManager,
+    CorruptCheckpointError,
+    EvictionConfig,
+    EvictionManager,
+    Kubelet,
+    PLEG,
+    ProbeSpec,
+)

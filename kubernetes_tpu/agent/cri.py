@@ -1,0 +1,195 @@
+"""CRI boundary: the container-runtime interface the kubelet drives, plus the
+fake runtime used by the hollow/test node agent.
+
+reference: staging/src/k8s.io/cri-api/pkg/apis/runtime/v1/api.proto — the 34
+RuntimeService/ImageService rpcs; the subset modeled here is the pod/container
+lifecycle the kubelet's syncPod path exercises (RunPodSandbox, CreateContainer,
+StartContainer, StopContainer, StopPodSandbox, RemovePodSandbox,
+ListPodSandbox, ListContainers, ContainerStatus, PullImage). The fake mirrors
+pkg/kubelet/container/testing.FakeRuntime / kubemark's containertest.FakeOS:
+state transitions without a kernel.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# container states (api.proto ContainerState)
+CONTAINER_CREATED = "CONTAINER_CREATED"
+CONTAINER_RUNNING = "CONTAINER_RUNNING"
+CONTAINER_EXITED = "CONTAINER_EXITED"
+
+SANDBOX_READY = "SANDBOX_READY"
+SANDBOX_NOTREADY = "SANDBOX_NOTREADY"
+
+_ids = itertools.count(1)
+
+
+@dataclass
+class ContainerStatus:
+    id: str
+    name: str
+    image: str
+    state: str = CONTAINER_CREATED
+    exit_code: int = 0
+    started_at: float = 0.0
+    finished_at: float = 0.0
+    restart_count: int = 0
+
+
+@dataclass
+class PodSandboxStatus:
+    id: str
+    pod_key: str  # "ns/name"
+    uid: str
+    state: str = SANDBOX_READY
+    containers: Dict[str, ContainerStatus] = field(default_factory=dict)  # by name
+
+
+class CRIRuntime:
+    """The RuntimeService surface the kubelet calls (gRPC in the reference)."""
+
+    def version(self) -> str:
+        raise NotImplementedError
+
+    def run_pod_sandbox(self, pod_key: str, uid: str) -> str:
+        raise NotImplementedError
+
+    def stop_pod_sandbox(self, sandbox_id: str) -> None:
+        raise NotImplementedError
+
+    def remove_pod_sandbox(self, sandbox_id: str) -> None:
+        raise NotImplementedError
+
+    def list_pod_sandboxes(self) -> List[PodSandboxStatus]:
+        raise NotImplementedError
+
+    def create_container(self, sandbox_id: str, name: str, image: str) -> str:
+        raise NotImplementedError
+
+    def start_container(self, sandbox_id: str, name: str) -> None:
+        raise NotImplementedError
+
+    def stop_container(self, sandbox_id: str, name: str) -> None:
+        raise NotImplementedError
+
+    def pull_image(self, image: str) -> None:
+        raise NotImplementedError
+
+
+class FakeRuntime(CRIRuntime):
+    """In-memory runtime. Containers run until `exit_container` is called or
+    their image's configured `run_duration` elapses on `tick(now)` — which is
+    how tests/hollow clusters simulate Jobs finishing."""
+
+    def __init__(self, clock=None):
+        from ..utils import Clock
+
+        self.clock = clock or Clock()
+        self._lock = threading.RLock()
+        self.sandboxes: Dict[str, PodSandboxStatus] = {}
+        self.pulled_images: List[str] = []
+        self.run_durations: Dict[str, float] = {}  # image -> seconds until exit 0
+        self.fail_images: Dict[str, int] = {}  # image -> exit code on completion
+        self.calls: List[str] = []  # rpc log (FakeRuntime.CalledFunctions)
+
+    # -- RuntimeService --------------------------------------------------------
+
+    def version(self) -> str:
+        return "0.1.0-faker"
+
+    def run_pod_sandbox(self, pod_key: str, uid: str) -> str:
+        with self._lock:
+            self.calls.append("RunPodSandbox")
+            sid = f"sandbox-{next(_ids)}"
+            self.sandboxes[sid] = PodSandboxStatus(id=sid, pod_key=pod_key, uid=uid)
+            return sid
+
+    def stop_pod_sandbox(self, sandbox_id: str) -> None:
+        with self._lock:
+            self.calls.append("StopPodSandbox")
+            sb = self.sandboxes.get(sandbox_id)
+            if sb is None:
+                return
+            sb.state = SANDBOX_NOTREADY
+            for c in sb.containers.values():
+                if c.state == CONTAINER_RUNNING:
+                    c.state = CONTAINER_EXITED
+                    c.exit_code = 137  # SIGKILL
+                    c.finished_at = self.clock.now()
+
+    def remove_pod_sandbox(self, sandbox_id: str) -> None:
+        with self._lock:
+            self.calls.append("RemovePodSandbox")
+            self.sandboxes.pop(sandbox_id, None)
+
+    def list_pod_sandboxes(self) -> List[PodSandboxStatus]:
+        with self._lock:
+            self.calls.append("ListPodSandbox")
+            return list(self.sandboxes.values())
+
+    def create_container(self, sandbox_id: str, name: str, image: str) -> str:
+        with self._lock:
+            self.calls.append("CreateContainer")
+            sb = self.sandboxes[sandbox_id]
+            prev = sb.containers.get(name)
+            c = ContainerStatus(id=f"container-{next(_ids)}", name=name, image=image,
+                                restart_count=prev.restart_count + 1 if prev else 0)
+            sb.containers[name] = c
+            return c.id
+
+    def start_container(self, sandbox_id: str, name: str) -> None:
+        with self._lock:
+            self.calls.append("StartContainer")
+            c = self.sandboxes[sandbox_id].containers[name]
+            c.state = CONTAINER_RUNNING
+            c.started_at = self.clock.now()
+
+    def stop_container(self, sandbox_id: str, name: str) -> None:
+        with self._lock:
+            self.calls.append("StopContainer")
+            c = self.sandboxes[sandbox_id].containers[name]
+            if c.state == CONTAINER_RUNNING:
+                c.state = CONTAINER_EXITED
+                c.exit_code = 137
+                c.finished_at = self.clock.now()
+
+    def pull_image(self, image: str) -> None:
+        with self._lock:
+            self.calls.append("PullImage")
+            self.pulled_images.append(image)
+
+    # -- test hooks ------------------------------------------------------------
+
+    def exit_container(self, pod_key: str, name: str, exit_code: int = 0) -> None:
+        with self._lock:
+            for sb in self.sandboxes.values():
+                if sb.pod_key == pod_key and name in sb.containers:
+                    c = sb.containers[name]
+                    if c.state == CONTAINER_RUNNING:
+                        c.state = CONTAINER_EXITED
+                        c.exit_code = exit_code
+                        c.finished_at = self.clock.now()
+
+    def tick(self) -> None:
+        """Expire containers whose image has a configured run duration."""
+        now = self.clock.now()
+        with self._lock:
+            for sb in self.sandboxes.values():
+                for c in sb.containers.values():
+                    dur = self.run_durations.get(c.image)
+                    if (dur is not None and c.state == CONTAINER_RUNNING
+                            and now - c.started_at >= dur):
+                        c.state = CONTAINER_EXITED
+                        c.exit_code = self.fail_images.get(c.image, 0)
+                        c.finished_at = now
+
+    def sandbox_for(self, pod_key: str) -> Optional[PodSandboxStatus]:
+        with self._lock:
+            for sb in self.sandboxes.values():
+                if sb.pod_key == pod_key and sb.state == SANDBOX_READY:
+                    return sb
+            return None

@@ -1,0 +1,511 @@
+"""Node agent with the kubelet's real internal structure against the CRI
+boundary: pod workers, PLEG, probers, status manager, eviction manager,
+checksummed checkpoints, Lease heartbeat.
+
+reference: pkg/kubelet — syncLoop/syncLoopIteration (kubelet.go:2410/:2484)
+selects over config updates, PLEG events, probe results and housekeeping;
+per-pod workers (pod_workers.go:735); PLEG 1s relist (pleg/generic.go:163);
+status manager PATCHes phase/conditions; eviction manager watches memory
+signals (pkg/kubelet/eviction); checkpoint manager writes checksummed local
+state (pkg/kubelet/checkpointmanager). Driven by `tick()` under a fake clock
+in tests or `start()` as a daemon.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..api import Node, Pod
+from ..api.types import FAILED, ObjectMeta, RUNNING, SUCCEEDED, new_uid
+from ..api.workloads import Lease
+from ..store import AlreadyExistsError, APIStore, ConflictError, NotFoundError
+from ..utils import Clock
+from .cri import CONTAINER_EXITED, CONTAINER_RUNNING, CRIRuntime, FakeRuntime
+
+LEASE_NAMESPACE = "kube-node-lease"
+
+
+# ---------------------------------------------------------------------------
+# PLEG — pod lifecycle event generator (pleg/generic.go)
+# ---------------------------------------------------------------------------
+
+CONTAINER_STARTED = "ContainerStarted"
+CONTAINER_DIED = "ContainerDied"
+
+
+@dataclass(frozen=True)
+class PodLifecycleEvent:
+    pod_key: str
+    type: str
+    container: str
+
+
+class PLEG:
+    """Relists the runtime, diffs against the previous snapshot, and emits
+    per-container lifecycle events (generic.go relist)."""
+
+    def __init__(self, runtime: CRIRuntime, relist_period: float = 1.0,
+                 clock: Optional[Clock] = None):
+        self.runtime = runtime
+        self.relist_period = relist_period
+        self.clock = clock or Clock()
+        self._last_states: Dict[Tuple[str, str], str] = {}  # (pod, container) -> state
+        self._last_relist = float("-inf")
+
+    def relist(self, force: bool = False) -> List[PodLifecycleEvent]:
+        now = self.clock.now()
+        if not force and now - self._last_relist < self.relist_period:
+            return []
+        self._last_relist = now
+        states: Dict[Tuple[str, str], str] = {}
+        events: List[PodLifecycleEvent] = []
+        for sb in self.runtime.list_pod_sandboxes():
+            for c in sb.containers.values():
+                key = (sb.pod_key, c.name)
+                states[key] = c.state
+                prev = self._last_states.get(key)
+                if prev != c.state:
+                    if c.state == CONTAINER_RUNNING:
+                        events.append(PodLifecycleEvent(sb.pod_key, CONTAINER_STARTED, c.name))
+                    elif c.state == CONTAINER_EXITED:
+                        events.append(PodLifecycleEvent(sb.pod_key, CONTAINER_DIED, c.name))
+        self._last_states = states
+        return events
+
+
+# ---------------------------------------------------------------------------
+# probers (pkg/kubelet/prober)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ProbeSpec:
+    """Liveness/readiness probe config; the probe itself is a callable (the
+    fake of an HTTP/exec probe) returning bool."""
+
+    kind: str  # "liveness" | "readiness"
+    probe: Callable[[], bool]
+    period: float = 10.0
+    failure_threshold: int = 3
+    success_threshold: int = 1
+
+
+class ProbeWorker:
+    def __init__(self, spec: ProbeSpec, clock: Clock):
+        self.spec = spec
+        self.clock = clock
+        self._last_run = float("-inf")
+        self._failures = 0
+        self._successes = 0
+        self.healthy = True
+
+    def tick(self) -> Optional[bool]:
+        """Run if due; returns new health state on transition, else None."""
+        now = self.clock.now()
+        if now - self._last_run < self.spec.period:
+            return None
+        self._last_run = now
+        ok = bool(self.spec.probe())
+        if ok:
+            self._successes += 1
+            self._failures = 0
+            if not self.healthy and self._successes >= self.spec.success_threshold:
+                self.healthy = True
+                return True
+        else:
+            self._failures += 1
+            self._successes = 0
+            if self.healthy and self._failures >= self.spec.failure_threshold:
+                self.healthy = False
+                return False
+        return None
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manager (pkg/kubelet/checkpointmanager/checkpoint_manager.go)
+# ---------------------------------------------------------------------------
+
+
+class CorruptCheckpointError(Exception):
+    pass
+
+
+class CheckpointManager:
+    """Checksummed JSON state files; a bad checksum is surfaced, never
+    silently loaded (checksum.go Verify)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.directory, f"{key}.json")
+
+    def save(self, key: str, data: dict) -> None:
+        payload = json.dumps(data, sort_keys=True)
+        checksum = hashlib.sha256(payload.encode()).hexdigest()
+        tmp = self._path(key) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"checksum": checksum, "data": payload}, f)
+        os.replace(tmp, self._path(key))
+
+    def load(self, key: str) -> Optional[dict]:
+        try:
+            with open(self._path(key)) as f:
+                wrapper = json.load(f)
+        except FileNotFoundError:
+            return None
+        except json.JSONDecodeError as e:
+            raise CorruptCheckpointError(str(e))
+        if not isinstance(wrapper, dict):
+            raise CorruptCheckpointError(f"checkpoint {key!r} is not an object")
+        payload = wrapper.get("data", "")
+        if hashlib.sha256(payload.encode()).hexdigest() != wrapper.get("checksum"):
+            raise CorruptCheckpointError(f"checksum mismatch for {key!r}")
+        return json.loads(payload)
+
+    def remove(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# eviction manager (pkg/kubelet/eviction)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EvictionConfig:
+    memory_available_threshold: int = 100 * 1024 * 1024  # evictionHard memory.available
+
+
+class EvictionManager:
+    """Ranks pods for eviction under memory pressure: pods exceeding their
+    requests first, then lowest priority, then highest usage
+    (eviction/helpers.go rankMemoryPressure)."""
+
+    def __init__(self, config: EvictionConfig,
+                 stats: Callable[[], Dict[str, int]],
+                 usage_of: Callable[[Pod], int]):
+        self.config = config
+        self.stats = stats
+        self.usage_of = usage_of
+        self.under_pressure = False
+
+    def select_victim(self, pods: List[Pod]) -> Optional[Pod]:
+        available = self.stats().get("memory_available", 1 << 62)
+        self.under_pressure = available < self.config.memory_available_threshold
+        if not self.under_pressure or not pods:
+            return None
+        from ..api import compute_pod_resource_request
+
+        def rank(p: Pod):
+            usage = self.usage_of(p)
+            req = compute_pod_resource_request(p).memory
+            exceeds = usage > req
+            return (not exceeds, p.spec.priority, -usage)
+
+        return sorted(pods, key=rank)[0]
+
+
+# ---------------------------------------------------------------------------
+# the kubelet
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _PodWorker:
+    """Per-pod worker state (pod_workers.go podSyncStatus)."""
+
+    pod: Pod
+    sandbox_id: str = ""
+    terminating: bool = False
+    probes: List[ProbeWorker] = field(default_factory=list)
+    ready: bool = True
+
+
+class Kubelet:
+    """Real sync-loop structure against a (fake) CRI runtime."""
+
+    def __init__(self, store: APIStore, node_name: str,
+                 runtime: Optional[CRIRuntime] = None,
+                 capacity: Optional[Dict] = None,
+                 labels: Optional[Dict[str, str]] = None,
+                 clock: Optional[Clock] = None,
+                 checkpoint_dir: Optional[str] = None,
+                 eviction: Optional[EvictionManager] = None,
+                 relist_period: float = 1.0,
+                 heartbeat_period: float = 10.0):
+        self.store = store
+        self.node_name = node_name
+        self.clock = clock or Clock()
+        self.runtime = runtime or FakeRuntime(clock=self.clock)
+        self.capacity = capacity or {"cpu": "8", "memory": "32Gi", "pods": "110"}
+        self.labels = labels or {}
+        self.pleg = PLEG(self.runtime, relist_period=relist_period, clock=self.clock)
+        self.workers: Dict[str, _PodWorker] = {}
+        self.eviction = eviction
+        self.heartbeat_period = heartbeat_period
+        self._last_heartbeat = float("-inf")
+        self.checkpoints = (CheckpointManager(checkpoint_dir)
+                            if checkpoint_dir else None)
+        self._watch = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # probe factories: pod key -> list of ProbeSpec (tests inject fakes)
+        self.probe_factory: Callable[[Pod], List[ProbeSpec]] = lambda pod: []
+
+    # -- registration + heartbeat ---------------------------------------------
+
+    def register(self) -> None:
+        from .nodeutil import register_node
+
+        register_node(self.store, self.node_name, self.capacity, self.labels)
+        self.heartbeat()
+        _, rv = self.store.list("pods")
+        self._watch = self.store.watch("pods", since_rv=rv)
+        # adopt pods already bound here (restart recovery: state comes from
+        # the store + runtime relist, kubelet is stateless modulo checkpoints)
+        pods, _ = self.store.list("pods", lambda p: p.spec.node_name == self.node_name)
+        for p in pods:
+            if not p.is_terminal():
+                self._start_pod(p)
+        if self.checkpoints is not None:
+            self.checkpoints.save("node-registration", {"node": self.node_name})
+
+    def heartbeat(self) -> None:
+        from .nodeutil import renew_lease
+
+        now = self.clock.now()
+        self._last_heartbeat = now
+        renew_lease(self.store, self.node_name, now)
+
+    # -- syncLoopIteration ----------------------------------------------------
+
+    def tick(self) -> int:
+        """One syncLoopIteration: config updates -> runtime tick -> PLEG ->
+        probes -> eviction -> heartbeat. Returns #events handled."""
+        n = self._pump_config()
+        if isinstance(self.runtime, FakeRuntime):
+            self.runtime.tick()
+        for ev in self.pleg.relist():
+            n += 1
+            self._handle_pleg_event(ev)
+        self._tick_probes()
+        self._tick_eviction()
+        if self.clock.now() - self._last_heartbeat >= self.heartbeat_period:
+            self.heartbeat()
+        return n
+
+    def _pump_config(self) -> int:
+        if self._watch is None:
+            return 0
+        n = 0
+        for ev in self._watch.drain():
+            pod = ev.obj
+            if pod.spec.node_name != self.node_name:
+                continue
+            n += 1
+            if ev.type == "DELETED":
+                self._stop_pod(pod.key)
+            elif pod.is_terminal():
+                continue  # our own status write echoed back
+            elif pod.key not in self.workers:
+                self._start_pod(pod)
+        return n
+
+    def _start_pod(self, pod: Pod) -> None:
+        """SyncPod: sandbox, image pulls, containers (kuberuntime SyncPod)."""
+        existing = (self.runtime.sandbox_for(pod.key)
+                    if hasattr(self.runtime, "sandbox_for") else None)
+        if existing is not None:
+            sid = existing.id
+        else:
+            sid = self.runtime.run_pod_sandbox(pod.key, pod.metadata.uid)
+            for c in pod.spec.containers:
+                image = c.image or "pause"
+                self.runtime.pull_image(image)
+                self.runtime.create_container(sid, c.name or "main", image)
+                self.runtime.start_container(sid, c.name or "main")
+        worker = _PodWorker(pod=pod, sandbox_id=sid)
+        worker.probes = [ProbeWorker(s, self.clock) for s in self.probe_factory(pod)]
+        self.workers[pod.key] = worker
+        self._write_phase(pod.key, RUNNING)
+
+    def _stop_pod(self, pod_key: str) -> None:
+        worker = self.workers.pop(pod_key, None)
+        if worker is not None and worker.sandbox_id:
+            self.runtime.stop_pod_sandbox(worker.sandbox_id)
+            self.runtime.remove_pod_sandbox(worker.sandbox_id)
+
+    def _handle_pleg_event(self, ev: PodLifecycleEvent) -> None:
+        worker = self.workers.get(ev.pod_key)
+        if worker is None:
+            return
+        if ev.type == CONTAINER_DIED:
+            self._sync_pod_status(worker)
+
+    def _sync_pod_status(self, worker: _PodWorker) -> None:
+        """Phase from container states (kubelet_pods.go getPhase):
+        all exited 0 -> Succeeded; any exited non-0 with restartPolicy Never ->
+        Failed; exited with Always/OnFailure -> restart."""
+        sb = self.runtime.sandbox_for(worker.pod.key)
+        if sb is None:
+            return
+        statuses = list(sb.containers.values())
+        exited = [c for c in statuses if c.state == CONTAINER_EXITED]
+        if not exited:
+            return
+        policy = worker.pod.spec.restart_policy
+        failed = [c for c in exited if c.exit_code != 0]
+        if len(exited) == len(statuses):
+            if not failed and policy != "Always":
+                self._write_phase(worker.pod.key, SUCCEEDED)
+                self.workers.pop(worker.pod.key, None)
+                return
+            if failed and policy == "Never":
+                self._write_phase(worker.pod.key, FAILED)
+                self.workers.pop(worker.pod.key, None)
+                return
+        # restart path (Always, or OnFailure with non-zero exits); Never
+        # containers stay exited even while siblings run
+        if policy == "Never":
+            return
+        for c in exited:
+            if c.exit_code == 0 and policy == "OnFailure":
+                continue
+            self.runtime.create_container(sb.id, c.name, c.image)
+            self.runtime.start_container(sb.id, c.name)
+
+    def _tick_probes(self) -> None:
+        for worker in list(self.workers.values()):
+            for pw in worker.probes:
+                changed = pw.tick()
+                if changed is None:
+                    continue
+                if pw.spec.kind == "readiness":
+                    worker.ready = all(
+                        p.healthy for p in worker.probes
+                        if p.spec.kind == "readiness")
+                    self._write_ready(worker.pod.key, worker.ready)
+                elif pw.spec.kind == "liveness" and changed is False:
+                    # liveness failure: kill + restart per policy
+                    sb = self.runtime.sandbox_for(worker.pod.key)
+                    if sb is None:
+                        continue
+                    for name in list(sb.containers):
+                        self.runtime.stop_container(sb.id, name)
+                        if worker.pod.spec.restart_policy != "Never":
+                            self.runtime.create_container(
+                                sb.id, name, sb.containers[name].image)
+                            self.runtime.start_container(sb.id, name)
+                    if worker.pod.spec.restart_policy == "Never":
+                        self._write_phase(worker.pod.key, FAILED)
+                        self.workers.pop(worker.pod.key, None)
+
+    def _tick_eviction(self) -> None:
+        if self.eviction is None:
+            return
+        victim = self.eviction.select_victim(
+            [w.pod for w in self.workers.values() if not w.terminating])
+        # pressure state comes from the signal, not from victim availability:
+        # a pressured node with nothing evictable still reports pressure
+        self._set_pressure_condition(self.eviction.under_pressure)
+        if victim is None:
+            return
+        self._stop_pod(victim.key)
+        from ..api.types import PodCondition
+
+        def mark_evicted(st):
+            st.phase = FAILED
+            st.conditions.append(PodCondition(
+                type="DisruptionTarget", status="True",
+                reason="TerminationByKubelet",
+                message="evicted: node memory pressure",
+                last_transition_time=self.clock.now()))
+
+        try:
+            self.store.update_pod_status(
+                victim.metadata.namespace, victim.metadata.name, mark_evicted)
+        except (NotFoundError, ConflictError):
+            pass
+
+    def _set_pressure_condition(self, pressure: bool) -> None:
+        from ..api.types import NodeCondition
+
+        # only write on transition: a no-op write per tick would bump the
+        # node's resourceVersion and wake every node watcher
+        if getattr(self, "_last_pressure", None) == pressure:
+            return
+        self._last_pressure = pressure
+
+        def mutate(node: Node) -> Node:
+            node.status.conditions = [
+                c for c in node.status.conditions if c.type != "MemoryPressure"]
+            node.status.conditions.append(NodeCondition(
+                type="MemoryPressure", status="True" if pressure else "False",
+                reason="KubeletHasInsufficientMemory" if pressure
+                else "KubeletHasSufficientMemory",
+                last_transition_time=self.clock.now()))
+            return node
+
+        try:
+            self.store.guaranteed_update("nodes", self.node_name, mutate)
+        except NotFoundError:
+            pass
+
+    # -- status writes ---------------------------------------------------------
+
+    def _write_phase(self, pod_key: str, phase: str) -> None:
+        ns, name = pod_key.split("/", 1)
+        try:
+            self.store.update_pod_status(ns, name,
+                                         lambda st: setattr(st, "phase", phase))
+        except (NotFoundError, ConflictError):
+            pass
+
+    def _write_ready(self, pod_key: str, ready: bool) -> None:
+        ns, name = pod_key.split("/", 1)
+        from ..api.types import PodCondition
+
+        def mutate(st):
+            st.conditions = [c for c in st.conditions if c.type != "Ready"]
+            st.conditions.append(PodCondition(
+                type="Ready", status="True" if ready else "False",
+                last_transition_time=self.clock.now()))
+
+        try:
+            self.store.update_pod_status(ns, name, mutate)
+        except (NotFoundError, ConflictError):
+            pass
+
+    # -- daemon mode -----------------------------------------------------------
+
+    def start(self, interval: float = 0.05) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                self.tick()
+                self.clock.sleep(interval)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+        if self._watch is not None:
+            self._watch.stop()
+            self._watch = None

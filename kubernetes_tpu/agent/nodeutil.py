@@ -1,0 +1,51 @@
+"""Shared node-agent plumbing: Node registration and Lease renewal.
+
+reference: pkg/kubelet/nodestatus (node object construction) and
+pkg/kubelet/nodelease (the 10s Lease heartbeat) — used by both the full
+Kubelet and the hollow kubemark agent.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from ..api import Node
+from ..api.types import ObjectMeta, new_uid
+from ..api.workloads import Lease
+from ..store import AlreadyExistsError, APIStore, NotFoundError
+
+LEASE_NAMESPACE = "kube-node-lease"
+
+
+def register_node(store: APIStore, node_name: str, capacity: Dict,
+                  labels: Optional[Dict[str, str]] = None) -> None:
+    """Create the Node object if absent (idempotent re-register)."""
+    all_labels = {"kubernetes.io/hostname": node_name, **(labels or {})}
+    node = Node(metadata=ObjectMeta(name=node_name, namespace="", uid=new_uid(),
+                                    labels=all_labels))
+    node.status.capacity = dict(capacity)
+    node.status.allocatable = dict(capacity)
+    try:
+        store.create("nodes", node)
+    except AlreadyExistsError:
+        pass
+
+
+def renew_lease(store: APIStore, node_name: str, now: float) -> None:
+    """Renew (or create) the node's coordination Lease."""
+    key = f"{LEASE_NAMESPACE}/{node_name}"
+    try:
+        def renew(lease: Lease) -> Lease:
+            lease.renew_time = now
+            lease.holder_identity = node_name
+            return lease
+
+        store.guaranteed_update("leases", key, renew)
+    except NotFoundError:
+        lease = Lease(metadata=ObjectMeta(name=node_name,
+                                          namespace=LEASE_NAMESPACE, uid=new_uid()),
+                      holder_identity=node_name, acquire_time=now, renew_time=now)
+        try:
+            store.create("leases", lease)
+        except AlreadyExistsError:
+            pass

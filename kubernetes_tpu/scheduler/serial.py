@@ -257,9 +257,21 @@ class Scheduler:
     # -- core scheduling (schedule_one.go) -------------------------------------
 
     def schedule_pod(self, pod: Pod, snapshot: Optional[Snapshot] = None) -> ScheduleResult:
-        """schedulePod :410 — snapshot, prefilter, filter, score, select."""
+        """schedulePod :410 — snapshot, prefilter, filter, score, select.
+        Traced with the reference's 100ms log threshold (schedule_one.go:411)."""
+        from ..utils.tracing import Trace
+
+        trace = Trace("Scheduling", pod=pod.key)
+        try:
+            return self._schedule_pod_traced(pod, snapshot, trace)
+        finally:
+            trace.log_if_long(0.1)
+
+    def _schedule_pod_traced(self, pod: Pod, snapshot: Optional[Snapshot],
+                             trace) -> ScheduleResult:
         if snapshot is None:
             snapshot = self.cache.update_snapshot()
+            trace.step("Snapshotting scheduler cache done")
         res = ScheduleResult()
         if len(snapshot) == 0:
             res.status = Status.unschedulable("no nodes available to schedule pods")
@@ -326,11 +338,15 @@ class Scheduler:
             keep = set(names)
             feasible = [ni for ni in feasible if ni.node.metadata.name in keep]
         res.feasible_nodes = len(feasible)
+        trace.step("Computing predicates done",
+                   evaluated=res.evaluated_nodes, feasible=len(feasible))
         if not feasible:
             res.status = Status.unschedulable(
                 f"0/{len(snapshot)} nodes are available", plugin="")
             return res
-        return self._score_and_select(state, pod, feasible, res)
+        out = self._score_and_select(state, pod, feasible, res)
+        trace.step("Prioritizing done")
+        return out
 
     def _score_and_select(self, state: CycleState, pod, feasible: List[NodeInfo],
                           res: ScheduleResult) -> ScheduleResult:

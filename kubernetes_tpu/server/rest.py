@@ -106,6 +106,18 @@ class _Handler(BaseHTTPRequestHandler):
         if url.path == "/version":
             self._send_json(200, {"gitVersion": "v0.1.0-kubernetes-tpu"})
             return
+        if url.path == "/configz":
+            from ..utils.tracing import configz_snapshot
+
+            # configs may be arbitrary objects; coerce like the JSON logger
+            body = json.dumps(configz_snapshot(), default=lambda o: vars(o)
+                              if hasattr(o, "__dict__") else str(o)).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         parsed = _parse_path(url.path)
         if parsed is None:
             self._error(404, f"unknown path {url.path}")

@@ -1,0 +1,237 @@
+"""Node agent internals: CRI fake, PLEG, pod workers, probes, restart policy,
+eviction, checkpoints — mirrors pkg/kubelet's unit tiers (pleg/generic_test,
+prober tests, eviction helpers tests, checkpointmanager tests)."""
+
+import pytest
+
+from kubernetes_tpu.agent import (
+    CheckpointManager,
+    CorruptCheckpointError,
+    EvictionConfig,
+    EvictionManager,
+    FakeRuntime,
+    Kubelet,
+    PLEG,
+    ProbeSpec,
+)
+from kubernetes_tpu.agent.cri import CONTAINER_EXITED, CONTAINER_RUNNING
+from kubernetes_tpu.store import APIStore, NotFoundError
+from kubernetes_tpu.testing import MakePod
+from kubernetes_tpu.utils import FakeClock
+
+
+def make_kubelet(store=None, clock=None, **kw):
+    store = store or APIStore()
+    clock = clock or FakeClock(start=100.0)
+    kubelet = Kubelet(store, "n1", clock=clock, **kw)
+    kubelet.register()
+    return store, clock, kubelet
+
+
+def bind_pod(store, name, image="app:v1", restart_policy="Always", **podkw):
+    pod = MakePod(name).container(image).node("n1").obj()
+    pod.spec.restart_policy = restart_policy
+    store.create("pods", pod)
+    return pod
+
+
+class TestCRIAndPLEG:
+    def test_sandbox_and_container_lifecycle(self):
+        clock = FakeClock()
+        rt = FakeRuntime(clock=clock)
+        sid = rt.run_pod_sandbox("default/p", "uid-1")
+        rt.create_container(sid, "main", "app:v1")
+        rt.start_container(sid, "main")
+        assert rt.sandbox_for("default/p").containers["main"].state == CONTAINER_RUNNING
+        rt.exit_container("default/p", "main", exit_code=3)
+        c = rt.sandbox_for("default/p").containers["main"]
+        assert c.state == CONTAINER_EXITED and c.exit_code == 3
+        assert "RunPodSandbox" in rt.calls and "StartContainer" in rt.calls
+
+    def test_pleg_emits_started_and_died(self):
+        clock = FakeClock()
+        rt = FakeRuntime(clock=clock)
+        pleg = PLEG(rt, relist_period=1.0, clock=clock)
+        sid = rt.run_pod_sandbox("default/p", "u")
+        rt.create_container(sid, "main", "app:v1")
+        rt.start_container(sid, "main")
+        events = pleg.relist(force=True)
+        assert [(e.type, e.container) for e in events] == [("ContainerStarted", "main")]
+        rt.exit_container("default/p", "main")
+        assert pleg.relist(force=True)[0].type == "ContainerDied"
+        # period gating: no relist before the period elapses
+        assert pleg.relist() == []
+        clock.step(1.1)
+        assert pleg.relist() == []  # no state change, no events
+
+
+class TestKubeletLifecycle:
+    def test_pod_runs_and_heartbeats(self):
+        store, clock, kubelet = make_kubelet()
+        bind_pod(store, "web")
+        kubelet.tick()
+        assert store.get("pods", "default/web").status.phase == "Running"
+        lease = store.get("leases", "kube-node-lease/n1")
+        assert lease.holder_identity == "n1"
+        clock.step(11)
+        kubelet.tick()
+        assert store.get("leases", "kube-node-lease/n1").renew_time == clock.now()
+
+    def test_job_pod_completes_via_run_duration(self):
+        store, clock, kubelet = make_kubelet()
+        kubelet.runtime.run_durations["worker:v1"] = 30.0
+        pod = MakePod("job-1").container("worker:v1").node("n1").obj()
+        pod.spec.restart_policy = "Never"
+        store.create("pods", pod)
+        kubelet.tick()
+        assert store.get("pods", "default/job-1").status.phase == "Running"
+        clock.step(31)
+        kubelet.tick()
+        assert store.get("pods", "default/job-1").status.phase == "Succeeded"
+
+    def test_failing_container_restart_policy_never(self):
+        store, clock, kubelet = make_kubelet()
+        kubelet.runtime.run_durations["crash:v1"] = 5.0
+        kubelet.runtime.fail_images["crash:v1"] = 1
+        pod = MakePod("crasher").container("crash:v1").node("n1").obj()
+        pod.spec.restart_policy = "Never"
+        store.create("pods", pod)
+        kubelet.tick()
+        clock.step(6)
+        kubelet.tick()
+        assert store.get("pods", "default/crasher").status.phase == "Failed"
+
+    def test_always_restart_restarts_container(self):
+        store, clock, kubelet = make_kubelet()
+        kubelet.runtime.run_durations["flaky:v1"] = 5.0
+        bind_pod(store, "flaky", image="flaky:v1", restart_policy="Always")
+        kubelet.tick()
+        clock.step(6)
+        kubelet.tick()  # container died -> restarted
+        sb = kubelet.runtime.sandbox_for("default/flaky")
+        c = sb.containers["c0"]
+        assert c.state == CONTAINER_RUNNING
+        assert c.restart_count == 1
+        assert store.get("pods", "default/flaky").status.phase == "Running"
+
+    def test_pod_deletion_stops_sandbox(self):
+        store, clock, kubelet = make_kubelet()
+        bind_pod(store, "web")
+        kubelet.tick()
+        assert kubelet.runtime.sandbox_for("default/web") is not None
+        store.delete("pods", "default/web")
+        kubelet.tick()
+        assert kubelet.runtime.sandbox_for("default/web") is None
+        assert "StopPodSandbox" in kubelet.runtime.calls
+
+    def test_restart_recovery_adopts_existing_sandbox(self):
+        store, clock, kubelet = make_kubelet()
+        bind_pod(store, "web")
+        kubelet.tick()
+        calls_before = kubelet.runtime.calls.count("RunPodSandbox")
+        # new kubelet instance over the same runtime: no duplicate sandbox
+        kubelet2 = Kubelet(store, "n1", runtime=kubelet.runtime, clock=clock)
+        kubelet2.register()
+        assert kubelet.runtime.calls.count("RunPodSandbox") == calls_before
+
+
+class TestProbes:
+    def _kubelet_with_probe(self, kind, results, restart_policy="Always"):
+        store, clock, kubelet = make_kubelet()
+        seq = iter(results)
+        state = {"last": True}
+
+        def probe():
+            state["last"] = next(seq, state["last"])
+            return state["last"]
+
+        kubelet.probe_factory = lambda pod: [
+            ProbeSpec(kind=kind, probe=probe, period=1.0, failure_threshold=2)]
+        pod = MakePod("probed").container("app:v1").node("n1").obj()
+        pod.spec.restart_policy = restart_policy
+        store.create("pods", pod)
+        kubelet.tick()
+        return store, clock, kubelet
+
+    def test_readiness_flips_ready_condition(self):
+        store, clock, kubelet = self._kubelet_with_probe(
+            "readiness", [True, False, False, True])
+        for _ in range(4):
+            clock.step(1.0)
+            kubelet.tick()
+        pod = store.get("pods", "default/probed")
+        ready = [c for c in pod.status.conditions if c.type == "Ready"]
+        assert ready and ready[-1].status == "True"  # recovered at the end
+
+    def test_liveness_failure_restarts(self):
+        store, clock, kubelet = self._kubelet_with_probe(
+            "liveness", [True, False, False])
+        for _ in range(3):
+            clock.step(1.0)
+            kubelet.tick()
+        sb = kubelet.runtime.sandbox_for("default/probed")
+        assert sb.containers["c0"].restart_count >= 1
+
+    def test_liveness_failure_never_policy_fails_pod(self):
+        store, clock, kubelet = self._kubelet_with_probe(
+            "liveness", [False, False], restart_policy="Never")
+        for _ in range(2):
+            clock.step(1.0)
+            kubelet.tick()
+        assert store.get("pods", "default/probed").status.phase == "Failed"
+
+
+class TestEviction:
+    def test_memory_pressure_evicts_and_sets_condition(self):
+        stats = {"memory_available": 10 * 1024 * 1024 * 1024}
+        usage = {}
+        ev = EvictionManager(
+            EvictionConfig(memory_available_threshold=1024 ** 3),
+            stats=lambda: stats,
+            usage_of=lambda p: usage.get(p.metadata.name, 0))
+        store, clock, kubelet = make_kubelet(eviction=ev)
+        for name, prio in (("low", 0), ("high", 100)):
+            pod = MakePod(name).container("app").req({"memory": "1Gi"}).node("n1").obj()
+            pod.spec.priority = prio
+            store.create("pods", pod)
+        usage["low"] = 2 * 1024 ** 3  # exceeds its request
+        usage["high"] = 512 * 1024 ** 2
+        kubelet.tick()
+        node = store.get("nodes", "n1")
+        assert any(c.type == "MemoryPressure" and c.status == "False"
+                   for c in node.status.conditions)
+        stats["memory_available"] = 100  # pressure!
+        kubelet.tick()
+        low = store.get("pods", "default/low")
+        assert low.status.phase == "Failed"
+        assert any(c.type == "DisruptionTarget" for c in low.status.conditions)
+        assert store.get("pods", "default/high").status.phase == "Running"
+        node = store.get("nodes", "n1")
+        assert any(c.type == "MemoryPressure" and c.status == "True"
+                   for c in node.status.conditions)
+
+
+class TestCheckpoints:
+    def test_roundtrip_and_corruption(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        cm.save("cpu-state", {"assignments": {"pod-a": [0, 1]}})
+        assert cm.load("cpu-state") == {"assignments": {"pod-a": [0, 1]}}
+        # corrupt the payload: checksum must catch it
+        path = tmp_path / "cpu-state.json"
+        import json
+
+        wrapper = json.loads(path.read_text())
+        wrapper["data"] = wrapper["data"].replace("pod-a", "pod-x")
+        path.write_text(json.dumps(wrapper))
+        with pytest.raises(CorruptCheckpointError):
+            cm.load("cpu-state")
+        assert cm.load("missing") is None
+        cm.remove("cpu-state")
+        assert cm.load("cpu-state") is None
+
+    def test_kubelet_writes_registration_checkpoint(self, tmp_path):
+        store = APIStore()
+        kubelet = Kubelet(store, "n1", clock=FakeClock(),
+                          checkpoint_dir=str(tmp_path))
+        kubelet.register()
+        assert kubelet.checkpoints.load("node-registration") == {"node": "n1"}

@@ -1,0 +1,127 @@
+"""Tracing, structured logging, configz, and the scheduler cache debugger."""
+
+import io
+import json
+
+from kubernetes_tpu.scheduler.debugger import compare, dump
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.scheduler.runtime import Framework
+from kubernetes_tpu.scheduler.serial import Scheduler
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+from kubernetes_tpu.utils import FakeClock
+from kubernetes_tpu.utils.tracing import (
+    StructuredLogger,
+    Trace,
+    configz_snapshot,
+    register_config,
+)
+
+
+class TestTrace:
+    def test_below_threshold_not_logged(self):
+        clock = FakeClock()
+        stream = io.StringIO()
+        log = StructuredLogger("test", stream=stream)
+        t = Trace("Op", logger=log, clock=clock)
+        clock.step(0.05)
+        assert not t.log_if_long(0.1)
+        assert stream.getvalue() == ""
+
+    def test_long_trace_logged_with_steps(self):
+        clock = FakeClock()
+        stream = io.StringIO()
+        log = StructuredLogger("test", stream=stream)
+        t = Trace("Scheduling", logger=log, clock=clock, pod="default/p")
+        clock.step(0.08)
+        t.step("Computing predicates done", feasible=3)
+        clock.step(0.07)
+        t.step("Prioritizing done")
+        assert t.log_if_long(0.1)
+        record = json.loads(stream.getvalue())
+        assert record["total_ms"] == 150.0
+        assert record["pod"] == "default/p"
+        steps = {s["msg"]: s for s in record["steps"]}
+        assert steps["Computing predicates done"]["ms"] == 80.0
+        assert steps["Computing predicates done"]["feasible"] == 3
+        assert steps["Prioritizing done"]["ms"] == 70.0
+
+    def test_logger_levels(self):
+        stream = io.StringIO()
+        log = StructuredLogger("c", stream=stream, level="warning")
+        log.info("hidden")
+        log.warning("shown", code=7)
+        lines = [json.loads(x) for x in stream.getvalue().splitlines()]
+        assert len(lines) == 1 and lines[0]["msg"] == "shown" and lines[0]["code"] == 7
+
+
+class TestConfigz:
+    def test_register_and_http(self):
+        import urllib.request
+
+        from kubernetes_tpu.server import APIServer
+
+        register_config("testcomponent", {"percentageOfNodesToScore": 40})
+        assert configz_snapshot()["testcomponent"]["percentageOfNodesToScore"] == 40
+        srv = APIServer(APIStore(), port=0).start()
+        try:
+            with urllib.request.urlopen(f"{srv.url}/configz") as resp:
+                payload = json.loads(resp.read())
+            assert payload["testcomponent"] == {"percentageOfNodesToScore": 40}
+        finally:
+            srv.stop()
+
+
+class TestCacheDebugger:
+    def _scheduler(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("n1").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": "10"}).obj())
+        store.create("pods", MakePod("p").req({"cpu": "1"}).obj())
+        sched = Scheduler(store, Framework(default_plugins()), clock=FakeClock())
+        sched.sync()
+        sched.schedule_one()
+        return store, sched
+
+    def test_dump_shape(self):
+        store, sched = self._scheduler()
+        d = dump(sched)
+        assert "n1" in d["nodes"]
+        assert d["nodes"]["n1"]["pods"] == ["default/p"]
+        assert d["nodes"]["n1"]["requested"]["milliCPU"] == 1000
+        assert set(d["queue"]) == {"active", "backoff", "unschedulable"}
+
+    def test_compare_consistent(self):
+        store, sched = self._scheduler()
+        sched.pump_events()
+        assert compare(sched) == []
+
+    def test_compare_detects_divergence(self):
+        store, sched = self._scheduler()
+        sched.pump_events()
+        # write a bound pod behind the scheduler's back (no pump)
+        store.create("pods", MakePod("ghost").node("n1").obj())
+        problems = compare(sched)
+        assert any("ghost" in p and "missing from cache" in p for p in problems)
+
+    def test_slow_cycle_traced(self):
+        """A schedule_pod call past the 100ms threshold emits a trace record."""
+        store, sched = self._scheduler()
+        stream = io.StringIO()
+        from kubernetes_tpu.utils import tracing
+
+        old = tracing.default_logger
+        tracing.default_logger = StructuredLogger("sched", stream=stream)
+        try:
+            import kubernetes_tpu.utils.tracing as tr
+
+            real_perf = tr.time.perf_counter
+            ticks = iter([0.0, 0.0, 0.2, 0.25, 0.3, 0.35, 0.4])
+            tr.time.perf_counter = lambda: next(ticks, 1.0)
+            sched.schedule_pod(MakePod("slow").req({"cpu": "1"}).obj())
+            tr.time.perf_counter = real_perf
+        finally:
+            tracing.default_logger = old
+        record = json.loads(stream.getvalue().splitlines()[0])
+        assert record["msg"].startswith("Trace 'Scheduling'")
+        assert record["pod"] == "default/slow"
