@@ -53,7 +53,10 @@ class JobController(Controller):
                   and p.metadata.deletion_timestamp is None]
         succeeded = sum(1 for p in pods if p.status.phase == "Succeeded")
         failed = sum(1 for p in pods if p.status.phase == "Failed")
-        completions = job.spec.completions if job.spec.completions is not None else 1
+        # nil completions = work-queue job (job_controller.go manageJob):
+        # wantActive is parallelism, and the job completes when any pod
+        # succeeds and no pods remain active (JobSpec's documented semantic).
+        completions = job.spec.completions
 
         condition = None
         want_active = len(active)
@@ -64,7 +67,8 @@ class JobController(Controller):
             for p in active:
                 self._try_delete_pod(p)
             want_active = 0
-        elif succeeded >= completions:
+        elif (succeeded >= completions if completions is not None
+              else succeeded >= 1 and not active):
             condition = {"type": "Complete", "status": "True"}
         elif job.spec.suspend:
             for p in active:
@@ -73,7 +77,14 @@ class JobController(Controller):
         else:
             # wantActive (job_controller.go manageJob): bounded by parallelism
             # and by the completions still owed; scales down as well as up
-            want_active = min(job.spec.parallelism, completions - succeeded)
+            if completions is None:
+                # work-queue semantics: full parallelism until the first
+                # success, then just let running pods drain — but always
+                # capped by parallelism so lowering it scales down
+                want_active = job.spec.parallelism if succeeded == 0 \
+                    else min(len(active), job.spec.parallelism)
+            else:
+                want_active = min(job.spec.parallelism, completions - succeeded)
             for _ in range(max(0, want_active - len(active))):
                 self._create_pod(job)
             for p in active[want_active:] if want_active < len(active) else []:

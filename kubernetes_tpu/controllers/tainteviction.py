@@ -22,8 +22,13 @@ class TaintEvictionController(Controller):
 
     def __init__(self, store, clock=None):
         super().__init__(store, clock)
-        # pod key -> eviction deadline (timed evictions pending)
-        self._deadlines: Dict[str, float] = {}
+        # pod key -> (eviction deadline, taint-set signature that produced it).
+        # The signature lets a taint-set change cancel+reschedule the timed
+        # eviction (TimedWorkerQueue semantics) in either direction — a new
+        # tighter taint shortens the deadline, removing the tight taint
+        # restores the longer one — without the deadline sliding forward on
+        # every no-change resync.
+        self._deadlines: Dict[str, tuple] = {}
 
     def key_of_object(self, kind: str, obj) -> Optional[str]:
         if kind == "nodes":
@@ -33,7 +38,7 @@ class TaintEvictionController(Controller):
     def tick(self) -> None:
         """Fire due timed evictions (the reference's TimedWorkerQueue)."""
         now = self.clock.now()
-        for pod_key, deadline in list(self._deadlines.items()):
+        for pod_key, (deadline, _sig) in list(self._deadlines.items()):
             if deadline <= now:
                 self._deadlines.pop(pod_key, None)
                 self._evict(pod_key)
@@ -96,8 +101,13 @@ class TaintEvictionController(Controller):
                 min_seconds = s if min_seconds is None else min(min_seconds, s)
         if min_seconds is None:
             self._deadlines.pop(pod.key, None)  # tolerated forever
-        elif pod.key not in self._deadlines:
-            self._deadlines[pod.key] = self.clock.now() + min_seconds
+        else:
+            sig = tuple(sorted((t.key, t.value, t.effect) for t in taints))
+            existing = self._deadlines.get(pod.key)
+            if existing is None or existing[1] != sig:
+                # new countdown, or the taint set changed: cancel + reschedule
+                # from now with the recomputed minimum (may tighten or loosen)
+                self._deadlines[pod.key] = (self.clock.now() + min_seconds, sig)
 
     def _evict(self, pod_key: str) -> None:
         try:

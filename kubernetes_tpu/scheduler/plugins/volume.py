@@ -144,15 +144,18 @@ class VolumeBinding(Plugin):
         self._store = store
 
     def _persist(self, kind: str, obj) -> None:
+        """Write-through to the API store. Update-then-create covers objects
+        the lister knows but the store hasn't seen yet; any other failure
+        propagates so PreBind fails instead of silently diverging from the
+        store."""
         if self._store is None:
             return
+        from ...store import NotFoundError
+
         try:
             self._store.update(kind, obj, check_rv=False)
-        except Exception:
-            try:
-                self._store.create(kind, obj)
-            except Exception:
-                pass  # store may not track storage kinds (unit-test wiring)
+        except NotFoundError:
+            self._store.create(kind, obj)
 
     def pre_filter(self, state: CycleState, pod, snapshot):
         claims = pod_pvc_names(pod)
@@ -271,6 +274,12 @@ class VolumeBinding(Plugin):
         binding: Optional[_NodeBinding] = state.read_or_none(self.BIND_KEY)
         if binding is None:
             return SUCCESS
+        try:
+            return self._pre_bind(binding)
+        except Exception as e:  # failed PVC/PV write must fail the bind
+            return Status.error(f"binding volumes: {e}", plugin=self.name)
+
+    def _pre_bind(self, binding: "_NodeBinding") -> Status:
         for pvc, pv in binding.static:
             pv.spec.claim_ref = pvc.key
             pv.phase = VOLUME_BOUND

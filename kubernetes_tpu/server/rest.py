@@ -238,33 +238,48 @@ class _Handler(BaseHTTPRequestHandler):
         if ns and resource not in CLUSTER_SCOPED:
             obj.metadata.namespace = ns
         # admission + create under one store transaction: concurrent creates
-        # cannot both pass a quota check they jointly exceed
+        # cannot both pass a quota check they jointly exceed. The verdict is
+        # buffered and the HTTP response written AFTER the lock is released —
+        # a slow client socket must never block every store consumer.
+        err = None
+        created = None
         with self.store.transaction():
-            if not self._admit(resource, "CREATE", obj):
-                return
-            try:
-                created = self.store.create(resource, obj)
-            except AlreadyExistsError as e:
-                self._error(409, str(e), "AlreadyExists")
-                return
+            err = self._admission_verdict(resource, "CREATE", obj)
+            if err is None:
+                try:
+                    created = self.store.create(resource, obj)
+                except AlreadyExistsError as e:
+                    err = (409, str(e), "AlreadyExists")
+        if err is not None:
+            self._error(*err)
+            return
         self._send_json(201, to_dict(created))
 
-    def _admit(self, resource: str, operation: str, obj) -> bool:
-        """Run the admission chain; False = rejected (response already sent).
-        Identity comes from the X-Remote-User header (authenticating-proxy
-        convention) — node agents send system:node:<name>."""
+    def _admission_verdict(self, resource: str, operation: str, obj):
+        """Run the admission chain; returns None on admit or an
+        (http_code, message, reason) tuple on reject — the caller sends the
+        response outside any store lock. Identity comes from the X-Remote-User
+        header (authenticating-proxy convention) — node agents send
+        system:node:<name>."""
         chain = getattr(self.server, "admission", None)
         if chain is None:
-            return True
+            return None
         from .admission import AdmissionError
 
         user = self.headers.get("X-Remote-User", "")
         try:
             chain.run(self.store, resource, operation, obj, user=user)
-            return True
+            return None
         except AdmissionError as e:
-            self._error(e.code, str(e), e.reason)
+            return (e.code, str(e), e.reason)
+
+    def _admit(self, resource: str, operation: str, obj) -> bool:
+        """Lock-free admission wrapper for paths without a transaction."""
+        err = self._admission_verdict(resource, operation, obj)
+        if err is not None:
+            self._error(*err)
             return False
+        return True
 
     # ---- PUT / DELETE --------------------------------------------------------
 
@@ -304,20 +319,20 @@ class _Handler(BaseHTTPRequestHandler):
             return
         resource, ns, name, _ = parsed
         key = self._key(resource, ns, name)
+        err = None
+        obj = None
         with self.store.transaction():
             try:
                 existing = self.store.get(resource, key)
+                # deletes go through admission too (noderestriction covers DELETE)
+                err = self._admission_verdict(resource, "DELETE", existing)
+                if err is None:
+                    obj = self.store.delete(resource, key)
             except NotFoundError as e:
-                self._error(404, str(e), "NotFound")
-                return
-            # deletes go through admission too (noderestriction covers DELETE)
-            if not self._admit(resource, "DELETE", existing):
-                return
-            try:
-                obj = self.store.delete(resource, key)
-            except NotFoundError as e:
-                self._error(404, str(e), "NotFound")
-                return
+                err = (404, str(e), "NotFound")
+        if err is not None:
+            self._error(*err)
+            return
         self._send_json(200, to_dict(obj))
 
 

@@ -27,7 +27,8 @@ def _parse_field(field: str, lo: int, hi: int) -> Set[int]:
     out: Set[int] = set()
     for part in field.split(","):
         step = 1
-        if "/" in part:
+        has_step = "/" in part
+        if has_step:
             part, step_s = part.split("/", 1)
             step = int(step_s)
             if step <= 0:
@@ -39,6 +40,10 @@ def _parse_field(field: str, lo: int, hi: int) -> Set[int]:
             start, end = int(a), int(b)
         else:
             start = end = int(part)
+            if has_step:
+                # robfig/cron: N/step means the range N..hi stepped (for any
+                # step value, including 1), not just {N}
+                end = hi
         if not (lo <= start <= hi and lo <= end <= hi and start <= end):
             raise ValueError(f"field value out of range: {part!r} not in [{lo},{hi}]")
         out.update(range(start, end + 1, step))

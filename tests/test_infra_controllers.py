@@ -245,6 +245,77 @@ class TestTaintEviction:
         ctl.tick()
         assert store.get("pods", "default/p")
 
+    def test_additional_taint_tightens_deadline(self):
+        # a second NoExecute taint with smaller tolerationSeconds must replace
+        # the stale longer deadline (tainteviction timed-worker semantics)
+        store, clock, ctl = self._setup()
+        pod = MakePod("p").node("n1").obj()
+        T = Taint
+        from kubernetes_tpu.api.types import Toleration
+
+        pod.spec.tolerations = [
+            Toleration(key="node.kubernetes.io/unreachable", operator="Exists",
+                       effect="NoExecute", toleration_seconds=600),
+            Toleration(key="node.kubernetes.io/memory-pressure", operator="Exists",
+                       effect="NoExecute", toleration_seconds=5),
+        ]
+        store.create("pods", pod)
+        self._taint_node(store)  # unreachable: 600s countdown
+        ctl.reconcile_once()
+        assert store.get("pods", "default/p")
+
+        def add_second(n):
+            n.spec.taints.append(T(key="node.kubernetes.io/memory-pressure",
+                                   effect="NoExecute"))
+            return n
+
+        store.guaranteed_update("nodes", "n1", add_second)
+        ctl.reconcile_once()
+        clock.step(6)  # past the tightened 5s deadline, far before 600s
+        ctl.tick()
+        with pytest.raises(NotFoundError):
+            store.get("pods", "default/p")
+
+    def test_removing_tight_taint_restores_longer_deadline(self):
+        # inverse of the tighten case: dropping the 5s taint while the 600s
+        # taint remains must reschedule on the longer deadline
+        store, clock, ctl = self._setup()
+        pod = MakePod("p").node("n1").obj()
+        from kubernetes_tpu.api.types import Toleration
+
+        pod.spec.tolerations = [
+            Toleration(key="node.kubernetes.io/unreachable", operator="Exists",
+                       effect="NoExecute", toleration_seconds=600),
+            Toleration(key="node.kubernetes.io/memory-pressure", operator="Exists",
+                       effect="NoExecute", toleration_seconds=5),
+        ]
+        store.create("pods", pod)
+
+        def add_both(n):
+            n.spec.taints = [
+                Taint(key="node.kubernetes.io/unreachable", effect="NoExecute"),
+                Taint(key="node.kubernetes.io/memory-pressure", effect="NoExecute"),
+            ]
+            return n
+
+        store.guaranteed_update("nodes", "n1", add_both)
+        ctl.reconcile_once()
+
+        def drop_tight(n):
+            n.spec.taints = [t for t in n.spec.taints
+                             if t.key == "node.kubernetes.io/unreachable"]
+            return n
+
+        store.guaranteed_update("nodes", "n1", drop_tight)
+        ctl.reconcile_once()
+        clock.step(10)  # past the stale 5s deadline
+        ctl.tick()
+        assert store.get("pods", "default/p")  # survives on the 600s countdown
+        clock.step(600)
+        ctl.tick()
+        with pytest.raises(NotFoundError):
+            store.get("pods", "default/p")
+
     def test_taint_removed_cancels_pending_eviction(self):
         store, clock, ctl = self._setup()
         pod = MakePod("p").node("n1").obj()

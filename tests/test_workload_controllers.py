@@ -69,6 +69,14 @@ class TestCron:
         s0 = CronSchedule("0 0 * * 0")
         assert s7.next_after(0) == s0.next_after(0) == 3 * 86400
 
+    def test_single_value_with_step_expands_to_range(self):
+        # robfig/cron: "5/15" = the range 5..59 stepped by 15, not just {5}
+        s = CronSchedule("5/15 * * * *")
+        assert s.minutes == {5, 20, 35, 50}
+        assert CronSchedule("3/10 * * * *").minutes == {3, 13, 23, 33, 43, 53}
+        # step of 1 still expands: 30/1 = every minute from :30 to :59
+        assert CronSchedule("30/1 * * * *").minutes == set(range(30, 60))
+
 
 class TestJobController:
     def _setup(self, **kw):
@@ -105,6 +113,42 @@ class TestJobController:
         # finished: no new pods created
         ctl.reconcile_once()
         assert len(self._pods(store)) == 2
+
+    def test_nil_completions_runs_parallelism_pods(self):
+        # work-queue job (job_controller.go manageJob): nil completions =>
+        # wantActive = parallelism; Complete when any pod succeeds and none active
+        store, _, ctl, job = self._setup(parallelism=3, completions=None)
+        ctl.process()
+        active = [p for p in self._pods(store) if not p.is_terminal()]
+        assert len(active) == 3
+        set_phase(store, active[0].key, "Succeeded")
+        ctl.reconcile_once()
+        j = store.get("jobs", "default/j")
+        assert not j.is_finished()  # two pods still running
+        for p in active[1:]:
+            set_phase(store, p.key, "Succeeded")
+        ctl.reconcile_once()
+        j = store.get("jobs", "default/j")
+        assert j.is_finished()
+        assert any(c["type"] == "Complete" for c in j.status.conditions)
+
+    def test_nil_completions_lowered_parallelism_scales_down(self):
+        # manageJob bounds active by parallelism even after a success
+        store, _, ctl, job = self._setup(parallelism=5, completions=None)
+        ctl.process()
+        active = [p for p in self._pods(store) if not p.is_terminal()]
+        assert len(active) == 5
+        set_phase(store, active[0].key, "Succeeded")
+
+        def lower(j):
+            j.spec.parallelism = 1
+            return j
+
+        store.guaranteed_update("jobs", "default/j", lower)
+        ctl.reconcile_once()
+        still_active = [p for p in self._pods(store)
+                        if not p.is_terminal() and p.metadata.deletion_timestamp is None]
+        assert len(still_active) == 1
 
     def test_failure_backoff_limit(self):
         store, _, ctl, job = self._setup(parallelism=1, completions=1, backoff_limit=1)
