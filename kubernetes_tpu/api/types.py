@@ -171,6 +171,15 @@ class Volume:
     iscsi_read_only: bool = False
     ephemeral: bool = False  # ephemeral.volumeClaimTemplate (claim name = pod-volname)
 
+    @property
+    def scheduling_relevant(self) -> bool:
+        """True when any scheduler plugin inspects this source (PVC/ephemeral
+        for VolumeBinding/Zone/Limits, shared-disk sources for
+        VolumeRestrictions). configMap/secret/emptyDir/projected volumes parse
+        to name-only entries and never constrain placement."""
+        return bool(self.pvc_claim_name or self.ephemeral or self.gce_pd
+                    or self.aws_ebs or self.rbd or self.iscsi)
+
     @staticmethod
     def from_dict(d: Mapping) -> "Volume":
         pvc = d.get("persistentVolumeClaim") or {}

@@ -66,6 +66,27 @@ class SolverInputs(NamedTuple):
     st_sel: jnp.ndarray
     st_max_skew: jnp.ndarray
     st_self_match: jnp.ndarray
+    # inter-pod affinity (snapshot/ipa.py; all padded to >=1 rows)
+    ra_class: jnp.ndarray  # [RA] incoming required affinity
+    ra_key: jnp.ndarray
+    ra_sel: jnp.ndarray
+    rn_class: jnp.ndarray  # [RN] incoming required anti-affinity
+    rn_key: jnp.ndarray
+    rn_sel: jnp.ndarray
+    pp_class: jnp.ndarray  # [PP] incoming preferred (signed weight)
+    pp_key: jnp.ndarray
+    pp_sel: jnp.ndarray
+    pp_weight: jnp.ndarray
+    grp_key: jnp.ndarray  # [G] topo row per holder group
+    grp_count: jnp.ndarray  # [G, N] existing holders per node (dyn seed)
+    class_holds_grp: jnp.ndarray  # [C, G]
+    ea_grp: jnp.ndarray  # [E] required-anti groups (filter rule 1)
+    ea_match: jnp.ndarray  # [C, E] bool
+    sym_grp: jnp.ndarray  # [S] symmetric score groups
+    sym_weight: jnp.ndarray  # [S]
+    sym_match: jnp.ndarray  # [C, S] bool
+    class_self_ok: jnp.ndarray  # [C] bool
+    class_has_ra: jnp.ndarray  # [C] bool
     # pod batch
     req: jnp.ndarray  # [P, R]
     req_nz: jnp.ndarray  # [P, R]
@@ -98,6 +119,22 @@ def make_inputs(cluster, batch) -> Tuple[SolverInputs, int]:
                  batch.ct_min_domains, batch.ct_self_match)
     st = _pad_ct(batch.st_class, batch.st_key, batch.st_sel, batch.st_max_skew,
                  batch.st_self_match)
+    ipa = batch.ipa
+    ra = _pad_ct(ipa.ra_class, ipa.ra_key, ipa.ra_sel)
+    rn = _pad_ct(ipa.rn_class, ipa.rn_key, ipa.rn_sel)
+    pp = _pad_ct(ipa.pp_class, ipa.pp_key, ipa.pp_sel, ipa.pp_weight)
+    g = max(ipa.grp_key.size, 1)
+    grp_key = ipa.grp_key if ipa.grp_key.size else np.zeros(1, np.int32)
+    grp_count = ipa.grp_count if ipa.grp_count.size else np.zeros((1, n), np.int32)
+    chg = ipa.class_holds_grp
+    assert chg.shape[1] == g, f"class_holds_grp width {chg.shape[1]} != {g}"
+    ea_grp = ipa.ea_grp if ipa.ea_grp.size else np.zeros(1, np.int32)
+    ea_match = ipa.ea_match if ipa.ea_match.shape[1] else \
+        np.zeros((ipa.ea_match.shape[0], 1), bool)
+    sym_grp = ipa.sym_grp if ipa.sym_grp.size else np.zeros(1, np.int32)
+    sym_weight = ipa.sym_weight if ipa.sym_weight.size else np.zeros(1, np.int32)
+    sym_match = ipa.sym_match if ipa.sym_match.shape[1] else \
+        np.zeros((ipa.sym_match.shape[0], 1), bool)
 
     inputs = SolverInputs(
         alloc=jnp.asarray(cluster.alloc), used=jnp.asarray(cluster.used),
@@ -113,6 +150,16 @@ def make_inputs(cluster, batch) -> Tuple[SolverInputs, int]:
         ct_min_domains=ct[4], ct_self_match=ct[5],
         st_class=st[0], st_key=st[1], st_sel=st[2], st_max_skew=st[3],
         st_self_match=st[4],
+        ra_class=ra[0], ra_key=ra[1], ra_sel=ra[2],
+        rn_class=rn[0], rn_key=rn[1], rn_sel=rn[2],
+        pp_class=pp[0], pp_key=pp[1], pp_sel=pp[2], pp_weight=pp[3],
+        grp_key=jnp.asarray(grp_key), grp_count=jnp.asarray(grp_count),
+        class_holds_grp=jnp.asarray(chg),
+        ea_grp=jnp.asarray(ea_grp), ea_match=jnp.asarray(ea_match),
+        sym_grp=jnp.asarray(sym_grp), sym_weight=jnp.asarray(sym_weight),
+        sym_match=jnp.asarray(sym_match),
+        class_self_ok=jnp.asarray(ipa.class_self_ok),
+        class_has_ra=jnp.asarray(ipa.class_has_ra),
         req=jnp.asarray(batch.req), req_nz=jnp.asarray(batch.req_nz),
         class_of_pod=jnp.asarray(batch.class_of_pod),
         balanced_active=jnp.asarray(batch.balanced_active),
@@ -215,8 +262,16 @@ def greedy_scan_solve(inp: SolverInputs, d_max: int):
     and the final node state.
     """
 
+    def _dom_node_count(per_node, topo_row):
+        """Per-node view of the node's topology-domain total of `per_node`
+        (nodes missing the key read 0)."""
+        seg = jnp.where(topo_row >= 0, topo_row, d_max)
+        dom = jax.ops.segment_sum(jnp.where(topo_row >= 0, per_node, 0), seg,
+                                  num_segments=d_max + 1)[:d_max]
+        return jnp.where(topo_row >= 0, dom[jnp.clip(topo_row, 0, d_max - 1)], 0)
+
     def step(state, pod):
-        used, used_nz, pod_count, dyn_selcls, port_used = state
+        used, used_nz, pod_count, dyn_selcls, dyn_grp, port_used = state
         req, req_nz, cls, bal_active = pod
         cls = jnp.maximum(cls, 0)
 
@@ -226,6 +281,50 @@ def greedy_scan_solve(inp: SolverInputs, d_max: int):
         feas &= ~jnp.any(port_used & inp.class_ports[cls][None, :], axis=1)
 
         aff_row = inp.aff_ok[cls]
+
+        # --- InterPodAffinity Filter (filtering.go:415) ---
+        # rule 1: no existing/placed pod's required anti-affinity is violated
+        # (satisfyExistingPodsAntiAffinity): the incoming pod may not land in a
+        # topology domain containing any holder of a matching anti term.
+        def ea_fn(g, m):
+            topo_row = inp.topo_id[inp.grp_key[g]]
+            cnt = _dom_node_count(dyn_grp[g], topo_row)
+            return jnp.where(m, (topo_row < 0) | (cnt == 0), True)
+
+        ea_ok = jax.vmap(ea_fn)(inp.ea_grp, inp.ea_match[cls])
+        feas &= jnp.all(ea_ok, axis=0)
+
+        # rule 2: incoming required affinity (satisfyPodAffinity): every term's
+        # domain must contain a matching pod; nodes missing any term's key are
+        # out; the first-pod exception admits a self-matching pod when no
+        # matching pod exists anywhere (global count zero across all terms).
+        def ra_fn(c_, k_, s_):
+            active = c_ == cls
+            topo_row = inp.topo_id[k_]
+            cnt = _dom_node_count(dyn_selcls[s_], topo_row)
+            has_key = topo_row >= 0
+            glob = jnp.sum(jnp.where(has_key, dyn_selcls[s_], 0))
+            pos = jnp.where(active, has_key & (cnt > 0), True)
+            keys = jnp.where(active, has_key, True)
+            glob_zero = jnp.where(active, glob == 0, True)
+            return pos, keys, glob_zero
+
+        ra_pos, ra_keys, ra_glob0 = jax.vmap(ra_fn)(inp.ra_class, inp.ra_key, inp.ra_sel)
+        ra_ok = jnp.all(ra_keys, axis=0) & (
+            jnp.all(ra_pos, axis=0)
+            | (jnp.all(ra_glob0) & inp.class_self_ok[cls])
+        )
+        feas &= jnp.where(inp.class_has_ra[cls], ra_ok, True)
+
+        # rule 3: incoming required anti-affinity (satisfyPodAntiAffinity)
+        def rn_fn(c_, k_, s_):
+            active = c_ == cls
+            topo_row = inp.topo_id[k_]
+            cnt = _dom_node_count(dyn_selcls[s_], topo_row)
+            return jnp.where(active, (topo_row < 0) | (cnt == 0), True)
+
+        rn_ok = jax.vmap(rn_fn)(inp.rn_class, inp.rn_key, inp.rn_sel)
+        feas &= jnp.all(rn_ok, axis=0)
 
         # --- PodTopologySpread DoNotSchedule (filtering.go:340) ---
         def ct_feas(ct_c, ct_k, ct_s, ct_skew, ct_mind, ct_self):
@@ -287,7 +386,41 @@ def greedy_scan_solve(inp: SolverInputs, d_max: int):
         )
         pts = jnp.where(any_st & ~ignored & jnp.any(norm_mask), pts, 0)
 
-        total = least + bal + 2 * napref + 3 * taint + 2 * pts + img
+        # --- InterPodAffinity Score (scoring.go) ---
+        # incoming preferred terms: +/-weight per matching pod in the domain
+        def pp_fn(c_, k_, s_, w_):
+            active = c_ == cls
+            topo_row = inp.topo_id[k_]
+            cnt = _dom_node_count(dyn_selcls[s_], topo_row)
+            return jnp.where(active, w_ * cnt, 0)
+
+        pp_contrib = jnp.sum(jax.vmap(pp_fn)(
+            inp.pp_class, inp.pp_key, inp.pp_sel, inp.pp_weight), axis=0)
+
+        # symmetric: existing/placed pods' preferred terms matching the
+        # incoming pod, plus their required affinity x hardPodAffinityWeight
+        def sym_fn(g, w_, m):
+            topo_row = inp.topo_id[inp.grp_key[g]]
+            cnt = _dom_node_count(dyn_grp[g], topo_row)
+            return jnp.where(m, w_ * cnt, 0)
+
+        sym_contrib = jnp.sum(jax.vmap(sym_fn)(
+            inp.sym_grp, inp.sym_weight, inp.sym_match[cls]), axis=0)
+
+        ipa_raw = pp_contrib + sym_contrib
+        # normalize_score: MAX*(v-min)/(max-min) over feasible nodes, 0 when
+        # uniform (interpod_affinity.py normalize_score). int32: weights(<=100)
+        # x domain pod counts keep MAX*(v-min) under 2^31 for realistic scale.
+        imx = jnp.max(jnp.where(feas, ipa_raw, -(2**30)))
+        imn = jnp.min(jnp.where(feas, ipa_raw, 2**30))
+        idiff = imx - imn
+        ipa_score = jnp.where(
+            feas & (idiff > 0),
+            (MAX_NODE_SCORE * (ipa_raw - imn)) // jnp.maximum(idiff, 1),
+            0,
+        ).astype(jnp.int32)
+
+        total = least + bal + 2 * napref + 3 * taint + 2 * pts + 2 * ipa_score + img
 
         # --- selectHost: deterministic argmax (lowest index on ties) ---
         masked = jnp.where(feas, total, INT_MIN)
@@ -302,11 +435,14 @@ def greedy_scan_solve(inp: SolverInputs, d_max: int):
         pod_count = pod_count + jnp.where(ok, onehot.astype(jnp.int32), 0)
         bump = inp.class_matches_selcls[cls][:, None] * onehot[None, :].astype(jnp.int32)
         dyn_selcls = dyn_selcls + jnp.where(ok, bump, 0)
+        gbump = inp.class_holds_grp[cls][:, None] * onehot[None, :].astype(jnp.int32)
+        dyn_grp = dyn_grp + jnp.where(ok, gbump, 0)
         port_used = port_used | (ok & onehot)[:, None] & inp.class_ports[cls][None, :]
-        return (used, used_nz, pod_count, dyn_selcls, port_used), node
+        return (used, used_nz, pod_count, dyn_selcls, dyn_grp, port_used), node
 
-    init = (inp.used, inp.used_nz, inp.pod_count, inp.selcls_count, inp.node_ports)
-    (used, used_nz, pod_count, dyn_selcls, port_used), assignment = jax.lax.scan(
+    init = (inp.used, inp.used_nz, inp.pod_count, inp.selcls_count, inp.grp_count,
+            inp.node_ports)
+    (used, used_nz, pod_count, dyn_selcls, dyn_grp, port_used), assignment = jax.lax.scan(
         step, init, (inp.req, inp.req_nz, inp.class_of_pod, inp.balanced_active)
     )
     return assignment, used, pod_count

@@ -13,6 +13,8 @@ behind the same extension surface, serial path always available).
 from __future__ import annotations
 
 import copy
+import queue as _queue
+import threading
 from typing import List, Optional
 
 import numpy as np
@@ -33,12 +35,22 @@ class BatchScheduler(Scheduler):
     the batch has no topology-spread constraints, exact otherwise)."""
 
     def __init__(self, store: APIStore, framework: Framework, batch_size: int = 4096,
-                 solver: str = "exact", **kw):
+                 solver: str = "exact", pipeline_binds: bool = True, **kw):
         super().__init__(store, framework, **kw)
         self.batch_size = batch_size
         self.solver = solver
         self.batches_solved = 0
         self.transport_state = None  # warm duals carried across batches
+        # Bind pipelining (schedule_one.go:120-132 bindingCycle-in-goroutine
+        # analog): assume_pod runs synchronously so the next solve's snapshot
+        # sees the capacity, while the store.bind writes flush on a worker
+        # thread overlapped with solve(N+1).
+        self.pipeline_binds = pipeline_binds
+        self._bind_q: _queue.Queue = _queue.Queue()
+        self._bind_worker: Optional[threading.Thread] = None
+        self._bind_errors: List = []
+        self._bind_successes = 0  # folded into scheduled_count on the
+        self._bind_err_lock = threading.Lock()  # scheduling thread (no race)
 
     def schedule_batch(self, timeout: Optional[float] = 0.0) -> int:
         """Drain up to batch_size pods, solve jointly, bind. Returns #pods handled."""
@@ -61,7 +73,9 @@ class BatchScheduler(Scheduler):
 
         cluster = build_cluster_tensors(snapshot)
         pods = [qp.pod for qp in qps]
-        batch = build_pod_batch(pods, snapshot, cluster)
+        batch = build_pod_batch(
+            pods, snapshot, cluster, ns_labels=self._ns_labels,
+            hard_pod_affinity_weight=self._hard_pod_affinity_weight())
 
         fallback_mask = batch.fallback_class[batch.class_of_pod]
         device_idx = np.nonzero(~fallback_mask)[0]
@@ -70,9 +84,10 @@ class BatchScheduler(Scheduler):
         if device_idx.size:
             sub = _subset_batch(batch, device_idx)
             # 'fast' means fast-when-legal: the water-fill kernel has no
-            # topology-spread handling, so constrained batches always take the
-            # exact scan path regardless of mode.
-            constraint_free = batch.ct_class.size == 0 and batch.st_class.size == 0
+            # topology-spread or inter-pod-affinity handling, so constrained
+            # batches always take the exact scan path regardless of mode.
+            constraint_free = (batch.ct_class.size == 0 and batch.st_class.size == 0
+                               and not batch.ipa.has_any)
             use_fast = self.solver in ("fast", "auto") and constraint_free
             use_transport = self.solver in ("auction", "sinkhorn") and constraint_free
             assignment = None
@@ -126,6 +141,13 @@ class BatchScheduler(Scheduler):
         m.batch_solve_duration.observe(time.perf_counter() - t_batch)
         return len(qps)
 
+    def _hard_pod_affinity_weight(self) -> int:
+        for fw in self.profiles.values():
+            for p in fw.plugins:
+                if p.name == "InterPodAffinity":
+                    return getattr(p, "hard_pod_affinity_weight", 1)
+        return 1
+
     def _bind_assignment(self, qp: QueuedPodInfo, node_name: str) -> None:
         assumed = copy.deepcopy(qp.pod)
         try:
@@ -133,13 +155,63 @@ class BatchScheduler(Scheduler):
         except ValueError as e:
             self._handle_failure(qp, Status.error(str(e)))
             return
+        if self.pipeline_binds:
+            self._ensure_bind_worker()
+            self._bind_q.put((qp, node_name, assumed))
+            return
+        self._bind_one(qp, node_name, assumed, async_mode=False)
+
+    def _bind_one(self, qp: QueuedPodInfo, node_name: str, assumed,
+                  async_mode: bool) -> None:
         try:
             self.store.bind(qp.pod.metadata.namespace, qp.pod.metadata.name, node_name)
             self.cache.finish_binding(assumed)
-            self.scheduled_count += 1
+            if async_mode:
+                with self._bind_err_lock:
+                    self._bind_successes += 1
+            else:
+                self.scheduled_count += 1
         except Exception as e:
             self.cache.forget_pod(assumed)
-            self._handle_failure(qp, Status.error(str(e)))
+            if async_mode:
+                # surfaced on the scheduling thread at the next drain; handling
+                # failures re-enters the queue, which isn't bind-thread-safe
+                with self._bind_err_lock:
+                    self._bind_errors.append((qp, Status.error(str(e))))
+            else:
+                self._handle_failure(qp, Status.error(str(e)))
+
+    def _ensure_bind_worker(self) -> None:
+        if self._bind_worker is None or not self._bind_worker.is_alive():
+            self._bind_worker = threading.Thread(target=self._bind_loop, daemon=True)
+            self._bind_worker.start()
+
+    def _bind_loop(self) -> None:
+        while True:
+            item = self._bind_q.get()
+            try:
+                if item is None:
+                    return
+                self._bind_one(*item, async_mode=True)
+            finally:
+                self._bind_q.task_done()
+
+    def _drain_bind_results(self) -> None:
+        """Fold completed async binds into counters and re-handle failures on
+        the scheduling thread (handleBindingCycleError -> requeue). Does NOT
+        wait for in-flight binds — callable every cycle under sustained load."""
+        with self._bind_err_lock:
+            done, self._bind_successes = self._bind_successes, 0
+            errs, self._bind_errors = self._bind_errors, []
+        self.scheduled_count += done
+        for qp, status in errs:
+            self._handle_failure(qp, status)
+
+    def flush_binds(self) -> None:
+        """Wait for queued store.bind writes, then drain results."""
+        if self._bind_worker is not None:
+            self._bind_q.join()
+        self._drain_bind_results()
 
     def _serial_one(self, qp: QueuedPodInfo) -> None:
         result = self.schedule_pod(qp.pod)
@@ -151,14 +223,40 @@ class BatchScheduler(Scheduler):
         # (volumes, inter-pod affinity) depend on those extension points.
         self._commit_cycle(qp, result)
 
+    def start(self) -> None:
+        """Background loop: batch solve instead of one-pod cycles."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                handled = self.schedule_batch(timeout=0.0)
+                # drain async-bind outcomes every cycle (bind failures must
+                # requeue even under sustained load), full flush only on idle
+                self._drain_bind_results()
+                if handled == 0:
+                    self.flush_binds()
+                    self.pump_events()
+                    self.queue.flush_backoff_completed()
+                    self.queue.flush_unschedulable_left_over()
+                    self._stop.wait(0.05)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
     def run_until_idle(self, max_cycles: int = 10_000) -> int:
         n = 0
         while n < max_cycles:
             if self.schedule_batch(timeout=0.0) == 0:
+                # quiesce: flush in-flight binds (may requeue failures), then
+                # drain events before declaring idle
+                self.flush_binds()
                 self.pump_events()
                 if self.schedule_batch(timeout=0.0) == 0:
                     break
             n += 1
+        self.flush_binds()
         return n
 
 

@@ -111,7 +111,11 @@ class BatchExtenderServer:
                 cluster = build_cluster_tensors(snapshot)
                 self._tensor_cache = {"latest": (snapshot, cluster)}
         batch = build_pod_batch([pod], snapshot, cluster)
-        if bool(batch.fallback_class[batch.class_of_pod[0]]):
+        # pass-through for fallback classes AND pods whose feasibility/score
+        # depends on dynamic count tensors (IPA, topology spread): the static
+        # pod_row formula below carries neither, only the scan solver does
+        if bool(batch.fallback_class[batch.class_of_pod[0]]) or batch.ipa.has_any \
+                or batch.ct_class.size or batch.st_class.size:
             return cluster.node_names, None, None
         inputs, _d_max = make_inputs(cluster, batch)
         feas, score = pod_row_feasibility_score(

@@ -448,6 +448,30 @@ class TestStoreWiring:
         fallback = batch.fallback_class[batch.class_of_pod]
         assert list(fallback) == [True, False]
 
+    def test_config_volumes_stay_on_device(self):
+        """configMap/secret/emptyDir volumes never constrain placement; pods
+        carrying only those take the device path (VERDICT weak item 2)."""
+        from kubernetes_tpu.api.types import Volume
+        from kubernetes_tpu.snapshot.tensorizer import build_pod_batch, build_cluster_tensors
+        from kubernetes_tpu.scheduler import Cache
+        from kubernetes_tpu.utils import FakeClock
+
+        cache = Cache(clock=FakeClock())
+        cache.add_node(MakeNode("n1").capacity({"cpu": "4"}).obj())
+        snap = cache.update_snapshot()
+        cluster = build_cluster_tensors(snap)
+        # the wire shapes a real pod would carry
+        cfg = Volume.from_dict({"name": "cfg", "configMap": {"name": "app-config"}})
+        sec = Volume.from_dict({"name": "creds", "secret": {"secretName": "s"}})
+        tmp = Volume.from_dict({"name": "scratch", "emptyDir": {}})
+        pod = MakePod("cfgpod").req({"cpu": "1"}).obj()
+        pod.spec.volumes = [cfg, sec, tmp]
+        ephemeral = MakePod("eph").req({"cpu": "1"}).volume(
+            name="data", ephemeral=True).obj()
+        batch = build_pod_batch([pod, ephemeral], snap, cluster)
+        fallback = batch.fallback_class[batch.class_of_pod]
+        assert list(fallback) == [False, True]
+
 
 class TestEndToEndSerial:
     def test_serial_scheduler_binds_wfc_claim(self):
