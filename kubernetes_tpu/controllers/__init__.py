@@ -3,6 +3,7 @@
 from .base import Controller  # noqa: F401
 from .daemonset import DaemonSetController  # noqa: F401
 from .deployment import DeploymentController  # noqa: F401
+from .disruption import DisruptionController  # noqa: F401
 from .endpointslice import EndpointSliceController  # noqa: F401
 from .garbagecollector import GarbageCollector  # noqa: F401
 from .job import CronJobController, JobController  # noqa: F401

@@ -66,25 +66,22 @@ class SolverInputs(NamedTuple):
     st_sel: jnp.ndarray
     st_max_skew: jnp.ndarray
     st_self_match: jnp.ndarray
-    # inter-pod affinity (snapshot/ipa.py; all padded to >=1 rows)
-    ra_class: jnp.ndarray  # [RA] incoming required affinity
-    ra_key: jnp.ndarray
+    # inter-pod affinity (snapshot/ipa.py; per-class padded tables, -1 pads —
+    # each scan step gathers ONE class row, so per-step cost is the max term
+    # count of a class, not the batch total)
+    ra_key: jnp.ndarray  # [C, RAm] incoming required affinity
     ra_sel: jnp.ndarray
-    rn_class: jnp.ndarray  # [RN] incoming required anti-affinity
-    rn_key: jnp.ndarray
+    rn_key: jnp.ndarray  # [C, RNm] incoming required anti-affinity
     rn_sel: jnp.ndarray
-    pp_class: jnp.ndarray  # [PP] incoming preferred (signed weight)
-    pp_key: jnp.ndarray
+    pp_key: jnp.ndarray  # [C, PPm] incoming preferred
     pp_sel: jnp.ndarray
-    pp_weight: jnp.ndarray
+    pp_weight: jnp.ndarray  # [C, PPm] signed, 0 pads
     grp_key: jnp.ndarray  # [G] topo row per holder group
     grp_count: jnp.ndarray  # [G, N] existing holders per node (dyn seed)
     class_holds_grp: jnp.ndarray  # [C, G]
-    ea_grp: jnp.ndarray  # [E] required-anti groups (filter rule 1)
-    ea_match: jnp.ndarray  # [C, E] bool
-    sym_grp: jnp.ndarray  # [S] symmetric score groups
-    sym_weight: jnp.ndarray  # [S]
-    sym_match: jnp.ndarray  # [C, S] bool
+    ea_grp: jnp.ndarray  # [C, Em] required-anti groups matching the class
+    sym_grp: jnp.ndarray  # [C, Sm] symmetric score groups matching the class
+    sym_weight: jnp.ndarray  # [C, Sm] signed, 0 pads
     class_self_ok: jnp.ndarray  # [C] bool
     class_has_ra: jnp.ndarray  # [C] bool
     # pod batch
@@ -120,21 +117,11 @@ def make_inputs(cluster, batch) -> Tuple[SolverInputs, int]:
     st = _pad_ct(batch.st_class, batch.st_key, batch.st_sel, batch.st_max_skew,
                  batch.st_self_match)
     ipa = batch.ipa
-    ra = _pad_ct(ipa.ra_class, ipa.ra_key, ipa.ra_sel)
-    rn = _pad_ct(ipa.rn_class, ipa.rn_key, ipa.rn_sel)
-    pp = _pad_ct(ipa.pp_class, ipa.pp_key, ipa.pp_sel, ipa.pp_weight)
     g = max(ipa.grp_key.size, 1)
     grp_key = ipa.grp_key if ipa.grp_key.size else np.zeros(1, np.int32)
     grp_count = ipa.grp_count if ipa.grp_count.size else np.zeros((1, n), np.int32)
     chg = ipa.class_holds_grp
     assert chg.shape[1] == g, f"class_holds_grp width {chg.shape[1]} != {g}"
-    ea_grp = ipa.ea_grp if ipa.ea_grp.size else np.zeros(1, np.int32)
-    ea_match = ipa.ea_match if ipa.ea_match.shape[1] else \
-        np.zeros((ipa.ea_match.shape[0], 1), bool)
-    sym_grp = ipa.sym_grp if ipa.sym_grp.size else np.zeros(1, np.int32)
-    sym_weight = ipa.sym_weight if ipa.sym_weight.size else np.zeros(1, np.int32)
-    sym_match = ipa.sym_match if ipa.sym_match.shape[1] else \
-        np.zeros((ipa.sym_match.shape[0], 1), bool)
 
     inputs = SolverInputs(
         alloc=jnp.asarray(cluster.alloc), used=jnp.asarray(cluster.used),
@@ -150,14 +137,14 @@ def make_inputs(cluster, batch) -> Tuple[SolverInputs, int]:
         ct_min_domains=ct[4], ct_self_match=ct[5],
         st_class=st[0], st_key=st[1], st_sel=st[2], st_max_skew=st[3],
         st_self_match=st[4],
-        ra_class=ra[0], ra_key=ra[1], ra_sel=ra[2],
-        rn_class=rn[0], rn_key=rn[1], rn_sel=rn[2],
-        pp_class=pp[0], pp_key=pp[1], pp_sel=pp[2], pp_weight=pp[3],
+        ra_key=jnp.asarray(ipa.ra_key), ra_sel=jnp.asarray(ipa.ra_sel),
+        rn_key=jnp.asarray(ipa.rn_key), rn_sel=jnp.asarray(ipa.rn_sel),
+        pp_key=jnp.asarray(ipa.pp_key), pp_sel=jnp.asarray(ipa.pp_sel),
+        pp_weight=jnp.asarray(ipa.pp_weight),
         grp_key=jnp.asarray(grp_key), grp_count=jnp.asarray(grp_count),
         class_holds_grp=jnp.asarray(chg),
-        ea_grp=jnp.asarray(ea_grp), ea_match=jnp.asarray(ea_match),
-        sym_grp=jnp.asarray(sym_grp), sym_weight=jnp.asarray(sym_weight),
-        sym_match=jnp.asarray(sym_match),
+        ea_grp=jnp.asarray(ipa.ea_grp),
+        sym_grp=jnp.asarray(ipa.sym_grp), sym_weight=jnp.asarray(ipa.sym_weight),
         class_self_ok=jnp.asarray(ipa.class_self_ok),
         class_has_ra=jnp.asarray(ipa.class_has_ra),
         req=jnp.asarray(batch.req), req_nz=jnp.asarray(batch.req_nz),
@@ -286,20 +273,24 @@ def greedy_scan_solve(inp: SolverInputs, d_max: int):
         # rule 1: no existing/placed pod's required anti-affinity is violated
         # (satisfyExistingPodsAntiAffinity): the incoming pod may not land in a
         # topology domain containing any holder of a matching anti term.
-        def ea_fn(g, m):
+        def ea_fn(g):
+            active = g >= 0
+            g = jnp.maximum(g, 0)
             topo_row = inp.topo_id[inp.grp_key[g]]
             cnt = _dom_node_count(dyn_grp[g], topo_row)
-            return jnp.where(m, (topo_row < 0) | (cnt == 0), True)
+            return jnp.where(active, (topo_row < 0) | (cnt == 0), True)
 
-        ea_ok = jax.vmap(ea_fn)(inp.ea_grp, inp.ea_match[cls])
+        ea_ok = jax.vmap(ea_fn)(inp.ea_grp[cls])
         feas &= jnp.all(ea_ok, axis=0)
 
         # rule 2: incoming required affinity (satisfyPodAffinity): every term's
         # domain must contain a matching pod; nodes missing any term's key are
         # out; the first-pod exception admits a self-matching pod when no
         # matching pod exists anywhere (global count zero across all terms).
-        def ra_fn(c_, k_, s_):
-            active = c_ == cls
+        def ra_fn(k_, s_):
+            active = k_ >= 0
+            k_ = jnp.maximum(k_, 0)
+            s_ = jnp.maximum(s_, 0)
             topo_row = inp.topo_id[k_]
             cnt = _dom_node_count(dyn_selcls[s_], topo_row)
             has_key = topo_row >= 0
@@ -309,7 +300,7 @@ def greedy_scan_solve(inp: SolverInputs, d_max: int):
             glob_zero = jnp.where(active, glob == 0, True)
             return pos, keys, glob_zero
 
-        ra_pos, ra_keys, ra_glob0 = jax.vmap(ra_fn)(inp.ra_class, inp.ra_key, inp.ra_sel)
+        ra_pos, ra_keys, ra_glob0 = jax.vmap(ra_fn)(inp.ra_key[cls], inp.ra_sel[cls])
         ra_ok = jnp.all(ra_keys, axis=0) & (
             jnp.all(ra_pos, axis=0)
             | (jnp.all(ra_glob0) & inp.class_self_ok[cls])
@@ -317,13 +308,15 @@ def greedy_scan_solve(inp: SolverInputs, d_max: int):
         feas &= jnp.where(inp.class_has_ra[cls], ra_ok, True)
 
         # rule 3: incoming required anti-affinity (satisfyPodAntiAffinity)
-        def rn_fn(c_, k_, s_):
-            active = c_ == cls
+        def rn_fn(k_, s_):
+            active = k_ >= 0
+            k_ = jnp.maximum(k_, 0)
+            s_ = jnp.maximum(s_, 0)
             topo_row = inp.topo_id[k_]
             cnt = _dom_node_count(dyn_selcls[s_], topo_row)
             return jnp.where(active, (topo_row < 0) | (cnt == 0), True)
 
-        rn_ok = jax.vmap(rn_fn)(inp.rn_class, inp.rn_key, inp.rn_sel)
+        rn_ok = jax.vmap(rn_fn)(inp.rn_key[cls], inp.rn_sel[cls])
         feas &= jnp.all(rn_ok, axis=0)
 
         # --- PodTopologySpread DoNotSchedule (filtering.go:340) ---
@@ -388,24 +381,28 @@ def greedy_scan_solve(inp: SolverInputs, d_max: int):
 
         # --- InterPodAffinity Score (scoring.go) ---
         # incoming preferred terms: +/-weight per matching pod in the domain
-        def pp_fn(c_, k_, s_, w_):
-            active = c_ == cls
+        def pp_fn(k_, s_, w_):
+            active = k_ >= 0
+            k_ = jnp.maximum(k_, 0)
+            s_ = jnp.maximum(s_, 0)
             topo_row = inp.topo_id[k_]
             cnt = _dom_node_count(dyn_selcls[s_], topo_row)
             return jnp.where(active, w_ * cnt, 0)
 
         pp_contrib = jnp.sum(jax.vmap(pp_fn)(
-            inp.pp_class, inp.pp_key, inp.pp_sel, inp.pp_weight), axis=0)
+            inp.pp_key[cls], inp.pp_sel[cls], inp.pp_weight[cls]), axis=0)
 
         # symmetric: existing/placed pods' preferred terms matching the
         # incoming pod, plus their required affinity x hardPodAffinityWeight
-        def sym_fn(g, w_, m):
+        def sym_fn(g, w_):
+            active = g >= 0
+            g = jnp.maximum(g, 0)
             topo_row = inp.topo_id[inp.grp_key[g]]
             cnt = _dom_node_count(dyn_grp[g], topo_row)
-            return jnp.where(m, w_ * cnt, 0)
+            return jnp.where(active, w_ * cnt, 0)
 
         sym_contrib = jnp.sum(jax.vmap(sym_fn)(
-            inp.sym_grp, inp.sym_weight, inp.sym_match[cls]), axis=0)
+            inp.sym_grp[cls], inp.sym_weight[cls]), axis=0)
 
         ipa_raw = pp_contrib + sym_contrib
         # normalize_score: MAX*(v-min)/(max-min) over feasible nodes, 0 when

@@ -44,12 +44,12 @@ _SPECS = dict(
     ct_class=P(), ct_key=P(), ct_sel=P(), ct_max_skew=P(),
     ct_min_domains=P(), ct_self_match=P(),
     st_class=P(), st_key=P(), st_sel=P(), st_max_skew=P(), st_self_match=P(),
-    ra_class=P(), ra_key=P(), ra_sel=P(),
-    rn_class=P(), rn_key=P(), rn_sel=P(),
-    pp_class=P(), pp_key=P(), pp_sel=P(), pp_weight=P(),
+    ra_key=P(), ra_sel=P(),
+    rn_key=P(), rn_sel=P(),
+    pp_key=P(), pp_sel=P(), pp_weight=P(),
     grp_key=P(), grp_count=P(None, "nodes"), class_holds_grp=P(),
-    ea_grp=P(), ea_match=P(),
-    sym_grp=P(), sym_weight=P(), sym_match=P(),
+    ea_grp=P(),
+    sym_grp=P(), sym_weight=P(),
     class_self_ok=P(), class_has_ra=P(),
     req=P(), req_nz=P(), class_of_pod=P(), balanced_active=P(),
 )

@@ -117,21 +117,19 @@ class BatchScheduler(Scheduler):
             if assignment is None:
                 assignment, _, _ = greedy_scan_solve(inputs, d_max)
             assignment = np.asarray(assignment)
-            # Two phases: bind every device assignment FIRST, then re-run the
-            # rejected pods serially. The serial fallback reads the live cache;
-            # running it mid-loop would see capacity still promised to not-yet-
-            # bound assignments and double-book nodes.
+            # Two phases: bind every device assignment FIRST, then handle the
+            # rejected pods. Handling mid-loop would see capacity still
+            # promised to not-yet-bound assignments and double-book nodes.
             rejected = []
             for j, pi in enumerate(device_idx):
                 nidx = int(assignment[j])
                 if nidx < 0:
-                    rejected.append(qps[pi])
+                    rejected.append((j, qps[pi]))
                 else:
                     self._bind_assignment(qps[pi], cluster.node_names[nidx])
-            for qp in rejected:
-                # produces per-node failure statuses so PostFilter/preemption
-                # can run (schedule_one.go:175)
-                self._serial_one(qp)
+            if rejected:
+                self._handle_device_rejects(rejected, snapshot, cluster, sub,
+                                            assignment)
 
         # Serial fallback, in original priority order among themselves.
         for pi in fallback_idx:
@@ -140,6 +138,66 @@ class BatchScheduler(Scheduler):
         self.batches_solved += 1
         m.batch_solve_duration.observe(time.perf_counter() - t_batch)
         return len(qps)
+
+    def _handle_device_rejects(self, rejected, snapshot, cluster, sub,
+                               assignment) -> None:
+        """Failure handling for pods the device solver could not place, without
+        re-running a serial scheduling cycle per pod (the per-node Python
+        filter loop would dominate preemption-heavy batches).
+
+        Per-node failure codes are synthesized from the class tables + the
+        post-batch capacity state: nodes failing static predicates (affinity/
+        taints/name/unschedulable) are UNSCHEDULABLE_AND_UNRESOLVABLE —
+        preemption cannot help (interface.go semantics) — everything else is
+        UNSCHEDULABLE, and the preemption dry run re-verifies with the real
+        serial filters (schedule_one.go:175 -> RunPostFilterPlugins)."""
+        import numpy as np
+
+        from .framework import CycleState
+
+        # post-batch capacity: fold every in-batch assignment into used state
+        used = cluster.used.astype(np.int64).copy()
+        pod_count = cluster.pod_count.astype(np.int64).copy()
+        a = np.asarray(assignment)
+        placed = a >= 0
+        if placed.any():
+            np.add.at(used, a[placed], sub.req[placed])
+            np.add.at(pod_count, a[placed], 1)
+        alloc = cluster.alloc.astype(np.int64)
+        max_pods = cluster.max_pods
+
+        filter_ok = sub.tables.filter_ok
+        node_names = cluster.node_names
+        for j, qp in rejected:
+            pod = qp.pod
+            cls = int(sub.class_of_pod[j])
+            req = sub.req[j].astype(np.int64)
+            fits = np.all((req[None, :] == 0) | (req[None, :] <= alloc - used),
+                          axis=1) & (pod_count + 1 <= max_pods)
+            static_ok = filter_ok[cls]
+            failed = {}
+            for i, name in enumerate(node_names):
+                if not static_ok[i]:
+                    failed[name] = Status.unresolvable(
+                        "node(s) didn't match the pod's static predicates")
+                elif not fits[i]:
+                    failed[name] = Status.unschedulable(
+                        "Insufficient resources on the node")
+                else:
+                    failed[name] = Status.unschedulable(
+                        "node rejected by in-batch constraints")
+            fw = self._fw(pod) or self.framework
+            state = CycleState()
+            fw.run_pre_filter(state, pod, snapshot)
+            from .serial import ScheduleResult
+
+            result = ScheduleResult(
+                status=Status.unschedulable(
+                    f"0/{len(node_names)} nodes are available"),
+                failed_nodes=failed, state=state,
+                evaluated_nodes=len(node_names))
+            self._maybe_preempt(qp, result)
+            self._handle_failure(qp, result.status)
 
     def _hard_pod_affinity_weight(self) -> int:
         for fw in self.profiles.values():

@@ -278,6 +278,21 @@ class Snapshot:
 # ---------------------------------------------------------------------------
 
 
+class ClusterEventWithHint:
+    """reference: framework/interface.go ClusterEventWithHint — an event a
+    plugin cares about plus an optional QueueingHintFn. The hint decides
+    whether the event could make a pod this plugin rejected schedulable:
+    hint(pod, event_obj) -> bool (True = Queue, False = Skip). hint=None means
+    always Queue (the pre-hints behavior for that event)."""
+
+    __slots__ = ("resource", "action", "hint")
+
+    def __init__(self, resource: str, action: str, hint=None):
+        self.resource = resource  # store kind: "pods", "nodes", storage kinds
+        self.action = action  # "add" | "update" | "delete"
+        self.hint = hint
+
+
 class Plugin:
     name: str = "Plugin"
 
@@ -290,6 +305,12 @@ class Plugin:
     # normalize_score(state, pod, scores: dict) -> Status
     # reserve/unreserve, permit, pre_bind, bind, post_bind
     # add_pod/remove_pod: PreFilterExtensions for incremental state updates
+
+    def events_to_register(self):
+        """EnqueueExtensions (interface.go:482): the cluster events that can
+        make a pod rejected by this plugin schedulable. Default: none — a
+        plugin that never rejects needs no events."""
+        return ()
 
 
 def default_normalize_score(max_priority: int, reverse: bool, scores: Dict[str, int]) -> None:

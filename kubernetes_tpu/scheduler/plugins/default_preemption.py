@@ -9,17 +9,21 @@ Algorithm preserved:
      whose victims are still terminating, don't preempt again (:246).
   2. Candidates = nodes that failed with UNSCHEDULABLE (not UNRESOLVABLE).
   3. Dry run per node: remove ALL lower-priority pods; if the pod then fits,
-     reprieve victims highest-priority-first while the pod still fits; the rest
-     are the node's victims (fewest possible, highest-value kept).
-  4. SelectCandidate: fewest PDB violations (PDBs land later — count is 0),
-     then highest victim-priority minimum, then smallest victim sum, then
-     fewest victims, then node order (pick_one_node_for_preemption :560).
-  5. prepareCandidate: DELETE victims, clear their nominations, set the
-     preemptor's status.nominatedNodeName.
+     reprieve victims while the pod still fits — PDB-violating victims first
+     (so they are most likely to be kept), then non-violating, each
+     highest-priority-first (selectVictimsOnNode + filterPodsWithPDBViolation);
+     reprieve failures among the violating set count as PDB violations.
+  4. SelectCandidate: fewest PDB violations, then highest victim-priority
+     minimum, then smallest victim sum, then fewest victims, then node order
+     (pick_one_node_for_preemption :560).
+  5. prepareCandidate[Async]: DELETE victims (async on a worker thread when
+     async_preparation is on — prepareCandidateAsync :470), set the
+     preemptor's status.nominatedNodeName synchronously.
 """
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -36,14 +40,28 @@ class Candidate:
 class DefaultPreemption:
     name = "DefaultPreemption"
 
-    def __init__(self, framework=None, store=None):
+    # candidate search caps (defaultpreemption config defaults:
+    # minCandidateNodesPercentage 10, minCandidateNodesAbsolute 100)
+    MIN_CANDIDATE_NODES_PERCENTAGE = 10
+    MIN_CANDIDATE_NODES_ABSOLUTE = 100
+
+    def __init__(self, framework=None, store=None, async_preparation: bool = False):
         self.framework = framework
         self.store = store
+        # SchedulerAsyncPreemption: victim deletion off the scheduling thread
+        self.async_preparation = async_preparation
+        self._prep_threads: List[threading.Thread] = []
 
     def set_handles(self, framework, store) -> None:
         """Injected by the Scheduler (the reference passes framework.Handle)."""
         self.framework = framework
         self.store = store
+
+    def _pdbs(self):
+        if self.store is None:
+            return []
+        pdbs, _ = self.store.list("poddisruptionbudgets")
+        return pdbs
 
     def post_filter(self, state: CycleState, pod, filtered_statuses: Dict[str, Status]):
         """Returns (nominated_node_name | None, Status)."""
@@ -65,18 +83,46 @@ class DefaultPreemption:
     # -- dry run (DryRunPreemption :680) ---------------------------------------
 
     def _find_candidates(self, state, pod, snapshot, filtered_statuses) -> List[Candidate]:
+        pdbs = self._pdbs()
+        # candidate cap (GetOffsetAndNumCandidates, preemption.go:595): dry-run
+        # until enough candidates are found instead of sweeping every node
+        n = len(snapshot.node_info_list)
+        num_candidates = max(self.MIN_CANDIDATE_NODES_ABSOLUTE,
+                             n * self.MIN_CANDIDATE_NODES_PERCENTAGE // 100)
         out = []
         for ni in snapshot.node_info_list:
             name = ni.node.metadata.name
             st = filtered_statuses.get(name)
             if st is not None and st.code == Code.UNSCHEDULABLE_AND_UNRESOLVABLE:
                 continue  # removing pods cannot help (interface.go semantics)
-            cand = self._dry_run_node(state, pod, ni)
+            cand = self._dry_run_node(state, pod, ni, pdbs)
             if cand is not None:
                 out.append(cand)
+                if len(out) >= num_candidates:
+                    break
         return out
 
-    def _dry_run_node(self, state, pod, node_info: NodeInfo) -> Optional[Candidate]:
+    @staticmethod
+    def _split_pdb_violating(victims, pdbs):
+        """filterPodsWithPDBViolation (preemption.go): a victim violates when it
+        matches a PDB with no disruption budget left; each non-violating match
+        consumes one unit of that PDB's remaining allowance."""
+        allowed = [p.disruptions_allowed for p in pdbs]
+        violating, non_violating = [], []
+        for v in victims:
+            hits = [i for i, p in enumerate(pdbs)
+                    if p.metadata.namespace == v.metadata.namespace
+                    and p.selector is not None
+                    and p.selector.matches(v.metadata.labels)]
+            if any(allowed[i] <= 0 for i in hits):
+                violating.append(v)
+            else:
+                for i in hits:
+                    allowed[i] -= 1
+                non_violating.append(v)
+        return violating, non_violating
+
+    def _dry_run_node(self, state, pod, node_info: NodeInfo, pdbs) -> Optional[Candidate]:
         fw = self.framework
         ni = node_info.clone()
         st = state.clone()
@@ -91,20 +137,34 @@ class DefaultPreemption:
             fw.run_remove_pod(st, pod, v, ni)
         if not fw.run_filter(st, pod, ni).is_success():
             return None
-        # reprieve highest-priority victims first while the pod still fits
+        # reprieve while the pod still fits: PDB-violating victims first (most
+        # likely to be KEPT), then non-violating; highest priority first within
+        # each set (selectVictimsOnNode)
         potential_victims.sort(key=lambda p: (-p.spec.priority, p.key))
+        violating, non_violating = self._split_pdb_violating(potential_victims, pdbs)
         victims = []
-        for v in potential_victims:
+        num_violations = 0
+
+        def reprieve(v) -> bool:
             ni.add_pod(PodInfo(v))
             fw.run_add_pod(st, pod, v, ni)
-            if not fw.run_filter(st, pod, ni).is_success():
-                ni.remove_pod(v)
-                fw.run_remove_pod(st, pod, v, ni)
-                victims.append(v)
+            if fw.run_filter(st, pod, ni).is_success():
+                return True
+            ni.remove_pod(v)
+            fw.run_remove_pod(st, pod, v, ni)
+            victims.append(v)
+            return False
+
+        for v in violating:
+            if not reprieve(v):
+                num_violations += 1
+        for v in non_violating:
+            reprieve(v)
         if not victims:
             return None  # pod fit without evictions: not a preemption case
         victims.sort(key=lambda p: -p.spec.priority)
-        return Candidate(node_name=node_info.node.metadata.name, victims=victims)
+        return Candidate(node_name=node_info.node.metadata.name, victims=victims,
+                         num_pdb_violations=num_violations)
 
     # -- selection (pick_one_node_for_preemption :560) -------------------------
 
@@ -122,17 +182,13 @@ class DefaultPreemption:
 
         return min(candidates, key=key)
 
-    # -- execution (prepareCandidate :431) -------------------------------------
+    # -- execution (prepareCandidate :431 / prepareCandidateAsync :470) --------
 
     def _prepare_candidate(self, cand: Candidate, pod) -> None:
         if self.store is None:
             return
-        for v in cand.victims:
-            try:
-                # clear nomination of victims nominated to this node first
-                self.store.delete("pods", v.key)
-            except Exception:
-                pass
+        # nomination is set synchronously either way — the next cycle's
+        # nominated-node fast path depends on it (schedule_one.go:492)
         try:
             self.store.update_pod_status(
                 pod.metadata.namespace, pod.metadata.name,
@@ -140,3 +196,24 @@ class DefaultPreemption:
             )
         except Exception:
             pass
+        if self.async_preparation:
+            t = threading.Thread(target=self._delete_victims,
+                                 args=(cand.victims,), daemon=True)
+            t.start()
+            self._prep_threads = [x for x in self._prep_threads if x.is_alive()]
+            self._prep_threads.append(t)
+        else:
+            self._delete_victims(cand.victims)
+
+    def _delete_victims(self, victims) -> None:
+        for v in victims:
+            try:
+                self.store.delete("pods", v.key)
+            except Exception:
+                pass
+
+    def wait_for_preparation(self) -> None:
+        """Join outstanding async victim deletions (test/quiesce hook)."""
+        for t in self._prep_threads:
+            t.join(timeout=5)
+        self._prep_threads = []

@@ -41,6 +41,30 @@ class NodeResourcesFit(Plugin):
         # RequestedToCapacityRatio piecewise-linear shape: [(utilization, score)]
         self.shape = shape or [(0, 0), (100, 10)]
 
+    def events_to_register(self):
+        """fit.go EventsToRegister: Node add/update (more capacity may fit the
+        pod), assigned-Pod delete/update (resources freed)."""
+        from ..framework import ClusterEventWithHint
+
+        def node_could_fit(pod, node):
+            # isSchedulableAfterNodeChange simplification: queue when the
+            # request fits the node's full allocatable (optimistic — the
+            # filter re-checks against live usage)
+            from ...api import Resource, compute_pod_resource_request
+
+            req = compute_pod_resource_request(pod)
+            alloc = Resource.from_resource_list(node.status.allocatable)
+            return (req.milli_cpu <= alloc.milli_cpu and req.memory <= alloc.memory
+                    and all(alloc.scalar.get(k, 0) >= v for k, v in req.scalar.items()))
+
+        def assigned_pod_freed(pod, event_pod):
+            return bool(event_pod.spec.node_name)
+
+        return (ClusterEventWithHint("nodes", "add", node_could_fit),
+                ClusterEventWithHint("nodes", "update", node_could_fit),
+                ClusterEventWithHint("pods", "delete", assigned_pod_freed),
+                ClusterEventWithHint("pods", "update", assigned_pod_freed))
+
     # -- PreFilter -------------------------------------------------------------
 
     def pre_filter(self, state: CycleState, pod, snapshot):

@@ -63,6 +63,32 @@ class InterPodAffinity(Plugin):
         aff = pod.spec.affinity
         return bool(aff and (aff.pod_affinity_required or aff.pod_anti_affinity_required))
 
+    def events_to_register(self):
+        """interpodaffinity EventsToRegister: Pod add/update/delete (a matching
+        pod appearing satisfies affinity; a blocking pod leaving clears
+        anti-affinity) and Node add/update (new topology domains)."""
+        from ..framework import ClusterEventWithHint
+
+        def pod_related(pod, event_pod):
+            aff = pod.spec.affinity
+            if aff is None:
+                return True  # rejected via symmetry: any pod event may matter
+            terms = (tuple(aff.pod_affinity_required)
+                     + tuple(aff.pod_anti_affinity_required))
+            if any(term_matches_pod(t, pod, event_pod, self._ns_labels)
+                   for t in terms):
+                return True
+            # symmetric direction: the event pod's own terms may target us
+            ev_aff = event_pod.spec.affinity
+            return bool(ev_aff and (ev_aff.pod_affinity_required
+                                    or ev_aff.pod_anti_affinity_required))
+
+        return (ClusterEventWithHint("pods", "add", pod_related),
+                ClusterEventWithHint("pods", "update", pod_related),
+                ClusterEventWithHint("pods", "delete", pod_related),
+                ClusterEventWithHint("nodes", "add"),
+                ClusterEventWithHint("nodes", "update"))
+
     # -- Filter ----------------------------------------------------------------
 
     def pre_filter(self, state: CycleState, pod, snapshot):

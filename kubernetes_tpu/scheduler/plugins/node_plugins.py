@@ -31,6 +31,14 @@ class NodeName(Plugin):
 
     name = "NodeName"
 
+    def events_to_register(self):
+        from ..framework import ClusterEventWithHint
+
+        def is_the_node(pod, node):
+            return node.metadata.name == pod.spec.node_name
+
+        return (ClusterEventWithHint("nodes", "add", is_the_node),)
+
     def filter(self, state, pod, node_info: NodeInfo) -> Status:
         if pod.spec.node_name and pod.spec.node_name != node_info.node.metadata.name:
             return Status.unschedulable("node(s) didn't match the requested node name",
@@ -43,6 +51,19 @@ class NodePorts(Plugin):
 
     name = "NodePorts"
     _KEY = "PreFilterNodePorts"
+
+    def events_to_register(self):
+        from ..framework import ClusterEventWithHint, _host_ports
+
+        def freed_wanted_port(pod, event_pod):
+            if not event_pod.spec.node_name:
+                return False
+            wanted = {(proto, port) for _, proto, port in _host_ports(pod)}
+            return any((proto, port) in wanted
+                       for _, proto, port in _host_ports(event_pod))
+
+        return (ClusterEventWithHint("nodes", "add"),
+                ClusterEventWithHint("pods", "delete", freed_wanted_port))
 
     def pre_filter(self, state: CycleState, pod, snapshot):
         from ..framework import _host_ports
@@ -76,6 +97,15 @@ class NodeUnschedulable(Plugin):
     name = "NodeUnschedulable"
     _UNSCHEDULABLE_TAINT_KEY = "node.kubernetes.io/unschedulable"
 
+    def events_to_register(self):
+        from ..framework import ClusterEventWithHint
+
+        def now_schedulable(pod, node):
+            return not node.spec.unschedulable
+
+        return (ClusterEventWithHint("nodes", "add", now_schedulable),
+                ClusterEventWithHint("nodes", "update", now_schedulable))
+
     def filter(self, state, pod, node_info: NodeInfo) -> Status:
         if not node_info.node.spec.unschedulable:
             return SUCCESS
@@ -94,6 +124,15 @@ class NodeAffinity(Plugin):
     preferred term weights, DefaultNormalizeScore (node_affinity.go)."""
 
     name = "NodeAffinity"
+
+    def events_to_register(self):
+        from ..framework import ClusterEventWithHint
+
+        def node_matches(pod, node):
+            return node_matches_node_selector_and_affinity(pod, node)
+
+        return (ClusterEventWithHint("nodes", "add", node_matches),
+                ClusterEventWithHint("nodes", "update", node_matches))
 
     def filter(self, state, pod, node_info: NodeInfo) -> Status:
         if not node_matches_node_selector_and_affinity(pod, node_info.node):
@@ -121,6 +160,17 @@ class TaintToleration(Plugin):
     PreferNoSchedule taints, normalized reversed (taint_toleration.go)."""
 
     name = "TaintToleration"
+
+    def events_to_register(self):
+        from ..framework import ClusterEventWithHint
+
+        def taints_tolerated(pod, node):
+            return find_matching_untolerated_taint(
+                node.spec.taints, pod.spec.tolerations,
+                effects=(TAINT_NO_SCHEDULE, TAINT_NO_EXECUTE)) is None
+
+        return (ClusterEventWithHint("nodes", "add", taints_tolerated),
+                ClusterEventWithHint("nodes", "update", taints_tolerated))
 
     def filter(self, state, pod, node_info: NodeInfo) -> Status:
         taint = find_matching_untolerated_taint(

@@ -68,6 +68,27 @@ class PodTopologySpread(Plugin):
         self.default_constraints = tuple(default_constraints)
         self.system_defaulted = system_defaulted
 
+    def events_to_register(self):
+        """podtopologyspread EventsToRegister: Pod add/update/delete of pods
+        matching a constraint selector shift the skew; Node add/update can add
+        topology domains."""
+        from ..framework import ClusterEventWithHint
+
+        def pod_counts(pod, event_pod):
+            if event_pod.metadata.namespace != pod.metadata.namespace:
+                return False
+            for c in pod.spec.topology_spread_constraints:
+                sel = pts_effective_selector(c, pod)
+                if sel is not None and sel.matches(event_pod.metadata.labels):
+                    return True
+            return False
+
+        return (ClusterEventWithHint("pods", "add", pod_counts),
+                ClusterEventWithHint("pods", "update", pod_counts),
+                ClusterEventWithHint("pods", "delete", pod_counts),
+                ClusterEventWithHint("nodes", "add"),
+                ClusterEventWithHint("nodes", "update"))
+
     # -- Filter path -----------------------------------------------------------
 
     def pre_filter(self, state: CycleState, pod, snapshot):

@@ -138,6 +138,23 @@ class VolumeBinding(Plugin):
         self.lister = lister or VolumeLister()
         self._store = None
 
+    def events_to_register(self):
+        """volume_binding EventsToRegister: any PV/PVC/StorageClass/CSINode
+        change can unblock a pending claim; assigned-pod deletes release
+        ReadWriteOncePod claims and attach slots."""
+        from ..framework import ClusterEventWithHint
+
+        return (ClusterEventWithHint("persistentvolumes", "add"),
+                ClusterEventWithHint("persistentvolumes", "update"),
+                ClusterEventWithHint("persistentvolumeclaims", "add"),
+                ClusterEventWithHint("persistentvolumeclaims", "update"),
+                ClusterEventWithHint("storageclasses", "add"),
+                ClusterEventWithHint("storageclasses", "update"),
+                ClusterEventWithHint("csinodes", "add"),
+                ClusterEventWithHint("csinodes", "update"),
+                ClusterEventWithHint("nodes", "add"),
+                ClusterEventWithHint("pods", "delete"))
+
     def set_handles(self, framework, store) -> None:
         """Persist PreBind's PVC/PV writes through the API store (the reference
         binder PATCHes the apiserver; serial.py calls this during wiring)."""

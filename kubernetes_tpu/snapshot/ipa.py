@@ -72,42 +72,44 @@ def _term_matcher(term, source_pod, ns_labels) -> Callable[[Pod], bool]:
 
 @dataclass
 class IPATensors:
-    """Batch-scoped InterPodAffinity tensors (numpy; ops/ uploads)."""
+    """Batch-scoped InterPodAffinity tensors (numpy; ops/ uploads).
 
-    # incoming pod's terms: one row per (class, term); *_sel indexes the shared
-    # selector-class count tensor, *_key the topo_id rows
-    ra_class: np.ndarray  # [RA] int32 — required affinity
-    ra_key: np.ndarray
+    All term tables are PER-CLASS padded rows (-1 = inactive slot): the scan
+    solver gathers one class row per pod step, so per-step device cost scales
+    with the max term count of a single class, not the batch-wide total —
+    the difference between O(C·N) and O(terms·N) per pod at bench scale.
+    """
+
+    # incoming pod's terms per class; *_sel indexes the shared selector-class
+    # count tensor, *_key the topo_id rows; -1 pads
+    ra_key: np.ndarray  # [C, RAm] — required affinity
     ra_sel: np.ndarray
-    rn_class: np.ndarray  # [RN] int32 — required anti-affinity
-    rn_key: np.ndarray
+    rn_key: np.ndarray  # [C, RNm] — required anti-affinity
     rn_sel: np.ndarray
-    pp_class: np.ndarray  # [PP] int32 — preferred terms (signed weight)
-    pp_key: np.ndarray
+    pp_key: np.ndarray  # [C, PPm] — preferred terms
     pp_sel: np.ndarray
-    pp_weight: np.ndarray
+    pp_weight: np.ndarray  # [C, PPm] signed; 0 on pads
 
     # holder groups
     grp_key: np.ndarray  # [G] int32 — topo_id row per group
     grp_count: np.ndarray  # [G, N] int32 — existing holders per node
     class_holds_grp: np.ndarray  # [C, G] int32 — terms of class c in group g
 
-    # filter rule 1: required-anti groups x does group match incoming class?
-    ea_grp: np.ndarray  # [E] int32 (index into G)
-    ea_match: np.ndarray  # [C, E] bool
+    # filter rule 1: required-anti groups matching each class (-1 pads)
+    ea_grp: np.ndarray  # [C, Em] int32 (index into G)
 
-    # symmetric score rows: group, signed weight, per-class match
-    sym_grp: np.ndarray  # [S] int32
-    sym_weight: np.ndarray  # [S] int32
-    sym_match: np.ndarray  # [C, S] bool
+    # symmetric score: groups whose terms match each class + signed weight
+    sym_grp: np.ndarray  # [C, Sm] int32 (-1 pads)
+    sym_weight: np.ndarray  # [C, Sm] int32 (0 on pads)
 
     class_self_ok: np.ndarray  # [C] bool — pod matches all own required terms
     class_has_ra: np.ndarray  # [C] bool
 
     @property
     def has_any(self) -> bool:
-        return bool(self.ra_class.size or self.rn_class.size or self.pp_class.size
-                    or self.ea_grp.size or self.sym_grp.size)
+        return bool((self.ra_key >= 0).any() or (self.rn_key >= 0).any()
+                    or (self.pp_key >= 0).any() or (self.ea_grp >= 0).any()
+                    or (self.sym_grp >= 0).any())
 
 
 def compile_ipa(
@@ -128,10 +130,10 @@ def compile_ipa(
     """
     c = len(rep_pods)
 
-    # ---- incoming-term rows ------------------------------------------------
-    ra_rows: List[Tuple[int, int, int]] = []
-    rn_rows: List[Tuple[int, int, int]] = []
-    pp_rows: List[Tuple[int, int, int, int]] = []
+    # ---- incoming-term rows, grouped per class -----------------------------
+    ra_rows: List[List[Tuple[int, int]]] = [[] for _ in range(c)]
+    rn_rows: List[List[Tuple[int, int]]] = [[] for _ in range(c)]
+    pp_rows: List[List[Tuple[int, int, int]]] = [[] for _ in range(c)]
     class_self_ok = np.zeros(c, dtype=bool)
     class_has_ra = np.zeros(c, dtype=bool)
 
@@ -151,15 +153,15 @@ def compile_ipa(
             class_self_ok[ci] = all(
                 term_matches_pod(t, pod, pod, ns_labels) for t in required)
         for term in required:
-            ra_rows.append((ci, topo_row(term.topology_key), _sel_row_for(term, pod)))
+            ra_rows[ci].append((topo_row(term.topology_key), _sel_row_for(term, pod)))
         for term in aff.pod_anti_affinity_required:
-            rn_rows.append((ci, topo_row(term.topology_key), _sel_row_for(term, pod)))
+            rn_rows[ci].append((topo_row(term.topology_key), _sel_row_for(term, pod)))
         for wt in aff.pod_affinity_preferred:
-            pp_rows.append((ci, topo_row(wt.term.topology_key),
-                            _sel_row_for(wt.term, pod), wt.weight))
+            pp_rows[ci].append((topo_row(wt.term.topology_key),
+                                _sel_row_for(wt.term, pod), wt.weight))
         for wt in aff.pod_anti_affinity_preferred:
-            pp_rows.append((ci, topo_row(wt.term.topology_key),
-                            _sel_row_for(wt.term, pod), -wt.weight))
+            pp_rows[ci].append((topo_row(wt.term.topology_key),
+                                _sel_row_for(wt.term, pod), -wt.weight))
 
     # ---- holder groups -----------------------------------------------------
     # group key -> (index, representative (term, source_pod))
@@ -235,40 +237,48 @@ def compile_ipa(
             class_holds_grp[ci, gi] += 1
 
     # ---- per-class matching against group representatives ------------------
-    ea_list = [gi for gi in range(g) if grp_kinds[gi] == _KIND_REQ_ANTI]
-    sym_list = [gi for gi in range(g) if grp_kinds[gi] != _KIND_REQ_ANTI]
-    ea_match = np.zeros((c, max(len(ea_list), 1)), dtype=bool)
-    sym_match = np.zeros((c, max(len(sym_list), 1)), dtype=bool)
-    for ci, pod in enumerate(rep_pods):
-        for ei, gi in enumerate(ea_list):
-            term, src = grp_reps[gi]
-            ea_match[ci, ei] = term_matches_pod(term, src, pod, ns_labels)
-        for si, gi in enumerate(sym_list):
-            term, src = grp_reps[gi]
-            sym_match[ci, si] = term_matches_pod(term, src, pod, ns_labels)
+    # a group is relevant to class c only if its representative term matches
+    # the class's rep pod; per-class index lists keep the device tables tight
+    ea_lists: List[List[int]] = [[] for _ in range(c)]
+    sym_lists: List[List[Tuple[int, int]]] = [[] for _ in range(c)]
+    for gi in range(g):
+        term, src = grp_reps[gi]
+        for ci, pod in enumerate(rep_pods):
+            if term_matches_pod(term, src, pod, ns_labels):
+                if grp_kinds[gi] == _KIND_REQ_ANTI:
+                    ea_lists[ci].append(gi)
+                else:
+                    sym_lists[ci].append((gi, grp_weights[gi]))
 
-    def arr(rows, width):
-        if not rows:
-            return tuple(np.zeros(0, dtype=np.int32) for _ in range(width))
-        a = np.array(rows, dtype=np.int32)
-        return tuple(a[:, i] for i in range(width))
+    def pad2(rows_per_class, width):
+        """[[tuple...]] -> `width` arrays [C, m], -1/0-padded."""
+        m = max((len(r) for r in rows_per_class), default=0)
+        m = max(m, 1)
+        out = [np.full((c, m), -1 if i < max(width - 1, 1) else 0, dtype=np.int32)
+               for i in range(width)]
+        # weights (last column of width-3 tables) pad with 0; keys/sels with -1
+        for ci, rows in enumerate(rows_per_class):
+            for j, row in enumerate(rows):
+                vals = row if isinstance(row, tuple) else (row,)
+                for i, v in enumerate(vals):
+                    out[i][ci, j] = v
+        return out
 
-    ra_class, ra_key, ra_sel = arr(ra_rows, 3)
-    rn_class, rn_key, rn_sel = arr(rn_rows, 3)
-    pp = arr(pp_rows, 4)
+    ra_key_c, ra_sel_c = pad2(ra_rows, 2)
+    rn_key_c, rn_sel_c = pad2(rn_rows, 2)
+    pp_key_c, pp_sel_c, pp_w_c = pad2(pp_rows, 3)
+    (ea_grp_c,) = pad2(ea_lists, 1)
+    sym_grp_c, sym_w_c = pad2(sym_lists, 2)
 
     return IPATensors(
-        ra_class=ra_class, ra_key=ra_key, ra_sel=ra_sel,
-        rn_class=rn_class, rn_key=rn_key, rn_sel=rn_sel,
-        pp_class=pp[0], pp_key=pp[1], pp_sel=pp[2], pp_weight=pp[3],
+        ra_key=ra_key_c, ra_sel=ra_sel_c,
+        rn_key=rn_key_c, rn_sel=rn_sel_c,
+        pp_key=pp_key_c, pp_sel=pp_sel_c, pp_weight=pp_w_c,
         grp_key=np.array(grp_topo, dtype=np.int32),
         grp_count=grp_count,
         class_holds_grp=class_holds_grp,
-        ea_grp=np.array(ea_list, dtype=np.int32),
-        ea_match=ea_match,
-        sym_grp=np.array(sym_list, dtype=np.int32),
-        sym_weight=np.array([grp_weights[gi] for gi in sym_list], dtype=np.int32),
-        sym_match=sym_match,
+        ea_grp=ea_grp_c,
+        sym_grp=sym_grp_c, sym_weight=sym_w_c,
         class_self_ok=class_self_ok,
         class_has_ra=class_has_ra,
     )

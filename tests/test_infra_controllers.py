@@ -191,6 +191,70 @@ class TestEndpointSlice:
         assert sum(len(s.endpoints) for s in slices) == 2
 
 
+class TestDisruptionController:
+    """Mirrors pkg/controller/disruption trySync: disruptionsAllowed =
+    max(0, currentHealthy - desiredHealthy)."""
+
+    def _setup(self):
+        from kubernetes_tpu.controllers import DisruptionController
+
+        store = APIStore()
+        ctl = DisruptionController(store, clock=FakeClock())
+        ctl.sync_all()
+        return store, ctl
+
+    def _pdb(self, store, name="pdb", min_available=None, max_unavailable=None,
+             labels=None):
+        from kubernetes_tpu.api.policy import PodDisruptionBudget
+        from kubernetes_tpu.api.labels import Selector
+        from kubernetes_tpu.api.types import ObjectMeta
+
+        store.create("poddisruptionbudgets", PodDisruptionBudget(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            selector=Selector.from_match_labels(labels or {"app": "web"}),
+            min_available=min_available, max_unavailable=max_unavailable))
+
+    def test_min_available_absolute(self):
+        store, ctl = self._setup()
+        for i in range(5):
+            store.create("pods", MakePod(f"w{i}").labels({"app": "web"})
+                         .node("n1").obj())
+        self._pdb(store, min_available=3)
+        ctl.reconcile_once()
+        pdb = store.get("poddisruptionbudgets", "default/pdb")
+        assert pdb.disruptions_allowed == 2
+
+    def test_max_unavailable_percent(self):
+        store, ctl = self._setup()
+        for i in range(10):
+            store.create("pods", MakePod(f"w{i}").labels({"app": "web"})
+                         .node("n1").obj())
+        self._pdb(store, max_unavailable="20%")
+        ctl.reconcile_once()
+        # desired = 10 - ceil(20% of 10) = 8 -> allowed 2
+        assert store.get("poddisruptionbudgets", "default/pdb").disruptions_allowed == 2
+
+    def test_unbound_pods_not_healthy(self):
+        store, ctl = self._setup()
+        for i in range(3):
+            store.create("pods", MakePod(f"w{i}").labels({"app": "web"}).obj())
+        self._pdb(store, min_available=1)
+        ctl.reconcile_once()
+        # 0 healthy (none bound): allowed stays 0
+        assert store.get("poddisruptionbudgets", "default/pdb").disruptions_allowed == 0
+
+    def test_pod_events_retrigger(self):
+        store, ctl = self._setup()
+        self._pdb(store, min_available=1)
+        ctl.reconcile_once()
+        assert store.get("poddisruptionbudgets", "default/pdb").disruptions_allowed == 0
+        for i in range(2):
+            store.create("pods", MakePod(f"w{i}").labels({"app": "web"})
+                         .node("n1").obj())
+        ctl.reconcile_once()
+        assert store.get("poddisruptionbudgets", "default/pdb").disruptions_allowed == 1
+
+
 class TestTaintEviction:
     def _setup(self):
         store = APIStore()

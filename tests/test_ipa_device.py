@@ -86,9 +86,9 @@ class TestIPADevicePath:
         batch = build_pod_batch(pods, snap, cluster)
         assert not batch.fallback_class.any()
         assert batch.ipa.has_any
-        assert batch.ipa.ra_class.size == 1  # one class
-        assert batch.ipa.rn_class.size == 1
-        assert batch.ipa.pp_class.size == 1
+        assert (batch.ipa.ra_key >= 0).sum() == 1  # one class, one term each
+        assert (batch.ipa.rn_key >= 0).sum() == 1
+        assert (batch.ipa.pp_key >= 0).sum() == 1
 
     def test_required_affinity_colocates_with_existing(self):
         nodes = zone_nodes()

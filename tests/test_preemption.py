@@ -105,6 +105,90 @@ class TestPreemption:
             with pytest.raises(NotFoundError):
                 store.get("pods", f"default/{gone}")
 
+    def test_pdb_protected_node_avoided(self):
+        """SelectCandidate prefers the candidate with fewest PDB violations
+        (pick_one_node_for_preemption): victims on n0 are PDB-protected
+        (disruptionsAllowed=0), so the preemptor goes to n1."""
+        from kubernetes_tpu.api.policy import PodDisruptionBudget
+        from kubernetes_tpu.api.types import ObjectMeta
+        from kubernetes_tpu.api.labels import Selector
+
+        store = APIStore()
+        for n in ("n0", "n1"):
+            store.create("nodes", MakeNode(n).capacity({"cpu": "2", "pods": "10"}).obj())
+        prot = MakePod("protected").labels({"app": "critical"}).priority(1).req(
+            {"cpu": "2"}).obj()
+        prot.spec.node_name = "n0"
+        store.create("pods", prot)
+        plain = MakePod("plain").priority(1).req({"cpu": "2"}).obj()
+        plain.spec.node_name = "n1"
+        store.create("pods", plain)
+        pdb = PodDisruptionBudget(
+            metadata=ObjectMeta(name="crit-pdb", namespace="default"),
+            selector=Selector.from_match_labels({"app": "critical"}),
+            min_available=1, disruptions_allowed=0)
+        store.create("poddisruptionbudgets", pdb)
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        store.create("pods", MakePod("high").priority(100).req({"cpu": "2"}).obj())
+        drive(sched)
+        assert store.get("pods", "default/high").spec.node_name == "n1"
+        assert store.get("pods", "default/protected").spec.node_name == "n0"
+        with pytest.raises(NotFoundError):
+            store.get("pods", "default/plain")
+
+    def test_pdb_with_budget_is_spendable(self):
+        """disruptionsAllowed > 0 means the victim does NOT count as a
+        violation, so the protected node is still preemptable."""
+        from kubernetes_tpu.api.policy import PodDisruptionBudget
+        from kubernetes_tpu.api.types import ObjectMeta
+        from kubernetes_tpu.api.labels import Selector
+
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "2", "pods": "10"}).obj())
+        prot = MakePod("victim").labels({"app": "web"}).priority(1).req({"cpu": "2"}).obj()
+        prot.spec.node_name = "n0"
+        store.create("pods", prot)
+        store.create("poddisruptionbudgets", PodDisruptionBudget(
+            metadata=ObjectMeta(name="web-pdb", namespace="default"),
+            selector=Selector.from_match_labels({"app": "web"}),
+            max_unavailable=1, disruptions_allowed=1))
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        store.create("pods", MakePod("high").priority(100).req({"cpu": "2"}).obj())
+        drive(sched)
+        assert store.get("pods", "default/high").spec.node_name == "n0"
+        with pytest.raises(NotFoundError):
+            store.get("pods", "default/victim")
+
+    def test_async_preparation_deletes_victims(self):
+        from kubernetes_tpu.scheduler.plugins.default_preemption import DefaultPreemption
+
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity({"cpu": "2", "pods": "10"}).obj())
+        store.create("pods", MakePod("low").priority(1).req({"cpu": "2"}).obj())
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        sched.run_until_idle()
+        for fw in sched.profiles.values():
+            for p in fw.plugins:
+                if isinstance(p, DefaultPreemption):
+                    p.async_preparation = True
+        store.create("pods", MakePod("high").priority(100).req({"cpu": "2"}).obj())
+        for _ in range(4):
+            sched.run_until_idle()
+            for fw in sched.profiles.values():
+                for p in fw.plugins:
+                    if isinstance(p, DefaultPreemption):
+                        p.wait_for_preparation()
+            time.sleep(1.1)
+            sched.queue.flush_backoff_completed()
+            sched.queue.flush_unschedulable_left_over()
+        sched.run_until_idle()
+        assert store.get("pods", "default/high").spec.node_name == "n0"
+        with pytest.raises(NotFoundError):
+            store.get("pods", "default/low")
+
     def test_batch_scheduler_preempts(self):
         store = APIStore()
         store.create("nodes", MakeNode("n0").capacity({"cpu": "2", "pods": "10"}).obj())
