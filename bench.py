@@ -288,9 +288,21 @@ def rung_preemption(results):
             low = MakePod(f"low-{i}").priority(1).req({"cpu": "3"}).obj()
             low.spec.node_name = f"node-{i}"
             store.create("pods", low)
+        # warm-up: compile the solver at the same [P=500, N=500] shapes on a
+        # throwaway cluster so the timed run measures scheduling, not XLA
+        warm_store = APIStore()
+        for n in _nodes(n_nodes, cpu="4"):
+            warm_store.create("nodes", n)
+        warm = BatchScheduler(warm_store, Framework(default_plugins()), solver="auto")
+        warm.sync()
+        for i in range(n_nodes):
+            warm_store.create("pods", MakePod(f"w-{i}").priority(100).req(
+                {"cpu": "2"}).obj())
+        warm.run_until_idle()
+
         sched = BatchScheduler(store, Framework(default_plugins()), solver="auto")
         sched.sync()
-        sched.run_until_idle()  # warm-up compile
+        sched.run_until_idle()
         for i in range(n_nodes):
             store.create("pods", MakePod(f"high-{i}").priority(100).req(
                 {"cpu": "2"}).obj())

@@ -143,8 +143,11 @@ def waterfill_solve(inp: SolverInputs, groups: List[Tuple[np.ndarray, int]]):
         has_port = bool(np.asarray(inp.class_ports[cls]).any())
         port_conflict = jnp.any(port_taken & inp.class_ports[cls][None, :], axis=1)
         # pow2 bucket keeps the jit key stable across batch sizes; never wider
-        # than the slot count (top_k requires k <= size)
+        # than the slot count (top_k requires k <= size). Floored at 256 so
+        # trickles of small batches (requeues, churn) share ONE compiled shape
+        # instead of compiling per power of two.
         k_slots = min(1 << (len(members) - 1).bit_length(), n * j_max)
+        k_slots = max(k_slots, min(256, n * j_max))
         k_per_node, chosen_nodes = waterfill_group(
             inp.alloc, used, used_nz, pod_count, inp.max_pods,
             inp.filter_ok[cls], port_conflict, has_port,

@@ -141,16 +141,18 @@ class BatchScheduler(Scheduler):
 
     def _handle_device_rejects(self, rejected, snapshot, cluster, sub,
                                assignment) -> None:
-        """Failure handling for pods the device solver could not place, without
-        re-running a serial scheduling cycle per pod (the per-node Python
-        filter loop would dominate preemption-heavy batches).
+        """Failure handling for pods the device solver could not place.
 
-        Per-node failure codes are synthesized from the class tables + the
-        post-batch capacity state: nodes failing static predicates (affinity/
-        taints/name/unschedulable) are UNSCHEDULABLE_AND_UNRESOLVABLE —
-        preemption cannot help (interface.go semantics) — everything else is
-        UNSCHEDULABLE, and the preemption dry run re-verifies with the real
-        serial filters (schedule_one.go:175 -> RunPostFilterPlugins)."""
+        When the batch is constraint-free (no PTS DoNotSchedule rows, no
+        inter-pod affinity), preemption candidates are computed as dense
+        priority-tier tensors (_batch_preempt) — the vector analog of the
+        reference's parallel DryRunPreemption (preemption.go:680) — and only
+        the single chosen node per pod is verified with the real serial
+        filters. Constrained batches keep the serial PostFilter path, because
+        evicting victims can change PTS/IPA feasibility in ways the tier math
+        does not model."""
+        import itertools
+
         import numpy as np
 
         from .framework import CycleState
@@ -164,10 +166,38 @@ class BatchScheduler(Scheduler):
             np.add.at(used, a[placed], sub.req[placed])
             np.add.at(pod_count, a[placed], 1)
         alloc = cluster.alloc.astype(np.int64)
-        max_pods = cluster.max_pods
+        max_pods = cluster.max_pods.astype(np.int64)
 
         filter_ok = sub.tables.filter_ok
         node_names = cluster.node_names
+        n = len(node_names)
+
+        constraint_free = sub.ct_class.size == 0 and not sub.ipa.has_any
+        if constraint_free:
+            # in-batch placements per node: the verify step must see them
+            placed_by_node = {}
+            for jj in np.nonzero(placed)[0]:
+                placed_by_node.setdefault(int(a[jj]), []).append(sub.pods[jj])
+            remaining = self._batch_preempt(
+                rejected, snapshot, cluster, sub, alloc, used, pod_count,
+                max_pods, placed_by_node)
+            # the tier math is strictly more permissive than the serial dry
+            # run for constraint-free pods (it ignores port conflicts), so a
+            # pod with no tier candidate has no serial candidate either —
+            # fail it without a second sweep.
+            for j, qp in remaining:
+                # attributed to Fit so hint-gated requeue fires on node
+                # capacity / assigned-pod-freed events
+                self._handle_failure(qp, Status.unschedulable(
+                    f"0/{n} nodes are available", plugin="NodeResourcesFit"))
+            return
+
+        # Constrained batch: synthesize the per-node failure map (vectorized;
+        # shared Status instances per category) and run the serial PostFilter.
+        unres = Status.unresolvable("node(s) didn't match the pod's static predicates")
+        nofit = Status.unschedulable("Insufficient resources on the node")
+        inbatch = Status.unschedulable("node rejected by in-batch constraints")
+        names_arr = np.array(node_names)
         for j, qp in rejected:
             pod = qp.pod
             cls = int(sub.class_of_pod[j])
@@ -176,28 +206,193 @@ class BatchScheduler(Scheduler):
                           axis=1) & (pod_count + 1 <= max_pods)
             static_ok = filter_ok[cls]
             failed = {}
-            for i, name in enumerate(node_names):
-                if not static_ok[i]:
-                    failed[name] = Status.unresolvable(
-                        "node(s) didn't match the pod's static predicates")
-                elif not fits[i]:
-                    failed[name] = Status.unschedulable(
-                        "Insufficient resources on the node")
-                else:
-                    failed[name] = Status.unschedulable(
-                        "node rejected by in-batch constraints")
+            failed.update(zip(names_arr[~static_ok].tolist(), itertools.repeat(unres)))
+            failed.update(zip(names_arr[static_ok & ~fits].tolist(), itertools.repeat(nofit)))
+            failed.update(zip(names_arr[static_ok & fits].tolist(), itertools.repeat(inbatch)))
             fw = self._fw(pod) or self.framework
             state = CycleState()
             fw.run_pre_filter(state, pod, snapshot)
             from .serial import ScheduleResult
 
             result = ScheduleResult(
-                status=Status.unschedulable(
-                    f"0/{len(node_names)} nodes are available"),
+                status=Status.unschedulable(f"0/{n} nodes are available"),
                 failed_nodes=failed, state=state,
-                evaluated_nodes=len(node_names))
+                evaluated_nodes=n)
             self._maybe_preempt(qp, result)
-            self._handle_failure(qp, result.status)
+            self._handle_failure(qp, result.status, result.failed_nodes)
+
+    def _preemption_plugin(self, fw):
+        from .plugins.default_preemption import DefaultPreemption
+
+        for p in fw.post_filter_plugins:
+            if isinstance(p, DefaultPreemption):
+                return p
+        return None
+
+    def _batch_preempt(self, rejected, snapshot, cluster, sub, alloc, used,
+                       pod_count, max_pods, placed_by_node):
+        """Tiered batch preemption (reference: preemption.go DryRunPreemption
+        :680 + SelectCandidate :396, reframed as tensor math).
+
+        For each rejected pod at priority p, candidate nodes are those where
+        the pod fits after evicting every pod with priority < p — computed
+        once per distinct tier as dense [N,R] freed-capacity tensors. Node
+        selection follows pick_one_node_for_preemption's order (fewest PDB
+        violations, lowest max victim priority, smallest priority sum, fewest
+        victims, index). Only the chosen node runs the serial dry run
+        (_dry_run_node), which produces the MINIMAL victim set via the
+        reprieve pass and exact PDB accounting; its victims update the tier
+        tensors so later pods in the batch see the new capacity.
+
+        Returns the (j, qp) pairs that could not be preempted."""
+        import numpy as np
+
+        from ..api import compute_pod_resource_request
+        from ..snapshot.tensorizer import _quantize
+        from .framework import CycleState, PodInfo
+        from .plugins.default_preemption import Candidate
+
+        n = cluster.n
+        dims = cluster.resource_dims
+        r = len(dims)
+
+        # flatten bound pods into victim arrays (one pass over the snapshot)
+        v_node, v_prio, v_req, v_pods = [], [], [], []
+        node_victims: List[List[int]] = [[] for _ in range(n)]
+        for i, ni in enumerate(snapshot.node_info_list):
+            for pi in ni.pods:
+                p = pi.pod
+                node_victims[i].append(len(v_pods))
+                v_node.append(i)
+                v_prio.append(p.spec.priority)
+                v_req.append(_quantize(
+                    compute_pod_resource_request(p), dims, is_request=True))
+                v_pods.append(p)
+        if not v_pods:
+            return list(rejected)
+        v_node = np.array(v_node, np.int64)
+        v_prio = np.array(v_prio, np.int64)
+        v_req = np.array(v_req, np.int64).reshape(len(v_pods), r)
+        v_alive = np.ones(len(v_pods), dtype=bool)
+
+        plugin_by_fw: dict = {}
+
+        def plugin_for(pod):
+            fw = self._fw(pod) or self.framework
+            got = plugin_by_fw.get(id(fw))
+            if got is None:
+                got = (fw, self._preemption_plugin(fw))
+                plugin_by_fw[id(fw)] = got
+            return got
+
+        # PDB exhaustion per victim (approximate violation count for node
+        # selection; the serial dry run on the chosen node is exact)
+        _, any_plugin = plugin_for(rejected[0][1].pod)
+        pdbs = any_plugin._pdbs() if any_plugin is not None else []
+        v_pdb_blocked = np.zeros(len(v_pods), dtype=bool)
+        if pdbs:
+            for vi, p in enumerate(v_pods):
+                v_pdb_blocked[vi] = any(
+                    pd.metadata.namespace == p.metadata.namespace
+                    and pd.selector is not None
+                    and pd.selector.matches(p.metadata.labels)
+                    and pd.disruptions_allowed <= 0
+                    for pd in pdbs)
+
+        tier_cache: dict = {}
+
+        def tier(p):
+            got = tier_cache.get(p)
+            if got is None:
+                mask = v_alive & (v_prio < p)
+                freed = np.zeros((n, r), np.int64)
+                np.add.at(freed, v_node[mask], v_req[mask])
+                cnt = np.zeros(n, np.int64)
+                np.add.at(cnt, v_node[mask], 1)
+                psum = np.zeros(n, np.int64)
+                np.add.at(psum, v_node[mask], v_prio[mask])
+                viol = np.zeros(n, np.int64)
+                if pdbs:
+                    np.add.at(viol, v_node[mask & v_pdb_blocked], 1)
+                pmax = np.full(n, -(2**31), np.int64)
+                np.maximum.at(pmax, v_node[mask], v_prio[mask])
+                got = [freed, cnt, psum, viol, pmax]
+                tier_cache[p] = got
+            return got
+
+        filter_ok = sub.tables.filter_ok
+        node_names = cluster.node_names
+        remaining = []
+        for j, qp in rejected:
+            pod = qp.pod
+            fw, plugin = plugin_for(pod)
+            if plugin is None or pod.spec.preemption_policy == "Never":
+                remaining.append((j, qp))
+                continue
+            p = pod.spec.priority
+            cls = int(sub.class_of_pod[j])
+            req = sub.req[j].astype(np.int64)
+            freed, cnt, psum, viol, pmax = tier(p)
+            fits = np.all((req[None, :] == 0)
+                          | (req[None, :] <= alloc - used + freed), axis=1)
+            fits &= pod_count + 1 - cnt <= max_pods
+            cand_mask = fits & filter_ok[cls] & (cnt > 0)
+            if not cand_mask.any():
+                remaining.append((j, qp))
+                continue
+            idxs = np.nonzero(cand_mask)[0]
+            order = np.lexsort((idxs, cnt[idxs], psum[idxs], pmax[idxs], viol[idxs]))
+            # candidate cap mirrors GetOffsetAndNumCandidates (preemption.go:595)
+            num_candidates = max(plugin.MIN_CANDIDATE_NODES_ABSOLUTE,
+                                 n * plugin.MIN_CANDIDATE_NODES_PERCENTAGE // 100)
+            state = CycleState()
+            _, st = fw.run_pre_filter(state, pod, snapshot)
+            chosen = None
+            if st.is_success():
+                for oi in order[:num_candidates]:  # best-ranked first
+                    nn = int(idxs[oi])
+                    ni = snapshot.node_info_list[nn]
+                    extra = placed_by_node.get(nn)
+                    if extra:
+                        ni = ni.clone()
+                        for xp in extra:
+                            ni.add_pod(PodInfo(xp))
+                    got = plugin._dry_run_node(state, pod, ni, pdbs)
+                    if got is not None:
+                        chosen = (nn, got)
+                        break
+            if chosen is None:
+                remaining.append((j, qp))
+                continue
+            nn, cand = chosen
+            victims = cand.victims
+            vkeys = {v.key for v in victims}
+            freed_now = np.zeros(r, np.int64)
+            for vi in node_victims[nn]:
+                if v_alive[vi] and v_pods[vi].key in vkeys:
+                    v_alive[vi] = False
+                    freed_now += v_req[vi]
+                    for tp, (tfreed, tcnt, tpsum, tviol, _tp) in tier_cache.items():
+                        if v_prio[vi] < tp:
+                            tfreed[nn] -= v_req[vi]
+                            tcnt[nn] -= 1
+                            tpsum[nn] -= v_prio[vi]
+                            if v_pdb_blocked[vi]:
+                                tviol[nn] -= 1
+            # max victim priority can only be recomputed, not decremented
+            for tp, arrs in tier_cache.items():
+                alive = [int(v_prio[vi]) for vi in node_victims[nn]
+                         if v_alive[vi] and v_prio[vi] < tp]
+                arrs[4][nn] = max(alive) if alive else -(2**31)
+            used[nn] += req - freed_now
+            pod_count[nn] += 1 - len(victims)
+            plugin._prepare_candidate(cand, pod)
+            qp.pod.status.nominated_node_name = node_names[nn]
+            self.preemption_count += 1
+            self._handle_failure(qp, Status.unschedulable(
+                f"preempted {len(victims)} pod(s) on {node_names[nn]}; "
+                "waiting for victims to terminate", plugin="NodeResourcesFit"))
+        return remaining
 
     def _hard_pod_affinity_weight(self) -> int:
         for fw in self.profiles.values():
@@ -275,10 +470,10 @@ class BatchScheduler(Scheduler):
         result = self.schedule_pod(qp.pod)
         if not result.suggested_host:
             self._maybe_preempt(qp, result)
-            self._handle_failure(qp, result.status)
+            self._handle_failure(qp, result.status, result.failed_nodes)
             return
         # Full commit chain (Reserve/Permit/PreBind/PostBind) — fallback pods
-        # (volumes, inter-pod affinity) depend on those extension points.
+        # (volumes, inter-pod affinity) depend on these extension points.
         self._commit_cycle(qp, result)
 
     def start(self) -> None:

@@ -45,12 +45,21 @@ class DefaultPreemption:
     MIN_CANDIDATE_NODES_PERCENTAGE = 10
     MIN_CANDIDATE_NODES_ABSOLUTE = 100
 
-    def __init__(self, framework=None, store=None, async_preparation: bool = False):
+    def __init__(self, framework=None, store=None,
+                 async_preparation: Optional[bool] = None):
+        from ...utils.featuregate import feature_gates
+
         self.framework = framework
         self.store = store
-        # SchedulerAsyncPreemption: victim deletion off the scheduling thread
+        # SchedulerAsyncPreemption: victim deletion off the scheduling thread.
+        # Defaults from the feature gate (beta, on — registry.go:45-60).
+        if async_preparation is None:
+            async_preparation = feature_gates.enabled("SchedulerAsyncPreemption")
         self.async_preparation = async_preparation
-        self._prep_threads: List[threading.Thread] = []
+        # one shared deletion worker (prepareCandidateAsync :470 runs one
+        # goroutine per candidate; a queue bounds thread count under batches)
+        self._prep_q = None  # queue.Queue, created lazily
+        self._prep_thread: Optional[threading.Thread] = None
 
     def set_handles(self, framework, store) -> None:
         """Injected by the Scheduler (the reference passes framework.Handle)."""
@@ -197,13 +206,27 @@ class DefaultPreemption:
         except Exception:
             pass
         if self.async_preparation:
-            t = threading.Thread(target=self._delete_victims,
-                                 args=(cand.victims,), daemon=True)
-            t.start()
-            self._prep_threads = [x for x in self._prep_threads if x.is_alive()]
-            self._prep_threads.append(t)
+            self._ensure_prep_worker()
+            self._prep_q.put(list(cand.victims))
         else:
             self._delete_victims(cand.victims)
+
+    def _ensure_prep_worker(self) -> None:
+        import queue as _q
+
+        if self._prep_q is None:
+            self._prep_q = _q.Queue()
+        if self._prep_thread is None or not self._prep_thread.is_alive():
+            self._prep_thread = threading.Thread(target=self._prep_loop, daemon=True)
+            self._prep_thread.start()
+
+    def _prep_loop(self) -> None:
+        while True:
+            victims = self._prep_q.get()
+            try:
+                self._delete_victims(victims)
+            finally:
+                self._prep_q.task_done()
 
     def _delete_victims(self, victims) -> None:
         for v in victims:
@@ -212,8 +235,13 @@ class DefaultPreemption:
             except Exception:
                 pass
 
-    def wait_for_preparation(self) -> None:
-        """Join outstanding async victim deletions (test/quiesce hook)."""
-        for t in self._prep_threads:
-            t.join(timeout=5)
-        self._prep_threads = []
+    def wait_for_preparation(self, timeout: float = 5.0) -> None:
+        """Wait (bounded) for outstanding async victim deletions (test/quiesce
+        hook); a hung store delete must not block the caller forever."""
+        import time
+
+        if self._prep_q is None:
+            return
+        deadline = time.monotonic() + timeout
+        while self._prep_q.unfinished_tasks and time.monotonic() < deadline:
+            time.sleep(0.005)

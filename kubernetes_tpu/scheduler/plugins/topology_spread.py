@@ -87,7 +87,10 @@ class PodTopologySpread(Plugin):
                 ClusterEventWithHint("pods", "update", pod_counts),
                 ClusterEventWithHint("pods", "delete", pod_counts),
                 ClusterEventWithHint("nodes", "add"),
-                ClusterEventWithHint("nodes", "update"))
+                ClusterEventWithHint("nodes", "update"),
+                # a domain disappearing can lower minMatchNum below the skew
+                # bound (upstream registers Node Add|Delete)
+                ClusterEventWithHint("nodes", "delete"))
 
     # -- Filter path -----------------------------------------------------------
 

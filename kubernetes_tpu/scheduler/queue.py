@@ -118,15 +118,29 @@ class SchedulingQueue:
 
     def move_all_to_active_or_backoff(self) -> None:
         """MoveAllToActiveOrBackoffQueue (:1028) on a cluster event."""
+        self.move_pods_for_event(lambda qp: True)
+
+    def move_pods_for_event(self, should_move) -> None:
+        """movePodsToActiveOrBackoffQueue (:1028) gated by QueueingHints:
+        should_move(qp) -> bool decides, per unschedulable pod, whether this
+        cluster event could make it schedulable (the scheduler derives it from
+        the rejecting plugins' hint functions — scheduling_queue.go:263
+        QueueingHintMap + podMatchesEvent). Pods that stay are still swept by
+        flush_unschedulable_left_over (the reference's safety net)."""
         with self._lock:
+            moved = False
             for key, qp in list(self._unschedulable.items()):
+                if not should_move(qp):
+                    continue
                 self._unschedulable.pop(key)
                 remaining = self._backoff_remaining(qp)
                 if remaining > 0:
                     heapq.heappush(self._backoff, (self._clock.now() + remaining, next(self._seq), qp))
                 else:
                     self._push_active(qp)
-            self._lock.notify_all()
+                moved = True
+            if moved:
+                self._lock.notify_all()
 
     def _backoff_remaining(self, qp: QueuedPodInfo) -> float:
         if qp.attempts == 0:

@@ -116,6 +116,9 @@ class Scheduler:
         self.scheduled_count = 0
         self.failed_count = 0
         self.preemption_count = 0
+        # QueueingHintMap per framework (buildQueueingHintMap, scheduler.go:405):
+        # (resource, action) -> {plugin name: [hint fn | None]}
+        self._hint_maps: Dict[int, Dict] = {}
         # ns labels for InterPodAffinity namespaceSelector
         self._ns_labels: Dict[str, Dict[str, str]] = {}
         # plugins needing framework/store handles (e.g. DefaultPreemption)
@@ -194,13 +197,77 @@ class Scheduler:
                 break
         return n
 
+    _EVENT_ACTION = {ADDED: "add", MODIFIED: "update", DELETED: "delete"}
+
+    def _hint_map(self, fw: Framework) -> Tuple[Dict, frozenset]:
+        """Returns ((resource, action) -> {plugin: [hints]}, names of plugins
+        that registered ANY event). A rejecting plugin that registered nothing
+        is treated as interested in every event (the reference registers
+        non-EnqueueExtensions plugins for all events — scheduler.go:405)."""
+        got = self._hint_maps.get(id(fw))
+        if got is None:
+            hmap: Dict = {}
+            registered = set()
+            for p in fw.plugins:
+                for ev in getattr(p, "events_to_register", lambda: ())():
+                    registered.add(p.name)
+                    hmap.setdefault((ev.resource, ev.action), {}) \
+                        .setdefault(p.name, []).append(ev.hint)
+            got = (hmap, frozenset(registered))
+            self._hint_maps[id(fw)] = got
+        return got
+
+    def _move_for_event(self, resource: str, etype: str, obj) -> None:
+        """Hint-gated requeue on a cluster event (scheduling_queue.go:263,1028
+        QueueingHintMap + podMatchesEvent): an unschedulable pod moves only if
+        one of its rejecting plugins registered this event and its hint (if
+        any) returns Queue. Pods with no recorded rejector move conservatively;
+        hint errors queue conservatively. SchedulerQueueingHints=false restores
+        the pre-hints move-everything behavior."""
+        from ..utils.featuregate import feature_gates
+
+        try:
+            hints_on = feature_gates.enabled("SchedulerQueueingHints")
+        except KeyError:
+            hints_on = True
+        if not hints_on:
+            self.queue.move_all_to_active_or_backoff()
+            return
+        action = self._EVENT_ACTION.get(etype, etype)
+
+        def should_move(qp: QueuedPodInfo) -> bool:
+            if not qp.unschedulable_plugins:
+                return True
+            fw = self._fw(qp.pod) or self.framework
+            hmap, registered = self._hint_map(fw)
+            entries = hmap.get((resource, action), {})
+            for name in qp.unschedulable_plugins:
+                if not name or name not in registered:
+                    # unattributed rejection, or a rejector that declared no
+                    # events at all: conservative move on any event
+                    return True
+                hints = entries.get(name)
+                if hints is None:
+                    continue  # this plugin doesn't care about the event
+                for h in hints:
+                    if h is None:
+                        return True
+                    try:
+                        if h(qp.pod, obj):
+                            return True
+                    except Exception:
+                        return True  # hint error -> Queue (reference behavior)
+            return False
+
+        self.queue.move_pods_for_event(should_move)
+
     def _handle_event(self, ev) -> None:
         if ev.kind == "nodes":
             if ev.type == DELETED:
                 self.cache.remove_node(ev.obj.metadata.name)
             else:
                 self.cache.add_node(ev.obj)
-            self.queue.move_all_to_active_or_backoff()
+            self._move_for_event("nodes", ev.type, ev.obj)
         elif ev.kind == "pods":
             self._handle_pod(ev.type, ev.obj)
         elif ev.kind == "namespaces":
@@ -212,7 +279,7 @@ class Scheduler:
                 else:
                     lister.add(ev.obj)
             # a new/changed PV or class can unblock pending claims
-            self.queue.move_all_to_active_or_backoff()
+            self._move_for_event(ev.kind, ev.type, ev.obj)
 
     def _handle_pod(self, etype: str, pod: Pod) -> None:
         # Unassigned pods of a scheduler we have no profile for are not ours
@@ -224,13 +291,16 @@ class Scheduler:
         if pod.is_terminal():
             if pod.spec.node_name:
                 self.cache.remove_pod(pod)
+                # a bound pod turning terminal frees its resources — same
+                # schedulability signal as an assigned-pod delete
+                self._move_for_event("pods", DELETED, pod)
             else:
                 self.queue.delete(pod)
             return
         if etype == DELETED:
             if pod.spec.node_name:
                 self.cache.remove_pod(pod)
-                self.queue.move_all_to_active_or_backoff()
+                self._move_for_event("pods", DELETED, pod)
             else:
                 self.queue.delete(pod)
             return
@@ -240,10 +310,10 @@ class Scheduler:
             elif etype == MODIFIED:
                 # keep labels/requests fresh — affinity/spread counts read them
                 self.cache.update_pod(pod)
-                self.queue.move_all_to_active_or_backoff()
+                self._move_for_event("pods", MODIFIED, pod)
             else:
                 self.cache.add_pod(pod)
-                self.queue.move_all_to_active_or_backoff()
+                self._move_for_event("pods", ADDED, pod)
         else:
             if etype == MODIFIED and self.queue.update(pod):
                 return  # status-only updates of queued pods don't requeue
@@ -399,7 +469,7 @@ class Scheduler:
         m.pending_pods.set(unsched, queue="unschedulable")
         if not result.suggested_host:
             self._maybe_preempt(qp, result)
-            self._handle_failure(qp, result.status)
+            self._handle_failure(qp, result.status, result.failed_nodes)
             return True
         self._commit_cycle(qp, result)
         return True
@@ -472,9 +542,20 @@ class Scheduler:
             qp.pod.status.nominated_node_name = nominated
             self.preemption_count += 1
 
-    def _handle_failure(self, qp: QueuedPodInfo, status: Status) -> None:
-        """handleSchedulingFailure :1022 — requeue + patch PodScheduled condition."""
+    def _handle_failure(self, qp: QueuedPodInfo, status: Status,
+                        failed_nodes: Optional[Dict[str, Status]] = None) -> None:
+        """handleSchedulingFailure :1022 — requeue + patch PodScheduled
+        condition. Records the rejecting plugins (QueuedPodInfo
+        UnschedulablePlugins) so hint-gated requeue knows which events matter."""
         self.failed_count += 1
+        plugins = set()
+        if failed_nodes:
+            # keep "" for unattributed per-node rejections (extender vetoes):
+            # should_move treats it as move-on-any-event
+            plugins = {st.plugin for st in failed_nodes.values()}
+        elif status.plugin:
+            plugins = {status.plugin}
+        qp.unschedulable_plugins = tuple(sorted(plugins))
         self.queue.add_unschedulable(qp)
         try:
             def set_cond(st):

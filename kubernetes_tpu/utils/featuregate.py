@@ -90,7 +90,7 @@ class FeatureGates:
 # The build's gate catalog (scheduler gates: plugins/registry.go:45-60).
 DEFAULT_FEATURE_GATES = {
     "SchedulerQueueingHints": FeatureSpec(True, BETA),
-    "SchedulerAsyncPreemption": FeatureSpec(False, ALPHA),
+    "SchedulerAsyncPreemption": FeatureSpec(True, BETA),
     "DynamicResourceAllocation": FeatureSpec(False, BETA),
     "VolumeCapacityPriority": FeatureSpec(False, ALPHA),
     "PodSchedulingReadiness": FeatureSpec(True, GA, lock_to_default=True),

@@ -135,9 +135,9 @@ class TestFeatureGates:
     def test_defaults_and_parse(self):
         gates = default_feature_gates()
         assert gates.enabled("SchedulerQueueingHints") is True
+        assert gates.enabled("SchedulerAsyncPreemption") is True  # beta, on
+        gates.parse("SchedulerAsyncPreemption=false,SchedulerQueueingHints=false")
         assert gates.enabled("SchedulerAsyncPreemption") is False
-        gates.parse("SchedulerAsyncPreemption=true,SchedulerQueueingHints=false")
-        assert gates.enabled("SchedulerAsyncPreemption") is True
         assert gates.enabled("SchedulerQueueingHints") is False
 
     def test_unknown_and_locked(self):
@@ -309,7 +309,7 @@ class TestBatchExtenderServer:
         cache.add_pod(MakePod("hog").req({"cpu": "6"}).node("busy").obj())
         server = BatchExtenderServer(cache.update_snapshot).start()
         try:
-            ext = HTTPExtender(ExtenderConfig(url_prefix=server.url))
+            ext = HTTPExtender(ExtenderConfig(url_prefix=server.url, timeout_seconds=120.0))  # first call may JIT-compile
             pod = MakePod("p").req({"cpu": "2", "memory": "2Gi"}).obj()
             result = ext.filter(pod, ["full", "busy", "empty"])
             assert result.node_names == ["busy", "empty"]
@@ -329,7 +329,7 @@ class TestBatchExtenderServer:
             {"cpu": "4", "memory": "8Gi", "pods": "10"}).obj())
         server = BatchExtenderServer(cache.update_snapshot).start()
         try:
-            ext = HTTPExtender(ExtenderConfig(url_prefix=server.url))
+            ext = HTTPExtender(ExtenderConfig(url_prefix=server.url, timeout_seconds=120.0))  # first call may JIT-compile
             pod = MakePod("p").req({"cpu": "1"}).pvc("claim").obj()
             result = ext.filter(pod, ["n1"])
             assert result.node_names == ["n1"]  # pass-through, no veto

@@ -1,0 +1,155 @@
+"""QueueingHints: event-gated requeue of unschedulable pods.
+
+Mirrors the reference's queueing hint behavior (scheduling_queue.go:263
+QueueingHintMap, :1028 MoveAllToActiveOrBackoffQueue + podMatchesEvent,
+test/integration/scheduler/queueing): a pod rejected by plugin P moves back
+to active/backoff only on events P registered, and only when P's hint
+function says the event could make the pod schedulable.
+"""
+
+import pytest
+
+from kubernetes_tpu.scheduler import Framework, Scheduler
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def _mk_sched(store, cls=Scheduler, **kw):
+    # tiny backoff so hint-moved pods become poppable without wall-clock waits
+    kw.setdefault("pod_initial_backoff", 0.01)
+    sched = cls(store, Framework(default_plugins()), **kw)
+    sched.sync()
+    return sched
+
+
+class TestQueueingHints:
+    def test_irrelevant_pod_event_does_not_requeue(self):
+        """A pod unschedulable on resources must NOT re-enter the active queue
+        when an unrelated pending pod appears (pods/add has no Fit hint)."""
+        store = APIStore()
+        store.create("nodes", MakeNode("small").capacity(
+            {"cpu": "1", "memory": "1Gi", "pods": "10"}).obj())
+        sched = _mk_sched(store)
+        store.create("pods", MakePod("big").req({"cpu": "4"}).obj())
+        sched.run_until_idle()
+        active, backoff, unsched = sched.queue.lengths()
+        assert unsched == 1 and active == 0
+
+        # unrelated pending pod: schedules itself, must not move 'big'
+        store.create("pods", MakePod("tiny").req({"cpu": "100m"}).obj())
+        sched.run_until_idle()
+        assert store.get("pods", "default/tiny").spec.node_name == "small"
+        active, backoff, unsched = sched.queue.lengths()
+        assert unsched == 1 and active == 0 and backoff == 0
+
+    def test_node_add_with_capacity_requeues(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("small").capacity(
+            {"cpu": "1", "memory": "1Gi", "pods": "10"}).obj())
+        sched = _mk_sched(store)
+        store.create("pods", MakePod("big").req({"cpu": "4"}).obj())
+        sched.run_until_idle()
+        assert sched.queue.lengths()[2] == 1
+
+        store.create("nodes", MakeNode("huge").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": "10"}).obj())
+        sched.pump_events()
+        import time as _t
+        _t.sleep(0.05)
+        sched.queue.flush_backoff_completed()
+        sched.run_until_idle()
+        assert store.get("pods", "default/big").spec.node_name == "huge"
+
+    def test_node_add_too_small_is_skipped_by_hint(self):
+        """Fit's node hint rejects nodes whose full allocatable can't hold the
+        request — the pod must stay parked (no busy retry loop)."""
+        store = APIStore()
+        store.create("nodes", MakeNode("small").capacity(
+            {"cpu": "1", "memory": "1Gi", "pods": "10"}).obj())
+        sched = _mk_sched(store)
+        store.create("pods", MakePod("big").req({"cpu": "4"}).obj())
+        sched.run_until_idle()
+        failed_before = sched.failed_count
+
+        store.create("nodes", MakeNode("small2").capacity(
+            {"cpu": "1", "memory": "1Gi", "pods": "10"}).obj())
+        sched.pump_events()
+        import time as _t
+        _t.sleep(0.05)
+        sched.queue.flush_backoff_completed()
+        sched.run_until_idle()
+        active, backoff, unsched = sched.queue.lengths()
+        assert unsched == 1 and active == 0 and backoff == 0
+        assert sched.failed_count == failed_before  # no wasted cycle
+
+    def test_assigned_pod_delete_requeues(self):
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity(
+            {"cpu": "2", "memory": "4Gi", "pods": "10"}).obj())
+        sched = _mk_sched(store)
+        store.create("pods", MakePod("first").req({"cpu": "2"}).obj())
+        sched.run_until_idle()
+        store.create("pods", MakePod("second").req({"cpu": "2"}).obj())
+        sched.run_until_idle()
+        assert sched.queue.lengths()[2] == 1
+
+        store.delete("pods", "default/first")
+        sched.pump_events()
+        import time as _t
+        _t.sleep(0.05)
+        sched.queue.flush_backoff_completed()
+        sched.run_until_idle()
+        assert store.get("pods", "default/second").spec.node_name == "n0"
+
+    def test_gate_off_restores_move_all(self):
+        from kubernetes_tpu.utils.featuregate import feature_gates
+
+        store = APIStore()
+        store.create("nodes", MakeNode("small").capacity(
+            {"cpu": "1", "memory": "1Gi", "pods": "10"}).obj())
+        sched = _mk_sched(store)
+        store.create("pods", MakePod("big").req({"cpu": "4"}).obj())
+        sched.run_until_idle()
+        assert sched.queue.lengths()[2] == 1
+
+        feature_gates.set("SchedulerQueueingHints", False)
+        try:
+            # small node add: hint would skip, move-all must not
+            store.create("nodes", MakeNode("small2").capacity(
+                {"cpu": "1", "memory": "1Gi", "pods": "10"}).obj())
+            sched.pump_events()
+            sched.queue.flush_backoff_completed()
+            active, backoff, unsched = sched.queue.lengths()
+            assert unsched == 0 and (active + backoff) == 1
+        finally:
+            feature_gates.set("SchedulerQueueingHints", True)
+
+    def test_batch_scheduler_requeues_on_victim_delete(self):
+        """BatchScheduler failures carry Fit attribution: rejected pods wake on
+        assigned-pod deletes, not on unrelated pod creates."""
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity(
+            {"cpu": "2", "memory": "4Gi", "pods": "10"}).obj())
+        blocker = MakePod("blocker").req({"cpu": "2"}).obj()
+        blocker.spec.node_name = "n0"
+        store.create("pods", blocker)
+        sched = _mk_sched(store, cls=BatchScheduler, solver="auto")
+        waiter = MakePod("waiter").req({"cpu": "2"}).obj()
+        waiter.spec.priority = 0
+        blocker2 = store.get("pods", "default/blocker")
+        assert blocker2.spec.node_name == "n0"
+        store.create("pods", waiter)
+        sched.run_until_idle()
+        assert sched.queue.lengths()[2] == 1
+        qp = next(iter(sched.queue._unschedulable.values()))
+        assert "NodeResourcesFit" in qp.unschedulable_plugins
+
+        store.delete("pods", "default/blocker")
+        sched.pump_events()
+        import time as _t
+        _t.sleep(0.05)
+        sched.queue.flush_backoff_completed()
+        sched.run_until_idle()
+        assert store.get("pods", "default/waiter").spec.node_name == "n0"
