@@ -1,11 +1,12 @@
-"""A live mini-cluster behind the HTTP server, for CLI verification."""
+"""A live mini-cluster behind the HTTP server, for CLI verification.
+
+Control plane runs behind leader election (ControlPlane — the
+cmd/kube-scheduler server.go:281 / controller-manager wiring): the full
+controller set including DisruptionController, so PDB status stays live."""
 import sys, time
 from kubernetes_tpu.agent import HollowCluster
-from kubernetes_tpu.controllers import DeploymentController, ReplicaSetController, NodeLifecycleController
-from kubernetes_tpu.scheduler import Framework
-from kubernetes_tpu.scheduler.batch import BatchScheduler
-from kubernetes_tpu.scheduler.plugins import default_plugins
 from kubernetes_tpu.server import APIServer
+from kubernetes_tpu.server.controlplane import ControlPlane
 from kubernetes_tpu.store import APIStore
 
 store = APIStore()
@@ -14,10 +15,9 @@ cluster = HollowCluster(store, n_nodes=3)
 cluster.register_all()
 for k in cluster.kubelets:
     k.start(heartbeat_interval=2.0)
-sched = BatchScheduler(store, Framework(default_plugins()), solver="auto")
-sched.sync(); sched.start()
-dc, rsc = DeploymentController(store), ReplicaSetController(store)
-for c in (dc, rsc):
-    c.sync_all(); c.start()
+cp = ControlPlane(store, identity="daemon-0").start()
+deadline = time.time() + 30
+while not cp.is_leader and time.time() < deadline:
+    time.sleep(0.05)
 print("READY", srv.url, flush=True)
 time.sleep(600)

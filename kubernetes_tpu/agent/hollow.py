@@ -80,6 +80,23 @@ class HollowKubelet:
         """Process pending pod events for this node (syncLoopIteration analog)."""
         if self._watch is None:
             return 0
+        if self._watch.terminated:
+            # evicted slow watcher: relist + rewatch (Reflector restart)
+            self._watch.stop()
+            _, rv = self.store.list("pods")
+            self._watch = self.store.watch("pods", since_rv=rv)
+            pods, _ = self.store.list(
+                "pods", lambda p: p.spec.node_name == self.node_name)
+            live = set()
+            for p in pods:
+                if not p.is_terminal():
+                    live.add(p.key)
+                    if p.key not in self.running_pods:
+                        self._run_pod(p)
+            for key in list(self.running_pods):
+                if key not in live:
+                    self.running_pods.pop(key, None)
+            return 0
         n = 0
         for ev in self._watch.drain():
             pod = ev.obj

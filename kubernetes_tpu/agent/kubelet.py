@@ -307,6 +307,23 @@ class Kubelet:
     def _pump_config(self) -> int:
         if self._watch is None:
             return 0
+        if self._watch.terminated:
+            # evicted as a slow watcher: relist + rewatch, reconcile workers
+            # against the fresh pod list (Reflector restart; kubelet is
+            # stateless modulo checkpoints)
+            self._watch.stop()
+            _, rv = self.store.list("pods")
+            self._watch = self.store.watch("pods", since_rv=rv)
+            pods, _ = self.store.list(
+                "pods", lambda p: p.spec.node_name == self.node_name)
+            live = {p.key for p in pods if not p.is_terminal()}
+            for p in pods:
+                if not p.is_terminal() and p.key not in self.workers:
+                    self._start_pod(p)
+            for key in list(self.workers):
+                if key not in live:
+                    self._stop_pod(key)
+            return 0
         n = 0
         for ev in self._watch.drain():
             pod = ev.obj

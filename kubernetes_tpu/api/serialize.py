@@ -33,6 +33,7 @@ from .policy import (
     PodDisruptionBudget,
     ResourceQuota,
 )
+from .dra import DeviceClass, ResourceClaim, ResourceSlice
 from .storage import CSINode, PersistentVolume, PersistentVolumeClaim, StorageClass
 from .workloads import (
     CronJob,
@@ -65,6 +66,9 @@ KIND_TO_RESOURCE = {
     "LimitRange": "limitranges",
     "HorizontalPodAutoscaler": "horizontalpodautoscalers",
     "PodDisruptionBudget": "poddisruptionbudgets",
+    "ResourceClaim": "resourceclaims",
+    "ResourceSlice": "resourceslices",
+    "DeviceClass": "deviceclasses",
 }
 RESOURCE_TO_TYPE = {
     "pods": Pod,
@@ -87,8 +91,12 @@ RESOURCE_TO_TYPE = {
     "limitranges": LimitRange,
     "horizontalpodautoscalers": HorizontalPodAutoscaler,
     "poddisruptionbudgets": PodDisruptionBudget,
+    "resourceclaims": ResourceClaim,
+    "resourceslices": ResourceSlice,
+    "deviceclasses": DeviceClass,
 }
-CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses", "csinodes"}
+CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses",
+                  "csinodes", "resourceslices", "deviceclasses"}
 GROUP_PREFIX = {
     "pods": "/api/v1",
     "nodes": "/api/v1",
@@ -110,6 +118,9 @@ GROUP_PREFIX = {
     "limitranges": "/api/v1",
     "horizontalpodautoscalers": "/apis/autoscaling/v2",
     "poddisruptionbudgets": "/apis/policy/v1",
+    "resourceclaims": "/apis/resource.k8s.io/v1beta1",
+    "resourceslices": "/apis/resource.k8s.io/v1beta1",
+    "deviceclasses": "/apis/resource.k8s.io/v1beta1",
 }
 
 
@@ -239,6 +250,21 @@ def pod_to_dict(pod: Pod) -> Dict:
         spec["overhead"] = pod.spec.overhead
     if pod.spec.volumes:
         spec["volumes"] = [v.to_dict() for v in pod.spec.volumes]
+    if pod.spec.resource_claims:
+        spec["resourceClaims"] = [
+            {"name": n, "resourceClaimName": rc}
+            for n, rc in pod.spec.resource_claims
+        ]
+    # non-default scalars must round-trip, or read-modify-write paths (PATCH,
+    # apply) silently reset them to from_dict defaults
+    if pod.spec.restart_policy != "Always":
+        spec["restartPolicy"] = pod.spec.restart_policy
+    if pod.spec.termination_grace_period_seconds != 30:
+        spec["terminationGracePeriodSeconds"] = pod.spec.termination_grace_period_seconds
+    if pod.spec.preemption_policy != "PreemptLowerPriority":
+        spec["preemptionPolicy"] = pod.spec.preemption_policy
+    if pod.spec.host_network:
+        spec["hostNetwork"] = True
     status: Dict[str, Any] = {"phase": pod.status.phase}
     if pod.status.nominated_node_name:
         status["nominatedNodeName"] = pod.status.nominated_node_name
@@ -246,7 +272,9 @@ def pod_to_dict(pod: Pod) -> Dict:
         status["conditions"] = [
             {"type": c.type, "status": c.status,
              **({"reason": c.reason} if c.reason else {}),
-             **({"message": c.message} if c.message else {})}
+             **({"message": c.message} if c.message else {}),
+             **({"lastTransitionTime": c.last_transition_time}
+                if c.last_transition_time else {})}
             for c in pod.status.conditions
         ]
     return {"apiVersion": "v1", "kind": "Pod", "metadata": pod.metadata.to_dict(),

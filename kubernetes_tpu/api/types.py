@@ -396,6 +396,9 @@ class PodSpec:
     restart_policy: str = "Always"
     termination_grace_period_seconds: int = 30
     volumes: List[Volume] = field(default_factory=list)
+    # DRA (core/v1 PodSpec.ResourceClaims): [(claim ref name, ResourceClaim
+    # object name)] — reference: PodResourceClaim, core/v1/types.go
+    resource_claims: List[Tuple[str, str]] = field(default_factory=list)
 
     @staticmethod
     def from_dict(d: Mapping) -> "PodSpec":
@@ -420,6 +423,10 @@ class PodSpec:
             restart_policy=d.get("restartPolicy", "Always"),
             termination_grace_period_seconds=int(d.get("terminationGracePeriodSeconds", 30) or 30),
             volumes=[Volume.from_dict(v) for v in d.get("volumes") or []],
+            resource_claims=[
+                (rc.get("name", ""), rc.get("resourceClaimName", ""))
+                for rc in d.get("resourceClaims") or []
+            ],
         )
 
 

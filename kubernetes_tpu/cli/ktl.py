@@ -3,8 +3,10 @@
 reference: staging/src/k8s.io/kubectl/pkg/cmd (the command set, not the code).
 Talks HTTP to the API server (KTL_SERVER env or --server).
 
-Commands: get, describe, create -f, apply -f, delete, scale, cordon, uncordon,
-taint, drain, top nodes, version, api-resources.
+Commands: get, describe, create -f, apply -f (server-side merge patch),
+delete, scale, cordon, uncordon, taint, drain, label, annotate, patch,
+rollout status|restart, set image, top nodes|pods, wait, autoscale,
+api-resources, version.
 """
 
 from __future__ import annotations
@@ -174,10 +176,10 @@ def cmd_apply(client: RESTClient, args) -> int:
         ns_arg = None if resource in CLUSTER_SCOPED else ns
         try:
             try:
-                current = client.get(resource, meta["name"], ns_arg)
-                doc.setdefault("metadata", {})["resourceVersion"] = \
-                    current["metadata"]["resourceVersion"]
-                client.update(resource, doc, ns_arg)
+                # apply = server-side merge PATCH of the manifest (kubectl
+                # apply's patch path; reference handlers/patch.go) — no
+                # read-modify-write race, unspecified fields are preserved
+                client.patch(resource, meta["name"], doc, ns_arg)
                 print(f"{resource}/{meta['name']} configured")
             except APIError as e:
                 if e.code != 404:
@@ -272,6 +274,224 @@ def cmd_describe(client: RESTClient, args) -> int:
     return 0
 
 
+def _parse_kv_args(pairs: List[str]):
+    """key=value -> set; key- -> delete (kubectl label/annotate syntax)."""
+    sets, dels = {}, []
+    for p in pairs:
+        if p.endswith("-") and "=" not in p:
+            dels.append(p[:-1])
+        elif "=" in p:
+            k, _, v = p.partition("=")
+            sets[k] = v
+        else:
+            raise SystemExit(f"error: bad key=value pair {p!r}")
+    return sets, dels
+
+
+def _meta_patch_cmd(client: RESTClient, args, field: str) -> int:
+    """Shared label/annotate implementation: a merge PATCH on metadata."""
+    resource = resolve_resource(args.resource)
+    ns = None if resource in CLUSTER_SCOPED else (args.namespace or "default")
+    sets, dels = _parse_kv_args(args.pairs)
+    patch = {"metadata": {field: {**sets, **{k: None for k in dels}}}}
+    client.patch(resource, args.name, patch, ns)
+    print(f"{resource}/{args.name} {field[:-1]}ed" if field.endswith("s")
+          else f"{resource}/{args.name} updated")
+    return 0
+
+
+def cmd_label(client: RESTClient, args) -> int:
+    """kubectl label (kubectl/pkg/cmd/label)."""
+    return _meta_patch_cmd(client, args, "labels")
+
+
+def cmd_annotate(client: RESTClient, args) -> int:
+    """kubectl annotate (kubectl/pkg/cmd/annotate)."""
+    return _meta_patch_cmd(client, args, "annotations")
+
+
+def cmd_patch(client: RESTClient, args) -> int:
+    """kubectl patch -p '{...}' (kubectl/pkg/cmd/patch)."""
+    resource = resolve_resource(args.resource)
+    ns = None if resource in CLUSTER_SCOPED else (args.namespace or "default")
+    client.patch(resource, args.name, json.loads(args.patch), ns)
+    print(f"{resource}/{args.name} patched")
+    return 0
+
+
+def _split_typed_name(arg: str, default_resource: str) -> (str, str):
+    if "/" in arg:
+        kind, _, name = arg.partition("/")
+        return resolve_resource(kind), name
+    return default_resource, arg
+
+
+def cmd_rollout(client: RESTClient, args) -> int:
+    """kubectl rollout status|restart (kubectl/pkg/cmd/rollout)."""
+    resource, name = _split_typed_name(args.target, "deployments")
+    ns = args.namespace or "default"
+    if args.action == "status":
+        import time
+
+        deadline = time.time() + args.timeout
+        while True:
+            d = client.get(resource, name, ns)
+            spec = d.get("spec") or {}
+            st = d.get("status") or {}
+            want = int(spec.get("replicas", 1))
+            updated = int(st.get("updatedReplicas", 0))
+            ready = int(st.get("readyReplicas", 0))
+            if updated >= want and ready >= want:
+                print(f'{resource} "{name}" successfully rolled out')
+                return 0
+            if time.time() > deadline:
+                print(f"error: timed out waiting for rollout "
+                      f"({updated}/{want} updated, {ready}/{want} ready)",
+                      file=sys.stderr)
+                return 1
+            time.sleep(0.2)
+    if args.action == "restart":
+        import time
+
+        client.patch(resource, name, {"spec": {"template": {"metadata": {
+            "annotations": {"kubectl.kubernetes.io/restartedAt": str(time.time())}}}}},
+            ns)
+        print(f"{resource}/{name} restarted")
+        return 0
+    print(f"error: unknown rollout action {args.action!r}", file=sys.stderr)
+    return 1
+
+
+def cmd_set_image(client: RESTClient, args) -> int:
+    """kubectl set image deployment/NAME container=image (kubectl/pkg/cmd/set)."""
+    resource, name = _split_typed_name(args.target, "deployments")
+    ns = args.namespace or "default"
+    obj = client.get(resource, name, ns)
+    changed = False
+    spec = obj.get("spec") or {}
+    tmpl = (spec.get("template") or {}).get("spec") or spec
+    containers = tmpl.get("containers") or []
+    for pair in args.images:
+        cname, _, image = pair.partition("=")
+        for c in containers:
+            if c.get("name") == cname or cname == "*":
+                c["image"] = image
+                changed = True
+    if not changed:
+        print("error: no matching container", file=sys.stderr)
+        return 1
+    client.update(resource, obj, ns)
+    print(f"{resource}/{name} image updated")
+    return 0
+
+
+def cmd_top(client: RESTClient, args) -> int:
+    """kubectl top nodes|pods — requested/allocatable from the API objects
+    (no metrics-server; utilization = scheduled requests, the quantity the
+    scheduler actually balances)."""
+    from ..api.resources import quantity_milli_value, quantity_value
+
+    pods, _ = client.list("pods")
+    if args.what in ("nodes", "node", "no"):
+        nodes, _ = client.list("nodes")
+        rows = []
+        for n in nodes:
+            name = n["metadata"]["name"]
+            alloc = (n.get("status") or {}).get("allocatable") or {}
+            cpu_alloc = quantity_milli_value(alloc.get("cpu", "0"))
+            mem_alloc = quantity_value(alloc.get("memory", "0"))
+            cpu_req = mem_req = 0
+            for p in pods:
+                if (p.get("spec") or {}).get("nodeName") != name:
+                    continue
+                for c in (p["spec"].get("containers") or []):
+                    req = ((c.get("resources") or {}).get("requests") or {})
+                    cpu_req += quantity_milli_value(req.get("cpu", "0"))
+                    mem_req += quantity_value(req.get("memory", "0"))
+            rows.append([
+                name, f"{cpu_req}m",
+                f"{cpu_req * 100 // max(cpu_alloc, 1)}%",
+                f"{mem_req // (1024 * 1024)}Mi",
+                f"{mem_req * 100 // max(mem_alloc, 1)}%",
+            ])
+        print(fmt_table(["NAME", "CPU(requests)", "CPU%", "MEMORY(requests)",
+                         "MEMORY%"], rows))
+        return 0
+    ns = args.namespace or "default"
+    rows = []
+    for p in pods:
+        meta = p["metadata"]
+        if meta.get("namespace", "default") != ns:
+            continue
+        cpu = mem = 0
+        for c in (p["spec"].get("containers") or []):
+            req = ((c.get("resources") or {}).get("requests") or {})
+            cpu += quantity_milli_value(req.get("cpu", "0"))
+            mem += quantity_value(req.get("memory", "0"))
+        rows.append([meta["name"], f"{cpu}m", f"{mem // (1024 * 1024)}Mi"])
+    print(fmt_table(["NAME", "CPU(requests)", "MEMORY(requests)"], rows))
+    return 0
+
+
+def cmd_wait(client: RESTClient, args) -> int:
+    """kubectl wait --for=condition=X|delete (kubectl/pkg/cmd/wait)."""
+    import time
+
+    resource, name = _split_typed_name(args.target, "pods")
+    ns = None if resource in CLUSTER_SCOPED else (args.namespace or "default")
+    want = args.wait_for
+    deadline = time.time() + args.timeout
+    while True:
+        try:
+            obj = client.get(resource, name, ns)
+        except APIError as e:
+            if e.code == 404:
+                if want == "delete":
+                    print(f"{resource}/{name} condition met")
+                    return 0
+                obj = None
+            else:
+                raise
+        if obj is not None and want.startswith("condition="):
+            cond = want.split("=", 1)[1]
+            conds = ((obj.get("status") or {}).get("conditions") or [])
+            if any(c.get("type") == cond and c.get("status") == "True"
+                   for c in conds):
+                print(f"{resource}/{name} condition met")
+                return 0
+        if obj is not None and want.startswith("jsonpath="):
+            # minimal jsonpath: {.status.phase}=Value
+            expr, _, expect = want[len("jsonpath="):].partition("=")
+            cur = obj
+            for part in expr.strip("{}").lstrip(".").split("."):
+                cur = cur.get(part) if isinstance(cur, dict) else None
+            if cur is not None and str(cur) == expect:
+                print(f"{resource}/{name} condition met")
+                return 0
+        if time.time() > deadline:
+            print(f"error: timed out waiting for {want} on {resource}/{name}",
+                  file=sys.stderr)
+            return 1
+        time.sleep(0.1)
+
+
+def cmd_autoscale(client: RESTClient, args) -> int:
+    """kubectl autoscale deployment NAME --min --max --cpu-percent."""
+    resource, name = _split_typed_name(args.target, "deployments")
+    ns = args.namespace or "default"
+    client.create("horizontalpodautoscalers", {
+        "kind": "HorizontalPodAutoscaler",
+        "metadata": {"name": name, "namespace": ns},
+        "spec": {
+            "scaleTargetRef": {"kind": "Deployment", "name": name},
+            "minReplicas": args.min, "maxReplicas": args.max,
+            "targetCPUUtilizationPercentage": args.cpu_percent,
+        },
+    }, ns)
+    print(f"horizontalpodautoscaler/{name} autoscaled")
+    return 0
+
+
 def cmd_api_resources(client: RESTClient, args) -> int:
     rows = [[r, GROUP_PREFIX[r].split("/")[-2] if "apis" in GROUP_PREFIX[r] else "v1"]
             for r in sorted(RESOURCE_TO_TYPE)]
@@ -329,6 +549,48 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("name")
     p.add_argument("taint")
     p.set_defaults(fn=cmd_taint)
+
+    for name, fn in (("label", cmd_label), ("annotate", cmd_annotate)):
+        p = sub.add_parser(name)
+        p.add_argument("resource")
+        p.add_argument("name")
+        p.add_argument("pairs", nargs="+")
+        p.set_defaults(fn=fn)
+
+    p = sub.add_parser("patch")
+    p.add_argument("resource")
+    p.add_argument("name")
+    p.add_argument("-p", "--patch", required=True)
+    p.set_defaults(fn=cmd_patch)
+
+    p = sub.add_parser("rollout")
+    p.add_argument("action", choices=["status", "restart"])
+    p.add_argument("target")  # deployment/NAME
+    p.add_argument("--timeout", type=float, default=30.0)
+    p.set_defaults(fn=cmd_rollout)
+
+    p = sub.add_parser("set")
+    p.add_argument("what", choices=["image"])
+    p.add_argument("target")
+    p.add_argument("images", nargs="+")  # container=image
+    p.set_defaults(fn=cmd_set_image)
+
+    p = sub.add_parser("top")
+    p.add_argument("what", choices=["nodes", "node", "no", "pods", "pod", "po"])
+    p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("wait")
+    p.add_argument("target")  # [resource/]name
+    p.add_argument("--for", dest="wait_for", required=True)
+    p.add_argument("--timeout", type=float, default=30.0)
+    p.set_defaults(fn=cmd_wait)
+
+    p = sub.add_parser("autoscale")
+    p.add_argument("target")  # deployment/NAME
+    p.add_argument("--min", type=int, default=1)
+    p.add_argument("--max", type=int, required=True)
+    p.add_argument("--cpu-percent", type=int, default=80)
+    p.set_defaults(fn=cmd_autoscale)
 
     p = sub.add_parser("api-resources")
     p.set_defaults(fn=cmd_api_resources)

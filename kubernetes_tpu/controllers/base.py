@@ -47,6 +47,11 @@ class Controller:
     def pump(self, max_events: int = 10_000) -> int:
         if self._watch is None:
             return 0
+        if self._watch.terminated:
+            # evicted as a slow watcher: relist + rewatch (Reflector contract)
+            self._watch.stop()
+            self.sync_all()
+            return 0
         n = 0
         for ev in self._watch.drain():
             if ev.kind in self.watch_kinds:

@@ -15,7 +15,7 @@ from __future__ import annotations
 import copy
 import queue as _queue
 import threading
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
@@ -286,9 +286,13 @@ class BatchScheduler(Scheduler):
             return got
 
         # PDB exhaustion per victim (approximate violation count for node
-        # selection; the serial dry run on the chosen node is exact)
-        _, any_plugin = plugin_for(rejected[0][1].pod)
-        pdbs = any_plugin._pdbs() if any_plugin is not None else []
+        # selection; the serial dry run on the chosen node is exact). Listed
+        # from the store directly — profiles without DefaultPreemption must
+        # not blind the batch to budgets.
+        try:
+            pdbs, _ = self.store.list("poddisruptionbudgets")
+        except Exception:
+            pdbs = []
         v_pdb_blocked = np.zeros(len(v_pods), dtype=bool)
         if pdbs:
             for vi, p in enumerate(v_pods):
@@ -323,6 +327,7 @@ class BatchScheduler(Scheduler):
         filter_ok = sub.tables.filter_ok
         node_names = cluster.node_names
         remaining = []
+        nominated_by_node: Dict[int, List] = {}
         for j, qp in rejected:
             pod = qp.pod
             fw, plugin = plugin_for(pod)
@@ -352,9 +357,18 @@ class BatchScheduler(Scheduler):
                 for oi in order[:num_candidates]:  # best-ranked first
                     nn = int(idxs[oi])
                     ni = snapshot.node_info_list[nn]
-                    extra = placed_by_node.get(nn)
-                    if extra:
+                    # the snapshot NodeInfo is pre-batch: drop victims an
+                    # earlier pod in this batch already claimed (v_alive
+                    # False) and add in-batch placements/nominations, or the
+                    # dry run re-selects dead victims and frees nothing
+                    dead = [v_pods[vi] for vi in node_victims[nn]
+                            if not v_alive[vi]]
+                    extra = list(placed_by_node.get(nn, ()))
+                    extra += nominated_by_node.get(nn, [])
+                    if dead or extra:
                         ni = ni.clone()
+                        for dp_ in dead:
+                            ni.remove_pod(dp_)
                         for xp in extra:
                             ni.add_pod(PodInfo(xp))
                     got = plugin._dry_run_node(state, pod, ni, pdbs)
@@ -386,6 +400,7 @@ class BatchScheduler(Scheduler):
                 arrs[4][nn] = max(alive) if alive else -(2**31)
             used[nn] += req - freed_now
             pod_count[nn] += 1 - len(victims)
+            nominated_by_node.setdefault(nn, []).append(pod)
             plugin._prepare_candidate(cand, pod)
             qp.pod.status.nominated_node_name = node_names[nn]
             self.preemption_count += 1
@@ -440,14 +455,53 @@ class BatchScheduler(Scheduler):
             self._bind_worker.start()
 
     def _bind_loop(self) -> None:
+        """Drains the bind queue in opportunistic batches: everything queued
+        at wake-up goes through ONE store.bind_many transaction (the pipeline
+        analog of BindingREST write batching — binds are the north star's
+        end-to-end bottleneck at 100k-pod scale)."""
         while True:
             item = self._bind_q.get()
-            try:
-                if item is None:
-                    return
-                self._bind_one(*item, async_mode=True)
-            finally:
+            if item is None:
                 self._bind_q.task_done()
+                return
+            items = [item]
+            done = False
+            while True:
+                try:
+                    nxt = self._bind_q.get_nowait()
+                except _queue.Empty:
+                    break
+                if nxt is None:
+                    done = True
+                    break
+                items.append(nxt)
+            try:
+                self._bind_batch(items)
+            finally:
+                for _ in items:
+                    self._bind_q.task_done()
+                if done:
+                    self._bind_q.task_done()  # the sentinel
+            if done:
+                return
+
+    def _bind_batch(self, items) -> None:
+        triples = [(qp.pod.metadata.namespace, qp.pod.metadata.name, node)
+                   for qp, node, _assumed in items]
+        try:
+            _bound, errors = self.store.bind_many(triples)
+        except Exception as e:  # store-wide failure: every bind in the batch failed
+            errors = [(qp.pod.key, str(e)) for qp, _n, _a in items]
+        errmap = dict(errors)
+        with self._bind_err_lock:
+            for qp, _node, assumed in items:
+                msg = errmap.get(qp.pod.key)
+                if msg is None:
+                    self.cache.finish_binding(assumed)
+                    self._bind_successes += 1
+                else:
+                    self.cache.forget_pod(assumed)
+                    self._bind_errors.append((qp, Status.error(msg)))
 
     def _drain_bind_results(self) -> None:
         """Fold completed async binds into counters and re-handle failures on

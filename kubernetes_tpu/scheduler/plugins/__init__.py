@@ -27,9 +27,13 @@ from .volume import (  # noqa: F401
 
 
 def default_plugins(volume_lister=None):
-    """Registry + default ordering (plugins/registry.go:64, default_plugins.go:30)."""
+    """Registry + default ordering (plugins/registry.go:64, default_plugins.go:30).
+    DynamicResources joins the set behind its feature gate, exactly like the
+    reference's registry (plugins/registry.go:45-60)."""
+    from ...utils.featuregate import feature_gates
+
     vl = volume_lister if volume_lister is not None else VolumeLister()
-    return [
+    plugins = [
         PrioritySort(),
         SchedulingGates(),
         NodeUnschedulable(),
@@ -48,3 +52,12 @@ def default_plugins(volume_lister=None):
         ImageLocality(),
         DefaultPreemption(),
     ]
+    try:
+        dra_on = feature_gates.enabled("DynamicResourceAllocation")
+    except KeyError:
+        dra_on = False
+    if dra_on:
+        from .dynamic_resources import DynamicResources
+
+        plugins.insert(8, DynamicResources())
+    return plugins

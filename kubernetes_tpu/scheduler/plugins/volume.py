@@ -58,6 +58,13 @@ class VolumeLister:
     def get_pvc(self, namespace: str, name: str) -> Optional[PersistentVolumeClaim]:
         return self.pvcs.get(f"{namespace}/{name}")
 
+    def clear(self) -> None:
+        """Drop all state ahead of a relist (informer cache replace)."""
+        self.pvcs.clear()
+        self.pvs.clear()
+        self.classes.clear()
+        self.csinodes.clear()
+
     def default_class(self) -> Optional[StorageClass]:
         for sc in self.classes.values():
             if sc.is_default:

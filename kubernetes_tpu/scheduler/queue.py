@@ -249,8 +249,10 @@ class SchedulingQueue:
             return True
 
     def delete(self, pod: Pod) -> None:
+        self.delete_key(pod.key)
+
+    def delete_key(self, key: str) -> None:
         with self._lock:
-            key = pod.key
             self._unschedulable.pop(key, None)
             if key in self._in_active:
                 self._in_active.pop(key)
@@ -258,6 +260,13 @@ class SchedulingQueue:
                 heapq.heapify(self._active)
             self._backoff = [(t, s, qp) for t, s, qp in self._backoff if qp.key != key]
             heapq.heapify(self._backoff)
+
+    def tracked_keys(self) -> List[str]:
+        """Keys of every pod the queue knows, across all three tiers."""
+        with self._lock:
+            return (list(self._in_active)
+                    + [qp.key for _, _, qp in self._backoff]
+                    + list(self._unschedulable))
 
     def close(self) -> None:
         with self._lock:

@@ -176,7 +176,9 @@ class Scheduler:
                 for lister in self._volume_listers:
                     lister.add(obj)
         self._push_ns_labels()
-        self._watch = self.store.watch(since_rv=rv)
+        # generous buffer — the scheduler drains every cycle; if it still
+        # falls behind it is evicted and relists (pump_events)
+        self._watch = self.store.watch(since_rv=rv, maxsize=200_000)
 
     def _push_ns_labels(self):
         for fw in self.profiles.values():
@@ -186,8 +188,12 @@ class Scheduler:
 
     def pump_events(self, max_events: int = 10_000) -> int:
         """Drain pending watch events into cache/queue (deterministic test path;
-        the run loop calls this between cycles)."""
+        the run loop calls this between cycles). An evicted (slow) watch forces
+        a full relist — the Reflector contract on 410/terminated streams."""
         if self._watch is None:
+            return 0
+        if self._watch.terminated:
+            self._relist()
             return 0
         n = 0
         for ev in self._watch.drain():
@@ -196,6 +202,51 @@ class Scheduler:
             if n >= max_events:
                 break
         return n
+
+    def _relist(self) -> None:
+        """Rebuild cache + listers from a fresh consistent LIST and rewatch
+        (reflector.go ListAndWatch restart after a dead watch). Queue state is
+        preserved: tracked pods keep their backoff/attempt counts; pods the
+        list no longer contains are deleted, new pending pods are added."""
+        if hasattr(self, "flush_binds"):
+            # batch path: in-flight async binds must commit before the list,
+            # or their pods would be listed as pending and scheduled twice
+            self.flush_binds()
+        if self._watch is not None:
+            self._watch.stop()
+        self.cache = Cache(clock=self.clock)
+        for lister in self._volume_listers:
+            if hasattr(lister, "clear"):
+                lister.clear()
+        self._ns_labels.clear()
+        lists, rv = self.store.list_many(
+            ("nodes", "pods", "namespaces") + STORAGE_KINDS)
+        known_pending = set()
+        for n in lists["nodes"]:
+            self.cache.add_node(n)
+        for p in lists["pods"]:
+            if p.spec.node_name:
+                if not p.is_terminal():
+                    self.cache.add_pod(p)
+            elif not p.is_terminal():
+                known_pending.add(p.key)
+                if not self.queue.update(p):  # unknown to the queue: enqueue
+                    self._handle_pod(ADDED, p)
+        # drop queued pods (ALL tiers) that no longer exist as pending pods —
+        # deleted or bound-by-another-leader during the outage; no DELETED
+        # event will ever arrive for them on the new watch
+        for key in self.queue.tracked_keys():
+            if key not in known_pending:
+                self.queue.delete_key(key)
+        for ns in lists["namespaces"]:
+            self._ns_labels[ns.metadata.name] = dict(ns.metadata.labels)
+        for kind in STORAGE_KINDS:
+            for obj in lists[kind]:
+                for lister in self._volume_listers:
+                    lister.add(obj)
+        self._push_ns_labels()
+        self._watch = self.store.watch(since_rv=rv, maxsize=200_000)
+        self.queue.move_all_to_active_or_backoff()
 
     _EVENT_ACTION = {ADDED: "add", MODIFIED: "update", DELETED: "delete"}
 
@@ -279,6 +330,10 @@ class Scheduler:
                 else:
                     lister.add(ev.obj)
             # a new/changed PV or class can unblock pending claims
+            self._move_for_event(ev.kind, ev.type, ev.obj)
+        elif ev.kind in ("resourceclaims", "resourceslices", "deviceclasses"):
+            # DRA objects gate pods via DynamicResources' hints (claims read
+            # through the store lister — no local cache to update)
             self._move_for_event(ev.kind, ev.type, ev.obj)
 
     def _handle_pod(self, etype: str, pod: Pod) -> None:

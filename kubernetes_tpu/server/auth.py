@@ -1,0 +1,130 @@
+"""Authentication + authorization for the API server front-end.
+
+reference: the apiserver handler chain runs authn -> audit -> authz ->
+admission before any storage access (staging/src/k8s.io/apiserver/pkg/server/
+config.go DefaultBuildHandlerChain; SURVEY.md §1 L2).
+
+Carried subset:
+  - TokenAuthenticator — static token file authn, the analog of
+    `kube-apiserver --token-auth-file` (apiserver/pkg/authentication/
+    request/bearertoken + token/tokenfile): `Authorization: Bearer <t>`
+    resolves to (user, groups); unknown tokens are 401.
+  - RBACAuthorizer — RBAC-lite: rules are (verbs, resources) pairs bound to
+    users or groups (staging/src/k8s.io/apiserver/pkg/authorization +
+    plugin/pkg/auth/authorizer/rbac). `*` wildcards match everything.
+    Unauthorized requests are 403.
+
+Both are optional: a server constructed without them keeps the open,
+in-process behavior the test harness uses (identity then comes from the
+X-Remote-User header, the authenticating-proxy convention — only trustable
+when a trusted proxy sets it, which is why enabling the authenticator
+disables the header entirely).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class UserInfo:
+    """authentication/user.Info subset."""
+
+    name: str
+    groups: Tuple[str, ...] = ()
+
+    @property
+    def is_authenticated(self) -> bool:
+        return bool(self.name)
+
+
+ANONYMOUS = UserInfo(name="system:anonymous", groups=("system:unauthenticated",))
+
+
+class TokenAuthenticator:
+    """Static bearer-token table: token -> UserInfo.
+
+    from_csv_lines accepts the reference's token file shape:
+    `token,user,uid[,"group1,group2"]` (one per line)."""
+
+    def __init__(self, tokens: Optional[Dict[str, UserInfo]] = None):
+        self._tokens: Dict[str, UserInfo] = dict(tokens or {})
+
+    @classmethod
+    def from_csv_lines(cls, lines: Sequence[str]) -> "TokenAuthenticator":
+        import csv
+
+        tokens: Dict[str, UserInfo] = {}
+        for row in csv.reader([l for l in lines if l.strip() and not l.startswith("#")]):
+            if len(row) < 2:
+                continue
+            token, user = row[0].strip(), row[1].strip()
+            groups = tuple(g.strip() for g in row[3].split(",")) if len(row) > 3 and row[3] else ()
+            tokens[token] = UserInfo(name=user, groups=groups + ("system:authenticated",))
+        return cls(tokens)
+
+    @classmethod
+    def from_file(cls, path: str) -> "TokenAuthenticator":
+        with open(path) as f:
+            return cls.from_csv_lines(f.read().splitlines())
+
+    def add(self, token: str, user: str, groups: Sequence[str] = ()) -> None:
+        self._tokens[token] = UserInfo(
+            name=user, groups=tuple(groups) + ("system:authenticated",))
+
+    def authenticate(self, authorization_header: str) -> Optional[UserInfo]:
+        """Returns UserInfo for a valid `Bearer <token>` header, None otherwise."""
+        if not authorization_header.startswith("Bearer "):
+            return None
+        return self._tokens.get(authorization_header[len("Bearer "):].strip())
+
+
+@dataclass
+class Rule:
+    """rbac.PolicyRule subset: which verbs on which resources."""
+
+    verbs: Tuple[str, ...]  # get/list/watch/create/update/patch/delete/bind or *
+    resources: Tuple[str, ...]  # store kinds or *
+
+    def allows(self, verb: str, resource: str) -> bool:
+        return (("*" in self.verbs or verb in self.verbs)
+                and ("*" in self.resources or resource in self.resources))
+
+
+class RBACAuthorizer:
+    """Subject (user or `group:<name>`) -> list of rules. Deny by default."""
+
+    def __init__(self):
+        self._grants: Dict[str, List[Rule]] = {}
+
+    def grant(self, subject: str, verbs: Sequence[str], resources: Sequence[str]) -> "RBACAuthorizer":
+        self._grants.setdefault(subject, []).append(
+            Rule(tuple(verbs), tuple(resources)))
+        return self
+
+    def authorize(self, user: UserInfo, verb: str, resource: str) -> bool:
+        for subject in (user.name, *(f"group:{g}" for g in user.groups)):
+            for rule in self._grants.get(subject, ()):
+                if rule.allows(verb, resource):
+                    return True
+        return False
+
+
+def default_component_authorizer() -> RBACAuthorizer:
+    """Grants mirroring the reference's bootstrap cluster roles
+    (plugin/pkg/auth/authorizer/rbac/bootstrappolicy): admins everything,
+    scheduler binds + reads, nodes status + leases, controllers broad write."""
+    a = RBACAuthorizer()
+    a.grant("group:system:masters", ["*"], ["*"])
+    a.grant("group:system:kube-scheduler",
+            ["get", "list", "watch", "update", "patch", "bind"],
+            ["pods", "nodes", "namespaces", "persistentvolumes",
+             "persistentvolumeclaims", "storageclasses", "csinodes",
+             "poddisruptionbudgets", "leases"])
+    a.grant("group:system:nodes",
+            ["get", "list", "watch", "create", "update", "patch", "delete"],
+            ["pods", "nodes", "leases", "events"])
+    a.grant("group:system:kube-controller-manager", ["*"], ["*"])
+    a.grant("group:system:authenticated", ["get", "list", "watch"], ["*"])
+    return a

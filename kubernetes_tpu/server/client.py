@@ -21,9 +21,22 @@ class APIError(Exception):
 
 
 class RESTClient:
-    def __init__(self, base_url: str, timeout: float = 10.0):
+    def __init__(self, base_url: str, timeout: float = 10.0,
+                 token: Optional[str] = None, user: Optional[str] = None):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
+        # token -> Authorization: Bearer (the secured path); user -> the
+        # X-Remote-User convention honored by servers without an authenticator
+        self.token = token
+        self.user = user
+
+    def _headers(self) -> Dict[str, str]:
+        h = {"Content-Type": "application/json"}
+        if self.token:
+            h["Authorization"] = f"Bearer {self.token}"
+        elif self.user:
+            h["X-Remote-User"] = self.user
+        return h
 
     def _path(self, resource: str, namespace: Optional[str], name: Optional[str] = None,
               subresource: Optional[str] = None) -> str:
@@ -39,10 +52,14 @@ class RESTClient:
         return p
 
     def request(self, method: str, path: str, body: Optional[Dict] = None,
-                timeout: Optional[float] = None):
+                timeout: Optional[float] = None,
+                content_type: Optional[str] = None):
         data = json.dumps(body).encode() if body is not None else None
+        headers = self._headers()
+        if content_type:
+            headers["Content-Type"] = content_type
         req = urllib.request.Request(self.base_url + path, data=data, method=method,
-                                     headers={"Content-Type": "application/json"})
+                                     headers=headers)
         try:
             with urllib.request.urlopen(req, timeout=timeout or self.timeout) as resp:
                 return json.loads(resp.read() or b"{}")
@@ -74,6 +91,13 @@ class RESTClient:
 
     def delete(self, resource: str, name: str, namespace: Optional[str] = "default") -> Dict:
         return self.request("DELETE", self._path(resource, namespace, name))
+
+    def patch(self, resource: str, name: str, patch: Dict,
+              namespace: Optional[str] = "default",
+              patch_type: str = "application/strategic-merge-patch+json") -> Dict:
+        """PATCH (merge semantics) — reference: handlers/patch.go."""
+        return self.request("PATCH", self._path(resource, namespace, name),
+                            patch, content_type=patch_type)
 
     def bind(self, namespace: str, pod_name: str, node_name: str) -> Dict:
         return self.request("POST", self._path("pods", namespace, pod_name, "binding"),

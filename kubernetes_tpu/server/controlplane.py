@@ -1,0 +1,138 @@
+"""Leader-elected control plane bundle: scheduler + controller manager.
+
+reference: cmd/kube-scheduler/app/server.go:167 (Run wires healthz, then
+LeaderElector.Run at :281 — only the leader runs sched.Run) and
+cmd/kube-controller-manager/app/controllermanager.go (one elected manager
+starting every controller loop). This is the component _cluster_daemon.py and
+HA deployments embed: N replicas each construct a ControlPlane; exactly one
+drives the cluster at a time, a standby takes over within lease_duration.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+from ..store import APIStore
+from ..utils.leaderelection import LeaderElector
+
+DEFAULT_CONTROLLERS = (
+    "deployment", "replicaset", "statefulset", "daemonset", "job", "cronjob",
+    "disruption", "nodelifecycle", "tainteviction", "endpointslice",
+    "namespace", "garbagecollector", "resourcequota", "horizontalpodautoscaler",
+)
+
+
+def _controller_registry():
+    from ..controllers import (
+        CronJobController,
+        DaemonSetController,
+        DeploymentController,
+        DisruptionController,
+        EndpointSliceController,
+        GarbageCollector,
+        HorizontalPodAutoscalerController,
+        JobController,
+        NamespaceController,
+        NodeLifecycleController,
+        ReplicaSetController,
+        ResourceQuotaController,
+        StatefulSetController,
+        TaintEvictionController,
+    )
+
+    return {
+        "deployment": DeploymentController,
+        "replicaset": ReplicaSetController,
+        "statefulset": StatefulSetController,
+        "daemonset": DaemonSetController,
+        "job": JobController,
+        "cronjob": CronJobController,
+        "disruption": DisruptionController,
+        "nodelifecycle": NodeLifecycleController,
+        "tainteviction": TaintEvictionController,
+        "endpointslice": EndpointSliceController,
+        "namespace": NamespaceController,
+        "garbagecollector": GarbageCollector,
+        "resourcequota": ResourceQuotaController,
+        "horizontalpodautoscaler": HorizontalPodAutoscalerController,
+    }
+
+
+class ControlPlane:
+    """One control-plane replica. start() joins the election; the winner runs
+    the scheduler + the controller set, a loser idles hot. Losing the lease
+    stops everything mid-flight (the reference's leaderelection OnStoppedLeading
+    exits the process; in-process we stop the loops so a standby's writes can't
+    interleave with ours — no double binds)."""
+
+    def __init__(self, store: APIStore, identity: str,
+                 controllers: tuple = DEFAULT_CONTROLLERS,
+                 use_batch_scheduler: bool = True,
+                 scheduler_factory: Optional[Callable] = None,
+                 lease_duration: float = 15.0, renew_deadline: float = 10.0,
+                 retry_period: float = 2.0):
+        self.store = store
+        self.identity = identity
+        self.controller_names = tuple(controllers)
+        self.use_batch_scheduler = use_batch_scheduler
+        self.scheduler_factory = scheduler_factory
+        self.scheduler = None
+        self.controllers: List = []
+        self._lock = threading.Lock()
+        self.elector = LeaderElector(
+            store, lock_name="kube-controlplane", identity=identity,
+            lease_duration=lease_duration, renew_deadline=renew_deadline,
+            retry_period=retry_period,
+            on_started_leading=self._start_components,
+            on_stopped_leading=self._stop_components,
+        )
+
+    @property
+    def is_leader(self) -> bool:
+        return self.elector.is_leader
+
+    def _build_scheduler(self):
+        if self.scheduler_factory is not None:
+            return self.scheduler_factory(self.store)
+        from ..scheduler import Framework
+        from ..scheduler.plugins import default_plugins
+
+        if self.use_batch_scheduler:
+            from ..scheduler.batch import BatchScheduler
+
+            return BatchScheduler(self.store, Framework(default_plugins()),
+                                  solver="auto")
+        from ..scheduler.serial import Scheduler
+
+        return Scheduler(self.store, Framework(default_plugins()))
+
+    def _start_components(self) -> None:
+        with self._lock:
+            registry = _controller_registry()
+            self.scheduler = self._build_scheduler()
+            self.scheduler.sync()
+            self.scheduler.start()
+            self.controllers = []
+            for name in self.controller_names:
+                c = registry[name](self.store)
+                c.sync_all()
+                c.start()
+                self.controllers.append(c)
+
+    def _stop_components(self) -> None:
+        with self._lock:
+            if self.scheduler is not None:
+                self.scheduler.stop()
+                self.scheduler = None
+            for c in self.controllers:
+                c.stop()
+            self.controllers = []
+
+    def start(self) -> "ControlPlane":
+        self.elector.start()
+        return self
+
+    def stop(self) -> None:
+        self.elector.stop()  # releases the lease; triggers _stop_components
+        self._stop_components()

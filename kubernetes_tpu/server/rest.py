@@ -60,6 +60,24 @@ def _parse_path(path: str) -> Optional[Tuple[str, Optional[str], Optional[str], 
     return resource, None, name, sub
 
 
+def json_merge_patch(target, patch):
+    """RFC 7386 JSON Merge Patch: dicts merge recursively, null deletes,
+    everything else replaces (the subset of strategic-merge the build's types
+    need — k8s list-merge keys degrade to whole-list replace, which is also
+    what strategic merge does for lists without a patchMergeKey)."""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for k, v in patch.items():
+        if v is None:
+            out.pop(k, None)
+        else:
+            out[k] = json_merge_patch(out.get(k), v)
+    return out
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "kubernetes-tpu-apiserver"
@@ -72,6 +90,40 @@ class _Handler(BaseHTTPRequestHandler):
     @property
     def store(self) -> APIStore:
         return self.server.store  # type: ignore[attr-defined]
+
+    # ---- authn/authz (DefaultBuildHandlerChain order: authn -> authz) --------
+
+    def _user(self):
+        """Resolve request identity. With an authenticator configured, only
+        bearer tokens count and X-Remote-User is ignored (it is forgeable
+        unless a trusted proxy sets it). Without one, the header is honored —
+        the open in-process mode tests and local daemons use."""
+        from .auth import ANONYMOUS, UserInfo
+
+        authn = getattr(self.server, "authenticator", None)
+        if authn is not None:
+            return authn.authenticate(self.headers.get("Authorization", ""))
+        remote = self.headers.get("X-Remote-User", "")
+        if remote:
+            groups = tuple(g for g in self.headers.get(
+                "X-Remote-Group", "").split(",") if g)
+            return UserInfo(name=remote, groups=groups)
+        return ANONYMOUS
+
+    def _authenticated_user(self, verb: str, resource: str):
+        """Runs authn then authz; sends the error response and returns None on
+        either failure. Health/metrics endpoints bypass (always_allow_paths)."""
+        user = self._user()
+        if user is None:
+            self._error(401, "Unauthorized: invalid or missing bearer token",
+                        "Unauthorized")
+            return None
+        authz = getattr(self.server, "authorizer", None)
+        if authz is not None and not authz.authorize(user, verb, resource):
+            self._error(403, f"user {user.name!r} cannot {verb} {resource}",
+                        "Forbidden")
+            return None
+        return user
 
     def _send_json(self, code: int, payload) -> None:
         body = json.dumps(payload).encode()
@@ -127,7 +179,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, f"unknown resource {resource}")
             return
         q = parse_qs(url.query)
-        if name is None and q.get("watch", ["false"])[0] == "true":
+        is_watch = name is None and q.get("watch", ["false"])[0] == "true"
+        verb = "watch" if is_watch else ("get" if name is not None else "list")
+        if self._authenticated_user(verb, resource) is None:
+            return
+        if is_watch:
             self._watch(resource, ns, int(q.get("resourceVersion", ["-1"])[0]))
             return
         try:
@@ -160,8 +216,8 @@ class _Handler(BaseHTTPRequestHandler):
             while True:
                 ev = w.get(timeout=1.0)
                 if ev is None:
-                    if self.server.shutting_down:  # type: ignore[attr-defined]
-                        break
+                    if w.terminated or self.server.shutting_down:  # type: ignore[attr-defined]
+                        break  # evicted slow watcher: close; client relists
                     # periodic BOOKMARK on quiet streams (reflector.go:156
                     # bookmark events): doubles as a liveness probe so a dead
                     # client fails the write and the watch thread is reaped
@@ -209,6 +265,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, "unknown path")
             return
         resource, ns, name, sub = parsed
+        verb = "bind" if (sub == "binding" and resource == "pods") else "create"
+        user = self._authenticated_user(verb, resource)
+        if user is None:
+            return
         try:
             body = self._read_body()
         except json.JSONDecodeError as e:
@@ -244,7 +304,7 @@ class _Handler(BaseHTTPRequestHandler):
         err = None
         created = None
         with self.store.transaction():
-            err = self._admission_verdict(resource, "CREATE", obj)
+            err = self._admission_verdict(resource, "CREATE", obj, user)
             if err is None:
                 try:
                     created = self.store.create(resource, obj)
@@ -255,27 +315,26 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send_json(201, to_dict(created))
 
-    def _admission_verdict(self, resource: str, operation: str, obj):
+    def _admission_verdict(self, resource: str, operation: str, obj, user=None):
         """Run the admission chain; returns None on admit or an
         (http_code, message, reason) tuple on reject — the caller sends the
-        response outside any store lock. Identity comes from the X-Remote-User
-        header (authenticating-proxy convention) — node agents send
-        system:node:<name>."""
+        response outside any store lock. Identity is the authenticated user
+        (node agents are system:node:<name>)."""
         chain = getattr(self.server, "admission", None)
         if chain is None:
             return None
         from .admission import AdmissionError
 
-        user = self.headers.get("X-Remote-User", "")
+        username = user.name if user is not None else ""
         try:
-            chain.run(self.store, resource, operation, obj, user=user)
+            chain.run(self.store, resource, operation, obj, user=username)
             return None
         except AdmissionError as e:
             return (e.code, str(e), e.reason)
 
-    def _admit(self, resource: str, operation: str, obj) -> bool:
+    def _admit(self, resource: str, operation: str, obj, user=None) -> bool:
         """Lock-free admission wrapper for paths without a transaction."""
-        err = self._admission_verdict(resource, operation, obj)
+        err = self._admission_verdict(resource, operation, obj, user)
         if err is not None:
             self._error(*err)
             return False
@@ -289,6 +348,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, "unknown path")
             return
         resource, ns, name, _ = parsed
+        user = self._authenticated_user("update", resource)
+        if user is None:
+            return
         try:
             body = self._read_body()
             obj = from_dict(resource, body)
@@ -302,7 +364,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, f"name mismatch: URL {name!r} vs body {obj.metadata.name!r}")
             return
         obj.metadata.name = name
-        if not self._admit(resource, "UPDATE", obj):
+        if not self._admit(resource, "UPDATE", obj, user):
             return
         try:
             updated = self.store.update(resource, obj)
@@ -312,12 +374,67 @@ class _Handler(BaseHTTPRequestHandler):
         except ConflictError as e:
             self._error(409, str(e), "Conflict")
 
+    def do_PATCH(self):
+        """JSON Merge Patch / strategic-merge-patch (degraded to merge
+        semantics) — reference: apiserver/pkg/endpoints/handlers/patch.go.
+        get + merge + admission + OCC update run under one store transaction
+        so concurrent patches serialize instead of clobbering."""
+        parsed = _parse_path(urlparse(self.path).path)
+        if parsed is None or parsed[2] is None:
+            self._error(404, "unknown path")
+            return
+        resource, ns, name, _ = parsed
+        user = self._authenticated_user("patch", resource)
+        if user is None:
+            return
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype not in ("application/merge-patch+json",
+                        "application/strategic-merge-patch+json",
+                        "application/json", ""):
+            self._error(415, f"unsupported patch type {ctype!r}")
+            return
+        try:
+            patch = self._read_body()
+        except json.JSONDecodeError as e:
+            self._error(400, f"invalid JSON: {e}")
+            return
+        key = self._key(resource, ns, name)
+        err = None
+        updated = None
+        with self.store.transaction():
+            try:
+                existing = self.store.get(resource, key)
+                merged = json_merge_patch(to_dict(existing), patch)
+                obj = from_dict(resource, merged)
+                obj.metadata.name = name
+                if ns and resource not in CLUSTER_SCOPED:
+                    obj.metadata.namespace = ns
+                # patch is read-modify-write of the current object: carry its
+                # RV so a concurrent writer between our get and update conflicts
+                obj.metadata.resource_version = existing.metadata.resource_version
+                err = self._admission_verdict(resource, "UPDATE", obj, user)
+                if err is None:
+                    updated = self.store.update(resource, obj)
+            except NotFoundError as e:
+                err = (404, str(e), "NotFound")
+            except ConflictError as e:
+                err = (409, str(e), "Conflict")
+            except Exception as e:
+                err = (400, f"cannot apply patch: {e}", "Invalid")
+        if err is not None:
+            self._error(*err)
+            return
+        self._send_json(200, to_dict(updated))
+
     def do_DELETE(self):
         parsed = _parse_path(urlparse(self.path).path)
         if parsed is None or parsed[2] is None:
             self._error(404, "unknown path")
             return
         resource, ns, name, _ = parsed
+        user = self._authenticated_user("delete", resource)
+        if user is None:
+            return
         key = self._key(resource, ns, name)
         err = None
         obj = None
@@ -325,7 +442,7 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 existing = self.store.get(resource, key)
                 # deletes go through admission too (noderestriction covers DELETE)
-                err = self._admission_verdict(resource, "DELETE", existing)
+                err = self._admission_verdict(resource, "DELETE", existing, user)
                 if err is None:
                     obj = self.store.delete(resource, key)
             except NotFoundError as e:
@@ -340,7 +457,8 @@ class APIServer:
     """Embeds the store behind HTTP. start() binds a port; .url for clients."""
 
     def __init__(self, store: APIStore, host: str = "127.0.0.1", port: int = 0,
-                 verbose: bool = False, admission="default"):
+                 verbose: bool = False, admission="default",
+                 authenticator=None, authorizer=None):
         self.store = store
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.store = store  # type: ignore[attr-defined]
@@ -351,6 +469,10 @@ class APIServer:
 
             admission = default_admission_chain()
         self._httpd.admission = admission  # type: ignore[attr-defined]
+        # authn/authz: None keeps the open in-process mode (tests, local
+        # daemons); see auth.py for the secured configuration
+        self._httpd.authenticator = authenticator  # type: ignore[attr-defined]
+        self._httpd.authorizer = authorizer  # type: ignore[attr-defined]
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 

@@ -190,28 +190,37 @@ class NodeColumns:
 
 def pod_class_signature(pod: Pod) -> tuple:
     """Scheduling-relevant spec signature; pods with equal signatures schedule
-    identically given equal resource requests (the equivalence-class dedupe)."""
+    identically given equal resource requests (the equivalence-class dedupe).
+
+    Hot: called once per pod per batch (100k at north-star scale), so the
+    common empty cases (no labels/selector/affinity/constraints) short-circuit
+    before any sort/repr work."""
     spec = pod.spec
     aff = spec.affinity
+    labels = pod.metadata.labels
+    any_ports = any(c.ports for c in spec.containers)
     ports = tuple(sorted(
         (p.protocol or "TCP", p.host_port)
         for c in spec.containers for p in c.ports if p.host_port > 0
-    ))
+    )) if any_ports else ()
+    any_images = any(c.image for c in spec.containers) or any(
+        c.image for c in spec.init_containers)
     images = tuple(sorted(
         c.image for c in list(spec.init_containers) + list(spec.containers) if c.image
-    ))
+    )) if any_images else ()
     return (
         pod.metadata.namespace,
-        tuple(sorted(pod.metadata.labels.items())),
+        tuple(sorted(labels.items())) if labels else (),
         spec.node_name,
-        tuple(sorted(spec.node_selector.items())),
+        tuple(sorted(spec.node_selector.items())) if spec.node_selector else (),
         repr(aff) if aff else "",
-        tuple(spec.tolerations),
-        tuple(spec.topology_spread_constraints),
+        tuple(spec.tolerations) if spec.tolerations else (),
+        tuple(spec.topology_spread_constraints) if spec.topology_spread_constraints else (),
         ports,
         images,
         len(spec.containers) + len(spec.init_containers),
-        tuple(spec.volumes),
+        tuple(spec.volumes) if spec.volumes else (),
+        tuple(spec.resource_claims) if spec.resource_claims else (),
     )
 
 

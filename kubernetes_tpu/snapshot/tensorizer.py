@@ -187,10 +187,20 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
     # memoize by container-resources signature: template-stamped pods (the
     # overwhelmingly common case) compute their request vectors exactly once
     req_cache: Dict[tuple, Tuple[List[int], List[int], bool]] = {}
+    def _res_sig(res: dict) -> tuple:
+        # {"requests": {...}, "limits": {...}, "claims": [...]} -> hashable
+        # value key (cheaper than repr at 100k-pod scale); non-dict values
+        # (resources.claims is a list) degrade to repr
+        if not res:
+            return ()
+        return tuple(
+            (k, tuple(sorted(v.items())) if isinstance(v, dict) else repr(v))
+            for k, v in sorted(res.items()))
+
     for pi, pod in enumerate(pods):
         sig = (
-            tuple(repr(c.resources) for c in pod.spec.containers),
-            tuple(repr(c.resources) for c in pod.spec.init_containers),
+            tuple(_res_sig(c.resources) for c in pod.spec.containers),
+            tuple(_res_sig(c.resources) for c in pod.spec.init_containers),
             repr(pod.spec.overhead) if pod.spec.overhead else "",
         )
         got = req_cache.get(sig)
@@ -244,6 +254,10 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
     ct_rows, st_rows = [], []
     fallback_class = np.zeros(len(rep_pods), dtype=bool)
     for ci, pod in enumerate(rep_pods):
+        if pod.spec.resource_claims:
+            # DRA claims need the allocator's Reserve/Unreserve/PreBind
+            # transitions — serial path (dynamic_resources.py)
+            fallback_class[ci] = True
         if any(v.scheduling_relevant for v in pod.spec.volumes):
             # PVC/ephemeral/shared-disk constraints (binding/zone/limits/
             # conflicts) are not dense-encoded; those pods take the serial path

@@ -62,18 +62,70 @@ class AlreadyBoundError(Exception):
     pass
 
 
-class Watch:
-    """A single watch subscription. Iterate or .get(timeout). Call .stop() to end."""
+def _pod_structural_clone(pod):
+    """Fast pod clone for the bind/status hot paths: fresh Pod, ObjectMeta
+    (with own labels/annotations/owner_references/finalizers containers),
+    PodSpec, and PodStatus (own conditions list) — ~20x cheaper than deepcopy.
 
-    def __init__(self, store: "APIStore", kind: Optional[str]):
-        self._q: "queue.Queue[Optional[Event]]" = queue.Queue()
+    The deep members that stay SHARED (containers, tolerations, affinity,
+    topology-spread constraints, volumes, node_selector) are treated as
+    immutable by every store consumer: the store itself never mutates stored
+    objects (writes replace them), and clients mutate only top-level metadata
+    dicts / spec.node_name / status fields — all cloned here."""
+    meta = copy.copy(pod.metadata)
+    meta.labels = dict(meta.labels)
+    meta.annotations = dict(meta.annotations)
+    meta.owner_references = list(meta.owner_references)
+    meta.finalizers = list(meta.finalizers)
+    spec = copy.copy(pod.spec)
+    status = copy.copy(pod.status)
+    status.conditions = list(status.conditions)
+    new = copy.copy(pod)
+    new.metadata = meta
+    new.spec = spec
+    new.status = status
+    return new
+
+
+class Watch:
+    """A single watch subscription. Iterate or .get(timeout). Call .stop() to end.
+
+    Buffers are BOUNDED (maxsize events): a consumer that stops draining is
+    terminated instead of growing the queue without limit — the reference's
+    Cacher does the same to slow watchers (cacher.go terminateAllWatchers /
+    per-watcher buffer overflow). A terminated watcher must relist+rewatch
+    (`terminated` flips True and the stream ends)."""
+
+    DEFAULT_MAXSIZE = 10_000
+
+    def __init__(self, store: "APIStore", kind: Optional[str],
+                 maxsize: int = DEFAULT_MAXSIZE):
+        self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize=maxsize or 0)
         self._store = store
         self._kind = kind
         self._stopped = False
+        self.terminated = False  # True when evicted for falling behind
 
     def _deliver(self, ev: Event) -> None:
+        if self.terminated or self._stopped:
+            return
         if self._kind is None or ev.kind == self._kind:
-            self._q.put(ev)
+            try:
+                self._q.put_nowait(ev)
+            except queue.Full:
+                # slow watcher: evict rather than buffer forever; drop one
+                # event to make room for the end-of-stream sentinel (the
+                # stream is void anyway — the consumer must relist)
+                self.terminated = True
+                self._store._unsubscribe(self)
+                try:
+                    self._q.get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    self._q.put_nowait(None)
+                except queue.Full:
+                    pass
 
     def get(self, timeout: Optional[float] = None) -> Optional[Event]:
         try:
@@ -101,7 +153,10 @@ class Watch:
     def stop(self) -> None:
         self._stopped = True
         self._store._unsubscribe(self)
-        self._q.put(None)
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass  # consumer is behind anyway; it checks _stopped/terminated
 
 
 class APIStore:
@@ -141,13 +196,19 @@ class APIStore:
         # Events carry a copy, never the stored object: a watcher that mutates an
         # event object (the client-go mutation-detector failure mode) must not be
         # able to corrupt store state. One copy per write, shared by watchers.
-        ev = Event(etype, kind, self._copy(obj), self._rv)
+        self._emit_prepared(etype, kind, self._copy(obj))
+
+    def _emit_prepared(self, etype: str, kind: str, obj) -> None:
+        """Emit an event whose object is ALREADY private to the event (hot
+        write paths pre-clone instead of paying a second deepcopy here)."""
+        ev = Event(etype, kind, obj, self._rv)
         self._history.append(ev)
         if len(self._history) > self._history_limit:
             drop = self._history_limit // 4
             self._history_floor_rv = self._history[drop - 1].resource_version
             del self._history[:drop]
-        for w in self._watchers:
+        # snapshot: _deliver may evict (unsubscribe) a slow watcher mid-loop
+        for w in list(self._watchers):
             w._deliver(ev)
 
     # -- CRUD ------------------------------------------------------------------
@@ -250,22 +311,30 @@ class APIStore:
 
     # -- watch -----------------------------------------------------------------
 
-    def watch(self, kind: Optional[str] = None, since_rv: int = -1) -> Watch:
+    def watch(self, kind: Optional[str] = None, since_rv: int = -1,
+              maxsize: int = Watch.DEFAULT_MAXSIZE) -> Watch:
         """Subscribe to events. since_rv >= 0 replays history events with rv > since_rv
         first (the Reflector resume contract); since_rv == -1 means 'from now'.
-        Raises ResourceVersionTooOldError if since_rv predates retained history —
-        the caller must relist (410 Gone analog)."""
+        Raises ResourceVersionTooOldError if since_rv predates retained history
+        or the replay alone would overflow the watch buffer — the caller must
+        relist (410 Gone analog). maxsize bounds the per-watcher buffer; a
+        consumer that falls that far behind is evicted (Watch.terminated)."""
         with self._lock:
             if 0 <= since_rv < self._history_floor_rv:
                 raise ResourceVersionTooOldError(
                     f"rv {since_rv} is older than retained history (floor "
                     f"{self._history_floor_rv}); relist required"
                 )
-            w = Watch(self, kind)
+            replay = []
             if since_rv >= 0:
-                for ev in self._history:
-                    if ev.resource_version > since_rv:
-                        w._deliver(ev)
+                replay = [ev for ev in self._history if ev.resource_version > since_rv]
+                if maxsize and len(replay) >= maxsize:
+                    raise ResourceVersionTooOldError(
+                        f"replay of {len(replay)} events from rv {since_rv} exceeds "
+                        f"the watch buffer ({maxsize}); relist required")
+            w = Watch(self, kind, maxsize=maxsize)
+            for ev in replay:
+                w._deliver(ev)
             self._watchers.append(w)
             return w
 
@@ -278,30 +347,72 @@ class APIStore:
 
     # -- scheduling-specific transactional surfaces ----------------------------
 
+    def _pod_internal(self, key: str):
+        try:
+            return self._objects.get("pods", {})[key]
+        except KeyError:
+            raise NotFoundError(f"pods {key} not found") from None
+
     def bind(self, namespace: str, name: str, node_name: str) -> Any:
         """Atomic pod->node binding (reference: BindingREST.Create,
         pkg/registry/core/pod/storage/storage.go:149 — guaranteed-update that fails
-        if the pod is already bound to a different node)."""
+        if the pod is already bound to a different node).
+
+        Hot path: binds happen at batch-solver rate (the north star is 100k),
+        so the stored object and the event object are STRUCTURAL clones
+        (fresh Pod/metadata/spec/status, shared immutable innards like
+        containers) instead of three deepcopies — see _pod_structural_clone."""
         with self._lock:
             key = f"{namespace}/{name}"
-            pod = self.get("pods", key)
+            pod = self._pod_internal(key)
             if pod.spec.node_name:
                 raise AlreadyBoundError(f"pod {key} is already bound to {pod.spec.node_name}")
-            pod = self._copy(pod)
-            pod.spec.node_name = node_name
+            new = _pod_structural_clone(pod)
+            new.spec.node_name = node_name
             self._rv += 1
-            pod.metadata.resource_version = self._rv
-            self._objects["pods"][key] = pod
-            self._emit(MODIFIED, "pods", pod)
-            return pod
+            new.metadata.resource_version = self._rv
+            self._objects["pods"][key] = new
+            self._emit_prepared(MODIFIED, "pods", _pod_structural_clone(new))
+            # the caller's copy is distinct from both the stored object and
+            # the event object (mutating it must corrupt neither)
+            return _pod_structural_clone(new)
+
+    def bind_many(self, bindings: Iterable[Tuple[str, str, str]]) -> Tuple[int, List[Tuple[str, str]]]:
+        """Batched bind: one lock acquisition for a whole solver batch.
+        bindings = (namespace, name, node_name) triples. Returns
+        (bound_count, [(key, error message) ...]) — per-pod failures do not
+        abort the batch (each binding is its own transaction, like N
+        BindingREST calls back-to-back)."""
+        errors: List[Tuple[str, str]] = []
+        bound = 0
+        with self._lock:
+            for namespace, name, node_name in bindings:
+                key = f"{namespace}/{name}"
+                try:
+                    pod = self._pod_internal(key)
+                    if pod.spec.node_name:
+                        raise AlreadyBoundError(
+                            f"pod {key} is already bound to {pod.spec.node_name}")
+                    new = _pod_structural_clone(pod)
+                    new.spec.node_name = node_name
+                    self._rv += 1
+                    new.metadata.resource_version = self._rv
+                    self._objects["pods"][key] = new
+                    self._emit_prepared(MODIFIED, "pods", _pod_structural_clone(new))
+                    bound += 1
+                except (NotFoundError, AlreadyBoundError) as e:
+                    errors.append((key, str(e)))
+        return bound, errors
 
     def update_pod_status(self, namespace: str, name: str, mutate_status: Callable[[Any], None]) -> Any:
+        """Status-subresource write (hot under failure storms: one structural
+        clone for the store, one for the event, no deepcopies)."""
         with self._lock:
             key = f"{namespace}/{name}"
-            pod = self._copy(self.get("pods", key))
+            pod = _pod_structural_clone(self._pod_internal(key))
             mutate_status(pod.status)
             self._rv += 1
             pod.metadata.resource_version = self._rv
             self._objects["pods"][key] = pod
-            self._emit(MODIFIED, "pods", pod)
-            return pod
+            self._emit_prepared(MODIFIED, "pods", _pod_structural_clone(pod))
+            return _pod_structural_clone(pod)

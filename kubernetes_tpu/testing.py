@@ -146,6 +146,12 @@ class MakePod:
         self._pod.spec.priority = p
         return self
 
+    def claim(self, claim_name: str, ref_name: str = "") -> "MakePod":
+        """Reference a DRA ResourceClaim (PodSpec.resourceClaims)."""
+        self._pod.spec.resource_claims.append(
+            (ref_name or claim_name, claim_name))
+        return self
+
     def scheduling_gate(self, name: str) -> "MakePod":
         self._pod.spec.scheduling_gates.append(name)
         return self

@@ -1,0 +1,193 @@
+"""apiserver hardening: token authn, RBAC-lite authz, PATCH, bounded watches.
+
+reference: apiserver handler chain (authn -> authz -> admission),
+authentication/request/bearertoken + token file, RBAC bootstrap policy,
+endpoints/handlers/patch.go, and the Cacher's slow-watcher termination.
+"""
+
+import pytest
+
+from kubernetes_tpu.server.auth import (
+    RBACAuthorizer,
+    TokenAuthenticator,
+    UserInfo,
+    default_component_authorizer,
+)
+from kubernetes_tpu.server.client import APIError, RESTClient
+from kubernetes_tpu.server.rest import APIServer, json_merge_patch
+from kubernetes_tpu.store import APIStore, ResourceVersionTooOldError
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+class TestTokenAuthn:
+    def test_csv_parse_and_authenticate(self):
+        authn = TokenAuthenticator.from_csv_lines([
+            "# comment",
+            'tok-sched,system:kube-scheduler,uid1,"system:kube-scheduler"',
+            "tok-plain,alice,uid2",
+        ])
+        u = authn.authenticate("Bearer tok-sched")
+        assert u.name == "system:kube-scheduler"
+        assert "system:kube-scheduler" in u.groups
+        assert "system:authenticated" in u.groups
+        assert authn.authenticate("Bearer nope") is None
+        assert authn.authenticate("") is None
+
+    def test_server_rejects_bad_token(self):
+        store = APIStore()
+        authn = TokenAuthenticator()
+        authn.add("good", "alice", ["system:masters"])
+        srv = APIServer(store, authenticator=authn,
+                        authorizer=default_component_authorizer()).start()
+        try:
+            anon = RESTClient(srv.url)
+            with pytest.raises(APIError) as e:
+                anon.list("pods")
+            assert e.value.code == 401
+            ok = RESTClient(srv.url, token="good")
+            items, _ = ok.list("pods")
+            assert items == []
+            # X-Remote-User must be IGNORED when an authenticator is configured
+            spoof = RESTClient(srv.url, user="system:admin")
+            with pytest.raises(APIError) as e:
+                spoof.list("pods")
+            assert e.value.code == 401
+        finally:
+            srv.stop()
+
+    def test_rbac_denies_wrong_verb(self):
+        store = APIStore()
+        authn = TokenAuthenticator()
+        authn.add("viewer-tok", "viewer", [])  # only system:authenticated
+        srv = APIServer(store, authenticator=authn,
+                        authorizer=default_component_authorizer()).start()
+        try:
+            viewer = RESTClient(srv.url, token="viewer-tok")
+            items, _ = viewer.list("pods")  # read: allowed
+            assert items == []
+            with pytest.raises(APIError) as e:
+                viewer.create("pods", {"kind": "Pod",
+                                       "metadata": {"name": "x", "namespace": "default"}})
+            assert e.value.code == 403
+        finally:
+            srv.stop()
+
+    def test_rbac_rules(self):
+        a = RBACAuthorizer().grant("bob", ["get", "list"], ["pods"])
+        bob = UserInfo("bob")
+        assert a.authorize(bob, "get", "pods")
+        assert not a.authorize(bob, "delete", "pods")
+        assert not a.authorize(bob, "get", "nodes")
+        assert not a.authorize(UserInfo("eve"), "get", "pods")
+
+
+class TestPatch:
+    def test_json_merge_patch_semantics(self):
+        target = {"a": {"b": 1, "c": 2}, "keep": "x", "lst": [1, 2]}
+        patch = {"a": {"b": 9, "c": None}, "lst": [3], "new": True}
+        assert json_merge_patch(target, patch) == {
+            "a": {"b": 9}, "keep": "x", "lst": [3], "new": True}
+
+    def test_http_patch_updates_labels_preserves_spec(self):
+        store = APIStore()
+        srv = APIServer(store).start()
+        try:
+            client = RESTClient(srv.url)
+            client.create("pods", {
+                "kind": "Pod",
+                "metadata": {"name": "p", "namespace": "default"},
+                "spec": {"containers": [
+                    {"name": "c0", "resources": {"requests": {"cpu": "1"}}}]},
+            })
+            out = client.patch("pods", "p", {"metadata": {"labels": {"tier": "web"}}})
+            assert out["metadata"]["labels"]["tier"] == "web"
+            got = store.get("pods", "default/p")
+            assert got.metadata.labels["tier"] == "web"
+            # unspecified fields preserved
+            assert got.spec.containers[0].resources["requests"]["cpu"] == "1"
+        finally:
+            srv.stop()
+
+    def test_patch_missing_object_404(self):
+        store = APIStore()
+        srv = APIServer(store).start()
+        try:
+            client = RESTClient(srv.url)
+            with pytest.raises(APIError) as e:
+                client.patch("pods", "ghost", {"metadata": {"labels": {"a": "b"}}})
+            assert e.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_ktl_apply_uses_patch(self, tmp_path):
+        import io
+        import json as _json
+        from contextlib import redirect_stdout
+
+        from kubernetes_tpu.cli.ktl import main as ktl_main
+
+        store = APIStore()
+        srv = APIServer(store).start()
+        try:
+            manifest = tmp_path / "pod.json"
+            manifest.write_text(_json.dumps({
+                "kind": "Pod", "metadata": {"name": "ap", "namespace": "default"},
+                "spec": {"containers": [{"name": "c0"}]}}))
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert ktl_main(["--server", srv.url, "apply", "-f", str(manifest)]) == 0
+            assert "created" in buf.getvalue()
+            # second apply with a label: patched, spec preserved
+            manifest.write_text(_json.dumps({
+                "kind": "Pod", "metadata": {"name": "ap", "namespace": "default",
+                                            "labels": {"v": "2"}}}))
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert ktl_main(["--server", srv.url, "apply", "-f", str(manifest)]) == 0
+            assert "configured" in buf.getvalue()
+            got = store.get("pods", "default/ap")
+            assert got.metadata.labels["v"] == "2"
+            assert got.spec.containers[0].name == "c0"
+        finally:
+            srv.stop()
+
+
+class TestBoundedWatch:
+    def test_slow_watcher_evicted(self):
+        store = APIStore()
+        w = store.watch("pods", maxsize=8)
+        for i in range(20):
+            store.create("pods", MakePod(f"p{i}").obj())
+        assert w.terminated
+        # the store no longer delivers to it
+        assert w not in store._watchers
+        # drained events end with the None sentinel, not a hang
+        seen = w.drain()
+        assert len(seen) <= 8
+
+    def test_replay_overflow_raises_410(self):
+        store = APIStore()
+        for i in range(50):
+            store.create("pods", MakePod(f"p{i}").obj())
+        with pytest.raises(ResourceVersionTooOldError):
+            store.watch("pods", since_rv=0, maxsize=10)
+
+    def test_scheduler_relists_after_eviction(self):
+        from kubernetes_tpu.scheduler import Framework, Scheduler
+        from kubernetes_tpu.scheduler.plugins import default_plugins
+
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity(
+            {"cpu": "64", "memory": "64Gi", "pods": "500"}).obj())
+        sched = Scheduler(store, Framework(default_plugins()),
+                          pod_initial_backoff=0.01)
+        sched.sync()
+        # shrink the buffer to force eviction
+        sched._watch.stop()
+        sched._watch = store.watch(maxsize=16)
+        for i in range(100):
+            store.create("pods", MakePod(f"p{i}").req({"cpu": "100m"}).obj())
+        assert sched._watch.terminated
+        sched.run_until_idle()  # pump -> relist -> schedule
+        bound = sum(1 for p in store.list("pods")[0] if p.spec.node_name)
+        assert bound == 100

@@ -1,0 +1,214 @@
+"""Dynamic Resource Allocation tests.
+
+Mirrors the reference's dynamicresources plugin + structured allocator
+behavior (pkg/scheduler/framework/plugins/dynamicresources,
+staging/src/k8s.io/dynamic-resource-allocation/structured) and the
+test/integration/scheduler DRA flows: claim-driven placement, reservation,
+unreserve on failure, allocate/deallocate races.
+"""
+
+import pytest
+
+from kubernetes_tpu.api.dra import (
+    Device,
+    DeviceAttributeRequirement,
+    DeviceClass,
+    DeviceRequest,
+    ResourceClaim,
+    ResourceSlice,
+)
+from kubernetes_tpu.api.types import ObjectMeta
+from kubernetes_tpu.scheduler import Framework, Scheduler
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+from kubernetes_tpu.utils.featuregate import feature_gates
+
+
+@pytest.fixture(autouse=True)
+def dra_gate():
+    feature_gates.set("DynamicResourceAllocation", True)
+    yield
+    feature_gates.set("DynamicResourceAllocation", False)
+
+
+def _slice(node, devices, driver="tpu.driver", pool="pool0"):
+    return ResourceSlice(
+        metadata=ObjectMeta(name=f"{node}-slice", namespace=""),
+        node_name=node, driver=driver, pool=pool,
+        devices=[Device(name=d, attributes={"type": "tpu", "memGiB": 16})
+                 for d in devices])
+
+
+def _class(name="tpu-v5", selectors=()):
+    return DeviceClass(
+        metadata=ObjectMeta(name=name, namespace=""),
+        selectors=list(selectors) or [
+            DeviceAttributeRequirement(key="type", op="==", value="tpu")])
+
+
+def _claim(name, count=1, class_name="tpu-v5", ns="default"):
+    return ResourceClaim(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        requests=[DeviceRequest(name="dev", device_class_name=class_name,
+                                count=count)])
+
+
+def _cluster(store, n_nodes=3, devices_per_node=2):
+    for i in range(n_nodes):
+        store.create("nodes", MakeNode(f"n{i}").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": "20"}).obj())
+    store.create("deviceclasses", _class())
+    # only node n1 carries devices by default
+    store.create("resourceslices", _slice(
+        "n1", [f"dev-{j}" for j in range(devices_per_node)]))
+
+
+class TestDRAScheduling:
+    def test_claiming_pod_lands_only_on_device_node(self):
+        store = APIStore()
+        _cluster(store)
+        store.create("resourceclaims", _claim("c1"))
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        store.create("pods", MakePod("p").req({"cpu": "1"}).claim("c1").obj())
+        sched.run_until_idle()
+        assert store.get("pods", "default/p").spec.node_name == "n1"
+        claim = store.get("resourceclaims", "default/c1")
+        assert claim.allocation is not None
+        assert claim.allocation.node_name == "n1"
+        assert len(claim.allocation.devices["dev"]) == 1
+        assert "p" in claim.reserved_for
+
+    def test_pod_without_claim_unaffected(self):
+        store = APIStore()
+        _cluster(store)
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        store.create("pods", MakePod("plain").req({"cpu": "1"}).obj())
+        sched.run_until_idle()
+        assert store.get("pods", "default/plain").spec.node_name != ""
+
+    def test_missing_claim_gates_pod_until_created(self):
+        store = APIStore()
+        _cluster(store)
+        sched = Scheduler(store, Framework(default_plugins()),
+                          pod_initial_backoff=0.01)
+        sched.sync()
+        store.create("pods", MakePod("p").req({"cpu": "1"}).claim("late").obj())
+        sched.run_until_idle()
+        assert store.get("pods", "default/p").spec.node_name == ""
+        store.create("resourceclaims", _claim("late"))
+        sched.pump_events()
+        import time
+
+        time.sleep(0.05)
+        sched.queue.flush_backoff_completed()
+        sched.queue.flush_unschedulable_left_over()
+        sched.run_until_idle()
+        assert store.get("pods", "default/p").spec.node_name == "n1"
+
+    def test_device_exhaustion_blocks_second_pod(self):
+        store = APIStore()
+        _cluster(store, devices_per_node=1)
+        store.create("resourceclaims", _claim("c1"))
+        store.create("resourceclaims", _claim("c2"))
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        store.create("pods", MakePod("p1").req({"cpu": "1"}).claim("c1").obj())
+        store.create("pods", MakePod("p2").req({"cpu": "1"}).claim("c2").obj())
+        sched.run_until_idle()
+        bound = [store.get("pods", f"default/p{i}").spec.node_name for i in (1, 2)]
+        assert sorted(bound)[0] == ""  # exactly one placed
+        assert sorted(bound)[1] == "n1"
+
+    def test_deallocate_frees_devices_for_next_pod(self):
+        from kubernetes_tpu.scheduler.plugins.dynamic_resources import DynamicResources
+
+        store = APIStore()
+        _cluster(store, devices_per_node=1)
+        store.create("resourceclaims", _claim("c1"))
+        store.create("resourceclaims", _claim("c2"))
+        sched = Scheduler(store, Framework(default_plugins()),
+                          pod_initial_backoff=0.01)
+        sched.sync()
+        store.create("pods", MakePod("p1").req({"cpu": "1"}).claim("c1").obj())
+        sched.run_until_idle()
+        assert store.get("pods", "default/p1").spec.node_name == "n1"
+
+        store.create("pods", MakePod("p2").req({"cpu": "1"}).claim("c2").obj())
+        sched.run_until_idle()
+        assert store.get("pods", "default/p2").spec.node_name == ""
+
+        # pod p1 finishes; its claim is deallocated (kubelet/controller side)
+        plugin = next(p for fw in sched.profiles.values() for p in fw.plugins
+                      if isinstance(p, DynamicResources))
+        store.delete("pods", "default/p1")
+        plugin.deallocate("default/c1")
+        sched.pump_events()
+        import time
+
+        time.sleep(0.05)
+        sched.queue.flush_backoff_completed()
+        sched.queue.flush_unschedulable_left_over()
+        sched.run_until_idle()
+        assert store.get("pods", "default/p2").spec.node_name == "n1"
+        c2 = store.get("resourceclaims", "default/c2")
+        assert c2.allocation is not None
+
+    def test_multi_count_and_selector_requests(self):
+        store = APIStore()
+        for i in range(2):
+            store.create("nodes", MakeNode(f"n{i}").capacity(
+                {"cpu": "8", "memory": "16Gi", "pods": "20"}).obj())
+        store.create("deviceclasses", _class())
+        # n0: two small devices; n1: two big devices
+        s0 = ResourceSlice(metadata=ObjectMeta(name="s0", namespace=""),
+                           node_name="n0", driver="d", pool="p",
+                           devices=[Device(name=f"small-{j}",
+                                           attributes={"type": "tpu", "memGiB": 8})
+                                    for j in range(2)])
+        s1 = ResourceSlice(metadata=ObjectMeta(name="s1", namespace=""),
+                           node_name="n1", driver="d", pool="p",
+                           devices=[Device(name=f"big-{j}",
+                                           attributes={"type": "tpu", "memGiB": 32})
+                                    for j in range(2)])
+        store.create("resourceslices", s0)
+        store.create("resourceslices", s1)
+        claim = ResourceClaim(
+            metadata=ObjectMeta(name="big2", namespace="default"),
+            requests=[DeviceRequest(
+                name="dev", device_class_name="tpu-v5", count=2,
+                selectors=[DeviceAttributeRequirement(
+                    key="memGiB", op=">=", value=16)])])
+        store.create("resourceclaims", claim)
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        store.create("pods", MakePod("p").req({"cpu": "1"}).claim("big2").obj())
+        sched.run_until_idle()
+        assert store.get("pods", "default/p").spec.node_name == "n1"
+        got = store.get("resourceclaims", "default/big2")
+        assert sorted(got.allocation.devices["dev"]) == ["big-0", "big-1"]
+
+    def test_batch_scheduler_routes_claims_to_serial_path(self):
+        store = APIStore()
+        _cluster(store)
+        store.create("resourceclaims", _claim("c1"))
+        sched = BatchScheduler(store, Framework(default_plugins()), solver="auto")
+        sched.sync()
+        store.create("pods", MakePod("claimer").req({"cpu": "1"}).claim("c1").obj())
+        for i in range(5):
+            store.create("pods", MakePod(f"plain-{i}").req({"cpu": "1"}).obj())
+        sched.run_until_idle()
+        assert store.get("pods", "default/claimer").spec.node_name == "n1"
+        for i in range(5):
+            assert store.get("pods", f"default/plain-{i}").spec.node_name != ""
+
+    def test_gate_off_means_no_plugin(self):
+        feature_gates.set("DynamicResourceAllocation", False)
+        names = {p.name for p in default_plugins()}
+        assert "DynamicResources" not in names
+        feature_gates.set("DynamicResourceAllocation", True)
+        names = {p.name for p in default_plugins()}
+        assert "DynamicResources" in names

@@ -244,3 +244,125 @@ def test_put_honors_url_namespace(server, client):
     with pytest.raises(APIError) as e:
         client.get("pods", "web", "default")
     assert e.value.code == 404
+
+
+class TestCLIBreadth:
+    """The kubectl-parity commands added in round 4 (label/annotate/patch/
+    rollout/set image/top/wait/autoscale — kubectl/pkg/cmd)."""
+
+    def run(self, server, *argv):
+        import io
+        from contextlib import redirect_stdout
+
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = ktl_main(["--server", server.url, *argv])
+        return rc, buf.getvalue()
+
+    def _mk_pod(self, server, name="p1"):
+        store = server.store
+        from kubernetes_tpu.testing import MakePod
+
+        store.create("pods", MakePod(name).req({"cpu": "500m", "memory": "1Gi"}).obj())
+
+    def test_label_and_unlabel(self, server):
+        self._mk_pod(server)
+        rc, _ = self.run(server, "label", "pods", "p1", "tier=web", "team=a")
+        assert rc == 0
+        pod = server.store.get("pods", "default/p1")
+        assert pod.metadata.labels["tier"] == "web"
+        rc, _ = self.run(server, "label", "pods", "p1", "team-")
+        assert rc == 0
+        assert "team" not in server.store.get("pods", "default/p1").metadata.labels
+
+    def test_annotate(self, server):
+        self._mk_pod(server)
+        rc, _ = self.run(server, "annotate", "pods", "p1", "note=hello")
+        assert rc == 0
+        assert server.store.get("pods", "default/p1").metadata.annotations["note"] == "hello"
+
+    def test_patch(self, server):
+        self._mk_pod(server)
+        rc, _ = self.run(server, "patch", "pods", "p1",
+                         "-p", '{"metadata": {"labels": {"x": "y"}}}')
+        assert rc == 0
+        assert server.store.get("pods", "default/p1").metadata.labels["x"] == "y"
+
+    def test_top_nodes_and_pods(self, server):
+        from kubernetes_tpu.testing import MakeNode, MakePod
+
+        server.store.create("nodes", MakeNode("n0").capacity(
+            {"cpu": "4", "memory": "8Gi", "pods": "10"}).obj())
+        p = MakePod("busy").req({"cpu": "2", "memory": "4Gi"}).obj()
+        p.spec.node_name = "n0"
+        server.store.create("pods", p)
+        rc, out = self.run(server, "top", "nodes")
+        assert rc == 0 and "n0" in out and "50%" in out
+        rc, out = self.run(server, "top", "pods")
+        assert rc == 0 and "busy" in out and "2000m" in out
+
+    def test_wait_for_condition_and_delete(self, server):
+        import threading
+        import time
+
+        self._mk_pod(server)
+
+        def later():
+            time.sleep(0.2)
+            server.store.update_pod_status(
+                "default", "p1", lambda st: st.conditions.append(
+                    __import__("kubernetes_tpu.api.types",
+                               fromlist=["PodCondition"]).PodCondition(
+                        type="Ready", status="True")))
+
+        threading.Thread(target=later, daemon=True).start()
+        rc, out = self.run(server, "wait", "pods/p1", "--for", "condition=Ready",
+                           "--timeout", "5")
+        assert rc == 0 and "condition met" in out
+
+        def deleter():
+            time.sleep(0.2)
+            server.store.delete("pods", "default/p1")
+
+        threading.Thread(target=deleter, daemon=True).start()
+        rc, out = self.run(server, "wait", "pods/p1", "--for", "delete",
+                           "--timeout", "5")
+        assert rc == 0
+
+    def test_rollout_and_set_image_and_autoscale(self, server, tmp_path):
+        import json as _json
+
+        manifest = tmp_path / "d.json"
+        manifest.write_text(_json.dumps({
+            "kind": "Deployment", "metadata": {"name": "web"},
+            "spec": {"replicas": 1,
+                     "selector": {"matchLabels": {"app": "web"}},
+                     "template": {"metadata": {"labels": {"app": "web"}},
+                                  "spec": {"containers": [
+                                      {"name": "c", "image": "img:1"}]}}},
+        }))
+        rc, _ = self.run(server, "create", "-f", str(manifest))
+        assert rc == 0
+        rc, _ = self.run(server, "set", "image", "deployment/web", "c=img:2")
+        assert rc == 0
+        d = server.store.get("deployments", "default/web")
+        assert d.spec.template.spec.containers[0].image == "img:2"
+        rc, _ = self.run(server, "rollout", "restart", "deployment/web")
+        assert rc == 0
+        d = server.store.get("deployments", "default/web")
+        assert "kubectl.kubernetes.io/restartedAt" in \
+            d.spec.template.metadata.annotations
+        # rollout status succeeds once the controller reports readiness
+        def mutate(dep):
+            dep.status.updated_replicas = 1
+            dep.status.ready_replicas = 1
+            return dep
+
+        server.store.guaranteed_update("deployments", "default/web", mutate)
+        rc, out = self.run(server, "rollout", "status", "deployment/web",
+                           "--timeout", "5")
+        assert rc == 0 and "successfully rolled out" in out
+        rc, _ = self.run(server, "autoscale", "deployment/web", "--max", "5")
+        assert rc == 0
+        hpa = server.store.get("horizontalpodautoscalers", "default/web")
+        assert hpa is not None

