@@ -127,7 +127,9 @@ def device_solve(snap, pods, solver):
     if solver == "waterfill":
         a = np.asarray(waterfill_solve(inputs, make_groups(batch)))
     else:
-        assignment, _, _ = greedy_scan_solve(inputs, d_max)
+        assignment, _, _ = greedy_scan_solve(
+            inputs, d_max, has_ipa=bool(batch.ipa.has_any),
+            has_ct=bool(batch.ct_class.size), has_st=bool(batch.st_class.size))
         a = np.asarray(assignment)
     return a, time.perf_counter() - t0
 

@@ -188,3 +188,76 @@ class PodDisruptionBudget:
         return {"apiVersion": "policy/v1", "kind": "PodDisruptionBudget",
                 "metadata": self.metadata.to_dict(), "spec": sp,
                 "status": {"disruptionsAllowed": self.disruptions_allowed}}
+
+
+@dataclass
+class PriorityClass:
+    """scheduling.k8s.io/v1 PriorityClass (reference:
+    staging/src/k8s.io/api/scheduling/v1/types.go): named priority values the
+    Priority admission plugin resolves into pod.spec.priority."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    value: int = 0
+    global_default: bool = False
+    preemption_policy: str = "PreemptLowerPriority"  # or "Never"
+    description: str = ""
+
+    kind = "PriorityClass"
+
+    def __post_init__(self):
+        self.metadata.namespace = ""  # cluster-scoped
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "PriorityClass":
+        return PriorityClass(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            value=int(d.get("value", 0) or 0),
+            global_default=bool(d.get("globalDefault", False)),
+            preemption_policy=d.get("preemptionPolicy", "PreemptLowerPriority"),
+            description=d.get("description", ""),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind, "apiVersion": "scheduling.k8s.io/v1",
+            "metadata": self.metadata.to_dict(), "value": self.value,
+            **({"globalDefault": True} if self.global_default else {}),
+            **({"preemptionPolicy": self.preemption_policy}
+               if self.preemption_policy != "PreemptLowerPriority" else {}),
+            **({"description": self.description} if self.description else {}),
+        }
+
+
+@dataclass
+class ServiceAccount:
+    """core/v1 ServiceAccount (identity for in-cluster workloads; the
+    serviceaccount admission plugin + controller pair keep a 'default' SA in
+    every namespace — reference: plugin/pkg/admission/serviceaccount,
+    pkg/controller/serviceaccount)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    secrets: list = field(default_factory=list)
+    automount_token: bool = True
+
+    kind = "ServiceAccount"
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "ServiceAccount":
+        return ServiceAccount(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            secrets=list(d.get("secrets") or []),
+            automount_token=bool(d.get("automountServiceAccountToken", True)),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind, "apiVersion": "v1",
+            "metadata": self.metadata.to_dict(),
+            **({"secrets": list(self.secrets)} if self.secrets else {}),
+            **({} if self.automount_token
+               else {"automountServiceAccountToken": False}),
+        }
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"

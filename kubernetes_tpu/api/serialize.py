@@ -31,7 +31,9 @@ from .policy import (
     HorizontalPodAutoscaler,
     LimitRange,
     PodDisruptionBudget,
+    PriorityClass,
     ResourceQuota,
+    ServiceAccount,
 )
 from .dra import DeviceClass, ResourceClaim, ResourceSlice
 from .storage import CSINode, PersistentVolume, PersistentVolumeClaim, StorageClass
@@ -66,6 +68,8 @@ KIND_TO_RESOURCE = {
     "LimitRange": "limitranges",
     "HorizontalPodAutoscaler": "horizontalpodautoscalers",
     "PodDisruptionBudget": "poddisruptionbudgets",
+    "PriorityClass": "priorityclasses",
+    "ServiceAccount": "serviceaccounts",
     "ResourceClaim": "resourceclaims",
     "ResourceSlice": "resourceslices",
     "DeviceClass": "deviceclasses",
@@ -91,12 +95,15 @@ RESOURCE_TO_TYPE = {
     "limitranges": LimitRange,
     "horizontalpodautoscalers": HorizontalPodAutoscaler,
     "poddisruptionbudgets": PodDisruptionBudget,
+    "priorityclasses": PriorityClass,
+    "serviceaccounts": ServiceAccount,
     "resourceclaims": ResourceClaim,
     "resourceslices": ResourceSlice,
     "deviceclasses": DeviceClass,
 }
 CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses",
-                  "csinodes", "resourceslices", "deviceclasses"}
+                  "csinodes", "resourceslices", "deviceclasses",
+                  "priorityclasses"}
 GROUP_PREFIX = {
     "pods": "/api/v1",
     "nodes": "/api/v1",
@@ -118,6 +125,8 @@ GROUP_PREFIX = {
     "limitranges": "/api/v1",
     "horizontalpodautoscalers": "/apis/autoscaling/v2",
     "poddisruptionbudgets": "/apis/policy/v1",
+    "priorityclasses": "/apis/scheduling.k8s.io/v1",
+    "serviceaccounts": "/api/v1",
     "resourceclaims": "/apis/resource.k8s.io/v1beta1",
     "resourceslices": "/apis/resource.k8s.io/v1beta1",
     "deviceclasses": "/apis/resource.k8s.io/v1beta1",
@@ -255,6 +264,8 @@ def pod_to_dict(pod: Pod) -> Dict:
             {"name": n, "resourceClaimName": rc}
             for n, rc in pod.spec.resource_claims
         ]
+    if pod.spec.service_account_name:
+        spec["serviceAccountName"] = pod.spec.service_account_name
     # non-default scalars must round-trip, or read-modify-write paths (PATCH,
     # apply) silently reset them to from_dict defaults
     if pod.spec.restart_policy != "Always":

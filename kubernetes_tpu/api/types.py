@@ -116,6 +116,7 @@ class Container:
     image: str = ""
     resources: Dict[str, Dict[str, Any]] = field(default_factory=dict)  # requests/limits
     ports: List[ContainerPort] = field(default_factory=list)
+    image_pull_policy: str = ""  # "", Always, IfNotPresent, Never
 
     @staticmethod
     def from_dict(d: Mapping) -> "Container":
@@ -123,6 +124,7 @@ class Container:
             name=d.get("name", ""),
             image=d.get("image", ""),
             resources=dict(d.get("resources") or {}),
+            image_pull_policy=d.get("imagePullPolicy", ""),
             ports=[
                 ContainerPort(
                     container_port=int(p["containerPort"]),
@@ -140,6 +142,8 @@ class Container:
             d["image"] = self.image
         if self.resources:
             d["resources"] = self.resources
+        if self.image_pull_policy:
+            d["imagePullPolicy"] = self.image_pull_policy
         if self.ports:
             d["ports"] = [
                 {
@@ -399,6 +403,7 @@ class PodSpec:
     # DRA (core/v1 PodSpec.ResourceClaims): [(claim ref name, ResourceClaim
     # object name)] — reference: PodResourceClaim, core/v1/types.go
     resource_claims: List[Tuple[str, str]] = field(default_factory=list)
+    service_account_name: str = ""
 
     @staticmethod
     def from_dict(d: Mapping) -> "PodSpec":
@@ -427,6 +432,7 @@ class PodSpec:
                 (rc.get("name", ""), rc.get("resourceClaimName", ""))
                 for rc in d.get("resourceClaims") or []
             ],
+            service_account_name=d.get("serviceAccountName", ""),
         )
 
 

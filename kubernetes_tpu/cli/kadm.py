@@ -1,0 +1,251 @@
+"""kadm — cluster bootstrap CLI (the kubeadm analog, L10).
+
+reference: cmd/kubeadm (init/join/token flows — the lifecycle surface, not the
+code). `kadm init` stands up a control plane: API server (optionally secured
+with a generated bootstrap token), leader-elected scheduler + controller
+bundle. `kadm join` attaches a (hollow) node over HTTP: registers the Node,
+renews its Lease, and runs a minimal remote kubelet loop that watches for
+bound pods and reports them Running — the kubemark-style join that exercises
+the full client surface instead of in-process store access.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import secrets
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from ..server.client import APIError, RESTClient
+
+
+class InitResult:
+    """Handle onto an init-ed control plane (library surface for tests/embeds)."""
+
+    def __init__(self, server, control_plane, token: Optional[str], store):
+        self.server = server
+        self.control_plane = control_plane
+        self.token = token
+        self.store = store
+
+    @property
+    def url(self) -> str:
+        return self.server.url
+
+    def wait_ready(self, timeout: float = 30.0) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.control_plane.is_leader:
+                return True
+            time.sleep(0.02)
+        return self.control_plane.is_leader
+
+    def stop(self) -> None:
+        self.control_plane.stop()
+        self.server.stop()
+
+
+def init_control_plane(port: int = 0, secure: bool = False,
+                       identity: str = "kadm-0",
+                       use_batch_scheduler: bool = True) -> InitResult:
+    """kubeadm init equivalent: store + apiserver (+ bootstrap token RBAC when
+    secure) + leader-elected control plane."""
+    from ..server.auth import TokenAuthenticator, default_component_authorizer
+    from ..server.controlplane import ControlPlane
+    from ..server.rest import APIServer
+    from ..store import APIStore
+
+    store = APIStore()
+    token = None
+    authn = authz = None
+    if secure:
+        token = secrets.token_urlsafe(16)
+        authn = TokenAuthenticator()
+        # the bootstrap token is cluster-admin, like kubeadm's initial
+        # admin.conf credential
+        authn.add(token, "kubernetes-admin", ["system:masters"])
+        authz = default_component_authorizer()
+    server = APIServer(store, port=port, authenticator=authn,
+                       authorizer=authz).start()
+    cp = ControlPlane(store, identity=identity,
+                      use_batch_scheduler=use_batch_scheduler).start()
+    return InitResult(server, cp, token, store)
+
+
+class JoinedNode:
+    """A node joined over HTTP: Node object + Lease heartbeats + a fake
+    remote kubelet (bound pods get phase Running; deletes observed)."""
+
+    def __init__(self, client: RESTClient, node_name: str,
+                 capacity: Dict[str, str], heartbeat: float = 2.0):
+        self.client = client
+        self.node_name = node_name
+        self.capacity = dict(capacity)
+        self.heartbeat = heartbeat
+        self.running: Dict[str, dict] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self) -> None:
+        try:
+            self.client.create("nodes", {
+                "kind": "Node",
+                "metadata": {"name": self.node_name,
+                             "labels": {"kubernetes.io/hostname": self.node_name}},
+                "status": {"capacity": self.capacity,
+                           "allocatable": self.capacity},
+            })
+        except APIError as e:
+            if e.code != 409:
+                raise
+        self._renew_lease()
+
+    def _renew_lease(self) -> None:
+        now = time.time()
+        body = {"kind": "Lease",
+                "metadata": {"name": self.node_name, "namespace": "kube-node-lease"},
+                "spec": {"holderIdentity": self.node_name,
+                         "acquireTime": now, "renewTime": now}}
+        try:
+            cur = self.client.get("leases", self.node_name, "kube-node-lease")
+            body["metadata"]["resourceVersion"] = cur["metadata"]["resourceVersion"]
+            self.client.update("leases", body, "kube-node-lease")
+        except APIError as e:
+            if e.code == 404:
+                try:
+                    self.client.create("leases", body, "kube-node-lease")
+                except APIError as e2:
+                    if e2.code != 409:
+                        raise
+            else:
+                raise
+
+    def sync_once(self) -> int:
+        """One kubelet-ish pass: adopt bound pods, report them Running."""
+        n = 0
+        pods, _ = self.client.list("pods")
+        seen = set()
+        for p in pods:
+            spec = p.get("spec") or {}
+            if spec.get("nodeName") != self.node_name:
+                continue
+            key = f"{p['metadata'].get('namespace', 'default')}/{p['metadata']['name']}"
+            seen.add(key)
+            phase = (p.get("status") or {}).get("phase")
+            if key not in self.running and phase not in ("Succeeded", "Failed"):
+                self.running[key] = p
+                if phase != "Running":
+                    p.setdefault("status", {})["phase"] = "Running"
+                    try:
+                        self.client.update("pods", p,
+                                           p["metadata"].get("namespace", "default"))
+                        n += 1
+                    except APIError:
+                        pass
+        for key in list(self.running):
+            if key not in seen:
+                self.running.pop(key, None)
+        return n
+
+    def start(self) -> "JoinedNode":
+        self.register()
+
+        def loop():
+            last_hb = 0.0
+            while not self._stop.is_set():
+                try:
+                    if time.time() - last_hb >= self.heartbeat:
+                        self._renew_lease()
+                        last_hb = time.time()
+                    self.sync_once()
+                except Exception:
+                    pass
+                self._stop.wait(0.2)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+def join_node(server_url: str, node_name: str,
+              capacity: Optional[Dict[str, str]] = None,
+              token: Optional[str] = None) -> JoinedNode:
+    """kubeadm join equivalent (library surface)."""
+    client = RESTClient(server_url, token=token)
+    return JoinedNode(client, node_name,
+                      capacity or {"cpu": "8", "memory": "16Gi", "pods": "110"}
+                      ).start()
+
+
+# -- CLI -----------------------------------------------------------------------
+
+
+def cmd_init(args) -> int:
+    res = init_control_plane(port=args.port, secure=args.secure)
+    if not res.wait_ready(30):
+        print("error: control plane did not become leader", file=sys.stderr)
+        return 1
+    print(f"control plane ready at {res.url}")
+    if res.token:
+        print(f"join token: {res.token}")
+        if args.token_file:
+            with open(args.token_file, "w") as f:
+                f.write(res.token)
+    print(f"join nodes with: kadm join --server {res.url} --node-name <name>"
+          + (" --token <token>" if res.token else ""))
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        res.stop()
+    return 0
+
+
+def cmd_join(args) -> int:
+    node = join_node(args.server, args.node_name,
+                     capacity={"cpu": args.cpu, "memory": args.memory,
+                               "pods": str(args.max_pods)},
+                     token=args.token or None)
+    print(f"node {args.node_name} joined {args.server}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        node.stop()
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="kadm")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("init")
+    p.add_argument("--port", type=int, default=18080)
+    p.add_argument("--secure", action="store_true")
+    p.add_argument("--token-file", default="")
+    p.set_defaults(fn=cmd_init)
+
+    p = sub.add_parser("join")
+    p.add_argument("--server", required=True)
+    p.add_argument("--node-name", required=True)
+    p.add_argument("--token", default=os.environ.get("KADM_TOKEN", ""))
+    p.add_argument("--cpu", default="8")
+    p.add_argument("--memory", default="16Gi")
+    p.add_argument("--max-pods", type=int, default=110)
+    p.set_defaults(fn=cmd_join)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

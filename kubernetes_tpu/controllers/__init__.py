@@ -12,5 +12,9 @@ from .node_lifecycle import NodeLifecycleController  # noqa: F401
 from .podautoscaler import HorizontalPodAutoscalerController  # noqa: F401
 from .replicaset import ReplicaSetController  # noqa: F401
 from .resourcequota import ResourceQuotaController  # noqa: F401
+from .serviceaccount import (  # noqa: F401
+    ServiceAccountController,
+    TTLAfterFinishedController,
+)
 from .statefulset import StatefulSetController  # noqa: F401
 from .tainteviction import TaintEvictionController  # noqa: F401

@@ -97,6 +97,9 @@ class JobController(Controller):
             if obj.status.start_time is None and not job.spec.suspend:
                 obj.status.start_time = self.clock.now()
             if condition is not None and not obj.status.conditions:
+                # both terminal conditions carry a transition time — the TTL
+                # controller counts ttlSecondsAfterFinished from it
+                condition["lastTransitionTime"] = self.clock.now()
                 obj.status.conditions = [condition]
                 if condition["type"] == "Complete":
                     obj.status.completion_time = self.clock.now()

@@ -19,7 +19,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..snapshot.tensorizer import build_cluster_tensors, build_pod_batch
+from ..snapshot.tensorizer import TensorCache, build_cluster_tensors, build_pod_batch
 from ..store import APIStore
 from .framework import Status
 from .queue import QueuedPodInfo
@@ -41,6 +41,8 @@ class BatchScheduler(Scheduler):
         self.solver = solver
         self.batches_solved = 0
         self.transport_state = None  # warm duals carried across batches
+        # generation-diff incremental tensorization (cache.go:186 analog)
+        self._tensor_cache = TensorCache()
         # Bind pipelining (schedule_one.go:120-132 bindingCycle-in-goroutine
         # analog): assume_pod runs synchronously so the next solve's snapshot
         # sees the capacity, while the store.bind writes flush on a worker
@@ -71,11 +73,12 @@ class BatchScheduler(Scheduler):
                 self._handle_failure(qp, Status.unschedulable("no nodes available to schedule pods"))
             return len(qps)
 
-        cluster = build_cluster_tensors(snapshot)
+        cluster, changed_nodes = self._tensor_cache.cluster_tensors(snapshot)
         pods = [qp.pod for qp in qps]
         batch = build_pod_batch(
             pods, snapshot, cluster, ns_labels=self._ns_labels,
-            hard_pod_affinity_weight=self._hard_pod_affinity_weight())
+            hard_pod_affinity_weight=self._hard_pod_affinity_weight(),
+            reuse=self._tensor_cache, changed_nodes=changed_nodes)
 
         fallback_mask = batch.fallback_class[batch.class_of_pod]
         device_idx = np.nonzero(~fallback_mask)[0]
@@ -115,7 +118,12 @@ class BatchScheduler(Scheduler):
 
                 assignment = waterfill_solve(inputs, make_groups(sub))
             if assignment is None:
-                assignment, _, _ = greedy_scan_solve(inputs, d_max)
+                # static gates: constraint-free batches compile the scan
+                # variant without IPA gathers / PTS segment sums
+                assignment, _, _ = greedy_scan_solve(
+                    inputs, d_max, has_ipa=bool(batch.ipa.has_any),
+                    has_ct=bool(batch.ct_class.size),
+                    has_st=bool(batch.st_class.size))
             assignment = np.asarray(assignment)
             # Two phases: bind every device assignment FIRST, then handle the
             # rejected pods. Handling mid-loop would see capacity still

@@ -248,6 +248,174 @@ class NodeRestriction(AdmissionPlugin):
                 f"node {node_name!r} may only write pods bound to itself")
 
 
+class PriorityAdmission(AdmissionPlugin):
+    """Resolves pod.spec.priorityClassName into spec.priority and
+    spec.preemptionPolicy (plugin/pkg/admission/priority): unknown class
+    names are rejected; a globalDefault class applies to pods that name none;
+    the system- prefix is reserved."""
+
+    name = "Priority"
+    SYSTEM_CLASSES = {
+        "system-cluster-critical": 2_000_000_000,
+        "system-node-critical": 2_000_001_000,
+    }
+
+    def admit(self, store, resource, operation, obj, user="") -> None:
+        if resource != "pods" or operation != CREATE:
+            return
+        name = obj.spec.priority_class_name
+        if not name:
+            # the class value is AUTHORITATIVE: a client-supplied
+            # spec.priority is always overwritten (0 without a default class)
+            # — otherwise any tenant could self-assign system priority
+            classes, _ = store.list("priorityclasses", lambda c: c.global_default)
+            if classes:
+                # ties between multiple globalDefault classes resolve to the
+                # highest value (priority plugin getDefaultPriority)
+                default = max(classes, key=lambda c: c.value)
+                obj.spec.priority_class_name = default.metadata.name
+                obj.spec.priority = default.value
+                obj.spec.preemption_policy = default.preemption_policy
+            else:
+                obj.spec.priority = 0
+            return
+        if name in self.SYSTEM_CLASSES:
+            # system classes are reserved for kube-system workloads
+            if obj.metadata.namespace != "kube-system":
+                raise AdmissionError(
+                    f"pods with {name} priorityClass may only be created in "
+                    "the kube-system namespace")
+            obj.spec.priority = self.SYSTEM_CLASSES[name]
+            return
+        try:
+            pc = store.get("priorityclasses", name)
+        except NotFoundError:
+            raise AdmissionError(f"no PriorityClass with name {name!r} was found",
+                                 code=400, reason="Invalid")
+        obj.spec.priority = pc.value
+        obj.spec.preemption_policy = pc.preemption_policy
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        if resource == "pods" and operation == UPDATE:
+            # pod priority is immutable after create (api validation in the
+            # reference); without this a PUT could self-assign system priority
+            try:
+                existing = store.get("pods", obj.key)
+            except NotFoundError:
+                return
+            if (obj.spec.priority != existing.spec.priority
+                    or obj.spec.priority_class_name != existing.spec.priority_class_name):
+                raise AdmissionError(
+                    "pod updates may not change priority or priorityClassName",
+                    code=422, reason="Invalid")
+            return
+        if resource != "priorityclasses" or operation != CREATE:
+            return
+        if obj.metadata.name.startswith("system-") \
+                and obj.metadata.name not in self.SYSTEM_CLASSES:
+            raise AdmissionError(
+                "the system- prefix is reserved for system priority classes")
+
+
+class DefaultTolerationSeconds(AdmissionPlugin):
+    """Adds the 300s not-ready/unreachable NoExecute tolerations every pod
+    gets (plugin/pkg/admission/defaulttolerationseconds) so taint eviction has
+    the standard grace period."""
+
+    name = "DefaultTolerationSeconds"
+    SECONDS = 300
+    KEYS = ("node.kubernetes.io/not-ready", "node.kubernetes.io/unreachable")
+
+    def admit(self, store, resource, operation, obj, user="") -> None:
+        if resource != "pods" or operation != CREATE:
+            return
+        from ..api.types import Taint
+
+        for key in self.KEYS:
+            # skip only when an existing toleration ACTUALLY tolerates the
+            # taint (ToleratesTaint in the reference plugin) — a key-equal
+            # toleration with a non-matching value would not
+            taint = Taint(key=key, effect="NoExecute")
+            if any(t.tolerates(taint) for t in obj.spec.tolerations):
+                continue
+            obj.spec.tolerations.append(Toleration(
+                key=key, operator="Exists", effect="NoExecute",
+                toleration_seconds=self.SECONDS))
+
+
+class DefaultStorageClass(AdmissionPlugin):
+    """PVCs without a storageClassName get the cluster default class
+    (plugin/pkg/admission/storage/storageclass/setdefault)."""
+
+    name = "DefaultStorageClass"
+
+    def admit(self, store, resource, operation, obj, user="") -> None:
+        if resource != "persistentvolumeclaims" or operation != CREATE:
+            return
+        # None = "use the default class"; an EXPLICIT "" requests classless
+        # static binding and must not be overwritten (setdefault plugin only
+        # defaults when the field is nil)
+        if obj.spec.storage_class_name is not None:
+            return
+        classes, _ = store.list("storageclasses", lambda c: c.is_default)
+        if classes:
+            # several defaults: newest creationTimestamp wins (setdefault
+            # plugin tie-break)
+            newest = max(classes, key=lambda c: c.metadata.creation_timestamp)
+            obj.spec.storage_class_name = newest.metadata.name
+
+
+class AlwaysPullImages(AdmissionPlugin):
+    """Forces imagePullPolicy=Always (plugin/pkg/admission/alwayspullimages —
+    multi-tenant image-credential protection). NOT in the default chain, like
+    the reference; opt in via AdmissionChain([...])."""
+
+    name = "AlwaysPullImages"
+
+    def admit(self, store, resource, operation, obj, user="") -> None:
+        if resource != "pods" or operation != CREATE:
+            return
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            c.image_pull_policy = "Always"
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        if resource != "pods" or operation != CREATE:
+            return
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            if c.image_pull_policy != "Always":
+                raise AdmissionError(
+                    f"container {c.name!r} must have imagePullPolicy Always")
+
+
+class ServiceAccountAdmission(AdmissionPlugin):
+    """Defaults pod.spec.serviceAccountName to 'default' and requires an
+    explicitly named non-default SA to exist
+    (plugin/pkg/admission/serviceaccount). The implicit 'default' SA is not
+    required to exist yet — the serviceaccount controller creates it
+    asynchronously, same bootstrap tolerance as the reference's retry loop."""
+
+    name = "ServiceAccount"
+
+    def admit(self, store, resource, operation, obj, user="") -> None:
+        if resource != "pods" or operation != CREATE:
+            return
+        if not obj.spec.service_account_name:
+            obj.spec.service_account_name = "default"
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        if resource != "pods" or operation != CREATE:
+            return
+        name = obj.spec.service_account_name
+        if name in ("", "default"):
+            return
+        try:
+            store.get("serviceaccounts", f"{obj.metadata.namespace}/{name}")
+        except NotFoundError:
+            raise AdmissionError(
+                f"service account {obj.metadata.namespace}/{name} was not found",
+                code=403, reason="Forbidden")
+
+
 class AdmissionChain:
     """All mutators in order, then all validators (apiserver/pkg/admission
     chainAdmissionHandler)."""
@@ -265,12 +433,16 @@ class AdmissionChain:
 
 def default_admission_chain() -> AdmissionChain:
     """The default plugin set, in the reference's recommended order
-    (kubeapiserver/options/plugins.go)."""
+    (kubeapiserver/options/plugins.go — ResourceQuota last)."""
     return AdmissionChain([
         MetadataDefaulter(),
         NamespaceLifecycle(),
         LimitRanger(),
+        ServiceAccountAdmission(),
         PodTolerationRestriction(),
+        PriorityAdmission(),
+        DefaultTolerationSeconds(),
+        DefaultStorageClass(),
         NodeRestriction(),
         ResourceQuotaAdmission(),
     ])

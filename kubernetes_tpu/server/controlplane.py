@@ -20,6 +20,7 @@ DEFAULT_CONTROLLERS = (
     "deployment", "replicaset", "statefulset", "daemonset", "job", "cronjob",
     "disruption", "nodelifecycle", "tainteviction", "endpointslice",
     "namespace", "garbagecollector", "resourcequota", "horizontalpodautoscaler",
+    "serviceaccount", "ttlafterfinished",
 )
 
 
@@ -37,11 +38,15 @@ def _controller_registry():
         NodeLifecycleController,
         ReplicaSetController,
         ResourceQuotaController,
+        ServiceAccountController,
         StatefulSetController,
         TaintEvictionController,
+        TTLAfterFinishedController,
     )
 
     return {
+        "serviceaccount": ServiceAccountController,
+        "ttlafterfinished": TTLAfterFinishedController,
         "deployment": DeploymentController,
         "replicaset": ReplicaSetController,
         "statefulset": StatefulSetController,

@@ -122,6 +122,105 @@ class PodBatchTensors:
         return len(self.tables.rep_pods)
 
 
+class TensorCache:
+    """Cross-batch incremental tensorization (VERDICT r3 #2; reference:
+    cache.go:186 UpdateSnapshot's generation diff).
+
+    `Cache.update_snapshot` reuses the SAME NodeInfo object for nodes whose
+    generation didn't change, so identity comparison against the previous
+    snapshot is exactly the generation diff. This cache exploits it twice:
+
+      cluster rows   — alloc/used/used_nz/pod_count/max_pods/port rows are
+                       recomputed only for changed nodes (same node set);
+      count columns  — the PTS/IPA per-(selector-class, node) count tensor is
+                       recomputed only for changed nodes when the batch
+                       registers the same selector classes AND the namespace
+                       label table is unchanged (IPA namespaceSelector
+                       matchers resolve against it live). IPA holder-group
+                       counts are NOT incremental — compile_ipa rebuilds them
+                       per batch (group registration dominates there anyway).
+
+    Anything structural (node set/order, label/taint/image/vocab changes,
+    different class registry, namespace relabels) falls back to a full
+    rebuild — correctness first, the fast path is an optimization the parity
+    tests pin."""
+
+    def __init__(self):
+        self.snap: Optional[Snapshot] = None
+        self.node_infos: Optional[list] = None  # aligned NodeInfo identities
+        self.cluster: Optional[ClusterTensors] = None
+        # batch-level artifacts for count-column reuse
+        self.selcls_keys: Optional[tuple] = None
+        self.selcls_count: Optional[np.ndarray] = None
+        self.ns_fingerprint: Optional[tuple] = None
+
+    # -- cluster tensors -------------------------------------------------------
+
+    def cluster_tensors(self, snapshot: Snapshot) -> Tuple[ClusterTensors, Optional[List[int]]]:
+        """Returns (cluster, changed_node_indices). changed is None on a full
+        rebuild (meaning: treat every node as changed)."""
+        nis = snapshot.node_info_list
+        prev_nis = self.node_infos
+        if (self.cluster is None or prev_nis is None or len(prev_nis) != len(nis)):
+            return self._full(snapshot)
+        changed = [i for i in range(len(nis)) if nis[i] is not prev_nis[i]]
+        cluster = self.cluster
+        for i in changed:
+            ni, old = nis[i], prev_nis[i]
+            if (ni.node is None or old.node is None
+                    or ni.node.metadata.name != cluster.node_names[i]
+                    or ni.node.metadata.labels != old.node.metadata.labels
+                    or ni.node.spec.taints != old.node.spec.taints
+                    or ni.node.spec.unschedulable != old.node.spec.unschedulable
+                    or ni.image_states.keys() != old.image_states.keys()):
+                # label-churn batches COULD be patched in place, but vocab
+                # growth / topo-id rewrites make it structural: full rebuild
+                return self._full(snapshot)
+        if not changed:
+            self.snap = snapshot
+            self.node_infos = list(nis)
+            return cluster, []
+        dims = cluster.resource_dims
+        for i in changed:
+            ni = nis[i]
+            if set(ni.allocatable.scalar.keys()) - set(dims):
+                return self._full(snapshot)  # new extended resource dim
+            cluster.alloc[i] = np.array(
+                _quantize(ni.allocatable, dims, is_request=False), dtype=np.int32)
+            cluster.used[i] = np.array(
+                _quantize(ni.requested, dims, is_request=True), dtype=np.int32)
+            cluster.used_nz[i] = np.array(
+                _quantize(ni.non_zero_requested, dims, is_request=True), dtype=np.int32)
+            cluster.pod_count[i] = len(ni.pods)
+            cluster.max_pods[i] = ni.allocatable.allowed_pod_number
+        # port usage rows (NodeColumns caches them for class table compile)
+        cols = cluster.cols
+        for i in changed:
+            cols.node_infos[i] = nis[i]
+            row = np.zeros(cols.port_matrix.shape[1], dtype=bool)
+            ok = True
+            for (_ip, proto, port) in nis[i].used_ports:
+                pi = cols.port_vocab.get((proto, port))
+                if pi is None:
+                    ok = False  # new port vocab entry: structural
+                    break
+                row[pi] = True
+            if not ok:
+                return self._full(snapshot)
+            cols.port_matrix[i] = row
+        self.snap = snapshot
+        self.node_infos = list(nis)
+        return cluster, changed
+
+    def _full(self, snapshot: Snapshot) -> Tuple[ClusterTensors, None]:
+        self.cluster = build_cluster_tensors(snapshot)
+        self.snap = snapshot
+        self.node_infos = list(snapshot.node_info_list)
+        self.selcls_keys = self.selcls_count = None
+        self.ns_fingerprint = None
+        return self.cluster, None
+
+
 def build_cluster_tensors(snapshot: Snapshot, extra_resource_dims: Sequence[str] = ()) -> ClusterTensors:
     node_infos = snapshot.node_info_list
     n = len(node_infos)
@@ -163,8 +262,15 @@ def build_cluster_tensors(snapshot: Snapshot, extra_resource_dims: Sequence[str]
 
 def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
                     cluster: ClusterTensors, ns_labels=None,
-                    hard_pod_affinity_weight: int = 1) -> PodBatchTensors:
-    """Group pods into classes, compile class tables, build PTS + IPA tensors."""
+                    hard_pod_affinity_weight: int = 1,
+                    reuse: Optional[TensorCache] = None,
+                    changed_nodes: Optional[List[int]] = None) -> PodBatchTensors:
+    """Group pods into classes, compile class tables, build PTS + IPA tensors.
+
+    reuse + changed_nodes (from TensorCache.cluster_tensors) enable the
+    incremental count path: when this batch registers the same selector
+    classes as the previous one, per-node match counts are recomputed only
+    for changed nodes instead of scanning every bound pod."""
     ns_labels = ns_labels or {}
     sig_to_class: Dict[tuple, int] = {}
     rep_pods: List[Pod] = []
@@ -294,13 +400,40 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
 
     # existing matching-pod counts per (selector-class, node)
     sc = len(selcls_matchers)
-    selcls_count = np.zeros((sc, cluster.n), dtype=np.int32)
-    for nidx, ni in enumerate(snapshot.node_info_list):
+    selcls_key_tuple = tuple(selcls_idx.keys())
+
+    def _count_node_column(ni) -> np.ndarray:
+        col = np.zeros(sc, dtype=np.int32)
         for pinfo in ni.pods:
             p = pinfo.pod
             for si, matcher in enumerate(selcls_matchers):
                 if matcher(p):
-                    selcls_count[si, nidx] += 1
+                    col[si] += 1
+        return col
+
+    # IPA namespaceSelector matchers resolve against the live ns_labels
+    # table, which the selector-class keys do NOT capture — a namespace
+    # relabel must invalidate cached counts
+    ns_fp = tuple(sorted(
+        (ns, tuple(sorted(lbls.items()))) for ns, lbls in ns_labels.items()))
+    if (reuse is not None and changed_nodes is not None
+            and reuse.selcls_keys == selcls_key_tuple
+            and reuse.ns_fingerprint == ns_fp
+            and reuse.selcls_count is not None
+            and reuse.selcls_count.shape == (sc, cluster.n)):
+        # incremental: only changed nodes rescan their pods
+        selcls_count = reuse.selcls_count
+        for nidx in changed_nodes:
+            selcls_count[:, nidx] = _count_node_column(
+                snapshot.node_info_list[nidx])
+    else:
+        selcls_count = np.zeros((sc, cluster.n), dtype=np.int32)
+        for nidx, ni in enumerate(snapshot.node_info_list):
+            selcls_count[:, nidx] = _count_node_column(ni)
+    if reuse is not None:
+        reuse.selcls_keys = selcls_key_tuple
+        reuse.selcls_count = selcls_count
+        reuse.ns_fingerprint = ns_fp
     cluster.selcls_count = selcls_count
 
     # cross-match: placing a pod of class c bumps counts of selector-class sc?

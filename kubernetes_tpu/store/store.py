@@ -62,6 +62,50 @@ class AlreadyBoundError(Exception):
     pass
 
 
+class MutationDetectedError(Exception):
+    """A watch consumer mutated an event object (client-go's cache mutation
+    detector failure: informer objects are shared and must be read-only)."""
+
+
+class MutationDetector:
+    """Fingerprints emitted event objects and detects later mutation.
+
+    reference: client-go tools/cache/mutation_detector.go — enabled by env
+    (KUBE_CACHE_MUTATION_DETECTOR); here: APIStore(mutation_detector=True) or
+    env CACHE_MUTATION_DETECTOR=true, then call store.check_mutations() (the
+    test tier does this at teardown)."""
+
+    LIMIT = 5_000
+
+    def __init__(self):
+        self._entries = []  # (event, fingerprint json)
+
+    @staticmethod
+    def _fingerprint(obj) -> str:
+        import json as _json
+
+        from ..api.serialize import to_dict
+
+        try:
+            return _json.dumps(to_dict(obj), sort_keys=True, default=repr)
+        except Exception:
+            return repr(obj)
+
+    def record(self, ev: "Event") -> None:
+        self._entries.append((ev, self._fingerprint(ev.obj)))
+        if len(self._entries) > self.LIMIT:
+            del self._entries[: self.LIMIT // 4]
+
+    def check(self) -> None:
+        for ev, fp in self._entries:
+            now = self._fingerprint(ev.obj)
+            if now != fp:
+                raise MutationDetectedError(
+                    f"{ev.type} {ev.kind} event object at rv "
+                    f"{ev.resource_version} was mutated after emission:\n"
+                    f"was: {fp}\nnow: {now}")
+
+
 def _pod_structural_clone(pod):
     """Fast pod clone for the bind/status hot paths: fresh Pod, ObjectMeta
     (with own labels/annotations/owner_references/finalizers containers),
@@ -162,9 +206,16 @@ class Watch:
 class APIStore:
     """The hub every component is a client of (SURVEY.md §1)."""
 
-    def __init__(self, deep_copy_on_write: bool = True):
+    def __init__(self, deep_copy_on_write: bool = True,
+                 mutation_detector: Optional[bool] = None):
+        import os
+
         self._lock = threading.RLock()
         self._rv = 0  # monotonic resourceVersion, read via .rv
+        if mutation_detector is None:
+            mutation_detector = os.environ.get(
+                "CACHE_MUTATION_DETECTOR", "").lower() in ("1", "true")
+        self._mutation_detector = MutationDetector() if mutation_detector else None
         # kind -> {"namespace/name" or "name": obj}
         self._objects: Dict[str, Dict[str, Any]] = {}
         # bounded event history for watch replay (RV-ordered)
@@ -198,10 +249,18 @@ class APIStore:
         # able to corrupt store state. One copy per write, shared by watchers.
         self._emit_prepared(etype, kind, self._copy(obj))
 
+    def check_mutations(self) -> None:
+        """Raise MutationDetectedError if any watcher mutated an event object
+        (no-op unless the detector is enabled)."""
+        if self._mutation_detector is not None:
+            self._mutation_detector.check()
+
     def _emit_prepared(self, etype: str, kind: str, obj) -> None:
         """Emit an event whose object is ALREADY private to the event (hot
         write paths pre-clone instead of paying a second deepcopy here)."""
         ev = Event(etype, kind, obj, self._rv)
+        if self._mutation_detector is not None:
+            self._mutation_detector.record(ev)
         self._history.append(ev)
         if len(self._history) > self._history_limit:
             drop = self._history_limit // 4

@@ -1,0 +1,221 @@
+"""kadm bootstrap (kubeadm analog) + round-4 admission/controller breadth.
+
+reference: cmd/kubeadm init/join lifecycle, plugin/pkg/admission/{priority,
+defaulttolerationseconds,storage/storageclass,serviceaccount,alwayspullimages},
+pkg/controller/{serviceaccount,ttlafterfinished}.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.policy import PriorityClass, ServiceAccount
+from kubernetes_tpu.api.types import ObjectMeta
+from kubernetes_tpu.cli.kadm import init_control_plane, join_node
+from kubernetes_tpu.server.admission import (
+    AdmissionChain,
+    AdmissionError,
+    AlwaysPullImages,
+    default_admission_chain,
+)
+from kubernetes_tpu.server.client import APIError, RESTClient
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def _wait(pred, timeout=10.0, step=0.05):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+class TestKadmBootstrap:
+    def test_init_join_schedule_run(self):
+        """Full lifecycle over HTTP: init control plane, join two nodes,
+        create a pod via the API, see it scheduled AND reported Running by
+        the joined node's remote kubelet loop."""
+        res = init_control_plane(use_batch_scheduler=False)
+        nodes = []
+        try:
+            assert res.wait_ready(30)
+            client = RESTClient(res.url)
+            nodes = [join_node(res.url, f"jn{i}") for i in range(2)]
+            assert _wait(lambda: len(client.list("nodes")[0]) == 2)
+            client.create("pods", {
+                "kind": "Pod",
+                "metadata": {"name": "web", "namespace": "default"},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": "500m"}}}]},
+            })
+
+            def running():
+                p = client.get("pods", "web", "default")
+                return (p["spec"].get("nodeName", "") != ""
+                        and p["status"]["phase"] == "Running")
+
+            assert _wait(running, 15), client.get("pods", "web", "default")
+        finally:
+            for n in nodes:
+                n.stop()
+            res.stop()
+
+    def test_secure_init_requires_token(self):
+        res = init_control_plane(secure=True, use_batch_scheduler=False)
+        try:
+            assert res.token
+            with pytest.raises(APIError) as e:
+                RESTClient(res.url).list("pods")
+            assert e.value.code == 401
+            admin = RESTClient(res.url, token=res.token)
+            admin.list("pods")
+        finally:
+            res.stop()
+
+
+class TestAdmissionBreadth:
+    def _chain_run(self, store, pod, chain=None):
+        (chain or default_admission_chain()).run(store, "pods", "CREATE", pod)
+        return pod
+
+    def test_priority_class_resolution(self):
+        store = APIStore()
+        store.create("priorityclasses", PriorityClass(
+            metadata=ObjectMeta(name="high"), value=5000,
+            preemption_policy="Never"))
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        pod.spec.priority_class_name = "high"
+        self._chain_run(store, pod)
+        assert pod.spec.priority == 5000
+        assert pod.spec.preemption_policy == "Never"
+
+    def test_global_default_priority_class(self):
+        store = APIStore()
+        store.create("priorityclasses", PriorityClass(
+            metadata=ObjectMeta(name="base"), value=7, global_default=True))
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        self._chain_run(store, pod)
+        assert pod.spec.priority == 7
+        assert pod.spec.priority_class_name == "base"
+
+    def test_unknown_priority_class_rejected(self):
+        store = APIStore()
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        pod.spec.priority_class_name = "ghost"
+        with pytest.raises(AdmissionError):
+            self._chain_run(store, pod)
+
+    def test_system_priority_classes(self):
+        store = APIStore()
+        pod = MakePod("p", namespace="kube-system").req({"cpu": "1"}).obj()
+        pod.spec.priority_class_name = "system-node-critical"
+        self._chain_run(store, pod)
+        assert pod.spec.priority == 2_000_001_000
+        # reserved outside kube-system
+        outsider = MakePod("p2").req({"cpu": "1"}).obj()
+        outsider.spec.priority_class_name = "system-node-critical"
+        with pytest.raises(AdmissionError):
+            self._chain_run(store, outsider)
+
+    def test_client_supplied_priority_is_overwritten(self):
+        store = APIStore()
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        pod.spec.priority = 2_000_000_001  # escalation attempt
+        self._chain_run(store, pod)
+        assert pod.spec.priority == 0
+
+    def test_default_toleration_seconds(self):
+        store = APIStore()
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        self._chain_run(store, pod)
+        keys = {(t.key, t.toleration_seconds) for t in pod.spec.tolerations}
+        assert ("node.kubernetes.io/not-ready", 300) in keys
+        assert ("node.kubernetes.io/unreachable", 300) in keys
+
+    def test_default_storage_class(self):
+        from kubernetes_tpu.api.storage import PersistentVolumeClaim, StorageClass
+
+        store = APIStore()
+        store.create("storageclasses", StorageClass(
+            metadata=ObjectMeta(name="fast", namespace=""), is_default=True))
+        pvc = PersistentVolumeClaim.from_dict({
+            "metadata": {"name": "data", "namespace": "default"},
+            "spec": {"resources": {"requests": {"storage": "1Gi"}}}})
+        default_admission_chain().run(
+            store, "persistentvolumeclaims", "CREATE", pvc)
+        assert pvc.spec.storage_class_name == "fast"
+
+    def test_service_account_defaulting_and_validation(self):
+        store = APIStore()
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        self._chain_run(store, pod)
+        assert pod.spec.service_account_name == "default"
+
+        pod2 = MakePod("p2").req({"cpu": "1"}).obj()
+        pod2.spec.service_account_name = "builder"
+        with pytest.raises(AdmissionError):
+            self._chain_run(store, pod2)
+        store.create("serviceaccounts", ServiceAccount(
+            metadata=ObjectMeta(name="builder", namespace="default")))
+        self._chain_run(store, pod2)  # now admitted
+
+    def test_always_pull_images_opt_in(self):
+        store = APIStore()
+        chain = AdmissionChain([AlwaysPullImages()])
+        pod = MakePod("p").req({"cpu": "1"}, image="img:1").obj()
+        chain.run(store, "pods", "CREATE", pod)
+        assert pod.spec.containers[0].image_pull_policy == "Always"
+
+
+class TestNewControllers:
+    def test_service_account_controller_creates_defaults(self):
+        from kubernetes_tpu.api.types import Namespace
+        from kubernetes_tpu.controllers import ServiceAccountController
+
+        store = APIStore()
+        store.create("namespaces", Namespace(
+            metadata=ObjectMeta(name="team-a", namespace="")))
+        c = ServiceAccountController(store)
+        c.sync_all()
+        c.run_until_stable()
+        assert store.get("serviceaccounts", "team-a/default") is not None
+        assert store.get("serviceaccounts", "default/default") is not None
+
+    def test_ttl_after_finished_deletes_job(self):
+        from kubernetes_tpu.api.workloads import Job
+        from kubernetes_tpu.controllers import TTLAfterFinishedController
+        from kubernetes_tpu.utils import FakeClock
+
+        store = APIStore()
+        clock = FakeClock(start=1000.0)
+        job = Job.from_dict({
+            "metadata": {"name": "done", "namespace": "default"},
+            "spec": {"ttlSecondsAfterFinished": 60,
+                     "template": {"spec": {"containers": [{"name": "c"}]}}}})
+        job.status.conditions.append({"type": "Complete", "status": "True"})
+        job.status.completion_time = clock.now()
+        store.create("jobs", job)
+        c = TTLAfterFinishedController(store, clock=clock)
+        c.sync_all()
+        c.run_until_stable()
+        assert store.get("jobs", "default/done") is not None  # not yet
+        clock.step(61)
+        c.run_until_stable()
+        from kubernetes_tpu.store import NotFoundError
+
+        with pytest.raises(NotFoundError):
+            store.get("jobs", "default/done")
+
+    def test_mutation_detector_fires(self):
+        from kubernetes_tpu.store import MutationDetectedError
+
+        store = APIStore(mutation_detector=True)
+        w = store.watch("pods")
+        store.create("pods", MakePod("p").obj())
+        ev = w.drain()[0]
+        store.check_mutations()  # clean so far
+        ev.obj.metadata.labels["oops"] = "mutated"
+        with pytest.raises(MutationDetectedError):
+            store.check_mutations()

@@ -1,0 +1,137 @@
+"""Codec round-trip fuzzing — the test/fuzz analog (SURVEY.md §4).
+
+reference: test/fuzz roundtrip fuzzing of API codecs. Property: for every
+resource kind, from_dict(to_dict(obj)) == to_dict-stable — serializing a
+deserialized object again yields the identical wire form (the invariant the
+apiserver's codecs enforce; a lossy field here silently corrupts PATCH
+read-modify-write, which round 4's review actually caught by hand).
+"""
+
+import string
+
+from hypothesis import given, settings, strategies as st
+
+from kubernetes_tpu.api.serialize import from_dict, to_dict
+
+name_st = st.text(alphabet=string.ascii_lowercase + "-", min_size=1, max_size=12)
+label_key = st.text(alphabet=string.ascii_lowercase + ".-/", min_size=1, max_size=20)
+label_val = st.text(alphabet=string.ascii_lowercase + string.digits + "-", max_size=15)
+labels_st = st.dictionaries(label_key, label_val, max_size=4)
+qty_st = st.sampled_from(["100m", "1", "2", "500m", "1Gi", "256Mi", "2G", "0"])
+
+
+def meta_st():
+    return st.fixed_dictionaries(
+        {"name": name_st},
+        optional={"namespace": name_st, "labels": labels_st,
+                  "annotations": labels_st,
+                  "resourceVersion": st.integers(0, 10**6),
+                  "uid": name_st})
+
+
+container_st = st.fixed_dictionaries(
+    {"name": name_st},
+    optional={
+        "image": name_st,
+        "imagePullPolicy": st.sampled_from(["Always", "IfNotPresent", "Never"]),
+        "resources": st.fixed_dictionaries({}, optional={
+            "requests": st.dictionaries(
+                st.sampled_from(["cpu", "memory"]), qty_st, max_size=2),
+            "limits": st.dictionaries(
+                st.sampled_from(["cpu", "memory"]), qty_st, max_size=2)}),
+        "ports": st.lists(st.fixed_dictionaries(
+            {"containerPort": st.integers(1, 65535)},
+            optional={"hostPort": st.integers(1, 65535),
+                      "protocol": st.sampled_from(["TCP", "UDP"])}),
+            max_size=2),
+    })
+
+pod_st = st.fixed_dictionaries(
+    {"kind": st.just("Pod"), "metadata": meta_st(),
+     "spec": st.fixed_dictionaries(
+         {"containers": st.lists(container_st, min_size=1, max_size=2)},
+         optional={
+             "nodeName": name_st,
+             "nodeSelector": labels_st,
+             "priority": st.integers(-100, 10**6),
+             "priorityClassName": name_st,
+             "restartPolicy": st.sampled_from(["Always", "OnFailure", "Never"]),
+             "terminationGracePeriodSeconds": st.integers(0, 300),
+             "preemptionPolicy": st.sampled_from(
+                 ["PreemptLowerPriority", "Never"]),
+             "hostNetwork": st.booleans(),
+             "serviceAccountName": name_st,
+             "schedulingGates": st.lists(name_st, max_size=2),
+             "tolerations": st.lists(st.fixed_dictionaries(
+                 {"key": label_key},
+                 optional={"operator": st.sampled_from(["Exists", "Equal"]),
+                           "value": label_val,
+                           "effect": st.sampled_from(
+                               ["NoSchedule", "PreferNoSchedule", "NoExecute"]),
+                           "tolerationSeconds": st.integers(0, 3600)}),
+                 max_size=2),
+             "resourceClaims": st.lists(st.fixed_dictionaries(
+                 {"name": name_st, "resourceClaimName": name_st}), max_size=2),
+         })},
+)
+
+
+def _stable(resource: str, doc: dict) -> None:
+    """to_dict(from_dict(x)) must be a fixed point after one round."""
+    once = to_dict(from_dict(resource, doc))
+    twice = to_dict(from_dict(resource, once))
+    assert once == twice, f"{resource} round-trip not stable:\n{once}\nvs\n{twice}"
+
+
+@settings(max_examples=150, deadline=None)
+@given(pod_st)
+def test_pod_roundtrip_stable(doc):
+    _stable("pods", doc)
+
+
+node_st = st.fixed_dictionaries(
+    {"kind": st.just("Node"), "metadata": meta_st()},
+    optional={
+        "spec": st.fixed_dictionaries({}, optional={
+            "unschedulable": st.booleans(),
+            "taints": st.lists(st.fixed_dictionaries(
+                {"key": label_key, "effect": st.sampled_from(
+                    ["NoSchedule", "PreferNoSchedule", "NoExecute"])},
+                optional={"value": label_val}), max_size=2)}),
+        "status": st.fixed_dictionaries({}, optional={
+            "capacity": st.dictionaries(
+                st.sampled_from(["cpu", "memory", "pods"]), qty_st, max_size=3),
+            "allocatable": st.dictionaries(
+                st.sampled_from(["cpu", "memory", "pods"]), qty_st, max_size=3)}),
+    })
+
+
+@settings(max_examples=100, deadline=None)
+@given(node_st)
+def test_node_roundtrip_stable(doc):
+    _stable("nodes", doc)
+
+
+claim_st = st.fixed_dictionaries(
+    {"kind": st.just("ResourceClaim"), "metadata": meta_st(),
+     "spec": st.fixed_dictionaries({"devices": st.fixed_dictionaries({
+         "requests": st.lists(st.fixed_dictionaries(
+             {"name": name_st, "deviceClassName": name_st},
+             optional={"count": st.integers(1, 8)}), min_size=1, max_size=2)})})})
+
+
+@settings(max_examples=60, deadline=None)
+@given(claim_st)
+def test_resourceclaim_roundtrip_stable(doc):
+    _stable("resourceclaims", doc)
+
+
+@settings(max_examples=60, deadline=None)
+@given(st.fixed_dictionaries(
+    {"kind": st.just("PriorityClass"), "metadata": meta_st(),
+     "value": st.integers(-(10**9), 10**9)},
+    optional={"globalDefault": st.booleans(),
+              "preemptionPolicy": st.sampled_from(
+                  ["PreemptLowerPriority", "Never"])}))
+def test_priorityclass_roundtrip_stable(doc):
+    _stable("priorityclasses", doc)

@@ -188,8 +188,12 @@ class TestCLI:
 
 
 def test_serialization_roundtrip_via_server(server):
-    """Pod with every scheduling feature survives HTTP round-trip."""
+    """Pod with every scheduling feature survives HTTP round-trip. Priority
+    comes via a PriorityClass — the Priority admission plugin overrides any
+    client-set spec.priority (reference plugin behavior)."""
     client = RESTClient(server.url)
+    client.create("priorityclasses", {"kind": "PriorityClass",
+                                      "metadata": {"name": "p10"}, "value": 10})
     doc = {
         "kind": "Pod",
         "metadata": {"name": "full", "labels": {"app": "x"}},
@@ -210,7 +214,7 @@ def test_serialization_roundtrip_via_server(server):
             "topologySpreadConstraints": [{
                 "maxSkew": 1, "topologyKey": "zone", "whenUnsatisfiable": "DoNotSchedule",
                 "labelSelector": {"matchLabels": {"app": "x"}}}],
-            "priority": 10,
+            "priorityClassName": "p10",
         },
     }
     client.create("pods", doc)
