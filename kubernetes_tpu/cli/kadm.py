@@ -77,7 +77,9 @@ def init_control_plane(port: int = 0, secure: bool = False,
 
 class JoinedNode:
     """A node joined over HTTP: Node object + Lease heartbeats + a fake
-    remote kubelet (bound pods get phase Running; deletes observed)."""
+    remote kubelet (bound pods get phase Running; deletes observed). Pod
+    state arrives through a watching Informer, not per-tick LISTs — N joined
+    hollow nodes must not turn the apiserver into an O(N*P) list mill."""
 
     def __init__(self, client: RESTClient, node_name: str,
                  capacity: Dict[str, str], heartbeat: float = 2.0):
@@ -86,6 +88,7 @@ class JoinedNode:
         self.capacity = dict(capacity)
         self.heartbeat = heartbeat
         self.running: Dict[str, dict] = {}
+        self._informer = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -124,34 +127,44 @@ class JoinedNode:
                 raise
 
     def sync_once(self) -> int:
-        """One kubelet-ish pass: adopt bound pods, report them Running."""
+        """One kubelet-ish pass over the informer cache: adopt bound pods,
+        report them Running. Adoption happens only AFTER the status write
+        succeeds — a 409/422 must be retried on the next pass, not swallowed
+        into a forever-Pending pod."""
+        from ..api.serialize import to_dict
+
+        if self._informer is None:
+            return 0
         n = 0
-        pods, _ = self.client.list("pods")
         seen = set()
-        for p in pods:
-            spec = p.get("spec") or {}
-            if spec.get("nodeName") != self.node_name:
+        for key, pod in list(self._informer.cache.items()):
+            if pod.spec.node_name != self.node_name:
                 continue
-            key = f"{p['metadata'].get('namespace', 'default')}/{p['metadata']['name']}"
             seen.add(key)
-            phase = (p.get("status") or {}).get("phase")
-            if key not in self.running and phase not in ("Succeeded", "Failed"):
-                self.running[key] = p
-                if phase != "Running":
-                    p.setdefault("status", {})["phase"] = "Running"
-                    try:
-                        self.client.update("pods", p,
-                                           p["metadata"].get("namespace", "default"))
-                        n += 1
-                    except APIError:
-                        pass
+            if pod.is_terminal() or key in self.running:
+                continue
+            if pod.status.phase == "Running":
+                self.running[key] = pod
+                continue
+            doc = to_dict(pod)
+            doc.setdefault("status", {})["phase"] = "Running"
+            try:
+                self.client.update("pods", doc, pod.metadata.namespace)
+            except APIError:
+                continue  # conflict/validation: retry next pass
+            self.running[key] = pod
+            n += 1
         for key in list(self.running):
             if key not in seen:
                 self.running.pop(key, None)
         return n
 
     def start(self) -> "JoinedNode":
+        from .. import server as _server  # noqa: F401  (package init)
+        from ..server.client import Informer
+
         self.register()
+        self._informer = Informer(self.client, "pods").start()
 
         def loop():
             last_hb = 0.0
@@ -171,6 +184,9 @@ class JoinedNode:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._informer is not None:
+            self._informer.stop()
+            self._informer = None
         if self._thread is not None:
             self._thread.join(timeout=2)
             self._thread = None

@@ -89,12 +89,15 @@ class TTLAfterFinishedController(Controller):
         try:
             job = self.store.get("jobs", key)
         except NotFoundError:
+            self._pending_ttl.pop(key, None)
             return
         ttl = job.spec.ttl_seconds_after_finished
         if ttl is None:
+            self._pending_ttl.pop(key, None)
             return
         finished = self._finished_at(job)
         if finished is None:
+            self._pending_ttl.pop(key, None)  # condition cleared: stop timing
             return
         if not finished:
             # a terminal condition without a timestamp (legacy object): count

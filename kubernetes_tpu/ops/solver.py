@@ -100,8 +100,18 @@ def _pad_ct(*arrays, sentinel_class=-1):
     return out
 
 
-def make_inputs(cluster, batch) -> Tuple[SolverInputs, int]:
-    """numpy -> device arrays. Returns (inputs, D_max)."""
+def make_inputs(cluster, batch, device=None) -> Tuple[SolverInputs, int]:
+    """numpy -> device arrays. Returns (inputs, D_max).
+
+    device, when given, is TensorCache.device_views' dict of HBM-resident
+    arrays (alloc/used/used_nz/pod_count/max_pods, selcls_count) maintained by
+    scatter updates — those fields skip the host->device upload here."""
+    device = device or {}
+
+    def dev(name, host):
+        got = device.get(name)
+        return got if got is not None else jnp.asarray(host)
+
     t = batch.tables
     kk = max(cluster.topo_id.shape[0], 1)
     n = cluster.n
@@ -124,14 +134,17 @@ def make_inputs(cluster, batch) -> Tuple[SolverInputs, int]:
     assert chg.shape[1] == g, f"class_holds_grp width {chg.shape[1]} != {g}"
 
     inputs = SolverInputs(
-        alloc=jnp.asarray(cluster.alloc), used=jnp.asarray(cluster.used),
-        used_nz=jnp.asarray(cluster.used_nz), pod_count=jnp.asarray(cluster.pod_count),
-        max_pods=jnp.asarray(cluster.max_pods),
+        alloc=dev("alloc", cluster.alloc), used=dev("used", cluster.used),
+        used_nz=dev("used_nz", cluster.used_nz),
+        pod_count=dev("pod_count", cluster.pod_count),
+        max_pods=dev("max_pods", cluster.max_pods),
         filter_ok=jnp.asarray(t.filter_ok), aff_ok=jnp.asarray(t.aff_ok),
         napref_raw=jnp.asarray(t.napref_raw), has_napref=jnp.asarray(t.has_napref),
         taint_cnt=jnp.asarray(t.taint_cnt), img_score=jnp.asarray(t.img_score),
         class_ports=jnp.asarray(t.class_ports), node_ports=jnp.asarray(t.node_ports),
-        topo_id=jnp.asarray(topo_id), selcls_count=jnp.asarray(selcls),
+        topo_id=jnp.asarray(topo_id),
+        selcls_count=dev("selcls_count", selcls) if cluster.selcls_count.size
+        else jnp.asarray(selcls),
         class_matches_selcls=jnp.asarray(cms),
         ct_class=ct[0], ct_key=ct[1], ct_sel=ct[2], ct_max_skew=ct[3],
         ct_min_domains=ct[4], ct_self_match=ct[5],

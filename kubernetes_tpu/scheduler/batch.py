@@ -99,10 +99,13 @@ class BatchScheduler(Scheduler):
 
                 if native_available():
                     assignment, _ = native_greedy_solve(cluster, sub)
-            # device upload happens only for paths that consume it
+            # device upload happens only for paths that consume it; cluster
+            # tensors ride the persistent HBM mirrors (diff streaming)
             inputs = d_max = None
             if assignment is None:
-                inputs, d_max = make_inputs(cluster, sub)
+                inputs, d_max = make_inputs(
+                    cluster, sub,
+                    device=self._tensor_cache.device_views(cluster))
             if use_transport:
                 from ..models.transport import transport_solve
                 from ..models.waterfill import make_groups

@@ -286,6 +286,9 @@ class PriorityAdmission(AdmissionPlugin):
                     f"pods with {name} priorityClass may only be created in "
                     "the kube-system namespace")
             obj.spec.priority = self.SYSTEM_CLASSES[name]
+            # the class value is authoritative here too — system-critical
+            # pods must be able to preempt
+            obj.spec.preemption_policy = "PreemptLowerPriority"
             return
         try:
             pc = store.get("priorityclasses", name)

@@ -107,7 +107,7 @@ class RESTClient:
               namespace: Optional[str] = None) -> Iterator[Tuple[str, Dict]]:
         """Yields (event_type, object_dict); blocks on the streaming response."""
         path = self._path(resource, namespace) + f"?watch=true&resourceVersion={since_rv}"
-        req = urllib.request.Request(self.base_url + path)
+        req = urllib.request.Request(self.base_url + path, headers=self._headers())
         resp = urllib.request.urlopen(req, timeout=3600)
         for raw in resp:
             raw = raw.strip()

@@ -145,6 +145,10 @@ class TensorCache:
     rebuild — correctness first, the fast path is an optimization the parity
     tests pin."""
 
+    # cluster-level tensors that live in HBM across batches; changed rows are
+    # scatter-updated on device instead of re-uploading the full array
+    DEVICE_FIELDS = ("alloc", "used", "used_nz", "pod_count", "max_pods")
+
     def __init__(self):
         self.snap: Optional[Snapshot] = None
         self.node_infos: Optional[list] = None  # aligned NodeInfo identities
@@ -153,6 +157,17 @@ class TensorCache:
         self.selcls_keys: Optional[tuple] = None
         self.selcls_count: Optional[np.ndarray] = None
         self.ns_fingerprint: Optional[tuple] = None
+        # persistent device (HBM) mirrors of the cluster tensors; dirty rows
+        # accumulate across passes (a pass may skip the device upload — e.g.
+        # native solver or all-fallback batches — and the rows it changed must
+        # still reach HBM on the next upload)
+        self._device: dict = {}
+        self._device_selcls = None
+        self._device_selcls_host = None  # the host array the mirror tracks
+        self._dirty_rows: set = set()
+        self._dirty_all = True
+        # previous PodBatchTensors (pod-axis reuse for same-backlog re-solves)
+        self._last_batch = None
 
     # -- cluster tensors -------------------------------------------------------
 
@@ -180,6 +195,7 @@ class TensorCache:
             self.snap = snapshot
             self.node_infos = list(nis)
             return cluster, []
+        self._dirty_rows.update(changed)
         dims = cluster.resource_dims
         for i in changed:
             ni = nis[i]
@@ -218,7 +234,55 @@ class TensorCache:
         self.node_infos = list(snapshot.node_info_list)
         self.selcls_keys = self.selcls_count = None
         self.ns_fingerprint = None
+        self._device = {}
+        self._device_selcls = None
+        self._device_selcls_host = None
+        self._dirty_rows.clear()
+        self._dirty_all = True
         return self.cluster, None
+
+    # -- persistent HBM mirrors (the diff -> device stream of cache.go:186) ----
+
+    def device_views(self, cluster: ClusterTensors) -> dict:
+        """Device-resident cluster tensors, updated incrementally: a full
+        rebuild uploads once; afterwards only dirty node rows (accumulated
+        across passes, including ones that skipped the device path) are
+        scattered into HBM with `.at[rows].set`, so per-batch host->device
+        traffic scales with the diff, not the cluster. Returns
+        {field: jnp.ndarray} for make_inputs(device=...)."""
+        import jax.numpy as jnp
+
+        dirty = sorted(self._dirty_rows)
+        if self._dirty_all or not self._device:
+            self._device = {f: jnp.asarray(getattr(cluster, f))
+                            for f in self.DEVICE_FIELDS}
+            full_upload = True
+        elif dirty:
+            rows = np.asarray(dirty)
+            for f in self.DEVICE_FIELDS:
+                host = getattr(cluster, f)
+                self._device[f] = self._device[f].at[rows].set(host[rows])
+            full_upload = False
+        else:
+            full_upload = False
+        out = dict(self._device)
+        # selector-class counts: same treatment, keyed by host-array identity
+        # (build_pod_batch reuses the array in place on the incremental path)
+        sc = cluster.selcls_count
+        if sc.size:
+            if (self._device_selcls is None
+                    or self._device_selcls_host is not sc
+                    or self._device_selcls.shape != sc.shape
+                    or full_upload):
+                self._device_selcls = jnp.asarray(sc)
+                self._device_selcls_host = sc
+            elif dirty:
+                cols = np.asarray(dirty)
+                self._device_selcls = self._device_selcls.at[:, cols].set(sc[:, cols])
+            out["selcls_count"] = self._device_selcls
+        self._dirty_rows.clear()
+        self._dirty_all = False
+        return out
 
 
 def build_cluster_tensors(snapshot: Snapshot, extra_resource_dims: Sequence[str] = ()) -> ClusterTensors:
@@ -272,24 +336,45 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
     classes as the previous one, per-node match counts are recomputed only
     for changed nodes instead of scanning every bound pod."""
     ns_labels = ns_labels or {}
-    sig_to_class: Dict[tuple, int] = {}
-    rep_pods: List[Pod] = []
-    class_of_pod = np.zeros(len(pods), dtype=np.int32)
-    for pi, pod in enumerate(pods):
-        sig = pod_class_signature(pod)
-        ci = sig_to_class.get(sig)
-        if ci is None:
-            ci = len(rep_pods)
-            sig_to_class[sig] = ci
-            rep_pods.append(pod)
-        class_of_pod[pi] = ci
+    # pod-axis reuse: re-solving the SAME pending backlog after cluster churn
+    # (the incremental re-solve of BASELINE.json's ladder) skips the per-pod
+    # signature/quantization loops — identity comparison against the previous
+    # batch's pod list
+    prev = getattr(reuse, "_last_batch", None) if reuse is not None else None
+    pod_axis = None
+    if (prev is not None and len(prev.pods) == len(pods)
+            and all(a is b for a, b in zip(prev.pods, pods))):
+        pod_axis = prev
+    if pod_axis is not None:
+        rep_pods = list(pod_axis.tables.rep_pods)
+        class_of_pod = pod_axis.class_of_pod
+    else:
+        sig_to_class: Dict[tuple, int] = {}
+        rep_pods = []
+        class_of_pod = np.zeros(len(pods), dtype=np.int32)
+        for pi, pod in enumerate(pods):
+            sig = pod_class_signature(pod)
+            ci = sig_to_class.get(sig)
+            if ci is None:
+                ci = len(rep_pods)
+                sig_to_class[sig] = ci
+                rep_pods.append(pod)
+            class_of_pod[pi] = ci
 
     tables = compile_class_tables(rep_pods, cluster.cols)
 
     r = len(cluster.resource_dims)
-    req = np.zeros((len(pods), r), dtype=np.int64)
-    req_nz = np.zeros((len(pods), r), dtype=np.int64)
-    balanced_active = np.zeros(len(pods), dtype=bool)
+    if (pod_axis is not None
+            and getattr(pod_axis, "_resource_dims", None) == tuple(cluster.resource_dims)):
+        req = pod_axis.req.astype(np.int64)
+        req_nz = pod_axis.req_nz.astype(np.int64)
+        balanced_active = pod_axis.balanced_active
+        skip_req_loop = True
+    else:
+        skip_req_loop = False
+        req = np.zeros((len(pods), r), dtype=np.int64)
+        req_nz = np.zeros((len(pods), r), dtype=np.int64)
+        balanced_active = np.zeros(len(pods), dtype=bool)
     # memoize by container-resources signature: template-stamped pods (the
     # overwhelmingly common case) compute their request vectors exactly once
     req_cache: Dict[tuple, Tuple[List[int], List[int], bool]] = {}
@@ -303,7 +388,7 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
             (k, tuple(sorted(v.items())) if isinstance(v, dict) else repr(v))
             for k, v in sorted(res.items()))
 
-    for pi, pod in enumerate(pods):
+    for pi, pod in (() if skip_req_loop else list(enumerate(pods))):
         sig = (
             tuple(_res_sig(c.resources) for c in pod.spec.containers),
             tuple(_res_sig(c.resources) for c in pod.spec.init_containers),
@@ -455,7 +540,7 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
     ct_class, ct_key, ct_sel, ct_max_skew, ct_min_domains, ct_self = rows_to_arrays(ct_rows, True)
     st_class, st_key, st_sel, st_max_skew, st_self = rows_to_arrays(st_rows, False)
 
-    return PodBatchTensors(
+    out = PodBatchTensors(
         pods=list(pods),
         class_of_pod=class_of_pod,
         req=req.astype(np.int32),
@@ -470,3 +555,9 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
         ipa=ipa,
         fallback_class=fallback_class,
     )
+    if reuse is not None:
+        # the cached req vectors are only valid against the same resource-dim
+        # layout (a dim swap with equal length would misquantize silently)
+        out._resource_dims = tuple(cluster.resource_dims)
+        reuse._last_batch = out  # pod-axis reuse for same-backlog re-solves
+    return out

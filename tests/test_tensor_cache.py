@@ -128,3 +128,30 @@ class TestTensorCache:
         for p in bound:
             per_zone[int(p.spec.node_name[1:]) % 4] += 1
         assert max(per_zone.values()) - min(per_zone.values()) <= 2
+
+    def test_device_mirrors_track_host_after_churn(self):
+        """The persistent HBM mirrors (diff -> device streaming) must equal a
+        fresh upload of the host arrays after any churn sequence."""
+        import jax.numpy as jnp
+
+        cache = Cache(clock=FakeClock())
+        for i in range(30):
+            cache.add_node(MakeNode(f"n{i}").labels({ZONE: f"z{i % 3}"})
+                           .capacity({"cpu": "8", "memory": "16Gi", "pods": "50"}).obj())
+        tc = TensorCache()
+        for step in range(5):
+            for j in range(4):
+                p = MakePod(f"d{step}-{j}").labels({"app": "w"}).req(
+                    {"cpu": "250m"}).obj()
+                p.spec.node_name = f"n{(step * 4 + j) % 30}"
+                cache.add_pod(p)
+            snap = cache.update_snapshot()
+            cluster, changed = tc.cluster_tensors(snap)
+            build_pod_batch(_pods(step * 8, 6, spread=True), snap, cluster,
+                            reuse=tc, changed_nodes=changed)
+            views = tc.device_views(cluster)
+            for f in TensorCache.DEVICE_FIELDS:
+                np.testing.assert_array_equal(
+                    np.asarray(views[f]), getattr(cluster, f), err_msg=f)
+            np.testing.assert_array_equal(
+                np.asarray(views["selcls_count"]), cluster.selcls_count)
