@@ -27,6 +27,13 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 PARTIAL_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "BENCH_partial.json")
+# BENCH_SMOKE=1 shrinks every rung ~64x for a fast CPU harness check —
+# validates the ladder end to end without TPU hardware (numbers meaningless)
+SMOKE = os.environ.get("BENCH_SMOKE", "") not in ("", "0")
+
+
+def sz(n: int, floor: int = 8) -> int:
+    return max(floor, n // 64) if SMOKE else n
 GLOBAL_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "1500"))
 _START = time.monotonic()
 
@@ -55,6 +62,15 @@ def ensure_device_alive(timeout_s: float = 60.0) -> str:
     def probe():
         try:
             import jax
+
+            if os.environ.get("JAX_PLATFORMS"):
+                # the env var alone doesn't always win over sitecustomize's
+                # PJRT plugin registration (see tests/conftest.py)
+                try:
+                    jax.config.update("jax_platforms",
+                                      os.environ["JAX_PLATFORMS"])
+                except Exception:
+                    pass
             import jax.numpy as jnp
 
             devs = jax.devices()
@@ -161,9 +177,9 @@ def run_rung(name, snap, pods, solver, baseline, min_placed=None, results=None):
 def rung_basic(results):
     from kubernetes_tpu.testing import MakePod
 
-    snap = make_snapshot(_nodes(5000))
+    snap = make_snapshot(_nodes(sz(5000)))
     pods = [MakePod(f"pod-{i}").req({"cpu": "500m", "memory": "1Gi"}).obj()
-            for i in range(10000)]
+            for i in range(sz(10000))]
     run_rung("SchedulingBasic", snap, pods, "waterfill", BASE_BASIC, results=results)
     run_rung("SchedulingBasic_scan", snap, pods, "scan", BASE_BASIC, results=results)
 
@@ -173,11 +189,11 @@ def rung_topology_spread(results):
     # (misc/performance-config.yaml:145-186 shape)
     from kubernetes_tpu.testing import MakePod
 
-    snap = make_snapshot(_nodes(5000, zones=10))
+    snap = make_snapshot(_nodes(sz(5000), zones=10))
     pods = [MakePod(f"sp-{i}").labels({"app": "spread"})
             .req({"cpu": "200m", "memory": "256Mi"})
             .topology_spread(1, ZONE, "DoNotSchedule", {"app": "spread"})
-            .obj() for i in range(5000)]
+            .obj() for i in range(sz(5000))]
     run_rung("TopologySpreading", snap, pods, "scan", BASE_PTS, results=results)
 
 
@@ -186,10 +202,10 @@ def rung_pod_anti_affinity(results):
     # (affinity/performance-config.yaml:23-68 shape: anti-affine batches)
     from kubernetes_tpu.testing import MakePod
 
-    snap = make_snapshot(_nodes(5000))
+    snap = make_snapshot(_nodes(sz(5000)))
     pods = []
-    for g in range(50):
-        for i in range(40):
+    for g in range(sz(50)):
+        for i in range(sz(40)):
             pods.append(MakePod(f"anti-{g}-{i}").labels({"grp": f"g{g}"})
                         .pod_anti_affinity(HOST, {"grp": f"g{g}"})
                         .req({"cpu": "200m"}).obj())
@@ -201,13 +217,13 @@ def rung_pod_affinity(results):
     # colocation with their seed (affinity/performance-config.yaml:85-135)
     from kubernetes_tpu.testing import MakePod
 
-    nodes = _nodes(5000, zones=50)
+    nodes = _nodes(sz(5000), zones=sz(50))
     seeds = [MakePod(f"seed-{z}").labels({"svc": f"s{z}"})
-             .node(f"node-{z}").req({"cpu": "100m"}).obj() for z in range(50)]
+             .node(f"node-{z}").req({"cpu": "100m"}).obj() for z in range(sz(50))]
     snap = make_snapshot(nodes, bound_pods=seeds)
     pods = [MakePod(f"aff-{i}").labels({"peer": "1"})
-            .pod_affinity(ZONE, {"svc": f"s{i % 50}"})
-            .req({"cpu": "200m"}).obj() for i in range(5000)]
+            .pod_affinity(ZONE, {"svc": f"s{i % sz(50)}"})
+            .req({"cpu": "200m"}).obj() for i in range(sz(5000))]
     run_rung("PodAffinity", snap, pods, "scan", BASE_AFF, results=results)
 
 
@@ -219,16 +235,16 @@ def rung_anti_affinity_ns_selector(results):
     from kubernetes_tpu.api.labels import Selector
     from kubernetes_tpu.testing import MakePod
 
-    snap = make_snapshot(_nodes(5000))
+    snap = make_snapshot(_nodes(sz(5000)))
     ns_labels = {f"team-{t}": {"team": "x"} for t in range(10)}
     pods = []
-    for g in range(50):
+    for g in range(sz(50)):
         term = PodAffinityTerm(
             topology_key=HOST,
             selector=Selector.from_match_labels({"grp": f"g{g}"}),
             namespace_selector=Selector.from_match_labels({"team": "x"}),
         )
-        for i in range(40):
+        for i in range(sz(40)):
             p = MakePod(f"nsa-{g}-{i}", namespace=f"team-{(g + i) % 10}").labels(
                 {"grp": f"g{g}"}).req({"cpu": "200m"}).obj()
             p.spec.affinity = Affinity(pod_anti_affinity_required=[term])
@@ -277,16 +293,16 @@ def rung_mixed_churn(results):
     from kubernetes_tpu.testing import MakeNode, MakePod
 
     try:
-        n_nodes, n_pods = 5000, 10000
+        n_nodes, n_pods = sz(5000), sz(10000)
         # warm-up on a throwaway cluster at the REAL batch shapes (the round-3
         # run compiled mid-measurement because the warm batch had 1 pod)
         warm_store = APIStore()
         for n in _nodes(n_nodes):
             warm_store.create("nodes", n)
         warm = BatchScheduler(warm_store, Framework(default_plugins()),
-                              batch_size=2500, solver="auto")
+                              batch_size=sz(2500), solver="auto")
         warm.sync()
-        for i in range(2500):
+        for i in range(sz(2500)):
             warm_store.create("pods", MakePod(f"w-{i}").req(
                 {"cpu": "500m", "memory": "1Gi"}).obj())
         warm.run_until_idle()
@@ -295,7 +311,7 @@ def rung_mixed_churn(results):
         for n in _nodes(n_nodes):
             store.create("nodes", n)
         sched = BatchScheduler(store, Framework(default_plugins()),
-                               batch_size=2500, solver="auto")
+                               batch_size=sz(2500), solver="auto")
         sched.sync()
         store.create("pods", MakePod("warm").req({"cpu": "100m"}).obj())
         sched.run_until_idle()
@@ -347,7 +363,7 @@ def rung_preemption(results):
     from kubernetes_tpu.testing import MakePod
 
     try:
-        n_nodes = 500
+        n_nodes = sz(500, floor=16)
         store = APIStore()
         for n in _nodes(n_nodes, cpu="4"):
             store.create("nodes", n)
@@ -402,9 +418,9 @@ def rung_north_star(results):
     # solver-only (tensorize + upload + solve + readback, target <1s)
     from kubernetes_tpu.testing import MakePod
 
-    snap = make_snapshot(_nodes(10000, cpu="16", mem="64Gi"))
+    snap = make_snapshot(_nodes(sz(10000), cpu="16", mem="64Gi"))
     pods = [MakePod(f"ns-{i}").req({"cpu": "500m", "memory": "1Gi"}).obj()
-            for i in range(100_000)]
+            for i in range(sz(100_000))]
     try:
         device_solve(snap, pods, "waterfill")
         a, dt = device_solve(snap, pods, "waterfill")
@@ -415,7 +431,7 @@ def rung_north_star(results):
             "vs_target": round(pps / NORTH_STAR, 2),
             "placed": placed, "pods": len(pods), "solver": "waterfill"}
         print(f"{'NorthStar_100k_10k':>28}: {pps:>9.0f} pods/s  "
-              f"({placed}/100000 placed in {dt:.3f}s; target <1s)", file=sys.stderr)
+              f"({placed}/{len(pods)} placed in {dt:.3f}s; target <1s)", file=sys.stderr)
     except Exception as e:
         results["NorthStar_100k_10k"] = {"error": str(e)[:200]}
         print(f"NorthStar_100k_10k: ERROR {e}", file=sys.stderr)
@@ -438,10 +454,10 @@ def rung_north_star_warm(results):
 
     try:
         cache = Cache(clock=FakeClock())
-        for n in _nodes(10000, cpu="16", mem="64Gi"):
+        for n in _nodes(sz(10000), cpu="16", mem="64Gi"):
             cache.add_node(n)
         pods = [MakePod(f"nw-{i}").req({"cpu": "500m", "memory": "1Gi"}).obj()
-                for i in range(100_000)]
+                for i in range(sz(100_000))]
         tc = TensorCache()
 
         def solve_pass():
@@ -456,10 +472,17 @@ def rung_north_star_warm(results):
             return a, time.perf_counter() - t0
 
         solve_pass()  # cold: full tensorize + compile
-        # churn: bind pods to 300 nodes, then re-solve warm
-        for i in range(300):
-            p = MakePod(f"wchurn-{i}").req({"cpu": "1"}).obj()
+        # warm-up the INCREMENTAL path too, at the SAME scatter width as the
+        # measured pass (the .at[rows].set update compiles per row count)
+        for i in range(sz(300)):
+            p = MakePod(f"wchurn0-{i}").req({"cpu": "1"}).obj()
             p.spec.node_name = f"node-{i}"
+            cache.add_pod(p)
+        solve_pass()
+        # churn: bind pods to 300 different nodes, then re-solve warm
+        for i in range(sz(300)):
+            p = MakePod(f"wchurn-{i}").req({"cpu": "1"}).obj()
+            p.spec.node_name = f"node-{sz(300) + i}"
             cache.add_pod(p)
         a, dt = solve_pass()
         placed = int((a >= 0).sum())
@@ -470,7 +493,7 @@ def rung_north_star_warm(results):
             "placed": placed, "pods": len(pods),
             "solver": "waterfill+tensorcache"}
         print(f"{'NorthStar_100k_10k_warm':>28}: {pps:>9.0f} pods/s  "
-              f"({placed}/100000 placed in {dt:.3f}s warm re-solve)",
+              f"({placed}/{len(pods)} placed in {dt:.3f}s warm re-solve)",
               file=sys.stderr)
     except Exception as e:
         results["NorthStar_100k_10k_warm"] = {"error": str(e)[:200]}
@@ -488,7 +511,7 @@ def rung_north_star_endtoend(results):
     from kubernetes_tpu.testing import MakePod
 
     try:
-        n_nodes, n_pods = 10_000, 100_000
+        n_nodes, n_pods = sz(10_000), sz(100_000)
         store = APIStore()
         for n in _nodes(n_nodes, cpu="16", mem="64Gi"):
             store.create("nodes", n)
@@ -531,9 +554,9 @@ def rung_transport(results):
     from kubernetes_tpu.testing import MakePod
 
     try:
-        snap = make_snapshot(_nodes(5000, cpu="16", mem="64Gi"))
+        snap = make_snapshot(_nodes(sz(5000), cpu="16", mem="64Gi"))
         pods = [MakePod(f"tr-{i}").req({"cpu": "500m", "memory": "1Gi"}).obj()
-                for i in range(50_000)]
+                for i in range(sz(50_000))]
         cluster = build_cluster_tensors(snap)
         batch = build_pod_batch(pods, snap, cluster)
         inputs, _ = make_inputs(cluster, batch)

@@ -87,7 +87,7 @@ class JoinedNode:
         self.node_name = node_name
         self.capacity = dict(capacity)
         self.heartbeat = heartbeat
-        self.running: Dict[str, dict] = {}
+        self.running: Dict[str, object] = {}  # pod key -> typed Pod (informer)
         self._informer = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -143,8 +143,7 @@ def make_inputs(cluster, batch, device=None) -> Tuple[SolverInputs, int]:
         taint_cnt=jnp.asarray(t.taint_cnt), img_score=jnp.asarray(t.img_score),
         class_ports=jnp.asarray(t.class_ports), node_ports=jnp.asarray(t.node_ports),
         topo_id=jnp.asarray(topo_id),
-        selcls_count=dev("selcls_count", selcls) if cluster.selcls_count.size
-        else jnp.asarray(selcls),
+        selcls_count=dev("selcls_count", selcls),
         class_matches_selcls=jnp.asarray(cms),
         ct_class=ct[0], ct_key=ct[1], ct_sel=ct[2], ct_max_skew=ct[3],
         ct_min_domains=ct[4], ct_self_match=ct[5],

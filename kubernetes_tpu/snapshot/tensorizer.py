@@ -366,8 +366,8 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
     r = len(cluster.resource_dims)
     if (pod_axis is not None
             and getattr(pod_axis, "_resource_dims", None) == tuple(cluster.resource_dims)):
-        req = pod_axis.req.astype(np.int64)
-        req_nz = pod_axis.req_nz.astype(np.int64)
+        req = pod_axis.req  # already int32; passed through copy-free below
+        req_nz = pod_axis.req_nz
         balanced_active = pod_axis.balanced_active
         skip_req_loop = True
     else:
@@ -543,8 +543,8 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
     out = PodBatchTensors(
         pods=list(pods),
         class_of_pod=class_of_pod,
-        req=req.astype(np.int32),
-        req_nz=req_nz.astype(np.int32),
+        req=np.asarray(req, dtype=np.int32),
+        req_nz=np.asarray(req_nz, dtype=np.int32),
         balanced_active=balanced_active,
         tables=tables,
         ct_class=ct_class, ct_key=ct_key, ct_sel=ct_sel,

@@ -155,3 +155,37 @@ class TestTensorCache:
                     np.asarray(views[f]), getattr(cluster, f), err_msg=f)
             np.testing.assert_array_equal(
                 np.asarray(views["selcls_count"]), cluster.selcls_count)
+
+    def test_pod_axis_reuse_parity(self):
+        """Re-solving the identical backlog (same pod objects) must produce
+        PodBatchTensors equal to a fresh build — the pod-axis fast path skips
+        the per-pod loops and must not drift."""
+        cache = Cache(clock=FakeClock())
+        for i in range(20):
+            cache.add_node(MakeNode(f"n{i}").labels({ZONE: f"z{i % 4}"})
+                           .capacity({"cpu": "8", "memory": "16Gi", "pods": "50"}).obj())
+        tc = TensorCache()
+        backlog = _pods(0, 12, spread=True) + _pods(100, 4)
+        snap = cache.update_snapshot()
+        cluster, changed = tc.cluster_tensors(snap)
+        b1 = build_pod_batch(backlog, snap, cluster, reuse=tc, changed_nodes=changed)
+        # churn a node, re-solve the SAME backlog
+        p = MakePod("bound").labels({"app": "w"}).req({"cpu": "500m"}).obj()
+        p.spec.node_name = "n7"
+        cache.add_pod(p)
+        snap2 = cache.update_snapshot()
+        cluster2, changed2 = tc.cluster_tensors(snap2)
+        b2 = build_pod_batch(backlog, snap2, cluster2, reuse=tc,
+                             changed_nodes=changed2)
+        fresh_cluster = build_cluster_tensors(snap2)
+        fb = build_pod_batch(backlog, snap2, fresh_cluster)
+        np.testing.assert_array_equal(b2.req, fb.req)
+        np.testing.assert_array_equal(b2.req_nz, fb.req_nz)
+        np.testing.assert_array_equal(b2.class_of_pod, fb.class_of_pod)
+        np.testing.assert_array_equal(b2.balanced_active, fb.balanced_active)
+        np.testing.assert_array_equal(b2.tables.filter_ok, fb.tables.filter_ok)
+        np.testing.assert_array_equal(
+            cluster2.selcls_count, fresh_cluster.selcls_count)
+        assert b2.req.dtype == np.int32
+        # the fast path actually engaged (shares the pod-axis arrays)
+        assert b2.class_of_pod is b1.class_of_pod
