@@ -97,3 +97,66 @@ class TestControlPlane:
         assert _wait(lambda: store.get(
             "poddisruptionbudgets", "default/web-pdb").disruptions_allowed == 2)
         cp.stop()
+
+
+class TestRestartRecovery:
+    """Chaos/restart tier (SURVEY.md §5): the scheduler is stateless — killed
+    mid-workload, a fresh instance rebuilds cache+queue from LIST+WATCH and
+    finishes the backlog without double-binding (reference: scheduler
+    restart semantics, eventhandlers.go:364 + assumed-pod expiry)."""
+
+    def test_scheduler_restart_mid_backlog(self):
+        from kubernetes_tpu.scheduler import Framework
+        from kubernetes_tpu.scheduler.batch import BatchScheduler
+        from kubernetes_tpu.scheduler.plugins import default_plugins
+        from kubernetes_tpu.testing import MakePod
+
+        store = APIStore()
+        for i in range(4):
+            store.create("nodes", MakeNode(f"n{i}").capacity(
+                {"cpu": "16", "memory": "32Gi", "pods": "100"}).obj())
+        s1 = BatchScheduler(store, Framework(default_plugins()),
+                            batch_size=10, solver="exact")
+        s1.sync()
+        for i in range(60):
+            store.create("pods", MakePod(f"r-{i}").req({"cpu": "100m"}).obj())
+        # schedule part of the backlog, then "crash"
+        s1.schedule_batch(timeout=0.0)
+        s1.flush_binds()
+        bound_before = sum(1 for p in store.list("pods")[0] if p.spec.node_name)
+        assert 0 < bound_before < 60
+        s1.stop()
+        del s1
+
+        s2 = BatchScheduler(store, Framework(default_plugins()),
+                            batch_size=64, solver="exact")
+        s2.sync()  # fresh LIST: bound pods -> cache, pending -> queue
+        s2.run_until_idle()
+        pods, _ = store.list("pods")
+        assert sum(1 for p in pods if p.spec.node_name) == 60
+        # no double binds: every bind after restart succeeded exactly once
+        assert s2.scheduled_count == 60 - bound_before
+
+    def test_hollow_node_restart_readopts(self):
+        from kubernetes_tpu.agent import HollowCluster
+        from kubernetes_tpu.scheduler import Framework
+        from kubernetes_tpu.scheduler.serial import Scheduler
+        from kubernetes_tpu.scheduler.plugins import default_plugins
+        from kubernetes_tpu.testing import MakePod
+
+        store = APIStore()
+        cluster = HollowCluster(store, n_nodes=2)
+        cluster.register_all()
+        sched = Scheduler(store, Framework(default_plugins()))
+        sched.sync()
+        store.create("pods", MakePod("w").req({"cpu": "100m"}).obj())
+        sched.run_until_idle()
+        pod = store.get("pods", "default/w")
+        assert pod.spec.node_name != ""
+        # node agent restarts: a fresh kubelet adopts the bound pod
+        from kubernetes_tpu.agent.hollow import HollowKubelet
+
+        hk = HollowKubelet(store, pod.spec.node_name)
+        hk.register()
+        hk.pump()
+        assert pod.key in hk.running_pods
