@@ -164,7 +164,9 @@ class JoinedNode:
         from ..server.client import Informer
 
         self.register()
-        self._informer = Informer(self.client, "pods").start()
+        self._informer = Informer(
+            self.client, "pods",
+            field_selector=f"spec.nodeName={self.node_name}").start()
 
         def loop():
             last_hb = 0.0

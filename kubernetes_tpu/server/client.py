@@ -80,8 +80,14 @@ class RESTClient:
     def get(self, resource: str, name: str, namespace: Optional[str] = "default") -> Dict:
         return self.request("GET", self._path(resource, namespace, name))
 
-    def list(self, resource: str, namespace: Optional[str] = None) -> Tuple[List[Dict], int]:
-        out = self.request("GET", self._path(resource, namespace))
+    def list(self, resource: str, namespace: Optional[str] = None,
+             field_selector: str = "") -> Tuple[List[Dict], int]:
+        path = self._path(resource, namespace)
+        if field_selector:
+            from urllib.parse import quote
+
+            path += f"?fieldSelector={quote(field_selector)}"
+        out = self.request("GET", path)
         return out["items"], out["metadata"]["resourceVersion"]
 
     def update(self, resource: str, obj_dict: Dict, namespace: Optional[str] = None) -> Dict:
@@ -104,9 +110,14 @@ class RESTClient:
                             {"target": {"kind": "Node", "name": node_name}})
 
     def watch(self, resource: str, since_rv: int = -1,
-              namespace: Optional[str] = None) -> Iterator[Tuple[str, Dict]]:
+              namespace: Optional[str] = None,
+              field_selector: str = "") -> Iterator[Tuple[str, Dict]]:
         """Yields (event_type, object_dict); blocks on the streaming response."""
         path = self._path(resource, namespace) + f"?watch=true&resourceVersion={since_rv}"
+        if field_selector:
+            from urllib.parse import quote
+
+            path += f"&fieldSelector={quote(field_selector)}"
         req = urllib.request.Request(self.base_url + path, headers=self._headers())
         resp = urllib.request.urlopen(req, timeout=3600)
         for raw in resp:
@@ -122,11 +133,14 @@ class Informer:
     SharedIndexInformer analog over HTTP."""
 
     def __init__(self, client: RESTClient, resource: str,
-                 on_event: Optional[Callable[[str, Any], None]] = None):
+                 on_event: Optional[Callable[[str, Any], None]] = None,
+                 field_selector: str = ""):
         self.client = client
         self.resource = resource
         self.cache: Dict[str, Any] = {}
         self.on_event = on_event
+        # server-side scope (e.g. spec.nodeName=<me> for a kubelet informer)
+        self.field_selector = field_selector
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -136,7 +150,8 @@ class Informer:
         return f"{ns}/{meta['name']}" if ns else meta["name"]
 
     def start(self) -> "Informer":
-        items, rv = self.client.list(self.resource)
+        items, rv = self.client.list(self.resource,
+                                     field_selector=self.field_selector)
         for it in items:
             self.cache[self._key(it)] = from_dict(self.resource, it)
 
@@ -144,7 +159,9 @@ class Informer:
             nonlocal rv
             while not self._stop.is_set():
                 try:
-                    for etype, obj_dict in self.client.watch(self.resource, since_rv=rv):
+                    for etype, obj_dict in self.client.watch(
+                            self.resource, since_rv=rv,
+                            field_selector=self.field_selector):
                         if self._stop.is_set():
                             return
                         if etype == "BOOKMARK":
@@ -171,7 +188,8 @@ class Informer:
                     # stale rv after a 410 Expired would loop forever and
                     # freeze the cache.
                     try:
-                        items, rv = self.client.list(self.resource)
+                        items, rv = self.client.list(
+                            self.resource, field_selector=self.field_selector)
                         fresh = {self._key(it): from_dict(self.resource, it) for it in items}
                         # synthetic deltas for changes missed during the outage
                         # (informers emit ADDED/MODIFIED/DELETED on cache

@@ -60,6 +60,51 @@ def _parse_path(path: str) -> Optional[Tuple[str, Optional[str], Optional[str], 
     return resource, None, name, sub
 
 
+_FIELD_READERS = {
+    "metadata.name": lambda o: o.metadata.name,
+    "metadata.namespace": lambda o: getattr(o.metadata, "namespace", ""),
+    "spec.nodeName": lambda o: getattr(getattr(o, "spec", None), "node_name", ""),
+    "spec.schedulerName": lambda o: getattr(
+        getattr(o, "spec", None), "scheduler_name", ""),
+    "status.phase": lambda o: getattr(getattr(o, "status", None), "phase", ""),
+}
+
+
+def parse_field_selector(raw: str):
+    """`spec.nodeName=n1,status.phase!=Failed` -> predicate(obj) or None.
+    The subset the reference serves from etcd/cacher for pods and nodes
+    (apiserver fields.Selector); `==` is accepted as an alias of `=`.
+    Raises ValueError for unsupported field paths (the apiserver's
+    'field label not supported' 400, not a silently-empty result)."""
+    if not raw:
+        return None
+    clauses = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "!=" in part:
+            key, _, want = part.partition("!=")
+            neg = True
+        else:
+            key, _, want = part.partition("=")
+            if want.startswith("="):  # the k8s `==` alias
+                want = want[1:]
+            neg = False
+        key = key.strip()
+        if key not in _FIELD_READERS:
+            raise ValueError(f"field label not supported: {key!r}")
+        clauses.append((key, want.strip(), neg))
+
+    def pred(obj):
+        for key, want, neg in clauses:
+            if (_FIELD_READERS[key](obj) == want) == neg:
+                return False
+        return True
+
+    return pred
+
+
 def json_merge_patch(target, patch):
     """RFC 7386 JSON Merge Patch: dicts merge recursively, null deletes,
     everything else replaces (the subset of strategic-merge the build's types
@@ -183,16 +228,27 @@ class _Handler(BaseHTTPRequestHandler):
         verb = "watch" if is_watch else ("get" if name is not None else "list")
         if self._authenticated_user(verb, resource) is None:
             return
+        try:
+            field_pred = parse_field_selector(q.get("fieldSelector", [""])[0])
+        except ValueError as e:
+            self._error(400, str(e), "BadRequest")
+            return
         if is_watch:
-            self._watch(resource, ns, int(q.get("resourceVersion", ["-1"])[0]))
+            self._watch(resource, ns, int(q.get("resourceVersion", ["-1"])[0]),
+                        field_pred)
             return
         try:
             if name is not None:
                 obj = self.store.get(resource, self._key(resource, ns, name))
                 self._send_json(200, to_dict(obj))
             else:
-                pred = (lambda o: o.metadata.namespace == ns) if ns else None
-                items, rv = self.store.list(resource, pred)
+                def pred(o, _ns=ns, _fp=field_pred):
+                    if _ns and o.metadata.namespace != _ns:
+                        return False
+                    return _fp is None or _fp(o)
+
+                items, rv = self.store.list(
+                    resource, pred if (ns or field_pred) else None)
                 self._send_json(200, {
                     "kind": "List",
                     "metadata": {"resourceVersion": rv},
@@ -201,7 +257,8 @@ class _Handler(BaseHTTPRequestHandler):
         except NotFoundError as e:
             self._error(404, str(e), "NotFound")
 
-    def _watch(self, resource: str, ns: Optional[str], since_rv: int) -> None:
+    def _watch(self, resource: str, ns: Optional[str], since_rv: int,
+               field_pred=None) -> None:
         try:
             w = self.store.watch(resource, since_rv=since_rv)
         except ResourceVersionTooOldError as e:
@@ -235,7 +292,26 @@ class _Handler(BaseHTTPRequestHandler):
                 idle = 0.0
                 if ns and getattr(ev.obj.metadata, "namespace", "") != ns:
                     continue
-                line = json.dumps({"type": ev.type, "object": to_dict(ev.obj)}).encode() + b"\n"
+                etype = ev.type
+                if field_pred is not None:
+                    # the cacher's transition rule: evaluate the selector on
+                    # the PREVIOUS object state vs the current one
+                    # (watch_cache filtering semantics) — works for objects
+                    # that matched before this watch connected, because prev
+                    # rides on the event itself
+                    cur_ok = etype != "DELETED" and field_pred(ev.obj)
+                    prev_src = ev.prev if ev.prev is not None else (
+                        ev.obj if etype == "DELETED" else None)
+                    prev_ok = prev_src is not None and field_pred(prev_src)
+                    if cur_ok and prev_ok:
+                        etype = "MODIFIED"
+                    elif cur_ok:
+                        etype = "ADDED"  # entered scope
+                    elif prev_ok:
+                        etype = "DELETED"  # left scope (or real delete)
+                    else:
+                        continue  # never visible to this watcher
+                line = json.dumps({"type": etype, "object": to_dict(ev.obj)}).encode() + b"\n"
                 self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
                 self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):

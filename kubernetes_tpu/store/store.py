@@ -39,6 +39,10 @@ class Event:
     kind: str
     obj: Any
     resource_version: int
+    # the object's previous stored state (None on create). Lets filtered
+    # watchers decide scope transitions the way the reference's watchCache
+    # does (predicate on prevObj vs obj); read-only like obj.
+    prev: Any = None
 
 
 class ConflictError(Exception):
@@ -243,11 +247,11 @@ class APIStore:
     def _copy(self, obj):
         return copy.deepcopy(obj) if self._deep_copy else obj
 
-    def _emit(self, etype: str, kind: str, obj) -> None:
+    def _emit(self, etype: str, kind: str, obj, prev=None) -> None:
         # Events carry a copy, never the stored object: a watcher that mutates an
         # event object (the client-go mutation-detector failure mode) must not be
         # able to corrupt store state. One copy per write, shared by watchers.
-        self._emit_prepared(etype, kind, self._copy(obj))
+        self._emit_prepared(etype, kind, self._copy(obj), prev=prev)
 
     def check_mutations(self) -> None:
         """Raise MutationDetectedError if any watcher mutated an event object
@@ -255,10 +259,12 @@ class APIStore:
         if self._mutation_detector is not None:
             self._mutation_detector.check()
 
-    def _emit_prepared(self, etype: str, kind: str, obj) -> None:
+    def _emit_prepared(self, etype: str, kind: str, obj, prev=None) -> None:
         """Emit an event whose object is ALREADY private to the event (hot
-        write paths pre-clone instead of paying a second deepcopy here)."""
-        ev = Event(etype, kind, obj, self._rv)
+        write paths pre-clone instead of paying a second deepcopy here).
+        prev is the replaced stored object — orphaned from the store by this
+        very write, so sharing it with watchers is safe (read-only)."""
+        ev = Event(etype, kind, obj, self._rv, prev)
         if self._mutation_detector is not None:
             self._mutation_detector.record(ev)
         self._history.append(ev)
@@ -305,11 +311,12 @@ class APIStore:
                     f"{kind} {key}: rv {obj.metadata.resource_version} != "
                     f"{objs[key].metadata.resource_version}"
                 )
+            old = objs[key]
             obj = self._copy(obj)
             self._rv += 1
             obj.metadata.resource_version = self._rv
             objs[key] = obj
-            self._emit(MODIFIED, kind, obj)
+            self._emit(MODIFIED, kind, obj, prev=old)
             return obj
 
     def guaranteed_update(self, kind: str, key: str, mutate: Callable[[Any], Any], max_retries: int = 16) -> Any:
@@ -328,12 +335,13 @@ class APIStore:
             objs = self._objects.get(kind, {})
             if key not in objs:
                 raise NotFoundError(f"{kind} {key} not found")
-            obj = self._copy(objs.pop(key))
+            old = objs.pop(key)
+            obj = self._copy(old)
             self._rv += 1
             # The DELETED event carries the object at its post-delete RV (client-go
             # convention: watchers track progress from obj.metadata.resourceVersion).
             obj.metadata.resource_version = self._rv
-            self._emit(DELETED, kind, obj)
+            self._emit(DELETED, kind, obj, prev=old)
             return obj
 
     def list(self, kind: str, predicate: Optional[Callable[[Any], bool]] = None) -> Tuple[List[Any], int]:
@@ -431,7 +439,8 @@ class APIStore:
             self._rv += 1
             new.metadata.resource_version = self._rv
             self._objects["pods"][key] = new
-            self._emit_prepared(MODIFIED, "pods", _pod_structural_clone(new))
+            self._emit_prepared(MODIFIED, "pods", _pod_structural_clone(new),
+                                prev=pod)
             # the caller's copy is distinct from both the stored object and
             # the event object (mutating it must corrupt neither)
             return _pod_structural_clone(new)
@@ -457,7 +466,8 @@ class APIStore:
                     self._rv += 1
                     new.metadata.resource_version = self._rv
                     self._objects["pods"][key] = new
-                    self._emit_prepared(MODIFIED, "pods", _pod_structural_clone(new))
+                    self._emit_prepared(MODIFIED, "pods",
+                                        _pod_structural_clone(new), prev=pod)
                     bound += 1
                 except (NotFoundError, AlreadyBoundError) as e:
                     errors.append((key, str(e)))
@@ -468,10 +478,12 @@ class APIStore:
         clone for the store, one for the event, no deepcopies)."""
         with self._lock:
             key = f"{namespace}/{name}"
-            pod = _pod_structural_clone(self._pod_internal(key))
+            old = self._pod_internal(key)
+            pod = _pod_structural_clone(old)
             mutate_status(pod.status)
             self._rv += 1
             pod.metadata.resource_version = self._rv
             self._objects["pods"][key] = pod
-            self._emit_prepared(MODIFIED, "pods", _pod_structural_clone(pod))
+            self._emit_prepared(MODIFIED, "pods", _pod_structural_clone(pod),
+                                prev=old)
             return _pod_structural_clone(pod)

@@ -191,3 +191,140 @@ class TestBoundedWatch:
         sched.run_until_idle()  # pump -> relist -> schedule
         bound = sum(1 for p in store.list("pods")[0] if p.spec.node_name)
         assert bound == 100
+
+
+class TestFieldSelector:
+    """Server-side fieldSelector on list/watch (apiserver fields.Selector /
+    watch_cache filtering): node-scoped pod watches see only their pods, and
+    an object leaving scope arrives as a synthetic DELETED."""
+
+    def test_list_filtered_by_node(self):
+        store = APIStore()
+        srv = APIServer(store).start()
+        try:
+            for i in range(3):
+                p = MakePod(f"p{i}").obj()
+                p.spec.node_name = f"n{i % 2}"
+                store.create("pods", p)
+            client = RESTClient(srv.url)
+            items, _ = client.list("pods", field_selector="spec.nodeName=n0")
+            assert {it["metadata"]["name"] for it in items} == {"p0", "p2"}
+            items, _ = client.list("pods", field_selector="status.phase!=Failed")
+            assert len(items) == 3
+        finally:
+            srv.stop()
+
+    def test_watch_scope_and_synthetic_delete(self):
+        import threading
+        import time
+
+        store = APIStore()
+        srv = APIServer(store).start()
+        try:
+            client = RESTClient(srv.url)
+            events = []
+
+            def consume():
+                for etype, obj in client.watch(
+                        "pods", since_rv=store.rv,
+                        field_selector="spec.nodeName=n0"):
+                    events.append((etype, obj["metadata"]["name"],
+                                   (obj["spec"] or {}).get("nodeName", "")))
+                    if len(events) >= 3:
+                        return
+
+            t = threading.Thread(target=consume, daemon=True)
+            t.start()
+            time.sleep(0.2)
+            other = MakePod("other").obj()
+            other.spec.node_name = "n9"
+            store.create("pods", other)  # out of scope: invisible
+            mine = MakePod("mine").obj()
+            mine.spec.node_name = "n0"
+            store.create("pods", mine)  # ADDED
+            store.update_pod_status("default", "mine",
+                                    lambda st: setattr(st, "phase", "Running"))
+            # leaves scope -> synthetic DELETED for this watcher
+            moved = store.get("pods", "default/mine")
+            moved.spec.node_name = "n1"
+            store.update("pods", moved, check_rv=False)
+            t.join(timeout=5)
+            assert [e[0] for e in events] == ["ADDED", "MODIFIED", "DELETED"]
+            assert all(e[1] == "mine" for e in events)
+        finally:
+            srv.stop()
+
+    def test_joined_node_uses_scoped_informer(self):
+        from kubernetes_tpu.cli.kadm import join_node
+
+        store = APIStore()
+        srv = APIServer(store).start()
+        node = None
+        try:
+            node = join_node(srv.url, "jn0")
+            import time
+
+            t0 = time.time()
+            while node._informer is None and time.time() - t0 < 5:
+                time.sleep(0.02)
+            assert node._informer.field_selector == "spec.nodeName=jn0"
+            # a pod on another node never enters the informer cache
+            p = MakePod("foreign").obj()
+            p.spec.node_name = "elsewhere"
+            store.create("pods", p)
+            time.sleep(0.3)
+            assert "default/foreign" not in node._informer.cache
+        finally:
+            if node:
+                node.stop()
+            srv.stop()
+
+    def test_preexisting_pod_delete_reaches_scoped_watcher(self):
+        """The transition rule must work for objects that matched BEFORE the
+        watch connected (prev state rides on the event, like the cacher's
+        prevObj) — a listed pod's later deletion must not be swallowed."""
+        import threading
+
+        store = APIStore()
+        srv = APIServer(store).start()
+        try:
+            client = RESTClient(srv.url)
+            pre = MakePod("pre").obj()
+            pre.spec.node_name = "n0"
+            store.create("pods", pre)
+            items, rv = client.list("pods", field_selector="spec.nodeName=n0")
+            assert len(items) == 1
+            events = []
+
+            def consume():
+                for etype, obj in client.watch(
+                        "pods", since_rv=rv, field_selector="spec.nodeName=n0"):
+                    events.append((etype, obj["metadata"]["name"]))
+                    return
+
+            t = threading.Thread(target=consume, daemon=True)
+            t.start()
+            import time
+
+            time.sleep(0.2)
+            store.delete("pods", "default/pre")
+            t.join(timeout=5)
+            assert events == [("DELETED", "pre")]
+        finally:
+            srv.stop()
+
+    def test_double_equals_alias_and_bad_field_400(self):
+        store = APIStore()
+        srv = APIServer(store).start()
+        try:
+            p = MakePod("p0").obj()
+            p.spec.node_name = "n0"
+            store.create("pods", p)
+            client = RESTClient(srv.url)
+            items, _ = client.list("pods", field_selector="spec.nodeName==n0")
+            assert len(items) == 1
+            with pytest.raises(APIError) as e:
+                client.list("pods", field_selector="spec.hostIP=x")
+            assert e.value.code == 400
+        finally:
+            srv.stop()
