@@ -1,0 +1,155 @@
+"""core/v1 Event + the EventRecorder analog.
+
+reference: staging/src/k8s.io/api/core/v1/types.go (Event), client-go
+tools/record (EventRecorder + aggregation): components narrate what they did
+to an object ("Scheduled", "FailedScheduling", "Preempted", "Killing") and
+repeated identical events fold into one object with a bumped `count` instead
+of flooding the store.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Mapping, Optional
+
+from .types import ObjectMeta, new_uid
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+
+@dataclass
+class Event:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_kind: str = ""
+    involved_name: str = ""
+    involved_namespace: str = ""
+    reason: str = ""
+    message: str = ""
+    type: str = NORMAL  # Normal | Warning
+    count: int = 1
+    source: str = ""  # reporting component
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
+
+    kind = "Event"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "Event":
+        inv = d.get("involvedObject") or {}
+        return Event(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            involved_kind=inv.get("kind", ""),
+            involved_name=inv.get("name", ""),
+            involved_namespace=inv.get("namespace", ""),
+            reason=d.get("reason", ""),
+            message=d.get("message", ""),
+            type=d.get("type", NORMAL),
+            count=int(d.get("count", 1) or 1),
+            source=(d.get("source") or {}).get("component", "")
+            if isinstance(d.get("source"), Mapping) else d.get("source", ""),
+            first_timestamp=float(d.get("firstTimestamp", 0.0) or 0.0),
+            last_timestamp=float(d.get("lastTimestamp", 0.0) or 0.0),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind, "apiVersion": "v1",
+            "metadata": self.metadata.to_dict(),
+            "involvedObject": {"kind": self.involved_kind,
+                               "name": self.involved_name,
+                               "namespace": self.involved_namespace},
+            "reason": self.reason,
+            "message": self.message,
+            "type": self.type,
+            "count": self.count,
+            **({"source": {"component": self.source}} if self.source else {}),
+            **({"firstTimestamp": self.first_timestamp}
+               if self.first_timestamp else {}),
+            **({"lastTimestamp": self.last_timestamp}
+               if self.last_timestamp else {}),
+        }
+
+
+class EventRecorder:
+    """client-go tools/record analog: record(obj, type, reason, message).
+
+    Identical (involved, reason, message) events within the aggregation
+    window fold into one Event with count += 1 (EventAggregator behavior) —
+    a failing pod retrying every second must not mint thousands of objects.
+    Failures to write are swallowed: events are best-effort narration and
+    must never break the component emitting them."""
+
+    def __init__(self, store, component: str = "", clock=None):
+        from ..utils import Clock
+
+        self.store = store
+        self.component = component
+        self.clock = clock or Clock()
+        self._lock = threading.Lock()
+        self._known: Dict[str, str] = {}  # aggregation key -> event object name
+
+    def _agg_key(self, kind: str, namespace: str, name: str,
+                 reason: str, message: str) -> str:
+        h = hashlib.sha1(
+            f"{kind}|{namespace}|{name}|{reason}|{message}|{self.component}"
+            .encode()).hexdigest()[:16]
+        return h
+
+    def event(self, obj, etype: str, reason: str, message: str) -> None:
+        kind = getattr(obj, "kind", type(obj).__name__)
+        namespace = getattr(obj.metadata, "namespace", "") or "default"
+        name = obj.metadata.name
+        now = self.clock.now()
+        agg = self._agg_key(kind, namespace, name, reason, message)
+        ev_name = f"{name}.{agg}"
+        key = f"{namespace}/{ev_name}"
+
+        def bump(cur: Event) -> Event:
+            cur.count += 1
+            cur.last_timestamp = now
+            return cur
+
+        try:
+            with self._lock:
+                # create-first for unseen keys (the common case pays ONE store
+                # op); _known remembers aggregation keys we already created so
+                # repeats go straight to the count bump
+                if agg in self._known:
+                    try:
+                        self.store.guaranteed_update("events", key, bump)
+                        return
+                    except Exception:
+                        self._known.pop(agg, None)  # deleted (TTL): recreate
+                try:
+                    self.store.create("events", Event(
+                        metadata=ObjectMeta(name=ev_name, namespace=namespace,
+                                            uid=new_uid()),
+                        involved_kind=kind, involved_name=name,
+                        involved_namespace=namespace,
+                        reason=reason, message=message, type=etype,
+                        source=self.component,
+                        first_timestamp=now, last_timestamp=now))
+                    self._known[agg] = ev_name
+                    if len(self._known) > 10_000:
+                        self._known.clear()  # bounded memory; worst case re-create
+                except Exception:
+                    self.store.guaranteed_update("events", key, bump)
+                    self._known[agg] = ev_name
+        except Exception:
+            pass  # best effort
+
+
+def events_for(store, kind: str, namespace: str, name: str):
+    """All events about one object, oldest first (ktl describe's Events:)."""
+    evs, _ = store.list(
+        "events",
+        lambda e: (e.involved_kind == kind and e.involved_name == name
+                   and e.involved_namespace == namespace))
+    return sorted(evs, key=lambda e: e.last_timestamp)

@@ -36,6 +36,7 @@ from .policy import (
     ServiceAccount,
 )
 from .dra import DeviceClass, ResourceClaim, ResourceSlice
+from .events import Event as CoreEvent
 from .storage import CSINode, PersistentVolume, PersistentVolumeClaim, StorageClass
 from .workloads import (
     CronJob,
@@ -70,6 +71,7 @@ KIND_TO_RESOURCE = {
     "PodDisruptionBudget": "poddisruptionbudgets",
     "PriorityClass": "priorityclasses",
     "ServiceAccount": "serviceaccounts",
+    "Event": "events",
     "ResourceClaim": "resourceclaims",
     "ResourceSlice": "resourceslices",
     "DeviceClass": "deviceclasses",
@@ -97,6 +99,7 @@ RESOURCE_TO_TYPE = {
     "poddisruptionbudgets": PodDisruptionBudget,
     "priorityclasses": PriorityClass,
     "serviceaccounts": ServiceAccount,
+    "events": CoreEvent,
     "resourceclaims": ResourceClaim,
     "resourceslices": ResourceSlice,
     "deviceclasses": DeviceClass,
@@ -127,6 +130,7 @@ GROUP_PREFIX = {
     "poddisruptionbudgets": "/apis/policy/v1",
     "priorityclasses": "/apis/scheduling.k8s.io/v1",
     "serviceaccounts": "/api/v1",
+    "events": "/api/v1",
     "resourceclaims": "/apis/resource.k8s.io/v1beta1",
     "resourceslices": "/apis/resource.k8s.io/v1beta1",
     "deviceclasses": "/apis/resource.k8s.io/v1beta1",

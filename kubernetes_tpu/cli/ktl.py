@@ -137,6 +137,16 @@ def _rows(resource: str, items: List[Dict]):
             str((o.get("status") or {}).get("replicas", 0)),
             str((o.get("status") or {}).get("readyReplicas", 0)),
         ] for o in items]
+    elif resource == "events":
+        headers = ["TYPE", "REASON", "OBJECT", "COUNT", "MESSAGE"]
+        rows = [[
+            o.get("type", ""),
+            o.get("reason", ""),
+            f'{(o.get("involvedObject") or {}).get("kind", "")}/'
+            f'{(o.get("involvedObject") or {}).get("name", "")}',
+            str(o.get("count", 1)),
+            (o.get("message", "") or "")[:80],
+        ] for o in sorted(items, key=lambda e: e.get("lastTimestamp", 0))]
     else:
         headers = ["NAMESPACE", "NAME"]
         rows = [[o["metadata"].get("namespace") or "", o["metadata"]["name"]] for o in items]
@@ -271,6 +281,21 @@ def cmd_describe(client: RESTClient, args) -> int:
     ns = None if resource in CLUSTER_SCOPED else (args.namespace or "default")
     obj = client.get(resource, args.name, ns)
     _print_yaml(obj)
+    # Events: section (kubectl describe's tail)
+    try:
+        kind = obj.get("kind", "")
+        evs, _ = client.list("events", ns or "default")
+        mine = [e for e in evs
+                if (e.get("involvedObject") or {}).get("kind") == kind
+                and (e.get("involvedObject") or {}).get("name") == args.name]
+        if mine:
+            print("\nEvents:")
+            rows = [[e.get("type", ""), e.get("reason", ""),
+                     f'x{e.get("count", 1)}', e.get("message", "")[:90]]
+                    for e in sorted(mine, key=lambda e: e.get("lastTimestamp", 0))]
+            print(fmt_table(["TYPE", "REASON", "COUNT", "MESSAGE"], rows))
+    except APIError:
+        pass
     return 0
 
 

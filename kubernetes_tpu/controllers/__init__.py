@@ -13,6 +13,7 @@ from .podautoscaler import HorizontalPodAutoscalerController  # noqa: F401
 from .replicaset import ReplicaSetController  # noqa: F401
 from .resourcequota import ResourceQuotaController  # noqa: F401
 from .serviceaccount import (  # noqa: F401
+    EventTTLController,
     ServiceAccountController,
     TTLAfterFinishedController,
 )

@@ -42,7 +42,9 @@ class Controller:
                 key = self.key_of_object(kind, obj)
                 if key:
                     self._mark(key)
-        self._watch = self.store.watch(since_rv=rv)
+        # kind-filtered subscription: high-volume kinds this controller
+        # ignores (e.g. events) never consume its watch buffer
+        self._watch = self.store.watch(kind=set(self.watch_kinds), since_rv=rv)
 
     def pump(self, max_events: int = 10_000) -> int:
         if self._watch is None:

@@ -116,3 +116,43 @@ class TTLAfterFinishedController(Controller):
                 pass
         else:
             self._pending_ttl[key] = expire  # AddAfter analog
+
+
+class EventTTLController(Controller):
+    """Expires Event objects after event_ttl (reference: kube-apiserver's
+    --event-ttl, default 1h, enforced by etcd leases; here a sweep controller
+    since the store has no per-object TTLs). Same timer-map pattern as
+    TTLAfterFinished — no busy loops between expiries."""
+
+    watch_kinds = ("events",)
+
+    def __init__(self, *a, event_ttl: float = 3600.0, **kw):
+        super().__init__(*a, **kw)
+        self.event_ttl = event_ttl
+        self._pending: dict = {}  # event key -> expiry
+
+    def key_of_object(self, kind: str, obj) -> Optional[str]:
+        return f"{obj.metadata.namespace}/{obj.metadata.name}"
+
+    def reconcile_once(self) -> int:
+        now = self.clock.now()
+        for key, exp in list(self._pending.items()):
+            if now >= exp:
+                self._mark(key)
+        return super().reconcile_once()
+
+    def sync(self, key: str) -> None:
+        try:
+            ev = self.store.get("events", key)
+        except NotFoundError:
+            self._pending.pop(key, None)
+            return
+        expire = (ev.last_timestamp or self.clock.now()) + self.event_ttl
+        if self.clock.now() >= expire:
+            self._pending.pop(key, None)
+            try:
+                self.store.delete("events", key)
+            except NotFoundError:
+                pass
+        else:
+            self._pending[key] = expire

@@ -61,10 +61,12 @@ class DefaultPreemption:
         self._prep_q = None  # queue.Queue, created lazily
         self._prep_thread: Optional[threading.Thread] = None
 
-    def set_handles(self, framework, store) -> None:
+    def set_handles(self, framework, store, recorder=None) -> None:
         """Injected by the Scheduler (the reference passes framework.Handle)."""
         self.framework = framework
         self.store = store
+        if recorder is not None:
+            self._recorder = recorder
 
     def _pdbs(self):
         if self.store is None:
@@ -203,6 +205,21 @@ class DefaultPreemption:
                 pod.metadata.namespace, pod.metadata.name,
                 lambda st: setattr(st, "nominated_node_name", cand.node_name),
             )
+        except Exception:
+            pass
+        # victim narration (prepareCandidate's "Preempted" event) — uses the
+        # scheduler's recorder (shared clock/aggregation) when injected
+        try:
+            recorder = getattr(self, "_recorder", None)
+            if recorder is None:
+                from ...api.events import EventRecorder
+
+                recorder = self._recorder = EventRecorder(
+                    self.store, component="default-scheduler")
+            for v in cand.victims:
+                recorder.event(
+                    v, "Normal", "Preempted",
+                    f"Preempted by pod {pod.metadata.name} on node {cand.node_name}")
         except Exception:
             pass
         if self.async_preparation:

@@ -119,13 +119,23 @@ class Scheduler:
         # QueueingHintMap per framework (buildQueueingHintMap, scheduler.go:405):
         # (resource, action) -> {plugin name: [hint fn | None]}
         self._hint_maps: Dict[int, Dict] = {}
+        # event narration (EventRecorder, schedule_one.go:1008,1098) —
+        # best-effort, aggregated, never blocks scheduling
+        from ..api.events import EventRecorder
+
+        self.recorder = EventRecorder(store, component="default-scheduler",
+                                      clock=self.clock)
         # ns labels for InterPodAffinity namespaceSelector
         self._ns_labels: Dict[str, Dict[str, str]] = {}
-        # plugins needing framework/store handles (e.g. DefaultPreemption)
+        # plugins needing framework/store handles (e.g. DefaultPreemption);
+        # the recorder is shared so plugin events use the same clock/aggregation
         for fw in self.profiles.values():
             for p in fw.plugins:
                 if hasattr(p, "set_handles"):
-                    p.set_handles(fw, store)
+                    try:
+                        p.set_handles(fw, store, recorder=self.recorder)
+                    except TypeError:
+                        p.set_handles(fw, store)
         # volume plugins share VolumeLister handles fed from the store's
         # storage kinds (the reference reaches these via shared informers)
         self._volume_listers = []
@@ -177,14 +187,23 @@ class Scheduler:
                     lister.add(obj)
         self._push_ns_labels()
         # generous buffer — the scheduler drains every cycle; if it still
-        # falls behind it is evicted and relists (pump_events)
-        self._watch = self.store.watch(since_rv=rv, maxsize=200_000)
+        # falls behind it is evicted and relists (pump_events). Subscribed to
+        # exactly the kinds _handle_event consumes: high-volume kinds it would
+        # ignore (its own Scheduled/FailedScheduling events!) never enqueue.
+        self._watch = self.store.watch(
+            kind=self._watched_kinds(), since_rv=rv, maxsize=200_000)
 
     def _push_ns_labels(self):
         for fw in self.profiles.values():
             for p in fw.plugins:
                 if hasattr(p, "set_namespace_labels"):
                     p.set_namespace_labels(self._ns_labels)
+
+    @staticmethod
+    def _watched_kinds() -> tuple:
+        """The kinds _handle_event consumes (eventhandlers.go informer set)."""
+        return (("nodes", "pods", "namespaces") + STORAGE_KINDS
+                + ("resourceclaims", "resourceslices", "deviceclasses"))
 
     def pump_events(self, max_events: int = 10_000) -> int:
         """Drain pending watch events into cache/queue (deterministic test path;
@@ -245,7 +264,8 @@ class Scheduler:
                 for lister in self._volume_listers:
                     lister.add(obj)
         self._push_ns_labels()
-        self._watch = self.store.watch(since_rv=rv, maxsize=200_000)
+        self._watch = self.store.watch(
+            kind=self._watched_kinds(), since_rv=rv, maxsize=200_000)
         self.queue.move_all_to_active_or_backoff()
 
     _EVENT_ACTION = {ADDED: "add", MODIFIED: "update", DELETED: "delete"}
@@ -565,6 +585,9 @@ class Scheduler:
             self.cache.finish_binding(assumed)
             framework.run_post_bind(state, assumed, result.suggested_host)
             self.scheduled_count += 1
+            self.recorder.event(
+                pod, "Normal", "Scheduled",
+                f"Successfully assigned {pod.key} to {result.suggested_host}")
         except Exception as e:
             # handleBindingCycleError (:344): Unreserve + ForgetPod + requeue
             framework.run_unreserve(state, assumed, result.suggested_host)
@@ -612,6 +635,8 @@ class Scheduler:
             plugins = {status.plugin}
         qp.unschedulable_plugins = tuple(sorted(plugins))
         self.queue.add_unschedulable(qp)
+        self.recorder.event(qp.pod, "Warning", "FailedScheduling",
+                            status.message())
         try:
             def set_cond(st):
                 st.phase = "Pending"

@@ -20,7 +20,7 @@ DEFAULT_CONTROLLERS = (
     "deployment", "replicaset", "statefulset", "daemonset", "job", "cronjob",
     "disruption", "nodelifecycle", "tainteviction", "endpointslice",
     "namespace", "garbagecollector", "resourcequota", "horizontalpodautoscaler",
-    "serviceaccount", "ttlafterfinished",
+    "serviceaccount", "ttlafterfinished", "eventttl",
 )
 
 
@@ -38,6 +38,7 @@ def _controller_registry():
         NodeLifecycleController,
         ReplicaSetController,
         ResourceQuotaController,
+        EventTTLController,
         ServiceAccountController,
         StatefulSetController,
         TaintEvictionController,
@@ -47,6 +48,7 @@ def _controller_registry():
     return {
         "serviceaccount": ServiceAccountController,
         "ttlafterfinished": TTLAfterFinishedController,
+        "eventttl": EventTTLController,
         "deployment": DeploymentController,
         "replicaset": ReplicaSetController,
         "statefulset": StatefulSetController,

@@ -269,28 +269,36 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
         try:
-            idle = 0.0
+            import time as _time
+
+            last_sent = _time.monotonic()
+
+            def maybe_bookmark() -> None:
+                # periodic BOOKMARK (reflector.go:156): advances the client's
+                # resourceVersion so reconnects don't 410-relist, and doubles
+                # as a liveness probe reaping dead clients. Fires on QUIET
+                # streams and on busy-but-filtered ones alike — 5s since the
+                # last actual send, not 5 queue timeouts.
+                nonlocal last_sent
+                if _time.monotonic() - last_sent < 5.0:
+                    return
+                last_sent = _time.monotonic()
+                bl = json.dumps(
+                    {"type": "BOOKMARK",
+                     "object": {"metadata": {"resourceVersion": str(self.store.rv)}}}
+                ).encode() + b"\n"
+                self.wfile.write(f"{len(bl):x}\r\n".encode() + bl + b"\r\n")
+                self.wfile.flush()
+
             while True:
                 ev = w.get(timeout=1.0)
                 if ev is None:
                     if w.terminated or self.server.shutting_down:  # type: ignore[attr-defined]
                         break  # evicted slow watcher: close; client relists
-                    # periodic BOOKMARK on quiet streams (reflector.go:156
-                    # bookmark events): doubles as a liveness probe so a dead
-                    # client fails the write and the watch thread is reaped
-                    # instead of leaking in store._watchers forever.
-                    idle += 1.0
-                    if idle >= 5.0:
-                        idle = 0.0
-                        line = json.dumps(
-                            {"type": "BOOKMARK",
-                             "object": {"metadata": {"resourceVersion": str(self.store.rv)}}}
-                        ).encode() + b"\n"
-                        self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
-                        self.wfile.flush()
+                    maybe_bookmark()
                     continue
-                idle = 0.0
                 if ns and getattr(ev.obj.metadata, "namespace", "") != ns:
+                    maybe_bookmark()
                     continue
                 etype = ev.type
                 if field_pred is not None:
@@ -310,7 +318,9 @@ class _Handler(BaseHTTPRequestHandler):
                     elif prev_ok:
                         etype = "DELETED"  # left scope (or real delete)
                     else:
+                        maybe_bookmark()
                         continue  # never visible to this watcher
+                last_sent = _time.monotonic()
                 line = json.dumps({"type": etype, "object": to_dict(ev.obj)}).encode() + b"\n"
                 self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
                 self.wfile.flush()

@@ -146,18 +146,22 @@ class Watch:
 
     DEFAULT_MAXSIZE = 10_000
 
-    def __init__(self, store: "APIStore", kind: Optional[str],
+    def __init__(self, store: "APIStore", kind=None,
                  maxsize: int = DEFAULT_MAXSIZE):
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize=maxsize or 0)
         self._store = store
-        self._kind = kind
+        # kind: None = all kinds; a str = one kind; a set/tuple = several
+        # (components subscribe to exactly what they handle, so high-volume
+        # kinds they ignore — e.g. events — never fill their buffers)
+        self._kinds = (None if kind is None
+                       else {kind} if isinstance(kind, str) else set(kind))
         self._stopped = False
         self.terminated = False  # True when evicted for falling behind
 
     def _deliver(self, ev: Event) -> None:
         if self.terminated or self._stopped:
             return
-        if self._kind is None or ev.kind == self._kind:
+        if self._kinds is None or ev.kind in self._kinds:
             try:
                 self._q.put_nowait(ev)
             except queue.Full:
@@ -378,7 +382,7 @@ class APIStore:
 
     # -- watch -----------------------------------------------------------------
 
-    def watch(self, kind: Optional[str] = None, since_rv: int = -1,
+    def watch(self, kind=None, since_rv: int = -1,
               maxsize: int = Watch.DEFAULT_MAXSIZE) -> Watch:
         """Subscribe to events. since_rv >= 0 replays history events with rv > since_rv
         first (the Reflector resume contract); since_rv == -1 means 'from now'.

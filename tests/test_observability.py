@@ -125,3 +125,122 @@ class TestCacheDebugger:
         record = json.loads(stream.getvalue().splitlines()[0])
         assert record["msg"].startswith("Trace 'Scheduling'")
         assert record["pod"] == "default/slow"
+
+
+class TestEventsAPI:
+    """core/v1 Events + EventRecorder (client-go tools/record analog):
+    the scheduler narrates Scheduled/FailedScheduling/Preempted; repeats
+    aggregate into one Event with a bumped count."""
+
+    def test_scheduler_records_scheduled_and_failed(self):
+        from kubernetes_tpu.api.events import events_for
+        from kubernetes_tpu.scheduler import Framework, Scheduler
+        from kubernetes_tpu.scheduler.plugins import default_plugins
+        from kubernetes_tpu.store import APIStore
+        from kubernetes_tpu.testing import MakeNode, MakePod
+
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity(
+            {"cpu": "2", "memory": "4Gi", "pods": "10"}).obj())
+        sched = Scheduler(store, Framework(default_plugins()),
+                          pod_initial_backoff=0.01)
+        sched.sync()
+        store.create("pods", MakePod("ok").req({"cpu": "1"}).obj())
+        store.create("pods", MakePod("big").req({"cpu": "64"}).obj())
+        sched.run_until_idle()
+
+        ok_evs = events_for(store, "Pod", "default", "ok")
+        assert any(e.reason == "Scheduled" and "n0" in e.message
+                   for e in ok_evs)
+        big_evs = events_for(store, "Pod", "default", "big")
+        fails = [e for e in big_evs if e.reason == "FailedScheduling"]
+        assert fails and fails[0].type == "Warning"
+
+    def test_repeat_failures_aggregate(self):
+        from kubernetes_tpu.api.events import EventRecorder
+        from kubernetes_tpu.store import APIStore
+        from kubernetes_tpu.testing import MakePod
+
+        store = APIStore()
+        rec = EventRecorder(store, component="test")
+        pod = MakePod("p").obj()
+        for _ in range(5):
+            rec.event(pod, "Warning", "FailedScheduling", "0/1 nodes available")
+        evs, _ = store.list("events")
+        assert len(evs) == 1
+        assert evs[0].count == 5
+
+    def test_preemption_emits_preempted_event(self):
+        import time
+
+        from kubernetes_tpu.api.events import events_for
+        from kubernetes_tpu.scheduler import Framework, Scheduler
+        from kubernetes_tpu.scheduler.plugins import default_plugins
+        from kubernetes_tpu.store import APIStore
+        from kubernetes_tpu.testing import MakeNode, MakePod
+
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity(
+            {"cpu": "2", "pods": "10"}).obj())
+        sched = Scheduler(store, Framework(default_plugins()),
+                          pod_initial_backoff=0.01)
+        sched.sync()
+        store.create("pods", MakePod("low").priority(1).req({"cpu": "2"}).obj())
+        sched.run_until_idle()
+        store.create("pods", MakePod("high").priority(100).req({"cpu": "2"}).obj())
+        for _ in range(5):
+            sched.run_until_idle()
+            time.sleep(0.05)
+            sched.queue.flush_backoff_completed()
+            sched.queue.flush_unschedulable_left_over()
+        evs = events_for(store, "Pod", "default", "low")
+        assert any(e.reason == "Preempted" for e in evs)
+
+    def test_ktl_get_and_describe_events(self):
+        import io
+        from contextlib import redirect_stdout
+
+        from kubernetes_tpu.api.events import EventRecorder
+        from kubernetes_tpu.cli.ktl import main as ktl_main
+        from kubernetes_tpu.server.rest import APIServer
+        from kubernetes_tpu.store import APIStore
+        from kubernetes_tpu.testing import MakePod
+
+        store = APIStore()
+        srv = APIServer(store).start()
+        try:
+            store.create("pods", MakePod("p").req({"cpu": "1"}).obj())
+            EventRecorder(store, component="test").event(
+                store.get("pods", "default/p"), "Normal", "Scheduled",
+                "assigned to n0")
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert ktl_main(["--server", srv.url, "get", "events"]) == 0
+            assert "Scheduled" in buf.getvalue()
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert ktl_main(["--server", srv.url, "describe", "pods", "p"]) == 0
+            out = buf.getvalue()
+            assert "Events:" in out and "Scheduled" in out
+        finally:
+            srv.stop()
+
+    def test_event_ttl_controller_expires(self):
+        from kubernetes_tpu.api.events import EventRecorder
+        from kubernetes_tpu.controllers import EventTTLController
+        from kubernetes_tpu.store import APIStore, NotFoundError
+        from kubernetes_tpu.testing import MakePod
+        from kubernetes_tpu.utils import FakeClock
+        import pytest
+
+        store = APIStore()
+        clock = FakeClock(start=1000.0)
+        rec = EventRecorder(store, component="t", clock=clock)
+        rec.event(MakePod("p").obj(), "Normal", "Scheduled", "x")
+        c = EventTTLController(store, clock=clock, event_ttl=60.0)
+        c.sync_all()
+        c.run_until_stable()
+        assert len(store.list("events")[0]) == 1  # not expired yet
+        clock.step(61)
+        c.run_until_stable()
+        assert store.list("events")[0] == []
