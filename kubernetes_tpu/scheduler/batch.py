@@ -12,7 +12,6 @@ behind the same extension surface, serial path always available).
 
 from __future__ import annotations
 
-import copy
 import queue as _queue
 import threading
 from typing import Dict, List, Optional
@@ -20,7 +19,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..snapshot.tensorizer import TensorCache, build_cluster_tensors, build_pod_batch
-from ..store import APIStore
+from ..store import APIStore, pod_structural_clone
 from .framework import Status
 from .queue import QueuedPodInfo
 from .runtime import Framework
@@ -428,7 +427,9 @@ class BatchScheduler(Scheduler):
         return 1
 
     def _bind_assignment(self, qp: QueuedPodInfo, node_name: str) -> None:
-        assumed = copy.deepcopy(qp.pod)
+        # assume on a structural clone, not a deepcopy — this runs per bind at
+        # batch rates (schedule_one.go:148 DeepCopy, tuned like store.bind)
+        assumed = pod_structural_clone(qp.pod)
         try:
             self.cache.assume_pod(assumed, node_name)
         except ValueError as e:

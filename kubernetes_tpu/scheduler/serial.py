@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..api import Pod
-from ..store import ADDED, DELETED, MODIFIED, APIStore
+from ..store import ADDED, DELETED, MODIFIED, APIStore, pod_structural_clone
 from ..utils import Clock
 from .cache import Cache
 from .framework import CycleState, NodeInfo, Snapshot, Status
@@ -551,15 +551,16 @@ class Scheduler:
 
     def _commit_cycle(self, qp: QueuedPodInfo, result: ScheduleResult) -> bool:
         """assume (:945) -> Reserve -> Permit -> PreBind -> bind (:967) ->
-        PostBind; binds synchronously. The assumed pod is a deep copy
-        (schedule_one.go:148 DeepCopy) — the queued/informer object must never
-        be mutated. Shared by the serial loop and the batch scheduler's serial
+        PostBind; binds synchronously. The assumed pod is a STRUCTURAL clone
+        (schedule_one.go:148 DeepCopy analog, tuned like store.bind): own
+        metadata/spec/status objects, shared immutable innards — plugins may
+        mutate the cloned top-level fields but must treat containers/
+        tolerations/affinity as read-only, the same contract informer objects
+        carry. Shared by the serial loop and the batch scheduler's serial
         fallback (fallback pods rely on these extension points)."""
-        import copy as _copy
-
         pod = qp.pod
         framework = self._fw(pod) or self.framework
-        assumed = _copy.deepcopy(pod)
+        assumed = pod_structural_clone(pod)
         try:
             self.cache.assume_pod(assumed, result.suggested_host)
         except ValueError:

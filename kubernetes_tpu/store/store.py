@@ -110,7 +110,7 @@ class MutationDetector:
                     f"was: {fp}\nnow: {now}")
 
 
-def _pod_structural_clone(pod):
+def pod_structural_clone(pod):
     """Fast pod clone for the bind/status hot paths: fresh Pod, ObjectMeta
     (with own labels/annotations/owner_references/finalizers containers),
     PodSpec, and PodStatus (own conditions list) — ~20x cheaper than deepcopy.
@@ -432,22 +432,22 @@ class APIStore:
         Hot path: binds happen at batch-solver rate (the north star is 100k),
         so the stored object and the event object are STRUCTURAL clones
         (fresh Pod/metadata/spec/status, shared immutable innards like
-        containers) instead of three deepcopies — see _pod_structural_clone."""
+        containers) instead of three deepcopies — see pod_structural_clone."""
         with self._lock:
             key = f"{namespace}/{name}"
             pod = self._pod_internal(key)
             if pod.spec.node_name:
                 raise AlreadyBoundError(f"pod {key} is already bound to {pod.spec.node_name}")
-            new = _pod_structural_clone(pod)
+            new = pod_structural_clone(pod)
             new.spec.node_name = node_name
             self._rv += 1
             new.metadata.resource_version = self._rv
             self._objects["pods"][key] = new
-            self._emit_prepared(MODIFIED, "pods", _pod_structural_clone(new),
+            self._emit_prepared(MODIFIED, "pods", pod_structural_clone(new),
                                 prev=pod)
             # the caller's copy is distinct from both the stored object and
             # the event object (mutating it must corrupt neither)
-            return _pod_structural_clone(new)
+            return pod_structural_clone(new)
 
     def bind_many(self, bindings: Iterable[Tuple[str, str, str]]) -> Tuple[int, List[Tuple[str, str]]]:
         """Batched bind: one lock acquisition for a whole solver batch.
@@ -465,13 +465,13 @@ class APIStore:
                     if pod.spec.node_name:
                         raise AlreadyBoundError(
                             f"pod {key} is already bound to {pod.spec.node_name}")
-                    new = _pod_structural_clone(pod)
+                    new = pod_structural_clone(pod)
                     new.spec.node_name = node_name
                     self._rv += 1
                     new.metadata.resource_version = self._rv
                     self._objects["pods"][key] = new
                     self._emit_prepared(MODIFIED, "pods",
-                                        _pod_structural_clone(new), prev=pod)
+                                        pod_structural_clone(new), prev=pod)
                     bound += 1
                 except (NotFoundError, AlreadyBoundError) as e:
                     errors.append((key, str(e)))
@@ -483,11 +483,11 @@ class APIStore:
         with self._lock:
             key = f"{namespace}/{name}"
             old = self._pod_internal(key)
-            pod = _pod_structural_clone(old)
+            pod = pod_structural_clone(old)
             mutate_status(pod.status)
             self._rv += 1
             pod.metadata.resource_version = self._rv
             self._objects["pods"][key] = pod
-            self._emit_prepared(MODIFIED, "pods", _pod_structural_clone(pod),
+            self._emit_prepared(MODIFIED, "pods", pod_structural_clone(pod),
                                 prev=old)
-            return _pod_structural_clone(pod)
+            return pod_structural_clone(pod)
