@@ -35,6 +35,7 @@ from .policy import (
     ResourceQuota,
     ServiceAccount,
 )
+from .crd import CustomResourceDefinition
 from .dra import DeviceClass, ResourceClaim, ResourceSlice
 from .events import Event as CoreEvent
 from .storage import CSINode, PersistentVolume, PersistentVolumeClaim, StorageClass
@@ -75,6 +76,7 @@ KIND_TO_RESOURCE = {
     "ResourceClaim": "resourceclaims",
     "ResourceSlice": "resourceslices",
     "DeviceClass": "deviceclasses",
+    "CustomResourceDefinition": "customresourcedefinitions",
 }
 RESOURCE_TO_TYPE = {
     "pods": Pod,
@@ -103,10 +105,11 @@ RESOURCE_TO_TYPE = {
     "resourceclaims": ResourceClaim,
     "resourceslices": ResourceSlice,
     "deviceclasses": DeviceClass,
+    "customresourcedefinitions": CustomResourceDefinition,
 }
 CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses",
                   "csinodes", "resourceslices", "deviceclasses",
-                  "priorityclasses"}
+                  "priorityclasses", "customresourcedefinitions"}
 GROUP_PREFIX = {
     "pods": "/api/v1",
     "nodes": "/api/v1",
@@ -134,6 +137,7 @@ GROUP_PREFIX = {
     "resourceclaims": "/apis/resource.k8s.io/v1beta1",
     "resourceslices": "/apis/resource.k8s.io/v1beta1",
     "deviceclasses": "/apis/resource.k8s.io/v1beta1",
+    "customresourcedefinitions": "/apis/apiextensions.k8s.io/v1",
 }
 
 

@@ -36,10 +36,22 @@ ALIASES = {
 
 
 def resolve_resource(name: str) -> str:
-    r = ALIASES.get(name, name)
-    if r not in RESOURCE_TO_TYPE:
-        raise SystemExit(f"error: unknown resource type {name!r}")
-    return r
+    """Static aliases resolve locally; anything else passes through so the
+    server's DynamicRegistry can match CRD plurals/singulars/shortNames
+    (unknown names come back as a clean 404)."""
+    return ALIASES.get(name, name)
+
+
+def resolve_kind(client: RESTClient, kind: str) -> Optional[str]:
+    """Manifest kind -> resource plural; built-ins locally, CRDs via
+    discovery."""
+    resource = KIND_TO_RESOURCE.get(kind)
+    if resource is not None:
+        return resource
+    try:
+        return client._discover(kind.lower())["name"]
+    except APIError:
+        return None
 
 
 def load_manifests(path: str) -> List[Dict]:
@@ -157,7 +169,7 @@ def cmd_create(client: RESTClient, args) -> int:
     rc = 0
     for doc in load_manifests(args.filename):
         kind = doc.get("kind", "")
-        resource = KIND_TO_RESOURCE.get(kind)
+        resource = resolve_kind(client, kind)
         if resource is None:
             print(f"error: unsupported kind {kind!r}", file=sys.stderr)
             rc = 1
@@ -176,7 +188,7 @@ def cmd_apply(client: RESTClient, args) -> int:
     rc = 0
     for doc in load_manifests(args.filename):
         kind = doc.get("kind", "")
-        resource = KIND_TO_RESOURCE.get(kind)
+        resource = resolve_kind(client, kind)
         if resource is None:
             print(f"error: unsupported kind {kind!r}", file=sys.stderr)
             rc = 1
@@ -518,9 +530,16 @@ def cmd_autoscale(client: RESTClient, args) -> int:
 
 
 def cmd_api_resources(client: RESTClient, args) -> int:
-    rows = [[r, GROUP_PREFIX[r].split("/")[-2] if "apis" in GROUP_PREFIX[r] else "v1"]
-            for r in sorted(RESOURCE_TO_TYPE)]
-    print(fmt_table(["NAME", "APIVERSION"], rows))
+    try:
+        doc = client.request("GET", "/apis")
+        rows = [[r, e["prefix"].lstrip("/").replace("apis/", "").replace("api/", ""),
+                 "true" if e.get("namespaced") else "false", e.get("kind", "")]
+                for r, e in sorted((doc.get("resources") or {}).items())]
+        print(fmt_table(["NAME", "APIVERSION", "NAMESPACED", "KIND"], rows))
+    except APIError:
+        rows = [[r, GROUP_PREFIX[r].split("/")[-2] if "apis" in GROUP_PREFIX[r] else "v1"]
+                for r in sorted(RESOURCE_TO_TYPE)]
+        print(fmt_table(["NAME", "APIVERSION"], rows))
     return 0
 
 

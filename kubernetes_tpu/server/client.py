@@ -29,6 +29,28 @@ class RESTClient:
         # X-Remote-User convention honored by servers without an authenticator
         self.token = token
         self.user = user
+        # plural/alias -> {"prefix", "namespaced"} for CRD-served resources,
+        # filled lazily from GET /apis (the reference's discovery client)
+        self._dynamic: Dict[str, Dict[str, Any]] = {}
+
+    def _discover(self, resource: str) -> Dict[str, Any]:
+        info = self._dynamic.get(resource)
+        if info is not None:
+            return info
+        doc = self.request("GET", "/apis")
+        self._dynamic = {}
+        for plural, entry in (doc.get("resources") or {}).items():
+            self._dynamic[plural] = entry
+            for alias in entry.get("shortNames") or []:
+                self._dynamic.setdefault(alias, entry)
+            for alias in (entry.get("singular", ""),
+                          entry.get("kind", "").lower()):
+                if alias:
+                    self._dynamic.setdefault(alias, entry)
+        info = self._dynamic.get(resource)
+        if info is None:
+            raise APIError(404, f"unknown resource {resource!r} (discovery)")
+        return info
 
     def _headers(self) -> Dict[str, str]:
         h = {"Content-Type": "application/json"}
@@ -40,8 +62,13 @@ class RESTClient:
 
     def _path(self, resource: str, namespace: Optional[str], name: Optional[str] = None,
               subresource: Optional[str] = None) -> str:
-        prefix = GROUP_PREFIX[resource]
-        if resource in CLUSTER_SCOPED or namespace is None:
+        prefix = GROUP_PREFIX.get(resource)
+        if prefix is not None:
+            namespaced = resource not in CLUSTER_SCOPED
+        else:
+            info = self._discover(resource)
+            prefix, namespaced = info["prefix"], bool(info.get("namespaced", True))
+        if not namespaced or namespace is None:
             p = f"{prefix}/{resource}"
         else:
             p = f"{prefix}/namespaces/{namespace}/{resource}"

@@ -123,6 +123,15 @@ def json_merge_patch(target, patch):
     return out
 
 
+class _PatchParseError(Exception):
+    """Carries a buffered (code, msg, reason) verdict out of the PATCH
+    transaction block."""
+
+    def __init__(self, verdict):
+        super().__init__(verdict[1])
+        self.verdict = verdict
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "kubernetes-tpu-apiserver"
@@ -135,6 +144,86 @@ class _Handler(BaseHTTPRequestHandler):
     @property
     def store(self) -> APIStore:
         return self.server.store  # type: ignore[attr-defined]
+
+    # ---- dynamic (CRD-served) resources --------------------------------------
+
+    def _crd(self, resource: str):
+        """CustomResourceDefinition serving `resource`, or None. Static types
+        win: a CRD cannot shadow a built-in (the reference's aggregation
+        layer has the same precedence)."""
+        if resource in RESOURCE_TO_TYPE:
+            return None
+        reg = getattr(self.server, "crds", None)
+        return reg.resolve(resource) if reg is not None else None
+
+    def _known(self, resource: str, crd) -> bool:
+        return resource in RESOURCE_TO_TYPE or crd is not None
+
+    def _cluster_scoped(self, resource: str, crd=None) -> bool:
+        if resource in RESOURCE_TO_TYPE:
+            return resource in CLUSTER_SCOPED
+        return crd is not None and crd.scope == "Cluster"
+
+    def _parse_obj(self, resource: str, body, crd):
+        """-> (obj, None) or (None, (code, msg, reason)). Dynamic objects get
+        structural-schema defaulting + pruning + validation here — the same
+        write path the reference's apiextensions handler runs."""
+        from ..api.crd import Unstructured, validate_custom_object
+
+        if not isinstance(body, dict):
+            return None, (400, f"body must be a JSON object, got "
+                          f"{type(body).__name__}", "BadRequest")
+        if crd is not None:
+            obj, errs = validate_custom_object(crd, Unstructured.from_dict(body))
+            if errs:
+                return None, (422, "; ".join(errs), "Invalid")
+            return obj, None
+        try:
+            obj = from_dict(resource, body)
+        except Exception as e:
+            return None, (400, f"cannot parse {resource}: {e}", "BadRequest")
+        if resource == "customresourcedefinitions":
+            err = obj.validate()
+            if err is not None:
+                return None, (422, err, "Invalid")
+            # a CRD may not shadow a built-in resource (static check; the
+            # cross-CRD plural conflict is checked under the store lock at
+            # write time — see _crd_conflict)
+            if obj.names.plural in RESOURCE_TO_TYPE:
+                return None, (422, f"spec.names.plural {obj.names.plural!r} "
+                              "shadows a built-in resource", "Invalid")
+        return obj, None
+
+    def _crd_conflict(self, obj):
+        """Plurals are a single flat route namespace: a second group claiming
+        an existing plural conflicts instead of silently stealing the route
+        and the store bucket. Reads the store directly (re-entrant under the
+        caller's transaction) so concurrent CRD writes serialize — never the
+        DynamicRegistry, whose lock ranks ABOVE the store lock."""
+        existing, _rv = self.store.list("customresourcedefinitions")
+        for other in existing:
+            if (other.names.plural == obj.names.plural
+                    and other.metadata.name != obj.metadata.name):
+                return (409, f"plural {obj.names.plural!r} already served by "
+                        f"{other.metadata.name}", "Conflict")
+            if other.metadata.name == obj.metadata.name and \
+                    other.scope != obj.scope:
+                # scope switches the store key scheme (ns/name vs name) and
+                # would orphan existing objects; the reference makes it
+                # immutable outright
+                return (422, "spec.scope is immutable", "Invalid")
+        return None
+
+    def _crd_still_served(self, crd):
+        """Inside a CR write transaction: the CRD resolved before the lock may
+        have been deleted concurrently (its delete cascades CR removal under
+        the same lock) — a write against a stale CRD would orphan the object."""
+        try:
+            self.store.get("customresourcedefinitions", crd.metadata.name)
+            return None
+        except NotFoundError:
+            return (404, f"unknown resource {crd.names.plural} "
+                    "(CRD deleted)", "NotFound")
 
     # ---- authn/authz (DefaultBuildHandlerChain order: authn -> authz) --------
 
@@ -182,8 +271,34 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(code, {"kind": "Status", "status": "Failure",
                                "message": message, "reason": reason, "code": code})
 
-    def _key(self, resource, ns, name) -> str:
-        return f"{ns}/{name}" if resource not in CLUSTER_SCOPED else name
+    def _key(self, resource, ns, name, crd=None) -> str:
+        return name if self._cluster_scoped(resource, crd) else f"{ns}/{name}"
+
+    def _discovery(self) -> None:
+        """GET /apis: every servable resource -> {prefix, namespaced, kind} —
+        static registries plus live CRDs. Clients use this instead of baked-in
+        tables for dynamic kinds (the reference's APIGroupDiscoveryList)."""
+        from ..api.serialize import GROUP_PREFIX, KIND_TO_RESOURCE
+
+        resources = {
+            res: {"name": res,
+                  "prefix": GROUP_PREFIX[res],
+                  "namespaced": res not in CLUSTER_SCOPED,
+                  "kind": kind}
+            for kind, res in KIND_TO_RESOURCE.items()
+        }
+        reg = getattr(self.server, "crds", None)
+        if reg is not None:
+            for crd in reg.all():
+                resources[crd.names.plural] = {
+                    "name": crd.names.plural,
+                    "prefix": crd.group_prefix,
+                    "namespaced": crd.scope == "Namespaced",
+                    "kind": crd.names.kind,
+                    "singular": crd.names.singular,
+                    "shortNames": list(crd.names.short_names),
+                }
+        self._send_json(200, {"kind": "APIResourceList", "resources": resources})
 
     def _read_body(self):
         length = int(self.headers.get("Content-Length", 0) or 0)
@@ -215,14 +330,26 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if url.path in ("/apis", "/api"):
+            # discovery needs a valid identity but no resource grant (the
+            # reference binds system:discovery to all authenticated users)
+            if self._user() is None:
+                self._error(401, "Unauthorized: invalid or missing bearer token",
+                            "Unauthorized")
+                return
+            self._discovery()
+            return
         parsed = _parse_path(url.path)
         if parsed is None:
             self._error(404, f"unknown path {url.path}")
             return
         resource, ns, name, _sub = parsed
-        if resource not in RESOURCE_TO_TYPE:
+        crd = self._crd(resource)
+        if not self._known(resource, crd):
             self._error(404, f"unknown resource {resource}")
             return
+        if crd is not None:
+            resource = crd.names.plural  # singular/shortName aliases
         q = parse_qs(url.query)
         is_watch = name is None and q.get("watch", ["false"])[0] == "true"
         verb = "watch" if is_watch else ("get" if name is not None else "list")
@@ -239,7 +366,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             if name is not None:
-                obj = self.store.get(resource, self._key(resource, ns, name))
+                obj = self.store.get(resource, self._key(resource, ns, name, crd))
                 self._send_json(200, to_dict(obj))
             else:
                 def pred(o, _ns=ns, _fp=field_pred):
@@ -351,6 +478,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, "unknown path")
             return
         resource, ns, name, sub = parsed
+        # canonicalize CRD aliases BEFORE authz so a grant on the plural
+        # covers every alias spelling, exactly as in do_GET
+        crd = self._crd(resource)
+        if crd is not None:
+            resource = crd.names.plural
         verb = "bind" if (sub == "binding" and resource == "pods") else "create"
         user = self._authenticated_user(verb, resource)
         if user is None:
@@ -373,15 +505,14 @@ class _Handler(BaseHTTPRequestHandler):
             except AlreadyBoundError as e:
                 self._error(409, str(e), "Conflict")
             return
-        if resource not in RESOURCE_TO_TYPE:
+        if not self._known(resource, crd):
             self._error(404, f"unknown resource {resource}")
             return
-        try:
-            obj = from_dict(resource, body)
-        except Exception as e:
-            self._error(400, f"cannot parse {resource}: {e}")
+        obj, perr = self._parse_obj(resource, body, crd)
+        if perr is not None:
+            self._error(*perr)
             return
-        if ns and resource not in CLUSTER_SCOPED:
+        if ns and not self._cluster_scoped(resource, crd):
             obj.metadata.namespace = ns
         # admission + create under one store transaction: concurrent creates
         # cannot both pass a quota check they jointly exceed. The verdict is
@@ -390,7 +521,12 @@ class _Handler(BaseHTTPRequestHandler):
         err = None
         created = None
         with self.store.transaction():
-            err = self._admission_verdict(resource, "CREATE", obj, user)
+            if resource == "customresourcedefinitions":
+                err = self._crd_conflict(obj)
+            elif crd is not None:
+                err = self._crd_still_served(crd)
+            if err is None:
+                err = self._admission_verdict(resource, "CREATE", obj, user)
             if err is None:
                 try:
                     created = self.store.create(resource, obj)
@@ -434,31 +570,51 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, "unknown path")
             return
         resource, ns, name, _ = parsed
+        crd = self._crd(resource)
+        if crd is not None:
+            resource = crd.names.plural
         user = self._authenticated_user("update", resource)
         if user is None:
             return
+        if not self._known(resource, crd):
+            self._error(404, f"unknown resource {resource}")
+            return
         try:
             body = self._read_body()
-            obj = from_dict(resource, body)
-        except Exception as e:
-            self._error(400, f"cannot parse: {e}")
+        except json.JSONDecodeError as e:
+            self._error(400, f"invalid JSON: {e}")
+            return
+        obj, perr = self._parse_obj(resource, body, crd)
+        if perr is not None:
+            self._error(*perr)
             return
         # the URL is authoritative for namespace/name (the body may omit them)
-        if ns and resource not in CLUSTER_SCOPED:
+        if ns and not self._cluster_scoped(resource, crd):
             obj.metadata.namespace = ns
         if obj.metadata.name and obj.metadata.name != name:
             self._error(400, f"name mismatch: URL {name!r} vs body {obj.metadata.name!r}")
             return
         obj.metadata.name = name
-        if not self._admit(resource, "UPDATE", obj, user):
+        err = None
+        updated = None
+        with self.store.transaction():
+            if resource == "customresourcedefinitions":
+                err = self._crd_conflict(obj)
+            elif crd is not None:
+                err = self._crd_still_served(crd)
+            if err is None:
+                err = self._admission_verdict(resource, "UPDATE", obj, user)
+            if err is None:
+                try:
+                    updated = self.store.update(resource, obj)
+                except NotFoundError as e:
+                    err = (404, str(e), "NotFound")
+                except ConflictError as e:
+                    err = (409, str(e), "Conflict")
+        if err is not None:
+            self._error(*err)
             return
-        try:
-            updated = self.store.update(resource, obj)
-            self._send_json(200, to_dict(updated))
-        except NotFoundError as e:
-            self._error(404, str(e), "NotFound")
-        except ConflictError as e:
-            self._error(409, str(e), "Conflict")
+        self._send_json(200, to_dict(updated))
 
     def do_PATCH(self):
         """JSON Merge Patch / strategic-merge-patch (degraded to merge
@@ -470,6 +626,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, "unknown path")
             return
         resource, ns, name, _ = parsed
+        crd = self._crd(resource)
+        if crd is not None:
+            resource = crd.names.plural
         user = self._authenticated_user("patch", resource)
         if user is None:
             return
@@ -479,21 +638,30 @@ class _Handler(BaseHTTPRequestHandler):
                         "application/json", ""):
             self._error(415, f"unsupported patch type {ctype!r}")
             return
+        if not self._known(resource, crd):
+            self._error(404, f"unknown resource {resource}")
+            return
         try:
             patch = self._read_body()
         except json.JSONDecodeError as e:
             self._error(400, f"invalid JSON: {e}")
             return
-        key = self._key(resource, ns, name)
+        key = self._key(resource, ns, name, crd)
         err = None
         updated = None
         with self.store.transaction():
             try:
                 existing = self.store.get(resource, key)
                 merged = json_merge_patch(to_dict(existing), patch)
-                obj = from_dict(resource, merged)
+                obj, perr = self._parse_obj(resource, merged, crd)
+                if perr is None and resource == "customresourcedefinitions":
+                    perr = self._crd_conflict(obj)
+                elif perr is None and crd is not None:
+                    perr = self._crd_still_served(crd)
+                if perr is not None:
+                    raise _PatchParseError(perr)
                 obj.metadata.name = name
-                if ns and resource not in CLUSTER_SCOPED:
+                if ns and not self._cluster_scoped(resource, crd):
                     obj.metadata.namespace = ns
                 # patch is read-modify-write of the current object: carry its
                 # RV so a concurrent writer between our get and update conflicts
@@ -505,6 +673,8 @@ class _Handler(BaseHTTPRequestHandler):
                 err = (404, str(e), "NotFound")
             except ConflictError as e:
                 err = (409, str(e), "Conflict")
+            except _PatchParseError as e:
+                err = e.verdict
             except Exception as e:
                 err = (400, f"cannot apply patch: {e}", "Invalid")
         if err is not None:
@@ -518,10 +688,16 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(404, "unknown path")
             return
         resource, ns, name, _ = parsed
+        crd = self._crd(resource)
+        if crd is not None:
+            resource = crd.names.plural
         user = self._authenticated_user("delete", resource)
         if user is None:
             return
-        key = self._key(resource, ns, name)
+        if not self._known(resource, crd):
+            self._error(404, f"unknown resource {resource}")
+            return
+        key = self._key(resource, ns, name, crd)
         err = None
         obj = None
         with self.store.transaction():
@@ -531,6 +707,15 @@ class _Handler(BaseHTTPRequestHandler):
                 err = self._admission_verdict(resource, "DELETE", existing, user)
                 if err is None:
                     obj = self.store.delete(resource, key)
+                    if resource == "customresourcedefinitions":
+                        # CR data dies with its CRD (the reference's
+                        # apiextensions finalizer); same transaction so a
+                        # same-plural CRD recreated later starts empty instead
+                        # of resurrecting schema-stale objects
+                        plural = existing.names.plural
+                        crs, _rv = self.store.list(plural)
+                        for cr in crs:
+                            self.store.delete(plural, self.store.object_key(cr))
             except NotFoundError as e:
                 err = (404, str(e), "NotFound")
         if err is not None:
@@ -550,6 +735,9 @@ class APIServer:
         self._httpd.store = store  # type: ignore[attr-defined]
         self._httpd.verbose = verbose  # type: ignore[attr-defined]
         self._httpd.shutting_down = False  # type: ignore[attr-defined]
+        from ..api.crd import DynamicRegistry
+
+        self._httpd.crds = DynamicRegistry(store)  # type: ignore[attr-defined]
         if admission == "default":
             from .admission import default_admission_chain
 

@@ -1,0 +1,368 @@
+"""CustomResourceDefinitions: dynamic types served end to end.
+
+reference semantics: staging/src/k8s.io/apiextensions-apiserver — CRD create
+makes /apis/{group}/{version}/{plural} servable; structural schemas validate,
+default, and prune on writes; aliases (singular/shortNames) resolve; deletes
+of the CRD make the resource unservable again.
+"""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.api.crd import (
+    CustomResourceDefinition,
+    Unstructured,
+    prune_and_default,
+    validate_structural,
+)
+from kubernetes_tpu.cli.ktl import main as ktl_main
+from kubernetes_tpu.server import APIError, APIServer, RESTClient
+from kubernetes_tpu.store import APIStore
+
+
+CRD = {
+    "apiVersion": "apiextensions.k8s.io/v1",
+    "kind": "CustomResourceDefinition",
+    "metadata": {"name": "tpujobs.batch.tpu.dev"},
+    "spec": {
+        "group": "batch.tpu.dev",
+        "scope": "Namespaced",
+        "names": {"plural": "tpujobs", "singular": "tpujob", "kind": "TPUJob",
+                  "shortNames": ["tj"]},
+        "versions": [{
+            "name": "v1",
+            "served": True,
+            "storage": True,
+            "schema": {"openAPIV3Schema": {
+                "type": "object",
+                "required": ["spec"],
+                "properties": {
+                    "spec": {
+                        "type": "object",
+                        "required": ["replicas"],
+                        "properties": {
+                            "replicas": {"type": "integer", "minimum": 1},
+                            "topology": {"type": "string",
+                                         "enum": ["2x2", "2x4", "4x4"],
+                                         "default": "2x2"},
+                            "preemptible": {"type": "boolean", "default": False},
+                        },
+                    },
+                    "status": {"type": "object",
+                               "x-kubernetes-preserve-unknown-fields": True,
+                               "properties": {}},
+                },
+            }},
+        }],
+    },
+}
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer(APIStore()).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient(server.url)
+
+
+class TestSchema:
+    def test_validate_types_and_bounds(self):
+        schema = CRD["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+        assert validate_structural(schema, {"spec": {"replicas": 3}}) == []
+        errs = validate_structural(schema, {"spec": {"replicas": 0}})
+        assert any("minimum" in e for e in errs)
+        errs = validate_structural(schema, {"spec": {"replicas": "three"}})
+        assert any("expected integer" in e for e in errs)
+        errs = validate_structural(schema, {})
+        assert any("required field 'spec'" in e for e in errs)
+        errs = validate_structural(schema, {"spec": {"replicas": 1,
+                                                     "topology": "3x3"}})
+        assert any("enum" in e for e in errs)
+
+    def test_defaulting_and_pruning(self):
+        schema = CRD["spec"]["versions"][0]["schema"]["openAPIV3Schema"]
+        out = prune_and_default(schema, {"spec": {"replicas": 2}, "junk": 1})
+        assert out["spec"]["topology"] == "2x2"
+        assert out["spec"]["preemptible"] is False
+        assert "junk" not in out  # pruned: not in properties
+        # preserve-unknown-fields keeps status payloads
+        out = prune_and_default(schema, {"spec": {"replicas": 2},
+                                         "status": {"phase": "Running"}})
+        assert out["status"] == {"phase": "Running"}
+
+    def test_crd_self_validation(self):
+        crd = CustomResourceDefinition.from_dict(CRD)
+        assert crd.validate() is None
+        bad = CustomResourceDefinition.from_dict(CRD)
+        bad.metadata.name = "wrong"
+        assert "metadata.name" in bad.validate()
+        bad2 = CustomResourceDefinition.from_dict(CRD)
+        bad2.versions[0].storage = False
+        assert "storage" in bad2.validate()
+
+
+class TestServedCRD:
+    def test_unknown_before_crd_then_served(self, client):
+        with pytest.raises(APIError) as e:
+            client.list("tpujobs")
+        assert e.value.code == 404
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        cr = {"apiVersion": "batch.tpu.dev/v1", "kind": "TPUJob",
+              "metadata": {"name": "train-1", "namespace": "default"},
+              "spec": {"replicas": 4, "topology": "2x4"}}
+        out = client.create("tpujobs", cr)
+        assert out["spec"]["replicas"] == 4
+        assert out["spec"]["preemptible"] is False  # defaulted
+        got = client.get("tpujobs", "train-1")
+        assert got["spec"]["topology"] == "2x4"
+        items, _ = client.list("tpujobs")
+        assert len(items) == 1
+
+    def test_validation_rejected_422(self, client):
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        with pytest.raises(APIError) as e:
+            client.create("tpujobs", {
+                "metadata": {"name": "bad"}, "spec": {"replicas": 0}})
+        assert e.value.code == 422
+
+    def test_alias_and_shortname_resolution(self, client):
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        client.create("tpujobs", {"metadata": {"name": "a"},
+                                  "spec": {"replicas": 1}})
+        # server resolves singular and shortName paths
+        assert client.request("GET", "/apis/batch.tpu.dev/v1/namespaces/default/tpujob/a")
+        assert client.request("GET", "/apis/batch.tpu.dev/v1/namespaces/default/tj/a")
+
+    def test_patch_and_delete(self, client):
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        client.create("tpujobs", {"metadata": {"name": "a"},
+                                  "spec": {"replicas": 1}})
+        out = client.patch("tpujobs", "a", {"spec": {"replicas": 8}})
+        assert out["spec"]["replicas"] == 8
+        # patch that breaks the schema is rejected inside the transaction
+        with pytest.raises(APIError) as e:
+            client.patch("tpujobs", "a", {"spec": {"replicas": -1}})
+        assert e.value.code == 422
+        client.delete("tpujobs", "a")
+        with pytest.raises(APIError):
+            client.get("tpujobs", "a")
+
+    def test_watch_streams_custom_objects(self, client):
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        _, rv = client.list("tpujobs")
+        seen = []
+
+        def consume():
+            for etype, obj in client.watch("tpujobs", since_rv=rv):
+                seen.append((etype, obj["metadata"]["name"]))
+                return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        client.create("tpujobs", {"metadata": {"name": "w"},
+                                  "spec": {"replicas": 2}})
+        t.join(timeout=5)
+        assert seen == [("ADDED", "w")]
+
+    def test_crd_delete_unserves_resource(self, client):
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        client.create("tpujobs", {"metadata": {"name": "a"},
+                                  "spec": {"replicas": 1}})
+        client.delete("customresourcedefinitions", "tpujobs.batch.tpu.dev",
+                      namespace=None)
+        with pytest.raises(APIError) as e:
+            client.list("tpujobs")
+        assert e.value.code == 404
+
+    def test_cluster_scoped_crd(self, client):
+        crd = {
+            "metadata": {"name": "meshes.infra.tpu.dev"},
+            "spec": {"group": "infra.tpu.dev", "scope": "Cluster",
+                     "names": {"plural": "meshes", "kind": "Mesh"},
+                     "versions": [{"name": "v1"}]},
+        }
+        client.create("customresourcedefinitions", crd, namespace=None)
+        client.create("meshes", {"metadata": {"name": "ici-8x8"}, "spec": {}},
+                      namespace=None)
+        got = client.get("meshes", "ici-8x8", namespace=None)
+        assert got["metadata"]["name"] == "ici-8x8"
+        # no namespace segment in the key: list sees it without ns filtering
+        items, _ = client.list("meshes")
+        assert [o["metadata"]["name"] for o in items] == ["ici-8x8"]
+
+    def test_crd_delete_purges_custom_objects(self, client):
+        """Recreating a same-plural CRD must not resurrect schema-stale CRs
+        (the reference deletes CR data via the apiextensions finalizer)."""
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        client.create("tpujobs", {"metadata": {"name": "stale"},
+                                  "spec": {"replicas": 9}})
+        client.delete("customresourcedefinitions", "tpujobs.batch.tpu.dev",
+                      namespace=None)
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        items, _ = client.list("tpujobs")
+        assert items == []
+
+    def test_duplicate_plural_cross_group_conflicts(self, client):
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        other = {
+            "metadata": {"name": "tpujobs.other.dev"},
+            "spec": {"group": "other.dev", "scope": "Namespaced",
+                     "names": {"plural": "tpujobs", "kind": "OtherJob"},
+                     "versions": [{"name": "v1"}]},
+        }
+        with pytest.raises(APIError) as e:
+            client.create("customresourcedefinitions", other, namespace=None)
+        assert e.value.code == 409
+
+    def test_crd_cannot_shadow_builtin(self, client):
+        shadow = {
+            "metadata": {"name": "pods.fake.dev"},
+            "spec": {"group": "fake.dev", "scope": "Namespaced",
+                     "names": {"plural": "pods", "kind": "FakePod"},
+                     "versions": [{"name": "v1"}]},
+        }
+        with pytest.raises(APIError) as e:
+            client.create("customresourcedefinitions", shadow, namespace=None)
+        assert e.value.code == 422
+
+    def test_additional_properties_false_prunes(self):
+        schema = {"type": "object",
+                  "properties": {"replicas": {"type": "integer"}},
+                  "additionalProperties": False}
+        out = prune_and_default(schema, {"replicas": 1, "bogus": 2})
+        assert out == {"replicas": 1}
+
+    def test_non_dict_body_clean_400(self, client):
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        for bad in ([], 5, "x"):
+            with pytest.raises(APIError) as e:
+                client.request(
+                    "POST", "/apis/batch.tpu.dev/v1/namespaces/default/tpujobs",
+                    bad)
+            assert e.value.code == 400
+
+    def test_modified_crd_drops_stale_aliases(self, client):
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        client.create("tpujobs", {"metadata": {"name": "a"},
+                                  "spec": {"replicas": 1}})
+        updated = __import__("copy").deepcopy(CRD)
+        updated["spec"]["names"]["shortNames"] = ["tpj"]
+        got = client.get("customresourcedefinitions", "tpujobs.batch.tpu.dev",
+                         namespace=None)
+        updated["metadata"]["resourceVersion"] = got["metadata"]["resourceVersion"]
+        client.update("customresourcedefinitions", updated, namespace=None)
+        # old shortName stops resolving; the new one works
+        with pytest.raises(APIError) as e:
+            client.request("GET", "/apis/batch.tpu.dev/v1/namespaces/default/tj/a")
+        assert e.value.code == 404
+        assert client.request(
+            "GET", "/apis/batch.tpu.dev/v1/namespaces/default/tpj/a")
+
+    def test_singular_differing_from_kind_resolves(self, server):
+        from kubernetes_tpu.cli.ktl import main as _ktl
+
+        c = RESTClient(server.url)
+        crd = {
+            "metadata": {"name": "widgets.fab.dev"},
+            "spec": {"group": "fab.dev", "scope": "Namespaced",
+                     "names": {"plural": "widgets", "singular": "wdg",
+                               "kind": "Widget"},
+                     "versions": [{"name": "v1"}]},
+        }
+        c.create("customresourcedefinitions", crd, namespace=None)
+        c.create("widgets", {"metadata": {"name": "w1"}, "spec": {}})
+        # a fresh client resolves the singular via discovery
+        c2 = RESTClient(server.url)
+        items, _ = c2.list("wdg")
+        assert [o["metadata"]["name"] for o in items] == ["w1"]
+
+    def test_scope_is_immutable(self, client):
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        with pytest.raises(APIError) as e:
+            client.patch("customresourcedefinitions", "tpujobs.batch.tpu.dev",
+                         {"spec": {"scope": "Cluster"}}, namespace=None)
+        assert e.value.code == 422
+
+    def test_invalid_crd_rejected(self, client):
+        with pytest.raises(APIError) as e:
+            client.create("customresourcedefinitions", {
+                "metadata": {"name": "oops"},
+                "spec": {"group": "x.dev", "names": {"plural": "foos", "kind": "Foo"},
+                         "versions": [{"name": "v1"}]},
+            }, namespace=None)
+        assert e.value.code == 422
+
+    def test_discovery_lists_crds(self, client):
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        doc = client.request("GET", "/apis")
+        res = doc["resources"]
+        assert "pods" in res and "tpujobs" in res
+        assert res["tpujobs"]["prefix"] == "/apis/batch.tpu.dev/v1"
+        assert res["tpujobs"]["namespaced"] is True
+
+
+class TestSecuredCRDServer:
+    @pytest.fixture()
+    def secured(self):
+        from kubernetes_tpu.server.auth import RBACAuthorizer, TokenAuthenticator
+
+        authn = TokenAuthenticator()
+        authn.add("tok-admin", "admin", groups=["system:masters"])
+        authn.add("tok-dev", "dev")
+        authz = (RBACAuthorizer()
+                 .grant("admin", ["*"], ["*"])
+                 .grant("dev", ["*"], ["tpujobs"]))
+        srv = APIServer(APIStore(), authenticator=authn, authorizer=authz).start()
+        yield srv
+        srv.stop()
+
+    def test_grant_on_plural_covers_alias_writes(self, secured):
+        """Authz must see the canonical plural for every verb, so a grant on
+        `tpujobs` allows DELETE/PATCH via the `tj` shortName path too."""
+        admin = RESTClient(secured.url, token="tok-admin")
+        dev = RESTClient(secured.url, token="tok-dev")
+        admin.create("customresourcedefinitions", CRD, namespace=None)
+        dev.create("tpujobs", {"metadata": {"name": "a"}, "spec": {"replicas": 1}})
+        assert dev.request(
+            "PATCH", "/apis/batch.tpu.dev/v1/namespaces/default/tj/a",
+            {"spec": {"replicas": 2}},
+            content_type="application/merge-patch+json")["spec"]["replicas"] == 2
+        dev.request("DELETE", "/apis/batch.tpu.dev/v1/namespaces/default/tj/a")
+        with pytest.raises(APIError) as e:
+            dev.create("customresourcedefinitions", CRD, namespace=None)
+        assert e.value.code == 403
+
+    def test_discovery_requires_authentication(self, secured):
+        anon = RESTClient(secured.url)
+        with pytest.raises(APIError) as e:
+            anon.request("GET", "/apis")
+        assert e.value.code == 401
+        dev = RESTClient(secured.url, token="tok-dev")
+        assert "pods" in dev.request("GET", "/apis")["resources"]
+
+
+class TestKtlWithCRs:
+    def test_ktl_apply_and_get_custom_resource(self, server, client, tmp_path, capsys):
+        crd_file = tmp_path / "crd.json"
+        crd_file.write_text(__import__("json").dumps(CRD))
+        assert ktl_main(["--server", server.url, "apply", "-f", str(crd_file)]) == 0
+        cr_file = tmp_path / "cr.json"
+        cr_file.write_text(__import__("json").dumps({
+            "apiVersion": "batch.tpu.dev/v1", "kind": "TPUJob",
+            "metadata": {"name": "train-9", "namespace": "default"},
+            "spec": {"replicas": 2}}))
+        assert ktl_main(["--server", server.url, "apply", "-f", str(cr_file)]) == 0
+        assert ktl_main(["--server", server.url, "get", "tpujobs"]) == 0
+        out = capsys.readouterr().out
+        assert "train-9" in out
+
+    def test_ktl_api_resources_includes_crd(self, server, client, capsys):
+        client.create("customresourcedefinitions", CRD, namespace=None)
+        assert ktl_main(["--server", server.url, "api-resources"]) == 0
+        assert "tpujobs" in capsys.readouterr().out
