@@ -284,6 +284,12 @@ def pod_to_dict(pod: Pod) -> Dict:
         spec["preemptionPolicy"] = pod.spec.preemption_policy
     if pod.spec.host_network:
         spec["hostNetwork"] = True
+    if pod.spec.host_pid:
+        spec["hostPID"] = True
+    if pod.spec.host_ipc:
+        spec["hostIPC"] = True
+    if pod.spec.security_context:
+        spec["securityContext"] = pod.spec.security_context
     status: Dict[str, Any] = {"phase": pod.status.phase}
     if pod.status.nominated_node_name:
         status["nominatedNodeName"] = pod.status.nominated_node_name

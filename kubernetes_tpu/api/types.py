@@ -117,6 +117,10 @@ class Container:
     resources: Dict[str, Dict[str, Any]] = field(default_factory=dict)  # requests/limits
     ports: List[ContainerPort] = field(default_factory=list)
     image_pull_policy: str = ""  # "", Always, IfNotPresent, Never
+    # raw core/v1 SecurityContext dict (privileged, runAsNonRoot,
+    # allowPrivilegeEscalation, capabilities, seccompProfile, ...) — consumed
+    # by the PodSecurity admission level checks
+    security_context: Dict[str, Any] = field(default_factory=dict)
 
     @staticmethod
     def from_dict(d: Mapping) -> "Container":
@@ -125,6 +129,7 @@ class Container:
             image=d.get("image", ""),
             resources=dict(d.get("resources") or {}),
             image_pull_policy=d.get("imagePullPolicy", ""),
+            security_context=dict(d.get("securityContext") or {}),
             ports=[
                 ContainerPort(
                     container_port=int(p["containerPort"]),
@@ -144,6 +149,8 @@ class Container:
             d["resources"] = self.resources
         if self.image_pull_policy:
             d["imagePullPolicy"] = self.image_pull_policy
+        if self.security_context:
+            d["securityContext"] = self.security_context
         if self.ports:
             d["ports"] = [
                 {
@@ -174,6 +181,7 @@ class Volume:
     iscsi: str = ""  # iscsi "iqn/lun"
     iscsi_read_only: bool = False
     ephemeral: bool = False  # ephemeral.volumeClaimTemplate (claim name = pod-volname)
+    host_path: str = ""  # hostPath.path — PodSecurity baseline forbids these
 
     @property
     def scheduling_relevant(self) -> bool:
@@ -203,6 +211,7 @@ class Volume:
             iscsi=(f"{iscsi.get('iqn', '')}/{iscsi.get('lun', 0)}" if iscsi else ""),
             iscsi_read_only=bool(iscsi.get("readOnly", False)),
             ephemeral="ephemeral" in d,
+            host_path=(d.get("hostPath") or {}).get("path", ""),
         )
 
     def to_dict(self) -> Dict[str, Any]:
@@ -223,6 +232,8 @@ class Volume:
                           **({"readOnly": True} if self.iscsi_read_only else {})}
         if self.ephemeral:
             d["ephemeral"] = {"volumeClaimTemplate": {}}
+        if self.host_path:
+            d["hostPath"] = {"path": self.host_path}
         return d
 
 
@@ -397,6 +408,10 @@ class PodSpec:
     scheduling_gates: List[str] = field(default_factory=list)
     overhead: Optional[Dict[str, Any]] = None
     host_network: bool = False
+    host_pid: bool = False
+    host_ipc: bool = False
+    # raw core/v1 PodSecurityContext dict (runAsNonRoot, seccompProfile, ...)
+    security_context: Dict[str, Any] = field(default_factory=dict)
     restart_policy: str = "Always"
     termination_grace_period_seconds: int = 30
     volumes: List[Volume] = field(default_factory=list)
@@ -425,6 +440,9 @@ class PodSpec:
             scheduling_gates=[g["name"] if isinstance(g, Mapping) else g for g in d.get("schedulingGates") or []],
             overhead=d.get("overhead"),
             host_network=bool(d.get("hostNetwork", False)),
+            host_pid=bool(d.get("hostPID", False)),
+            host_ipc=bool(d.get("hostIPC", False)),
+            security_context=dict(d.get("securityContext") or {}),
             restart_policy=d.get("restartPolicy", "Always"),
             termination_grace_period_seconds=int(d.get("terminationGracePeriodSeconds", 30) or 30),
             volumes=[Volume.from_dict(v) for v in d.get("volumes") or []],

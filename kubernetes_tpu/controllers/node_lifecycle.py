@@ -48,15 +48,20 @@ class NodeLifecycleController(Controller):
         except NotFoundError:
             return
         ready = self._node_healthy(name)
-        has_taint = any(t.key == NOT_READY_TAINT for t in node.spec.taints)
-        if ready and has_taint:
+        # match by (key, effect): the TaintNodesByCondition admission plugin
+        # seeds new nodes with a NoSchedule not-ready taint, which must not
+        # suppress this controller's NoExecute escalation for unhealthy nodes
+        has_noexec = any(t.key == NOT_READY_TAINT and t.effect == TAINT_NO_EXECUTE
+                         for t in node.spec.taints)
+        has_any = any(t.key == NOT_READY_TAINT for t in node.spec.taints)
+        if ready and has_any:
             def clear(obj: Node) -> Node:
                 obj.spec.taints = [t for t in obj.spec.taints if t.key != NOT_READY_TAINT]
                 self._set_ready_condition(obj, True)
                 return obj
 
             self.store.guaranteed_update("nodes", name, clear)
-        elif not ready and not has_taint:
+        elif not ready and not has_noexec:
             def taint(obj: Node) -> Node:
                 obj.spec.taints.append(Taint(key=NOT_READY_TAINT, effect=TAINT_NO_EXECUTE))
                 self._set_ready_condition(obj, False)

@@ -16,8 +16,9 @@ from typing import Callable, List, Optional, Sequence
 
 from ..api.policy import LimitRange, ResourceQuota
 from ..api.resources import quantity_milli_value, quantity_value
-from ..api.types import Toleration, new_uid
+from ..api.types import Taint, Toleration, new_uid
 from ..store import APIStore, NotFoundError
+from .podsecurity import ENFORCE_LABEL, LEVELS, check_level
 
 CREATE = "CREATE"
 UPDATE = "UPDATE"
@@ -332,8 +333,6 @@ class DefaultTolerationSeconds(AdmissionPlugin):
     def admit(self, store, resource, operation, obj, user="") -> None:
         if resource != "pods" or operation != CREATE:
             return
-        from ..api.types import Taint
-
         for key in self.KEYS:
             # skip only when an existing toleration ACTUALLY tolerates the
             # taint (ToleratesTaint in the reference plugin) — a key-equal
@@ -419,6 +418,105 @@ class ServiceAccountAdmission(AdmissionPlugin):
                 code=403, reason="Forbidden")
 
 
+class PodSecurityAdmission(AdmissionPlugin):
+    """Enforces the namespace's pod-security.kubernetes.io/enforce level on
+    pod writes (staging/src/k8s.io/pod-security-admission/admission). The
+    level checks live in podsecurity.py; unlabelled namespaces are
+    `privileged` (no enforcement)."""
+
+    name = "PodSecurity"
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        # CREATE only: labelling a namespace must leave existing pods
+        # updatable (status writes, labels) — the reference's
+        # isSignificantPodUpdate exemption; pod specs are near-immutable
+        # anyway, so create-time is where the policy bites
+        if resource != "pods" or operation != CREATE:
+            return
+        try:
+            ns = store.get("namespaces", obj.metadata.namespace)
+        except NotFoundError:
+            return  # NamespaceLifecycle owns this rejection
+        level = ns.metadata.labels.get(ENFORCE_LABEL, "privileged")
+        if level not in LEVELS:
+            level = "restricted"  # unknown label value: fail closed
+        errs = check_level(level, obj)
+        if errs:
+            raise AdmissionError(
+                f"violates PodSecurity \"{level}\": " + "; ".join(errs),
+                code=403, reason="Forbidden")
+
+
+class ExtendedResourceToleration(AdmissionPlugin):
+    """Pods requesting extended resources (anything not a core compute
+    resource) get a matching toleration, so dedicated device nodes can be
+    tainted with their resource name
+    (plugin/pkg/admission/extendedresourcetoleration)."""
+
+    name = "ExtendedResourceToleration"
+
+    @staticmethod
+    def is_extended(key: str) -> bool:
+        """helper.IsExtendedResourceName: domain-qualified, not a native
+        kubernetes.io resource, not a hugepages size."""
+        if "/" not in key:
+            return False
+        domain = key.split("/", 1)[0]
+        if domain == "kubernetes.io" or domain.endswith(".kubernetes.io"):
+            return False
+        return not key.startswith("requests.")
+
+    def admit(self, store, resource, operation, obj, user="") -> None:
+        if resource != "pods" or operation != CREATE:
+            return
+        extended = set()
+        for c in list(obj.spec.containers) + list(obj.spec.init_containers):
+            for section in ("requests", "limits"):
+                for key in ((c.resources or {}).get(section) or {}):
+                    if self.is_extended(key):
+                        extended.add(key)
+        for key in sorted(extended):
+            if not any(t.key == key for t in obj.spec.tolerations):
+                obj.spec.tolerations.append(Toleration(
+                    key=key, operator="Exists", effect="NoSchedule"))
+
+
+class TaintNodesByCondition(AdmissionPlugin):
+    """New nodes start tainted not-ready NoSchedule until node_lifecycle
+    observes a Ready condition (plugin/pkg/admission/nodetaint) — closes the
+    window where a scheduler could bind to a node whose kubelet has not
+    reported yet."""
+
+    name = "TaintNodesByCondition"
+    NOT_READY = "node.kubernetes.io/not-ready"
+
+    def admit(self, store, resource, operation, obj, user="") -> None:
+        if resource != "nodes" or operation != CREATE:
+            return
+        if not any(t.key == self.NOT_READY for t in obj.spec.taints):
+            obj.spec.taints.append(Taint(key=self.NOT_READY, effect="NoSchedule"))
+
+
+class LimitPodHardAntiAffinityTopology(AdmissionPlugin):
+    """Rejects required pod anti-affinity with a topologyKey other than
+    kubernetes.io/hostname (plugin/pkg/admission/antiaffinity) — zone-wide
+    hard anti-affinity lets one tenant fence whole failure domains. NOT in
+    the default chain, same as the reference."""
+
+    name = "LimitPodHardAntiAffinityTopology"
+    HOSTNAME = "kubernetes.io/hostname"
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        if resource != "pods" or operation != CREATE or obj.spec.affinity is None:
+            return
+        for term in obj.spec.affinity.pod_anti_affinity_required:
+            if term.topology_key != self.HOSTNAME:
+                raise AdmissionError(
+                    "affinity.podAntiAffinity.requiredDuringScheduling... "
+                    f"topologyKey must be {self.HOSTNAME!r}, got "
+                    f"{term.topology_key!r}", code=422, reason="Invalid")
+
+
 class AdmissionChain:
     """All mutators in order, then all validators (apiserver/pkg/admission
     chainAdmissionHandler)."""
@@ -443,9 +541,12 @@ def default_admission_chain() -> AdmissionChain:
         LimitRanger(),
         ServiceAccountAdmission(),
         PodTolerationRestriction(),
+        ExtendedResourceToleration(),
         PriorityAdmission(),
         DefaultTolerationSeconds(),
         DefaultStorageClass(),
+        TaintNodesByCondition(),
+        PodSecurityAdmission(),
         NodeRestriction(),
         ResourceQuotaAdmission(),
     ])

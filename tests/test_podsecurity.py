@@ -1,0 +1,198 @@
+"""PodSecurity levels + round-4 admission breadth plugins.
+
+reference: staging/src/k8s.io/pod-security-admission/policy,
+plugin/pkg/admission/{extendedresourcetoleration,nodetaint,antiaffinity}.
+"""
+
+import pytest
+
+from kubernetes_tpu.api.types import (
+    Affinity,
+    Namespace,
+    ObjectMeta,
+    Pod,
+    PodAffinityTerm,
+    Volume,
+)
+from kubernetes_tpu.server.admission import (
+    AdmissionChain,
+    AdmissionError,
+    ExtendedResourceToleration,
+    LimitPodHardAntiAffinityTopology,
+    MetadataDefaulter,
+    PodSecurityAdmission,
+    TaintNodesByCondition,
+)
+from kubernetes_tpu.server.podsecurity import check_baseline, check_restricted
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def _ns(store, name, level=None):
+    ns = Namespace(metadata=ObjectMeta(name=name))
+    if level:
+        ns.metadata.labels["pod-security.kubernetes.io/enforce"] = level
+    store.create("namespaces", ns)
+    return ns
+
+
+def _restricted_ok_pod(ns="default"):
+    pod = MakePod("web", namespace=ns).req({"cpu": "100m"}).obj()
+    for c in pod.spec.containers:
+        c.security_context = {
+            "runAsNonRoot": True,
+            "allowPrivilegeEscalation": False,
+            "capabilities": {"drop": ["ALL"]},
+            "seccompProfile": {"type": "RuntimeDefault"},
+        }
+    return pod
+
+
+class TestLevelChecks:
+    def test_baseline_flags_host_surfaces(self):
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        pod.spec.host_network = True
+        pod.spec.host_pid = True
+        pod.spec.volumes.append(Volume(name="h", host_path="/etc"))
+        pod.spec.containers[0].security_context = {"privileged": True}
+        errs = check_baseline(pod)
+        assert len(errs) == 4
+        assert any("privileged" in e for e in errs)
+        assert any("hostPath" in e for e in errs)
+
+    def test_baseline_capability_allowlist(self):
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        pod.spec.containers[0].security_context = {
+            "capabilities": {"add": ["CHOWN", "SYS_ADMIN"]}}
+        errs = check_baseline(pod)
+        assert len(errs) == 1
+        assert "SYS_ADMIN" in errs[0] and "CHOWN" not in errs[0]
+
+    def test_restricted_requires_hardening(self):
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        errs = check_restricted(pod)
+        assert any("runAsNonRoot" in e for e in errs)
+        assert any("allowPrivilegeEscalation" in e for e in errs)
+        assert any("drop ALL" in e for e in errs)
+        assert any("seccompProfile" in e for e in errs)
+        assert check_restricted(_restricted_ok_pod()) == []
+
+    def test_pod_level_security_context_inherited(self):
+        pod = _restricted_ok_pod()
+        for c in pod.spec.containers:
+            del c.security_context["runAsNonRoot"]
+            del c.security_context["seccompProfile"]
+        pod.spec.security_context = {"runAsNonRoot": True,
+                                     "seccompProfile": {"type": "RuntimeDefault"}}
+        assert check_restricted(pod) == []
+
+
+class TestPodSecurityAdmission:
+    def test_enforced_by_namespace_label(self):
+        store = APIStore()
+        _ns(store, "locked", level="restricted")
+        chain = AdmissionChain([PodSecurityAdmission()])
+        bad = MakePod("p", namespace="locked").req({"cpu": "1"}).obj()
+        with pytest.raises(AdmissionError) as e:
+            chain.run(store, "pods", "CREATE", bad)
+        assert "violates PodSecurity" in str(e.value)
+        chain.run(store, "pods", "CREATE", _restricted_ok_pod("locked"))
+
+    def test_unlabelled_namespace_not_enforced(self):
+        store = APIStore()
+        _ns(store, "open")
+        chain = AdmissionChain([PodSecurityAdmission()])
+        pod = MakePod("p", namespace="open").obj()
+        pod.spec.host_network = True
+        chain.run(store, "pods", "CREATE", pod)  # no error
+
+    def test_unknown_level_fails_closed(self):
+        store = APIStore()
+        _ns(store, "weird", level="bogus")
+        chain = AdmissionChain([PodSecurityAdmission()])
+        with pytest.raises(AdmissionError):
+            chain.run(store, "pods", "CREATE",
+                      MakePod("p", namespace="weird").req({"cpu": "1"}).obj())
+
+
+class TestBreadthPlugins:
+    def test_extended_resource_toleration(self):
+        store = APIStore()
+        pod = MakePod("p").req({"cpu": "1", "tpu.dev/chips": "4"}).obj()
+        AdmissionChain([ExtendedResourceToleration()]).run(
+            store, "pods", "CREATE", pod)
+        tols = [t for t in pod.spec.tolerations if t.key == "tpu.dev/chips"]
+        assert len(tols) == 1 and tols[0].operator == "Exists"
+        # idempotent: re-running does not duplicate
+        AdmissionChain([ExtendedResourceToleration()]).run(
+            store, "pods", "CREATE", pod)
+        assert len([t for t in pod.spec.tolerations
+                    if t.key == "tpu.dev/chips"]) == 1
+
+    def test_extended_resource_requires_domain(self):
+        """helper.IsExtendedResourceName: unqualified and kubernetes.io/
+        hugepages keys never earn tolerations."""
+        store = APIStore()
+        pod = MakePod("p").req({"gpu": "1", "hugepages-512Mi": "512Mi",
+                                "kubernetes.io/batch-cpu": "1"}).obj()
+        AdmissionChain([ExtendedResourceToleration()]).run(
+            store, "pods", "CREATE", pod)
+        assert pod.spec.tolerations == []
+
+    def test_admission_taint_does_not_mask_lifecycle_escalation(self):
+        """A never-heartbeating node with the admission NoSchedule taint must
+        still get Ready=False and the NoExecute taint from node_lifecycle."""
+        from kubernetes_tpu.controllers.node_lifecycle import (
+            NodeLifecycleController,
+        )
+        from kubernetes_tpu.utils.clock import FakeClock
+
+        store = APIStore()
+        node = MakeNode("n1").capacity({"cpu": "4"}).obj()
+        AdmissionChain([TaintNodesByCondition()]).run(
+            store, "nodes", "CREATE", node)
+        store.create("nodes", node)
+        clock = FakeClock(1000.0)
+        ctrl = NodeLifecycleController(store, clock=clock)
+        ctrl.monitor()
+        got = store.get("nodes", "n1")
+        effects = {(t.key, t.effect) for t in got.spec.taints}
+        assert ("node.kubernetes.io/not-ready", "NoExecute") in effects
+        assert any(c.type == "Ready" and c.status == "False"
+                   for c in got.status.conditions)
+
+    def test_taint_nodes_by_condition(self):
+        store = APIStore()
+        node = MakeNode("n1").capacity({"cpu": "4"}).obj()
+        AdmissionChain([TaintNodesByCondition()]).run(
+            store, "nodes", "CREATE", node)
+        assert any(t.key == "node.kubernetes.io/not-ready" and
+                   t.effect == "NoSchedule" for t in node.spec.taints)
+
+    def test_limit_hard_anti_affinity_topology(self):
+        store = APIStore()
+        chain = AdmissionChain([LimitPodHardAntiAffinityTopology()])
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        pod.spec.affinity = Affinity(pod_anti_affinity_required=[
+            PodAffinityTerm(selector=None, topology_key="topology.kubernetes.io/zone")])
+        with pytest.raises(AdmissionError) as e:
+            chain.run(store, "pods", "CREATE", pod)
+        assert e.value.code == 422
+        ok = MakePod("q").obj()
+        ok.spec.affinity = Affinity(pod_anti_affinity_required=[
+            PodAffinityTerm(selector=None, topology_key="kubernetes.io/hostname")])
+        chain.run(store, "pods", "CREATE", ok)
+
+    def test_security_context_round_trips(self):
+        from kubernetes_tpu.api.serialize import to_dict
+
+        pod = _restricted_ok_pod()
+        pod.spec.host_pid = True
+        pod.spec.security_context = {"runAsUser": 1000}
+        d = to_dict(pod)
+        back = Pod.from_dict(d)
+        assert back.spec.host_pid is True
+        assert back.spec.security_context == {"runAsUser": 1000}
+        assert back.spec.containers[0].security_context["capabilities"] == {
+            "drop": ["ALL"]}
+        assert to_dict(back) == d

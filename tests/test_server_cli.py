@@ -166,7 +166,10 @@ class TestCLI:
         rc, _ = self.run(server, "taint", "nodes", "n1", "gpu=true:NoSchedule")
         assert rc == 0
         node = client.get("nodes", "n1", namespace=None)
-        assert node["spec"]["taints"] == [{"key": "gpu", "value": "true", "effect": "NoSchedule"}]
+        # TaintNodesByCondition admission adds not-ready on create; the CLI
+        # taint must append alongside it
+        assert {"key": "gpu", "value": "true",
+                "effect": "NoSchedule"} in node["spec"]["taints"]
         rc, out = self.run(server, "drain", "n1")
         assert rc == 0 and "pod/p evicted" in out
         node = client.get("nodes", "n1", namespace=None)
