@@ -35,6 +35,7 @@ from .policy import (
     ResourceQuota,
     ServiceAccount,
 )
+from .certificates import CertificateSigningRequest
 from .crd import CustomResourceDefinition
 from .dra import DeviceClass, ResourceClaim, ResourceSlice
 from .events import Event as CoreEvent
@@ -77,6 +78,7 @@ KIND_TO_RESOURCE = {
     "ResourceSlice": "resourceslices",
     "DeviceClass": "deviceclasses",
     "CustomResourceDefinition": "customresourcedefinitions",
+    "CertificateSigningRequest": "certificatesigningrequests",
 }
 RESOURCE_TO_TYPE = {
     "pods": Pod,
@@ -106,10 +108,12 @@ RESOURCE_TO_TYPE = {
     "resourceslices": ResourceSlice,
     "deviceclasses": DeviceClass,
     "customresourcedefinitions": CustomResourceDefinition,
+    "certificatesigningrequests": CertificateSigningRequest,
 }
 CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses",
                   "csinodes", "resourceslices", "deviceclasses",
-                  "priorityclasses", "customresourcedefinitions"}
+                  "priorityclasses", "customresourcedefinitions",
+                  "certificatesigningrequests"}
 GROUP_PREFIX = {
     "pods": "/api/v1",
     "nodes": "/api/v1",
@@ -138,6 +142,7 @@ GROUP_PREFIX = {
     "resourceslices": "/apis/resource.k8s.io/v1beta1",
     "deviceclasses": "/apis/resource.k8s.io/v1beta1",
     "customresourcedefinitions": "/apis/apiextensions.k8s.io/v1",
+    "certificatesigningrequests": "/apis/certificates.k8s.io/v1",
 }
 
 

@@ -25,10 +25,12 @@ from ..server.client import APIError, RESTClient
 class InitResult:
     """Handle onto an init-ed control plane (library surface for tests/embeds)."""
 
-    def __init__(self, server, control_plane, token: Optional[str], store):
+    def __init__(self, server, control_plane, token: Optional[str], store,
+                 join_token: Optional[str] = None):
         self.server = server
         self.control_plane = control_plane
-        self.token = token
+        self.token = token  # admin credential (kubeadm's admin.conf analog)
+        self.join_token = join_token  # node bootstrap token (system:bootstrappers)
         self.store = store
 
     @property
@@ -53,26 +55,42 @@ def init_control_plane(port: int = 0, secure: bool = False,
                        use_batch_scheduler: bool = True) -> InitResult:
     """kubeadm init equivalent: store + apiserver (+ bootstrap token RBAC when
     secure) + leader-elected control plane."""
-    from ..server.auth import TokenAuthenticator, default_component_authorizer
+    from ..server.auth import (
+        AuthenticatorChain,
+        SignedTokenAuthenticator,
+        TokenAuthenticator,
+        default_component_authorizer,
+    )
     from ..server.controlplane import ControlPlane
     from ..server.rest import APIServer
     from ..store import APIStore
 
     store = APIStore()
-    token = None
-    authn = authz = None
+    token = join_token = None
+    authn = authz = signer = None
     if secure:
         token = secrets.token_urlsafe(16)
-        authn = TokenAuthenticator()
-        # the bootstrap token is cluster-admin, like kubeadm's initial
+        static = TokenAuthenticator()
+        # the admin token is cluster-admin, like kubeadm's initial
         # admin.conf credential
-        authn.add(token, "kubernetes-admin", ["system:masters"])
+        static.add(token, "kubernetes-admin", ["system:masters"])
+        # the JOIN token is only a bootstrapper: it can file a CSR and read
+        # it back, nothing else — the issued credential carries the real
+        # node identity (kubeadm's bootstrap-token + TLS-bootstrap split)
+        join_token = secrets.token_urlsafe(16)
+        static.add(join_token, "system:bootstrap:kadm", ["system:bootstrappers"])
+        signer = SignedTokenAuthenticator(secrets.token_bytes(32))
+        authn = AuthenticatorChain([static, signer])
         authz = default_component_authorizer()
+        authz.grant("group:system:bootstrappers",
+                    ["create", "get", "list", "watch"],
+                    ["certificatesigningrequests"])
     server = APIServer(store, port=port, authenticator=authn,
                        authorizer=authz).start()
     cp = ControlPlane(store, identity=identity,
-                      use_batch_scheduler=use_batch_scheduler).start()
-    return InitResult(server, cp, token, store)
+                      use_batch_scheduler=use_batch_scheduler,
+                      signer=signer).start()
+    return InitResult(server, cp, token, store, join_token=join_token)
 
 
 class JoinedNode:
@@ -82,11 +100,15 @@ class JoinedNode:
     hollow nodes must not turn the apiserver into an O(N*P) list mill."""
 
     def __init__(self, client: RESTClient, node_name: str,
-                 capacity: Dict[str, str], heartbeat: float = 2.0):
+                 capacity: Dict[str, str], heartbeat: float = 2.0,
+                 credential_refresher=None):
         self.client = client
         self.node_name = node_name
         self.capacity = dict(capacity)
         self.heartbeat = heartbeat
+        # () -> new bearer token; called when the current credential expires
+        # (the kubelet's client-cert rotation analog)
+        self.credential_refresher = credential_refresher
         self.running: Dict[str, object] = {}  # pod key -> typed Pod (informer)
         self._informer = None
         self._stop = threading.Event()
@@ -176,6 +198,12 @@ class JoinedNode:
                         self._renew_lease()
                         last_hb = time.time()
                     self.sync_once()
+                except APIError as e:
+                    if e.code == 401 and self.credential_refresher is not None:
+                        try:  # expired credential: rotate and retry next tick
+                            self.client.token = self.credential_refresher()
+                        except Exception:
+                            pass
                 except Exception:
                     pass
                 self._stop.wait(0.2)
@@ -194,14 +222,62 @@ class JoinedNode:
             self._thread = None
 
 
+def bootstrap_node_credential(server_url: str, node_name: str,
+                              bootstrap_token: str,
+                              timeout: float = 30.0) -> str:
+    """The TLS-bootstrap analog: authenticate with the bootstrap token, file
+    a CSR for the system:node:<name> identity, wait for the approve+sign
+    controllers, return the issued credential. reference: kubeadm join's
+    bootstrap flow + pkg/kubelet/certificate/bootstrap."""
+    client = RESTClient(server_url, token=bootstrap_token)
+    # generated name (the kubelet's csr-<rand> convention): every join or
+    # renewal files a FRESH request, so a stale issued credential on an old
+    # CSR can never be handed back; the cleaner GCs the leftovers
+    name = f"node-csr-{node_name}-{secrets.token_hex(4)}"
+    body = {
+        "kind": "CertificateSigningRequest",
+        "metadata": {"name": name},
+        "spec": {
+            "request": {"user": f"system:node:{node_name}",
+                        "groups": ["system:nodes"]},
+            "signerName": "kubernetes.io/kube-apiserver-client-kubelet",
+            "usages": ["client auth"],
+        },
+    }
+    client.create("certificatesigningrequests", body, namespace=None)
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        csr = client.get("certificatesigningrequests", name, namespace=None)
+        cert = (csr.get("status") or {}).get("certificate", "")
+        if cert:
+            return cert
+        for c in (csr.get("status") or {}).get("conditions", []):
+            if c.get("type") == "Denied":
+                raise RuntimeError(f"CSR {name} denied: {c.get('message', '')}")
+        time.sleep(0.05)
+    raise TimeoutError(f"CSR {name} not issued within {timeout}s")
+
+
 def join_node(server_url: str, node_name: str,
               capacity: Optional[Dict[str, str]] = None,
-              token: Optional[str] = None) -> JoinedNode:
-    """kubeadm join equivalent (library surface)."""
+              token: Optional[str] = None,
+              bootstrap: bool = False) -> JoinedNode:
+    """kubeadm join equivalent (library surface). With bootstrap=True the
+    token is treated as a bootstrap token: the node first trades it for its
+    own signed system:node:<name> credential via the CSR flow, so
+    NodeRestriction admission scopes everything it writes."""
+    refresher = None
+    if bootstrap:
+        if not token:
+            raise ValueError("bootstrap join requires a bootstrap token")
+        bootstrap_token = token
+        token = bootstrap_node_credential(server_url, node_name, bootstrap_token)
+        refresher = lambda: bootstrap_node_credential(  # noqa: E731
+            server_url, node_name, bootstrap_token)
     client = RESTClient(server_url, token=token)
     return JoinedNode(client, node_name,
-                      capacity or {"cpu": "8", "memory": "16Gi", "pods": "110"}
-                      ).start()
+                      capacity or {"cpu": "8", "memory": "16Gi", "pods": "110"},
+                      credential_refresher=refresher).start()
 
 
 # -- CLI -----------------------------------------------------------------------
@@ -214,12 +290,13 @@ def cmd_init(args) -> int:
         return 1
     print(f"control plane ready at {res.url}")
     if res.token:
-        print(f"join token: {res.token}")
+        print(f"admin token: {res.token}")
+        print(f"join token: {res.join_token}")
         if args.token_file:
             with open(args.token_file, "w") as f:
-                f.write(res.token)
+                f.write(res.join_token or res.token)
     print(f"join nodes with: kadm join --server {res.url} --node-name <name>"
-          + (" --token <token>" if res.token else ""))
+          + (" --token <join-token> --bootstrap" if res.token else ""))
     try:
         while True:
             time.sleep(3600)
@@ -232,7 +309,8 @@ def cmd_join(args) -> int:
     node = join_node(args.server, args.node_name,
                      capacity={"cpu": args.cpu, "memory": args.memory,
                                "pods": str(args.max_pods)},
-                     token=args.token or None)
+                     token=args.token or None,
+                     bootstrap=args.bootstrap)
     print(f"node {args.node_name} joined {args.server}")
     try:
         while True:
@@ -256,6 +334,9 @@ def main(argv=None) -> int:
     p.add_argument("--server", required=True)
     p.add_argument("--node-name", required=True)
     p.add_argument("--token", default=os.environ.get("KADM_TOKEN", ""))
+    p.add_argument("--bootstrap", action="store_true",
+                   help="treat --token as a bootstrap token: run the CSR "
+                        "flow and join with the issued node credential")
     p.add_argument("--cpu", default="8")
     p.add_argument("--memory", default="16Gi")
     p.add_argument("--max-pods", type=int, default=110)

@@ -1,5 +1,10 @@
 """L4 — reconciling control loops (reference: pkg/controller)."""
 
+from .certificates import (  # noqa: F401
+    CSRApprovingController,
+    CSRCleanerController,
+    CSRSigningController,
+)
 from .base import Controller  # noqa: F401
 from .daemonset import DaemonSetController  # noqa: F401
 from .deployment import DeploymentController  # noqa: F401

@@ -517,6 +517,25 @@ class LimitPodHardAntiAffinityTopology(AdmissionPlugin):
                     f"{term.topology_key!r}", code=422, reason="Invalid")
 
 
+class CertificateSubjectRestriction(AdmissionPlugin):
+    """Rejects kube-apiserver-client CSRs that request the system:masters
+    group (plugin/pkg/admission/certificates/subjectrestriction) — no
+    credential-issuance path may mint a cluster-admin identity."""
+
+    name = "CertificateSubjectRestriction"
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        if resource != "certificatesigningrequests" or operation != CREATE:
+            return
+        from ..api.certificates import KUBE_APISERVER_CLIENT
+
+        if obj.signer_name == KUBE_APISERVER_CLIENT and \
+                "system:masters" in (obj.request.get("groups") or []):
+            raise AdmissionError(
+                "use of kubernetes.io/kube-apiserver-client signer with "
+                "system:masters group is not allowed")
+
+
 class AdmissionChain:
     """All mutators in order, then all validators (apiserver/pkg/admission
     chainAdmissionHandler)."""
@@ -547,6 +566,7 @@ def default_admission_chain() -> AdmissionChain:
         DefaultStorageClass(),
         TaintNodesByCondition(),
         PodSecurityAdmission(),
+        CertificateSubjectRestriction(),
         NodeRestriction(),
         ResourceQuotaAdmission(),
     ])

@@ -80,6 +80,86 @@ class TokenAuthenticator:
         return self._tokens.get(authorization_header[len("Bearer "):].strip())
 
 
+class SignedTokenAuthenticator:
+    """Stateless verifier for cluster-signed bearer credentials — the
+    verification half of the certificates flow (reference: the apiserver
+    trusting certs chained to the cluster CA; here the CA analog is an HMAC
+    key held by the control plane).
+
+    Token wire format: `ktpu.v1.<b64url(payload-json)>.<hex hmac-sha256>`
+    with payload {"user": ..., "groups": [...], "exp": epoch-or-null}.
+    mint() lives here too so the CSR signing controller and the verifier
+    cannot drift."""
+
+    PREFIX = "ktpu.v1."
+
+    def __init__(self, key: bytes, now=None):
+        import time
+
+        self._key = key
+        self._now = now or time.time
+
+    def mint(self, user: str, groups: Sequence[str] = (),
+             expiration_seconds: Optional[int] = None) -> str:
+        import base64
+        import hashlib
+        import hmac
+        import json
+
+        payload = {"user": user, "groups": list(groups)}
+        if expiration_seconds is not None:
+            payload["exp"] = self._now() + expiration_seconds
+        body = base64.urlsafe_b64encode(
+            json.dumps(payload, sort_keys=True).encode()).decode().rstrip("=")
+        sig = hmac.new(self._key, body.encode(), hashlib.sha256).hexdigest()
+        return f"{self.PREFIX}{body}.{sig}"
+
+    def authenticate(self, authorization_header: str) -> Optional[UserInfo]:
+        import base64
+        import hashlib
+        import hmac
+        import json
+
+        if not authorization_header.startswith("Bearer "):
+            return None
+        token = authorization_header[len("Bearer "):].strip()
+        if not token.startswith(self.PREFIX):
+            return None
+        rest = token[len(self.PREFIX):]
+        body, _, sig = rest.rpartition(".")
+        if not body or not sig:
+            return None
+        want = hmac.new(self._key, body.encode(), hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, sig):
+            return None
+        try:
+            pad = "=" * (-len(body) % 4)
+            payload = json.loads(base64.urlsafe_b64decode(body + pad))
+        except Exception:
+            return None
+        exp = payload.get("exp")
+        if exp is not None and self._now() > exp:
+            return None
+        return UserInfo(name=payload.get("user", ""),
+                        groups=tuple(payload.get("groups") or ())
+                        + ("system:authenticated",))
+
+
+class AuthenticatorChain:
+    """First authenticator to recognize the credential wins (the apiserver's
+    union authenticator, authentication/request/union)."""
+
+    def __init__(self, authenticators: Sequence):
+        self._authns = list(authenticators)
+
+    def authenticate(self, authorization_header: str) -> Optional[UserInfo]:
+        for a in self._authns:
+            user = a.authenticate(authorization_header)
+            if user is not None:
+                return user
+        return None
+
+
 @dataclass
 class Rule:
     """rbac.PolicyRule subset: which verbs on which resources."""
@@ -125,6 +205,10 @@ def default_component_authorizer() -> RBACAuthorizer:
     a.grant("group:system:nodes",
             ["get", "list", "watch", "create", "update", "patch", "delete"],
             ["pods", "nodes", "leases", "events"])
+    # nodes may renew their own credential (certificatesigningrequests
+    # recognizer allows requestor == requested node identity)
+    a.grant("group:system:nodes", ["create", "get", "list", "watch"],
+            ["certificatesigningrequests"])
     a.grant("group:system:kube-controller-manager", ["*"], ["*"])
     a.grant("group:system:authenticated", ["get", "list", "watch"], ["*"])
     return a

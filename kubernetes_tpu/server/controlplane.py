@@ -20,12 +20,15 @@ DEFAULT_CONTROLLERS = (
     "deployment", "replicaset", "statefulset", "daemonset", "job", "cronjob",
     "disruption", "nodelifecycle", "tainteviction", "endpointslice",
     "namespace", "garbagecollector", "resourcequota", "horizontalpodautoscaler",
-    "serviceaccount", "ttlafterfinished", "eventttl",
+    "serviceaccount", "ttlafterfinished", "eventttl", "csrapproving",
+    "csrcleaner",
 )
 
 
 def _controller_registry():
     from ..controllers import (
+        CSRApprovingController,
+        CSRCleanerController,
         CronJobController,
         DaemonSetController,
         DeploymentController,
@@ -46,6 +49,8 @@ def _controller_registry():
     )
 
     return {
+        "csrapproving": CSRApprovingController,
+        "csrcleaner": CSRCleanerController,
         "serviceaccount": ServiceAccountController,
         "ttlafterfinished": TTLAfterFinishedController,
         "eventttl": EventTTLController,
@@ -78,9 +83,12 @@ class ControlPlane:
                  use_batch_scheduler: bool = True,
                  scheduler_factory: Optional[Callable] = None,
                  lease_duration: float = 15.0, renew_deadline: float = 10.0,
-                 retry_period: float = 2.0):
+                 retry_period: float = 2.0, signer=None):
         self.store = store
         self.identity = identity
+        # cluster credential signer (auth.SignedTokenAuthenticator); when set,
+        # the leader also runs the CSR signing controller
+        self.signer = signer
         self.controller_names = tuple(controllers)
         self.use_batch_scheduler = use_batch_scheduler
         self.scheduler_factory = scheduler_factory
@@ -123,6 +131,13 @@ class ControlPlane:
             self.controllers = []
             for name in self.controller_names:
                 c = registry[name](self.store)
+                c.sync_all()
+                c.start()
+                self.controllers.append(c)
+            if self.signer is not None:
+                from ..controllers import CSRSigningController
+
+                c = CSRSigningController(self.store, self.signer)
                 c.sync_all()
                 c.start()
                 self.controllers.append(c)

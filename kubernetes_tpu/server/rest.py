@@ -514,6 +514,11 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if ns and not self._cluster_scoped(resource, crd):
             obj.metadata.namespace = ns
+        if resource == "certificatesigningrequests":
+            # requestor identity is server-populated and unforgeable
+            # (certificates/v1 PrepareForCreate semantics)
+            obj.username = user.name
+            obj.groups = list(user.groups)
         # admission + create under one store transaction: concurrent creates
         # cannot both pass a quota check they jointly exceed. The verdict is
         # buffered and the HTTP response written AFTER the lock is released —

@@ -1,0 +1,292 @@
+"""CSR flow: types, signed tokens, approver/signer/cleaner, bootstrap join.
+
+reference: staging/src/k8s.io/api/certificates/v1,
+pkg/controller/certificates/{approver,signer,cleaner}, kubeadm TLS bootstrap,
+plugin/pkg/admission/certificates/subjectrestriction.
+"""
+
+import time
+
+import pytest
+
+from kubernetes_tpu.api.certificates import (
+    APPROVED,
+    CertificateSigningRequest,
+    CSRCondition,
+    KUBE_APISERVER_CLIENT_KUBELET,
+)
+from kubernetes_tpu.api.serialize import from_dict, to_dict
+from kubernetes_tpu.api.types import ObjectMeta
+from kubernetes_tpu.controllers.certificates import (
+    CSRApprovingController,
+    CSRCleanerController,
+    CSRSigningController,
+    recognize_node_client,
+)
+from kubernetes_tpu.server.auth import (
+    AuthenticatorChain,
+    SignedTokenAuthenticator,
+    TokenAuthenticator,
+)
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.utils import FakeClock
+
+
+def make_csr(name="node-csr-n1", user="system:node:n1",
+             groups=("system:nodes",), requestor="system:bootstrap:kadm",
+             requestor_groups=("system:bootstrappers",),
+             signer=KUBE_APISERVER_CLIENT_KUBELET):
+    return CertificateSigningRequest(
+        metadata=ObjectMeta(name=name),
+        request={"user": user, "groups": list(groups)},
+        signer_name=signer,
+        username=requestor,
+        groups=list(requestor_groups),
+    )
+
+
+class TestSignedTokens:
+    def test_mint_and_authenticate(self):
+        s = SignedTokenAuthenticator(b"k" * 32)
+        tok = s.mint("system:node:n1", ["system:nodes"])
+        user = s.authenticate(f"Bearer {tok}")
+        assert user.name == "system:node:n1"
+        assert "system:nodes" in user.groups
+        assert "system:authenticated" in user.groups
+
+    def test_tampered_and_foreign_tokens_rejected(self):
+        s = SignedTokenAuthenticator(b"k" * 32)
+        tok = s.mint("u", [])
+        assert s.authenticate(f"Bearer {tok}x") is None
+        assert s.authenticate("Bearer not-a-signed-token") is None
+        other = SignedTokenAuthenticator(b"j" * 32)
+        assert other.authenticate(f"Bearer {tok}") is None
+
+    def test_expiry(self):
+        clock = FakeClock(1000.0)
+        s = SignedTokenAuthenticator(b"k" * 32, now=clock.now)
+        tok = s.mint("u", [], expiration_seconds=60)
+        assert s.authenticate(f"Bearer {tok}") is not None
+        clock.step(61)
+        assert s.authenticate(f"Bearer {tok}") is None
+
+    def test_chain_first_match_wins(self):
+        static = TokenAuthenticator()
+        static.add("abc", "admin", ["system:masters"])
+        signed = SignedTokenAuthenticator(b"k" * 32)
+        chain = AuthenticatorChain([static, signed])
+        assert chain.authenticate("Bearer abc").name == "admin"
+        tok = signed.mint("u", [])
+        assert chain.authenticate(f"Bearer {tok}").name == "u"
+        assert chain.authenticate("Bearer nope") is None
+
+
+class TestRecognizer:
+    def test_recognizes_bootstrap_node_request(self):
+        assert recognize_node_client(make_csr()) == "n1"
+
+    def test_rejects_wrong_signer_group_or_requestor(self):
+        assert recognize_node_client(make_csr(signer="other")) is None
+        assert recognize_node_client(make_csr(groups=())) is None
+        assert recognize_node_client(make_csr(user="system:admin")) is None
+        assert recognize_node_client(
+            make_csr(requestor="eve", requestor_groups=())) is None
+
+    def test_extra_groups_rejected(self):
+        """The escalation probe: a CSR smuggling system:masters next to
+        system:nodes must NOT be recognized (groups must be exactly
+        [system:nodes])."""
+        assert recognize_node_client(
+            make_csr(groups=("system:nodes", "system:masters"))) is None
+
+    def test_node_renewal_allowed(self):
+        csr = make_csr(requestor="system:node:n1", requestor_groups=("system:nodes",))
+        assert recognize_node_client(csr) == "n1"
+
+
+class TestControllers:
+    def test_approve_sign_roundtrip(self):
+        store = APIStore()
+        clock = FakeClock(1000.0)
+        signer = SignedTokenAuthenticator(b"k" * 32, now=clock.now)
+        store.create("certificatesigningrequests", make_csr())
+        approver = CSRApprovingController(store, clock=clock)
+        approver.sync_all()
+        approver.run_until_stable()
+        csr = store.get("certificatesigningrequests", "node-csr-n1")
+        assert csr.approved and not csr.certificate
+        signing = CSRSigningController(store, signer, clock=clock)
+        signing.sync_all()
+        signing.run_until_stable()
+        csr = store.get("certificatesigningrequests", "node-csr-n1")
+        assert csr.certificate
+        user = signer.authenticate(f"Bearer {csr.certificate}")
+        assert user.name == "system:node:n1" and "system:nodes" in user.groups
+
+    def test_unrecognized_request_denied(self):
+        store = APIStore()
+        store.create("certificatesigningrequests",
+                     make_csr(user="system:admin", groups=("system:masters",)))
+        approver = CSRApprovingController(store)
+        approver.sync_all()
+        approver.run_until_stable()
+        csr = store.get("certificatesigningrequests", "node-csr-n1")
+        assert csr.denied and not csr.approved
+        # the signer never issues for denied CSRs
+        signing = CSRSigningController(store, SignedTokenAuthenticator(b"k" * 32))
+        signing.sync_all()
+        signing.run_until_stable()
+        assert not store.get("certificatesigningrequests", "node-csr-n1").certificate
+
+    def test_foreign_signer_never_issued(self):
+        """Approved CSRs for third-party signers are not ours to sign."""
+        store = APIStore()
+        csr = make_csr(name="ext", signer="example.com/monitoring-agent")
+        csr.conditions.append(CSRCondition(type=APPROVED))
+        store.create("certificatesigningrequests", csr)
+        signing = CSRSigningController(store, SignedTokenAuthenticator(b"k" * 32))
+        signing.sync_all()
+        signing.run_until_stable()
+        assert not store.get("certificatesigningrequests", "ext").certificate
+
+    def test_cleaner_sweeps_from_daemon_loop(self):
+        """reconcile_once must age out quiet CSRs without external monitor()
+        calls (time-driven sweep, not event-driven)."""
+        store = APIStore()
+        clock = FakeClock(1000.0)
+        old = make_csr(name="old-denied")
+        old.metadata.creation_timestamp = 1000.0
+        old.conditions.append(CSRCondition(type="Denied"))
+        store.create("certificatesigningrequests", old)
+        cleaner = CSRCleanerController(store, clock=clock)
+        cleaner.sync_all()
+        cleaner.reconcile_once()
+        assert store.list("certificatesigningrequests")[0]  # too young
+        clock.step(3700)
+        cleaner.reconcile_once()
+        assert store.list("certificatesigningrequests")[0] == []
+
+    def test_cleaner_removes_stale(self):
+        store = APIStore()
+        clock = FakeClock(1000.0)
+        issued = make_csr(name="old-issued")
+        issued.metadata.creation_timestamp = 900.0
+        issued.conditions.append(CSRCondition(type=APPROVED))
+        issued.certificate = "tok"
+        store.create("certificatesigningrequests", issued)
+        pending = make_csr(name="fresh-pending")
+        pending.metadata.creation_timestamp = 990.0
+        store.create("certificatesigningrequests", pending)
+        cleaner = CSRCleanerController(store, clock=clock)
+        clock.step(3600)
+        cleaner.monitor()
+        names = [c.metadata.name
+                 for c in store.list("certificatesigningrequests")[0]]
+        assert names == ["fresh-pending"]  # issued one aged out
+
+    def test_serialization_roundtrip(self):
+        csr = make_csr()
+        csr.conditions.append(CSRCondition(type=APPROVED, reason="AutoApproved",
+                                           last_update_time=5.0))
+        csr.certificate = "tok"
+        d = to_dict(csr)
+        back = from_dict("certificatesigningrequests", d)
+        assert to_dict(back) == d
+        assert back.approved and back.certificate == "tok"
+
+
+class TestBootstrapJoinFlow:
+    def test_secure_init_csr_join_schedule(self):
+        """End to end: init --secure, node joins with only the BOOTSTRAP
+        token, trades it for a signed system:node credential, heartbeats,
+        and a pod schedules onto it and runs."""
+        from kubernetes_tpu.cli.kadm import init_control_plane, join_node
+        from kubernetes_tpu.server.client import APIError, RESTClient
+
+        res = init_control_plane(secure=True, use_batch_scheduler=False)
+        try:
+            assert res.wait_ready(30)
+            node = join_node(res.url, "boot-n1", token=res.join_token,
+                             bootstrap=True)
+            try:
+                # the node client carries the ISSUED identity, not the
+                # bootstrap one: its CSR got approved + signed
+                admin = RESTClient(res.url, token=res.token)
+                csrs, _ = admin.list("certificatesigningrequests")
+                mine = [c for c in csrs
+                        if c["metadata"]["name"].startswith("node-csr-boot-n1-")]
+                assert mine and (mine[0].get("status") or {}).get("certificate")
+                # bootstrap token alone may NOT write pods
+                boot = RESTClient(res.url, token=res.join_token)
+                with pytest.raises(APIError) as e:
+                    boot.create("pods", {"metadata": {"name": "x"},
+                                         "spec": {"containers": [{"name": "c"}]}})
+                assert e.value.code == 403
+                admin.create("pods", {
+                    "metadata": {"name": "w"},
+                    "spec": {"containers": [{"name": "c", "resources": {
+                        "requests": {"cpu": "100m"}}}]},
+                })
+                deadline = time.time() + 30
+                phase = ""
+                while time.time() < deadline:
+                    pod = admin.get("pods", "w")
+                    phase = pod["status"]["phase"]
+                    if phase == "Running":
+                        break
+                    time.sleep(0.1)
+                assert phase == "Running"
+            finally:
+                node.stop()
+        finally:
+            res.stop()
+
+    def test_bootstrap_token_cannot_escalate(self):
+        """Live-exploit regression: a join token filing a CSR with
+        system:masters smuggled into the groups must be DENIED, and no
+        credential issued."""
+        from kubernetes_tpu.cli.kadm import init_control_plane
+        from kubernetes_tpu.server.client import RESTClient
+
+        res = init_control_plane(secure=True, use_batch_scheduler=False)
+        try:
+            assert res.wait_ready(30)
+            boot = RESTClient(res.url, token=res.join_token)
+            boot.create("certificatesigningrequests", {
+                "kind": "CertificateSigningRequest",
+                "metadata": {"name": "evil"},
+                "spec": {
+                    "request": {"user": "system:node:evil",
+                                "groups": ["system:nodes", "system:masters"]},
+                    "signerName": "kubernetes.io/kube-apiserver-client-kubelet",
+                },
+            }, namespace=None)
+            deadline = time.time() + 10
+            denied = False
+            while time.time() < deadline:
+                csr = boot.get("certificatesigningrequests", "evil",
+                               namespace=None)
+                st = csr.get("status") or {}
+                assert not st.get("certificate"), "exploit: credential issued!"
+                if any(c.get("type") == "Denied"
+                       for c in st.get("conditions", [])):
+                    denied = True
+                    break
+                time.sleep(0.05)
+            assert denied
+        finally:
+            res.stop()
+
+    def test_subject_restriction_admission(self):
+        from kubernetes_tpu.server.admission import (
+            AdmissionChain,
+            AdmissionError,
+            CertificateSubjectRestriction,
+        )
+
+        store = APIStore()
+        bad = make_csr(signer="kubernetes.io/kube-apiserver-client",
+                       user="eve", groups=("system:masters",))
+        with pytest.raises(AdmissionError):
+            AdmissionChain([CertificateSubjectRestriction()]).run(
+                store, "certificatesigningrequests", "CREATE", bad)
