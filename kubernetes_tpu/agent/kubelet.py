@@ -351,16 +351,26 @@ class Kubelet:
                 self.runtime.pull_image(image)
                 self.runtime.create_container(sid, c.name or "main", image)
                 self.runtime.start_container(sid, c.name or "main")
+                self._log_line(pod, c.name or "main",
+                               f"Started container with image {image}")
         worker = _PodWorker(pod=pod, sandbox_id=sid)
         worker.probes = [ProbeWorker(s, self.clock) for s in self.probe_factory(pod)]
         self.workers[pod.key] = worker
         self._write_phase(pod.key, RUNNING)
+
+    def _log_line(self, pod: Pod, container: str, message: str) -> None:
+        from ..api.events import append_pod_log
+
+        append_pod_log(self.store, pod.metadata.namespace, pod.metadata.name,
+                       container, message, self.clock.now(),
+                       pod_uid=pod.metadata.uid)
 
     def _stop_pod(self, pod_key: str) -> None:
         worker = self.workers.pop(pod_key, None)
         if worker is not None and worker.sandbox_id:
             self.runtime.stop_pod_sandbox(worker.sandbox_id)
             self.runtime.remove_pod_sandbox(worker.sandbox_id)
+            self._log_line(worker.pod, "sandbox", "Stopped pod sandbox")
 
     def _handle_pleg_event(self, ev: PodLifecycleEvent) -> None:
         worker = self.workers.get(ev.pod_key)

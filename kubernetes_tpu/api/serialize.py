@@ -38,7 +38,7 @@ from .policy import (
 from .certificates import CertificateSigningRequest
 from .crd import CustomResourceDefinition
 from .dra import DeviceClass, ResourceClaim, ResourceSlice
-from .events import Event as CoreEvent
+from .events import Event as CoreEvent, PodLog
 from .storage import CSINode, PersistentVolume, PersistentVolumeClaim, StorageClass
 from .workloads import (
     CronJob,
@@ -79,6 +79,7 @@ KIND_TO_RESOURCE = {
     "DeviceClass": "deviceclasses",
     "CustomResourceDefinition": "customresourcedefinitions",
     "CertificateSigningRequest": "certificatesigningrequests",
+    "PodLog": "podlogs",
 }
 RESOURCE_TO_TYPE = {
     "pods": Pod,
@@ -109,6 +110,7 @@ RESOURCE_TO_TYPE = {
     "deviceclasses": DeviceClass,
     "customresourcedefinitions": CustomResourceDefinition,
     "certificatesigningrequests": CertificateSigningRequest,
+    "podlogs": PodLog,
 }
 CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses",
                   "csinodes", "resourceslices", "deviceclasses",
@@ -143,6 +145,7 @@ GROUP_PREFIX = {
     "deviceclasses": "/apis/resource.k8s.io/v1beta1",
     "customresourcedefinitions": "/apis/apiextensions.k8s.io/v1",
     "certificatesigningrequests": "/apis/certificates.k8s.io/v1",
+    "podlogs": "/api/v1",
 }
 
 

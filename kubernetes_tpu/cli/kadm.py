@@ -175,11 +175,47 @@ class JoinedNode:
             except APIError:
                 continue  # conflict/validation: retry next pass
             self.running[key] = pod
+            self._append_log(pod, "Started container (hollow)")
             n += 1
         for key in list(self.running):
             if key not in seen:
                 self.running.pop(key, None)
         return n
+
+    def _append_log(self, pod, message: str) -> None:
+        """Feed the pod's log channel over HTTP (PodLog; best effort)."""
+        from ..api.events import PodLog
+
+        ns, name = pod.metadata.namespace, pod.metadata.name
+        line = f"{time.time():.3f} [kubelet] {message}"
+        try:
+            cur = self.client.get("podlogs", name, ns)
+            refs = (cur.get("metadata") or {}).get("ownerReferences") or []
+            if refs and refs[0].get("uid") not in ("", pod.metadata.uid):
+                # recreated same-name pod: fresh stream, re-owned (see
+                # append_pod_log)
+                self.client.patch("podlogs", name, {
+                    "metadata": {"ownerReferences": [{
+                        "kind": "Pod", "name": name,
+                        "uid": pod.metadata.uid}]},
+                    "entries": [line]}, ns)
+                return
+            entries = (cur.get("entries") or []) + [line]
+            self.client.patch("podlogs", name,
+                              {"entries": entries[-PodLog.MAX_LINES:]}, ns)
+        except APIError as e:
+            if e.code != 404:
+                return
+            try:
+                self.client.create("podlogs", {
+                    "kind": "PodLog",
+                    "metadata": {"name": name, "namespace": ns,
+                                 "ownerReferences": [{
+                                     "kind": "Pod", "name": name,
+                                     "uid": pod.metadata.uid}]},
+                    "entries": [line]}, ns)
+            except APIError:
+                pass
 
     def start(self) -> "JoinedNode":
         from .. import server as _server  # noqa: F401  (package init)

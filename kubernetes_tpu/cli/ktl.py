@@ -215,6 +215,28 @@ def cmd_apply(client: RESTClient, args) -> int:
 
 
 def cmd_delete(client: RESTClient, args) -> int:
+    if getattr(args, "filename", None):
+        # kubectl delete -f: resolve each manifest's kind and delete by name
+        rc = 0
+        for doc in load_manifests(args.filename):
+            resource = resolve_kind(client, doc.get("kind", ""))
+            meta = doc.get("metadata") or {}
+            if resource is None or not meta.get("name"):
+                print(f"error: cannot delete {doc.get('kind')!r}", file=sys.stderr)
+                rc = 1
+                continue
+            ns = None if resource in CLUSTER_SCOPED else (
+                args.namespace or meta.get("namespace") or "default")
+            try:
+                client.delete(resource, meta["name"], ns)
+                print(f"{resource}/{meta['name']} deleted")
+            except APIError as e:
+                print(f"error: {e}", file=sys.stderr)
+                rc = 1
+        return rc
+    if not args.resource or not args.name:
+        print("error: delete requires RESOURCE NAME or -f FILE", file=sys.stderr)
+        return 1
     resource = resolve_resource(args.resource)
     ns = None if resource in CLUSTER_SCOPED else (args.namespace or "default")
     try:
@@ -224,6 +246,161 @@ def cmd_delete(client: RESTClient, args) -> int:
     except APIError as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
+
+
+def cmd_replace(client: RESTClient, args) -> int:
+    """kubectl replace: full PUT of each manifest (handlers/update.go)."""
+    rc = 0
+    for doc in load_manifests(args.filename):
+        resource = resolve_kind(client, doc.get("kind", ""))
+        meta = doc.get("metadata") or {}
+        if resource is None or not meta.get("name"):
+            print(f"error: cannot replace {doc.get('kind')!r}", file=sys.stderr)
+            rc = 1
+            continue
+        ns = None if resource in CLUSTER_SCOPED else (
+            args.namespace or meta.get("namespace") or "default")
+        try:
+            if "resourceVersion" not in (doc.get("metadata") or {}):
+                # carry the live RV so OCC applies to the replacement
+                cur = client.get(resource, meta["name"], ns)
+                doc.setdefault("metadata", {})["resourceVersion"] = \
+                    cur["metadata"]["resourceVersion"]
+            client.update(resource, doc, ns)
+            print(f"{resource}/{meta['name']} replaced")
+        except APIError as e:
+            print(f"error: {e}", file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def cmd_run(client: RESTClient, args) -> int:
+    """kubectl run: one pod from flags."""
+    requests = {}
+    for pair in args.requests.split(",") if args.requests else []:
+        k, _, v = pair.partition("=")
+        if k and v:
+            requests[k] = v
+    container = {"name": args.name, "image": args.image}
+    if requests:
+        container["resources"] = {"requests": requests}
+    pod = {"kind": "Pod",
+           "metadata": {"name": args.name,
+                        "labels": {"run": args.name},
+                        "namespace": args.namespace or "default"},
+           "spec": {"containers": [container]}}
+    client.create("pods", pod, args.namespace or "default")
+    print(f"pod/{args.name} created")
+    return 0
+
+
+def cmd_expose(client: RESTClient, args) -> int:
+    """kubectl expose: Service selecting the target workload's pods."""
+    kind, name = args.target.split("/", 1) if "/" in args.target else ("deployment", args.target)
+    resource = resolve_resource(kind)
+    ns = args.namespace or "default"
+    obj = client.get(resource, name, ns)
+    selector = ((obj.get("spec") or {}).get("selector") or {})
+    # Service selectors are plain label maps; fold single-value In
+    # expressions back down (the serializer normalizes matchLabels into
+    # matchExpressions)
+    match = dict(selector.get("matchLabels") or {})
+    for e in selector.get("matchExpressions") or []:
+        if e.get("operator") == "In" and len(e.get("values") or []) == 1:
+            match.setdefault(e["key"], e["values"][0])
+    if not match:
+        match = {"run": name}
+    svc = {"kind": "Service",
+           "metadata": {"name": args.service_name or name, "namespace": ns},
+           "spec": {"selector": match,
+                    "ports": [{"port": args.port,
+                               "targetPort": args.target_port or args.port}]}}
+    client.create("services", svc, ns)
+    print(f"service/{svc['metadata']['name']} exposed")
+    return 0
+
+
+def cmd_certificate(client: RESTClient, args) -> int:
+    """kubectl certificate approve|deny (certificates/v1 approval)."""
+    import time as _time
+
+    cond = {"type": "Approved" if args.action == "approve" else "Denied",
+            "reason": "KubectlApprove" if args.action == "approve" else "KubectlDeny",
+            "lastUpdateTime": _time.time()}
+    csr = client.get("certificatesigningrequests", args.name, None)
+    conds = (csr.get("status") or {}).get("conditions", [])
+    if any(c.get("type") == cond["type"] for c in conds):
+        print(f"certificatesigningrequest/{args.name} already {args.action}d")
+        return 0
+    conds.append(cond)
+    client.patch("certificatesigningrequests", args.name,
+                 {"status": {"conditions": conds}}, None)
+    print(f"certificatesigningrequest/{args.name} {args.action}d")
+    return 0
+
+
+def cmd_auth_can_i(client: RESTClient, args) -> int:
+    """kubectl auth can-i: SelfSubjectAccessReview round-trip."""
+    out = client.request(
+        "POST", "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews",
+        {"spec": {"resourceAttributes": {
+            "verb": args.verb, "resource": resolve_resource(args.resource)}}})
+    allowed = bool((out.get("status") or {}).get("allowed"))
+    print("yes" if allowed else "no")
+    return 0 if allowed else 1
+
+
+def cmd_logs(client: RESTClient, args) -> int:
+    """kubectl logs: the pods/{name}/log subresource (text/plain)."""
+    out = client.logs(args.name, args.namespace or "default",
+                      tail_lines=args.tail)
+    sys.stdout.write(out)
+    return 0
+
+
+def cmd_explain(client: RESTClient, args) -> int:
+    """kubectl explain: field documentation straight from the API types."""
+    import dataclasses
+
+    resource = resolve_resource(args.resource)
+    t = RESOURCE_TO_TYPE.get(resource)
+    if t is None:
+        print(f"error: explain supports built-in resources only", file=sys.stderr)
+        return 1
+    print(f"KIND:     {getattr(t, 'kind', t.__name__)}")
+    print(f"RESOURCE: {resource}\n")
+    doc = (t.__doc__ or "").strip().splitlines()
+    if doc:
+        print(f"DESCRIPTION:\n    {doc[0]}\n")
+    print("FIELDS:")
+    import typing
+
+    def resolve(cls, ftype):
+        """Postponed annotations make f.type a STRING — resolve via
+        get_type_hints and unwrap Optional/List/Dict to find a dataclass."""
+        try:
+            hints = typing.get_type_hints(cls)
+        except Exception:
+            return None
+        hint = hints.get(ftype)
+        if dataclasses.is_dataclass(hint):
+            return hint
+        for arg in typing.get_args(hint):
+            if dataclasses.is_dataclass(arg):
+                return arg
+        return None
+
+    def walk(cls, indent):
+        for f in dataclasses.fields(cls):
+            tname = getattr(f.type, "__name__", str(f.type))
+            print(f"{' ' * indent}{f.name}\t<{tname}>")
+            sub = resolve(cls, f.name)
+            if sub is not None and indent < 6:
+                walk(sub, indent + 3)
+
+    if dataclasses.is_dataclass(t):
+        walk(t, 3)
+    return 0
 
 
 def cmd_scale(client: RESTClient, args) -> int:
@@ -573,9 +750,47 @@ def main(argv: Optional[List[str]] = None) -> int:
         p.set_defaults(fn=fn)
 
     p = sub.add_parser("delete")
-    p.add_argument("resource")
-    p.add_argument("name")
+    p.add_argument("resource", nargs="?")
+    p.add_argument("name", nargs="?")
+    p.add_argument("-f", "--filename")
     p.set_defaults(fn=cmd_delete)
+
+    p = sub.add_parser("replace")
+    p.add_argument("-f", "--filename", required=True)
+    p.set_defaults(fn=cmd_replace)
+
+    p = sub.add_parser("run")
+    p.add_argument("name")
+    p.add_argument("--image", required=True)
+    p.add_argument("--requests", default="")
+    p.set_defaults(fn=cmd_run)
+
+    p = sub.add_parser("expose")
+    p.add_argument("target")  # deployment/NAME
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--target-port", type=int, default=0)
+    p.add_argument("--name", dest="service_name", default="")
+    p.set_defaults(fn=cmd_expose)
+
+    p = sub.add_parser("certificate")
+    p.add_argument("action", choices=["approve", "deny"])
+    p.add_argument("name")
+    p.set_defaults(fn=cmd_certificate)
+
+    p = sub.add_parser("auth")
+    p.add_argument("subcmd", choices=["can-i"])
+    p.add_argument("verb")
+    p.add_argument("resource")
+    p.set_defaults(fn=cmd_auth_can_i)
+
+    p = sub.add_parser("explain")
+    p.add_argument("resource")
+    p.set_defaults(fn=cmd_explain)
+
+    p = sub.add_parser("logs")
+    p.add_argument("name")
+    p.add_argument("--tail", type=int, default=0)
+    p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("scale")
     p.add_argument("resource")

@@ -31,10 +31,27 @@ class GarbageCollector(Controller):
     watch_kinds = ("pods", "replicasets", "jobs", "endpointslices",
                    "persistentvolumeclaims")
 
+    SWEEP_INTERVAL = 30.0
+
+    def __init__(self, store, clock=None):
+        super().__init__(store, clock)
+        self._last_sweep = float("-inf")
+
     def key_of_object(self, kind: str, obj) -> Optional[str]:
         if obj.metadata.owner_references:
             return f"{kind}|{self.store.object_key(obj)}"
         return None
+
+    def reconcile_once(self) -> int:
+        """Event-driven marks plus a periodic full-store sweep: owner DELETION
+        does not emit events on the dependents (podlogs, orphaned pods), so
+        only the graph resync catches them (the reference GC's absentOwnerCache
+        + monitor resync)."""
+        n = super().reconcile_once()
+        if self.clock.now() - self._last_sweep >= self.SWEEP_INTERVAL:
+            self._last_sweep = self.clock.now()
+            n += self.sweep()
+        return n
 
     def sweep(self) -> int:
         """Full-store orphan scan (the GC's graph resync). Returns #deleted."""

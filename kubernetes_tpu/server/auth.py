@@ -204,7 +204,7 @@ def default_component_authorizer() -> RBACAuthorizer:
              "poddisruptionbudgets", "leases"])
     a.grant("group:system:nodes",
             ["get", "list", "watch", "create", "update", "patch", "delete"],
-            ["pods", "nodes", "leases", "events"])
+            ["pods", "nodes", "leases", "events", "podlogs"])
     # nodes may renew their own credential (certificatesigningrequests
     # recognizer allows requestor == requested node identity)
     a.grant("group:system:nodes", ["create", "get", "list", "watch"],

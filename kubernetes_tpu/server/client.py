@@ -78,6 +78,28 @@ class RESTClient:
             p += f"/{subresource}"
         return p
 
+    def request_text(self, path: str) -> str:
+        """GET a text/plain endpoint (the pods/{name}/log subresource)."""
+        req = urllib.request.Request(self.base_url + path, method="GET",
+                                     headers=self._headers())
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read().decode()
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read() or b"{}")
+                msg = payload.get("message", str(e))
+            except Exception:
+                msg = str(e)
+            raise APIError(e.code, msg) from None
+
+    def logs(self, name: str, namespace: str = "default",
+             tail_lines: int = 0) -> str:
+        path = self._path("pods", namespace, name, "log")
+        if tail_lines:
+            path += f"?tailLines={tail_lines}"
+        return self.request_text(path)
+
     def request(self, method: str, path: str, body: Optional[Dict] = None,
                 timeout: Optional[float] = None,
                 content_type: Optional[str] = None):

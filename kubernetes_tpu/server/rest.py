@@ -225,6 +225,32 @@ class _Handler(BaseHTTPRequestHandler):
             return (404, f"unknown resource {crd.names.plural} "
                     "(CRD deleted)", "NotFound")
 
+    def _self_subject_access_review(self) -> None:
+        """POST selfsubjectaccessreviews: "can I, the caller, do X?"
+        (authorization/v1 SelfSubjectAccessReview; kubectl auth can-i).
+        Evaluated against the live authorizer; an open server answers yes."""
+        user = self._user()
+        if user is None:
+            self._error(401, "Unauthorized: invalid or missing bearer token",
+                        "Unauthorized")
+            return
+        try:
+            body = self._read_body()
+        except json.JSONDecodeError as e:
+            self._error(400, f"invalid JSON: {e}")
+            return
+        attrs = ((body.get("spec") or {}).get("resourceAttributes") or {})
+        verb = attrs.get("verb", "")
+        resource = attrs.get("resource", "")
+        authz = getattr(self.server, "authorizer", None)
+        allowed = True if authz is None else authz.authorize(user, verb, resource)
+        self._send_json(201, {
+            "kind": "SelfSubjectAccessReview",
+            "apiVersion": "authorization.k8s.io/v1",
+            "spec": {"resourceAttributes": {"verb": verb, "resource": resource}},
+            "status": {"allowed": allowed},
+        })
+
     # ---- authn/authz (DefaultBuildHandlerChain order: authn -> authz) --------
 
     def _user(self):
@@ -351,23 +377,52 @@ class _Handler(BaseHTTPRequestHandler):
         if crd is not None:
             resource = crd.names.plural  # singular/shortName aliases
         q = parse_qs(url.query)
+        if _sub == "log" and resource == "pods" and name is not None:
+            # pods/{name}/log subresource (registry/core/pod/rest/log.go):
+            # rendered text/plain from the PodLog channel node agents feed
+            if self._authenticated_user("get", "pods") is None:
+                return
+            try:
+                tail = int(q.get("tailLines", ["0"])[0] or 0)
+            except ValueError:
+                tail = 0
+            try:
+                log = self.store.get("podlogs", f"{ns}/{name}")
+                lines = log.entries[-tail:] if tail > 0 else log.entries
+            except NotFoundError:
+                # pod exists but has no log yet -> empty body; unknown pod -> 404
+                try:
+                    self.store.get("pods", f"{ns}/{name}")
+                except NotFoundError:
+                    self._error(404, f"pods {ns}/{name} not found", "NotFound")
+                    return
+                lines = []
+            body = ("\n".join(lines) + ("\n" if lines else "")).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         is_watch = name is None and q.get("watch", ["false"])[0] == "true"
         verb = "watch" if is_watch else ("get" if name is not None else "list")
-        if self._authenticated_user(verb, resource) is None:
+        user = self._authenticated_user(verb, resource)
+        if user is None:
             return
         try:
             field_pred = parse_field_selector(q.get("fieldSelector", [""])[0])
         except ValueError as e:
             self._error(400, str(e), "BadRequest")
             return
+        view = self._view_transform(resource, user)
         if is_watch:
             self._watch(resource, ns, int(q.get("resourceVersion", ["-1"])[0]),
-                        field_pred)
+                        field_pred, view=view)
             return
         try:
             if name is not None:
                 obj = self.store.get(resource, self._key(resource, ns, name, crd))
-                self._send_json(200, to_dict(obj))
+                self._send_json(200, view(to_dict(obj)))
             else:
                 def pred(o, _ns=ns, _fp=field_pred):
                     if _ns and o.metadata.namespace != _ns:
@@ -379,13 +434,38 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(200, {
                     "kind": "List",
                     "metadata": {"resourceVersion": rv},
-                    "items": [to_dict(o) for o in items],
+                    "items": [view(to_dict(o)) for o in items],
                 })
         except NotFoundError as e:
             self._error(404, str(e), "NotFound")
 
+    def _view_transform(self, resource: str, user):
+        """Per-resource response redaction. A CSR's status.certificate is a
+        LIVE bearer credential in this build (not a public x509 cert), so only
+        cluster admins and the CSR's own requestor may read it — any broader
+        read grant (e.g. the system:authenticated read-all bootstrap rule)
+        sees the CSR with the credential blanked."""
+        if resource != "certificatesigningrequests" or user is None:
+            return lambda d: d
+        privileged = (getattr(self.server, "authorizer", None) is None
+                      or "system:masters" in user.groups)
+
+        def view(d):
+            if privileged:
+                return d
+            if (d.get("spec") or {}).get("username") == user.name:
+                return d
+            if (d.get("status") or {}).get("certificate"):
+                d = dict(d)
+                d["status"] = {**d["status"], "certificate": ""}
+            return d
+
+        return view
+
     def _watch(self, resource: str, ns: Optional[str], since_rv: int,
-               field_pred=None) -> None:
+               field_pred=None, view=None) -> None:
+        if view is None:
+            view = lambda d: d  # noqa: E731
         try:
             w = self.store.watch(resource, since_rv=since_rv)
         except ResourceVersionTooOldError as e:
@@ -448,7 +528,8 @@ class _Handler(BaseHTTPRequestHandler):
                         maybe_bookmark()
                         continue  # never visible to this watcher
                 last_sent = _time.monotonic()
-                line = json.dumps({"type": etype, "object": to_dict(ev.obj)}).encode() + b"\n"
+                line = json.dumps({"type": etype,
+                                   "object": view(to_dict(ev.obj))}).encode() + b"\n"
                 self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
                 self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
@@ -473,7 +554,11 @@ class _Handler(BaseHTTPRequestHandler):
     # ---- POST: create / binding ----------------------------------------------
 
     def do_POST(self):
-        parsed = _parse_path(urlparse(self.path).path)
+        path = urlparse(self.path).path
+        if path == "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews":
+            self._self_subject_access_review()
+            return
+        parsed = _parse_path(path)
         if parsed is None:
             self._error(404, "unknown path")
             return

@@ -1,0 +1,261 @@
+"""Round-4 ktl breadth: run/expose/replace/delete -f/certificate/auth
+can-i/explain/logs, and the PodLog pipeline behind `ktl logs`.
+
+reference: staging/src/k8s.io/kubectl/pkg/cmd/{run,expose,replace,delete,
+certificates,auth,explain,logs}; registry/core/pod/rest/log.go.
+"""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.cli.ktl import main as ktl_main
+from kubernetes_tpu.server import APIError, APIServer, RESTClient
+from kubernetes_tpu.store import APIStore
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer(APIStore()).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient(server.url)
+
+
+def run(server, *argv):
+    return ktl_main(["--server", server.url, *argv])
+
+
+class TestNewCommands:
+    def test_run_creates_pod(self, server, client, capsys):
+        assert run(server, "run", "web", "--image", "nginx",
+                   "--requests", "cpu=100m,memory=64Mi") == 0
+        pod = client.get("pods", "web")
+        c = pod["spec"]["containers"][0]
+        assert c["image"] == "nginx"
+        assert c["resources"]["requests"] == {"cpu": "100m", "memory": "64Mi"}
+        assert pod["metadata"]["labels"]["run"] == "web"
+
+    def test_expose_deployment(self, server, client, capsys):
+        client.create("deployments", {
+            "kind": "Deployment", "metadata": {"name": "web"},
+            "spec": {"replicas": 1,
+                     "selector": {"matchLabels": {"app": "web"}},
+                     "template": {"metadata": {"labels": {"app": "web"}},
+                                  "spec": {"containers": [{"name": "c"}]}}},
+        })
+        assert run(server, "expose", "deployment/web", "--port", "80") == 0
+        svc = client.get("services", "web")
+        assert svc["spec"]["selector"] == {"app": "web"}
+        assert svc["spec"]["ports"][0]["port"] == 80
+
+    def test_replace_and_delete_f(self, server, client, tmp_path, capsys):
+        manifest = tmp_path / "pod.json"
+        doc = {"kind": "Pod", "metadata": {"name": "p"},
+               "spec": {"containers": [{"name": "c", "image": "a"}]}}
+        manifest.write_text(json.dumps(doc))
+        assert run(server, "create", "-f", str(manifest)) == 0
+        doc["spec"]["containers"][0]["image"] = "b"
+        manifest.write_text(json.dumps(doc))
+        assert run(server, "replace", "-f", str(manifest)) == 0
+        assert client.get("pods", "p")["spec"]["containers"][0]["image"] == "b"
+        assert run(server, "delete", "-f", str(manifest)) == 0
+        with pytest.raises(APIError):
+            client.get("pods", "p")
+
+    def test_certificate_approve(self, server, client, capsys):
+        client.create("certificatesigningrequests", {
+            "kind": "CertificateSigningRequest",
+            "metadata": {"name": "csr1"},
+            "spec": {"request": {"user": "u", "groups": []},
+                     "signerName": "example.com/custom"},
+        }, namespace=None)
+        assert run(server, "certificate", "approve", "csr1") == 0
+        csr = client.get("certificatesigningrequests", "csr1", namespace=None)
+        assert any(c["type"] == "Approved"
+                   for c in csr["status"]["conditions"])
+        # idempotent
+        assert run(server, "certificate", "approve", "csr1") == 0
+
+    def test_auth_can_i_open_server(self, server, capsys):
+        assert run(server, "auth", "can-i", "create", "pods") == 0
+        assert capsys.readouterr().out.strip() == "yes"
+
+    def test_auth_can_i_secured(self, capsys):
+        from kubernetes_tpu.server.auth import RBACAuthorizer, TokenAuthenticator
+
+        authn = TokenAuthenticator()
+        authn.add("t-reader", "reader")
+        authz = RBACAuthorizer().grant("reader", ["get", "list"], ["pods"])
+        srv = APIServer(APIStore(), authenticator=authn, authorizer=authz).start()
+        try:
+            reader = RESTClient(srv.url, token="t-reader")
+            out = reader.request(
+                "POST", "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews",
+                {"spec": {"resourceAttributes": {"verb": "list",
+                                                 "resource": "pods"}}})
+            assert out["status"]["allowed"] is True
+            out = reader.request(
+                "POST", "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews",
+                {"spec": {"resourceAttributes": {"verb": "delete",
+                                                 "resource": "pods"}}})
+            assert out["status"]["allowed"] is False
+        finally:
+            srv.stop()
+
+    def test_explain(self, server, capsys):
+        assert run(server, "explain", "pods") == 0
+        out = capsys.readouterr().out
+        assert "KIND:     Pod" in out and "metadata" in out and "spec" in out
+
+
+class TestLogsPipeline:
+    def test_append_and_serve(self, server, client):
+        from kubernetes_tpu.api.events import append_pod_log
+
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        store = server.store
+        append_pod_log(store, "default", "p", "c", "hello", 1.0, pod_uid="u1")
+        append_pod_log(store, "default", "p", "c", "world", 2.0, pod_uid="u1")
+        text = client.logs("p")
+        assert "[c] hello" in text and "[c] world" in text
+        assert client.logs("p", tail_lines=1).count("\n") == 1
+        assert "world" in client.logs("p", tail_lines=1)
+
+    def test_no_logs_yet_empty_unknown_pod_404(self, server, client):
+        client.create("pods", {"metadata": {"name": "quiet"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        assert client.logs("quiet") == ""
+        with pytest.raises(APIError) as e:
+            client.logs("ghost")
+        assert e.value.code == 404
+
+    def test_bounded_entries(self):
+        from kubernetes_tpu.api.events import PodLog, append_pod_log
+
+        store = APIStore()
+        for i in range(PodLog.MAX_LINES + 50):
+            append_pod_log(store, "default", "p", "c", f"l{i}", float(i))
+        log = store.get("podlogs", "default/p")
+        assert len(log.entries) == PodLog.MAX_LINES
+        assert "l49" not in log.entries[0]  # oldest dropped
+
+    def test_kubelet_writes_logs(self):
+        """In-process kubelet records container starts; ktl logs shows them."""
+        from kubernetes_tpu.agent.cri import FakeRuntime
+        from kubernetes_tpu.agent.kubelet import Kubelet
+        from kubernetes_tpu.testing import MakeNode, MakePod
+        from kubernetes_tpu.utils import FakeClock
+
+        store = APIStore()
+        clock = FakeClock(100.0)
+        store.create("nodes", MakeNode("n1").capacity({"cpu": "8"}).obj())
+        kubelet = Kubelet(store, "n1", runtime=FakeRuntime(clock=clock),
+                          clock=clock)
+        kubelet.register()
+        pod = MakePod("w").req({"cpu": "100m"}).obj()
+        pod.spec.node_name = "n1"
+        pod.spec.containers[0].image = "busybox"
+        store.create("pods", pod)
+        kubelet.tick()
+        log = store.get("podlogs", "default/w")
+        assert any("busybox" in line for line in log.entries)
+
+    def test_gc_reaps_log_after_pod_delete(self):
+        from kubernetes_tpu.api.events import append_pod_log
+        from kubernetes_tpu.controllers.garbagecollector import GarbageCollector
+        from kubernetes_tpu.testing import MakePod
+
+        store = APIStore()
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        store.create("pods", pod)
+        append_pod_log(store, "default", "p", "c", "x", 1.0,
+                       pod_uid=pod.metadata.uid)
+        store.delete("pods", "default/p")
+        gc = GarbageCollector(store)
+        gc.sync_all()
+        gc.reconcile_once()  # first tick sweeps (owner deletes emit no
+        # events on dependents; the periodic graph resync catches them)
+        from kubernetes_tpu.store import NotFoundError
+
+        with pytest.raises(NotFoundError):
+            store.get("podlogs", "default/p")
+
+    def test_recreated_pod_gets_fresh_stream(self):
+        """Same-name pod with a new UID must not inherit (or lose to GC) the
+        old pod's lines."""
+        from kubernetes_tpu.api.events import append_pod_log
+
+        store = APIStore()
+        append_pod_log(store, "default", "p", "c", "old-line", 1.0, pod_uid="A")
+        append_pod_log(store, "default", "p", "c", "new-line", 2.0, pod_uid="B")
+        log = store.get("podlogs", "default/p")
+        assert len(log.entries) == 1 and "new-line" in log.entries[0]
+        assert log.metadata.owner_references[0]["uid"] == "B"
+
+    def test_csr_certificate_redacted_for_other_users(self):
+        """status.certificate is a live bearer credential: only admins and
+        the requestor may read it; broad read grants see it blanked."""
+        from kubernetes_tpu.server.auth import RBACAuthorizer, TokenAuthenticator
+
+        authn = TokenAuthenticator()
+        authn.add("t-admin", "admin", ["system:masters"])
+        authn.add("t-boot", "system:bootstrap:kadm", ["system:bootstrappers"])
+        authn.add("t-other", "otheruser")
+        authz = (RBACAuthorizer()
+                 .grant("group:system:masters", ["*"], ["*"])
+                 .grant("group:system:authenticated", ["get", "list", "watch"],
+                        ["*"])
+                 .grant("group:system:bootstrappers", ["create", "get", "list"],
+                        ["certificatesigningrequests"]))
+        srv = APIServer(APIStore(), authenticator=authn, authorizer=authz).start()
+        try:
+            boot = RESTClient(srv.url, token="t-boot")
+            boot.create("certificatesigningrequests", {
+                "kind": "CertificateSigningRequest",
+                "metadata": {"name": "c1"},
+                "spec": {"request": {"user": "system:node:n1",
+                                     "groups": ["system:nodes"]},
+                         "signerName":
+                         "kubernetes.io/kube-apiserver-client-kubelet"},
+            }, namespace=None)
+            # simulate the signer issuing (in-process write)
+            def fill(obj):
+                obj.certificate = "SECRET-CRED"
+                return obj
+
+            srv.store.guaranteed_update("certificatesigningrequests", "c1", fill)
+            admin = RESTClient(srv.url, token="t-admin")
+            other = RESTClient(srv.url, token="t-other")
+            assert admin.get("certificatesigningrequests", "c1",
+                             namespace=None)["status"]["certificate"] == "SECRET-CRED"
+            # requestor sees its own credential
+            assert boot.get("certificatesigningrequests", "c1",
+                            namespace=None)["status"]["certificate"] == "SECRET-CRED"
+            # any other authenticated identity sees it BLANKED (get and list)
+            assert other.get("certificatesigningrequests", "c1",
+                             namespace=None)["status"]["certificate"] == ""
+            items, _ = other.list("certificatesigningrequests")
+            assert items[0]["status"]["certificate"] == ""
+        finally:
+            srv.stop()
+
+    def test_explain_recurses_into_nested_types(self, server, capsys):
+        assert run(server, "explain", "pods") == 0
+        out = capsys.readouterr().out
+        # nested ObjectMeta/PodSpec fields appear indented under the top level
+        assert "name" in out and "containers" in out
+
+    def test_ktl_logs_command(self, server, client, capsys):
+        from kubernetes_tpu.api.events import append_pod_log
+
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        append_pod_log(server.store, "default", "p", "c", "line-1", 1.0)
+        assert run(server, "logs", "p") == 0
+        assert "line-1" in capsys.readouterr().out
