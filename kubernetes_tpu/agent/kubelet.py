@@ -247,6 +247,7 @@ class Kubelet:
         self.node_name = node_name
         self.clock = clock or Clock()
         self.runtime = runtime or FakeRuntime(clock=self.clock)
+        self._config_errors: Dict[str, str] = {}  # pod key -> last config error
         self.capacity = capacity or {"cpu": "8", "memory": "32Gi", "pods": "110"}
         self.labels = labels or {}
         self.pleg = PLEG(self.runtime, relist_period=relist_period, clock=self.clock)
@@ -293,6 +294,7 @@ class Kubelet:
         """One syncLoopIteration: config updates -> runtime tick -> PLEG ->
         probes -> eviction -> heartbeat. Returns #events handled."""
         n = self._pump_config()
+        self._retry_config_blocked()
         if isinstance(self.runtime, FakeRuntime):
             self.runtime.tick()
         for ev in self.pleg.relist():
@@ -338,8 +340,75 @@ class Kubelet:
                 self._start_pod(pod)
         return n
 
+    def _retry_config_blocked(self) -> None:
+        """Pods blocked on missing ConfigMap/Secret refs get re-attempted
+        every tick (the reference kubelet's container-start backoff) — the
+        blocking event already drained from the watch, so only this retry
+        notices the reference appearing."""
+        from ..store import NotFoundError
+
+        for key in list(self._config_errors):
+            if key in self.workers:
+                self._config_errors.pop(key, None)
+                continue
+            try:
+                pod = self.store.get("pods", key)
+            except NotFoundError:
+                self._config_errors.pop(key, None)
+                continue
+            if pod.spec.node_name == self.node_name and not pod.is_terminal():
+                self._start_pod(pod)
+            else:
+                self._config_errors.pop(key, None)
+
+    def _missing_config_refs(self, pod: Pod) -> list:
+        """ConfigMap/Secret references a container start needs
+        (kuberuntime makeEnvironmentVariables + volume mounts): missing
+        non-optional sources block the start — the
+        CreateContainerConfigError state."""
+        from ..store import NotFoundError
+
+        missing = []
+        ns = pod.metadata.namespace
+
+        def check(kind: str, name: str, optional) -> None:
+            if not name or optional:
+                return
+            try:
+                self.store.get(kind, f"{ns}/{name}")
+            except NotFoundError:
+                missing.append(f"{kind[:-1]} {name!r}")
+
+        for c in list(pod.spec.containers) + list(pod.spec.init_containers):
+            for e in c.env:
+                vf = e.get("valueFrom") or {}
+                cm = vf.get("configMapKeyRef") or {}
+                check("configmaps", cm.get("name", ""), cm.get("optional"))
+                sk = vf.get("secretKeyRef") or {}
+                check("secrets", sk.get("name", ""), sk.get("optional"))
+            for e in c.env_from:
+                cm = e.get("configMapRef") or {}
+                check("configmaps", cm.get("name", ""), cm.get("optional"))
+                sk = e.get("secretRef") or {}
+                check("secrets", sk.get("name", ""), sk.get("optional"))
+        for v in pod.spec.volumes:
+            check("configmaps", v.config_map, v.config_map_optional)
+            check("secrets", v.secret, v.secret_optional)
+        return missing
+
     def _start_pod(self, pod: Pod) -> None:
         """SyncPod: sandbox, image pulls, containers (kuberuntime SyncPod)."""
+        missing = self._missing_config_refs(pod)
+        if missing:
+            # CreateContainerConfigError: stay Pending; retried every tick
+            # until the reference appears (the reference kubelet backs off).
+            # Log once per distinct error, not per tick.
+            msg = f"CreateContainerConfigError: {', '.join(missing)} not found"
+            if self._config_errors.get(pod.key) != msg:
+                self._config_errors[pod.key] = msg
+                self._log_line(pod, "kubelet", msg)
+            return
+        self._config_errors.pop(pod.key, None)
         existing = (self.runtime.sandbox_for(pod.key)
                     if hasattr(self.runtime, "sandbox_for") else None)
         if existing is not None:

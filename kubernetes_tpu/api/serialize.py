@@ -36,6 +36,7 @@ from .policy import (
     ServiceAccount,
 )
 from .certificates import CertificateSigningRequest
+from .config import ConfigMap, Secret
 from .crd import CustomResourceDefinition
 from .dra import DeviceClass, ResourceClaim, ResourceSlice
 from .events import Event as CoreEvent, PodLog
@@ -80,6 +81,8 @@ KIND_TO_RESOURCE = {
     "CustomResourceDefinition": "customresourcedefinitions",
     "CertificateSigningRequest": "certificatesigningrequests",
     "PodLog": "podlogs",
+    "ConfigMap": "configmaps",
+    "Secret": "secrets",
 }
 RESOURCE_TO_TYPE = {
     "pods": Pod,
@@ -111,6 +114,8 @@ RESOURCE_TO_TYPE = {
     "customresourcedefinitions": CustomResourceDefinition,
     "certificatesigningrequests": CertificateSigningRequest,
     "podlogs": PodLog,
+    "configmaps": ConfigMap,
+    "secrets": Secret,
 }
 CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses",
                   "csinodes", "resourceslices", "deviceclasses",
@@ -146,6 +151,8 @@ GROUP_PREFIX = {
     "customresourcedefinitions": "/apis/apiextensions.k8s.io/v1",
     "certificatesigningrequests": "/apis/certificates.k8s.io/v1",
     "podlogs": "/api/v1",
+    "configmaps": "/api/v1",
+    "secrets": "/api/v1",
 }
 
 

@@ -121,6 +121,12 @@ class Container:
     # allowPrivilegeEscalation, capabilities, seccompProfile, ...) — consumed
     # by the PodSecurity admission level checks
     security_context: Dict[str, Any] = field(default_factory=dict)
+    # raw core/v1 EnvVar list ({name, value} | {name, valueFrom:
+    # {configMapKeyRef|secretKeyRef}}) + EnvFromSource list — the kubelet
+    # resolves references at container start (CreateContainerConfigError
+    # when the source is missing)
+    env: List[Dict[str, Any]] = field(default_factory=list)
+    env_from: List[Dict[str, Any]] = field(default_factory=list)
 
     @staticmethod
     def from_dict(d: Mapping) -> "Container":
@@ -130,6 +136,8 @@ class Container:
             resources=dict(d.get("resources") or {}),
             image_pull_policy=d.get("imagePullPolicy", ""),
             security_context=dict(d.get("securityContext") or {}),
+            env=[dict(e) for e in d.get("env") or []],
+            env_from=[dict(e) for e in d.get("envFrom") or []],
             ports=[
                 ContainerPort(
                     container_port=int(p["containerPort"]),
@@ -151,6 +159,10 @@ class Container:
             d["imagePullPolicy"] = self.image_pull_policy
         if self.security_context:
             d["securityContext"] = self.security_context
+        if self.env:
+            d["env"] = self.env
+        if self.env_from:
+            d["envFrom"] = self.env_from
         if self.ports:
             d["ports"] = [
                 {
@@ -182,6 +194,10 @@ class Volume:
     iscsi_read_only: bool = False
     ephemeral: bool = False  # ephemeral.volumeClaimTemplate (claim name = pod-volname)
     host_path: str = ""  # hostPath.path — PodSecurity baseline forbids these
+    config_map: str = ""  # configMap.name — kubelet resolves at start
+    config_map_optional: bool = False
+    secret: str = ""  # secret.secretName
+    secret_optional: bool = False
 
     @property
     def scheduling_relevant(self) -> bool:
@@ -212,6 +228,10 @@ class Volume:
             iscsi_read_only=bool(iscsi.get("readOnly", False)),
             ephemeral="ephemeral" in d,
             host_path=(d.get("hostPath") or {}).get("path", ""),
+            config_map=(d.get("configMap") or {}).get("name", ""),
+            config_map_optional=bool((d.get("configMap") or {}).get("optional", False)),
+            secret=(d.get("secret") or {}).get("secretName", ""),
+            secret_optional=bool((d.get("secret") or {}).get("optional", False)),
         )
 
     def to_dict(self) -> Dict[str, Any]:
@@ -234,6 +254,14 @@ class Volume:
             d["ephemeral"] = {"volumeClaimTemplate": {}}
         if self.host_path:
             d["hostPath"] = {"path": self.host_path}
+        if self.config_map:
+            d["configMap"] = {"name": self.config_map,
+                              **({"optional": True} if self.config_map_optional
+                                 else {})}
+        if self.secret:
+            d["secret"] = {"secretName": self.secret,
+                           **({"optional": True} if self.secret_optional
+                              else {})}
         return d
 
 

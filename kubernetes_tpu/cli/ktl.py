@@ -166,6 +166,41 @@ def _rows(resource: str, items: List[Dict]):
 
 
 def cmd_create(client: RESTClient, args) -> int:
+    rest = getattr(args, "rest", None) or []
+    if rest and rest[0] in ("configmap", "cm", "secret"):
+        # kubectl create configmap/secret NAME --from-literal k=v ...
+        if len(rest) < 2:
+            print("error: create configmap/secret requires a NAME", file=sys.stderr)
+            return 1
+        name = rest[1]
+        data = {}
+        for pair in args.from_literal or []:
+            k, _, v = pair.partition("=")
+            data[k] = v
+        ns = args.namespace or "default"
+        if rest[0] == "secret":
+            # `create secret generic NAME`: skip the subtype word; a missing
+            # NAME is a usage error, not a secret named "generic"
+            if name == "generic":
+                if len(rest) < 3:
+                    print("error: create secret generic requires a NAME",
+                          file=sys.stderr)
+                    return 1
+                name = rest[2]
+            doc = {"kind": "Secret", "metadata": {"name": name},
+                   "stringData": data}
+            client.create("secrets", doc, ns)
+            print(f"secret/{name} created")
+        else:
+            doc = {"kind": "ConfigMap", "metadata": {"name": name},
+                   "data": data}
+            client.create("configmaps", doc, ns)
+            print(f"configmap/{name} created")
+        return 0
+    if not args.filename:
+        print("error: create requires -f FILE or configmap/secret form",
+              file=sys.stderr)
+        return 1
     rc = 0
     for doc in load_manifests(args.filename):
         kind = doc.get("kind", "")
@@ -332,6 +367,12 @@ def cmd_certificate(client: RESTClient, args) -> int:
     if any(c.get("type") == cond["type"] for c in conds):
         print(f"certificatesigningrequest/{args.name} already {args.action}d")
         return 0
+    opposite = "Denied" if cond["type"] == "Approved" else "Approved"
+    if any(c.get("type") == opposite for c in conds):
+        # a CSR may not carry both verdicts (certificates/v1 validation)
+        print(f"error: certificatesigningrequest/{args.name} is already "
+              f"{opposite}", file=sys.stderr)
+        return 1
     conds.append(cond)
     client.patch("certificatesigningrequests", args.name,
                  {"status": {"conditions": conds}}, None)
@@ -744,10 +785,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("name")
     p.set_defaults(fn=cmd_describe)
 
-    for name, fn in (("create", cmd_create), ("apply", cmd_apply)):
-        p = sub.add_parser(name)
-        p.add_argument("-f", "--filename", required=True)
-        p.set_defaults(fn=fn)
+    p = sub.add_parser("create")
+    p.add_argument("rest", nargs="*")  # e.g. configmap NAME / secret generic NAME
+    p.add_argument("-f", "--filename")
+    p.add_argument("--from-literal", action="append", default=[])
+    p.set_defaults(fn=cmd_create)
+
+    p = sub.add_parser("apply")
+    p.add_argument("-f", "--filename", required=True)
+    p.set_defaults(fn=cmd_apply)
 
     p = sub.add_parser("delete")
     p.add_argument("resource", nargs="?")

@@ -14,6 +14,7 @@ from .garbagecollector import GarbageCollector  # noqa: F401
 from .job import CronJobController, JobController  # noqa: F401
 from .namespace import NamespaceController  # noqa: F401
 from .node_lifecycle import NodeLifecycleController  # noqa: F401
+from .podgc import PodGCController  # noqa: F401
 from .podautoscaler import HorizontalPodAutoscalerController  # noqa: F401
 from .replicaset import ReplicaSetController  # noqa: F401
 from .resourcequota import ResourceQuotaController  # noqa: F401

@@ -517,6 +517,38 @@ class LimitPodHardAntiAffinityTopology(AdmissionPlugin):
                     f"{term.topology_key!r}", code=422, reason="Invalid")
 
 
+class ImmutableConfigAdmission(AdmissionPlugin):
+    """Enforces ConfigMap/Secret immutability (validation.Validate{ConfigMap,
+    Secret}Update): once immutable, payload may not change and the flag may
+    not be cleared — only deletion releases the name."""
+
+    name = "ImmutableConfig"
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        if resource not in ("configmaps", "secrets") or operation != UPDATE:
+            return
+        try:
+            existing = store.get(
+                resource, f"{obj.metadata.namespace}/{obj.metadata.name}")
+        except NotFoundError:
+            return
+        if not existing.immutable:
+            return
+        if not obj.immutable:
+            raise AdmissionError(
+                f"{resource[:-1]} is immutable: the flag cannot be unset",
+                code=422, reason="Invalid")
+        changed = existing.data != obj.data
+        if resource == "configmaps":
+            changed = changed or existing.binary_data != obj.binary_data
+        else:
+            changed = changed or existing.type != obj.type
+        if changed:
+            raise AdmissionError(
+                f"{resource[:-1]} {obj.metadata.name!r} is immutable: "
+                "data cannot be updated", code=422, reason="Invalid")
+
+
 class CertificateSubjectRestriction(AdmissionPlugin):
     """Rejects kube-apiserver-client CSRs that request the system:masters
     group (plugin/pkg/admission/certificates/subjectrestriction) — no
@@ -566,6 +598,7 @@ def default_admission_chain() -> AdmissionChain:
         DefaultStorageClass(),
         TaintNodesByCondition(),
         PodSecurityAdmission(),
+        ImmutableConfigAdmission(),
         CertificateSubjectRestriction(),
         NodeRestriction(),
         ResourceQuotaAdmission(),

@@ -209,6 +209,18 @@ def default_component_authorizer() -> RBACAuthorizer:
     # recognizer allows requestor == requested node identity)
     a.grant("group:system:nodes", ["create", "get", "list", "watch"],
             ["certificatesigningrequests"])
+    # nodes resolve pod config payloads (the node authorizer scopes these to
+    # pods bound to the node in the reference; kind-level here)
+    a.grant("group:system:nodes", ["get", "list", "watch"],
+            ["configmaps", "secrets"])
     a.grant("group:system:kube-controller-manager", ["*"], ["*"])
-    a.grant("group:system:authenticated", ["get", "list", "watch"], ["*"])
+    # authenticated read-all EXCLUDES secrets: no reference bootstrap role
+    # puts secret payloads in a wildcard read grant (bootstrappolicy's
+    # system:basic-user has nothing; even view/edit enumerate resources).
+    # Enumerated dynamically so new resources stay readable by default while
+    # secrets require an explicit grant.
+    from ..api.serialize import RESOURCE_TO_TYPE
+
+    readable = sorted(r for r in RESOURCE_TO_TYPE if r != "secrets")
+    a.grant("group:system:authenticated", ["get", "list", "watch"], readable)
     return a

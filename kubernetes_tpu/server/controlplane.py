@@ -21,7 +21,7 @@ DEFAULT_CONTROLLERS = (
     "disruption", "nodelifecycle", "tainteviction", "endpointslice",
     "namespace", "garbagecollector", "resourcequota", "horizontalpodautoscaler",
     "serviceaccount", "ttlafterfinished", "eventttl", "csrapproving",
-    "csrcleaner",
+    "csrcleaner", "podgc",
 )
 
 
@@ -39,6 +39,7 @@ def _controller_registry():
         JobController,
         NamespaceController,
         NodeLifecycleController,
+        PodGCController,
         ReplicaSetController,
         ResourceQuotaController,
         EventTTLController,
@@ -62,6 +63,7 @@ def _controller_registry():
         "cronjob": CronJobController,
         "disruption": DisruptionController,
         "nodelifecycle": NodeLifecycleController,
+        "podgc": PodGCController,
         "tainteviction": TaintEvictionController,
         "endpointslice": EndpointSliceController,
         "namespace": NamespaceController,

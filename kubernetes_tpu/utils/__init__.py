@@ -1,2 +1,3 @@
 from .clock import Clock, FakeClock  # noqa: F401
 from .leaderelection import LeaderElector  # noqa: F401
+from .leakcheck import assert_no_thread_leaks  # noqa: F401

@@ -1,0 +1,302 @@
+"""ConfigMap/Secret types, immutability, kubelet reference resolution,
+PodGC, and the thread-leak checker.
+
+reference: core/v1 ConfigMap/Secret, pkg/apis/core/validation
+(ValidateConfigMapUpdate), kuberuntime makeEnvironmentVariables
+(CreateContainerConfigError), pkg/controller/podgc/gc_controller.go,
+test/integration/framework/goleak.go.
+"""
+
+import base64
+
+import pytest
+
+from kubernetes_tpu.api.config import ConfigMap, Secret
+from kubernetes_tpu.api.serialize import from_dict, to_dict
+from kubernetes_tpu.api.types import ObjectMeta, Volume
+from kubernetes_tpu.server import APIError, APIServer, RESTClient
+from kubernetes_tpu.store import APIStore, NotFoundError
+from kubernetes_tpu.testing import MakeNode, MakePod
+from kubernetes_tpu.utils import FakeClock, assert_no_thread_leaks
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer(APIStore()).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient(server.url)
+
+
+class TestTypes:
+    def test_configmap_roundtrip(self):
+        cm = ConfigMap(metadata=ObjectMeta(name="c"), data={"k": "v"},
+                       immutable=True)
+        d = to_dict(cm)
+        back = from_dict("configmaps", d)
+        assert back.data == {"k": "v"} and back.immutable
+        assert to_dict(back) == d
+
+    def test_secret_string_data_folds_to_b64(self):
+        s = Secret.from_dict({"metadata": {"name": "s"},
+                              "stringData": {"pw": "hunter2"},
+                              "data": {"pw": "overridden"}})
+        assert s.data["pw"] == base64.b64encode(b"hunter2").decode()
+        assert s.decoded("pw") == "hunter2"
+        # stringData never echoed on the wire
+        assert "stringData" not in to_dict(s)
+
+
+class TestImmutability:
+    def test_immutable_configmap_rejects_update(self, client):
+        client.create("configmaps", {"kind": "ConfigMap",
+                                     "metadata": {"name": "c"},
+                                     "data": {"k": "v"}, "immutable": True})
+        with pytest.raises(APIError) as e:
+            client.patch("configmaps", "c", {"data": {"k": "v2"}})
+        assert e.value.code == 422
+        # the flag cannot be unset either
+        with pytest.raises(APIError) as e:
+            client.patch("configmaps", "c", {"immutable": False})
+        assert e.value.code == 422
+        # metadata-only changes remain allowed
+        client.patch("configmaps", "c", {"metadata": {"labels": {"a": "b"}}})
+
+    def test_mutable_configmap_updates(self, client):
+        client.create("configmaps", {"kind": "ConfigMap",
+                                     "metadata": {"name": "c"},
+                                     "data": {"k": "v"}})
+        out = client.patch("configmaps", "c", {"data": {"k": "v2"}})
+        assert out["data"]["k"] == "v2"
+
+    def test_immutable_secret_rejects_data_change(self, client):
+        client.create("secrets", {"kind": "Secret", "metadata": {"name": "s"},
+                                  "stringData": {"a": "1"}, "immutable": True})
+        with pytest.raises(APIError) as e:
+            client.patch("secrets", "s", {"stringData": {"a": "2"}})
+        assert e.value.code == 422
+
+
+class TestKubeletConfigRefs:
+    def _kubelet(self, store):
+        from kubernetes_tpu.agent.kubelet import Kubelet
+
+        clock = FakeClock(100.0)
+        store.create("nodes", MakeNode("n1").capacity({"cpu": "8"}).obj())
+        k = Kubelet(store, "n1", clock=clock)
+        k.register()
+        return k
+
+    def _bound_pod(self, store, mutate):
+        pod = MakePod("w").req({"cpu": "100m"}).obj()
+        pod.spec.node_name = "n1"
+        mutate(pod)
+        store.create("pods", pod)
+        return pod
+
+    def test_missing_configmap_blocks_start(self):
+        store = APIStore()
+        k = self._kubelet(store)
+
+        def add_env(pod):
+            pod.spec.containers[0].env = [{"name": "K", "valueFrom": {
+                "configMapKeyRef": {"name": "app-config", "key": "k"}}}]
+
+        self._bound_pod(store, add_env)
+        k.tick()
+        pod = store.get("pods", "default/w")
+        assert pod.status.phase == "Pending"
+        log = store.get("podlogs", "default/w")
+        assert any("CreateContainerConfigError" in line for line in log.entries)
+        # reference appears -> next tick starts the pod
+        store.create("configmaps", ConfigMap(
+            metadata=ObjectMeta(name="app-config"), data={"k": "v"}))
+        k.tick()
+        assert store.get("pods", "default/w").status.phase == "Running"
+
+    def test_optional_and_volume_refs(self):
+        store = APIStore()
+        k = self._kubelet(store)
+
+        def add_refs(pod):
+            pod.spec.containers[0].env = [{"name": "K", "valueFrom": {
+                "configMapKeyRef": {"name": "nope", "key": "k",
+                                    "optional": True}}}]
+            pod.spec.volumes.append(Volume(name="v", secret="creds"))
+
+        self._bound_pod(store, add_refs)
+        k.tick()
+        assert store.get("pods", "default/w").status.phase == "Pending"
+        store.create("secrets", Secret(metadata=ObjectMeta(name="creds")))
+        k.tick()
+        assert store.get("pods", "default/w").status.phase == "Running"
+
+
+class TestPodGC:
+    def test_orphaned_and_terminated_reaped(self):
+        from kubernetes_tpu.controllers.podgc import PodGCController
+
+        store = APIStore()
+        clock = FakeClock(1000.0)
+        store.create("nodes", MakeNode("n1").capacity({"cpu": "8"}).obj())
+        bound = MakePod("ok").req({"cpu": "1"}).obj()
+        bound.spec.node_name = "n1"
+        store.create("pods", bound)
+        orphan = MakePod("orphan").req({"cpu": "1"}).obj()
+        orphan.spec.node_name = "gone-node"
+        store.create("pods", orphan)
+        for i in range(5):
+            t = MakePod(f"done-{i}").req({"cpu": "1"}).obj()
+            t.metadata.creation_timestamp = float(i)
+            t.status.phase = "Succeeded"
+            store.create("pods", t)
+        gc = PodGCController(store, clock=clock, terminated_threshold=2)
+        gc.sync_all()
+        gc.reconcile_once()
+        names = {p.metadata.name for p in store.list("pods")[0]}
+        assert "ok" in names and "orphan" not in names
+        # threshold keeps the NEWEST 2 terminated pods
+        assert names & {"done-3", "done-4"} == {"done-3", "done-4"}
+        assert not names & {"done-0", "done-1", "done-2"}
+
+    def test_unscheduled_terminating_reaped(self):
+        from kubernetes_tpu.controllers.podgc import PodGCController
+
+        store = APIStore()
+        p = MakePod("limbo").req({"cpu": "1"}).obj()
+        p.metadata.deletion_timestamp = 5.0
+        store.create("pods", p)
+        gc = PodGCController(store, clock=FakeClock(1000.0))
+        gc.sync_all()
+        gc.reconcile_once()
+        with pytest.raises(NotFoundError):
+            store.get("pods", "default/limbo")
+
+
+class TestLeakCheck:
+    def test_clean_lifecycle_passes(self):
+        from kubernetes_tpu.server.controlplane import ControlPlane
+
+        store = APIStore()
+        with assert_no_thread_leaks():
+            cp = ControlPlane(store, identity="lk-1",
+                              use_batch_scheduler=False).start()
+            import time as _t
+
+            deadline = _t.time() + 10
+            while not cp.is_leader and _t.time() < deadline:
+                _t.sleep(0.02)
+            assert cp.is_leader
+            cp.stop()
+
+    def test_detects_leak(self):
+        import threading
+        import time as _t
+
+        stop = threading.Event()
+        with pytest.raises(AssertionError, match="leaked threads"):
+            with assert_no_thread_leaks(grace=0.3):
+                threading.Thread(target=stop.wait, name="leaky-thread",
+                                 daemon=True).start()
+        stop.set()
+        _t.sleep(0.05)
+
+
+class TestSecretReadRestriction:
+    def test_wildcard_read_excludes_secrets(self):
+        """The system:authenticated read-all bootstrap rule must NOT cover
+        secret payloads; nodes get an explicit grant."""
+        from kubernetes_tpu.server.auth import (
+            TokenAuthenticator,
+            default_component_authorizer,
+        )
+
+        authn = TokenAuthenticator()
+        authn.add("t-user", "someuser")
+        authn.add("t-node", "system:node:n1", ["system:nodes"])
+        srv = APIServer(APIStore(), authenticator=authn,
+                        authorizer=default_component_authorizer()).start()
+        try:
+            srv.store.create("secrets", Secret(
+                metadata=ObjectMeta(name="s"),
+                data={"k": base64.b64encode(b"v").decode()}))
+            user = RESTClient(srv.url, token="t-user")
+            with pytest.raises(APIError) as e:
+                user.list("secrets")
+            assert e.value.code == 403
+            # other resources stay readable
+            user.list("pods")
+            # node identity reads secrets (pod config resolution)
+            node = RESTClient(srv.url, token="t-node")
+            items, _ = node.list("secrets")
+            assert items[0]["data"]["k"]
+        finally:
+            srv.stop()
+
+
+class TestOptionalVolumeRefs:
+    def test_optional_volume_source_does_not_block(self):
+        from kubernetes_tpu.agent.kubelet import Kubelet
+
+        store = APIStore()
+        store.create("nodes", MakeNode("n1").capacity({"cpu": "8"}).obj())
+        k = Kubelet(store, "n1", clock=FakeClock(100.0))
+        k.register()
+        pod = MakePod("w").req({"cpu": "100m"}).obj()
+        pod.spec.node_name = "n1"
+        pod.spec.volumes.append(Volume(name="v", config_map="nope",
+                                       config_map_optional=True))
+        store.create("pods", pod)
+        k.tick()
+        assert store.get("pods", "default/w").status.phase == "Running"
+
+    def test_volume_optional_round_trips(self):
+        from kubernetes_tpu.api.types import Pod
+
+        d = {"metadata": {"name": "p"},
+             "spec": {"containers": [{"name": "c"}],
+                      "volumes": [{"name": "v",
+                                   "configMap": {"name": "cm",
+                                                 "optional": True}}]}}
+        pod = Pod.from_dict(d)
+        v = pod.spec.volumes[0]
+        assert v.config_map == "cm" and v.config_map_optional
+        assert to_dict(pod)["spec"]["volumes"][0]["configMap"]["optional"] is True
+
+
+class TestKtlConfigCommands:
+    def test_create_configmap_and_secret(self, server, client, capsys):
+        from kubernetes_tpu.cli.ktl import main as ktl
+
+        S = ["--server", server.url]
+        assert ktl(S + ["create", "configmap", "app", "--from-literal",
+                        "k=v", "--from-literal", "x=y"]) == 0
+        cm = client.get("configmaps", "app")
+        assert cm["data"] == {"k": "v", "x": "y"}
+        assert ktl(S + ["create", "secret", "generic", "creds",
+                        "--from-literal", "pw=s3cret"]) == 0
+        sec = client.get("secrets", "creds")
+        assert base64.b64decode(sec["data"]["pw"]).decode() == "s3cret"
+        # NAME required after "generic"
+        assert ktl(S + ["create", "secret", "generic",
+                        "--from-literal", "a=b"]) == 1
+        with pytest.raises(APIError):
+            client.get("secrets", "generic")
+
+    def test_certificate_conflicting_verdict_rejected(self, server, client, capsys):
+        from kubernetes_tpu.cli.ktl import main as ktl
+
+        S = ["--server", server.url]
+        client.create("certificatesigningrequests", {
+            "kind": "CertificateSigningRequest", "metadata": {"name": "c1"},
+            "spec": {"request": {"user": "u"}, "signerName": "x/y"},
+        }, namespace=None)
+        assert ktl(S + ["certificate", "approve", "c1"]) == 0
+        assert ktl(S + ["certificate", "deny", "c1"]) == 1
+        csr = client.get("certificatesigningrequests", "c1", namespace=None)
+        types = [c["type"] for c in csr["status"]["conditions"]]
+        assert types == ["Approved"]
