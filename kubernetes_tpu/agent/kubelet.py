@@ -345,8 +345,6 @@ class Kubelet:
         every tick (the reference kubelet's container-start backoff) — the
         blocking event already drained from the watch, so only this retry
         notices the reference appearing."""
-        from ..store import NotFoundError
-
         for key in list(self._config_errors):
             if key in self.workers:
                 self._config_errors.pop(key, None)
@@ -366,8 +364,6 @@ class Kubelet:
         (kuberuntime makeEnvironmentVariables + volume mounts): missing
         non-optional sources block the start — the
         CreateContainerConfigError state."""
-        from ..store import NotFoundError
-
         missing = []
         ns = pod.metadata.namespace
 

@@ -179,14 +179,19 @@ def cmd_create(client: RESTClient, args) -> int:
             data[k] = v
         ns = args.namespace or "default"
         if rest[0] == "secret":
-            # `create secret generic NAME`: skip the subtype word; a missing
-            # NAME is a usage error, not a secret named "generic"
-            if name == "generic":
-                if len(rest) < 3:
-                    print("error: create secret generic requires a NAME",
-                          file=sys.stderr)
-                    return 1
-                name = rest[2]
+            # kubectl syntax is `create secret {generic|tls|docker-registry}
+            # NAME`; only generic is supported — anything else must error,
+            # not silently become the secret's name
+            subtype = name
+            if subtype != "generic":
+                print(f"error: unsupported secret type {subtype!r} "
+                      "(only 'generic' is supported)", file=sys.stderr)
+                return 1
+            if len(rest) < 3:
+                print("error: create secret generic requires a NAME",
+                      file=sys.stderr)
+                return 1
+            name = rest[2]
             doc = {"kind": "Secret", "metadata": {"name": name},
                    "stringData": data}
             client.create("secrets", doc, ns)
@@ -613,6 +618,53 @@ def cmd_rollout(client: RESTClient, args) -> int:
             ns)
         print(f"{resource}/{name} restarted")
         return 0
+    if args.action in ("history", "undo"):
+        dep = client.get(resource, name, ns)
+        dep_uid = dep["metadata"].get("uid", "")
+        rses, _ = client.list("replicasets", ns)
+        owned = [rs for rs in rses
+                 if any(ref.get("kind") == "Deployment"
+                        and ref.get("uid") == dep_uid
+                        for ref in rs["metadata"].get("ownerReferences", []))]
+        rev_key = "deployment.kubernetes.io/revision"
+
+        def rev(rs):
+            try:
+                return int(rs["metadata"].get("annotations", {}).get(rev_key, 0))
+            except ValueError:
+                return 0
+
+        owned.sort(key=rev)
+        if args.action == "history":
+            print(fmt_table(
+                ["REVISION", "REPLICASET", "REPLICAS"],
+                [[str(rev(rs)), rs["metadata"]["name"],
+                  str((rs.get("spec") or {}).get("replicas", 0))]
+                 for rs in owned]))
+            return 0
+        # undo: previous revision by default, or --to-revision
+        if not owned:
+            print("error: no rollout history", file=sys.stderr)
+            return 1
+        if args.to_revision:
+            targets = [rs for rs in owned if rev(rs) == args.to_revision]
+            if not targets:
+                print(f"error: revision {args.to_revision} not found",
+                      file=sys.stderr)
+                return 1
+            target = targets[0]
+        else:
+            if len(owned) < 2:
+                print("error: no previous revision to roll back to",
+                      file=sys.stderr)
+                return 1
+            target = owned[-2]  # current revision is the max
+        template = (target.get("spec") or {}).get("template") or {}
+        labels = ((template.get("metadata") or {}).get("labels") or {})
+        labels.pop("pod-template-hash", None)
+        client.patch(resource, name, {"spec": {"template": template}}, ns)
+        print(f"{resource}/{name} rolled back to revision {rev(target)}")
+        return 0
     print(f"error: unknown rollout action {args.action!r}", file=sys.stderr)
     return 1
 
@@ -869,9 +921,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.set_defaults(fn=cmd_patch)
 
     p = sub.add_parser("rollout")
-    p.add_argument("action", choices=["status", "restart"])
+    p.add_argument("action", choices=["status", "restart", "history", "undo"])
     p.add_argument("target")  # deployment/NAME
     p.add_argument("--timeout", type=float, default=30.0)
+    p.add_argument("--to-revision", type=int, default=0)
     p.set_defaults(fn=cmd_rollout)
 
     p = sub.add_parser("set")

@@ -22,6 +22,20 @@ def template_hash(dep: Deployment) -> str:
     return hashlib.sha1(raw.encode()).hexdigest()[:10]
 
 
+# revision bookkeeping (deployment/util/deployment_util.go Revision/
+# SetNewReplicaSetAnnotations): each template generation gets a monotonically
+# increasing revision on its RS; rollbacks re-activate an old RS's template,
+# which then receives the NEW max revision
+REVISION_ANNOTATION = "deployment.kubernetes.io/revision"
+
+
+def rs_revision(rs: ReplicaSet) -> int:
+    try:
+        return int(rs.metadata.annotations.get(REVISION_ANNOTATION, "0"))
+    except ValueError:
+        return 0
+
+
 def is_owned_by_dep(rs: ReplicaSet, dep: Deployment) -> bool:
     return any(
         ref.get("kind") == "Deployment" and ref.get("uid") == dep.metadata.uid
@@ -68,6 +82,15 @@ class DeploymentController(Controller):
                 new_rs = rs
             else:
                 old.append(rs)
+        max_rev = max((rs_revision(rs) for rs in rses), default=0)
+        if new_rs is not None and old and rs_revision(new_rs) < max_rev:
+            # rollback: an OLD template became current again — it takes the
+            # next revision so history stays monotonic (deployment_util.go)
+            def bump(obj: ReplicaSet) -> ReplicaSet:
+                obj.metadata.annotations[REVISION_ANNOTATION] = str(max_rev + 1)
+                return obj
+
+            new_rs = self.store.guaranteed_update("replicasets", new_rs.key, bump)
         if new_rs is None:
             import copy
 
@@ -79,6 +102,7 @@ class DeploymentController(Controller):
                     namespace=dep.metadata.namespace,
                     uid=new_uid(),
                     labels={**template.metadata.labels},
+                    annotations={REVISION_ANNOTATION: str(max_rev + 1)},
                     owner_references=[{
                         "kind": "Deployment",
                         "name": dep.metadata.name,

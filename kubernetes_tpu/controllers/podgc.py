@@ -27,11 +27,9 @@ class PodGCController(Controller):
 
     def key_of_object(self, kind, obj):
         # purely time-driven (the reference's 20s gcCheckPeriod): reacting to
-        # every pod/node event would run a full-store sweep per phase write
+        # every pod/node event would run a full-store sweep per phase write.
+        # No keys -> base sync() is never invoked; sweep() is the only path.
         return None
-
-    def sync(self, key: str) -> None:
-        self.sweep()
 
     def reconcile_once(self) -> int:
         n = super().reconcile_once()

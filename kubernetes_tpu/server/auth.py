@@ -162,12 +162,18 @@ class AuthenticatorChain:
 
 @dataclass
 class Rule:
-    """rbac.PolicyRule subset: which verbs on which resources."""
+    """rbac.PolicyRule subset: which verbs on which resources.
+    `except_resources` carves names out of a wildcard resource match — how a
+    broad read rule excludes secret payloads without enumerating every
+    resource (incl. CRD-served plurals unknown at grant time)."""
 
     verbs: Tuple[str, ...]  # get/list/watch/create/update/patch/delete/bind or *
     resources: Tuple[str, ...]  # store kinds or *
+    except_resources: Tuple[str, ...] = ()
 
     def allows(self, verb: str, resource: str) -> bool:
+        if resource in self.except_resources:
+            return False
         return (("*" in self.verbs or verb in self.verbs)
                 and ("*" in self.resources or resource in self.resources))
 
@@ -178,9 +184,10 @@ class RBACAuthorizer:
     def __init__(self):
         self._grants: Dict[str, List[Rule]] = {}
 
-    def grant(self, subject: str, verbs: Sequence[str], resources: Sequence[str]) -> "RBACAuthorizer":
+    def grant(self, subject: str, verbs: Sequence[str], resources: Sequence[str],
+              except_resources: Sequence[str] = ()) -> "RBACAuthorizer":
         self._grants.setdefault(subject, []).append(
-            Rule(tuple(verbs), tuple(resources)))
+            Rule(tuple(verbs), tuple(resources), tuple(except_resources)))
         return self
 
     def authorize(self, user: UserInfo, verb: str, resource: str) -> bool:
@@ -217,10 +224,8 @@ def default_component_authorizer() -> RBACAuthorizer:
     # authenticated read-all EXCLUDES secrets: no reference bootstrap role
     # puts secret payloads in a wildcard read grant (bootstrappolicy's
     # system:basic-user has nothing; even view/edit enumerate resources).
-    # Enumerated dynamically so new resources stay readable by default while
-    # secrets require an explicit grant.
-    from ..api.serialize import RESOURCE_TO_TYPE
-
-    readable = sorted(r for r in RESOURCE_TO_TYPE if r != "secrets")
-    a.grant("group:system:authenticated", ["get", "list", "watch"], readable)
+    # Wildcard-with-carve-out keeps CRD-served plurals readable by default
+    # while secrets require an explicit grant.
+    a.grant("group:system:authenticated", ["get", "list", "watch"], ["*"],
+            except_resources=("secrets",))
     return a

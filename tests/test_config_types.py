@@ -234,6 +234,16 @@ class TestSecretReadRestriction:
             node = RESTClient(srv.url, token="t-node")
             items, _ = node.list("secrets")
             assert items[0]["data"]["k"]
+            # CRD-served plurals stay readable under the wildcard carve-out
+            srv.store.create("customresourcedefinitions", __import__(
+                "kubernetes_tpu.api.crd", fromlist=["CustomResourceDefinition"]
+            ).CustomResourceDefinition.from_dict({
+                "metadata": {"name": "widgets.x.dev"},
+                "spec": {"group": "x.dev", "scope": "Namespaced",
+                         "names": {"plural": "widgets", "kind": "Widget"},
+                         "versions": [{"name": "v1"}]}}))
+            items, _ = user.list("widgets")
+            assert items == []
         finally:
             srv.stop()
 
@@ -286,6 +296,11 @@ class TestKtlConfigCommands:
                         "--from-literal", "a=b"]) == 1
         with pytest.raises(APIError):
             client.get("secrets", "generic")
+        # unsupported subtypes error instead of becoming the NAME
+        assert ktl(S + ["create", "secret", "tls", "web-cert",
+                        "--from-literal", "a=b"]) == 1
+        with pytest.raises(APIError):
+            client.get("secrets", "tls")
 
     def test_certificate_conflicting_verdict_rejected(self, server, client, capsys):
         from kubernetes_tpu.cli.ktl import main as ktl

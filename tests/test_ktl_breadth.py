@@ -113,6 +113,59 @@ class TestNewCommands:
         assert "KIND:     Pod" in out and "metadata" in out and "spec" in out
 
 
+class TestRolloutHistoryUndo:
+    def _deploy(self, client, image):
+        doc = {"kind": "Deployment", "metadata": {"name": "web"},
+               "spec": {"replicas": 2,
+                        "selector": {"matchLabels": {"app": "web"}},
+                        "template": {"metadata": {"labels": {"app": "web"}},
+                                     "spec": {"containers": [
+                                         {"name": "c", "image": image}]}}}}
+        try:
+            client.create("deployments", doc)
+        except APIError:
+            client.patch("deployments", "web",
+                         {"spec": {"template": doc["spec"]["template"]}})
+
+    def test_history_and_undo(self, server, client, capsys):
+        from kubernetes_tpu.controllers.deployment import DeploymentController
+
+        ctrl = DeploymentController(server.store)
+        ctrl.sync_all()
+        self._deploy(client, "app:v1")
+        ctrl.run_until_stable()
+        self._deploy(client, "app:v2")
+        ctrl.run_until_stable()
+        assert run(server, "rollout", "history", "deployment/web") == 0
+        out = capsys.readouterr().out
+        assert "1" in out and "2" in out  # two revisions listed
+        # undo goes back to v1's template
+        assert run(server, "rollout", "undo", "deployment/web") == 0
+        ctrl.run_until_stable()
+        dep = client.get("deployments", "web")
+        assert dep["spec"]["template"]["spec"]["containers"][0]["image"] == "app:v1"
+        # the re-activated RS takes the new max revision (monotonic history)
+        rses, _ = client.list("replicasets")
+        revs = {rs["spec"].get("template", {}).get("spec", {})
+                .get("containers", [{}])[0].get("image"):
+                rs["metadata"].get("annotations", {})
+                .get("deployment.kubernetes.io/revision")
+                for rs in rses}
+        assert revs.get("app:v1") == "3"
+
+    def test_undo_to_revision_and_errors(self, server, client, capsys):
+        from kubernetes_tpu.controllers.deployment import DeploymentController
+
+        ctrl = DeploymentController(server.store)
+        ctrl.sync_all()
+        self._deploy(client, "app:v1")
+        ctrl.run_until_stable()
+        # nothing to undo with a single revision
+        assert run(server, "rollout", "undo", "deployment/web") == 1
+        assert run(server, "rollout", "undo", "deployment/web",
+                   "--to-revision", "9") == 1
+
+
 class TestLogsPipeline:
     def test_append_and_serve(self, server, client):
         from kubernetes_tpu.api.events import append_pod_log
