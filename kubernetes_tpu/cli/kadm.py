@@ -86,7 +86,8 @@ def init_control_plane(port: int = 0, secure: bool = False,
                     ["create", "get", "list", "watch"],
                     ["certificatesigningrequests"])
     server = APIServer(store, port=port, authenticator=authn,
-                       authorizer=authz).start()
+                       authorizer=authz,
+                       flowcontrol="default" if secure else None).start()
     cp = ControlPlane(store, identity=identity,
                       use_batch_scheduler=use_batch_scheduler,
                       signer=signer).start()

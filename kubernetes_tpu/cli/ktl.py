@@ -662,7 +662,12 @@ def cmd_rollout(client: RESTClient, args) -> int:
         template = (target.get("spec") or {}).get("template") or {}
         labels = ((template.get("metadata") or {}).get("labels") or {})
         labels.pop("pod-template-hash", None)
-        client.patch(resource, name, {"spec": {"template": template}}, ns)
+        # full PUT, not merge patch: the old template must REPLACE the
+        # current one wholesale — a merge cannot remove map keys (labels,
+        # nodeSelector, ...) that newer revisions added, which would hash to
+        # a third template instead of re-activating the target RS
+        dep["spec"]["template"] = template
+        client.update(resource, dep, ns)
         print(f"{resource}/{name} rolled back to revision {rev(target)}")
         return 0
     print(f"error: unknown rollout action {args.action!r}", file=sys.stderr)

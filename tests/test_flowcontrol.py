@@ -1,0 +1,174 @@
+"""API Priority & Fairness: classification, seat limits, 429s, exemptions.
+
+reference: staging/src/k8s.io/apiserver/pkg/util/flowcontrol + the
+flowcontrol.apiserver.k8s.io bootstrap configuration.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubernetes_tpu.server import APIError, APIServer, RESTClient
+from kubernetes_tpu.server.auth import TokenAuthenticator, UserInfo
+from kubernetes_tpu.server.flowcontrol import (
+    FlowController,
+    FlowSchema,
+    PriorityLevel,
+    default_flow_controller,
+)
+from kubernetes_tpu.store import APIStore
+
+
+def user(name, *groups):
+    return UserInfo(name=name, groups=tuple(groups) + ("system:authenticated",))
+
+
+class TestClassification:
+    def test_bootstrap_schemas(self):
+        fc = default_flow_controller()
+        assert fc.classify(user("admin", "system:masters"),
+                           "create", "pods").name == "exempt"
+        assert fc.classify(user("system:node:n1", "system:nodes"),
+                           "update", "pods").name == "system"
+        assert fc.classify(user("sched", "system:kube-scheduler"),
+                           "bind", "pods").name == "system"
+        assert fc.classify(user("alice"), "list", "pods").name == "global-default"
+        assert fc.classify(None, "get", "pods").name == "global-default"
+
+    def test_first_match_wins_and_verb_resource_filters(self):
+        fc = FlowController(
+            [PriorityLevel("a", seats=1), PriorityLevel("b", seats=1)],
+            [FlowSchema("writes", "a", verbs=("create", "update")),
+             FlowSchema("catch-all", "b")])
+        assert fc.classify(user("u"), "create", "pods").name == "a"
+        assert fc.classify(user("u"), "get", "pods").name == "b"
+
+    def test_unknown_level_rejected(self):
+        with pytest.raises(ValueError):
+            FlowController([PriorityLevel("a")],
+                           [FlowSchema("s", "missing")])
+
+
+class TestPriorityLevel:
+    def test_seats_queue_and_reject(self):
+        lvl = PriorityLevel("t", seats=1, queue_length=1, queue_timeout=0.2)
+        assert lvl.acquire()  # seat 1
+        # next caller queues then times out
+        t0 = time.monotonic()
+        assert not lvl.acquire()
+        assert time.monotonic() - t0 >= 0.2
+        assert lvl.stats()["rejected"] == 1
+        lvl.release()
+        assert lvl.acquire()
+
+    def test_queue_overflow_rejects_immediately(self):
+        lvl = PriorityLevel("t", seats=1, queue_length=0, queue_timeout=5.0)
+        assert lvl.acquire()
+        t0 = time.monotonic()
+        assert not lvl.acquire()  # queue full (length 0): instant 429
+        assert time.monotonic() - t0 < 1.0
+
+    def test_waiter_gets_freed_seat(self):
+        lvl = PriorityLevel("t", seats=1, queue_length=5, queue_timeout=5.0)
+        assert lvl.acquire()
+        got = []
+        t = threading.Thread(target=lambda: got.append(lvl.acquire()))
+        t.start()
+        time.sleep(0.05)
+        lvl.release()
+        t.join(timeout=2)
+        assert got == [True]
+
+    def test_exempt_never_blocks(self):
+        lvl = PriorityLevel("x", seats=0, exempt=True)
+        for _ in range(10):
+            assert lvl.acquire()
+
+
+class TestServerIntegration:
+    def _server(self, fc):
+        authn = TokenAuthenticator()
+        authn.add("t-user", "alice")
+        authn.add("t-admin", "admin", ["system:masters"])
+        return APIServer(APIStore(), authenticator=authn,
+                         flowcontrol=fc).start()
+
+    def test_429_when_level_saturated(self):
+        fc = FlowController(
+            [PriorityLevel("exempt", exempt=True),
+             PriorityLevel("tiny", seats=1, queue_length=0)],
+            [FlowSchema("exempt", "exempt", users=(), groups=("system:masters",)),
+             FlowSchema("catch-all", "tiny")])
+        srv = self._server(fc)
+        try:
+            # hold the only seat
+            assert fc.levels["tiny"].acquire()
+            alice = RESTClient(srv.url, token="t-user")
+            with pytest.raises(APIError) as e:
+                alice.list("pods")
+            assert e.value.code == 429
+            # admins ride the exempt level regardless
+            admin = RESTClient(srv.url, token="t-admin")
+            admin.list("pods")
+            # health endpoints always answer
+            admin.request("GET", "/healthz")
+            fc.levels["tiny"].release()
+            alice.list("pods")  # seat free again
+        finally:
+            srv.stop()
+
+    def test_watch_bypasses_seats(self):
+        fc = FlowController(
+            [PriorityLevel("tiny", seats=1, queue_length=0)],
+            [FlowSchema("catch-all", "tiny")])
+        srv = self._server(fc)
+        try:
+            assert fc.levels["tiny"].acquire()  # saturate
+            alice = RESTClient(srv.url, token="t-user")
+            seen = []
+
+            def consume():
+                for et, obj in alice.watch("pods", since_rv=0):
+                    seen.append(et)
+                    return
+
+            t = threading.Thread(target=consume, daemon=True)
+            t.start()
+            time.sleep(0.2)
+            fc.levels["tiny"].release()
+            alice.create("pods", {"metadata": {"name": "p"},
+                                  "spec": {"containers": [{"name": "c"}]}})
+            t.join(timeout=5)
+            assert seen == ["ADDED"]  # watch streamed despite saturation
+        finally:
+            srv.stop()
+
+    def test_watch_param_on_writes_does_not_bypass(self):
+        """?watch=true glued onto a POST (or a named GET) must still be
+        seat-accounted — only collection GET watches are long-running."""
+        fc = FlowController(
+            [PriorityLevel("tiny", seats=1, queue_length=0)],
+            [FlowSchema("catch-all", "tiny")])
+        srv = self._server(fc)
+        try:
+            assert fc.levels["tiny"].acquire()  # saturate
+            alice = RESTClient(srv.url, token="t-user")
+            with pytest.raises(APIError) as e:
+                alice.request("POST", "/api/v1/namespaces/default/pods?watch=true",
+                              {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+            assert e.value.code == 429
+        finally:
+            srv.stop()
+
+    def test_metrics_expose_levels(self):
+        srv = self._server(default_flow_controller())
+        try:
+            admin = RESTClient(srv.url, token="t-admin")
+            admin.list("pods")
+            text = admin.request_text("/metrics")
+            assert 'apiserver_flowcontrol_dispatched{priority_level="exempt"}' in text
+            assert 'priority_level="global-default"' in text
+        finally:
+            srv.stop()

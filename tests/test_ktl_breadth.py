@@ -153,6 +153,32 @@ class TestRolloutHistoryUndo:
                 for rs in rses}
         assert revs.get("app:v1") == "3"
 
+    def test_undo_removes_keys_added_by_newer_revision(self, server, client,
+                                                       capsys):
+        """Undo must REPLACE the template: labels/nodeSelector keys the newer
+        revision added have to disappear, re-activating the old RS instead of
+        hashing to a third template."""
+        from kubernetes_tpu.controllers.deployment import DeploymentController
+
+        ctrl = DeploymentController(server.store)
+        ctrl.sync_all()
+        self._deploy(client, "app:v1")
+        ctrl.run_until_stable()
+        # v2 adds a template label on top of the image bump
+        client.patch("deployments", "web", {"spec": {"template": {
+            "metadata": {"labels": {"tier": "fe"}},
+            "spec": {"containers": [{"name": "c", "image": "app:v2"}]}}}})
+        ctrl.run_until_stable()
+        assert run(server, "rollout", "undo", "deployment/web") == 0
+        ctrl.run_until_stable()
+        dep = client.get("deployments", "web")
+        labels = dep["spec"]["template"]["metadata"]["labels"]
+        assert "tier" not in labels
+        # exactly two RSes: the v1 RS was re-activated, no third template
+        rses, _ = client.list("replicasets")
+        assert len([rs for rs in rses
+                    if rs["metadata"]["name"].startswith("web-")]) == 2
+
     def test_undo_to_revision_and_errors(self, server, client, capsys):
         from kubernetes_tpu.controllers.deployment import DeploymentController
 
