@@ -151,3 +151,138 @@ class EndpointSlice:
                        "port": p.port, "protocol": p.protocol}
                       for p in self.ports],
         }
+
+
+# ---- Ingress + NetworkPolicy ---------------------------------------------------
+#
+# reference: staging/src/k8s.io/api/networking/v1/types.go. Like the
+# reference, these are API surface served by the control plane and consumed
+# by OUT-OF-TREE dataplanes (ingress controllers, CNI plugins): the apiserver
+# stores/validates/watches them; nothing in-tree programs the packets.
+
+
+@dataclass
+class IngressClass:
+    """Cluster-scoped; the is_default annotation drives DefaultIngressClass
+    admission (ingressclass.kubernetes.io/is-default-class)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    controller: str = ""
+
+    kind = "IngressClass"
+    DEFAULT_ANNOTATION = "ingressclass.kubernetes.io/is-default-class"
+
+    def __post_init__(self):
+        self.metadata.namespace = ""  # cluster-scoped
+
+    @property
+    def is_default(self) -> bool:
+        return self.metadata.annotations.get(self.DEFAULT_ANNOTATION) == "true"
+
+    @staticmethod
+    def from_dict(d) -> "IngressClass":
+        return IngressClass(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            controller=(d.get("spec") or {}).get("controller", ""),
+        )
+
+    def to_dict(self):
+        return {"apiVersion": "networking.k8s.io/v1", "kind": "IngressClass",
+                "metadata": self.metadata.to_dict(),
+                "spec": {"controller": self.controller}}
+
+
+@dataclass
+class IngressRule:
+    host: str = ""
+    # [(path, pathType, serviceName, servicePort)]
+    paths: list = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d) -> "IngressRule":
+        paths = []
+        for p in ((d.get("http") or {}).get("paths") or []):
+            svc = ((p.get("backend") or {}).get("service") or {})
+            paths.append((p.get("path", "/"), p.get("pathType", "Prefix"),
+                          svc.get("name", ""),
+                          int((svc.get("port") or {}).get("number", 0) or 0)))
+        return IngressRule(host=d.get("host", ""), paths=paths)
+
+    def to_dict(self):
+        return {
+            **({"host": self.host} if self.host else {}),
+            "http": {"paths": [
+                {"path": path, "pathType": ptype,
+                 "backend": {"service": {"name": name,
+                                         "port": {"number": port}}}}
+                for path, ptype, name, port in self.paths]},
+        }
+
+
+@dataclass
+class Ingress:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    ingress_class_name: Optional[str] = None
+    rules: List[IngressRule] = field(default_factory=list)
+    default_backend: str = ""  # service name
+
+    kind = "Ingress"
+
+    @staticmethod
+    def from_dict(d) -> "Ingress":
+        spec = d.get("spec") or {}
+        db = (((spec.get("defaultBackend") or {}).get("service")) or {})
+        return Ingress(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            ingress_class_name=spec.get("ingressClassName"),
+            rules=[IngressRule.from_dict(r) for r in spec.get("rules") or []],
+            default_backend=db.get("name", ""),
+        )
+
+    def to_dict(self):
+        spec = {}
+        if self.ingress_class_name is not None:
+            spec["ingressClassName"] = self.ingress_class_name
+        if self.rules:
+            spec["rules"] = [r.to_dict() for r in self.rules]
+        if self.default_backend:
+            spec["defaultBackend"] = {"service": {"name": self.default_backend}}
+        return {"apiVersion": "networking.k8s.io/v1", "kind": "Ingress",
+                "metadata": self.metadata.to_dict(), "spec": spec}
+
+
+@dataclass
+class NetworkPolicy:
+    """Stored + watched; enforcement belongs to the CNI (out of tree in the
+    reference too). Ingress/egress rules kept as raw dicts — the policy
+    grammar (peers, ports, ipBlock) round-trips without loss."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    pod_selector: dict = field(default_factory=dict)  # raw LabelSelector
+    policy_types: List[str] = field(default_factory=list)
+    ingress: list = field(default_factory=list)
+    egress: list = field(default_factory=list)
+
+    kind = "NetworkPolicy"
+
+    @staticmethod
+    def from_dict(d) -> "NetworkPolicy":
+        spec = d.get("spec") or {}
+        return NetworkPolicy(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            pod_selector=dict(spec.get("podSelector") or {}),
+            policy_types=list(spec.get("policyTypes") or []),
+            ingress=list(spec.get("ingress") or []),
+            egress=list(spec.get("egress") or []),
+        )
+
+    def to_dict(self):
+        spec = {"podSelector": self.pod_selector}
+        if self.policy_types:
+            spec["policyTypes"] = list(self.policy_types)
+        if self.ingress:
+            spec["ingress"] = self.ingress
+        if self.egress:
+            spec["egress"] = self.egress
+        return {"apiVersion": "networking.k8s.io/v1", "kind": "NetworkPolicy",
+                "metadata": self.metadata.to_dict(), "spec": spec}

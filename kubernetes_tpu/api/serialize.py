@@ -26,7 +26,13 @@ from .types import (
     TopologySpreadConstraint,
     WeightedPodAffinityTerm,
 )
-from .networking import EndpointSlice, Service
+from .networking import (
+    EndpointSlice,
+    Ingress,
+    IngressClass,
+    NetworkPolicy,
+    Service,
+)
 from .policy import (
     HorizontalPodAutoscaler,
     LimitRange,
@@ -83,6 +89,9 @@ KIND_TO_RESOURCE = {
     "PodLog": "podlogs",
     "ConfigMap": "configmaps",
     "Secret": "secrets",
+    "Ingress": "ingresses",
+    "IngressClass": "ingressclasses",
+    "NetworkPolicy": "networkpolicies",
 }
 RESOURCE_TO_TYPE = {
     "pods": Pod,
@@ -116,11 +125,14 @@ RESOURCE_TO_TYPE = {
     "podlogs": PodLog,
     "configmaps": ConfigMap,
     "secrets": Secret,
+    "ingresses": Ingress,
+    "ingressclasses": IngressClass,
+    "networkpolicies": NetworkPolicy,
 }
 CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses",
                   "csinodes", "resourceslices", "deviceclasses",
                   "priorityclasses", "customresourcedefinitions",
-                  "certificatesigningrequests"}
+                  "certificatesigningrequests", "ingressclasses"}
 GROUP_PREFIX = {
     "pods": "/api/v1",
     "nodes": "/api/v1",
@@ -153,6 +165,9 @@ GROUP_PREFIX = {
     "podlogs": "/api/v1",
     "configmaps": "/api/v1",
     "secrets": "/api/v1",
+    "ingresses": "/apis/networking.k8s.io/v1",
+    "ingressclasses": "/apis/networking.k8s.io/v1",
+    "networkpolicies": "/apis/networking.k8s.io/v1",
 }
 
 

@@ -99,7 +99,8 @@ def cmd_get(client: RESTClient, args) -> int:
         else:
             print(fmt_table(*_rows(resource, [obj])))
         return 0
-    items, _ = client.list(resource, None if args.all_namespaces else ns)
+    items, _ = client.list(resource, None if args.all_namespaces else ns,
+                           label_selector=getattr(args, "selector", "") or "")
     if args.output == "json":
         print(json.dumps(items, indent=2))
     elif args.output == "yaml":
@@ -835,6 +836,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("name", nargs="?")
     p.add_argument("-o", "--output", choices=["wide", "json", "yaml"], default="wide")
     p.add_argument("-A", "--all-namespaces", action="store_true")
+    p.add_argument("-l", "--selector", default="")
     p.set_defaults(fn=cmd_get)
 
     p = sub.add_parser("describe")

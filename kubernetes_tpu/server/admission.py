@@ -517,6 +517,24 @@ class LimitPodHardAntiAffinityTopology(AdmissionPlugin):
                     f"{term.topology_key!r}", code=422, reason="Invalid")
 
 
+class DefaultIngressClass(AdmissionPlugin):
+    """Ingresses without an ingressClassName get the cluster default class
+    (plugin/pkg/admission/network/defaultingressclass) — the
+    is-default-class annotation drives it, ties resolve to the newest."""
+
+    name = "DefaultIngressClass"
+
+    def admit(self, store, resource, operation, obj, user="") -> None:
+        if resource != "ingresses" or operation != CREATE:
+            return
+        if obj.ingress_class_name is not None:
+            return
+        classes, _ = store.list("ingressclasses", lambda c: c.is_default)
+        if classes:
+            newest = max(classes, key=lambda c: c.metadata.creation_timestamp)
+            obj.ingress_class_name = newest.metadata.name
+
+
 class ImmutableConfigAdmission(AdmissionPlugin):
     """Enforces ConfigMap/Secret immutability (validation.Validate{ConfigMap,
     Secret}Update): once immutable, payload may not change and the flag may
@@ -596,6 +614,7 @@ def default_admission_chain() -> AdmissionChain:
         PriorityAdmission(),
         DefaultTolerationSeconds(),
         DefaultStorageClass(),
+        DefaultIngressClass(),
         TaintNodesByCondition(),
         PodSecurityAdmission(),
         ImmutableConfigAdmission(),

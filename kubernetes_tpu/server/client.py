@@ -130,12 +130,18 @@ class RESTClient:
         return self.request("GET", self._path(resource, namespace, name))
 
     def list(self, resource: str, namespace: Optional[str] = None,
-             field_selector: str = "") -> Tuple[List[Dict], int]:
-        path = self._path(resource, namespace)
-        if field_selector:
-            from urllib.parse import quote
+             field_selector: str = "",
+             label_selector: str = "") -> Tuple[List[Dict], int]:
+        from urllib.parse import quote
 
-            path += f"?fieldSelector={quote(field_selector)}"
+        path = self._path(resource, namespace)
+        params = []
+        if field_selector:
+            params.append(f"fieldSelector={quote(field_selector)}")
+        if label_selector:
+            params.append(f"labelSelector={quote(label_selector)}")
+        if params:
+            path += "?" + "&".join(params)
         out = self.request("GET", path)
         return out["items"], out["metadata"]["resourceVersion"]
 
@@ -160,13 +166,16 @@ class RESTClient:
 
     def watch(self, resource: str, since_rv: int = -1,
               namespace: Optional[str] = None,
-              field_selector: str = "") -> Iterator[Tuple[str, Dict]]:
+              field_selector: str = "",
+              label_selector: str = "") -> Iterator[Tuple[str, Dict]]:
         """Yields (event_type, object_dict); blocks on the streaming response."""
+        from urllib.parse import quote
+
         path = self._path(resource, namespace) + f"?watch=true&resourceVersion={since_rv}"
         if field_selector:
-            from urllib.parse import quote
-
             path += f"&fieldSelector={quote(field_selector)}"
+        if label_selector:
+            path += f"&labelSelector={quote(label_selector)}"
         req = urllib.request.Request(self.base_url + path, headers=self._headers())
         resp = urllib.request.urlopen(req, timeout=3600)
         for raw in resp:

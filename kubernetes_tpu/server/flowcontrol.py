@@ -75,7 +75,10 @@ class PriorityLevel:
     def release(self) -> None:
         with self._cond:
             self.inflight -= 1
-            self._cond.notify()
+            # notify_all: a single notify can be consumed by a waiter that is
+            # concurrently timing out, stranding the seat while other waiters
+            # sleep to rejection
+            self._cond.notify_all()
 
     def stats(self) -> Dict[str, int]:
         with self._cond:
@@ -121,16 +124,25 @@ class FlowController:
                  schemas: Sequence[FlowSchema]):
         self.levels = {l.name: l for l in levels}
         self.schemas = list(schemas)
+        if not self.schemas:
+            raise ValueError("at least one FlowSchema (a catch-all) is required")
         for s in self.schemas:
             if s.level not in self.levels:
                 raise ValueError(f"schema {s.name!r} names unknown level {s.level!r}")
+        last = self.schemas[-1]
+        if not ("*" in last.verbs and "*" in last.resources
+                and "*" in last.users and "*" in last.groups):
+            # the reference guarantees the catch-all FlowSchema exists;
+            # without one, unmatched requests would ride a level whose rule
+            # they explicitly failed
+            raise ValueError(
+                f"last schema {last.name!r} must be a universal catch-all")
 
     def classify(self, user, verb: str, resource: str) -> PriorityLevel:
         for s in self.schemas:
             if s.matches(user, verb, resource):
                 return self.levels[s.level]
-        # no schema matched: catch-all must exist by construction
-        return self.levels[self.schemas[-1].level]
+        return self.levels[self.schemas[-1].level]  # unreachable: catch-all
 
     def stats(self) -> Dict[str, Dict[str, int]]:
         return {name: lvl.stats() for name, lvl in self.levels.items()}

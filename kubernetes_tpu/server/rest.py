@@ -277,9 +277,22 @@ class _Handler(BaseHTTPRequestHandler):
             orig()
             return
         resource = parsed[0] if parsed else ""
+        # derive the SAME verb vocabulary the handlers/authz use, so
+        # FlowSchemas written against 'list'/'bind' actually match
         verb = self._FC_VERBS.get(self.command, "get")
+        if parsed is not None:
+            name, sub = parsed[2], parsed[3]
+            if self.command == "GET" and name is None:
+                verb = "list"
+            elif self.command == "POST" and sub == "binding" and resource == "pods":
+                verb = "bind"
         level = fc.classify(self._user(), verb, resource)
         if not level.acquire():
+            # drain the request body first: on a keep-alive connection the
+            # unread bytes would be parsed as the next request line
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            if length:
+                self.rfile.read(length)
             body = json.dumps({
                 "kind": "Status", "status": "Failure", "code": 429,
                 "reason": "TooManyRequests",
@@ -472,23 +485,36 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             self._error(400, str(e), "BadRequest")
             return
+        label_sel = None
+        raw_label = q.get("labelSelector", [""])[0]
+        if raw_label:
+            from ..api.labels import parse_selector_string
+
+            try:
+                label_sel = parse_selector_string(raw_label)
+            except ValueError as e:
+                self._error(400, str(e), "BadRequest")
+                return
         view = self._view_transform(resource, user)
         if is_watch:
             self._watch(resource, ns, int(q.get("resourceVersion", ["-1"])[0]),
-                        field_pred, view=view)
+                        field_pred, view=view, label_sel=label_sel)
             return
         try:
             if name is not None:
                 obj = self.store.get(resource, self._key(resource, ns, name, crd))
                 self._send_json(200, view(to_dict(obj)))
             else:
-                def pred(o, _ns=ns, _fp=field_pred):
+                def pred(o, _ns=ns, _fp=field_pred, _ls=label_sel):
                     if _ns and o.metadata.namespace != _ns:
+                        return False
+                    if _ls is not None and not _ls.matches(o.metadata.labels):
                         return False
                     return _fp is None or _fp(o)
 
                 items, rv = self.store.list(
-                    resource, pred if (ns or field_pred) else None)
+                    resource,
+                    pred if (ns or field_pred or label_sel) else None)
                 self._send_json(200, {
                     "kind": "List",
                     "metadata": {"resourceVersion": rv},
@@ -521,9 +547,19 @@ class _Handler(BaseHTTPRequestHandler):
         return view
 
     def _watch(self, resource: str, ns: Optional[str], since_rv: int,
-               field_pred=None, view=None) -> None:
+               field_pred=None, view=None, label_sel=None) -> None:
         if view is None:
             view = lambda d: d  # noqa: E731
+        if label_sel is not None:
+            # fold the label selector into the scope predicate so label
+            # changes ride the same ADDED/MODIFIED/DELETED transition logic
+            # the field selector uses (cacher watch filtering)
+            fp = field_pred
+
+            def field_pred(o, _fp=fp, _ls=label_sel):  # noqa: F811
+                if not _ls.matches(o.metadata.labels):
+                    return False
+                return _fp is None or _fp(o)
         try:
             w = self.store.watch(resource, since_rv=since_rv)
         except ResourceVersionTooOldError as e:

@@ -49,6 +49,72 @@ class TestClassification:
             FlowController([PriorityLevel("a")],
                            [FlowSchema("s", "missing")])
 
+    def test_catch_all_required(self):
+        with pytest.raises(ValueError):
+            FlowController([PriorityLevel("a")], [])
+        with pytest.raises(ValueError):
+            # last schema filters on verbs: not a universal catch-all
+            FlowController([PriorityLevel("a")],
+                           [FlowSchema("writes", "a", verbs=("create",))])
+
+    def test_list_verb_classification(self):
+        """Collection GETs classify as 'list' (the handler's verb), so
+        schemas throttling heavy lists actually engage."""
+        fc = FlowController(
+            [PriorityLevel("slow", seats=1, queue_length=0),
+             PriorityLevel("fast", seats=50)],
+            [FlowSchema("heavy-lists", "slow", verbs=("list",)),
+             FlowSchema("catch-all", "fast")])
+        authn = TokenAuthenticator()
+        authn.add("t-user", "alice")
+        srv = APIServer(APIStore(), authenticator=authn,
+                        flowcontrol=fc).start()
+        try:
+            assert fc.levels["slow"].acquire()  # saturate the list level
+            alice = RESTClient(srv.url, token="t-user")
+            with pytest.raises(APIError) as e:
+                alice.list("pods")
+            assert e.value.code == 429
+            # named GET rides the catch-all and succeeds
+            with pytest.raises(APIError) as e:
+                alice.get("pods", "nope")
+            assert e.value.code == 404  # not 429: different level
+        finally:
+            srv.stop()
+
+    def test_429_keeps_connection_usable(self):
+        """A rejected POST must drain its body so the keep-alive connection
+        still parses the NEXT request correctly."""
+        import http.client
+
+        fc = FlowController(
+            [PriorityLevel("tiny", seats=1, queue_length=0)],
+            [FlowSchema("catch-all", "tiny")])
+        authn = TokenAuthenticator()
+        authn.add("t-user", "alice")
+        srv = APIServer(APIStore(), authenticator=authn,
+                        flowcontrol=fc).start()
+        try:
+            assert fc.levels["tiny"].acquire()
+            host, port = srv._httpd.server_address[:2]
+            conn = http.client.HTTPConnection(host, port)
+            body = b'{"metadata": {"name": "p"}, "spec": {"containers": []}}'
+            conn.request("POST", "/api/v1/namespaces/default/pods", body,
+                         {"Authorization": "Bearer t-user",
+                          "Content-Type": "application/json"})
+            resp = conn.getresponse()
+            assert resp.status == 429
+            resp.read()
+            fc.levels["tiny"].release()
+            # SAME connection: the next request must parse cleanly
+            conn.request("GET", "/api/v1/namespaces/default/pods",
+                         headers={"Authorization": "Bearer t-user"})
+            resp = conn.getresponse()
+            assert resp.status == 200
+            conn.close()
+        finally:
+            srv.stop()
+
 
 class TestPriorityLevel:
     def test_seats_queue_and_reject(self):

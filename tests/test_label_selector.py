@@ -1,0 +1,121 @@
+"""Server-side labelSelector on list/watch + the selector string grammar.
+
+reference: apimachinery/pkg/labels/selector.go Parse; apiserver list/watch
+label filtering (cacher watch filtering for label transitions).
+"""
+
+import threading
+
+import pytest
+
+from kubernetes_tpu.api.labels import parse_selector_string
+from kubernetes_tpu.cli.ktl import main as ktl_main
+from kubernetes_tpu.server import APIError, APIServer, RESTClient
+from kubernetes_tpu.store import APIStore
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer(APIStore()).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient(server.url)
+
+
+def pod(name, labels):
+    return {"metadata": {"name": name, "labels": labels},
+            "spec": {"containers": [{"name": "c"}]}}
+
+
+class TestGrammar:
+    def test_forms(self):
+        s = parse_selector_string("app=web,env in (a, b),tier!=db,!legacy,gpu")
+        assert s.matches({"app": "web", "env": "b", "tier": "fe", "gpu": "1"})
+        assert not s.matches({"app": "web", "env": "c", "tier": "fe", "gpu": "1"})
+        assert not s.matches({"app": "web", "env": "a", "tier": "db", "gpu": "1"})
+        assert not s.matches({"app": "web", "env": "a", "legacy": "y", "gpu": "1"})
+        assert not s.matches({"app": "web", "env": "a"})  # gpu Exists fails
+
+    def test_double_equals_alias_and_notin(self):
+        s = parse_selector_string("app==web,env notin (prod)")
+        assert s.matches({"app": "web", "env": "dev"})
+        assert s.matches({"app": "web"})  # notin matches absent key
+        assert not s.matches({"app": "web", "env": "prod"})
+
+    def test_malformed_raises(self):
+        for bad in ("app in ()", "a b c", "=v", "!=v", "!", "app=web,!"):
+            with pytest.raises(ValueError):
+                parse_selector_string(bad)
+
+
+class TestServerSide:
+    def test_list_filters(self, client):
+        client.create("pods", pod("w1", {"app": "web"}))
+        client.create("pods", pod("w2", {"app": "web", "canary": "true"}))
+        client.create("pods", pod("d1", {"app": "db"}))
+        items, _ = client.list("pods", label_selector="app=web")
+        assert {o["metadata"]["name"] for o in items} == {"w1", "w2"}
+        items, _ = client.list("pods", label_selector="app=web,!canary")
+        assert {o["metadata"]["name"] for o in items} == {"w1"}
+        with pytest.raises(APIError) as e:
+            client.list("pods", label_selector="a b")
+        assert e.value.code == 400
+
+    def test_watch_label_transitions(self, client):
+        """Relabelling out of scope yields DELETED; into scope yields ADDED
+        (the cacher's prev-vs-current transition rule)."""
+        _, rv = client.list("pods")
+        events = []
+
+        def consume():
+            for et, obj in client.watch("pods", since_rv=rv,
+                                        label_selector="team=a"):
+                events.append((et, obj["metadata"]["name"]))
+                if len(events) >= 3:
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        client.create("pods", pod("p", {"team": "a"}))       # ADDED
+        client.create("pods", pod("q", {"team": "b"}))       # invisible
+        got = client.get("pods", "p")
+        got["metadata"]["labels"]["team"] = "b"
+        client.update("pods", got)                            # DELETED (left)
+        got2 = client.get("pods", "q")
+        got2["metadata"]["labels"]["team"] = "a"
+        client.update("pods", got2)                           # ADDED (entered)
+        t.join(timeout=5)
+        assert events == [("ADDED", "p"), ("DELETED", "p"), ("ADDED", "q")]
+
+    def test_ingress_types_served_and_defaulted(self, client):
+        """networking/v1 breadth: IngressClass default annotation drives
+        DefaultIngressClass admission; NetworkPolicy round-trips."""
+        client.create("ingressclasses", {
+            "kind": "IngressClass",
+            "metadata": {"name": "nginx", "annotations": {
+                "ingressclass.kubernetes.io/is-default-class": "true"}},
+            "spec": {"controller": "example.com/nginx"}}, namespace=None)
+        out = client.create("ingresses", {
+            "kind": "Ingress", "metadata": {"name": "web"},
+            "spec": {"rules": [{"host": "a.example", "http": {"paths": [
+                {"path": "/", "pathType": "Prefix", "backend": {"service": {
+                    "name": "web", "port": {"number": 80}}}}]}}]}})
+        assert out["spec"]["ingressClassName"] == "nginx"  # defaulted
+        np = client.create("networkpolicies", {
+            "kind": "NetworkPolicy", "metadata": {"name": "deny-all"},
+            "spec": {"podSelector": {}, "policyTypes": ["Ingress"]}})
+        assert np["spec"]["policyTypes"] == ["Ingress"]
+        got = client.get("networkpolicies", "deny-all")
+        assert got["spec"]["podSelector"] == {}
+
+    def test_ktl_get_selector(self, server, client, capsys):
+        client.create("pods", pod("w1", {"app": "web"}))
+        client.create("pods", pod("d1", {"app": "db"}))
+        assert ktl_main(["--server", server.url, "get", "pods",
+                         "-l", "app=web"]) == 0
+        out = capsys.readouterr().out
+        assert "w1" in out and "d1" not in out
