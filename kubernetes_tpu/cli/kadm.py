@@ -87,7 +87,9 @@ def init_control_plane(port: int = 0, secure: bool = False,
                     ["certificatesigningrequests"])
     server = APIServer(store, port=port, authenticator=authn,
                        authorizer=authz,
-                       flowcontrol="default" if secure else None).start()
+                       flowcontrol="default" if secure else None,
+                       audit="default" if secure else None,
+                       token_signer=signer).start()
     cp = ControlPlane(store, identity=identity,
                       use_batch_scheduler=use_batch_scheduler,
                       signer=signer).start()

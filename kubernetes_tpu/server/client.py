@@ -192,13 +192,14 @@ class Informer:
 
     def __init__(self, client: RESTClient, resource: str,
                  on_event: Optional[Callable[[str, Any], None]] = None,
-                 field_selector: str = ""):
+                 field_selector: str = "", label_selector: str = ""):
         self.client = client
         self.resource = resource
         self.cache: Dict[str, Any] = {}
         self.on_event = on_event
         # server-side scope (e.g. spec.nodeName=<me> for a kubelet informer)
         self.field_selector = field_selector
+        self.label_selector = label_selector
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -209,7 +210,8 @@ class Informer:
 
     def start(self) -> "Informer":
         items, rv = self.client.list(self.resource,
-                                     field_selector=self.field_selector)
+                                     field_selector=self.field_selector,
+                                     label_selector=self.label_selector)
         for it in items:
             self.cache[self._key(it)] = from_dict(self.resource, it)
 
@@ -219,7 +221,8 @@ class Informer:
                 try:
                     for etype, obj_dict in self.client.watch(
                             self.resource, since_rv=rv,
-                            field_selector=self.field_selector):
+                            field_selector=self.field_selector,
+                            label_selector=self.label_selector):
                         if self._stop.is_set():
                             return
                         if etype == "BOOKMARK":
@@ -247,7 +250,8 @@ class Informer:
                     # freeze the cache.
                     try:
                         items, rv = self.client.list(
-                            self.resource, field_selector=self.field_selector)
+                            self.resource, field_selector=self.field_selector,
+                            label_selector=self.label_selector)
                         fresh = {self._key(it): from_dict(self.resource, it) for it in items}
                         # synthetic deltas for changes missed during the outage
                         # (informers emit ADDED/MODIFIED/DELETED on cache

@@ -276,16 +276,9 @@ class _Handler(BaseHTTPRequestHandler):
                 and q.get("watch", ["false"])[0] == "true"):
             orig()
             return
-        resource = parsed[0] if parsed else ""
-        # derive the SAME verb vocabulary the handlers/authz use, so
-        # FlowSchemas written against 'list'/'bind' actually match
-        verb = self._FC_VERBS.get(self.command, "get")
-        if parsed is not None:
-            name, sub = parsed[2], parsed[3]
-            if self.command == "GET" and name is None:
-                verb = "list"
-            elif self.command == "POST" and sub == "binding" and resource == "pods":
-                verb = "bind"
+        # derive the SAME verb/resource vocabulary the handlers/authz use,
+        # so FlowSchemas written against 'list'/'bind' actually match
+        verb, resource = self._request_attrs(parsed)
         level = fc.classify(self._user(), verb, resource)
         if not level.acquire():
             # drain the request body first: on a keep-alive connection the
@@ -293,6 +286,7 @@ class _Handler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", 0) or 0)
             if length:
                 self.rfile.read(length)
+            self._audit_record(429, verb=verb)  # overload IS audit-worthy
             body = json.dumps({
                 "kind": "Status", "status": "Failure", "code": 429,
                 "reason": "TooManyRequests",
@@ -357,12 +351,56 @@ class _Handler(BaseHTTPRequestHandler):
         return user
 
     def _send_json(self, code: int, payload) -> None:
+        # audit BEFORE the bytes go out: a client that acts on the response
+        # must already find the event recorded (and the in-memory append
+        # cannot fail the request)
+        self._audit_record(code)
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _request_attrs(self, parsed) -> Tuple[str, str]:
+        """-> (verb, authz-resource): the ONE derivation authz, audit, and
+        flow control all share. Subresources that grant something the parent
+        does not (binding -> bind verb; token -> the serviceaccounts/token
+        resource, since minting a credential is a bigger power than creating
+        SA objects) are distinguished here."""
+        verb = self._FC_VERBS.get(self.command, "get")
+        if parsed is None:
+            return verb, ""
+        resource, _ns, name, sub = parsed
+        if self.command == "GET" and name is None:
+            q = parse_qs(urlparse(self.path).query)
+            verb = ("watch" if q.get("watch", ["false"])[0] == "true"
+                    else "list")
+        elif self.command == "POST" and sub == "binding" and resource == "pods":
+            verb = "bind"
+        elif self.command == "POST" and sub == "token" \
+                and resource == "serviceaccounts":
+            resource = "serviceaccounts/token"
+        return verb, resource
+
+    def _audit_record(self, code: int, verb: Optional[str] = None) -> None:
+        """Metadata-level audit on resource requests (audit.py). Callers
+        record BEFORE writing response bytes: a client acting on the response
+        must already find the event recorded; the in-memory append cannot
+        delay or fail the request."""
+        audit = getattr(self.server, "audit", None)
+        if audit is None:
+            return
+        parsed = _parse_path(urlparse(self.path).path)
+        if parsed is None:
+            return  # non-resource endpoints are not audited (subset)
+        derived_verb, resource = self._request_attrs(parsed)
+        _r, ns, name, _sub = parsed
+        try:
+            audit.log(self._user(), verb or derived_verb,
+                      resource, ns or "", name or "", code)
+        except Exception:
+            pass
 
     def _error(self, code: int, message: str, reason: str = "") -> None:
         self._send_json(code, {"kind": "Status", "status": "Failure",
@@ -565,6 +603,7 @@ class _Handler(BaseHTTPRequestHandler):
         except ResourceVersionTooOldError as e:
             self._error(410, str(e), "Expired")
             return
+        self._audit_record(200, verb="watch")
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
@@ -671,8 +710,9 @@ class _Handler(BaseHTTPRequestHandler):
         crd = self._crd(resource)
         if crd is not None:
             resource = crd.names.plural
-        verb = "bind" if (sub == "binding" and resource == "pods") else "create"
-        user = self._authenticated_user(verb, resource)
+        verb, authz_resource = self._request_attrs(
+            (resource, ns, name, sub))
+        user = self._authenticated_user(verb, authz_resource)
         if user is None:
             return
         try:
@@ -692,6 +732,38 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(404, str(e), "NotFound")
             except AlreadyBoundError as e:
                 self._error(409, str(e), "Conflict")
+            return
+        if sub == "token" and resource == "serviceaccounts":
+            # TokenRequest subresource: mint a signed bearer credential for
+            # the service account identity (registry/core/serviceaccount/
+            # storage TokenREST + the projected-token flow)
+            signer = getattr(self.server, "token_signer", None)
+            if signer is None:
+                self._error(501, "token signing is not configured on this "
+                            "server", "NotImplemented")
+                return
+            try:
+                self.store.get("serviceaccounts", f"{ns}/{name}")
+            except NotFoundError as e:
+                self._error(404, str(e), "NotFound")
+                return
+            exp = (body.get("spec") or {}).get("expirationSeconds") or 3600
+            try:
+                exp = max(600, min(int(exp), 86400))
+            except (TypeError, ValueError):
+                self._error(400, "spec.expirationSeconds must be an integer",
+                            "BadRequest")
+                return
+            token = signer.mint(
+                f"system:serviceaccount:{ns}:{name}",
+                ["system:serviceaccounts", f"system:serviceaccounts:{ns}"],
+                expiration_seconds=exp)
+            self._send_json(201, {
+                "kind": "TokenRequest",
+                "apiVersion": "authentication.k8s.io/v1",
+                "spec": {"expirationSeconds": exp},
+                "status": {"token": token, "expirationSeconds": exp},
+            })
             return
         if not self._known(resource, crd):
             self._error(404, f"unknown resource {resource}")
@@ -942,7 +1014,8 @@ class APIServer:
 
     def __init__(self, store: APIStore, host: str = "127.0.0.1", port: int = 0,
                  verbose: bool = False, admission="default",
-                 authenticator=None, authorizer=None, flowcontrol=None):
+                 authenticator=None, authorizer=None, flowcontrol=None,
+                 audit=None, token_signer=None):
         self.store = store
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.store = store  # type: ignore[attr-defined]
@@ -967,6 +1040,14 @@ class APIServer:
 
             flowcontrol = default_flow_controller()
         self._httpd.flowcontrol = flowcontrol  # type: ignore[attr-defined]
+        if audit == "default":
+            from .audit import AuditLogger
+
+            audit = AuditLogger()
+        self._httpd.audit = audit  # type: ignore[attr-defined]
+        # SignedTokenAuthenticator used to mint service-account tokens via
+        # the serviceaccounts/{name}/token subresource
+        self._httpd.token_signer = token_signer  # type: ignore[attr-defined]
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 

@@ -1,0 +1,230 @@
+"""Audit logging + ServiceAccount TokenRequest subresource.
+
+reference: apiserver/pkg/audit (+ apis/audit/v1 policy levels),
+registry/core/serviceaccount TokenREST (authentication.k8s.io TokenRequest).
+"""
+
+import pytest
+
+from kubernetes_tpu.server import APIError, APIServer, RESTClient
+from kubernetes_tpu.server.audit import (
+    AuditLogger,
+    AuditPolicy,
+    AuditRule,
+    LEVEL_NONE,
+    default_audit_policy,
+)
+from kubernetes_tpu.server.auth import (
+    SignedTokenAuthenticator,
+    TokenAuthenticator,
+    UserInfo,
+)
+from kubernetes_tpu.store import APIStore
+
+
+def user(name, *groups):
+    return UserInfo(name=name, groups=tuple(groups))
+
+
+class TestPolicy:
+    def test_default_drops_node_reads_keeps_writes(self):
+        p = default_audit_policy()
+        node = user("system:node:n1", "system:nodes")
+        assert p.level_for(node, "get", "pods") == LEVEL_NONE
+        assert p.level_for(node, "update", "pods") == "Metadata"
+        alice = user("alice", "system:authenticated")
+        assert p.level_for(alice, "list", "events") == LEVEL_NONE
+        assert p.level_for(alice, "list", "pods") == "Metadata"
+
+    def test_rule_order_first_match(self):
+        p = AuditPolicy(rules=[
+            AuditRule(level=LEVEL_NONE, verbs=("get",)),
+            AuditRule(level="Metadata"),
+        ])
+        assert p.level_for(user("u"), "get", "pods") == LEVEL_NONE
+        assert p.level_for(user("u"), "create", "pods") == "Metadata"
+
+
+class TestAuditedServer:
+    def test_writes_and_denials_recorded(self):
+        audit = AuditLogger(policy=AuditPolicy())  # audit everything
+        authn = TokenAuthenticator()
+        authn.add("t-u", "alice")
+        srv = APIServer(APIStore(), authenticator=authn, audit=audit).start()
+        try:
+            c = RESTClient(srv.url, token="t-u")
+            c.create("pods", {"metadata": {"name": "p"},
+                              "spec": {"containers": [{"name": "c"}]}})
+            with pytest.raises(APIError):
+                c.get("pods", "nope")
+            evs = audit.events()
+            create = [e for e in evs if e["verb"] == "create"]
+            assert create and create[0]["user"] == "alice"
+            assert create[0]["resource"] == "pods" and create[0]["code"] == 201
+            missing = [e for e in evs if e["name"] == "nope"]
+            assert missing and missing[0]["code"] == 404
+        finally:
+            srv.stop()
+
+    def test_file_sink(self, tmp_path):
+        path = tmp_path / "audit.log"
+        audit = AuditLogger(policy=AuditPolicy(), path=str(path))
+        srv = APIServer(APIStore(), audit=audit).start()
+        try:
+            RESTClient(srv.url).list("pods")
+        finally:
+            srv.stop()
+            audit.close()
+        import json
+
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert lines and lines[0]["verb"] == "list"
+
+
+class TestTokenRequest:
+    def _server(self):
+        signer = SignedTokenAuthenticator(b"k" * 32)
+        srv = APIServer(APIStore(), token_signer=signer).start()
+        return srv, signer
+
+    def test_mint_and_authenticate_sa_token(self):
+        srv, signer = self._server()
+        try:
+            c = RESTClient(srv.url)
+            c.create("serviceaccounts", {"kind": "ServiceAccount",
+                                         "metadata": {"name": "builder"}})
+            out = c.request(
+                "POST",
+                "/api/v1/namespaces/default/serviceaccounts/builder/token",
+                {"spec": {"expirationSeconds": 1200}})
+            tok = out["status"]["token"]
+            assert out["status"]["expirationSeconds"] == 1200
+            ident = signer.authenticate(f"Bearer {tok}")
+            assert ident.name == "system:serviceaccount:default:builder"
+            assert "system:serviceaccounts" in ident.groups
+            assert "system:serviceaccounts:default" in ident.groups
+        finally:
+            srv.stop()
+
+    def test_missing_sa_404_and_unconfigured_501(self):
+        srv, _ = self._server()
+        try:
+            c = RESTClient(srv.url)
+            with pytest.raises(APIError) as e:
+                c.request("POST",
+                          "/api/v1/namespaces/default/serviceaccounts/ghost/token",
+                          {})
+            assert e.value.code == 404
+        finally:
+            srv.stop()
+        bare = APIServer(APIStore()).start()
+        try:
+            c = RESTClient(bare.url)
+            c.create("serviceaccounts", {"kind": "ServiceAccount",
+                                         "metadata": {"name": "sa"}})
+            with pytest.raises(APIError) as e:
+                c.request("POST",
+                          "/api/v1/namespaces/default/serviceaccounts/sa/token",
+                          {})
+            assert e.value.code == 501
+        finally:
+            bare.stop()
+
+    def test_expiration_clamped(self):
+        srv, signer = self._server()
+        try:
+            c = RESTClient(srv.url)
+            c.create("serviceaccounts", {"kind": "ServiceAccount",
+                                         "metadata": {"name": "sa"}})
+            out = c.request(
+                "POST", "/api/v1/namespaces/default/serviceaccounts/sa/token",
+                {"spec": {"expirationSeconds": 10}})
+            assert out["status"]["expirationSeconds"] == 600  # floor
+            out = c.request(
+                "POST", "/api/v1/namespaces/default/serviceaccounts/sa/token",
+                {"spec": {"expirationSeconds": 10_000_000}})
+            assert out["status"]["expirationSeconds"] == 86400  # ceiling
+        finally:
+            srv.stop()
+
+    def test_token_subresource_needs_its_own_grant(self):
+        """create on `serviceaccounts` must NOT allow minting tokens: the
+        subresource authorizes as `serviceaccounts/token` (privilege
+        escalation otherwise)."""
+        from kubernetes_tpu.server.auth import RBACAuthorizer
+
+        signer = SignedTokenAuthenticator(b"k" * 32)
+        authn = TokenAuthenticator()
+        authn.add("t-sa-admin", "sa-admin")
+        authn.add("t-minter", "minter")
+        authz = (RBACAuthorizer()
+                 .grant("sa-admin", ["create", "get", "list"],
+                        ["serviceaccounts"])
+                 .grant("minter", ["create"], ["serviceaccounts/token"])
+                 .grant("minter", ["get", "list"], ["serviceaccounts"]))
+        srv = APIServer(APIStore(), authenticator=authn, authorizer=authz,
+                        token_signer=signer).start()
+        try:
+            sa_admin = RESTClient(srv.url, token="t-sa-admin")
+            sa_admin.create("serviceaccounts", {"kind": "ServiceAccount",
+                                                "metadata": {"name": "app"}})
+            with pytest.raises(APIError) as e:
+                sa_admin.request(
+                    "POST",
+                    "/api/v1/namespaces/default/serviceaccounts/app/token", {})
+            assert e.value.code == 403
+            minter = RESTClient(srv.url, token="t-minter")
+            out = minter.request(
+                "POST",
+                "/api/v1/namespaces/default/serviceaccounts/app/token", {})
+            assert out["status"]["token"]
+        finally:
+            srv.stop()
+
+    def test_denied_watch_audited_as_watch(self):
+        """A 403'd watch must record verb=watch, not list (audit shares the
+        handler's verb derivation)."""
+        from kubernetes_tpu.server.auth import RBACAuthorizer
+
+        audit = AuditLogger(policy=AuditPolicy())
+        authn = TokenAuthenticator()
+        authn.add("t-u", "alice")
+        authz = RBACAuthorizer().grant("alice", ["get", "list"], ["pods"])
+        srv = APIServer(APIStore(), authenticator=authn, authorizer=authz,
+                        audit=audit).start()
+        try:
+            c = RESTClient(srv.url, token="t-u")
+            with pytest.raises(APIError) as e:
+                c.request("GET", "/api/v1/namespaces/default/pods?watch=true")
+            assert e.value.code == 403
+            denied = [ev for ev in audit.events() if ev["code"] == 403]
+            assert denied and denied[-1]["verb"] == "watch"
+        finally:
+            srv.stop()
+
+    def test_secure_cluster_sa_token_end_to_end(self):
+        """kadm secure init: mint an SA token via the admin credential, then
+        use it — it authenticates and can read (authenticated group) but not
+        write (no grant)."""
+        from kubernetes_tpu.cli.kadm import init_control_plane
+
+        res = init_control_plane(secure=True, use_batch_scheduler=False)
+        try:
+            assert res.wait_ready(30)
+            admin = RESTClient(res.url, token=res.token)
+            admin.create("serviceaccounts", {"kind": "ServiceAccount",
+                                             "metadata": {"name": "app"}})
+            out = admin.request(
+                "POST", "/api/v1/namespaces/default/serviceaccounts/app/token",
+                {"spec": {"expirationSeconds": 900}})
+            sa = RESTClient(res.url, token=out["status"]["token"])
+            sa.list("pods")  # authenticated read
+            with pytest.raises(APIError) as e:
+                sa.create("pods", {"metadata": {"name": "x"},
+                                   "spec": {"containers": [{"name": "c"}]}})
+            assert e.value.code == 403
+            with pytest.raises(APIError) as e:
+                sa.list("secrets")  # secrets carved out of wildcard read
+            assert e.value.code == 403
+        finally:
+            res.stop()
