@@ -124,14 +124,21 @@ def waterfill_solve(inp: SolverInputs, groups: List[Tuple[np.ndarray, int]]):
     n = inp.alloc.shape[0]
     # j_max must cover every node's remaining pod headroom, or schedulable pods
     # would be silently clipped; the int32 sort key bounds slots at ~2.6M
-    # (max_total_score 800 * slots < 2^31). Bucketed to the next power of two
-    # so a cluster gradually filling up doesn't recompile per headroom value.
-    headroom = max(1, int(np.asarray(inp.max_pods - inp.pod_count).max(initial=1)))
-    j_max = 1 << (headroom - 1).bit_length()
+    # (max_total_score 800 * slots < 2^31). Derived from STATIC capacity
+    # (max_pods) when it fits: headroom shrinks as the cluster fills and a
+    # headroom-derived bucket would recompile at every power-of-two boundary
+    # — each mid-run XLA compile costs tens of seconds on TPU. Only when the
+    # static bound blows the int32 key range does the tighter dynamic
+    # headroom (then a raw, unbucketed one) come in.
+    cap = max(1, int(np.asarray(inp.max_pods).max(initial=1)))
+    j_max = 1 << (cap - 1).bit_length()
     if n * j_max > 2_600_000:
-        if n * headroom > 2_600_000:
-            return None
-        j_max = headroom
+        headroom = max(1, int(np.asarray(inp.max_pods - inp.pod_count).max(initial=1)))
+        j_max = 1 << (headroom - 1).bit_length()
+        if n * j_max > 2_600_000:
+            if n * headroom > 2_600_000:
+                return None
+            j_max = headroom
     assignment = np.full(p, -1, dtype=np.int32)
     used = inp.used
     used_nz = inp.used_nz

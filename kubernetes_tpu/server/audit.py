@@ -32,16 +32,20 @@ class AuditRule:
     resources: Tuple[str, ...] = ("*",)
 
     def matches(self, user, verb: str, resource: str) -> bool:
+        """Specified criteria AND together (audit/v1 policy semantics: a rule
+        matches only when every non-empty/non-wildcard field matches);
+        empty or wildcard fields are unconstrained."""
         if "*" not in self.verbs and verb not in self.verbs:
             return False
         if "*" not in self.resources and resource not in self.resources:
             return False
-        if "*" in self.users and "*" in self.groups:
-            return True
-        user_ok = user is not None and user.name in self.users
-        group_ok = user is not None and any(g in self.groups
-                                            for g in user.groups)
-        return user_ok or group_ok
+        checks = []
+        if self.users and "*" not in self.users:
+            checks.append(user is not None and user.name in self.users)
+        if self.groups and "*" not in self.groups:
+            checks.append(user is not None
+                          and any(g in self.groups for g in user.groups))
+        return all(checks)
 
 
 class AuditPolicy:

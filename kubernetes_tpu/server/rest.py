@@ -372,6 +372,11 @@ class _Handler(BaseHTTPRequestHandler):
         if parsed is None:
             return verb, ""
         resource, _ns, name, sub = parsed
+        # CRD aliases canonicalize to the plural here too — audit rules and
+        # FlowSchemas match the same name authz sees, however the URL spells it
+        crd = self._crd(resource)
+        if crd is not None:
+            resource = crd.names.plural
         if self.command == "GET" and name is None:
             q = parse_qs(urlparse(self.path).query)
             verb = ("watch" if q.get("watch", ["false"])[0] == "true"
@@ -747,13 +752,18 @@ class _Handler(BaseHTTPRequestHandler):
             except NotFoundError as e:
                 self._error(404, str(e), "NotFound")
                 return
-            exp = (body.get("spec") or {}).get("expirationSeconds") or 3600
+            raw_exp = (body.get("spec") or {}).get("expirationSeconds")
             try:
-                exp = max(600, min(int(exp), 86400))
+                exp = 3600 if raw_exp is None else int(raw_exp)
             except (TypeError, ValueError):
                 self._error(400, "spec.expirationSeconds must be an integer",
                             "BadRequest")
                 return
+            if exp <= 0:
+                self._error(400, "spec.expirationSeconds must be positive",
+                            "BadRequest")
+                return
+            exp = max(600, min(exp, 86400))
             token = signer.mint(
                 f"system:serviceaccount:{ns}:{name}",
                 ["system:serviceaccounts", f"system:serviceaccounts:{ns}"],

@@ -27,6 +27,8 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
+from ..api.types import Pod
+
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
@@ -249,13 +251,25 @@ class APIStore:
         return f"{ns}/{meta.name}" if ns else meta.name
 
     def _copy(self, obj):
+        """Full isolation copy: get/list results and stored create/update
+        inputs must be immune to caller mutation, however deep."""
         return copy.deepcopy(obj) if self._deep_copy else obj
+
+    def _event_copy(self, obj):
+        """Copy for WATCH EVENTS — the fan-out hot path under churn. Event
+        objects carry the client-go read-only contract (that is what the
+        mutation detector polices), so pods take the ~20x cheaper structural
+        clone; other kinds keep deepcopy. get/list/storage copies stay on
+        _copy: their callers never signed the event contract."""
+        if self._deep_copy and type(obj) is Pod:
+            return pod_structural_clone(obj)
+        return self._copy(obj)
 
     def _emit(self, etype: str, kind: str, obj, prev=None) -> None:
         # Events carry a copy, never the stored object: a watcher that mutates an
         # event object (the client-go mutation-detector failure mode) must not be
         # able to corrupt store state. One copy per write, shared by watchers.
-        self._emit_prepared(etype, kind, self._copy(obj), prev=prev)
+        self._emit_prepared(etype, kind, self._event_copy(obj), prev=prev)
 
     def check_mutations(self) -> None:
         """Raise MutationDetectedError if any watcher mutated an event object

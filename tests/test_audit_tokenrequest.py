@@ -36,6 +36,14 @@ class TestPolicy:
         assert p.level_for(alice, "list", "events") == LEVEL_NONE
         assert p.level_for(alice, "list", "pods") == "Metadata"
 
+    def test_user_and_group_criteria_and_together(self):
+        """Specified users AND groups must both match (audit/v1 rule
+        semantics) — an over-broad OR would silently drop audit events."""
+        r = AuditRule(level=LEVEL_NONE, users=("ci-bot",), groups=("ops",))
+        assert r.matches(user("ci-bot", "ops"), "get", "pods")
+        assert not r.matches(user("ci-bot", "dev"), "get", "pods")
+        assert not r.matches(user("someone-else", "ops"), "get", "pods")
+
     def test_rule_order_first_match(self):
         p = AuditPolicy(rules=[
             AuditRule(level=LEVEL_NONE, verbs=("get",)),
@@ -129,6 +137,41 @@ class TestTokenRequest:
             assert e.value.code == 501
         finally:
             bare.stop()
+
+    def test_nonpositive_expiration_rejected(self):
+        srv, _ = self._server()
+        try:
+            c = RESTClient(srv.url)
+            c.create("serviceaccounts", {"kind": "ServiceAccount",
+                                         "metadata": {"name": "sa"}})
+            for bad in (0, -5):
+                with pytest.raises(APIError) as e:
+                    c.request(
+                        "POST",
+                        "/api/v1/namespaces/default/serviceaccounts/sa/token",
+                        {"spec": {"expirationSeconds": bad}})
+                assert e.value.code == 400
+        finally:
+            srv.stop()
+
+    def test_crd_alias_audited_under_plural(self):
+        """Audit must record the canonical plural for alias-spelled URLs —
+        the name authz and audit rules are written against."""
+        audit = AuditLogger(policy=AuditPolicy())
+        srv = APIServer(APIStore(), audit=audit).start()
+        try:
+            c = RESTClient(srv.url)
+            c.create("customresourcedefinitions", {
+                "metadata": {"name": "widgets.x.dev"},
+                "spec": {"group": "x.dev", "scope": "Namespaced",
+                         "names": {"plural": "widgets", "kind": "Widget",
+                                   "shortNames": ["wgt"]},
+                         "versions": [{"name": "v1"}]}}, namespace=None)
+            c.request("GET", "/apis/x.dev/v1/namespaces/default/wgt")
+            listed = [e for e in audit.events() if e["verb"] == "list"]
+            assert listed and listed[-1]["resource"] == "widgets"
+        finally:
+            srv.stop()
 
     def test_expiration_clamped(self):
         srv, signer = self._server()
