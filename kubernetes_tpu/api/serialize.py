@@ -437,6 +437,8 @@ def job_to_dict(job: Job) -> Dict:
     }
     if job.status.conditions:
         status["conditions"] = job.status.conditions
+    if job.status.completed_indexes:
+        status["completedIndexes"] = job.status.completed_indexes
     return {"apiVersion": "batch/v1", "kind": "Job",
             "metadata": job.metadata.to_dict(), "spec": spec, "status": status}
 

@@ -197,6 +197,9 @@ class JobStatus:
     start_time: Optional[float] = None
     completion_time: Optional[float] = None
     conditions: List[Dict[str, Any]] = field(default_factory=list)  # Complete/Failed
+    # Indexed completion mode: compressed ranges of succeeded indexes,
+    # e.g. "0-2,5" (batch/v1 Job.status.completedIndexes)
+    completed_indexes: str = ""
 
 
 @dataclass
@@ -239,6 +242,7 @@ class Job:
                 succeeded=int(st.get("succeeded", 0) or 0),
                 failed=int(st.get("failed", 0) or 0),
                 conditions=list(st.get("conditions") or []),
+                completed_indexes=st.get("completedIndexes", ""),
             ),
         )
 

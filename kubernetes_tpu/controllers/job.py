@@ -17,6 +17,34 @@ from ..utils.cron import CronSchedule
 from .base import Controller
 
 JOB_NAME_LABEL = "job-name"
+COMPLETION_INDEX_ANNOTATION = "batch.kubernetes.io/job-completion-index"
+
+
+def pod_completion_index(pod: Pod) -> int:
+    try:
+        return int(pod.metadata.annotations.get(COMPLETION_INDEX_ANNOTATION, -1))
+    except (TypeError, ValueError):  # null/garbage annotation: no index
+        return -1
+
+
+def compress_indexes(indexes) -> str:
+    """{0,1,2,5} -> "0-2,5" (batch/v1 completedIndexes wire form)."""
+    out = []
+    run_start = prev = None
+    for i in sorted(indexes):
+        if prev is None:
+            run_start = prev = i
+            continue
+        if i == prev + 1:
+            prev = i
+            continue
+        out.append(str(run_start) if run_start == prev
+                   else f"{run_start}-{prev}")
+        run_start = prev = i
+    if prev is not None:
+        out.append(str(run_start) if run_start == prev
+                   else f"{run_start}-{prev}")
+    return ",".join(out)
 
 
 def job_owner_ref(job: Job) -> dict:
@@ -57,11 +85,31 @@ class JobController(Controller):
         # wantActive is parallelism, and the job completes when any pod
         # succeeds and no pods remain active (JobSpec's documented semantic).
         completions = job.spec.completions
+        indexed = job.spec.completion_mode == "Indexed" and completions is not None
+        completed_idx = set()
+        if indexed:
+            # per-index completion (indexed_job_utils.go): an index counts
+            # once, however many retried pods succeeded for it
+            completed_idx = {pod_completion_index(p) for p in pods
+                             if p.status.phase == "Succeeded"}
+            completed_idx = {i for i in completed_idx
+                             if 0 <= i < completions}
+            succeeded = len(completed_idx)
 
         condition = None
         want_active = len(active)
         if job.is_finished():
             pass  # terminal; pods are left for TTL/GC (job_controller.go)
+        elif job.spec.completion_mode == "Indexed" and completions is None:
+            # admission rejects this on the REST path; a direct store write
+            # must fail loudly, not silently run as a work-queue job whose
+            # pods carry no index identity
+            condition = {"type": "Failed", "status": "True",
+                         "reason": "InvalidSpec",
+                         "message": "completions is required for Indexed jobs"}
+            for p in active:
+                self._try_delete_pod(p)
+            want_active = 0
         elif failed > job.spec.backoff_limit:
             condition = {"type": "Failed", "status": "True", "reason": "BackoffLimitExceeded"}
             for p in active:
@@ -74,6 +122,21 @@ class JobController(Controller):
             for p in active:
                 self._try_delete_pod(p)
             want_active = 0
+        elif indexed:
+            # create pods for MISSING indexes: not completed, no active pod
+            # holding the index (failed pods free their index for a retry)
+            active_idx = {pod_completion_index(p) for p in active}
+            missing = [i for i in range(completions)
+                       if i not in completed_idx and i not in active_idx]
+            want_active = min(job.spec.parallelism, completions - succeeded)
+            for i in missing[:max(0, want_active - len(active))]:
+                self._create_pod(job, index=i)
+            if want_active < len(active):
+                # scale-down: drop highest indexes first (reference prefers
+                # keeping the lowest ones for stable completion)
+                for p in sorted(active, key=pod_completion_index,
+                                reverse=True)[: len(active) - want_active]:
+                    self._try_delete_pod(p)
         else:
             # wantActive (job_controller.go manageJob): bounded by parallelism
             # and by the completions still owed; scales down as well as up
@@ -94,6 +157,8 @@ class JobController(Controller):
             obj.status.active = want_active
             obj.status.succeeded = succeeded
             obj.status.failed = failed
+            if indexed:
+                obj.status.completed_indexes = compress_indexes(completed_idx)
             if obj.status.start_time is None and not job.spec.suspend:
                 obj.status.start_time = self.clock.now()
             if condition is not None and not obj.status.conditions:
@@ -110,13 +175,25 @@ class JobController(Controller):
         except NotFoundError:
             pass
 
-    def _create_pod(self, job: Job) -> None:
+    def _create_pod(self, job: Job, index: Optional[int] = None) -> None:
         import uuid
 
         template = job.spec.template
-        name = f"{job.metadata.name}-{uuid.uuid4().hex[:5]}"
+        if index is not None:
+            name = f"{job.metadata.name}-{index}-{uuid.uuid4().hex[:5]}"
+        else:
+            name = f"{job.metadata.name}-{uuid.uuid4().hex[:5]}"
         pod = template.make_pod(name, job.metadata.namespace, job_owner_ref(job))
         pod.metadata.labels[JOB_NAME_LABEL] = job.metadata.name
+        if index is not None:
+            # the index rides an annotation + label and the canonical env var
+            # (job_controller.go podGenerator for Indexed mode) — a TPU
+            # training pod reads JOB_COMPLETION_INDEX to pick its data shard
+            pod.metadata.annotations[COMPLETION_INDEX_ANNOTATION] = str(index)
+            pod.metadata.labels[COMPLETION_INDEX_ANNOTATION] = str(index)
+            for c in pod.spec.containers:
+                c.env = list(c.env) + [{"name": "JOB_COMPLETION_INDEX",
+                                        "value": str(index)}]
         if pod.spec.restart_policy == "Always":
             # job pods may not be Always (batch/validation); default to Never
             pod.spec.restart_policy = "Never"

@@ -517,6 +517,30 @@ class LimitPodHardAntiAffinityTopology(AdmissionPlugin):
                     f"{term.topology_key!r}", code=422, reason="Invalid")
 
 
+class WorkloadValidation(AdmissionPlugin):
+    """API-validation subset for workload specs the controllers depend on
+    (pkg/apis/batch/validation): Indexed jobs require completions, and
+    parallelism/completions/backoffLimit may not be negative."""
+
+    name = "WorkloadValidation"
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        if resource != "jobs" or operation not in (CREATE, UPDATE):
+            return
+        spec = obj.spec
+        if spec.completion_mode == "Indexed" and spec.completions is None:
+            raise AdmissionError(
+                "spec.completions: Required value: when completion mode is "
+                "Indexed", code=422, reason="Invalid")
+        for name, val in (("parallelism", spec.parallelism),
+                          ("completions", spec.completions),
+                          ("backoffLimit", spec.backoff_limit)):
+            if val is not None and val < 0:
+                raise AdmissionError(
+                    f"spec.{name}: must be greater than or equal to 0",
+                    code=422, reason="Invalid")
+
+
 class DefaultIngressClass(AdmissionPlugin):
     """Ingresses without an ingressClassName get the cluster default class
     (plugin/pkg/admission/network/defaultingressclass) — the
@@ -615,6 +639,7 @@ def default_admission_chain() -> AdmissionChain:
         DefaultTolerationSeconds(),
         DefaultStorageClass(),
         DefaultIngressClass(),
+        WorkloadValidation(),
         TaintNodesByCondition(),
         PodSecurityAdmission(),
         ImmutableConfigAdmission(),

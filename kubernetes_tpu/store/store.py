@@ -266,9 +266,11 @@ class APIStore:
         return self._copy(obj)
 
     def _emit(self, etype: str, kind: str, obj, prev=None) -> None:
-        # Events carry a copy, never the stored object: a watcher that mutates an
-        # event object (the client-go mutation-detector failure mode) must not be
-        # able to corrupt store state. One copy per write, shared by watchers.
+        # Events carry a copy, never the stored object. For pods the copy is
+        # a STRUCTURAL clone: top-level metadata/spec/status are private, but
+        # nested spec members (containers, volumes, tolerations, ...) are
+        # shared with the stored pod — event objects are read-only all the
+        # way down, and the mutation detector polices exactly that contract.
         self._emit_prepared(etype, kind, self._event_copy(obj), prev=prev)
 
     def check_mutations(self) -> None:

@@ -416,3 +416,143 @@ class TestDaemonSetController:
         ctl.reconcile_once()
         pods, _ = store.list("pods")
         assert sorted(p.spec.node_name for p in pods) == ["n0"]
+
+
+class TestIndexedJob:
+    """Indexed completion mode (job_controller.go + indexed_job_utils.go):
+    per-index pods with the completion-index annotation/label and the
+    JOB_COMPLETION_INDEX env var — the TPU-training job shape where each
+    index owns a data/model shard."""
+
+    def _setup(self, **kw):
+        store = APIStore()
+        clock = FakeClock(start=1000.0)
+        job = make_job(completionMode="Indexed", **kw)
+        store.create("jobs", job)
+        ctl = JobController(store, clock=clock)
+        ctl.sync_all()
+        return store, clock, ctl, job
+
+    def _pods(self, store):
+        pods, _ = store.list("pods")
+        return sorted(pods, key=lambda p: p.metadata.name)
+
+    def test_pods_carry_index_identity(self):
+        from kubernetes_tpu.controllers.job import (
+            COMPLETION_INDEX_ANNOTATION,
+            pod_completion_index,
+        )
+
+        store, _, ctl, _job = self._setup(parallelism=3, completions=3)
+        ctl.process()
+        pods = [p for p in self._pods(store) if not p.is_terminal()]
+        assert sorted(pod_completion_index(p) for p in pods) == [0, 1, 2]
+        p0 = next(p for p in pods if pod_completion_index(p) == 0)
+        assert p0.metadata.labels[COMPLETION_INDEX_ANNOTATION] == "0"
+        env = {e["name"]: e["value"] for e in p0.spec.containers[0].env}
+        assert env["JOB_COMPLETION_INDEX"] == "0"
+        assert p0.metadata.name.startswith("j-0-")
+
+    def test_completes_when_all_indexes_succeed(self):
+        store, _, ctl, _job = self._setup(parallelism=3, completions=3)
+        ctl.process()
+        for p in self._pods(store):
+            set_phase(store, p.key, "Succeeded")
+        ctl.reconcile_once()
+        j = store.get("jobs", "default/j")
+        assert j.is_finished()
+        assert j.status.completed_indexes == "0-2"
+        assert j.status.succeeded == 3
+
+    def test_failed_index_retried_same_index(self):
+        from kubernetes_tpu.controllers.job import pod_completion_index
+
+        store, _, ctl, _job = self._setup(parallelism=2, completions=2,
+                                          backoffLimit=3)
+        ctl.process()
+        pods = self._pods(store)
+        victim = next(p for p in pods if pod_completion_index(p) == 1)
+        set_phase(store, victim.key, "Failed")
+        ctl.reconcile_once()
+        ctl.reconcile_once()
+        active = [p for p in self._pods(store) if not p.is_terminal()]
+        # index 1 got a NEW pod; index 0 kept its original
+        assert sorted(pod_completion_index(p) for p in active) == [0, 1]
+        retried = next(p for p in active if pod_completion_index(p) == 1)
+        assert retried.metadata.name != victim.metadata.name
+        # duplicate successes for one index count once
+        set_phase(store, retried.key, "Succeeded")
+        ctl.reconcile_once()
+        j = store.get("jobs", "default/j")
+        assert j.status.succeeded == 1
+        assert j.status.completed_indexes == "1"
+
+    def test_parallelism_window_moves_through_indexes(self):
+        from kubernetes_tpu.controllers.job import pod_completion_index
+
+        store, _, ctl, _job = self._setup(parallelism=2, completions=5)
+        ctl.process()
+        active = [p for p in self._pods(store) if not p.is_terminal()]
+        assert sorted(pod_completion_index(p) for p in active) == [0, 1]
+        for p in active:
+            set_phase(store, p.key, "Succeeded")
+        ctl.reconcile_once()
+        ctl.reconcile_once()
+        active = [p for p in self._pods(store) if not p.is_terminal()]
+        assert sorted(pod_completion_index(p) for p in active) == [2, 3]
+        j = store.get("jobs", "default/j")
+        assert j.status.completed_indexes == "0-1"
+
+    def test_compress_indexes(self):
+        from kubernetes_tpu.controllers.job import compress_indexes
+
+        assert compress_indexes(set()) == ""
+        assert compress_indexes({3}) == "3"
+        assert compress_indexes({0, 1, 2, 5, 7, 8}) == "0-2,5,7-8"
+
+
+class TestIndexedValidation:
+    def test_null_index_annotation_does_not_crash(self):
+        from kubernetes_tpu.controllers.job import pod_completion_index
+        from kubernetes_tpu.testing import MakePod
+
+        p = MakePod("x").req({"cpu": "1"}).obj()
+        p.metadata.annotations["batch.kubernetes.io/job-completion-index"] = None
+        assert pod_completion_index(p) == -1
+
+    def test_indexed_without_completions_fails_job(self):
+        store = APIStore()
+        job = make_job(completionMode="Indexed")
+        job.spec.completions = None
+        store.create("jobs", job)
+        ctl = JobController(store)
+        ctl.sync_all()
+        ctl.process()
+        j = store.get("jobs", "default/j")
+        assert j.is_finished()
+        assert any(c.get("reason") == "InvalidSpec" for c in j.status.conditions)
+
+    def test_admission_rejects_indexed_without_completions(self):
+        from kubernetes_tpu.server import APIError, APIServer, RESTClient
+
+        srv = APIServer(APIStore()).start()
+        try:
+            c = RESTClient(srv.url)
+            import pytest as _pytest
+
+            with _pytest.raises(APIError) as e:
+                c.create("jobs", {
+                    "kind": "Job", "metadata": {"name": "bad"},
+                    "spec": {"completionMode": "Indexed",
+                             "template": {"spec": {"containers": [
+                                 {"name": "c"}]}}}})
+            assert e.value.code == 422
+            with _pytest.raises(APIError) as e:
+                c.create("jobs", {
+                    "kind": "Job", "metadata": {"name": "neg"},
+                    "spec": {"parallelism": -1,
+                             "template": {"spec": {"containers": [
+                                 {"name": "c"}]}}}})
+            assert e.value.code == 422
+        finally:
+            srv.stop()
