@@ -328,6 +328,18 @@ def cmd_init(args) -> int:
         print("error: control plane did not become leader", file=sys.stderr)
         return 1
     print(f"control plane ready at {res.url}")
+    if args.write_kubeconfig:
+        # kubeadm writes admin.conf; ktl's config analog gets a ready context
+        from .ktlconfig import load_config, save_config
+
+        cfg = load_config()
+        cfg["clusters"]["kadm"] = {"server": res.url}
+        cfg["users"]["kadm-admin"] = {"token": res.token or ""}
+        cfg["contexts"]["kadm"] = {"cluster": "kadm", "user": "kadm-admin",
+                                   "namespace": "default"}
+        cfg["current-context"] = "kadm"
+        save_config(cfg)
+        print("kubeconfig context 'kadm' written (ktl config view)")
     if res.token:
         print(f"admin token: {res.token}")
         print(f"join token: {res.join_token}")
@@ -367,6 +379,8 @@ def main(argv=None) -> int:
     p.add_argument("--port", type=int, default=18080)
     p.add_argument("--secure", action="store_true")
     p.add_argument("--token-file", default="")
+    p.add_argument("--write-kubeconfig", action="store_true",
+                   help="write a ready ktl config context (admin.conf analog)")
     p.set_defaults(fn=cmd_init)
 
     p = sub.add_parser("join")

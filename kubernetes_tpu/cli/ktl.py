@@ -827,9 +827,14 @@ def cmd_version(client: RESTClient, args) -> int:
 
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(prog="ktl", description="kubernetes-tpu CLI")
-    parser.add_argument("--server", default=os.environ.get("KTL_SERVER", "http://127.0.0.1:8001"))
+    # clientcmd precedence: explicit flags > $KTL_SERVER > kubeconfig context
+    parser.add_argument("--server", default=None)
+    parser.add_argument("--token", default=None)
     parser.add_argument("-n", "--namespace", default=None)
     sub = parser.add_subparsers(dest="cmd", required=True)
+    from .ktlconfig import add_config_parser
+
+    add_config_parser(sub)
 
     p = sub.add_parser("get")
     p.add_argument("resource")
@@ -963,7 +968,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.set_defaults(fn=cmd_version)
 
     args = parser.parse_args(argv)
-    client = RESTClient(args.server)
+    from .ktlconfig import resolve
+
+    cfg_server, cfg_token, cfg_ns = resolve()
+    server = (args.server or os.environ.get("KTL_SERVER")
+              or cfg_server or "http://127.0.0.1:8001")
+    token = args.token or cfg_token
+    if args.namespace is None and cfg_ns:
+        args.namespace = cfg_ns
+    client = RESTClient(server, token=token)
     try:
         return args.fn(client, args)
     except APIError as e:

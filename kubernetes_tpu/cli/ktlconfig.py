@@ -1,0 +1,137 @@
+"""ktl config — the kubeconfig analog.
+
+reference: staging/src/k8s.io/client-go/tools/clientcmd (kubeconfig loading
+precedence) and kubectl config view/set-cluster/set-credentials/set-context/
+use-context. The file is JSON at $KTLCONFIG or ~/.ktl/config:
+
+    {"clusters":  {"dev": {"server": "http://127.0.0.1:8001"}},
+     "users":     {"admin": {"token": "..."}},
+     "contexts":  {"dev-admin": {"cluster": "dev", "user": "admin",
+                                 "namespace": "default"}},
+     "current-context": "dev-admin"}
+
+Resolution precedence matches clientcmd: explicit --server/--token flags win,
+then $KTL_SERVER, then the current context.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+
+def config_path() -> str:
+    return os.environ.get("KTLCONFIG",
+                          os.path.join(os.path.expanduser("~"), ".ktl", "config"))
+
+
+def load_config() -> Dict:
+    try:
+        with open(config_path()) as f:
+            cfg = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError):
+        cfg = {}
+    cfg.setdefault("clusters", {})
+    cfg.setdefault("users", {})
+    cfg.setdefault("contexts", {})
+    cfg.setdefault("current-context", "")
+    return cfg
+
+
+def save_config(cfg: Dict) -> None:
+    path = config_path()
+    parent = os.path.dirname(path)
+    if parent:  # a bare filename has no directory to create
+        os.makedirs(parent, exist_ok=True)
+    tmp = path + ".tmp"
+    # 0600 like kubeconfig/admin.conf: the file carries bearer tokens
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "w") as f:
+        json.dump(cfg, f, indent=2, sort_keys=True)
+    os.replace(tmp, path)  # atomic: a concurrent reader never sees a torn file
+
+
+def resolve(cfg: Optional[Dict] = None
+            ) -> Tuple[Optional[str], Optional[str], Optional[str]]:
+    """-> (server, token, namespace) from the current context, or Nones."""
+    cfg = cfg if cfg is not None else load_config()
+    ctx_name = cfg.get("current-context") or ""
+    ctx = cfg.get("contexts", {}).get(ctx_name)
+    if not ctx:
+        return None, None, None
+    cluster = cfg.get("clusters", {}).get(ctx.get("cluster", ""), {})
+    user = cfg.get("users", {}).get(ctx.get("user", ""), {})
+    return (cluster.get("server"), user.get("token"),
+            ctx.get("namespace"))
+
+
+def cmd_config(client, args) -> int:
+    import sys
+
+    cfg = load_config()
+    sub = args.config_cmd
+    if sub == "view":
+        redacted = json.loads(json.dumps(cfg))
+        for u in redacted.get("users", {}).values():
+            if u.get("token"):
+                u["token"] = "REDACTED"
+        print(json.dumps(redacted, indent=2, sort_keys=True))
+        return 0
+    if sub == "current-context":
+        cur = cfg.get("current-context", "")
+        if not cur:
+            print("error: current-context is not set", file=sys.stderr)
+            return 1
+        print(cur)
+        return 0
+    if sub == "get-contexts":
+        cur = cfg.get("current-context", "")
+        for name, ctx in sorted(cfg["contexts"].items()):
+            marker = "*" if name == cur else " "
+            print(f"{marker} {name}\tcluster={ctx.get('cluster', '')}"
+                  f"\tuser={ctx.get('user', '')}"
+                  f"\tnamespace={ctx.get('namespace', 'default')}")
+        return 0
+    if sub == "set-cluster":
+        cfg["clusters"][args.name] = {"server": args.server_url}
+    elif sub == "set-credentials":
+        cfg["users"][args.name] = {"token": args.token}
+    elif sub == "set-context":
+        cfg["contexts"][args.name] = {
+            "cluster": args.cluster, "user": args.user_name,
+            "namespace": args.context_namespace or "default"}
+    elif sub == "use-context":
+        if args.name not in cfg["contexts"]:
+            print(f"error: no context exists with the name {args.name!r}",
+                  file=sys.stderr)
+            return 1
+        cfg["current-context"] = args.name
+    elif sub == "delete-context":
+        if cfg["contexts"].pop(args.name, None) is None:
+            print(f"error: no context exists with the name {args.name!r}",
+                  file=sys.stderr)
+            return 1
+        if cfg.get("current-context") == args.name:
+            cfg["current-context"] = ""
+    else:
+        print(f"error: unknown config command {sub!r}", file=sys.stderr)
+        return 1
+    save_config(cfg)
+    print(f"{sub}: done")
+    return 0
+
+
+def add_config_parser(sub) -> None:
+    p = sub.add_parser("config")
+    p.add_argument("config_cmd",
+                   choices=["view", "current-context", "get-contexts",
+                            "set-cluster", "set-credentials", "set-context",
+                            "use-context", "delete-context"])
+    p.add_argument("name", nargs="?", default="")
+    p.add_argument("--server-url", default="")
+    p.add_argument("--token", default="")
+    p.add_argument("--cluster", default="")
+    p.add_argument("--user", dest="user_name", default="")
+    p.add_argument("--namespace", dest="context_namespace", default="")
+    p.set_defaults(fn=cmd_config)

@@ -539,6 +539,21 @@ class WorkloadValidation(AdmissionPlugin):
                 raise AdmissionError(
                     f"spec.{name}: must be greater than or equal to 0",
                     code=422, reason="Invalid")
+        if operation == UPDATE:
+            # completionMode/completions are immutable (batch validation):
+            # flipping a running job to Indexed would orphan its index-less
+            # pods and double-schedule every index
+            try:
+                existing = store.get(
+                    "jobs", f"{obj.metadata.namespace}/{obj.metadata.name}")
+            except NotFoundError:
+                return
+            if existing.spec.completion_mode != spec.completion_mode:
+                raise AdmissionError("spec.completionMode is immutable",
+                                     code=422, reason="Invalid")
+            if existing.spec.completions != spec.completions:
+                raise AdmissionError("spec.completions is immutable",
+                                     code=422, reason="Invalid")
 
 
 class DefaultIngressClass(AdmissionPlugin):

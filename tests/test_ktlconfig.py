@@ -1,0 +1,136 @@
+"""ktl config (kubeconfig analog): contexts, precedence, secured round-trip.
+
+reference: client-go tools/clientcmd + kubectl config.
+"""
+
+import json
+import os
+
+import pytest
+
+from kubernetes_tpu.cli.ktl import main as ktl
+from kubernetes_tpu.cli.ktlconfig import load_config, resolve, save_config
+from kubernetes_tpu.server import APIServer, RESTClient
+from kubernetes_tpu.server.auth import TokenAuthenticator, RBACAuthorizer
+from kubernetes_tpu.store import APIStore
+
+
+@pytest.fixture()
+def kcfg(tmp_path, monkeypatch):
+    path = tmp_path / "config"
+    monkeypatch.setenv("KTLCONFIG", str(path))
+    monkeypatch.delenv("KTL_SERVER", raising=False)
+    return path
+
+
+class TestConfigFile:
+    def test_set_use_view_roundtrip(self, kcfg, capsys):
+        assert ktl(["config", "set-cluster", "dev",
+                    "--server-url", "http://127.0.0.1:9999"]) == 0
+        assert ktl(["config", "set-credentials", "admin",
+                    "--token", "sekrit"]) == 0
+        assert ktl(["config", "set-context", "dev-admin", "--cluster", "dev",
+                    "--user", "admin", "--namespace", "team-a"]) == 0
+        assert ktl(["config", "use-context", "dev-admin"]) == 0
+        capsys.readouterr()
+        assert ktl(["config", "current-context"]) == 0
+        assert capsys.readouterr().out.strip() == "dev-admin"
+        server, token, ns = resolve()
+        assert server == "http://127.0.0.1:9999"
+        assert token == "sekrit" and ns == "team-a"
+        # view redacts tokens
+        assert ktl(["config", "view"]) == 0
+        out = capsys.readouterr().out
+        assert "REDACTED" in out and "sekrit" not in out
+
+    def test_use_unknown_context_errors(self, kcfg, capsys):
+        assert ktl(["config", "use-context", "nope"]) == 1
+
+    def test_delete_context_clears_current(self, kcfg, capsys):
+        ktl(["config", "set-cluster", "c", "--server-url", "http://x"])
+        ktl(["config", "set-context", "ctx", "--cluster", "c", "--user", "u"])
+        ktl(["config", "use-context", "ctx"])
+        assert ktl(["config", "delete-context", "ctx"]) == 0
+        assert resolve() == (None, None, None)
+
+    def test_corrupt_file_treated_as_empty(self, kcfg):
+        kcfg.write_text("{not json")
+        assert load_config()["contexts"] == {}
+
+
+class TestPrecedence:
+    def test_flag_beats_env_beats_context(self, kcfg, monkeypatch, capsys):
+        srv = APIServer(APIStore()).start()
+        try:
+            # context points at a dead server; the flag must win
+            ktl(["config", "set-cluster", "dead",
+                 "--server-url", "http://127.0.0.1:1"])
+            ktl(["config", "set-context", "d", "--cluster", "dead",
+                 "--user", "x"])
+            ktl(["config", "use-context", "d"])
+            assert ktl(["--server", srv.url, "get", "pods"]) == 0
+            # env beats context too
+            monkeypatch.setenv("KTL_SERVER", srv.url)
+            assert ktl(["get", "pods"]) == 0
+        finally:
+            srv.stop()
+
+    def test_context_supplies_token_and_namespace(self, kcfg, capsys):
+        authn = TokenAuthenticator()
+        authn.add("tok-a", "alice")
+        authz = RBACAuthorizer().grant("alice", ["*"], ["*"])
+        srv = APIServer(APIStore(), authenticator=authn,
+                        authorizer=authz).start()
+        try:
+            store = srv.store
+            from kubernetes_tpu.api.types import Namespace, ObjectMeta
+
+            store.create("namespaces", Namespace(metadata=ObjectMeta(name="team-a")))
+            ktl(["config", "set-cluster", "c", "--server-url", srv.url])
+            ktl(["config", "set-credentials", "alice", "--token", "tok-a"])
+            ktl(["config", "set-context", "ctx", "--cluster", "c",
+                 "--user", "alice", "--namespace", "team-a"])
+            ktl(["config", "use-context", "ctx"])
+            capsys.readouterr()
+            # no flags at all: server, token, and namespace from the context
+            assert ktl(["run", "w", "--image", "i"]) == 0
+            c = RESTClient(srv.url, token="tok-a")
+            pod = c.get("pods", "w", "team-a")
+            assert pod["metadata"]["namespace"] == "team-a"
+        finally:
+            srv.stop()
+
+
+class TestHardening:
+    def test_file_mode_0600(self, kcfg):
+        ktl(["config", "set-credentials", "a", "--token", "t"])
+        assert oct(os.stat(kcfg).st_mode & 0o777) == "0o600"
+
+    def test_bare_filename_ktlconfig(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        monkeypatch.setenv("KTLCONFIG", "cfgfile")
+        assert ktl(["config", "set-cluster", "c",
+                    "--server-url", "http://x"]) == 0
+        assert (tmp_path / "cfgfile").exists()
+
+    def test_job_completion_mode_immutable(self):
+        from kubernetes_tpu.server import APIError
+
+        srv = APIServer(APIStore()).start()
+        try:
+            c = RESTClient(srv.url)
+            c.create("jobs", {"kind": "Job", "metadata": {"name": "j"},
+                              "spec": {"parallelism": 1, "completions": 2,
+                                       "template": {"spec": {"containers": [
+                                           {"name": "c"}]}}}})
+            with pytest.raises(APIError) as e:
+                c.patch("jobs", "j", {"spec": {"completionMode": "Indexed"}})
+            assert e.value.code == 422
+            with pytest.raises(APIError) as e:
+                c.patch("jobs", "j", {"spec": {"completions": 5}})
+            assert e.value.code == 422
+            # parallelism stays mutable (scale)
+            out = c.patch("jobs", "j", {"spec": {"parallelism": 3}})
+            assert out["spec"]["parallelism"] == 3
+        finally:
+            srv.stop()
