@@ -478,12 +478,20 @@ def statefulset_to_dict(sts: StatefulSet) -> Dict:
             "template": _template_to_dict(sts.spec.template),
             **({"volumeClaimTemplates": sts.spec.volume_claim_templates}
                if sts.spec.volume_claim_templates else {}),
+            "updateStrategy": {
+                "type": sts.spec.update_strategy,
+                **({"rollingUpdate": {"partition": sts.spec.partition}}
+                   if sts.spec.partition else {}),
+            },
         },
         "status": {
             "replicas": sts.status.replicas,
             "readyReplicas": sts.status.ready_replicas,
             "currentReplicas": sts.status.current_replicas,
+            "updatedReplicas": sts.status.updated_replicas,
             "observedGeneration": sts.status.observed_generation,
+            **({"updateRevision": sts.status.update_revision}
+               if sts.status.update_revision else {}),
         },
     }
 

@@ -310,6 +310,10 @@ class StatefulSetSpec:
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
     pod_management_policy: str = "OrderedReady"  # or "Parallel"
     volume_claim_templates: List[Dict[str, Any]] = field(default_factory=list)
+    update_strategy: str = "RollingUpdate"  # or "OnDelete"
+    # RollingUpdate only touches ordinals >= partition (canary staging;
+    # apps/v1 RollingUpdateStatefulSetStrategy.partition)
+    partition: int = 0
 
 
 @dataclass
@@ -317,7 +321,9 @@ class StatefulSetStatus:
     replicas: int = 0
     ready_replicas: int = 0
     current_replicas: int = 0
+    updated_replicas: int = 0
     observed_generation: int = 0
+    update_revision: str = ""
 
 
 @dataclass
@@ -344,6 +350,10 @@ class StatefulSet:
                 template=PodTemplateSpec.from_dict(sp.get("template") or {}),
                 pod_management_policy=sp.get("podManagementPolicy", "OrderedReady"),
                 volume_claim_templates=list(sp.get("volumeClaimTemplates") or []),
+                update_strategy=(sp.get("updateStrategy") or {}).get(
+                    "type", "RollingUpdate"),
+                partition=int(((sp.get("updateStrategy") or {})
+                               .get("rollingUpdate") or {}).get("partition", 0) or 0),
             ),
         )
 

@@ -17,9 +17,9 @@ from .base import Controller
 
 
 def template_hash(dep: Deployment) -> str:
-    t = dep.spec.template
-    raw = repr((sorted(t.metadata.labels.items()), t.spec))
-    return hashlib.sha1(raw.encode()).hexdigest()[:10]
+    from .revision import template_fingerprint
+
+    return template_fingerprint(dep.spec.template)
 
 
 # revision bookkeeping (deployment/util/deployment_util.go Revision/

@@ -34,6 +34,17 @@ def _ordinal(pod_name: str, base: str) -> int:
     return int(suffix) if suffix.isdigit() else -1
 
 
+REVISION_LABEL = "controller-revision-hash"
+
+
+def revision_hash(sts: StatefulSet) -> str:
+    """Template fingerprint — the ControllerRevision name analog
+    (pkg/controller/history). Pods carry it in controller-revision-hash."""
+    from .revision import template_fingerprint
+
+    return f"{sts.metadata.name}-{template_fingerprint(sts.spec.template)}"
+
+
 class StatefulSetController(Controller):
     watch_kinds = ("statefulsets", "pods")
 
@@ -59,6 +70,7 @@ class StatefulSetController(Controller):
 
         # scale up / replace missing, in ordinal order; OrderedReady gates each
         # ordinal on the previous one being Running (stateful_set_control.go)
+        created_this_pass = False
         for i in range(sts.spec.replicas):
             pod = by_ordinal.get(i)
             if pod is not None and pod.is_terminal():
@@ -70,6 +82,7 @@ class StatefulSetController(Controller):
                 pod = None
             if pod is None:
                 self._create_pod(sts, i)
+                created_this_pass = True
                 if ordered:
                     break
             elif ordered and pod.status.phase != "Running":
@@ -77,20 +90,55 @@ class StatefulSetController(Controller):
 
         # scale down: highest ordinal first, one at a time when ordered
         extra = sorted((o for o in by_ordinal if o >= sts.spec.replicas), reverse=True)
+        deleted_this_pass = False
         for o in extra[:1] if ordered else extra:
             try:
                 self.store.delete("pods", by_ordinal[o].key)
+                deleted_this_pass = True
             except NotFoundError:
                 pass
+
+        # rolling update (stateful_set_control.go updateStatefulSet): with
+        # RollingUpdate, stale-revision pods at ordinals >= partition are
+        # deleted HIGHEST ordinal first, one at a time, each gated on the
+        # rest being Running; the replace-missing pass above recreates them
+        # with the new template. OnDelete leaves stale pods for the operator.
+        rev = revision_hash(sts)
+        if sts.spec.update_strategy == "RollingUpdate":
+            stale = sorted(
+                (o for o, p in by_ordinal.items()
+                 if o >= max(sts.spec.partition, 0) and o < sts.spec.replicas
+                 and not p.is_terminal()
+                 and p.metadata.labels.get(REVISION_LABEL) != rev),
+                reverse=True)
+            # every ordinal must exist AND be Running before the next update
+            # step, and THIS sync must not have already deleted or created a
+            # pod (scale-down or recreate in flight) — at most one member is
+            # ever down at a time (OrderedReady's one-at-a-time guarantee)
+            all_running = (not created_this_pass
+                           and not deleted_this_pass
+                           and all(o in by_ordinal for o in range(sts.spec.replicas))
+                           and all(p.is_terminal() or p.status.phase == "Running"
+                                   for o, p in by_ordinal.items()
+                                   if o < sts.spec.replicas))
+            if stale and all_running:
+                try:
+                    self.store.delete("pods", by_ordinal[stale[0]].key)
+                except NotFoundError:
+                    pass
 
         current = [p for p in pods if _ordinal(p.metadata.name, base) < sts.spec.replicas
                    and not p.is_terminal()]
         ready = sum(1 for p in current if p.status.phase == "Running")
+        updated = sum(1 for p in current
+                      if p.metadata.labels.get(REVISION_LABEL) == rev)
 
         def mutate(obj: StatefulSet) -> StatefulSet:
             obj.status.replicas = len(current)
             obj.status.current_replicas = len(current)
             obj.status.ready_replicas = ready
+            obj.status.updated_replicas = updated
+            obj.status.update_revision = rev
             obj.status.observed_generation = obj.metadata.generation
             return obj
 
@@ -104,6 +152,7 @@ class StatefulSetController(Controller):
         pod = sts.spec.template.make_pod(name, sts.metadata.namespace, sts_owner_ref(sts))
         pod.metadata.labels["statefulset.kubernetes.io/pod-name"] = name
         pod.metadata.labels["apps.kubernetes.io/pod-index"] = str(ordinal)
+        pod.metadata.labels[REVISION_LABEL] = revision_hash(sts)
         # one PVC per volumeClaimTemplate, named <template>-<pod>; reused
         # across pod replacements (identity-preserving storage)
         for tpl in sts.spec.volume_claim_templates:

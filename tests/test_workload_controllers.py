@@ -556,3 +556,215 @@ class TestIndexedValidation:
             assert e.value.code == 422
         finally:
             srv.stop()
+
+
+class TestStatefulSetRollingUpdate:
+    """apps/v1 updateStrategy: RollingUpdate replaces stale-revision pods
+    highest-ordinal-first gated on readiness, honors partition (canary),
+    OnDelete leaves them (stateful_set_control.go)."""
+
+    def _setup(self, replicas=3, **spec_kw):
+        from kubernetes_tpu.api.workloads import StatefulSet
+        from kubernetes_tpu.api.types import new_uid
+        from kubernetes_tpu.controllers.statefulset import StatefulSetController
+
+        store = APIStore()
+        sts = StatefulSet.from_dict({
+            "metadata": {"name": "db"},
+            "spec": {"replicas": replicas, "serviceName": "db",
+                     "template": {"metadata": {"labels": {"app": "db"}},
+                                  "spec": {"containers": [
+                                      {"name": "c", "image": "v1"}]}},
+                     **spec_kw}})
+        sts.metadata.uid = new_uid()
+        store.create("statefulsets", sts)
+        ctl = StatefulSetController(store)
+        ctl.sync_all()
+        return store, ctl
+
+    def _run_all(self, store, ctl):
+        # drive until stable, marking every created pod Running
+        for _ in range(30):
+            ctl.reconcile_once()
+            pods, _ = store.list("pods")
+            changed = False
+            for p in pods:
+                if p.status.phase != "Running" and not p.is_terminal():
+                    set_phase(store, p.key, "Running")
+                    changed = True
+            if not changed and ctl.reconcile_once() == 0:
+                break
+        pods, _ = store.list("pods")
+        return sorted((p for p in pods if not p.is_terminal()),
+                      key=lambda p: p.metadata.name)
+
+    def test_template_change_rolls_highest_first(self):
+        from kubernetes_tpu.controllers.statefulset import REVISION_LABEL
+
+        store, ctl = self._setup()
+        pods = self._run_all(store, ctl)
+        assert len(pods) == 3
+        old_rev = pods[0].metadata.labels[REVISION_LABEL]
+
+        def bump(obj):
+            obj.spec.template.spec.containers[0].image = "v2"
+            return obj
+
+        store.guaranteed_update("statefulsets", "default/db", bump)
+        # first update step must delete ordinal 2 (highest) ONLY
+        ctl.reconcile_once()
+        present = {p.metadata.name for p in store.list("pods")[0]}
+        assert present == {"db-0", "db-1"}
+        pods = self._run_all(store, ctl)
+        assert len(pods) == 3
+        assert all(p.metadata.labels[REVISION_LABEL] != old_rev for p in pods)
+        assert all(p.spec.containers[0].image == "v2" for p in pods)
+        sts = store.get("statefulsets", "default/db")
+        assert sts.status.updated_replicas == 3
+
+    def test_partition_stages_canary(self):
+        from kubernetes_tpu.controllers.statefulset import REVISION_LABEL
+
+        store, ctl = self._setup(
+            updateStrategy={"type": "RollingUpdate",
+                            "rollingUpdate": {"partition": 2}})
+        pods = self._run_all(store, ctl)
+        old_rev = pods[0].metadata.labels[REVISION_LABEL]
+
+        def bump(obj):
+            obj.spec.template.spec.containers[0].image = "v2"
+            return obj
+
+        store.guaranteed_update("statefulsets", "default/db", bump)
+        pods = self._run_all(store, ctl)
+        revs = {p.metadata.name: p.metadata.labels[REVISION_LABEL]
+                for p in pods}
+        # only ordinal 2 (>= partition) updated; 0 and 1 keep the old revision
+        assert revs["db-0"] == old_rev and revs["db-1"] == old_rev
+        assert revs["db-2"] != old_rev
+        sts = store.get("statefulsets", "default/db")
+        assert sts.status.updated_replicas == 1
+
+    def test_on_delete_leaves_stale_pods(self):
+        from kubernetes_tpu.controllers.statefulset import REVISION_LABEL
+
+        store, ctl = self._setup(updateStrategy={"type": "OnDelete"})
+        pods = self._run_all(store, ctl)
+        old_rev = pods[0].metadata.labels[REVISION_LABEL]
+
+        def bump(obj):
+            obj.spec.template.spec.containers[0].image = "v2"
+            return obj
+
+        store.guaranteed_update("statefulsets", "default/db", bump)
+        pods = self._run_all(store, ctl)
+        assert all(p.metadata.labels[REVISION_LABEL] == old_rev for p in pods)
+        # operator deletes one by hand -> it comes back on the NEW revision
+        store.delete("pods", "default/db-1")
+        pods = self._run_all(store, ctl)
+        revs = {p.metadata.name: p.metadata.labels[REVISION_LABEL]
+                for p in pods}
+        assert revs["db-1"] != old_rev and revs["db-0"] == old_rev
+
+
+class TestRevisionFingerprint:
+    def test_annotation_change_triggers_rollout(self):
+        """`rollout restart` patches only a template annotation — the
+        fingerprint must change or restart is a silent no-op."""
+        from kubernetes_tpu.api.workloads import PodTemplateSpec
+        from kubernetes_tpu.controllers.revision import template_fingerprint
+
+        t = PodTemplateSpec.from_dict(
+            {"metadata": {"labels": {"a": "b"}},
+             "spec": {"containers": [{"name": "c"}]}})
+        before = template_fingerprint(t)
+        t.metadata.annotations["kubectl.kubernetes.io/restartedAt"] = "123"
+        assert template_fingerprint(t) != before
+
+    def test_key_order_does_not_change_fingerprint(self):
+        from kubernetes_tpu.api.workloads import PodTemplateSpec
+        from kubernetes_tpu.controllers.revision import template_fingerprint
+
+        a = PodTemplateSpec.from_dict(
+            {"spec": {"containers": [{"name": "c"}],
+                      "nodeSelector": {"x": "1", "y": "2"}}})
+        b = PodTemplateSpec.from_dict(
+            {"spec": {"nodeSelector": {"y": "2", "x": "1"},
+                      "containers": [{"name": "c"}]}})
+        assert template_fingerprint(a) == template_fingerprint(b)
+
+    def test_sts_rollout_restart_end_to_end(self):
+        """ktl rollout restart on a StatefulSet must actually roll pods."""
+        from kubernetes_tpu.cli.ktl import main as ktl
+        from kubernetes_tpu.controllers.statefulset import (
+            REVISION_LABEL,
+            StatefulSetController,
+        )
+        from kubernetes_tpu.server import APIServer
+
+        store = APIStore()
+        srv = APIServer(store).start()
+        try:
+            from kubernetes_tpu.api.workloads import StatefulSet
+            from kubernetes_tpu.api.types import new_uid
+
+            sts = StatefulSet.from_dict({
+                "metadata": {"name": "db"},
+                "spec": {"replicas": 1, "serviceName": "db",
+                         "template": {"metadata": {"labels": {"app": "db"}},
+                                      "spec": {"containers": [
+                                          {"name": "c", "image": "v1"}]}}}})
+            sts.metadata.uid = new_uid()
+            store.create("statefulsets", sts)
+            ctl = StatefulSetController(store)
+            ctl.sync_all()
+            ctl.reconcile_once()
+            set_phase(store, "default/db-0", "Running")
+            old = store.get("pods", "default/db-0").metadata.labels[REVISION_LABEL]
+            assert ktl(["--server", srv.url, "rollout", "restart",
+                        "statefulsets/db"]) == 0
+            for _ in range(10):
+                ctl.reconcile_once()
+                pods, _ = store.list("pods")
+                for p in pods:
+                    if p.status.phase != "Running" and not p.is_terminal():
+                        set_phase(store, p.key, "Running")
+            new = store.get("pods", "default/db-0").metadata.labels[REVISION_LABEL]
+            assert new != old
+        finally:
+            srv.stop()
+
+    def test_scaledown_and_update_one_delete_per_sync(self):
+        """replicas 3->2 + image bump in one write: a single sync may delete
+        ONE pod, not one per branch."""
+        from kubernetes_tpu.api.workloads import StatefulSet
+        from kubernetes_tpu.api.types import new_uid
+        from kubernetes_tpu.controllers.statefulset import StatefulSetController
+
+        store = APIStore()
+        sts = StatefulSet.from_dict({
+            "metadata": {"name": "db"},
+            "spec": {"replicas": 3, "serviceName": "db",
+                     "template": {"metadata": {"labels": {"app": "db"}},
+                                  "spec": {"containers": [
+                                      {"name": "c", "image": "v1"}]}}}})
+        sts.metadata.uid = new_uid()
+        store.create("statefulsets", sts)
+        ctl = StatefulSetController(store)
+        ctl.sync_all()
+        for _ in range(6):
+            ctl.reconcile_once()
+            for p in store.list("pods")[0]:
+                if p.status.phase != "Running":
+                    set_phase(store, p.key, "Running")
+        assert len(store.list("pods")[0]) == 3
+
+        def shrink_and_bump(obj):
+            obj.spec.replicas = 2
+            obj.spec.template.spec.containers[0].image = "v2"
+            return obj
+
+        store.guaranteed_update("statefulsets", "default/db", shrink_and_bump)
+        ctl.reconcile_once()
+        # exactly ONE pod gone after one sync (the scale-down of db-2)
+        assert len(store.list("pods")[0]) == 2
