@@ -504,12 +504,18 @@ def daemonset_to_dict(ds: DaemonSet) -> Dict:
             **({"selector": _selector_to_dict(ds.spec.selector)}
                if ds.spec.selector is not None else {}),
             "template": _template_to_dict(ds.spec.template),
+            "updateStrategy": {
+                "type": ds.spec.update_strategy,
+                **({"rollingUpdate": {"maxUnavailable": ds.spec.max_unavailable}}
+                   if ds.spec.update_strategy == "RollingUpdate" else {}),
+            },
         },
         "status": {
             "desiredNumberScheduled": ds.status.desired_number_scheduled,
             "currentNumberScheduled": ds.status.current_number_scheduled,
             "numberReady": ds.status.number_ready,
             "numberMisscheduled": ds.status.number_misscheduled,
+            "updatedNumberScheduled": ds.status.updated_number_scheduled,
             "observedGeneration": ds.status.observed_generation,
         },
     }

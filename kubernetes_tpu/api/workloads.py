@@ -362,6 +362,8 @@ class StatefulSet:
 class DaemonSetSpec:
     selector: Optional[Selector] = None
     template: PodTemplateSpec = field(default_factory=PodTemplateSpec)
+    update_strategy: str = "RollingUpdate"  # or "OnDelete"
+    max_unavailable: int = 1  # rollingUpdate.maxUnavailable (absolute count)
 
 
 @dataclass
@@ -370,6 +372,7 @@ class DaemonSetStatus:
     current_number_scheduled: int = 0
     number_ready: int = 0
     number_misscheduled: int = 0
+    updated_number_scheduled: int = 0
     observed_generation: int = 0
 
 
@@ -393,5 +396,10 @@ class DaemonSet:
             spec=DaemonSetSpec(
                 selector=Selector.from_label_selector(sp.get("selector")),
                 template=PodTemplateSpec.from_dict(sp.get("template") or {}),
+                update_strategy=(sp.get("updateStrategy") or {}).get(
+                    "type", "RollingUpdate"),
+                max_unavailable=int(((sp.get("updateStrategy") or {})
+                                     .get("rollingUpdate") or {})
+                                    .get("maxUnavailable", 1) or 1),
             ),
         )

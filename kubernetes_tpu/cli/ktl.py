@@ -502,14 +502,34 @@ def cmd_taint(client: RESTClient, args) -> int:
 
 
 def cmd_drain(client: RESTClient, args) -> int:
+    """cordon + PDB-respecting evictions (kubectl drain uses the eviction
+    subresource, never raw deletes)."""
     cmd_cordon(client, args)
+    rc = 0
     pods, _ = client.list("pods")
     for p in pods:
         if (p.get("spec") or {}).get("nodeName") == args.name:
             ns = p["metadata"].get("namespace") or "default"
-            client.delete("pods", p["metadata"]["name"], ns)
-            print(f"pod/{p['metadata']['name']} evicted")
-    return 0
+            pname = p["metadata"]["name"]
+            if any(r.get("kind") == "DaemonSet"
+                   for r in p["metadata"].get("ownerReferences", [])):
+                # daemon pods tolerate the unschedulable taint and would be
+                # recreated immediately (kubectl drain's --ignore-daemonsets)
+                print(f"ignoring DaemonSet-managed pod/{pname}")
+                continue
+            try:
+                client.evict(pname, ns)
+                print(f"pod/{pname} evicted")
+            except APIError as e:
+                if e.code == 429:
+                    print(f"error: cannot evict pod/{pname}: {e}",
+                          file=sys.stderr)
+                    rc = 1
+                elif e.code == 404:
+                    continue  # already gone between list and evict
+                else:
+                    raise
+    return rc
 
 
 def cmd_describe(client: RESTClient, args) -> int:

@@ -27,6 +27,15 @@ _AUTO_TOLERATIONS = (
 )
 
 
+REVISION_LABEL = "controller-revision-hash"
+
+
+def revision_hash(ds: DaemonSet) -> str:
+    from .revision import template_fingerprint
+
+    return f"{ds.metadata.name}-{template_fingerprint(ds.spec.template)}"
+
+
 def ds_owner_ref(ds: DaemonSet) -> dict:
     return {"apiVersion": "apps/v1", "kind": "DaemonSet", "name": ds.metadata.name,
             "uid": ds.metadata.uid, "controller": True}
@@ -79,8 +88,9 @@ class DaemonSetController(Controller):
                     pass
                 continue
             have.setdefault(p.spec.node_name, p)
+        rev = revision_hash(ds)
         for node_name in eligible - set(have):
-            self._create_pod(ds, node_name)
+            self._create_pod(ds, node_name, rev)
         misscheduled = 0
         for node_name, pod in have.items():
             if node_name not in eligible:
@@ -89,14 +99,37 @@ class DaemonSetController(Controller):
                     self.store.delete("pods", pod.key)
                 except NotFoundError:
                     pass
+
+        # rolling update (daemon/update.go rollingUpdate): delete up to
+        # maxUnavailable stale-revision pods per sync. Unavailable counts
+        # every ELIGIBLE node without a Running pod — including nodes whose
+        # replacement was just created (absent from the pre-sync `have`) —
+        # or the budget would double-spend across syncs.
+        if ds.spec.update_strategy == "RollingUpdate":
+            on_node = {n: p for n, p in have.items() if n in eligible}
+            stale = [p for p in on_node.values()
+                     if p.metadata.labels.get(REVISION_LABEL) != rev]
+            unavailable = sum(
+                1 for n in eligible
+                if n not in have or have[n].status.phase != "Running")
+            budget = max(0, ds.spec.max_unavailable - unavailable)
+            for p in sorted(stale, key=lambda p: p.spec.node_name)[:budget]:
+                try:
+                    self.store.delete("pods", p.key)
+                except NotFoundError:
+                    pass
         ready = sum(1 for n, p in have.items()
                     if n in eligible and p.status.phase == "Running")
+        updated = sum(1 for n, p in have.items()
+                      if n in eligible
+                      and p.metadata.labels.get(REVISION_LABEL) == rev)
 
         def mutate(obj: DaemonSet) -> DaemonSet:
             obj.status.desired_number_scheduled = len(eligible)
             obj.status.current_number_scheduled = len(eligible & set(have))
             obj.status.number_ready = ready
             obj.status.number_misscheduled = misscheduled
+            obj.status.updated_number_scheduled = updated
             obj.status.observed_generation = obj.metadata.generation
             return obj
 
@@ -112,9 +145,10 @@ class DaemonSetController(Controller):
             return False
         return find_matching_untolerated_taint(node.spec.taints, tolerations) is None
 
-    def _create_pod(self, ds: DaemonSet, node_name: str) -> None:
+    def _create_pod(self, ds: DaemonSet, node_name: str, rev: str) -> None:
         name = f"{ds.metadata.name}-{node_name}"
         pod = ds.spec.template.make_pod(name, ds.metadata.namespace, ds_owner_ref(ds))
+        pod.metadata.labels[REVISION_LABEL] = rev
         pod.spec.tolerations.extend(_AUTO_TOLERATIONS)
         pod.spec.node_name = node_name
         try:

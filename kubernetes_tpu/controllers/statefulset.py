@@ -67,6 +67,7 @@ class StatefulSetController(Controller):
             "pods", lambda p: p.metadata.namespace == ns and _owned(p, sts))
         by_ordinal = {_ordinal(p.metadata.name, base): p for p in pods}
         ordered = sts.spec.pod_management_policy == "OrderedReady"
+        rev = revision_hash(sts)
 
         # scale up / replace missing, in ordinal order; OrderedReady gates each
         # ordinal on the previous one being Running (stateful_set_control.go)
@@ -81,7 +82,7 @@ class StatefulSetController(Controller):
                     pass
                 pod = None
             if pod is None:
-                self._create_pod(sts, i)
+                self._create_pod(sts, i, rev)
                 created_this_pass = True
                 if ordered:
                     break
@@ -103,7 +104,6 @@ class StatefulSetController(Controller):
         # deleted HIGHEST ordinal first, one at a time, each gated on the
         # rest being Running; the replace-missing pass above recreates them
         # with the new template. OnDelete leaves stale pods for the operator.
-        rev = revision_hash(sts)
         if sts.spec.update_strategy == "RollingUpdate":
             stale = sorted(
                 (o for o, p in by_ordinal.items()
@@ -147,12 +147,12 @@ class StatefulSetController(Controller):
         except NotFoundError:
             pass
 
-    def _create_pod(self, sts: StatefulSet, ordinal: int) -> None:
+    def _create_pod(self, sts: StatefulSet, ordinal: int, rev: str) -> None:
         name = f"{sts.metadata.name}-{ordinal}"
         pod = sts.spec.template.make_pod(name, sts.metadata.namespace, sts_owner_ref(sts))
         pod.metadata.labels["statefulset.kubernetes.io/pod-name"] = name
         pod.metadata.labels["apps.kubernetes.io/pod-index"] = str(ordinal)
-        pod.metadata.labels[REVISION_LABEL] = revision_hash(sts)
+        pod.metadata.labels[REVISION_LABEL] = rev
         # one PVC per volumeClaimTemplate, named <template>-<pod>; reused
         # across pod replacements (identity-preserving storage)
         for tpl in sts.spec.volume_claim_templates:

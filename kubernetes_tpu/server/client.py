@@ -160,6 +160,13 @@ class RESTClient:
         return self.request("PATCH", self._path(resource, namespace, name),
                             patch, content_type=patch_type)
 
+    def evict(self, name: str, namespace: str = "default") -> Dict:
+        """PDB-respecting eviction (pods/{name}/eviction); 429 when a
+        matching budget has no disruptions left."""
+        return self.request("POST", self._path("pods", namespace, name, "eviction"),
+                            {"kind": "Eviction",
+                             "metadata": {"name": name, "namespace": namespace}})
+
     def bind(self, namespace: str, pod_name: str, node_name: str) -> Dict:
         return self.request("POST", self._path("pods", namespace, pod_name, "binding"),
                             {"target": {"kind": "Node", "name": node_name}})

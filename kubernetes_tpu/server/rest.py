@@ -386,6 +386,8 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.command == "POST" and sub == "token" \
                 and resource == "serviceaccounts":
             resource = "serviceaccounts/token"
+        elif self.command == "POST" and sub == "eviction" and resource == "pods":
+            resource = "pods/eviction"
         return verb, resource
 
     def _audit_record(self, code: int, verb: Optional[str] = None) -> None:
@@ -737,6 +739,44 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(404, str(e), "NotFound")
             except AlreadyBoundError as e:
                 self._error(409, str(e), "Conflict")
+            return
+        if sub == "eviction" and resource == "pods":
+            # Eviction subresource (registry/core/pod/storage/eviction.go):
+            # a PDB-respecting delete — every matching budget must have
+            # disruptionsAllowed > 0; the decrement and the delete commit in
+            # one transaction so two racing evictions cannot both spend the
+            # last allowance
+            err = None
+            with self.store.transaction():
+                try:
+                    pod = self.store.get("pods", f"{ns}/{name}")
+                    pdbs, _ = self.store.list(
+                        "poddisruptionbudgets",
+                        lambda b: b.metadata.namespace == ns
+                        and b.selector is not None
+                        and b.selector.matches(pod.metadata.labels))
+                    blocked = [b for b in pdbs if b.disruptions_allowed <= 0]
+                    if blocked:
+                        err = (429, "Cannot evict pod as it would violate "
+                               f"the pod's disruption budget "
+                               f"({blocked[0].metadata.name})",
+                               "TooManyRequests")
+                    else:
+                        for b in pdbs:
+                            def spend(obj):
+                                obj.disruptions_allowed = max(
+                                    0, obj.disruptions_allowed - 1)
+                                return obj
+
+                            self.store.guaranteed_update(
+                                "poddisruptionbudgets", b.key, spend)
+                        self.store.delete("pods", f"{ns}/{name}")
+                except NotFoundError as e:
+                    err = (404, str(e), "NotFound")
+            if err is not None:
+                self._error(*err)
+                return
+            self._send_json(201, {"kind": "Status", "status": "Success"})
             return
         if sub == "token" and resource == "serviceaccounts":
             # TokenRequest subresource: mint a signed bearer credential for

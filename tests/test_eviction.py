@@ -1,0 +1,163 @@
+"""Pod Eviction subresource: PDB-respecting deletes.
+
+reference: pkg/registry/core/pod/storage/eviction.go (429 + DisruptionBudget
+cause when disruptionsAllowed is exhausted; transactional decrement).
+"""
+
+import pytest
+
+from kubernetes_tpu.cli.ktl import main as ktl_main
+from kubernetes_tpu.controllers.disruption import DisruptionController
+from kubernetes_tpu.server import APIError, APIServer, RESTClient
+from kubernetes_tpu.store import APIStore
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer(APIStore()).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient(server.url)
+
+
+def make_pod(client, name, node="n1"):
+    client.create("pods", {"metadata": {"name": name, "labels": {"app": "web"}},
+                           "spec": {"containers": [{"name": "c"}]}})
+    client.bind("default", name, node)
+
+
+def make_pdb(client, min_available):
+    client.create("poddisruptionbudgets", {
+        "kind": "PodDisruptionBudget", "metadata": {"name": "web-pdb"},
+        "spec": {"minAvailable": min_available,
+                 "selector": {"matchLabels": {"app": "web"}}}})
+
+
+class TestEviction:
+    def test_evict_without_pdb_deletes(self, client):
+        make_pod(client, "p")
+        client.evict("p")
+        with pytest.raises(APIError) as e:
+            client.get("pods", "p")
+        assert e.value.code == 404
+
+    def test_pdb_blocks_when_exhausted(self, server, client):
+        for i in range(3):
+            make_pod(client, f"p{i}")
+        make_pdb(client, min_available=2)
+        ctrl = DisruptionController(server.store)
+        ctrl.sync_all()
+        ctrl.reconcile_once()  # disruptionsAllowed = 3 healthy - 2 = 1
+        client.evict("p0")  # spends the allowance
+        with pytest.raises(APIError) as e:
+            client.evict("p1")
+        assert e.value.code == 429
+        assert "disruption budget" in str(e.value)
+        # p1 still exists; p0 gone
+        client.get("pods", "p1")
+        with pytest.raises(APIError):
+            client.get("pods", "p0")
+        # once the controller recomputes (pod replaced etc.), eviction resumes
+        make_pod(client, "p3")
+        ctrl.reconcile_once()
+        client.evict("p1")
+
+    def test_unmatched_pdb_does_not_block(self, server, client):
+        make_pod(client, "p")
+        client.create("poddisruptionbudgets", {
+            "kind": "PodDisruptionBudget", "metadata": {"name": "other"},
+            "spec": {"minAvailable": 1,
+                     "selector": {"matchLabels": {"app": "db"}}}})
+        client.evict("p")  # budget selects different pods
+
+    def test_missing_pod_404(self, client):
+        with pytest.raises(APIError) as e:
+            client.evict("ghost")
+        assert e.value.code == 404
+
+    def test_drain_respects_pdb(self, server, client, capsys):
+        client.create("nodes", {"metadata": {"name": "n1"},
+                                "status": {"capacity": {"cpu": "8"}}})
+        for i in range(2):
+            make_pod(client, f"p{i}")
+        make_pdb(client, min_available=2)
+        ctrl = DisruptionController(server.store)
+        ctrl.sync_all()
+        ctrl.reconcile_once()  # allowed = 0
+        rc = ktl_main(["--server", server.url, "drain", "n1"])
+        assert rc == 1  # some pods could not be evicted
+        err = capsys.readouterr().err
+        assert "cannot evict" in err
+        # pods survived; node is cordoned
+        assert client.get("pods", "p0") and client.get("pods", "p1")
+        node = client.get("nodes", "n1", namespace=None)
+        assert node["spec"]["unschedulable"] is True
+
+class TestDrainDaemonSets:
+    def test_drain_skips_daemonset_pods(self, server, client, capsys):
+        client.create("nodes", {"metadata": {"name": "n1"},
+                                "status": {"capacity": {"cpu": "8"}}})
+        make_pod(client, "app-pod")
+        # a pod owned by a DaemonSet must be skipped, not evicted
+        client.create("pods", {
+            "metadata": {"name": "agent-n1",
+                         "ownerReferences": [{"kind": "DaemonSet",
+                                              "name": "agent", "uid": "u1"}]},
+            "spec": {"containers": [{"name": "c"}]}})
+        client.bind("default", "agent-n1", "n1")
+        assert ktl_main(["--server", server.url, "drain", "n1"]) == 0
+        out = capsys.readouterr().out
+        assert "ignoring DaemonSet-managed pod/agent-n1" in out
+        client.get("pods", "agent-n1")  # survived
+        with pytest.raises(APIError):
+            client.get("pods", "app-pod")  # evicted
+
+
+class TestDaemonSetBudgetAcrossSyncs:
+    def test_budget_not_double_spent(self):
+        """The unavailable count must include eligible nodes whose
+        replacement pod was created this sync (absent from the pre-sync
+        map), or two syncs take down 2 pods with maxUnavailable=1."""
+        from kubernetes_tpu.api.types import new_uid
+        from kubernetes_tpu.api.workloads import DaemonSet
+        from kubernetes_tpu.controllers.daemonset import DaemonSetController
+        from kubernetes_tpu.store import APIStore
+        from kubernetes_tpu.testing import MakeNode
+
+        store = APIStore()
+        for i in range(3):
+            store.create("nodes", MakeNode(f"n{i}").capacity({"cpu": "8"}).obj())
+        ds = DaemonSet.from_dict({
+            "metadata": {"name": "agent"},
+            "spec": {"template": {"metadata": {"labels": {"app": "agent"}},
+                                  "spec": {"containers": [
+                                      {"name": "c", "image": "v1"}]}}}})
+        ds.metadata.uid = new_uid()
+        store.create("daemonsets", ds)
+        ctl = DaemonSetController(store)
+        ctl.sync_all()
+        for _ in range(6):
+            ctl.reconcile_once()
+            for p in store.list("pods")[0]:
+                if p.status.phase != "Running":
+                    def run(x):
+                        x.status.phase = "Running"
+                        return x
+
+                    store.guaranteed_update("pods", p.key, run)
+        assert len(store.list("pods")[0]) == 3
+
+        def bump(obj):
+            obj.spec.template.spec.containers[0].image = "v2"
+            return obj
+
+        store.guaranteed_update("daemonsets", "default/agent", bump)
+        ctl.reconcile_once()  # deletes one stale pod
+        ctl.reconcile_once()  # recreates it (Pending) — must NOT delete more
+        pods = store.list("pods")[0]
+        running = [p for p in pods if p.status.phase == "Running"]
+        assert len(running) >= 2, "more than maxUnavailable pods down"

@@ -768,3 +768,93 @@ class TestRevisionFingerprint:
         ctl.reconcile_once()
         # exactly ONE pod gone after one sync (the scale-down of db-2)
         assert len(store.list("pods")[0]) == 2
+
+
+class TestDaemonSetRollingUpdate:
+    """daemon/update.go rollingUpdate: delete up to maxUnavailable stale
+    pods per sync; replacements carry the new revision."""
+
+    def _setup(self, n_nodes=3, **spec_kw):
+        from kubernetes_tpu.api.types import new_uid
+
+        store = APIStore()
+        for i in range(n_nodes):
+            store.create("nodes", MakeNode(f"n{i}").capacity({"cpu": "8"}).obj())
+        ds = DaemonSet.from_dict({
+            "metadata": {"name": "agent"},
+            "spec": {"template": {"metadata": {"labels": {"app": "agent"}},
+                                  "spec": {"containers": [
+                                      {"name": "c", "image": "v1"}]}},
+                     **spec_kw}})
+        ds.metadata.uid = new_uid()
+        store.create("daemonsets", ds)
+        ctl = DaemonSetController(store)
+        ctl.sync_all()
+        return store, ctl
+
+    def _settle(self, store, ctl):
+        for _ in range(20):
+            ctl.reconcile_once()
+            for p in store.list("pods")[0]:
+                if p.status.phase != "Running" and not p.is_terminal():
+                    set_phase(store, p.key, "Running")
+            if ctl.reconcile_once() == 0:
+                break
+        return {p.spec.node_name: p for p in store.list("pods")[0]
+                if not p.is_terminal()}
+
+    def test_template_change_rolls_max_unavailable_at_a_time(self):
+        from kubernetes_tpu.controllers.daemonset import REVISION_LABEL
+
+        store, ctl = self._setup()
+        by_node = self._settle(store, ctl)
+        assert len(by_node) == 3
+        old_rev = next(iter(by_node.values())).metadata.labels[REVISION_LABEL]
+
+        def bump(obj):
+            obj.spec.template.spec.containers[0].image = "v2"
+            return obj
+
+        store.guaranteed_update("daemonsets", "default/agent", bump)
+        # one sync deletes exactly maxUnavailable=1 stale pod
+        ctl.reconcile_once()
+        assert len(store.list("pods")[0]) == 2
+        by_node = self._settle(store, ctl)
+        assert len(by_node) == 3
+        assert all(p.metadata.labels[REVISION_LABEL] != old_rev
+                   for p in by_node.values())
+        assert all(p.spec.containers[0].image == "v2"
+                   for p in by_node.values())
+        ds = store.get("daemonsets", "default/agent")
+        assert ds.status.updated_number_scheduled == 3
+
+    def test_max_unavailable_budget(self):
+        store, ctl = self._setup(
+            n_nodes=4,
+            updateStrategy={"type": "RollingUpdate",
+                            "rollingUpdate": {"maxUnavailable": 2}})
+        self._settle(store, ctl)
+
+        def bump(obj):
+            obj.spec.template.spec.containers[0].image = "v2"
+            return obj
+
+        store.guaranteed_update("daemonsets", "default/agent", bump)
+        ctl.reconcile_once()
+        assert len(store.list("pods")[0]) == 2  # two deleted at once
+
+    def test_on_delete_strategy(self):
+        from kubernetes_tpu.controllers.daemonset import REVISION_LABEL
+
+        store, ctl = self._setup(updateStrategy={"type": "OnDelete"})
+        by_node = self._settle(store, ctl)
+        old_rev = next(iter(by_node.values())).metadata.labels[REVISION_LABEL]
+
+        def bump(obj):
+            obj.spec.template.spec.containers[0].image = "v2"
+            return obj
+
+        store.guaranteed_update("daemonsets", "default/agent", bump)
+        by_node = self._settle(store, ctl)
+        assert all(p.metadata.labels[REVISION_LABEL] == old_rev
+                   for p in by_node.values())
