@@ -353,64 +353,11 @@ def rung_mixed_churn(results):
 
 
 def rung_preemption(results):
-    """PreemptionBasic (misc/performance-config.yaml:363 shape): 500 full
-    nodes, 500 higher-priority preemptors. End-to-end through the scheduler:
-    dry-run victim selection, victim deletion, nomination, backoff, rebind."""
-    from kubernetes_tpu.scheduler import Framework
-    from kubernetes_tpu.scheduler.batch import BatchScheduler
-    from kubernetes_tpu.scheduler.plugins import default_plugins
-    from kubernetes_tpu.store import APIStore
-    from kubernetes_tpu.testing import MakePod
-
-    try:
-        n_nodes = sz(500, floor=16)
-        store = APIStore()
-        for n in _nodes(n_nodes, cpu="4"):
-            store.create("nodes", n)
-        for i in range(n_nodes):
-            low = MakePod(f"low-{i}").priority(1).req({"cpu": "3"}).obj()
-            low.spec.node_name = f"node-{i}"
-            store.create("pods", low)
-        # warm-up: compile the solver at the same [P=500, N=500] shapes on a
-        # throwaway cluster so the timed run measures scheduling, not XLA
-        warm_store = APIStore()
-        for n in _nodes(n_nodes, cpu="4"):
-            warm_store.create("nodes", n)
-        warm = BatchScheduler(warm_store, Framework(default_plugins()), solver="auto")
-        warm.sync()
-        for i in range(n_nodes):
-            warm_store.create("pods", MakePod(f"w-{i}").priority(100).req(
-                {"cpu": "2"}).obj())
-        warm.run_until_idle()
-
-        sched = BatchScheduler(store, Framework(default_plugins()), solver="auto")
-        sched.sync()
-        sched.run_until_idle()
-        for i in range(n_nodes):
-            store.create("pods", MakePod(f"high-{i}").priority(100).req(
-                {"cpu": "2"}).obj())
-        t0 = time.perf_counter()
-        deadline = t0 + 120
-        while time.perf_counter() < deadline:
-            sched.run_until_idle()
-            bound = sum(1 for p in store.list("pods")[0]
-                        if p.metadata.name.startswith("high") and p.spec.node_name)
-            if bound >= n_nodes:
-                break
-            sched.queue.flush_backoff_completed()
-            sched.queue.flush_unschedulable_left_over()
-            time.sleep(0.05)
-        dt = time.perf_counter() - t0
-        pps = bound / dt
-        results["PreemptionBasic"] = {
-            "pods_per_sec": round(pps, 1), "vs_baseline": round(pps / BASE_PREEMPT, 2),
-            "placed": bound, "pods": n_nodes, "solver": "serial-preempt+batch"}
-        print(f"{'PreemptionBasic':>28}: {pps:>9.0f} pods/s  "
-              f"({bound}/{n_nodes} preempted+bound, {pps / BASE_PREEMPT:.1f}x baseline 18)",
-              file=sys.stderr)
-    except Exception as e:
-        results["PreemptionBasic"] = {"error": str(e)[:200]}
-        print(f"PreemptionBasic: ERROR {e}", file=sys.stderr)
+    """PreemptionBasic (misc/performance-config.yaml:363 shape, baseline 18):
+    500 full nodes, 500 higher-priority preemptors, SERIAL victim preparation
+    (the reference's non-async mode); PreemptionAsync covers the async mode."""
+    _preemption_run(results, "PreemptionBasic", BASE_PREEMPT,
+                    async_preparation=False)
 
 
 def rung_north_star(results):
@@ -595,6 +542,113 @@ def rung_transport(results):
         print(f"Transport_50k: ERROR {e}", file=sys.stderr)
 
 
+def rung_node_affinity(results):
+    # NodeAffinity (affinity/performance-config.yaml:323 shape, baseline 220):
+    # half the nodes carry the wanted label; every pod requires it
+    from kubernetes_tpu.testing import MakePod
+
+    nodes = _nodes(sz(5000))
+    for i, n in enumerate(nodes):
+        n.metadata.labels["disk"] = "ssd" if i % 2 == 0 else "hdd"
+    snap = make_snapshot(nodes)
+    pods = [MakePod(f"na-{i}").node_affinity_in("disk", ["ssd"])
+            .req({"cpu": "200m", "memory": "256Mi"}).obj()
+            for i in range(sz(10000))]
+    run_rung("NodeAffinity", snap, pods, "scan", 220, results=results)
+
+
+def rung_preferred_topology_spread(results):
+    # PreferredTopologySpreading (misc/performance-config.yaml:249 shape,
+    # baseline 125): ScheduleAnyway constraints score instead of filter
+    from kubernetes_tpu.testing import MakePod
+
+    snap = make_snapshot(_nodes(sz(5000), zones=10))
+    pods = [MakePod(f"pts-{i}").labels({"app": "soft"})
+            .req({"cpu": "200m", "memory": "256Mi"})
+            .topology_spread(1, ZONE, "ScheduleAnyway", {"app": "soft"})
+            .obj() for i in range(sz(5000))]
+    run_rung("PreferredTopologySpreading", snap, pods, "scan", 125,
+             results=results)
+
+
+def _preemption_run(results, name, baseline, async_preparation):
+    """Shared preemption harness; async_preparation picks the reference's
+    PreemptionBasic (serial victim prep, baseline 18) vs PreemptionAsync
+    (prepareCandidateAsync, baseline 160) modes."""
+    from kubernetes_tpu.scheduler import Framework
+    from kubernetes_tpu.scheduler.batch import BatchScheduler
+    from kubernetes_tpu.scheduler.plugins import default_plugins
+    from kubernetes_tpu.scheduler.plugins.default_preemption import (
+        DefaultPreemption,
+    )
+    from kubernetes_tpu.store import APIStore
+    from kubernetes_tpu.testing import MakePod
+
+    def make_framework():
+        plugins = default_plugins()
+        for i, p in enumerate(plugins):
+            if isinstance(p, DefaultPreemption):
+                plugins[i] = DefaultPreemption(
+                    async_preparation=async_preparation)
+        return Framework(plugins)
+
+    try:
+        n_nodes = sz(500, floor=16)
+        store = APIStore()
+        for n in _nodes(n_nodes, cpu="4"):
+            store.create("nodes", n)
+        for i in range(n_nodes):
+            low = MakePod(f"low-{i}").priority(1).req({"cpu": "3"}).obj()
+            low.spec.node_name = f"node-{i}"
+            store.create("pods", low)
+        warm_store = APIStore()
+        for n in _nodes(n_nodes, cpu="4"):
+            warm_store.create("nodes", n)
+        warm = BatchScheduler(warm_store, make_framework(), solver="auto")
+        warm.sync()
+        for i in range(n_nodes):
+            warm_store.create("pods", MakePod(f"w-{i}").priority(100).req(
+                {"cpu": "2"}).obj())
+        warm.run_until_idle()
+
+        sched = BatchScheduler(store, make_framework(), solver="auto")
+        sched.sync()
+        sched.run_until_idle()
+        for i in range(n_nodes):
+            store.create("pods", MakePod(f"high-{i}").priority(100).req(
+                {"cpu": "2"}).obj())
+        t0 = time.perf_counter()
+        deadline = t0 + 120
+        bound = 0
+        while time.perf_counter() < deadline:
+            sched.run_until_idle()
+            bound = sum(1 for p in store.list("pods")[0]
+                        if p.metadata.name.startswith("high") and p.spec.node_name)
+            if bound >= n_nodes:
+                break
+            sched.queue.flush_backoff_completed()
+            sched.queue.flush_unschedulable_left_over()
+            time.sleep(0.05)
+        dt = time.perf_counter() - t0
+        pps = bound / dt
+        results[name] = {
+            "pods_per_sec": round(pps, 1),
+            "vs_baseline": round(pps / baseline, 2),
+            "placed": bound, "pods": n_nodes,
+            "solver": ("async" if async_preparation else "serial")
+            + "-preempt+batch"}
+        print(f"{name:>28}: {pps:>9.0f} pods/s  "
+              f"({bound}/{n_nodes} preempted+bound, "
+              f"{pps / baseline:.1f}x baseline {baseline})", file=sys.stderr)
+    except Exception as e:
+        results[name] = {"error": str(e)[:200]}
+        print(f"{name}: ERROR {e}", file=sys.stderr)
+
+
+def rung_preemption_async(results):
+    _preemption_run(results, "PreemptionAsync", 160, async_preparation=True)
+
+
 RUNGS = [
     ("SchedulingBasic", rung_basic),
     ("TopologySpreading", rung_topology_spread),
@@ -603,6 +657,9 @@ RUNGS = [
     ("AntiAffinityNSSelector", rung_anti_affinity_ns_selector),
     ("MixedChurn", rung_mixed_churn),
     ("Preemption", rung_preemption),
+    ("PreemptionAsync", rung_preemption_async),
+    ("NodeAffinity", rung_node_affinity),
+    ("PreferredTopologySpreading", rung_preferred_topology_spread),
     ("NorthStar", rung_north_star),
     ("NorthStarWarm", rung_north_star_warm),
     ("NorthStarEndToEnd", rung_north_star_endtoend),

@@ -27,13 +27,13 @@ _AUTO_TOLERATIONS = (
 )
 
 
-REVISION_LABEL = "controller-revision-hash"
+from .revision import REVISION_LABEL  # noqa: F401  (shared fingerprint home)
 
 
 def revision_hash(ds: DaemonSet) -> str:
-    from .revision import template_fingerprint
+    from .revision import revision_name
 
-    return f"{ds.metadata.name}-{template_fingerprint(ds.spec.template)}"
+    return revision_name(ds.metadata.name, ds.spec.template)
 
 
 def ds_owner_ref(ds: DaemonSet) -> dict:
@@ -109,11 +109,22 @@ class DaemonSetController(Controller):
             on_node = {n: p for n, p in have.items() if n in eligible}
             stale = [p for p in on_node.values()
                      if p.metadata.labels.get(REVISION_LABEL) != rev]
+            # already-down stale pods are deleted WITHOUT charging the budget
+            # (daemon/update.go deletes unavailable old pods first): a pod
+            # stuck Pending/CrashLoop on the old template must not stall the
+            # very rollout that would fix it
+            stale_down = [p for p in stale if p.status.phase != "Running"]
+            stale_up = [p for p in stale if p.status.phase == "Running"]
+            for p in stale_down:
+                try:
+                    self.store.delete("pods", p.key)
+                except NotFoundError:
+                    pass
             unavailable = sum(
                 1 for n in eligible
                 if n not in have or have[n].status.phase != "Running")
             budget = max(0, ds.spec.max_unavailable - unavailable)
-            for p in sorted(stale, key=lambda p: p.spec.node_name)[:budget]:
+            for p in sorted(stale_up, key=lambda p: p.spec.node_name)[:budget]:
                 try:
                     self.store.delete("pods", p.key)
                 except NotFoundError:

@@ -14,9 +14,17 @@ import hashlib
 import json
 
 
+REVISION_LABEL = "controller-revision-hash"
+
+
 def template_fingerprint(template) -> str:
     """Stable 10-hex-char digest of a PodTemplateSpec."""
     from ..api.serialize import _template_to_dict
 
     canon = json.dumps(_template_to_dict(template), sort_keys=True)
     return hashlib.sha1(canon.encode()).hexdigest()[:10]
+
+
+def revision_name(owner_name: str, template) -> str:
+    """<owner>-<fingerprint> — the value pods carry in REVISION_LABEL."""
+    return f"{owner_name}-{template_fingerprint(template)}"

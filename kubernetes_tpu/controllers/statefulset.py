@@ -34,15 +34,15 @@ def _ordinal(pod_name: str, base: str) -> int:
     return int(suffix) if suffix.isdigit() else -1
 
 
-REVISION_LABEL = "controller-revision-hash"
+from .revision import REVISION_LABEL  # noqa: F401  (shared fingerprint home)
 
 
 def revision_hash(sts: StatefulSet) -> str:
     """Template fingerprint — the ControllerRevision name analog
     (pkg/controller/history). Pods carry it in controller-revision-hash."""
-    from .revision import template_fingerprint
+    from .revision import revision_name
 
-    return f"{sts.metadata.name}-{template_fingerprint(sts.spec.template)}"
+    return revision_name(sts.metadata.name, sts.spec.template)
 
 
 class StatefulSetController(Controller):

@@ -858,3 +858,48 @@ class TestDaemonSetRollingUpdate:
         by_node = self._settle(store, ctl)
         assert all(p.metadata.labels[REVISION_LABEL] == old_rev
                    for p in by_node.values())
+
+
+class TestDaemonSetStuckPodRollout:
+    def test_stuck_stale_pod_does_not_stall_rollout(self):
+        """A Pending/CrashLoop pod on the OLD template must be replaced by
+        the rollout, not freeze it by eating the maxUnavailable budget."""
+        from kubernetes_tpu.api.types import new_uid
+        from kubernetes_tpu.controllers.daemonset import (
+            DaemonSetController,
+            REVISION_LABEL,
+        )
+
+        store = APIStore()
+        for i in range(2):
+            store.create("nodes", MakeNode(f"n{i}").capacity({"cpu": "8"}).obj())
+        ds = DaemonSet.from_dict({
+            "metadata": {"name": "agent"},
+            "spec": {"template": {"metadata": {"labels": {"app": "agent"}},
+                                  "spec": {"containers": [
+                                      {"name": "c", "image": "broken"}]}}}})
+        ds.metadata.uid = new_uid()
+        store.create("daemonsets", ds)
+        ctl = DaemonSetController(store)
+        ctl.sync_all()
+        ctl.reconcile_once()
+        # n0's pod runs; n1's pod is stuck Pending forever
+        pods = {p.spec.node_name: p for p in store.list("pods")[0]}
+        set_phase(store, pods["n0"].key, "Running")
+        old_rev = pods["n0"].metadata.labels[REVISION_LABEL]
+
+        def fix(obj):
+            obj.spec.template.spec.containers[0].image = "fixed"
+            return obj
+
+        store.guaranteed_update("daemonsets", "default/agent", fix)
+        for _ in range(8):
+            ctl.reconcile_once()
+            for p in store.list("pods")[0]:
+                if p.status.phase != "Running" and not p.is_terminal():
+                    set_phase(store, p.key, "Running")
+        pods = {p.spec.node_name: p for p in store.list("pods")[0]}
+        assert pods["n1"].spec.containers[0].image == "fixed"
+        assert pods["n1"].metadata.labels[REVISION_LABEL] != old_rev
+        # and the rollout completed everywhere
+        assert pods["n0"].spec.containers[0].image == "fixed"
