@@ -453,6 +453,7 @@ def cronjob_to_dict(cj: CronJob) -> Dict:
         "metadata": cj.metadata.to_dict(),
         "spec": {
             "schedule": cj.spec.schedule,
+            **({"timeZone": cj.spec.time_zone} if cj.spec.time_zone else {}),
             "concurrencyPolicy": cj.spec.concurrency_policy,
             **({"suspend": True} if cj.spec.suspend else {}),
             **({"startingDeadlineSeconds": cj.spec.starting_deadline_seconds}

@@ -250,6 +250,7 @@ class Job:
 @dataclass
 class CronJobSpec:
     schedule: str = "* * * * *"
+    time_zone: str = ""  # IANA name; empty = the controller's local/UTC
     suspend: bool = False
     concurrency_policy: str = "Allow"  # Allow | Forbid | Replace
     starting_deadline_seconds: Optional[int] = None
@@ -285,6 +286,7 @@ class CronJob:
             metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
             spec=CronJobSpec(
                 schedule=sp.get("schedule", "* * * * *"),
+                time_zone=sp.get("timeZone") or "",
                 suspend=bool(sp.get("suspend", False)),
                 concurrency_policy=sp.get("concurrencyPolicy", "Allow"),
                 starting_deadline_seconds=sp.get("startingDeadlineSeconds"),

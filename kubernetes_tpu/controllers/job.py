@@ -263,7 +263,13 @@ class CronJobController(Controller):
         if cj.spec.suspend:
             return
         now = self.clock.now()
-        schedule = CronSchedule(cj.spec.schedule)
+        try:
+            schedule = CronSchedule(cj.spec.schedule, tz=cj.spec.time_zone)
+        except ValueError:
+            # admission rejects these on the REST path; a direct store write
+            # with a bad schedule/timeZone must not hot-spin the controller
+            # (the reference records UnknownTimeZone and skips the object)
+            return
         # earliestTime: lastScheduleTime, else creationTimestamp (getRecentUnmet
         # ScheduleTimes); an object with no creation stamp starts counting now.
         since = cj.status.last_schedule_time

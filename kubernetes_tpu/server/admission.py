@@ -525,6 +525,17 @@ class WorkloadValidation(AdmissionPlugin):
     name = "WorkloadValidation"
 
     def validate(self, store, resource, operation, obj, user="") -> None:
+        if resource == "cronjobs" and operation in (CREATE, UPDATE):
+            # the schedule and timeZone must parse NOW — a bad value stored
+            # would make every controller sync raise forever
+            from ..utils.cron import CronSchedule
+
+            try:
+                CronSchedule(obj.spec.schedule, tz=obj.spec.time_zone)
+            except ValueError as e:
+                raise AdmissionError(f"spec.schedule/timeZone: {e}",
+                                     code=422, reason="Invalid")
+            return
         if resource != "jobs" or operation not in (CREATE, UPDATE):
             return
         spec = obj.spec

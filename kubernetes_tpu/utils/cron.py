@@ -51,12 +51,22 @@ def _parse_field(field: str, lo: int, hi: int) -> Set[int]:
 
 
 class CronSchedule:
-    def __init__(self, expr: str):
+    def __init__(self, expr: str, tz: str = ""):
         expr = expr.strip()
         expr = _MACROS.get(expr, expr)
         fields = expr.split()
         if len(fields) != 5:
             raise ValueError(f"cron expression needs 5 fields, got {expr!r}")
+        self.tz = timezone.utc
+        if tz:
+            # IANA zone (CronJob spec.timeZone; cronjob_controllerv2.go uses
+            # time.LoadLocation) — schedule fields are evaluated in this zone
+            from zoneinfo import ZoneInfo
+
+            try:
+                self.tz = ZoneInfo(tz)
+            except Exception as e:
+                raise ValueError(f"unknown timeZone {tz!r}") from e
         self.minutes, self.hours, self.dom, self.months, self.dow = (
             _parse_field(f, lo, hi) for f, (lo, hi) in zip(fields, _BOUNDS))
         if 7 in self.dow:  # 7 is an alias for Sunday (robfig/cron)
@@ -81,33 +91,35 @@ class CronSchedule:
         return {(d - 1) % 7 for d in self.dow}
 
     def matches(self, ts: float) -> bool:
-        dt = datetime.fromtimestamp(ts, tz=timezone.utc)
+        dt = datetime.fromtimestamp(ts, tz=self.tz)
         return (dt.minute in self.minutes and dt.hour in self.hours
                 and dt.month in self.months and self._day_matches(dt))
 
     def next_after(self, ts: float, horizon_days: int = 366) -> float:
-        """First scheduled time strictly after ts (cron.Next)."""
-        dt = datetime.fromtimestamp(ts, tz=timezone.utc)
-        dt = dt.replace(second=0, microsecond=0) + timedelta(minutes=1)
-        end = dt + timedelta(days=horizon_days)
-        while dt < end:
-            if dt.month not in self.months:
-                # jump to the 1st of the next month
-                if dt.month == 12:
-                    dt = dt.replace(year=dt.year + 1, month=1, day=1, hour=0, minute=0)
-                else:
-                    dt = dt.replace(month=dt.month + 1, day=1, hour=0, minute=0)
+        """First scheduled time strictly after ts (cron.Next).
+
+        The cursor advances in UTC — timedelta arithmetic on a zoned datetime
+        silently drops the DST fold and can step BACKWARDS across fall-back
+        (violating "strictly after"); only field matching happens in the
+        schedule's zone. Spring-forward times that don't exist locally are
+        skipped (the wall clock never shows them); during fall-back the
+        repeated local hour can fire on both passes.
+        """
+        cur = datetime.fromtimestamp(ts, tz=timezone.utc)
+        cur = cur.replace(second=0, microsecond=0) + timedelta(minutes=1)
+        end = cur + timedelta(days=horizon_days)
+        while cur < end:
+            local = cur.astimezone(self.tz)
+            if (local.month not in self.months
+                    or not self._day_matches(local)
+                    or local.hour not in self.hours):
+                # jump to the next LOCAL hour start: offsets are whole
+                # minutes, so adding (60 - local.minute) lands on :00
+                cur += timedelta(minutes=60 - local.minute)
                 continue
-            if not self._day_matches(dt):
-                dt = (dt + timedelta(days=1)).replace(hour=0, minute=0)
-                continue
-            if dt.hour not in self.hours:
-                dt = (dt + timedelta(hours=1)).replace(minute=0)
-                continue
-            if dt.minute not in self.minutes:
-                dt += timedelta(minutes=1)
-                continue
-            return dt.timestamp()
+            if local.minute in self.minutes:
+                return cur.timestamp()
+            cur += timedelta(minutes=1)
         raise ValueError("no cron occurrence within horizon")
 
     def times_between(self, start: float, end: float) -> Tuple[float, ...]:

@@ -903,3 +903,97 @@ class TestDaemonSetStuckPodRollout:
         assert pods["n1"].metadata.labels[REVISION_LABEL] != old_rev
         # and the rollout completed everywhere
         assert pods["n0"].spec.containers[0].image == "fixed"
+
+
+class TestCronTimeZone:
+    def test_schedule_evaluated_in_zone(self):
+        # 06:30 America/New_York on 1970-01-01 (EST, UTC-5) = 11:30 UTC
+        s = CronSchedule("30 6 * * *", tz="America/New_York")
+        assert s.next_after(0) == 11 * 3600 + 30 * 60
+        # vs plain UTC
+        assert CronSchedule("30 6 * * *").next_after(0) == 6 * 3600 + 30 * 60
+
+    def test_unknown_zone_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError):
+            CronSchedule("* * * * *", tz="Mars/Olympus")
+
+    def test_cronjob_spec_round_trips_timezone(self):
+        cj = CronJob.from_dict({
+            "metadata": {"name": "c"},
+            "spec": {"schedule": "0 9 * * *", "timeZone": "Europe/Berlin",
+                     "jobTemplate": {"spec": {"template": {"spec": {
+                         "containers": [{"name": "x"}]}}}}}})
+        assert cj.spec.time_zone == "Europe/Berlin"
+        from kubernetes_tpu.api.serialize import to_dict
+
+        assert to_dict(cj)["spec"]["timeZone"] == "Europe/Berlin"
+
+
+class TestCronDST:
+    def test_fall_back_never_steps_backwards(self):
+        """next_after across the America/New_York fall-back (2026-11-01
+        02:00 EDT -> 01:00 EST) must return times STRICTLY after ts."""
+        from datetime import datetime, timezone
+
+        s = CronSchedule("* * * * *", tz="America/New_York")
+        # 05:30 UTC = 01:30 EDT (first pass of the repeated hour)
+        t0 = datetime(2026, 11, 1, 5, 30, tzinfo=timezone.utc).timestamp()
+        # walk a whole day minute-by-minute through the transition
+        t = t0
+        for _ in range(200):
+            nxt = s.next_after(t)
+            assert nxt > t, (nxt, t)
+            t = nxt
+
+    def test_spring_forward_nonexistent_time_skipped(self):
+        """'30 2' on the spring-forward day (02:30 EDT never exists) must
+        fire the NEXT day, not at 03:30."""
+        from datetime import datetime, timezone
+
+        s = CronSchedule("30 2 * * *", tz="America/New_York")
+        # start just before the 2026-03-08 transition (07:00 UTC)
+        t0 = datetime(2026, 3, 8, 6, 0, tzinfo=timezone.utc).timestamp()
+        nxt = s.next_after(t0)
+        local = datetime.fromtimestamp(nxt, tz=timezone.utc)
+        # next occurrence is 02:30 EDT on March 9 = 06:30 UTC
+        assert (local.day, local.hour, local.minute) == (9, 6, 30), local
+
+    def test_bad_cronjob_does_not_spin_controller(self):
+        from kubernetes_tpu.api.types import new_uid
+
+        store = APIStore()
+        cj = CronJob.from_dict({
+            "metadata": {"name": "bad"},
+            "spec": {"schedule": "0 9 * * *", "timeZone": "Amerca/Typo",
+                     "jobTemplate": {"spec": {"template": {"spec": {
+                         "containers": [{"name": "x"}]}}}}}})
+        cj.metadata.uid = new_uid()
+        store.create("cronjobs", cj)
+        ctl = CronJobController(store, clock=FakeClock(1000.0))
+        ctl.sync_all()
+        ctl.process()
+        assert ctl.sync_errors == 0  # skipped cleanly, no raise/retry loop
+
+    def test_admission_rejects_bad_schedule_or_zone(self):
+        import pytest
+        from kubernetes_tpu.server import APIError, APIServer, RESTClient
+
+        srv = APIServer(APIStore()).start()
+        try:
+            c = RESTClient(srv.url)
+            body = {"kind": "CronJob", "metadata": {"name": "c"},
+                    "spec": {"schedule": "0 9 * * *", "timeZone": "Mars/Base",
+                             "jobTemplate": {"spec": {"template": {"spec": {
+                                 "containers": [{"name": "x"}]}}}}}}
+            with pytest.raises(APIError) as e:
+                c.create("cronjobs", body)
+            assert e.value.code == 422
+            body["spec"]["timeZone"] = "Europe/Berlin"
+            body["spec"]["schedule"] = "not a cron"
+            with pytest.raises(APIError) as e:
+                c.create("cronjobs", body)
+            assert e.value.code == 422
+        finally:
+            srv.stop()
