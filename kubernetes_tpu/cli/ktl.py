@@ -532,11 +532,82 @@ def cmd_drain(client: RESTClient, args) -> int:
     return rc
 
 
+def _describe_pod(obj) -> None:
+    """kubectl describe pod's section layout (describe/describe.go)."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    print(f"Name:         {meta.get('name', '')}")
+    print(f"Namespace:    {meta.get('namespace', '')}")
+    print(f"Node:         {spec.get('nodeName') or '<none>'}")
+    print(f"Status:       {status.get('phase', '')}")
+    if spec.get("priority") or spec.get("priorityClassName"):
+        line = f"Priority:     {spec.get('priority', 0)}"
+        if spec.get("priorityClassName"):
+            line += f" ({spec['priorityClassName']})"
+        print(line)
+    if meta.get("labels"):
+        print("Labels:       " + ",".join(
+            f"{k}={v}" for k, v in sorted(meta["labels"].items())))
+    print("Containers:")
+    for c in spec.get("containers", []):
+        print(f"  {c.get('name', '')}:")
+        print(f"    Image:    {c.get('image') or '<none>'}")
+        req = (c.get("resources") or {}).get("requests") or {}
+        if req:
+            print("    Requests: " + ", ".join(
+                f"{k}={v}" for k, v in sorted(req.items())))
+        for e in c.get("env", []):
+            val = e.get("value", "<set via valueFrom>")
+            print(f"    Env:      {e.get('name', '')}={val}")
+    if spec.get("tolerations"):
+        print("Tolerations:  " + "; ".join(
+            f"{t.get('key', '')}:{t.get('effect', '')}"
+            for t in spec["tolerations"]))
+    conds = status.get("conditions") or []
+    if conds:
+        print("Conditions:")
+        for c in conds:
+            line = f"  {c.get('type', '')}={c.get('status', '')}"
+            if c.get("reason"):
+                line += f" ({c['reason']})"
+            print(line)
+
+
+def _describe_node(obj) -> None:
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    print(f"Name:          {meta.get('name', '')}")
+    if meta.get("labels"):
+        print("Labels:        " + ",".join(
+            f"{k}={v}" for k, v in sorted(meta["labels"].items())))
+    print(f"Unschedulable: {spec.get('unschedulable', False)}")
+    for t in spec.get("taints", []):
+        print(f"Taint:         {t.get('key', '')}="
+              f"{t.get('value', '')}:{t.get('effect', '')}")
+    for section in ("capacity", "allocatable"):
+        vals = status.get(section) or {}
+        if vals:
+            print(f"{section.capitalize() + ':':<15}" + ", ".join(
+                f"{k}={v}" for k, v in sorted(vals.items())))
+    conds = status.get("conditions") or []
+    if conds:
+        print("Conditions:")
+        for c in conds:
+            print(f"  {c.get('type', '')}={c.get('status', '')}")
+
+
 def cmd_describe(client: RESTClient, args) -> int:
     resource = resolve_resource(args.resource)
     ns = None if resource in CLUSTER_SCOPED else (args.namespace or "default")
     obj = client.get(resource, args.name, ns)
-    _print_yaml(obj)
+    if resource == "pods":
+        _describe_pod(obj)
+    elif resource == "nodes":
+        _describe_node(obj)
+    else:
+        _print_yaml(obj)
     # Events: section (kubectl describe's tail)
     try:
         kind = obj.get("kind", "")

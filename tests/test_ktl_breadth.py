@@ -338,3 +338,59 @@ class TestLogsPipeline:
         append_pod_log(server.store, "default", "p", "c", "line-1", 1.0)
         assert run(server, "logs", "p") == 0
         assert "line-1" in capsys.readouterr().out
+
+
+class TestDescribeSections:
+    def test_describe_pod_sections(self, server, client, capsys):
+        client.create("pods", {
+            "metadata": {"name": "web", "labels": {"app": "web"}},
+            "spec": {"containers": [{"name": "c", "image": "nginx",
+                                     "resources": {"requests": {"cpu": "100m"}},
+                                     "env": [{"name": "MODE", "value": "fast"}]}]}})
+        client.bind("default", "web", "n9")
+        assert run(server, "describe", "pods", "web") == 0
+        out = capsys.readouterr().out
+        assert "Name:         web" in out
+        assert "Node:         n9" in out
+        assert "Image:    nginx" in out
+        assert "Requests: cpu=100m" in out
+        assert "MODE=fast" in out
+
+    def test_describe_node_sections(self, server, client, capsys):
+        client.create("nodes", {
+            "metadata": {"name": "n1", "labels": {"zone": "a"}},
+            "spec": {"taints": [{"key": "gpu", "value": "t",
+                                 "effect": "NoSchedule"}]},
+            "status": {"capacity": {"cpu": "8"}}})
+        assert run(server, "describe", "nodes", "n1") == 0
+        out = capsys.readouterr().out
+        assert "Name:          n1" in out
+        assert "zone=a" in out and "gpu=t:NoSchedule" in out
+        assert "cpu=8" in out
+
+    def test_describe_other_kinds_yaml_fallback(self, server, client, capsys):
+        client.create("configmaps", {"kind": "ConfigMap",
+                                     "metadata": {"name": "cm"},
+                                     "data": {"k": "v"}})
+        assert run(server, "describe", "configmaps", "cm") == 0
+        assert "ConfigMap" in capsys.readouterr().out
+
+
+class TestDescribePolish:
+    def test_priority_without_class_shown(self, server, client, capsys):
+        # direct store write: the admission chain (correctly) zeroes a
+        # client-supplied priority with no class — scheduler-set priorities
+        # reach the store exactly this way
+        from kubernetes_tpu.testing import MakePod
+
+        server.store.create("pods", MakePod("hi").priority(100)
+                            .req({"cpu": "1"}).obj())
+        assert run(server, "describe", "pods", "hi") == 0
+        assert "Priority:     100" in capsys.readouterr().out
+
+    def test_node_capacity_has_colon(self, server, client, capsys):
+        client.create("nodes", {"metadata": {"name": "n1"},
+                                "status": {"capacity": {"cpu": "8"}}})
+        assert run(server, "describe", "nodes", "n1") == 0
+        out = capsys.readouterr().out
+        assert "Capacity:" in out and "Allocatable:" in out
