@@ -532,6 +532,10 @@ def cmd_drain(client: RESTClient, args) -> int:
     return rc
 
 
+def _fmt_kv(d, sep=",") -> str:
+    return sep.join(f"{k}={v}" for k, v in sorted(d.items()))
+
+
 def _describe_pod(obj) -> None:
     """kubectl describe pod's section layout (describe/describe.go)."""
     meta = obj.get("metadata") or {}
@@ -547,18 +551,21 @@ def _describe_pod(obj) -> None:
             line += f" ({spec['priorityClassName']})"
         print(line)
     if meta.get("labels"):
-        print("Labels:       " + ",".join(
-            f"{k}={v}" for k, v in sorted(meta["labels"].items())))
+        print("Labels:       " + _fmt_kv(meta["labels"]))
     print("Containers:")
     for c in spec.get("containers", []):
         print(f"  {c.get('name', '')}:")
         print(f"    Image:    {c.get('image') or '<none>'}")
         req = (c.get("resources") or {}).get("requests") or {}
         if req:
-            print("    Requests: " + ", ".join(
-                f"{k}={v}" for k, v in sorted(req.items())))
+            print("    Requests: " + _fmt_kv(req, sep=", "))
         for e in c.get("env", []):
-            val = e.get("value", "<set via valueFrom>")
+            if "value" in e:
+                val = e["value"]
+            elif e.get("valueFrom"):
+                val = "<set via valueFrom>"
+            else:
+                val = ""  # k8s semantics: unset value = empty string
             print(f"    Env:      {e.get('name', '')}={val}")
     if spec.get("tolerations"):
         print("Tolerations:  " + "; ".join(
@@ -580,8 +587,7 @@ def _describe_node(obj) -> None:
     status = obj.get("status") or {}
     print(f"Name:          {meta.get('name', '')}")
     if meta.get("labels"):
-        print("Labels:        " + ",".join(
-            f"{k}={v}" for k, v in sorted(meta["labels"].items())))
+        print("Labels:        " + _fmt_kv(meta["labels"]))
     print(f"Unschedulable: {spec.get('unschedulable', False)}")
     for t in spec.get("taints", []):
         print(f"Taint:         {t.get('key', '')}="
@@ -589,8 +595,7 @@ def _describe_node(obj) -> None:
     for section in ("capacity", "allocatable"):
         vals = status.get(section) or {}
         if vals:
-            print(f"{section.capitalize() + ':':<15}" + ", ".join(
-                f"{k}={v}" for k, v in sorted(vals.items())))
+            print(f"{section.capitalize() + ':':<15}" + _fmt_kv(vals, sep=", "))
     conds = status.get("conditions") or []
     if conds:
         print("Conditions:")

@@ -160,3 +160,85 @@ class TestRestartRecovery:
         hk.register()
         hk.pump()
         assert pod.key in hk.running_pods
+
+
+class TestFailoverMidIndexedJob:
+    def test_indexed_job_survives_failover_without_duplicate_indexes(self):
+        """Leader dies while an Indexed Job is mid-flight: the standby's job
+        controller must finish the remaining indexes WITHOUT double-creating
+        pods for indexes that already succeeded or are active."""
+        from kubernetes_tpu.api.workloads import Job
+        from kubernetes_tpu.api.types import new_uid
+        from kubernetes_tpu.controllers.job import pod_completion_index
+
+        store = APIStore()
+        store.create("nodes", MakeNode("n0").capacity(
+            {"cpu": "32", "memory": "64Gi", "pods": "100"}).obj())
+
+        def mk(ident):
+            return ControlPlane(
+                store, identity=ident, use_batch_scheduler=False,
+                controllers=("job",),
+                lease_duration=0.6, renew_deadline=0.4, retry_period=0.05)
+
+        cp1 = mk("cp-1").start()
+        assert _wait(lambda: cp1.is_leader, 5)
+        cp2 = mk("cp-2").start()
+
+        job = Job.from_dict({
+            "metadata": {"name": "train"},
+            "spec": {"parallelism": 6, "completions": 6,
+                     "completionMode": "Indexed",
+                     "template": {"spec": {"containers": [
+                         {"name": "w", "resources": {
+                             "requests": {"cpu": "100m"}}}]}}}})
+        job.metadata.uid = new_uid()
+        store.create("jobs", job)
+        assert _wait(lambda: len(store.list("pods")[0]) == 6, 10)
+
+        # half the indexes succeed under the first leader
+        for p in store.list("pods")[0]:
+            if pod_completion_index(p) < 3:
+                def done(x):
+                    x.status.phase = "Succeeded"
+                    return x
+
+                store.guaranteed_update("pods", p.key, done)
+        assert _wait(lambda: store.get(
+            "jobs", "default/train").status.succeeded == 3, 10)
+
+        # crash the leader mid-job
+        cp1.elector.try_acquire_or_renew = lambda: False
+        cp1._stop_components()
+        assert _wait(lambda: cp2.is_leader, 5), "standby did not take over"
+
+        # finish the rest under the new leader
+        def finish_remaining():
+            for p in store.list("pods")[0]:
+                if not p.is_terminal():
+                    def done(x):
+                        x.status.phase = "Succeeded"
+                        return x
+
+                    store.guaranteed_update("pods", p.key, done)
+            j = store.get("jobs", "default/train")
+            return j.is_finished()
+
+        assert _wait(finish_remaining, 10), "job did not complete after failover"
+        j = store.get("jobs", "default/train")
+        assert j.status.completed_indexes == "0-5"
+        # no index ever had two simultaneously-active pods: every index's
+        # pods are terminal now and each index appears exactly once among
+        # the succeeded set per sync accounting
+        by_index = {}
+        for p in store.list("pods")[0]:
+            by_index.setdefault(pod_completion_index(p), []).append(p)
+        assert sorted(by_index) == [0, 1, 2, 3, 4, 5]
+        for idx, pods in by_index.items():
+            succ = [p for p in pods if p.status.phase == "Succeeded"]
+            assert len(succ) >= 1
+            # duplicates would mean the standby recreated an index that was
+            # already done/active
+            assert len(pods) == 1, (idx, [p.metadata.name for p in pods])
+        cp1.stop()
+        cp2.stop()

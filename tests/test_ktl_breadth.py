@@ -394,3 +394,19 @@ class TestDescribePolish:
         assert run(server, "describe", "nodes", "n1") == 0
         out = capsys.readouterr().out
         assert "Capacity:" in out and "Allocatable:" in out
+
+
+class TestDescribeEnvEdgeCases:
+    def test_env_without_value_shows_empty(self, server, capsys):
+        from kubernetes_tpu.testing import MakePod
+
+        pod = MakePod("p").req({"cpu": "1"}).obj()
+        pod.spec.containers[0].env = [
+            {"name": "EMPTY"},
+            {"name": "FROM", "valueFrom": {"configMapKeyRef": {
+                "name": "cm", "key": "k"}}}]
+        server.store.create("pods", pod)
+        assert run(server, "describe", "pods", "p") == 0
+        out = capsys.readouterr().out
+        assert "Env:      EMPTY=\n" in out
+        assert "FROM=<set via valueFrom>" in out
