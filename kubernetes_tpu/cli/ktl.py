@@ -87,26 +87,101 @@ def fmt_table(headers: List[str], rows: List[List[str]]) -> str:
 # -- command implementations ---------------------------------------------------
 
 
+class CLIError(Exception):
+    """User-facing CLI error: printed as `error: ...`, exit 1 — without
+    swallowing unrelated ValueErrors from command internals."""
+
+
+_OUTPUT_MODES = ("wide", "json", "yaml")
+
+
+def _jsonpath_extract(obj, expr: str):
+    """The dotted-path subset of kubectl's -o jsonpath: `{.a.b[0].c}`;
+    multiple `{...}` templates join with spaces. Range/filter/negative-index
+    syntax is not supported (clean error instead of silent garbage)."""
+    import re
+
+    parts = re.findall(r"\{([^}]*)\}", expr)
+    if not parts:
+        raise CLIError(f"invalid jsonpath template {expr!r}")
+    out = []
+    for part in parts:
+        if part.startswith("range") or "?(" in part or "*" in part:
+            raise CLIError(f"unsupported jsonpath feature in {{{part}}}")
+        cur = obj
+        for m in re.finditer(r"([^.\[\]]+)|\[([^\]]*)\]",
+                             part.strip().lstrip(".")):
+            key, idx = m.group(1), m.group(2)
+            if idx is not None:
+                if not idx.isdigit():
+                    raise CLIError(
+                        f"unsupported jsonpath index [{idx}] in {{{part}}}")
+                i = int(idx)
+                cur = cur[i] if isinstance(cur, list) and i < len(cur) else ""
+            elif isinstance(cur, dict):
+                cur = cur.get(key, "")
+            else:
+                cur = ""
+        out.append(cur if isinstance(cur, str) else json.dumps(cur))
+    return " ".join(out)
+
+
 def cmd_get(client: RESTClient, args) -> int:
     resource = resolve_resource(args.resource)
     ns = None if resource in CLUSTER_SCOPED else (args.namespace or "default")
+    output = args.output
+    if output not in _OUTPUT_MODES and not output.startswith("jsonpath="):
+        raise CLIError(f"unknown output format {output!r} "
+                       f"(wide|json|yaml|jsonpath={{...}})")
+
+    def emit(items, single=False):
+        if output == "json":
+            print(json.dumps(items[0] if single else items, indent=2))
+        elif output == "yaml":
+            _print_yaml(items[0] if single else {"items": items})
+        elif output.startswith("jsonpath="):
+            for o in items:
+                print(_jsonpath_extract(o, output[len("jsonpath="):]))
+        else:
+            print(fmt_table(*_rows(resource, items)))
+
+    def stream(rv, field_selector=""):
+        # kubectl get -w: the stream keeps the requested format — one JSON/
+        # YAML document or jsonpath line per event, table rows otherwise
+        try:
+            for etype, obj in client.watch(
+                    resource, since_rv=rv,
+                    namespace=None if args.all_namespaces else ns,
+                    field_selector=field_selector,
+                    label_selector=getattr(args, "selector", "") or ""):
+                if etype == "BOOKMARK":
+                    continue
+                if output == "json":
+                    print(json.dumps(obj))
+                elif output == "yaml":
+                    _print_yaml(obj)
+                elif output.startswith("jsonpath="):
+                    print(etype, _jsonpath_extract(
+                        obj, output[len("jsonpath="):]))
+                else:
+                    _h, rows = _rows(resource, [obj])
+                    print(f"{etype:<9}" + "  ".join(rows[0]))
+        except KeyboardInterrupt:
+            pass
+
     if args.name:
         obj = client.get(resource, args.name, ns)
-        if args.output == "json":
-            print(json.dumps(obj, indent=2))
-        elif args.output == "yaml":
-            _print_yaml(obj)
-        else:
-            print(fmt_table(*_rows(resource, [obj])))
+        emit([obj], single=True)
+        if getattr(args, "watch", False):
+            stream(int((obj.get("metadata") or {}).get("resourceVersion", 0)),
+                   field_selector=f"metadata.name={args.name}")
         return 0
-    items, _ = client.list(resource, None if args.all_namespaces else ns,
-                           label_selector=getattr(args, "selector", "") or "")
-    if args.output == "json":
-        print(json.dumps(items, indent=2))
-    elif args.output == "yaml":
-        _print_yaml({"items": items})
-    else:
-        print(fmt_table(*_rows(resource, items)))
+    sel = getattr(args, "selector", "") or ""
+    items, rv = client.list(resource, None if args.all_namespaces else ns,
+                            label_selector=sel)
+    emit(items)
+    if getattr(args, "watch", False):
+        stream(rv)
     return 0
 
 
@@ -935,9 +1010,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("get")
     p.add_argument("resource")
     p.add_argument("name", nargs="?")
-    p.add_argument("-o", "--output", choices=["wide", "json", "yaml"], default="wide")
+    p.add_argument("-o", "--output", default="wide")  # wide|json|yaml|jsonpath={..}
     p.add_argument("-A", "--all-namespaces", action="store_true")
     p.add_argument("-l", "--selector", default="")
+    p.add_argument("-w", "--watch", action="store_true")
     p.set_defaults(fn=cmd_get)
 
     p = sub.add_parser("describe")
@@ -1075,7 +1151,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     client = RESTClient(server, token=token)
     try:
         return args.fn(client, args)
-    except APIError as e:
+    except (APIError, CLIError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1
 

@@ -182,8 +182,20 @@ class TestFailoverMidIndexedJob:
                 lease_duration=0.6, renew_deadline=0.4, retry_period=0.05)
 
         cp1 = mk("cp-1").start()
-        assert _wait(lambda: cp1.is_leader, 5)
-        cp2 = mk("cp-2").start()
+        cp2 = None
+        try:
+            assert _wait(lambda: cp1.is_leader, 5)
+            cp2 = mk("cp-2").start()
+            self._run(store, cp1, cp2)
+        finally:
+            cp1.stop()
+            if cp2 is not None:
+                cp2.stop()
+
+    def _run(self, store, cp1, cp2):
+        from kubernetes_tpu.api.workloads import Job
+        from kubernetes_tpu.api.types import new_uid
+        from kubernetes_tpu.controllers.job import pod_completion_index
 
         job = Job.from_dict({
             "metadata": {"name": "train"},
@@ -240,5 +252,3 @@ class TestFailoverMidIndexedJob:
             # duplicates would mean the standby recreated an index that was
             # already done/active
             assert len(pods) == 1, (idx, [p.metadata.name for p in pods])
-        cp1.stop()
-        cp2.stop()

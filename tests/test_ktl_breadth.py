@@ -410,3 +410,133 @@ class TestDescribeEnvEdgeCases:
         out = capsys.readouterr().out
         assert "Env:      EMPTY=\n" in out
         assert "FROM=<set via valueFrom>" in out
+
+
+class TestGetOutputModes:
+    def test_jsonpath_extraction(self, server, client, capsys):
+        client.create("pods", {"metadata": {"name": "p", "labels": {"a": "b"}},
+                               "spec": {"containers": [{"name": "c",
+                                                        "image": "img"}]}})
+        assert run(server, "get", "pods", "p", "-o",
+                   "jsonpath={.metadata.name} {.spec.containers[0].image}") == 0
+        assert capsys.readouterr().out.strip() == "p img"
+
+    def test_jsonpath_over_list(self, server, client, capsys):
+        for n in ("a", "b"):
+            client.create("pods", {"metadata": {"name": n},
+                                   "spec": {"containers": [{"name": "c"}]}})
+        assert run(server, "get", "pods", "-o",
+                   "jsonpath={.metadata.name}") == 0
+        assert capsys.readouterr().out.split() == ["a", "b"]
+
+    def test_jsonpath_unsupported_features_error(self, server, client, capsys):
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        assert run(server, "get", "pods", "p", "-o",
+                   "jsonpath={range .items[*]}") == 1
+
+    def test_watch_streams_rows(self, server, client):
+        import threading
+
+        out = []
+
+        def consume():
+            import io
+            import contextlib
+
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                try:
+                    run(server, "get", "pods", "-w")
+                except Exception:
+                    pass
+            out.append(buf.getvalue())
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        import time
+
+        time.sleep(0.4)
+        client.create("pods", {"metadata": {"name": "streamed"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        time.sleep(0.6)
+        server.stop()  # terminates the watch stream
+        t.join(timeout=5)
+        assert out and "ADDED" in out[0] and "streamed" in out[0]
+
+
+class TestGetOutputHardening:
+    def test_invalid_output_mode_errors(self, server, client, capsys):
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        assert run(server, "get", "pods", "-o", "josn") == 1
+        assert "unknown output format" in capsys.readouterr().err
+
+    def test_negative_index_errors(self, server, client, capsys):
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        assert run(server, "get", "pods", "p", "-o",
+                   "jsonpath={.spec.containers[-1].image}") == 1
+        assert "unsupported jsonpath index" in capsys.readouterr().err
+
+    def test_named_watch_streams(self, server, client):
+        import contextlib
+        import io
+        import threading
+        import time
+
+        client.create("pods", {"metadata": {"name": "tgt"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        out = []
+
+        def consume():
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                try:
+                    run(server, "get", "pods", "tgt", "-w")
+                except Exception:
+                    pass
+            out.append(buf.getvalue())
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.4)
+        client.patch("pods", "tgt", {"metadata": {"labels": {"x": "y"}}})
+        client.create("pods", {"metadata": {"name": "other"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        time.sleep(0.6)
+        server.stop()
+        t.join(timeout=5)
+        # the named watch sees its own MODIFIED but not the other pod
+        assert "MODIFIED" in out[0] and "tgt" in out[0]
+        assert "other" not in out[0]
+
+    def test_watch_json_keeps_format(self, server, client):
+        import contextlib
+        import io
+        import json as _json
+        import threading
+        import time
+
+        out = []
+
+        def consume():
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                try:
+                    run(server, "get", "pods", "-o", "json", "-w")
+                except Exception:
+                    pass
+            out.append(buf.getvalue())
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.4)
+        client.create("pods", {"metadata": {"name": "j1"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        time.sleep(0.6)
+        server.stop()
+        t.join(timeout=5)
+        # initial list is a JSON doc; each event is a parseable JSON line
+        tail = out[0].strip().splitlines()[-1]
+        assert _json.loads(tail)["metadata"]["name"] == "j1"
