@@ -174,7 +174,9 @@ class JoinedNode:
             doc = to_dict(pod)
             doc.setdefault("status", {})["phase"] = "Running"
             try:
-                self.client.update("pods", doc, pod.metadata.namespace)
+                # status subresource: a kubelet's write can only ever touch
+                # status, never spec (registry status-REST split)
+                self.client.update_status("pods", doc, pod.metadata.namespace)
             except APIError:
                 continue  # conflict/validation: retry next pass
             self.running[key] = pod

@@ -211,7 +211,8 @@ def default_component_authorizer() -> RBACAuthorizer:
              "poddisruptionbudgets", "leases"])
     a.grant("group:system:nodes",
             ["get", "list", "watch", "create", "update", "patch", "delete"],
-            ["pods", "nodes", "leases", "events", "podlogs"])
+            ["pods", "nodes", "leases", "events", "podlogs",
+             "pods/status", "nodes/status"])
     # nodes may renew their own credential (certificatesigningrequests
     # recognizer allows requestor == requested node identity)
     a.grant("group:system:nodes", ["create", "get", "list", "watch"],

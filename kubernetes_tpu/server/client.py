@@ -160,6 +160,16 @@ class RESTClient:
         return self.request("PATCH", self._path(resource, namespace, name),
                             patch, content_type=patch_type)
 
+    def update_status(self, resource: str, obj_dict: Dict,
+                      namespace: Optional[str] = None) -> Dict:
+        """PUT the status subresource: only the status stanza lands (the
+        kubelet/controller write path — spec is untouchable here)."""
+        meta = obj_dict.get("metadata") or {}
+        ns = namespace or meta.get("namespace") or "default"
+        return self.request("PUT",
+                            self._path(resource, ns, meta["name"], "status"),
+                            obj_dict)
+
     def evict(self, name: str, namespace: str = "default") -> Dict:
         """PDB-respecting eviction (pods/{name}/eviction); 429 when a
         matching budget has no disruptions left."""

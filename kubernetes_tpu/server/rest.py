@@ -388,6 +388,8 @@ class _Handler(BaseHTTPRequestHandler):
             resource = "serviceaccounts/token"
         elif self.command == "POST" and sub == "eviction" and resource == "pods":
             resource = "pods/eviction"
+        elif self.command in ("PUT", "PATCH") and sub == "status":
+            resource = f"{resource}/status"
         return verb, resource
 
     def _audit_record(self, code: int, verb: Optional[str] = None) -> None:
@@ -879,20 +881,89 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ---- PUT / DELETE --------------------------------------------------------
 
+    def _put_status(self, resource: str, ns, name: str, user, crd=None) -> None:
+        """The status subresource (registry strategies' status REST): the
+        write replaces ONLY the status stanza — a status writer (kubelet,
+        controller) can never mutate spec or metadata, however its payload is
+        shaped. OCC applies via the body's resourceVersion when provided.
+        CRD-served resources keep status inside the Unstructured content."""
+        try:
+            body = self._read_body()
+        except json.JSONDecodeError as e:
+            self._error(400, f"invalid JSON: {e}")
+            return
+        if not isinstance(body, dict):
+            self._error(400, "body must be a JSON object", "BadRequest")
+            return
+        if crd is None:
+            try:
+                incoming = from_dict(resource, body)
+            except Exception as e:
+                self._error(400, f"cannot parse {resource}: {e}", "BadRequest")
+                return
+            if not hasattr(incoming, "status"):
+                self._error(400, f"{resource} has no status subresource",
+                            "BadRequest")
+                return
+            body_rv = incoming.metadata.resource_version
+        else:
+            from ..api.crd import Unstructured
+
+            incoming = Unstructured.from_dict(body)
+            body_rv = incoming.metadata.resource_version
+        key = self._key(resource, ns, name, crd)
+        err = None
+        updated = None
+        with self.store.transaction():
+            try:
+                existing = self.store.get(resource, key)
+                if body_rv and body_rv != existing.metadata.resource_version:
+                    raise ConflictError(
+                        f"{resource} {key}: stale resourceVersion {body_rv}")
+                if crd is None:
+                    existing.status = incoming.status
+                else:
+                    from ..api.crd import validate_custom_object
+
+                    existing.content["status"] = incoming.content.get(
+                        "status", {})
+                    validated, errs = validate_custom_object(crd, existing)
+                    if errs:
+                        raise _PatchParseError((422, "; ".join(errs), "Invalid"))
+                    existing = validated
+                err = self._admission_verdict(resource, "UPDATE", existing, user)
+                if err is None:
+                    updated = self.store.update(resource, existing,
+                                                check_rv=False)
+            except NotFoundError as e:
+                err = (404, str(e), "NotFound")
+            except ConflictError as e:
+                err = (409, str(e), "Conflict")
+            except _PatchParseError as e:
+                err = e.verdict
+        if err is not None:
+            self._error(*err)
+            return
+        self._send_json(200, to_dict(updated))
+
     def do_PUT(self):
         parsed = _parse_path(urlparse(self.path).path)
         if parsed is None or parsed[2] is None:
             self._error(404, "unknown path")
             return
-        resource, ns, name, _ = parsed
+        resource, ns, name, sub = parsed
         crd = self._crd(resource)
         if crd is not None:
             resource = crd.names.plural
-        user = self._authenticated_user("update", resource)
+        _verb, authz_resource = self._request_attrs((resource, ns, name, sub))
+        user = self._authenticated_user("update", authz_resource)
         if user is None:
             return
         if not self._known(resource, crd):
             self._error(404, f"unknown resource {resource}")
+            return
+        if sub == "status":
+            self._put_status(resource, ns, name, user, crd)
             return
         try:
             body = self._read_body()
@@ -940,11 +1011,12 @@ class _Handler(BaseHTTPRequestHandler):
         if parsed is None or parsed[2] is None:
             self._error(404, "unknown path")
             return
-        resource, ns, name, _ = parsed
+        resource, ns, name, sub = parsed
         crd = self._crd(resource)
         if crd is not None:
             resource = crd.names.plural
-        user = self._authenticated_user("patch", resource)
+        _verb, authz_resource = self._request_attrs((resource, ns, name, sub))
+        user = self._authenticated_user("patch", authz_resource)
         if user is None:
             return
         ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
@@ -961,6 +1033,14 @@ class _Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as e:
             self._error(400, f"invalid JSON: {e}")
             return
+        if sub == "status":
+            # the status endpoint only ever merges the status stanza: a
+            # status-scoped principal must not smuggle spec/metadata edits
+            # through PATCH any more than through PUT
+            if not isinstance(patch, dict):
+                self._error(400, "body must be a JSON object", "BadRequest")
+                return
+            patch = {"status": patch.get("status", {})}
         key = self._key(resource, ns, name, crd)
         err = None
         updated = None

@@ -328,3 +328,152 @@ class TestFieldSelector:
             assert e.value.code == 400
         finally:
             srv.stop()
+
+
+class TestStatusSubresource:
+    """registry status-REST split: status writes cannot touch spec."""
+
+    def _server(self):
+        from kubernetes_tpu.server import APIServer
+        from kubernetes_tpu.store import APIStore
+
+        return APIServer(APIStore()).start()
+
+    def test_status_put_replaces_only_status(self):
+        from kubernetes_tpu.server import RESTClient
+
+        srv = self._server()
+        try:
+            c = RESTClient(srv.url)
+            c.create("pods", {"metadata": {"name": "p"},
+                              "spec": {"containers": [{"name": "c",
+                                                       "image": "v1"}]}})
+            # a status write smuggling a spec change: spec must be ignored
+            out = c.update_status("pods", {
+                "metadata": {"name": "p"},
+                "spec": {"containers": [{"name": "c", "image": "EVIL"}]},
+                "status": {"phase": "Running"}})
+            assert out["status"]["phase"] == "Running"
+            assert out["spec"]["containers"][0]["image"] == "v1"
+        finally:
+            srv.stop()
+
+    def test_status_occ_with_body_rv(self):
+        import pytest as _pytest
+
+        from kubernetes_tpu.server import APIError, RESTClient
+
+        srv = self._server()
+        try:
+            c = RESTClient(srv.url)
+            c.create("pods", {"metadata": {"name": "p"},
+                              "spec": {"containers": [{"name": "c"}]}})
+            cur = c.get("pods", "p")
+            c.update_status("pods", {
+                "metadata": {"name": "p",
+                             "resourceVersion": cur["metadata"]["resourceVersion"]},
+                "status": {"phase": "Running"}})
+            with _pytest.raises(APIError) as e:
+                c.update_status("pods", {
+                    "metadata": {"name": "p",
+                                 "resourceVersion": cur["metadata"]["resourceVersion"]},
+                    "status": {"phase": "Failed"}})
+            assert e.value.code == 409
+            # no RV = last-write-wins (controllers' guaranteed-update style)
+            out = c.update_status("pods", {"metadata": {"name": "p"},
+                                           "status": {"phase": "Succeeded"}})
+            assert out["status"]["phase"] == "Succeeded"
+        finally:
+            srv.stop()
+
+    def test_status_authz_uses_subresource_name(self):
+        import pytest as _pytest
+
+        from kubernetes_tpu.server import APIError, APIServer, RESTClient
+        from kubernetes_tpu.server.auth import RBACAuthorizer, TokenAuthenticator
+        from kubernetes_tpu.store import APIStore
+
+        authn = TokenAuthenticator()
+        authn.add("t-status", "statuser")
+        authn.add("t-admin", "admin", ["system:masters"])
+        authz = (RBACAuthorizer()
+                 .grant("group:system:masters", ["*"], ["*"])
+                 .grant("statuser", ["update"], ["pods/status"])
+                 .grant("statuser", ["get", "list"], ["pods"]))
+        srv = APIServer(APIStore(), authenticator=authn, authorizer=authz).start()
+        try:
+            admin = RESTClient(srv.url, token="t-admin")
+            admin.create("pods", {"metadata": {"name": "p"},
+                                  "spec": {"containers": [{"name": "c"}]}})
+            su = RESTClient(srv.url, token="t-status")
+            out = su.update_status("pods", {"metadata": {"name": "p"},
+                                            "status": {"phase": "Running"}})
+            assert out["status"]["phase"] == "Running"
+            # but a full PUT (update on `pods`) is NOT granted
+            cur = su.get("pods", "p")
+            with _pytest.raises(APIError) as e:
+                su.update("pods", cur)
+            assert e.value.code == 403
+        finally:
+            srv.stop()
+
+    def test_status_patch_cannot_touch_spec(self):
+        """PATCH to /status only merges the status stanza — and a
+        status-scoped principal may use it while full-patch is denied."""
+        import pytest as _pytest
+
+        from kubernetes_tpu.server import APIError, APIServer, RESTClient
+        from kubernetes_tpu.server.auth import RBACAuthorizer, TokenAuthenticator
+        from kubernetes_tpu.store import APIStore
+
+        authn = TokenAuthenticator()
+        authn.add("t-admin", "admin", ["system:masters"])
+        authn.add("t-status", "statuser")
+        authz = (RBACAuthorizer()
+                 .grant("group:system:masters", ["*"], ["*"])
+                 .grant("statuser", ["patch"], ["pods/status"])
+                 .grant("statuser", ["get"], ["pods"]))
+        srv = APIServer(APIStore(), authenticator=authn, authorizer=authz).start()
+        try:
+            admin = RESTClient(srv.url, token="t-admin")
+            admin.create("pods", {"metadata": {"name": "p"},
+                                  "spec": {"containers": [{"name": "c",
+                                                           "image": "v1"}]}})
+            su = RESTClient(srv.url, token="t-status")
+            out = su.request(
+                "PATCH", "/api/v1/namespaces/default/pods/p/status",
+                {"spec": {"containers": [{"name": "c", "image": "EVIL"}]},
+                 "status": {"phase": "Running"}},
+                content_type="application/merge-patch+json")
+            assert out["status"]["phase"] == "Running"
+            assert out["spec"]["containers"][0]["image"] == "v1"  # untouched
+            with _pytest.raises(APIError) as e:
+                su.patch("pods", "p", {"metadata": {"labels": {"a": "b"}}})
+            assert e.value.code == 403  # no grant on bare pods patch
+        finally:
+            srv.stop()
+
+    def test_cr_status_put_cannot_replace_spec(self):
+        """A CR status write must not become a full-object replace."""
+        from kubernetes_tpu.server import APIServer, RESTClient
+        from kubernetes_tpu.store import APIStore
+
+        srv = APIServer(APIStore()).start()
+        try:
+            c = RESTClient(srv.url)
+            c.create("customresourcedefinitions", {
+                "metadata": {"name": "widgets.x.dev"},
+                "spec": {"group": "x.dev", "scope": "Namespaced",
+                         "names": {"plural": "widgets", "kind": "Widget"},
+                         "versions": [{"name": "v1"}]}}, namespace=None)
+            c.create("widgets", {"metadata": {"name": "w"},
+                                 "spec": {"size": 3}})
+            out = c.request(
+                "PUT", "/apis/x.dev/v1/namespaces/default/widgets/w/status",
+                {"metadata": {"name": "w"},
+                 "spec": {"size": 99},
+                 "status": {"ready": True}})
+            assert out["status"] == {"ready": True}
+            assert out["spec"] == {"size": 3}  # spec untouched
+        finally:
+            srv.stop()
