@@ -222,6 +222,8 @@ class Proxier(Controller):
         for svc in services:
             if svc.spec.type == "ExternalName" or not svc.spec.ports:
                 continue
+            if svc.spec.cluster_ip == "None":
+                continue  # headless: no VIP, no rules (proxier skips these)
             cluster_ip = svc.spec.cluster_ip or self._synth_ip(svc)
             eps: List[Tuple[str, str]] = []  # (ip, node)
             for s in sorted(by_service.get(svc.key, []),

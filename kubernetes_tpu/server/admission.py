@@ -617,6 +617,27 @@ class ImmutableConfigAdmission(AdmissionPlugin):
                 "data cannot be updated", code=422, reason="Invalid")
 
 
+class ServiceValidation(AdmissionPlugin):
+    """spec.clusterIP is immutable once set (core validation
+    ValidateServiceUpdate): a mutated address would desynchronize the
+    allocator and let two Services share one ClusterIP."""
+
+    name = "ServiceValidation"
+
+    def validate(self, store, resource, operation, obj, user="") -> None:
+        if resource != "services" or operation != UPDATE:
+            return
+        try:
+            existing = store.get(
+                "services", f"{obj.metadata.namespace}/{obj.metadata.name}")
+        except NotFoundError:
+            return
+        if existing.spec.cluster_ip and \
+                obj.spec.cluster_ip != existing.spec.cluster_ip:
+            raise AdmissionError("spec.clusterIP is immutable", code=422,
+                                 reason="Invalid")
+
+
 class CertificateSubjectRestriction(AdmissionPlugin):
     """Rejects kube-apiserver-client CSRs that request the system:masters
     group (plugin/pkg/admission/certificates/subjectrestriction) — no
@@ -669,6 +690,7 @@ def default_admission_chain() -> AdmissionChain:
         TaintNodesByCondition(),
         PodSecurityAdmission(),
         ImmutableConfigAdmission(),
+        ServiceValidation(),
         CertificateSubjectRestriction(),
         NodeRestriction(),
         ResourceQuotaAdmission(),

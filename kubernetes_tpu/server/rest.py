@@ -831,6 +831,21 @@ class _Handler(BaseHTTPRequestHandler):
             # (certificates/v1 PrepareForCreate semantics)
             obj.username = user.name
             obj.groups = list(user.groups)
+        allocated_ip = None
+        if resource == "services":
+            # ClusterIP allocation (registry/core/service/ipallocator):
+            # empty = assign next free; explicit = honor or conflict;
+            # "None" = headless, no address
+            from .ipalloc import HEADLESS
+
+            alloc = getattr(self.server, "ipalloc", None)
+            if alloc is not None and obj.spec.cluster_ip != HEADLESS:
+                try:
+                    obj.spec.cluster_ip = alloc.allocate(obj.spec.cluster_ip)
+                    allocated_ip = obj.spec.cluster_ip
+                except ValueError as e:
+                    self._error(422, str(e), "Invalid")
+                    return
         # admission + create under one store transaction: concurrent creates
         # cannot both pass a quota check they jointly exceed. The verdict is
         # buffered and the HTTP response written AFTER the lock is released —
@@ -850,6 +865,10 @@ class _Handler(BaseHTTPRequestHandler):
                 except AlreadyExistsError as e:
                     err = (409, str(e), "AlreadyExists")
         if err is not None:
+            if allocated_ip is not None:
+                # the create failed AFTER allocation: return the address or
+                # a retrying conflicting client drains the CIDR
+                self.server.ipalloc.release(allocated_ip)  # type: ignore[attr-defined]
             self._error(*err)
             return
         self._send_json(201, to_dict(created))
@@ -1102,6 +1121,10 @@ class _Handler(BaseHTTPRequestHandler):
                 err = self._admission_verdict(resource, "DELETE", existing, user)
                 if err is None:
                     obj = self.store.delete(resource, key)
+                    if resource == "services":
+                        alloc = getattr(self.server, "ipalloc", None)
+                        if alloc is not None:
+                            alloc.release(obj.spec.cluster_ip)
                     if resource == "customresourcedefinitions":
                         # CR data dies with its CRD (the reference's
                         # apiextensions finalizer); same transaction so a
@@ -1152,8 +1175,10 @@ class APIServer:
         self._httpd.verbose = verbose  # type: ignore[attr-defined]
         self._httpd.shutting_down = False  # type: ignore[attr-defined]
         from ..api.crd import DynamicRegistry
+        from .ipalloc import ClusterIPAllocator
 
         self._httpd.crds = DynamicRegistry(store)  # type: ignore[attr-defined]
+        self._httpd.ipalloc = ClusterIPAllocator(store)  # type: ignore[attr-defined]
         if admission == "default":
             from .admission import default_admission_chain
 
