@@ -126,7 +126,57 @@ def _jsonpath_extract(obj, expr: str):
     return " ".join(out)
 
 
+# the category `ktl get all` expands to (kubectl's `all` category)
+ALL_CATEGORY = ("pods", "services", "deployments", "replicasets",
+                "statefulsets", "daemonsets", "jobs", "cronjobs")
+
+
 def cmd_get(client: RESTClient, args) -> int:
+    if args.resource == "all" and not args.name:
+        if getattr(args, "watch", False):
+            raise CLIError("get all does not support --watch")
+        ns = args.namespace or "default"
+        sel = getattr(args, "selector", "") or ""
+        output = args.output
+        if output not in _OUTPUT_MODES and not output.startswith("jsonpath="):
+            raise CLIError(f"unknown output format {output!r}")
+        collected = []
+        for res in ALL_CATEGORY:
+            items, _ = client.list(res, None if args.all_namespaces else ns,
+                                   label_selector=sel)
+            collected.append((res, items))
+        if output == "json":
+            print(json.dumps([o for _r, items in collected for o in items],
+                             indent=2))
+            return 0
+        if output == "yaml":
+            _print_yaml({"items": [o for _r, items in collected for o in items]})
+            return 0
+        if output.startswith("jsonpath="):
+            for _r, items in collected:
+                for o in items:
+                    print(_jsonpath_extract(o, output[len("jsonpath="):]))
+            return 0
+        first = True
+        for res, items in collected:
+            if not items:
+                continue
+            if not first:
+                print()
+            first = False
+            headers, raw_rows = _rows(res, items)
+            # every category member's table starts NAMESPACE, NAME: fold
+            # them into the typed name column kubectl prints for `get all`,
+            # keeping NAMESPACE when -A made it meaningful
+            if args.all_namespaces:
+                rows = [[r[0], f"{res[:-1]}/{o['metadata']['name']}"] + r[2:]
+                        for o, r in zip(items, raw_rows)]
+                print(fmt_table(["NAMESPACE", "NAME"] + headers[2:], rows))
+            else:
+                rows = [[f"{res[:-1]}/{o['metadata']['name']}"] + r[2:]
+                        for o, r in zip(items, raw_rows)]
+                print(fmt_table(["NAME"] + headers[2:], rows))
+        return 0
     resource = resolve_resource(args.resource)
     ns = None if resource in CLUSTER_SCOPED else (args.namespace or "default")
     output = args.output
@@ -350,8 +400,32 @@ def cmd_delete(client: RESTClient, args) -> int:
                 print(f"error: {e}", file=sys.stderr)
                 rc = 1
         return rc
+    if args.resource and getattr(args, "all", False):
+        # kubectl delete RESOURCE --all [-l selector]; a NAME alongside
+        # --all is ambiguous and kubectl rejects it
+        if args.name:
+            print("error: name cannot be provided when --all is specified",
+                  file=sys.stderr)
+            return 1
+        resource = resolve_resource(args.resource)
+        ns = None if resource in CLUSTER_SCOPED else (args.namespace or "default")
+        items, _ = client.list(resource, ns,
+                               label_selector=getattr(args, "selector", "") or "")
+        rc = 0
+        for o in items:
+            ons = o["metadata"].get("namespace") or None
+            try:
+                client.delete(resource, o["metadata"]["name"], ons)
+                print(f"{resource}/{o['metadata']['name']} deleted")
+            except APIError as e:
+                if e.code == 404:
+                    continue  # deleted concurrently: that's the goal anyway
+                print(f"error: {e}", file=sys.stderr)
+                rc = 1
+        return rc
     if not args.resource or not args.name:
-        print("error: delete requires RESOURCE NAME or -f FILE", file=sys.stderr)
+        print("error: delete requires RESOURCE NAME, --all, or -f FILE",
+              file=sys.stderr)
         return 1
     resource = resolve_resource(args.resource)
     ns = None if resource in CLUSTER_SCOPED else (args.namespace or "default")
@@ -1035,6 +1109,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("resource", nargs="?")
     p.add_argument("name", nargs="?")
     p.add_argument("-f", "--filename")
+    p.add_argument("--all", action="store_true")
+    p.add_argument("-l", "--selector", default="")
     p.set_defaults(fn=cmd_delete)
 
     p = sub.add_parser("replace")

@@ -22,17 +22,49 @@ class ClusterIPAllocator:
         # skip the network and broadcast addresses like the reference
         self._base = int(self.network.network_address) + 1
         self._size = self.network.num_addresses - 2
+        self._store = store
         self._lock = threading.Lock()
         self._used: Set[int] = set()
         self._cursor = 0
-        # repair: rebuild from every stored Service (ipallocator/controller)
-        services, _ = store.list("services")
+        # repair: rebuild from every stored Service (ipallocator/controller),
+        # then track the store by WATCH — services die through many paths
+        # (namespace sweep, GC, direct store deletes), not only REST DELETE,
+        # and every one must release its address
+        services, rv = store.list("services")
         for svc in services:
-            ip = svc.spec.cluster_ip
-            if ip and ip != HEADLESS:
+            self._mark(svc.spec.cluster_ip)
+        self._watch = store.watch(kind="services", since_rv=rv)
+
+    def _sync_locked(self) -> None:
+        """Drain service events (caller holds the lock): deletes release,
+        adds/updates mark — covering objects written around the REST layer."""
+        if self._watch.terminated:
+            # evicted slow watcher: full repair + rewatch (reflector contract)
+            self._used.clear()
+            services, rv = self._store.list("services")
+            for svc in services:
+                self._mark(svc.spec.cluster_ip)
+            self._watch = self._store.watch(kind="services", since_rv=rv)
+            return
+        for ev in self._watch.drain():
+            ip = ev.obj.spec.cluster_ip
+            if ev.type == "DELETED":
+                self._release_locked(ip)
+            else:
                 self._mark(ip)
 
-    def _mark(self, ip: str) -> None:
+    def _release_locked(self, ip: Optional[str]) -> None:
+        if not ip or ip == HEADLESS:
+            return
+        try:
+            off = int(ipaddress.ip_address(ip)) - self._base
+        except ValueError:
+            return
+        self._used.discard(off)
+
+    def _mark(self, ip: Optional[str]) -> None:
+        if not ip or ip == HEADLESS:
+            return
         try:
             n = int(ipaddress.ip_address(ip))
         except ValueError:
@@ -45,6 +77,7 @@ class ClusterIPAllocator:
         """-> the assigned IP. Raises ValueError on exhaustion, an
         out-of-range request, or a conflict."""
         with self._lock:
+            self._sync_locked()
             if requested:
                 try:
                     n = int(ipaddress.ip_address(requested))
@@ -71,11 +104,6 @@ class ClusterIPAllocator:
             raise ValueError(f"service CIDR {self.network} exhausted")
 
     def release(self, ip: Optional[str]) -> None:
-        if not ip or ip == HEADLESS:
-            return
         with self._lock:
-            try:
-                off = int(ipaddress.ip_address(ip)) - self._base
-            except ValueError:
-                return
-            self._used.discard(off)
+            self._sync_locked()
+            self._release_locked(ip)

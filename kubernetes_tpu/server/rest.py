@@ -1121,10 +1121,9 @@ class _Handler(BaseHTTPRequestHandler):
                 err = self._admission_verdict(resource, "DELETE", existing, user)
                 if err is None:
                     obj = self.store.delete(resource, key)
-                    if resource == "services":
-                        alloc = getattr(self.server, "ipalloc", None)
-                        if alloc is not None:
-                            alloc.release(obj.spec.cluster_ip)
+                    # services: the allocator releases via its store watch —
+                    # an explicit release here would race a concurrent
+                    # allocate that already drained the DELETED event
                     if resource == "customresourcedefinitions":
                         # CR data dies with its CRD (the reference's
                         # apiextensions finalizer); same transaction so a

@@ -123,3 +123,25 @@ class TestAllocationHardening:
         p.reconcile_once()
         ruleset = p.sync_proxy_rules()
         assert all("None" not in r.cluster_ip for r in ruleset.rules)
+
+
+class TestWatchDrivenRelease:
+    def test_namespace_sweep_releases_addresses(self, server, client):
+        """Services deleted AROUND the REST layer (namespace sweep, GC,
+        direct store deletes) must still release their ClusterIPs."""
+        ip = client.create("services", svc("web"))["spec"]["clusterIP"]
+        server.store.delete("services", "default/web")  # direct store delete
+        # the address is reusable (allocator drains its watch on allocate)
+        out = client.create("services", svc("web2", clusterIP=ip))
+        assert out["spec"]["clusterIP"] == ip
+
+    def test_direct_store_create_marks_address(self, server, client):
+        from kubernetes_tpu.api.networking import Service
+
+        server.store.create("services", Service.from_dict(
+            svc("direct", clusterIP="10.96.0.77")))
+        import pytest as _pytest
+
+        with _pytest.raises(APIError) as e:
+            client.create("services", svc("clash", clusterIP="10.96.0.77"))
+        assert e.value.code == 422

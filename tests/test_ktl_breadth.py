@@ -540,3 +540,60 @@ class TestGetOutputHardening:
         # initial list is a JSON doc; each event is a parseable JSON line
         tail = out[0].strip().splitlines()[-1]
         assert _json.loads(tail)["metadata"]["name"] == "j1"
+
+
+class TestGetAllAndDeleteAll:
+    def test_get_all_category(self, server, client, capsys):
+        client.create("pods", {"metadata": {"name": "p1"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        client.create("deployments", {
+            "kind": "Deployment", "metadata": {"name": "web"},
+            "spec": {"replicas": 1, "selector": {"matchLabels": {"a": "b"}},
+                     "template": {"metadata": {"labels": {"a": "b"}},
+                                  "spec": {"containers": [{"name": "c"}]}}}})
+        assert run(server, "get", "all") == 0
+        out = capsys.readouterr().out
+        assert "pod/p1" in out and "deployment/web" in out
+
+    def test_delete_all_with_selector(self, server, client, capsys):
+        for n, lab in (("a", {"app": "x"}), ("b", {"app": "x"}),
+                       ("keep", {"app": "y"})):
+            client.create("pods", {"metadata": {"name": n, "labels": lab},
+                                   "spec": {"containers": [{"name": "c"}]}})
+        assert run(server, "delete", "pods", "--all", "-l", "app=x") == 0
+        names = {o["metadata"]["name"] for o in client.list("pods")[0]}
+        assert names == {"keep"}
+
+    def test_delete_all_without_selector(self, server, client, capsys):
+        for n in ("a", "b"):
+            client.create("pods", {"metadata": {"name": n},
+                                   "spec": {"containers": [{"name": "c"}]}})
+        assert run(server, "delete", "pods", "--all") == 0
+        assert client.list("pods")[0] == []
+
+
+class TestGetAllHardening:
+    def test_get_all_json_output(self, server, client, capsys):
+        import json as _json
+
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        assert run(server, "get", "all", "-o", "json") == 0
+        items = _json.loads(capsys.readouterr().out)
+        assert any(o["metadata"]["name"] == "p" for o in items)
+
+    def test_get_all_A_keeps_namespace_column(self, server, client, capsys):
+        client.create("namespaces", {"kind": "Namespace",
+                                     "metadata": {"name": "ns2"}})
+        for ns in ("default", "ns2"):
+            client.create("pods", {"metadata": {"name": "web", "namespace": ns},
+                                   "spec": {"containers": [{"name": "c"}]}})
+        assert run(server, "get", "all", "-A") == 0
+        out = capsys.readouterr().out
+        assert "NAMESPACE" in out and "ns2" in out and "default" in out
+
+    def test_delete_name_with_all_rejected(self, server, client, capsys):
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        assert run(server, "delete", "pods", "p", "--all") == 1
+        assert client.get("pods", "p")  # nothing deleted
