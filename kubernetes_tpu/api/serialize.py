@@ -44,6 +44,10 @@ from .policy import (
 from .certificates import CertificateSigningRequest
 from .config import ConfigMap, Secret
 from .crd import CustomResourceDefinition
+from .flowcontrolapi import (
+    FlowSchemaConfiguration,
+    PriorityLevelConfiguration,
+)
 from .dra import DeviceClass, ResourceClaim, ResourceSlice
 from .events import Event as CoreEvent, PodLog
 from .storage import CSINode, PersistentVolume, PersistentVolumeClaim, StorageClass
@@ -92,6 +96,8 @@ KIND_TO_RESOURCE = {
     "Ingress": "ingresses",
     "IngressClass": "ingressclasses",
     "NetworkPolicy": "networkpolicies",
+    "PriorityLevelConfiguration": "prioritylevelconfigurations",
+    "FlowSchema": "flowschemas",
 }
 RESOURCE_TO_TYPE = {
     "pods": Pod,
@@ -128,11 +134,14 @@ RESOURCE_TO_TYPE = {
     "ingresses": Ingress,
     "ingressclasses": IngressClass,
     "networkpolicies": NetworkPolicy,
+    "prioritylevelconfigurations": PriorityLevelConfiguration,
+    "flowschemas": FlowSchemaConfiguration,
 }
 CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses",
                   "csinodes", "resourceslices", "deviceclasses",
                   "priorityclasses", "customresourcedefinitions",
-                  "certificatesigningrequests", "ingressclasses"}
+                  "certificatesigningrequests", "ingressclasses",
+                  "prioritylevelconfigurations", "flowschemas"}
 GROUP_PREFIX = {
     "pods": "/api/v1",
     "nodes": "/api/v1",
@@ -168,6 +177,8 @@ GROUP_PREFIX = {
     "ingresses": "/apis/networking.k8s.io/v1",
     "ingressclasses": "/apis/networking.k8s.io/v1",
     "networkpolicies": "/apis/networking.k8s.io/v1",
+    "prioritylevelconfigurations": "/apis/flowcontrol.apiserver.k8s.io/v1",
+    "flowschemas": "/apis/flowcontrol.apiserver.k8s.io/v1",
 }
 
 

@@ -172,3 +172,94 @@ def default_flow_controller(default_seats: int = 10,
         FlowSchema("catch-all", "global-default"),
     ]
     return FlowController(levels, schemas)
+
+
+# ---- live configuration from API objects --------------------------------------
+#
+# The PriorityLevelConfiguration/FlowSchema API types live in
+# api/flowcontrolapi.py (the serializer cannot import server modules);
+# FlowConfigSource below watches them and rebuilds dispatch on change.
+
+
+class FlowConfigSource:
+    """Watch-driven live APF configuration: when PriorityLevelConfiguration/
+    FlowSchema objects exist in the store, they replace the bootstrap config;
+    when none do, the bootstrap defaults dispatch. Rebuilds preserve nothing
+    across swaps (in-flight requests finish on the old levels — their seats
+    release into objects no longer consulted, which is also how the
+    reference's config changes drain)."""
+
+    KINDS = ("prioritylevelconfigurations", "flowschemas")
+    MANDATORY_SCHEMAS = ("exempt", "system-nodes", "system-components")
+
+    def __init__(self, store, bootstrap: FlowController):
+        self._store = store
+        self._bootstrap = bootstrap
+        self._lock = threading.Lock()
+        self._current = bootstrap
+        self._list_rebuild_rewatch()
+
+    def _list_rebuild_rewatch(self) -> None:
+        # ONE consistent snapshot + watch point: two separate lists would
+        # lose an object committed between them (store.list_many exists for
+        # exactly this race)
+        lists, rv = self._store.list_many(self.KINDS)
+        self._rebuild(lists[self.KINDS[0]], lists[self.KINDS[1]])
+        self._watch = self._store.watch(kind=set(self.KINDS), since_rv=rv)
+
+    def _rebuild(self, levels, schemas) -> None:
+        if not levels or not schemas:
+            self._current = self._bootstrap
+            return
+        try:
+            built_levels = {l.metadata.name: l.to_level() for l in levels}
+            # the MANDATORY bootstrap configuration survives every custom
+            # config (the reference always merges it back): without the
+            # exempt/system levels a saturated custom level would 429 the
+            # control plane — including the DELETE that removes the bad
+            # config. User objects override same-named entries.
+            for name, lvl in self._bootstrap.levels.items():
+                built_levels.setdefault(name, lvl)
+            ordered = sorted(schemas, key=lambda s: s.matching_precedence)
+            built = [s.to_schema() for s in ordered]
+            user_names = {s.name for s in built}
+            mandatory = [s for s in self._bootstrap.schemas
+                         if s.name in self.MANDATORY_SCHEMAS
+                         and s.name not in user_names]
+            built = mandatory + built
+            last = built[-1]
+            if not ("*" in last.verbs and "*" in last.resources
+                    and "*" in last.users and "*" in last.groups):
+                # the synthesized catch-all must land on a LIMITED level —
+                # an arbitrary (possibly Exempt) target would fail open
+                target = next(
+                    (n for n in ("global-default", *built_levels)
+                     if n in built_levels and not built_levels[n].exempt),
+                    None)
+                if target is None:
+                    raise ValueError("no Limited level for the catch-all")
+                built.append(FlowSchema("catch-all", target))
+            self._current = FlowController(list(built_levels.values()), built)
+        except ValueError:
+            # inconsistent objects (schema naming a missing level): keep
+            # serving the previous configuration rather than failing open
+            pass
+
+    def _sync(self) -> None:
+        if self._watch.terminated:
+            self._list_rebuild_rewatch()
+            return
+        events = self._watch.drain()
+        if events:
+            lists, _rv = self._store.list_many(self.KINDS)
+            self._rebuild(lists[self.KINDS[0]], lists[self.KINDS[1]])
+
+    def classify(self, user, verb: str, resource: str) -> PriorityLevel:
+        with self._lock:
+            self._sync()
+            return self._current.classify(user, verb, resource)
+
+    def stats(self):
+        with self._lock:
+            self._sync()
+            return self._current.stats()

@@ -1190,9 +1190,11 @@ class APIServer:
         # APF: None = no flow control (open mode); pass a FlowController
         # (flowcontrol.default_flow_controller()) to seat-limit dispatch
         if flowcontrol == "default":
-            from .flowcontrol import default_flow_controller
+            from .flowcontrol import FlowConfigSource, default_flow_controller
 
-            flowcontrol = default_flow_controller()
+            # live APF: PriorityLevelConfiguration/FlowSchema objects in the
+            # store override the bootstrap defaults on the next request
+            flowcontrol = FlowConfigSource(store, default_flow_controller())
         self._httpd.flowcontrol = flowcontrol  # type: ignore[attr-defined]
         if audit == "default":
             from .audit import AuditLogger

@@ -238,3 +238,138 @@ class TestServerIntegration:
             assert 'priority_level="global-default"' in text
         finally:
             srv.stop()
+
+
+class TestAPFAsAPIObjects:
+    """flowcontrol.apiserver.k8s.io: config objects reconfigure dispatch
+    live; with none present the bootstrap defaults serve."""
+
+    def test_round_trip(self):
+        from kubernetes_tpu.api.flowcontrolapi import (
+            FlowSchemaConfiguration,
+            PriorityLevelConfiguration,
+        )
+
+        plc = PriorityLevelConfiguration.from_dict({
+            "metadata": {"name": "batch"},
+            "spec": {"type": "Limited",
+                     "limited": {"seats": 3, "queueLength": 7,
+                                 "queueTimeoutSeconds": 2.5}}})
+        assert PriorityLevelConfiguration.from_dict(
+            plc.to_dict()).to_dict() == plc.to_dict()
+        lvl = plc.to_level()
+        assert lvl.seats == 3 and lvl.queue_length == 7 and not lvl.exempt
+        fsc = FlowSchemaConfiguration.from_dict({
+            "metadata": {"name": "heavy"},
+            "spec": {"priorityLevelConfiguration": {"name": "batch"},
+                     "matchingPrecedence": 100, "verbs": ["list"]}})
+        assert fsc.to_schema().verbs == ("list",)
+
+    def test_objects_reconfigure_live_server(self):
+        authn = TokenAuthenticator()
+        authn.add("t-u", "alice")
+        srv = APIServer(APIStore(), authenticator=authn,
+                        flowcontrol="default").start()
+        try:
+            alice = RESTClient(srv.url, token="t-u")
+            alice.list("pods")  # bootstrap config serves initially
+            # install a tiny level + schemas via the API
+            alice.create("prioritylevelconfigurations", {
+                "kind": "PriorityLevelConfiguration",
+                "metadata": {"name": "tiny"},
+                "spec": {"type": "Limited",
+                         "limited": {"seats": 1, "queueLength": 0,
+                                     "queueTimeoutSeconds": 0.2}}},
+                namespace=None)
+            alice.create("flowschemas", {
+                "kind": "FlowSchema", "metadata": {"name": "lists"},
+                "spec": {"priorityLevelConfiguration": {"name": "tiny"},
+                         "matchingPrecedence": 10, "verbs": ["list"],
+                         "resources": ["pods"]}}, namespace=None)
+            alice.create("flowschemas", {
+                "kind": "FlowSchema", "metadata": {"name": "catch-all"},
+                "spec": {"priorityLevelConfiguration": {"name": "tiny"},
+                         "matchingPrecedence": 9999}}, namespace=None)
+            fc = srv._httpd.flowcontrol
+            level = fc.classify(None, "list", "pods")
+            assert level.name == "tiny" and level.seats == 1
+            # saturate it: pod lists now 429 while the level is held
+            assert level.acquire()
+            with pytest.raises(APIError) as e:
+                alice.list("pods")
+            assert e.value.code == 429
+            level.release()
+            alice.list("pods")
+            # deleting the config objects falls back to bootstrap
+            alice.delete("flowschemas", "lists", namespace=None)
+            alice.delete("flowschemas", "catch-all", namespace=None)
+            assert fc.classify(None, "list", "pods").name == "global-default"
+        finally:
+            srv.stop()
+
+
+class TestFlowConfigHardening:
+    def test_explicit_zero_queue_length_respected(self):
+        from kubernetes_tpu.api.flowcontrolapi import PriorityLevelConfiguration
+
+        plc = PriorityLevelConfiguration.from_dict({
+            "metadata": {"name": "t"},
+            "spec": {"type": "Limited", "limited": {"seats": 1,
+                                                    "queueLength": 0}}})
+        assert plc.queue_length == 0
+
+    def test_mandatory_bootstrap_survives_custom_config(self):
+        """Custom config must not strip the exempt/system guarantees — the
+        control plane's own traffic never rides a saturated custom level."""
+        from kubernetes_tpu.server.flowcontrol import FlowConfigSource
+
+        store = APIStore()
+        from kubernetes_tpu.api.flowcontrolapi import (
+            FlowSchemaConfiguration,
+            PriorityLevelConfiguration,
+        )
+
+        store.create("prioritylevelconfigurations",
+                     PriorityLevelConfiguration.from_dict({
+                         "metadata": {"name": "tiny"},
+                         "spec": {"type": "Limited",
+                                  "limited": {"seats": 1, "queueLength": 0}}}))
+        store.create("flowschemas", FlowSchemaConfiguration.from_dict({
+            "metadata": {"name": "workload"},
+            "spec": {"priorityLevelConfiguration": {"name": "tiny"},
+                     "matchingPrecedence": 100, "verbs": ["list"]}}))
+        src = FlowConfigSource(store, default_flow_controller())
+        # masters still exempt; nodes still on the system level
+        assert src.classify(user("admin", "system:masters"),
+                            "list", "pods").name == "exempt"
+        assert src.classify(user("n", "system:nodes"),
+                            "update", "pods").name == "system"
+        # the custom schema engages for plain users
+        assert src.classify(user("alice"), "list", "pods").name == "tiny"
+        # synthesized catch-all lands on a LIMITED level, never exempt
+        lvl = src.classify(user("alice"), "create", "pods")
+        assert not lvl.exempt
+
+    def test_exempt_only_custom_config_keeps_previous(self):
+        """A config whose only levels are Exempt cannot host a catch-all:
+        the previous configuration keeps serving (no fail-open)."""
+        from kubernetes_tpu.server.flowcontrol import FlowConfigSource
+        from kubernetes_tpu.api.flowcontrolapi import (
+            FlowSchemaConfiguration,
+            PriorityLevelConfiguration,
+        )
+
+        store = APIStore()
+        src = FlowConfigSource(store, default_flow_controller())
+        store.create("prioritylevelconfigurations",
+                     PriorityLevelConfiguration.from_dict({
+                         "metadata": {"name": "free"},
+                         "spec": {"type": "Exempt"}}))
+        store.create("flowschemas", FlowSchemaConfiguration.from_dict({
+            "metadata": {"name": "exempt"},  # overrides mandatory exempt
+            "spec": {"priorityLevelConfiguration": {"name": "free"},
+                     "verbs": ["list"]}}))
+        # bootstrap levels merge in, so a Limited level still exists and the
+        # config builds; unmatched traffic must land on a non-exempt level
+        lvl = src.classify(user("alice"), "create", "pods")
+        assert not lvl.exempt
