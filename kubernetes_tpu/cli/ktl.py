@@ -547,10 +547,63 @@ def cmd_auth_can_i(client: RESTClient, args) -> int:
 
 
 def cmd_logs(client: RESTClient, args) -> int:
-    """kubectl logs: the pods/{name}/log subresource (text/plain)."""
-    out = client.logs(args.name, args.namespace or "default",
-                      tail_lines=args.tail)
-    sys.stdout.write(out)
+    """kubectl logs [-f]: the pods/{name}/log subresource (text/plain);
+    --follow streams new lines by watching the pod's PodLog channel."""
+    ns = args.namespace or "default"
+    if not getattr(args, "follow", False):
+        sys.stdout.write(client.logs(args.name, ns, tail_lines=args.tail))
+        return 0
+    # follow: ONE snapshot (entries + resourceVersion) anchors both the
+    # initial print and the watch resume — two separate reads would lose
+    # lines appended between them. The cursor is the last printed LINE, not
+    # an index: the channel trims its front at MAX_LINES and resets wholesale
+    # when a same-name pod is recreated, so absolute indexes go stale.
+    try:
+        cur = client.get("podlogs", args.name, ns)
+        entries = cur.get("entries") or []
+        rv = int((cur.get("metadata") or {}).get("resourceVersion", 0) or 0)
+    except APIError as e:
+        if e.code != 404:
+            raise
+        entries, rv = [], -1  # no log yet: stream from now
+    shown = entries[-args.tail:] if args.tail > 0 else entries
+    for line in shown:
+        print(line)
+    last = entries[-1] if entries else None
+    sys.stdout.flush()
+
+    def emit_after(entries, last):
+        if last is not None:
+            for i in range(len(entries) - 1, -1, -1):
+                if entries[i] == last:
+                    new = entries[i + 1:]
+                    break
+            else:
+                new = entries  # anchor trimmed away or stream reset
+        else:
+            new = entries
+        for line in new:
+            print(line)
+        sys.stdout.flush()
+        return entries[-1] if entries else last
+
+    import http.client as _http_client
+
+    try:
+        for etype, obj in client.watch(
+                "podlogs", since_rv=rv, namespace=ns,
+                field_selector=f"metadata.name={args.name}"):
+            if etype == "BOOKMARK":
+                continue
+            if etype == "DELETED":
+                last = None  # pod gone; a recreation starts a fresh stream
+                continue
+            last = emit_after(obj.get("entries") or [], last)
+    except KeyboardInterrupt:
+        pass
+    except (OSError, _http_client.HTTPException):
+        print("error: log stream closed", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -1148,6 +1201,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("logs")
     p.add_argument("name")
     p.add_argument("--tail", type=int, default=0)
+    p.add_argument("-f", "--follow", action="store_true")
     p.set_defaults(fn=cmd_logs)
 
     p = sub.add_parser("scale")

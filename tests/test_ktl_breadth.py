@@ -597,3 +597,102 @@ class TestGetAllHardening:
                                "spec": {"containers": [{"name": "c"}]}})
         assert run(server, "delete", "pods", "p", "--all") == 1
         assert client.get("pods", "p")  # nothing deleted
+
+
+class TestLogsFollow:
+    def test_follow_streams_new_lines(self, server, client):
+        import contextlib
+        import io
+        import threading
+        import time
+
+        from kubernetes_tpu.api.events import append_pod_log
+
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        append_pod_log(server.store, "default", "p", "c", "old-1", 1.0)
+        append_pod_log(server.store, "default", "p", "c", "old-2", 2.0)
+        out = []
+
+        def consume():
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                try:
+                    run(server, "logs", "p", "--tail", "1", "-f")
+                except Exception:
+                    pass
+            out.append(buf.getvalue())
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.4)
+        append_pod_log(server.store, "default", "p", "c", "new-3", 3.0)
+        time.sleep(0.6)
+        server.stop()
+        t.join(timeout=5)
+        text = out[0]
+        # tail showed only old-2; the follow printed exactly the new line
+        assert "old-2" in text and "new-3" in text
+        assert text.count("old-1") == 0
+        assert text.count("new-3") == 1
+
+
+class TestLogsFollowHardening:
+    def _follow(self, server, *extra):
+        import contextlib
+        import io
+        import threading
+
+        out = []
+
+        def consume():
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                try:
+                    run(server, "logs", "p", "-f", *extra)
+                except Exception:
+                    pass
+            out.append(buf.getvalue())
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        return t, out
+
+    def test_follow_survives_trimming_channel(self, server, client):
+        """New lines keep printing after the channel hits MAX_LINES (the
+        front-trim made absolute indexes stall forever)."""
+        import time
+
+        from kubernetes_tpu.api.events import PodLog, append_pod_log
+
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        for i in range(PodLog.MAX_LINES + 5):
+            append_pod_log(server.store, "default", "p", "c", f"l{i}", float(i))
+        t, out = self._follow(server, "--tail", "2")
+        time.sleep(0.4)
+        append_pod_log(server.store, "default", "p", "c", "after-cap", 9e9)
+        time.sleep(0.6)
+        server.stop()
+        t.join(timeout=5)
+        assert "after-cap" in out[0]
+
+    def test_follow_sees_recreated_pod_stream(self, server, client):
+        """A same-name pod's fresh log stream prints from its first line."""
+        import time
+
+        from kubernetes_tpu.api.events import append_pod_log
+
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        append_pod_log(server.store, "default", "p", "c", "old", 1.0,
+                       pod_uid="A")
+        t, out = self._follow(server)
+        time.sleep(0.4)
+        # recreation: append with a NEW pod uid resets the stream
+        append_pod_log(server.store, "default", "p", "c", "fresh-1", 2.0,
+                       pod_uid="B")
+        time.sleep(0.6)
+        server.stop()
+        t.join(timeout=5)
+        assert "old" in out[0] and "fresh-1" in out[0]
