@@ -558,14 +558,22 @@ def cmd_logs(client: RESTClient, args) -> int:
     # lines appended between them. The cursor is the last printed LINE, not
     # an index: the channel trims its front at MAX_LINES and resets wholesale
     # when a same-name pod is recreated, so absolute indexes go stale.
-    try:
-        cur = client.get("podlogs", args.name, ns)
-        entries = cur.get("entries") or []
-        rv = int((cur.get("metadata") or {}).get("resourceVersion", 0) or 0)
-    except APIError as e:
-        if e.code != 404:
-            raise
-        entries, rv = [], -1  # no log yet: stream from now
+    def snapshot():
+        """-> (entries, rv): the channel's content and a watch-resume point.
+        With no channel yet, the COLLECTION rv anchors the watch — "-1 /
+        from now" would drop lines appended before the watcher registers."""
+        try:
+            cur = client.get("podlogs", args.name, ns)
+            return (cur.get("entries") or [],
+                    int((cur.get("metadata") or {}).get("resourceVersion", 0)
+                        or 0))
+        except APIError as e:
+            if e.code != 404:
+                raise
+            _items, rv = client.list("podlogs", ns)
+            return [], rv
+
+    entries, rv = snapshot()
     shown = entries[-args.tail:] if args.tail > 0 else entries
     for line in shown:
         print(line)
@@ -588,23 +596,38 @@ def cmd_logs(client: RESTClient, args) -> int:
         return entries[-1] if entries else last
 
     import http.client as _http_client
+    import urllib.error as _urlerr
 
-    try:
-        for etype, obj in client.watch(
-                "podlogs", since_rv=rv, namespace=ns,
-                field_selector=f"metadata.name={args.name}"):
-            if etype == "BOOKMARK":
+    while True:
+        try:
+            for etype, obj in client.watch(
+                    "podlogs", since_rv=rv, namespace=ns,
+                    field_selector=f"metadata.name={args.name}"):
+                if etype == "BOOKMARK":
+                    rv = int((obj.get("metadata") or {})
+                             .get("resourceVersion", rv) or rv)
+                    continue
+                rv = int((obj.get("metadata") or {})
+                         .get("resourceVersion", rv) or rv)
+                if etype == "DELETED":
+                    last = None  # pod gone; a recreation starts fresh
+                    continue
+                last = emit_after(obj.get("entries") or [], last)
+            return 0  # server ended the stream cleanly
+        except KeyboardInterrupt:
+            return 0
+        except _urlerr.HTTPError as e:
+            if e.code == 410:
+                # reflector contract: the resume point aged out of the watch
+                # history — RELIST (re-anchor on fresh content) and rewatch
+                entries, rv = snapshot()
+                last = emit_after(entries, last)
                 continue
-            if etype == "DELETED":
-                last = None  # pod gone; a recreation starts a fresh stream
-                continue
-            last = emit_after(obj.get("entries") or [], last)
-    except KeyboardInterrupt:
-        pass
-    except (OSError, _http_client.HTTPException):
-        print("error: log stream closed", file=sys.stderr)
-        return 1
-    return 0
+            print("error: log stream closed", file=sys.stderr)
+            return 1
+        except (OSError, _http_client.HTTPException):
+            print("error: log stream closed", file=sys.stderr)
+            return 1
 
 
 def cmd_explain(client: RESTClient, args) -> int:

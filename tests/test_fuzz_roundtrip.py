@@ -135,3 +135,66 @@ def test_resourceclaim_roundtrip_stable(doc):
                   ["PreemptLowerPriority", "Never"])}))
 def test_priorityclass_roundtrip_stable(doc):
     _stable("priorityclasses", doc)
+
+
+# ---- label-selector grammar + index-compression properties --------------------
+
+_key_st = st.text(alphabet=string.ascii_lowercase + string.digits + "-._/",
+                  min_size=1, max_size=12).filter(
+    lambda s: not s.startswith(("-", ".", "/")))
+_val_st = st.text(alphabet=string.ascii_lowercase + string.digits,
+                  min_size=1, max_size=8)
+
+
+@st.composite
+def _selector_clause(draw):
+    kind = draw(st.sampled_from(["eq", "ne", "in", "notin", "exists", "nexists"]))
+    k = draw(_key_st)
+    if kind == "eq":
+        return f"{k}={draw(_val_st)}"
+    if kind == "ne":
+        return f"{k}!={draw(_val_st)}"
+    if kind == "in":
+        vals = draw(st.lists(_val_st, min_size=1, max_size=3))
+        return f"{k} in ({','.join(vals)})"
+    if kind == "notin":
+        vals = draw(st.lists(_val_st, min_size=1, max_size=3))
+        return f"{k} notin ({','.join(vals)})"
+    if kind == "exists":
+        return k
+    return f"!{k}"
+
+
+@settings(max_examples=150, deadline=None)
+@given(st.lists(_selector_clause(), min_size=1, max_size=4),
+       st.dictionaries(_key_st, _val_st, max_size=4))
+def test_selector_grammar_parses_and_matches_consistently(clauses, labels):
+    """Every grammatical selector parses, and matching equals the AND of its
+    clauses evaluated through the same Requirement machinery."""
+    from kubernetes_tpu.api.labels import parse_selector_string
+
+    raw = ",".join(clauses)
+    sel = parse_selector_string(raw)
+    assert len(sel.requirements) == len(clauses)
+    expect = all(r.matches(labels) for r in sel.requirements)
+    assert sel.matches(labels) == expect
+
+
+@settings(max_examples=200, deadline=None)
+@given(st.sets(st.integers(min_value=0, max_value=200), max_size=40))
+def test_compress_indexes_round_trips(indexes):
+    """completedIndexes compression is lossless: expanding the ranges gives
+    back exactly the input set."""
+    from kubernetes_tpu.controllers.job import compress_indexes
+
+    out = compress_indexes(indexes)
+    expanded = set()
+    for part in out.split(","):
+        if not part:
+            continue
+        if "-" in part:
+            lo, hi = part.split("-")
+            expanded.update(range(int(lo), int(hi) + 1))
+        else:
+            expanded.add(int(part))
+    assert expanded == set(indexes)

@@ -696,3 +696,48 @@ class TestLogsFollowHardening:
         server.stop()
         t.join(timeout=5)
         assert "old" in out[0] and "fresh-1" in out[0]
+
+
+class TestLogsFollowRelist:
+    def test_follow_survives_410_expired(self, server, client):
+        """An aged-out resume point must relist + rewatch, not die with
+        'log stream closed' (the reflector contract)."""
+        import contextlib
+        import io
+        import threading
+        import time
+
+        from kubernetes_tpu.api.events import append_pod_log
+
+        client.create("pods", {"metadata": {"name": "p"},
+                               "spec": {"containers": [{"name": "c"}]}})
+        append_pod_log(server.store, "default", "p", "c", "early", 1.0)
+        # age the history past the floor so the snapshot rv 410s
+        server.store._history_limit = 50
+        for i in range(200):
+            client.create("configmaps", {"kind": "ConfigMap",
+                                         "metadata": {"name": f"noise-{i}"},
+                                         "data": {"k": "v"}})
+        out = []
+
+        def consume():
+            buf = io.StringIO()
+            err = io.StringIO()
+            with contextlib.redirect_stdout(buf), \
+                    contextlib.redirect_stderr(err):
+                try:
+                    run(server, "logs", "p", "-f")
+                except Exception:
+                    pass
+            out.append((buf.getvalue(), err.getvalue()))
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.5)
+        append_pod_log(server.store, "default", "p", "c", "post-expiry", 2.0)
+        time.sleep(0.6)
+        server.stop()
+        t.join(timeout=5)
+        stdout, stderr = out[0]
+        assert "early" in stdout and "post-expiry" in stdout
+        assert "log stream closed" not in stderr
