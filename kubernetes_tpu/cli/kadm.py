@@ -104,10 +104,14 @@ class JoinedNode:
 
     def __init__(self, client: RESTClient, node_name: str,
                  capacity: Dict[str, str], heartbeat: float = 2.0,
-                 credential_refresher=None):
+                 credential_refresher=None,
+                 labels: Optional[Dict[str, str]] = None):
         self.client = client
         self.node_name = node_name
         self.capacity = dict(capacity)
+        # extra node labels (topology zone/region etc.) applied at
+        # registration — kubelet's --node-labels
+        self.labels = dict(labels or {})
         self.heartbeat = heartbeat
         # () -> new bearer token; called when the current credential expires
         # (the kubelet's client-cert rotation analog)
@@ -122,13 +126,21 @@ class JoinedNode:
             self.client.create("nodes", {
                 "kind": "Node",
                 "metadata": {"name": self.node_name,
-                             "labels": {"kubernetes.io/hostname": self.node_name}},
+                             "labels": {"kubernetes.io/hostname": self.node_name,
+                                        **self.labels}},
                 "status": {"capacity": self.capacity,
                            "allocatable": self.capacity},
             })
         except APIError as e:
             if e.code != 409:
                 raise
+            # node exists (re-join / restart): reconcile labels onto it —
+            # the kubelet re-applies --node-labels at every registration
+            if self.labels:
+                self.client.patch("nodes", self.node_name, {
+                    "metadata": {"labels": {
+                        "kubernetes.io/hostname": self.node_name,
+                        **self.labels}}}, None)
         self._renew_lease()
 
     def _renew_lease(self) -> None:
@@ -302,7 +314,8 @@ def bootstrap_node_credential(server_url: str, node_name: str,
 def join_node(server_url: str, node_name: str,
               capacity: Optional[Dict[str, str]] = None,
               token: Optional[str] = None,
-              bootstrap: bool = False) -> JoinedNode:
+              bootstrap: bool = False,
+              labels: Optional[Dict[str, str]] = None) -> JoinedNode:
     """kubeadm join equivalent (library surface). With bootstrap=True the
     token is treated as a bootstrap token: the node first trades it for its
     own signed system:node:<name> credential via the CSR flow, so
@@ -318,7 +331,7 @@ def join_node(server_url: str, node_name: str,
     client = RESTClient(server_url, token=token)
     return JoinedNode(client, node_name,
                       capacity or {"cpu": "8", "memory": "16Gi", "pods": "110"},
-                      credential_refresher=refresher).start()
+                      credential_refresher=refresher, labels=labels).start()
 
 
 # -- CLI -----------------------------------------------------------------------
@@ -359,11 +372,24 @@ def cmd_init(args) -> int:
 
 
 def cmd_join(args) -> int:
+    labels = {}
+    for pair in (args.node_labels.split(",") if args.node_labels else []):
+        pair = pair.strip()
+        if not pair:
+            continue
+        k, eq, v = pair.partition("=")
+        k = k.strip()
+        if not eq or not k:
+            print(f"error: malformed --node-labels entry {pair!r} "
+                  "(want key=value)", file=sys.stderr)
+            return 1
+        labels[k] = v.strip()
     node = join_node(args.server, args.node_name,
                      capacity={"cpu": args.cpu, "memory": args.memory,
                                "pods": str(args.max_pods)},
                      token=args.token or None,
-                     bootstrap=args.bootstrap)
+                     bootstrap=args.bootstrap,
+                     labels=labels)
     print(f"node {args.node_name} joined {args.server}")
     try:
         while True:
@@ -392,6 +418,9 @@ def main(argv=None) -> int:
     p.add_argument("--bootstrap", action="store_true",
                    help="treat --token as a bootstrap token: run the CSR "
                         "flow and join with the issued node credential")
+    p.add_argument("--node-labels", default="",
+                   help="k=v[,k2=v2] labels applied at registration "
+                        "(kubelet --node-labels)")
     p.add_argument("--cpu", default="8")
     p.add_argument("--memory", default="16Gi")
     p.add_argument("--max-pods", type=int, default=110)

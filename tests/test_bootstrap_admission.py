@@ -219,3 +219,85 @@ class TestNewControllers:
         ev.obj.metadata.labels["oops"] = "mutated"
         with pytest.raises(MutationDetectedError):
             store.check_mutations()
+
+
+class TestJoinNodeLabels:
+    def test_labels_applied_and_schedulable(self):
+        """kadm join --node-labels: topology labels land on the Node and a
+        selector-bound pod schedules onto it."""
+        from kubernetes_tpu.cli.kadm import init_control_plane, join_node
+
+        res = init_control_plane(use_batch_scheduler=False)
+        try:
+            assert res.wait_ready(30)
+            node = join_node(res.url, "lab-n1",
+                             labels={"topology.kubernetes.io/zone": "z1",
+                                     "tpu.dev/pool": "v5e"})
+            try:
+                client = RESTClient(res.url)
+                got = client.get("nodes", "lab-n1", namespace=None)
+                labels = got["metadata"]["labels"]
+                assert labels["topology.kubernetes.io/zone"] == "z1"
+                assert labels["tpu.dev/pool"] == "v5e"
+                assert labels["kubernetes.io/hostname"] == "lab-n1"
+                client.create("pods", {
+                    "metadata": {"name": "pinned"},
+                    "spec": {"nodeSelector": {"tpu.dev/pool": "v5e"},
+                             "containers": [{"name": "c", "resources": {
+                                 "requests": {"cpu": "100m"}}}]}})
+                assert _wait(lambda: client.get("pods", "pinned")["spec"]
+                             .get("nodeName") == "lab-n1", 20)
+            finally:
+                node.stop()
+        finally:
+            res.stop()
+
+    def test_cli_parses_node_labels(self):
+        """--node-labels k=v,k2=v2 parses into the label dict."""
+        import kubernetes_tpu.cli.kadm as kadm
+
+        captured = {}
+
+        def fake_join(server, name, capacity=None, token=None,
+                      bootstrap=False, labels=None):
+            captured.update(labels or {})
+            raise KeyboardInterrupt  # exit cmd_join's wait loop immediately
+
+        orig = kadm.join_node
+        kadm.join_node = fake_join
+        try:
+            try:
+                kadm.main(["join", "--server", "http://x", "--node-name", "n",
+                           "--node-labels", "a=1,b=2"])
+            except KeyboardInterrupt:
+                pass
+        finally:
+            kadm.join_node = orig
+        assert captured == {"a": "1", "b": "2"}
+
+    def test_rejoin_reconciles_labels(self):
+        """A re-join (node already exists) must still land new labels."""
+        from kubernetes_tpu.cli.kadm import init_control_plane, join_node
+
+        res = init_control_plane(use_batch_scheduler=False)
+        try:
+            assert res.wait_ready(30)
+            n1 = join_node(res.url, "rn", labels={"old": "1"})
+            n1.stop()
+            n2 = join_node(res.url, "rn", labels={"tpu.dev/pool": "v5e"})
+            try:
+                client = RESTClient(res.url)
+                labels = client.get("nodes", "rn",
+                                    namespace=None)["metadata"]["labels"]
+                assert labels["tpu.dev/pool"] == "v5e"
+            finally:
+                n2.stop()
+        finally:
+            res.stop()
+
+    def test_malformed_node_labels_rejected(self):
+        import kubernetes_tpu.cli.kadm as kadm
+
+        rc = kadm.main(["join", "--server", "http://x", "--node-name", "n",
+                        "--node-labels", "novalue"])
+        assert rc == 1
