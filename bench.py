@@ -13,6 +13,10 @@ the weakest rung.
 
 Robustness (the round-2 rc=124 failure mode):
   - fails FAST (<=60s) with a recorded error when the TPU backend is down,
+  - on device failure, RE-EXECS itself with JAX_PLATFORMS=cpu and runs the
+    FULL ladder on the host platform (labeled "platform": "cpu") — a TPU
+    outage degrades the numbers' hardware, never their existence (the
+    round-4 blackout: BENCH_r04.json recorded nothing but the error),
   - checkpoints partial results to BENCH_partial.json after every rung,
   - skips remaining rungs once the global wall-clock budget is spent, so a
     slow chip degrades coverage instead of producing nothing.
@@ -56,6 +60,12 @@ def ensure_device_alive(timeout_s: float = 60.0) -> str:
     """Fail fast when the backend can't run a trivial op. Returns the platform
     name or raises RuntimeError after timeout_s."""
     import threading
+
+    if os.environ.get("BENCH_FORCE_DEVICE_FAIL", "") not in ("", "0"):
+        # test hook for the cpu_fallback path (cleared for the child so the
+        # fallback run itself can come up on the host platform)
+        os.environ.pop("BENCH_FORCE_DEVICE_FAIL")
+        raise RuntimeError("device backend unresponsive (forced by test hook)")
 
     out = {}
 
@@ -667,19 +677,51 @@ RUNGS = [
 ]
 
 
+def cpu_fallback(reason: str) -> int:
+    """The device backend is unresponsive: run the full-shape ladder on the
+    host platform in a CLEAN child process (this process's jax backend init
+    may be wedged mid-handshake with the dead device) and pass its output
+    through. The child's JSON is labeled platform=cpu + fallback_reason so a
+    CPU number can never masquerade as a TPU number."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CPU_FALLBACK"] = "1"
+    env["BENCH_FALLBACK_REASON"] = reason
+    # hand the child only the budget we actually have left (no grow-floor: a
+    # nearly-spent budget means the child skips rungs and still emits its
+    # JSON line fast, instead of wedging past an outer deadline)
+    env["BENCH_BUDGET_S"] = str(max(0.0, budget_left() - 30.0))
+    print(f"device backend down ({reason}); rerunning FULL ladder on cpu",
+          file=sys.stderr)
+    # child INHERITS stdout: its JSON streams out the moment it prints, so an
+    # outer kill of this parent can't strand a fully-written result in a pipe
+    proc = subprocess.run([sys.executable, os.path.abspath(__file__)], env=env)
+    return proc.returncode
+
+
 def main():
     results = {}
+    in_fallback = os.environ.get("BENCH_CPU_FALLBACK", "") not in ("", "0")
     try:
         platform = ensure_device_alive(timeout_s=60.0)
         print(f"device backend alive: {platform}", file=sys.stderr)
     except RuntimeError as e:
+        if not in_fallback and os.environ.get("JAX_PLATFORMS", "") != "cpu":
+            sys.exit(cpu_fallback(str(e)))
         results["device"] = {"error": str(e)}
         checkpoint(results)
-        print(json.dumps({
+        out = {
             "metric": "scheduling_throughput_5000nodes_10000pods",
             "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
-            "error": str(e), "workloads": results,
-        }))
+            "error": str(e), "platform": "none", "workloads": results,
+        }
+        if in_fallback:
+            # total failure (TPU down AND the cpu fallback child failed too):
+            # keep the original outage reason distinguishable
+            out["fallback_reason"] = os.environ.get("BENCH_FALLBACK_REASON", "")
+        print(json.dumps(out))
         return
 
     for name, rung in RUNGS:
@@ -696,14 +738,18 @@ def main():
 
     ratios = [w["vs_baseline"] for w in results.values() if "vs_baseline" in w]
     headline = results.get("SchedulingBasic", {})
-    print(json.dumps({
+    out = {
         "metric": "scheduling_throughput_5000nodes_10000pods",
         "value": headline.get("pods_per_sec", 0.0),
         "unit": "pods/s",
         "vs_baseline": headline.get("vs_baseline", 0.0),
         "min_vs_baseline": min(ratios) if ratios else 0.0,
+        "platform": platform,
         "workloads": results,
-    }))
+    }
+    if in_fallback:
+        out["fallback_reason"] = os.environ.get("BENCH_FALLBACK_REASON", "")
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
