@@ -61,6 +61,9 @@ class ObjectMeta:
     deletion_timestamp: Optional[float] = None
     owner_references: List[Dict[str, Any]] = field(default_factory=list)
     finalizers: List[str] = field(default_factory=list)
+    # server-side-apply field ownership (raw wire entries: manager,
+    # operation, fieldsType, fieldsV1) — maintained by server/fieldmanager.py
+    managed_fields: List[Dict[str, Any]] = field(default_factory=list)
 
     @staticmethod
     def from_dict(d: Mapping) -> "ObjectMeta":
@@ -76,6 +79,7 @@ class ObjectMeta:
             deletion_timestamp=d.get("deletionTimestamp"),
             owner_references=list(d.get("ownerReferences") or []),
             finalizers=list(d.get("finalizers") or []),
+            managed_fields=list(d.get("managedFields") or []),
         )
 
     def to_dict(self) -> Dict[str, Any]:
@@ -99,6 +103,8 @@ class ObjectMeta:
             d["ownerReferences"] = self.owner_references
         if self.finalizers:
             d["finalizers"] = self.finalizers
+        if self.managed_fields:
+            d["managedFields"] = self.managed_fields
         return d
 
 

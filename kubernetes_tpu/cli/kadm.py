@@ -282,7 +282,7 @@ def bootstrap_node_credential(server_url: str, node_name: str,
     a CSR for the system:node:<name> identity, wait for the approve+sign
     controllers, return the issued credential. reference: kubeadm join's
     bootstrap flow + pkg/kubelet/certificate/bootstrap."""
-    client = RESTClient(server_url, token=bootstrap_token)
+    client = RESTClient(server_url, token=bootstrap_token, user_agent="kadm")
     # generated name (the kubelet's csr-<rand> convention): every join or
     # renewal files a FRESH request, so a stale issued credential on an old
     # CSR can never be handed back; the cleaner GCs the leftovers
@@ -328,7 +328,7 @@ def join_node(server_url: str, node_name: str,
         token = bootstrap_node_credential(server_url, node_name, bootstrap_token)
         refresher = lambda: bootstrap_node_credential(  # noqa: E731
             server_url, node_name, bootstrap_token)
-    client = RESTClient(server_url, token=token)
+    client = RESTClient(server_url, token=token, user_agent="kadm")
     return JoinedNode(client, node_name,
                       capacity or {"cpu": "8", "memory": "16Gi", "pods": "110"},
                       credential_refresher=refresher, labels=labels).start()

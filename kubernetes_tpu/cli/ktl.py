@@ -363,17 +363,15 @@ def cmd_apply(client: RESTClient, args) -> int:
         ns = args.namespace or meta.get("namespace") or "default"
         ns_arg = None if resource in CLUSTER_SCOPED else ns
         try:
-            try:
-                # apply = server-side merge PATCH of the manifest (kubectl
-                # apply's patch path; reference handlers/patch.go) — no
-                # read-modify-write race, unspecified fields are preserved
-                client.patch(resource, meta["name"], doc, ns_arg)
-                print(f"{resource}/{meta['name']} configured")
-            except APIError as e:
-                if e.code != 404:
-                    raise
-                client.create(resource, doc, ns_arg)
-                print(f"{resource}/{meta['name']} created")
+            # apply = SERVER-SIDE APPLY (kubectl apply --server-side;
+            # handlers/patch.go applyPatcher): the manifest is this
+            # manager's full intent — fields it stops mentioning are
+            # removed, fields owned by other managers conflict (409)
+            # unless --force-conflicts steals them
+            client.apply(resource, meta["name"], doc, ns_arg,
+                         field_manager=args.field_manager,
+                         force=args.force_conflicts)
+            print(f"{resource}/{meta['name']} serverside-applied")
         except APIError as e:
             print(f"error: {e}", file=sys.stderr)
             rc = 1
@@ -1179,6 +1177,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     p = sub.add_parser("apply")
     p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--field-manager", default="ktl")
+    p.add_argument("--force-conflicts", action="store_true")
     p.set_defaults(fn=cmd_apply)
 
     p = sub.add_parser("delete")
@@ -1301,7 +1301,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     token = args.token or cfg_token
     if args.namespace is None and cfg_ns:
         args.namespace = cfg_ns
-    client = RESTClient(server, token=token)
+    client = RESTClient(server, token=token, user_agent="ktl")
     try:
         return args.fn(client, args)
     except (APIError, CLIError) as e:

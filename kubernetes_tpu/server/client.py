@@ -22,13 +22,17 @@ class APIError(Exception):
 
 class RESTClient:
     def __init__(self, base_url: str, timeout: float = 10.0,
-                 token: Optional[str] = None, user: Optional[str] = None):
+                 token: Optional[str] = None, user: Optional[str] = None,
+                 user_agent: str = ""):
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         # token -> Authorization: Bearer (the secured path); user -> the
         # X-Remote-User convention honored by servers without an authenticator
         self.token = token
         self.user = user
+        # first token doubles as the default field manager for writes
+        # (the server's managedfields default chain reads User-Agent)
+        self.user_agent = user_agent
         # plural/alias -> {"prefix", "namespaced"} for CRD-served resources,
         # filled lazily from GET /apis (the reference's discovery client)
         self._dynamic: Dict[str, Dict[str, Any]] = {}
@@ -54,6 +58,8 @@ class RESTClient:
 
     def _headers(self) -> Dict[str, str]:
         h = {"Content-Type": "application/json"}
+        if self.user_agent:
+            h["User-Agent"] = self.user_agent
         if self.token:
             h["Authorization"] = f"Bearer {self.token}"
         elif self.user:
@@ -159,6 +165,21 @@ class RESTClient:
         """PATCH (merge semantics) — reference: handlers/patch.go."""
         return self.request("PATCH", self._path(resource, namespace, name),
                             patch, content_type=patch_type)
+
+    def apply(self, resource: str, name: str, obj_dict: Dict,
+              namespace: Optional[str] = "default",
+              field_manager: str = "ktl", force: bool = False) -> Dict:
+        """Server-side apply (handlers/patch.go:432): PATCH with the
+        apply-patch content type; 409 Conflict lists owning managers unless
+        force steals the fields."""
+        from urllib.parse import quote
+
+        path = (self._path(resource, namespace, name)
+                + f"?fieldManager={quote(field_manager)}")
+        if force:
+            path += "&force=true"
+        return self.request("PATCH", path, obj_dict,
+                            content_type="application/apply-patch+yaml")
 
     def update_status(self, resource: str, obj_dict: Dict,
                       namespace: Optional[str] = None) -> Dict:

@@ -449,6 +449,31 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length else b"{}"
         return json.loads(raw or b"{}")
 
+    def _read_body_yaml(self):
+        """apply-patch bodies are YAML per the reference content type
+        (application/apply-patch+yaml); JSON is a YAML subset."""
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        raw = self.rfile.read(length) if length else b"{}"
+        try:
+            return json.loads(raw or b"{}")
+        except json.JSONDecodeError:
+            import yaml
+
+            return yaml.safe_load(raw.decode())
+
+    def _field_manager(self, user) -> str:
+        """Manager identity for field ownership: the fieldManager query param,
+        else the User-Agent's first token, else the username (the reference's
+        managedfields default chain)."""
+        qs = parse_qs(urlparse(self.path).query)
+        manager = (qs.get("fieldManager") or [""])[0]
+        if manager:
+            return manager
+        ua = (self.headers.get("User-Agent") or "").split("/")[0].split()[0:1]
+        if ua and ua[0]:
+            return ua[0]
+        return user.name if user is not None else "unknown"
+
     # ---- GET: get / list / watch / health / metrics --------------------------
 
     def do_GET(self):
@@ -846,6 +871,12 @@ class _Handler(BaseHTTPRequestHandler):
                 except ValueError as e:
                     self._error(422, str(e), "Invalid")
                     return
+        # the creating manager owns every field it sent (recomputed
+        # server-side — a client-supplied managedFields stanza is ignored)
+        from .fieldmanager import capture_update
+
+        obj.metadata.managed_fields = capture_update(
+            None, to_dict(obj), self._field_manager(user))
         # admission + create under one store transaction: concurrent creates
         # cannot both pass a quota check they jointly exceed. The verdict is
         # buffered and the HTTP response written AFTER the lock is released —
@@ -1011,6 +1042,16 @@ class _Handler(BaseHTTPRequestHandler):
                 err = self._admission_verdict(resource, "UPDATE", obj, user)
             if err is None:
                 try:
+                    # fields this PUT changes move to the writing manager
+                    # (fieldmanager.go:68); the body can't forge ownership —
+                    # it is recomputed from the live diff
+                    from .fieldmanager import capture_update
+
+                    existing = self.store.get(
+                        resource, self._key(resource, ns, name, crd))
+                    obj.metadata.managed_fields = capture_update(
+                        to_dict(existing), to_dict(obj),
+                        self._field_manager(user))
                     updated = self.store.update(resource, obj)
                 except NotFoundError as e:
                     err = (404, str(e), "NotFound")
@@ -1039,6 +1080,11 @@ class _Handler(BaseHTTPRequestHandler):
         if user is None:
             return
         ctype = (self.headers.get("Content-Type") or "").split(";")[0].strip()
+        if ctype == "application/apply-patch+yaml":
+            # server-side apply rides PATCH with its own content type
+            # (handlers/patch.go:432 applyPatcher)
+            self._apply_ssa(resource, ns, name, sub, crd, user)
+            return
         if ctype not in ("application/merge-patch+json",
                         "application/strategic-merge-patch+json",
                         "application/json", ""):
@@ -1060,6 +1106,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(400, "body must be a JSON object", "BadRequest")
                 return
             patch = {"status": patch.get("status", {})}
+        if isinstance(patch, dict) and isinstance(patch.get("metadata"), dict):
+            # managedFields are server-managed; a patch can't forge them
+            patch["metadata"].pop("managedFields", None)
         key = self._key(resource, ns, name, crd)
         err = None
         updated = None
@@ -1080,6 +1129,13 @@ class _Handler(BaseHTTPRequestHandler):
                 # patch is read-modify-write of the current object: carry its
                 # RV so a concurrent writer between our get and update conflicts
                 obj.metadata.resource_version = existing.metadata.resource_version
+                # changed fields move to the patching manager
+                # (managedfields/fieldmanager.go:68 Update semantics)
+                from .fieldmanager import capture_update
+
+                obj.metadata.managed_fields = capture_update(
+                    to_dict(existing), to_dict(obj),
+                    self._field_manager(user))
                 err = self._admission_verdict(resource, "UPDATE", obj, user)
                 if err is None:
                     updated = self.store.update(resource, obj)
@@ -1095,6 +1151,96 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(*err)
             return
         self._send_json(200, to_dict(updated))
+
+    def _apply_ssa(self, resource, ns, name, sub, crd, user):
+        """Server-side apply (handlers/patch.go:432 applyPatcher +
+        managedfields/fieldmanager.go:96): merge the applied configuration
+        into the live object under field ownership; 409 lists every
+        conflicting (manager, field) unless force=true steals them; absent
+        fields this manager previously applied are pruned; create-on-absent."""
+        if sub:
+            self._error(400, "apply is not supported on subresources",
+                        "BadRequest")
+            return
+        qs = parse_qs(urlparse(self.path).query)
+        manager = (qs.get("fieldManager") or [""])[0]
+        if not manager:
+            # the reference hard-requires an explicit manager for apply
+            self._error(400, "fieldManager is required for apply requests",
+                        "BadRequest")
+            return
+        force = (qs.get("force") or ["false"])[0].lower() in ("true", "1")
+        if not self._known(resource, crd):
+            self._error(404, f"unknown resource {resource}")
+            return
+        try:
+            applied = self._read_body_yaml()
+        except Exception as e:
+            self._error(400, f"invalid apply body: {e}", "BadRequest")
+            return
+        if not isinstance(applied, dict) or not isinstance(
+                applied.get("metadata", {}), dict):
+            self._error(400, "body must be an object with object metadata",
+                        "BadRequest")
+            return
+        from .fieldmanager import Conflict, apply_patch
+
+        applied.setdefault("metadata", {})["name"] = name
+        if ns and not self._cluster_scoped(resource, crd):
+            applied["metadata"]["namespace"] = ns
+        applied["metadata"].pop("managedFields", None)
+        # status is reset on main-resource apply (the strategy's resetFields)
+        applied.pop("status", None)
+        err = None
+        result = None
+        created = False
+        with self.store.transaction():
+            try:
+                key = self._key(resource, ns, name, crd)
+                try:
+                    existing = self.store.get(resource, key)
+                except NotFoundError:
+                    existing = None
+                live = to_dict(existing) if existing is not None else None
+                try:
+                    merged = apply_patch(live, applied, manager, force=force)
+                except Conflict as e:
+                    raise _PatchParseError((409, str(e), "Conflict"))
+                obj, perr = self._parse_obj(resource, merged, crd)
+                if perr is None and resource == "customresourcedefinitions":
+                    perr = self._crd_conflict(obj)
+                elif perr is None and crd is not None:
+                    perr = self._crd_still_served(crd)
+                if perr is not None:
+                    raise _PatchParseError(perr)
+                obj.metadata.name = name
+                if ns and not self._cluster_scoped(resource, crd):
+                    obj.metadata.namespace = ns
+                if existing is not None:
+                    obj.metadata.resource_version = \
+                        existing.metadata.resource_version
+                    err = self._admission_verdict(resource, "UPDATE", obj, user)
+                    if err is None:
+                        result = self.store.update(resource, obj)
+                else:
+                    err = self._admission_verdict(resource, "CREATE", obj, user)
+                    if err is None:
+                        result = self.store.create(resource, obj)
+                        created = True
+            except NotFoundError as e:
+                err = (404, str(e), "NotFound")
+            except ConflictError as e:
+                err = (409, str(e), "Conflict")
+            except AlreadyExistsError as e:
+                err = (409, str(e), "AlreadyExists")
+            except _PatchParseError as e:
+                err = e.verdict
+            except Exception as e:
+                err = (400, f"cannot apply: {e}", "Invalid")
+        if err is not None:
+            self._error(*err)
+            return
+        self._send_json(201 if created else 200, to_dict(result))
 
     def do_DELETE(self):
         parsed = _parse_path(urlparse(self.path).path)

@@ -136,15 +136,17 @@ class TestPatch:
             buf = io.StringIO()
             with redirect_stdout(buf):
                 assert ktl_main(["--server", srv.url, "apply", "-f", str(manifest)]) == 0
-            assert "created" in buf.getvalue()
-            # second apply with a label: patched, spec preserved
+            assert "serverside-applied" in buf.getvalue()
+            # second apply restates the manager's FULL intent (SSA: fields
+            # the manifest stops mentioning would be removed) + a new label
             manifest.write_text(_json.dumps({
                 "kind": "Pod", "metadata": {"name": "ap", "namespace": "default",
-                                            "labels": {"v": "2"}}}))
+                                            "labels": {"v": "2"}},
+                "spec": {"containers": [{"name": "c0"}]}}))
             buf = io.StringIO()
             with redirect_stdout(buf):
                 assert ktl_main(["--server", srv.url, "apply", "-f", str(manifest)]) == 0
-            assert "configured" in buf.getvalue()
+            assert "serverside-applied" in buf.getvalue()
             got = store.get("pods", "default/ap")
             assert got.metadata.labels["v"] == "2"
             assert got.spec.containers[0].name == "c0"

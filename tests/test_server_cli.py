@@ -137,11 +137,11 @@ class TestCLI:
         }
         manifest.write_text(json.dumps(doc))
         rc, out = self.run(server, "apply", "-f", str(manifest))
-        assert rc == 0 and "created" in out
+        assert rc == 0 and "serverside-applied" in out
         doc["spec"]["replicas"] = 5
         manifest.write_text(json.dumps(doc))
         rc, out = self.run(server, "apply", "-f", str(manifest))
-        assert rc == 0 and "configured" in out
+        assert rc == 0 and "serverside-applied" in out
         rc, out = self.run(server, "get", "rs", "web", "-o", "json")
         assert json.loads(out)["spec"]["replicas"] == 5
 

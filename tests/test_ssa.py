@@ -1,0 +1,385 @@
+"""Server-side apply: field ownership, conflicts, force, removal-on-absence.
+
+Pins the reference contract of managedfields/fieldmanager.go (Apply :96,
+Update :68) + structured-merge-diff merge semantics:
+  - two managers fight over one field -> 409 listing the owner; force=true
+    steals ownership and the loser's managedFields entry drops the field
+  - same value applied by two managers -> co-ownership, no conflict
+  - a manager re-applying without a previously-applied field removes it
+    (unless someone else co-owns it)
+  - a PUT/merge-PATCH moves the changed fields to the updating manager
+  - keyed lists (containers by name) merge associatively
+  - managedFields round-trip the wire and cannot be forged by clients
+"""
+
+import json
+
+import pytest
+
+from kubernetes_tpu.server.fieldmanager import (
+    Conflict,
+    apply_patch,
+    capture_update,
+    fields_of,
+    from_fields_v1,
+    to_fields_v1,
+)
+
+
+def deploy(replicas=1, image="app:v1", manager_extra=None):
+    d = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "web", "namespace": "default",
+                     "labels": {"app": "web"}},
+        "spec": {
+            "replicas": replicas,
+            "template": {"spec": {"containers": [
+                {"name": "main", "image": image}]}},
+        },
+    }
+    if manager_extra:
+        d.update(manager_extra)
+    return d
+
+
+class TestFieldSets:
+    def test_leaves_and_maps(self):
+        s = fields_of(deploy())
+        assert (("f", "spec"), ("f", "replicas")) in s
+        assert (("f", "metadata"), ("f", "labels"), ("f", "app")) in s
+
+    def test_identity_fields_excluded(self):
+        s = fields_of({"apiVersion": "v1", "kind": "Pod",
+                       "metadata": {"name": "p", "namespace": "ns",
+                                    "resourceVersion": 5},
+                       "status": {"phase": "Running"}})
+        assert s == frozenset()
+
+    def test_keyed_list_items(self):
+        s = fields_of(deploy())
+        item = (("f", "spec"), ("f", "template"), ("f", "spec"),
+                ("f", "containers"), ("k", '{"name":"main"}'))
+        assert item + ((".",),) in s
+        assert item + (("f", "image"),) in s
+
+    def test_atomic_list_is_one_leaf(self):
+        s = fields_of({"spec": {"nodeSelectorTerms": ["a", "b"]}})
+        assert (("f", "spec"), ("f", "nodeSelectorTerms")) in s
+
+    def test_fields_v1_roundtrip(self):
+        s = fields_of(deploy())
+        assert from_fields_v1(to_fields_v1(s)) == s
+
+
+class TestApply:
+    def test_create_on_absent(self):
+        merged = apply_patch(None, deploy(), "alice")
+        mf = merged["metadata"]["managedFields"]
+        assert len(mf) == 1
+        assert mf[0]["manager"] == "alice"
+        assert mf[0]["operation"] == "Apply"
+
+    def test_conflict_lists_owner(self):
+        live = apply_patch(None, deploy(replicas=1), "alice")
+        with pytest.raises(Conflict) as e:
+            apply_patch(live, deploy(replicas=3), "bob")
+        assert any(m == "alice" for m, _ in e.value.conflicts)
+        assert "replicas" in str(e.value)
+
+    def test_same_value_coowns_without_conflict(self):
+        live = apply_patch(None, deploy(replicas=2), "alice")
+        merged = apply_patch(live, deploy(replicas=2), "bob")
+        managers = {e["manager"] for e in merged["metadata"]["managedFields"]}
+        assert managers == {"alice", "bob"}
+        assert merged["spec"]["replicas"] == 2
+
+    def test_force_steals_ownership(self):
+        live = apply_patch(None, deploy(replicas=1), "alice")
+        merged = apply_patch(live, deploy(replicas=3), "bob", force=True)
+        assert merged["spec"]["replicas"] == 3
+        replicas = (("f", "spec"), ("f", "replicas"))
+        for e in merged["metadata"]["managedFields"]:
+            owned = from_fields_v1(e["fieldsV1"])
+            if e["manager"] == "alice":
+                assert replicas not in owned
+            if e["manager"] == "bob":
+                assert replicas in owned
+
+    def test_dropping_a_field_removes_it(self):
+        live = apply_patch(None, deploy(), "alice")
+        second = deploy()
+        del second["metadata"]["labels"]
+        merged = apply_patch(live, second, "alice")
+        assert "labels" not in merged["metadata"]
+
+    def test_dropped_field_coowned_by_other_survives(self):
+        live = apply_patch(None, deploy(replicas=2), "alice")
+        live = apply_patch(live, {"apiVersion": "apps/v1",
+                                  "kind": "Deployment",
+                                  "metadata": {"name": "web"},
+                                  "spec": {"replicas": 2}}, "bob")
+        third = deploy(replicas=2)
+        del third["spec"]["replicas"]
+        # alice drops replicas; bob still owns it -> value stays
+        merged = apply_patch(live, third, "alice")
+        assert merged["spec"]["replicas"] == 2
+
+    def test_unmentioned_fields_of_others_preserved(self):
+        live = apply_patch(None, deploy(), "alice")
+        merged = apply_patch(live, {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web", "annotations": {"note": "hi"}},
+        }, "bob")
+        # bob never mentioned spec; alice's spec is intact
+        assert merged["spec"]["replicas"] == 1
+        assert merged["metadata"]["annotations"]["note"] == "hi"
+
+    def test_keyed_list_merges_per_item(self):
+        base = deploy()
+        base["spec"]["template"]["spec"]["containers"].append(
+            {"name": "sidecar", "image": "side:v1"})
+        live = apply_patch(None, base, "alice")
+        # bob applies ONLY the sidecar container: main is untouched
+        merged = apply_patch(live, {
+            "apiVersion": "apps/v1", "kind": "Deployment",
+            "metadata": {"name": "web"},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": "sidecar", "image": "side:v2"}]}}},
+        }, "bob", force=True)
+        by_name = {c["name"]: c for c in
+                   merged["spec"]["template"]["spec"]["containers"]}
+        assert by_name["main"]["image"] == "app:v1"
+        assert by_name["sidecar"]["image"] == "side:v2"
+
+    def test_removing_keyed_item(self):
+        base = deploy()
+        base["spec"]["template"]["spec"]["containers"].append(
+            {"name": "sidecar", "image": "side:v1"})
+        live = apply_patch(None, base, "alice")
+        merged = apply_patch(live, deploy(), "alice")
+        names = [c["name"] for c in
+                 merged["spec"]["template"]["spec"]["containers"]]
+        assert names == ["main"]
+
+    def test_keyed_item_with_foreign_field_survives_drop(self):
+        # alice applies [main, sidecar]; bob updates the sidecar image
+        # (owns .../f:image); alice re-applies WITHOUT sidecar -> the item
+        # must survive because bob owns a field inside it
+        base = deploy()
+        base["spec"]["template"]["spec"]["containers"].append(
+            {"name": "sidecar", "image": "side:v1"})
+        live = apply_patch(None, base, "alice")
+        after = json.loads(json.dumps(live))
+        after["spec"]["template"]["spec"]["containers"][1]["image"] = "side:v2"
+        after["metadata"]["managedFields"] = capture_update(live, after, "bob")
+        merged = apply_patch(after, deploy(), "alice")
+        names = [c["name"] for c in
+                 merged["spec"]["template"]["spec"]["containers"]]
+        assert "sidecar" in names
+
+    def test_update_then_apply_same_manager_takes_over(self):
+        # POST by manager ktl (Update entry), then apply by ktl: no
+        # conflict, fields move to the Apply entry (the reference's
+        # update->apply takeover); unapplied fields stay in the Update entry
+        created = deploy(replicas=4)
+        live = dict(created)
+        live["metadata"] = dict(created["metadata"])
+        live["metadata"]["managedFields"] = capture_update(
+            None, created, "ktl")
+        narrow = {"apiVersion": "apps/v1", "kind": "Deployment",
+                  "metadata": {"name": "web"}, "spec": {"replicas": 9}}
+        merged = apply_patch(live, narrow, "ktl")  # must NOT raise
+        assert merged["spec"]["replicas"] == 9
+        # the template fields the apply didn't mention are NOT pruned —
+        # they were owned via Update, not via a previous Apply
+        assert merged["spec"]["template"]["spec"]["containers"]
+        ops = {(e["manager"], e["operation"])
+               for e in merged["metadata"]["managedFields"]}
+        assert ("ktl", "Apply") in ops and ("ktl", "Update") in ops
+
+
+class TestCaptureUpdate:
+    def test_update_moves_changed_fields(self):
+        live = apply_patch(None, deploy(replicas=1), "alice")
+        import json
+
+        after = json.loads(json.dumps(live))
+        after["spec"]["replicas"] = 5
+        mf = capture_update(live, after, "scaler")
+        replicas = (("f", "spec"), ("f", "replicas"))
+        by_mgr = {e["manager"]: from_fields_v1(e["fieldsV1"]) for e in mf}
+        assert replicas in by_mgr["scaler"]
+        assert replicas not in by_mgr["alice"]
+        # untouched fields stay with alice
+        assert (("f", "metadata"), ("f", "labels"), ("f", "app")) \
+            in by_mgr["alice"]
+
+    def test_removed_fields_leave_all_managers(self):
+        live = apply_patch(None, deploy(), "alice")
+        import json
+
+        after = json.loads(json.dumps(live))
+        del after["metadata"]["labels"]
+        mf = capture_update(live, after, "editor")
+        labels = (("f", "metadata"), ("f", "labels"), ("f", "app"))
+        for e in mf:
+            assert labels not in from_fields_v1(e["fieldsV1"])
+
+
+class TestHTTPApply:
+    """The contract end-to-end through the real API server."""
+
+    @pytest.fixture()
+    def server(self):
+        from kubernetes_tpu.server import APIServer
+        from kubernetes_tpu.store import APIStore
+
+        srv = APIServer(APIStore()).start()
+        yield srv
+        srv.stop()
+
+    def _client(self, srv, manager):
+        from kubernetes_tpu.server import RESTClient
+
+        return RESTClient(srv.url)
+
+    def test_conflict_and_force(self, server):
+        from kubernetes_tpu.server import APIError
+
+        alice = self._client(server, "alice")
+        bob = self._client(server, "bob")
+        doc = {"apiVersion": "v1", "kind": "ConfigMap",
+               "metadata": {"name": "cm"}, "data": {"k": "1"}}
+        alice.apply("configmaps", "cm", doc, "default", field_manager="alice")
+        doc2 = {"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "cm"}, "data": {"k": "2"}}
+        with pytest.raises(APIError) as e:
+            bob.apply("configmaps", "cm", doc2, "default",
+                      field_manager="bob")
+        assert e.value.code == 409
+        assert "alice" in str(e.value)
+        out = bob.apply("configmaps", "cm", doc2, "default",
+                        field_manager="bob", force=True)
+        assert out["data"]["k"] == "2"
+        owners = {m["manager"]: m for m in out["metadata"]["managedFields"]}
+        assert (("f", "data"), ("f", "k")) in \
+            from_fields_v1(owners["bob"]["fieldsV1"])
+
+    def test_apply_creates_then_prunes(self, server):
+        c = self._client(server, "alice")
+        doc = {"apiVersion": "v1", "kind": "ConfigMap",
+               "metadata": {"name": "cm2"},
+               "data": {"a": "1", "b": "2"}}
+        out = c.apply("configmaps", "cm2", doc, "default",
+                      field_manager="alice")
+        assert out["data"] == {"a": "1", "b": "2"}
+        doc2 = {"apiVersion": "v1", "kind": "ConfigMap",
+                "metadata": {"name": "cm2"}, "data": {"a": "1"}}
+        out = c.apply("configmaps", "cm2", doc2, "default",
+                      field_manager="alice")
+        assert out["data"] == {"a": "1"}
+
+    def test_field_manager_required(self, server):
+        from kubernetes_tpu.server import APIError
+
+        c = self._client(server, "x")
+        with pytest.raises(APIError) as e:
+            c.apply("configmaps", "cm3",
+                    {"apiVersion": "v1", "kind": "ConfigMap",
+                     "metadata": {"name": "cm3"}, "data": {}},
+                    "default", field_manager="")
+        assert e.value.code == 400
+
+    def test_put_transfers_ownership(self, server):
+        alice = self._client(server, "alice")
+        doc = {"apiVersion": "v1", "kind": "ConfigMap",
+               "metadata": {"name": "cm4"}, "data": {"k": "1", "j": "x"}}
+        alice.apply("configmaps", "cm4", doc, "default",
+                    field_manager="alice")
+        live = alice.get("configmaps", "cm4")
+        live["data"]["k"] = "9"
+        alice.request("PUT",
+                      "/api/v1/namespaces/default/configmaps/cm4?"
+                      "fieldManager=editor", live)
+        out = alice.get("configmaps", "cm4")
+        by_mgr = {m["manager"]: from_fields_v1(m["fieldsV1"])
+                  for m in out["metadata"]["managedFields"]}
+        k = (("f", "data"), ("f", "k"))
+        assert k in by_mgr["editor"]
+        assert k not in by_mgr["alice"]
+        # alice now re-applies her original config -> conflict on k
+        from kubernetes_tpu.server import APIError
+
+        with pytest.raises(APIError) as e:
+            alice.apply("configmaps", "cm4", doc, "default",
+                        field_manager="alice")
+        assert e.value.code == 409 and "editor" in str(e.value)
+
+    def test_unknown_resource_404(self, server):
+        from kubernetes_tpu.server import APIError
+
+        c = self._client(server, "x")
+        with pytest.raises(APIError) as e:
+            c.request("PATCH",
+                      "/api/v1/namespaces/default/bogusthings/x?"
+                      "fieldManager=m", {"metadata": {"name": "x"}},
+                      content_type="application/apply-patch+yaml")
+        assert e.value.code == 404
+
+    def test_bad_metadata_400_not_connection_drop(self, server):
+        from kubernetes_tpu.server import APIError
+
+        c = self._client(server, "x")
+        with pytest.raises(APIError) as e:
+            c.request("PATCH",
+                      "/api/v1/namespaces/default/configmaps/x?"
+                      "fieldManager=m", {"metadata": "bogus"},
+                      content_type="application/apply-patch+yaml")
+        assert e.value.code == 400
+
+    def test_create_then_apply_same_cli_manager(self, server):
+        # the ktl workflow: create -f then apply -f must not 409
+        import io
+        import json as _json
+        import tempfile
+        from contextlib import redirect_stdout
+
+        from kubernetes_tpu.cli.ktl import main as ktl_main
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            _json.dump({"kind": "ConfigMap",
+                        "metadata": {"name": "mix", "namespace": "default"},
+                        "data": {"k": "1"}}, f)
+            path = f.name
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert ktl_main(["--server", server.url, "create",
+                             "-f", path]) == 0
+        with open(path, "w") as f:
+            _json.dump({"kind": "ConfigMap",
+                        "metadata": {"name": "mix", "namespace": "default"},
+                        "data": {"k": "2"}}, f)
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert ktl_main(["--server", server.url, "apply",
+                             "-f", path]) == 0
+        c = self._client(server, "ktl")
+        assert c.get("configmaps", "mix")["data"]["k"] == "2"
+
+    def test_managed_fields_cannot_be_forged_via_patch(self, server):
+        c = self._client(server, "alice")
+        c.apply("configmaps", "cm5",
+                {"apiVersion": "v1", "kind": "ConfigMap",
+                 "metadata": {"name": "cm5"}, "data": {"k": "1"}},
+                "default", field_manager="alice")
+        c.patch("configmaps", "cm5",
+                {"metadata": {"managedFields": [
+                    {"manager": "evil", "operation": "Apply",
+                     "fieldsType": "FieldsV1",
+                     "fieldsV1": {"f:data": {"f:k": {}}}}]}},
+                "default")
+        out = c.get("configmaps", "cm5")
+        assert all(m["manager"] != "evil"
+                   for m in out["metadata"]["managedFields"])
