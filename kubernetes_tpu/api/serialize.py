@@ -41,6 +41,12 @@ from .policy import (
     ResourceQuota,
     ServiceAccount,
 )
+from .admissionregistration import (
+    MutatingWebhookConfiguration,
+    ValidatingAdmissionPolicy,
+    ValidatingAdmissionPolicyBinding,
+    ValidatingWebhookConfiguration,
+)
 from .certificates import CertificateSigningRequest
 from .config import ConfigMap, Secret
 from .crd import CustomResourceDefinition
@@ -98,6 +104,10 @@ KIND_TO_RESOURCE = {
     "NetworkPolicy": "networkpolicies",
     "PriorityLevelConfiguration": "prioritylevelconfigurations",
     "FlowSchema": "flowschemas",
+    "ValidatingAdmissionPolicy": "validatingadmissionpolicies",
+    "ValidatingAdmissionPolicyBinding": "validatingadmissionpolicybindings",
+    "MutatingWebhookConfiguration": "mutatingwebhookconfigurations",
+    "ValidatingWebhookConfiguration": "validatingwebhookconfigurations",
 }
 RESOURCE_TO_TYPE = {
     "pods": Pod,
@@ -136,12 +146,20 @@ RESOURCE_TO_TYPE = {
     "networkpolicies": NetworkPolicy,
     "prioritylevelconfigurations": PriorityLevelConfiguration,
     "flowschemas": FlowSchemaConfiguration,
+    "validatingadmissionpolicies": ValidatingAdmissionPolicy,
+    "validatingadmissionpolicybindings": ValidatingAdmissionPolicyBinding,
+    "mutatingwebhookconfigurations": MutatingWebhookConfiguration,
+    "validatingwebhookconfigurations": ValidatingWebhookConfiguration,
 }
 CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses",
                   "csinodes", "resourceslices", "deviceclasses",
                   "priorityclasses", "customresourcedefinitions",
                   "certificatesigningrequests", "ingressclasses",
-                  "prioritylevelconfigurations", "flowschemas"}
+                  "prioritylevelconfigurations", "flowschemas",
+                  "validatingadmissionpolicies",
+                  "validatingadmissionpolicybindings",
+                  "mutatingwebhookconfigurations",
+                  "validatingwebhookconfigurations"}
 GROUP_PREFIX = {
     "pods": "/api/v1",
     "nodes": "/api/v1",
@@ -179,6 +197,12 @@ GROUP_PREFIX = {
     "networkpolicies": "/apis/networking.k8s.io/v1",
     "prioritylevelconfigurations": "/apis/flowcontrol.apiserver.k8s.io/v1",
     "flowschemas": "/apis/flowcontrol.apiserver.k8s.io/v1",
+    "validatingadmissionpolicies": "/apis/admissionregistration.k8s.io/v1",
+    "validatingadmissionpolicybindings":
+        "/apis/admissionregistration.k8s.io/v1",
+    "mutatingwebhookconfigurations": "/apis/admissionregistration.k8s.io/v1",
+    "validatingwebhookconfigurations":
+        "/apis/admissionregistration.k8s.io/v1",
 }
 
 

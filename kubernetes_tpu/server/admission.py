@@ -674,7 +674,10 @@ class AdmissionChain:
 
 def default_admission_chain() -> AdmissionChain:
     """The default plugin set, in the reference's recommended order
-    (kubeapiserver/options/plugins.go — ResourceQuota last)."""
+    (kubeapiserver/options/plugins.go — ValidatingAdmissionPolicy just
+    before ResourceQuota, ResourceQuota last)."""
+    from .admissionpolicy import PolicyAdmission
+
     return AdmissionChain([
         MetadataDefaulter(),
         NamespaceLifecycle(),
@@ -693,5 +696,6 @@ def default_admission_chain() -> AdmissionChain:
         ServiceValidation(),
         CertificateSubjectRestriction(),
         NodeRestriction(),
+        PolicyAdmission(),
         ResourceQuotaAdmission(),
     ])

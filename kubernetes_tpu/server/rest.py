@@ -851,6 +851,14 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if ns and not self._cluster_scoped(resource, crd):
             obj.metadata.namespace = ns
+        # mutating webhooks run BEFORE registry-side allocation and identity
+        # stamping (the reference's order): a webhook patch can never forge
+        # CSR identity or bypass the ClusterIP allocator
+        obj, _patches, werr = self._run_webhooks(resource, "CREATE", obj,
+                                                 user, crd)
+        if werr is not None:
+            self._error(*werr)
+            return
         if resource == "certificatesigningrequests":
             # requestor identity is server-populated and unforgeable
             # (certificates/v1 PrepareForCreate semantics)
@@ -903,6 +911,34 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(*err)
             return
         self._send_json(201, to_dict(created))
+
+    def _run_webhooks(self, resource: str, operation: str, obj, user,
+                      crd=None):
+        """Webhook phase, OUTSIDE any store transaction (plugin/webhook/:
+        an HTTP round-trip must never ride the store lock, and a webhook
+        that calls back into this server would deadlock until timeout).
+        Returns (possibly-replaced obj, applied JSONPatches, verdict|None)."""
+        wh = getattr(self.server, "webhooks", None)
+        if wh is None:
+            return obj, [], None
+        from .admission import AdmissionError
+
+        try:
+            wire, patches = wh.run(resource, operation, to_dict(obj),
+                                   user.name if user is not None else "")
+        except AdmissionError as e:
+            return obj, [], (e.code, str(e), e.reason)
+        if not patches:
+            return obj, [], None
+        new_obj, perr = self._parse_obj(resource, wire, crd)
+        if perr is not None:
+            return obj, [], perr
+        # identity is authoritative — a webhook patch can't rename/move
+        new_obj.metadata.name = obj.metadata.name
+        new_obj.metadata.namespace = obj.metadata.namespace
+        new_obj.metadata.uid = obj.metadata.uid
+        new_obj.metadata.resource_version = obj.metadata.resource_version
+        return new_obj, patches, None
 
     def _admission_verdict(self, resource: str, operation: str, obj, user=None):
         """Run the admission chain; returns None on admit or an
@@ -1031,6 +1067,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, f"name mismatch: URL {name!r} vs body {obj.metadata.name!r}")
             return
         obj.metadata.name = name
+        obj, _patches, werr = self._run_webhooks(resource, "UPDATE", obj,
+                                                 user, crd)
+        if werr is not None:
+            self._error(*werr)
+            return
         err = None
         updated = None
         with self.store.transaction():
@@ -1110,12 +1151,42 @@ class _Handler(BaseHTTPRequestHandler):
             # managedFields are server-managed; a patch can't forge them
             patch["metadata"].pop("managedFields", None)
         key = self._key(resource, ns, name, crd)
+        # webhook phase outside the transaction, on a merge computed from a
+        # pre-read (bounded staleness — the reference's webhooks see the
+        # same); mutating patches are re-applied to the authoritative merge
+        # inside. Zero configs (the common case) skips the pre-read.
+        webhook_patches = []
+        wh = getattr(self.server, "webhooks", None)
+        # subresource requests never hit webhooks (rules here carry no
+        # subresource dimension; in the reference a rule must name
+        # pods/status to match one) — a webhook patch must not smuggle
+        # spec edits through the status endpoint's scoping guard
+        if wh is not None and not sub and wh.active():
+            from .admission import AdmissionError
+
+            try:
+                existing0 = self.store.get(resource, key)
+                merged0 = json_merge_patch(to_dict(existing0), patch)
+                _, webhook_patches = wh.run(
+                    resource, "UPDATE", merged0,
+                    user.name if user is not None else "")
+            except NotFoundError as e:
+                self._error(404, str(e), "NotFound")
+                return
+            except AdmissionError as e:
+                self._error(e.code, str(e), e.reason)
+                return
         err = None
         updated = None
         with self.store.transaction():
             try:
                 existing = self.store.get(resource, key)
                 merged = json_merge_patch(to_dict(existing), patch)
+                if webhook_patches:
+                    from .admissionpolicy import apply_json_patch
+
+                    for wp in webhook_patches:
+                        merged = apply_json_patch(merged, wp)
                 obj, perr = self._parse_obj(resource, merged, crd)
                 if perr is None and resource == "customresourcedefinitions":
                     perr = self._crd_conflict(obj)
@@ -1191,12 +1262,39 @@ class _Handler(BaseHTTPRequestHandler):
         applied["metadata"].pop("managedFields", None)
         # status is reset on main-resource apply (the strategy's resetFields)
         applied.pop("status", None)
+        key = self._key(resource, ns, name, crd)
+        # webhook phase outside the transaction (same pattern as do_PATCH);
+        # an apply Conflict here is ignored — the in-transaction apply
+        # raises it authoritatively
+        webhook_patches = []
+        wh = getattr(self.server, "webhooks", None)
+        if wh is not None and wh.active():
+            from .admission import AdmissionError
+
+            try:
+                try:
+                    live0 = to_dict(self.store.get(resource, key))
+                    op0 = "UPDATE"
+                except NotFoundError:
+                    live0 = None
+                    op0 = "CREATE"
+                try:
+                    merged0 = apply_patch(live0, applied, manager,
+                                          force=force)
+                except Conflict:
+                    merged0 = None
+                if merged0 is not None:
+                    _, webhook_patches = wh.run(
+                        resource, op0, merged0,
+                        user.name if user is not None else "")
+            except AdmissionError as e:
+                self._error(e.code, str(e), e.reason)
+                return
         err = None
         result = None
         created = False
         with self.store.transaction():
             try:
-                key = self._key(resource, ns, name, crd)
                 try:
                     existing = self.store.get(resource, key)
                 except NotFoundError:
@@ -1206,6 +1304,11 @@ class _Handler(BaseHTTPRequestHandler):
                     merged = apply_patch(live, applied, manager, force=force)
                 except Conflict as e:
                     raise _PatchParseError((409, str(e), "Conflict"))
+                if webhook_patches:
+                    from .admissionpolicy import apply_json_patch
+
+                    for wp in webhook_patches:
+                        merged = apply_json_patch(merged, wp)
                 obj, perr = self._parse_obj(resource, merged, crd)
                 if perr is None and resource == "customresourcedefinitions":
                     perr = self._crd_conflict(obj)
@@ -1324,6 +1427,11 @@ class APIServer:
 
         self._httpd.crds = DynamicRegistry(store)  # type: ignore[attr-defined]
         self._httpd.ipalloc = ClusterIPAllocator(store)  # type: ignore[attr-defined]
+        from .admissionpolicy import WebhookAdmission
+
+        # live Mutating/ValidatingWebhookConfiguration objects; the phase
+        # runs BEFORE store transactions (HTTP must never ride the lock)
+        self._httpd.webhooks = WebhookAdmission(store)  # type: ignore[attr-defined]
         if admission == "default":
             from .admission import default_admission_chain
 

@@ -1,0 +1,405 @@
+"""ValidatingAdmissionPolicy (restricted-CEL) + admission webhooks.
+
+Pins the reference's admission extensibility contract
+(apiserver/pkg/admission/plugin/policy/validating/plugin.go,
+plugin/webhook/{mutating,validating}):
+  - a policy API object rejects a live write with NO tree change
+  - policies are inert without a binding; namespaceSelector scopes bindings
+  - failurePolicy Fail vs Ignore on expression errors / unreachable hooks
+  - mutating webhooks patch objects via base64 JSONPatch; validating
+    webhooks deny with the webhook's status message
+  - webhook HTTP round-trips never run under the store transaction
+"""
+
+import base64
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubernetes_tpu.server import APIError, APIServer, RESTClient
+from kubernetes_tpu.server.celexpr import (
+    ExpressionError,
+    compile_expression,
+)
+from kubernetes_tpu.store import APIStore
+
+
+class TestCelExpr:
+    def run(self, src, obj=None, request=None):
+        return compile_expression(src)({
+            "object": obj or {}, "oldObject": None,
+            "request": request or {}})
+
+    def test_basic_comparison(self):
+        assert self.run("object.spec.replicas <= 5",
+                        {"spec": {"replicas": 3}})
+        assert not self.run("object.spec.replicas <= 5",
+                            {"spec": {"replicas": 9}})
+
+    def test_boolean_operators(self):
+        obj = {"spec": {"a": 1, "b": "x"}}
+        assert self.run("object.spec.a == 1 && object.spec.b == 'x'", obj)
+        assert self.run("object.spec.a == 2 || object.spec.b == 'x'", obj)
+        assert self.run("!(object.spec.a == 2)", obj)
+
+    def test_has_and_absent_fields(self):
+        assert self.run("has(object.metadata.labels)",
+                        {"metadata": {"labels": {"a": "b"}}})
+        assert not self.run("has(object.metadata.labels)", {"metadata": {}})
+        # comparisons against absent fields don't match
+        assert not self.run("object.spec.replicas > 0", {})
+        # != is vacuously true against absence
+        assert self.run("object.spec.x != 'y'", {})
+
+    def test_string_methods_and_size(self):
+        obj = {"metadata": {"name": "web-frontend"},
+               "spec": {"containers": [1, 2, 3]}}
+        assert self.run("object.metadata.name.startsWith('web-')", obj)
+        assert self.run("object.metadata.name.contains('front')", obj)
+        assert self.run("object.metadata.name.matches('^web-[a-z]+$')", obj)
+        assert self.run("size(object.spec.containers) == 3", obj)
+
+    def test_in_operator(self):
+        assert self.run("object.spec.tier in ['gold', 'silver']",
+                        {"spec": {"tier": "gold"}})
+
+    def test_request_variables(self):
+        assert self.run("request.operation == 'CREATE'",
+                        request={"operation": "CREATE"})
+
+    def test_keyword_strings_untouched(self):
+        # 'true'/'false'/'null' inside string literals stay verbatim
+        assert self.run("object.spec.x == 'true'", {"spec": {"x": "true"}})
+        assert self.run("object.spec.x == 'null'", {"spec": {"x": "null"}})
+        assert not self.run("object.spec.x == 'false'",
+                            {"spec": {"x": "False"}})
+
+    def test_null_literal(self):
+        assert self.run("object.spec.x == null", {"spec": {"x": None}})
+
+    def test_disallowed_syntax_rejected(self):
+        for bad in ("__import__('os')", "object.__class__",
+                    "[x for x in object]", "lambda: 1",
+                    "open('/etc/passwd')"):
+            with pytest.raises(ExpressionError):
+                compile_expression(bad)({"object": {}})
+
+    def test_non_boolean_result_rejected(self):
+        with pytest.raises(ExpressionError):
+            self.run("object.spec.replicas + 1", {"spec": {"replicas": 1}})
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer(APIStore()).start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(server):
+    return RESTClient(server.url)
+
+
+def make_policy(client, name, expression, message="denied by policy",
+                resources=("pods",), operations=("*",),
+                failure_policy="Fail", bind=True, ns_labels=None):
+    client.create("validatingadmissionpolicies", {
+        "kind": "ValidatingAdmissionPolicy",
+        "metadata": {"name": name},
+        "spec": {
+            "matchConstraints": {"resourceRules": [
+                {"resources": list(resources),
+                 "operations": list(operations)}]},
+            "validations": [{"expression": expression, "message": message}],
+            "failurePolicy": failure_policy,
+        }}, namespace=None)
+    if bind:
+        spec = {"policyName": name, "validationActions": ["Deny"]}
+        if ns_labels is not None:
+            spec["matchResources"] = {"namespaceSelector":
+                                      {"matchLabels": ns_labels}}
+        client.create("validatingadmissionpolicybindings", {
+            "kind": "ValidatingAdmissionPolicyBinding",
+            "metadata": {"name": f"{name}-binding"}, "spec": spec},
+            namespace=None)
+
+
+def pod(name, labels=None, cpu="100m"):
+    return {"kind": "Pod",
+            "metadata": {"name": name, "namespace": "default",
+                         "labels": labels or {}},
+            "spec": {"containers": [
+                {"name": "c", "resources": {"requests": {"cpu": cpu}}}]}}
+
+
+class TestValidatingAdmissionPolicy:
+    def test_policy_rejects_live_write(self, server, client):
+        make_policy(client, "require-team",
+                    "has(object.metadata.labels.team)",
+                    message="every pod needs a team label")
+        with pytest.raises(APIError) as e:
+            client.create("pods", pod("p1"))
+        assert e.value.code == 422
+        assert "every pod needs a team label" in str(e.value)
+        client.create("pods", pod("p2", labels={"team": "infra"}))
+
+    def test_policy_without_binding_is_inert(self, server, client):
+        make_policy(client, "inert", "false", bind=False)
+        client.create("pods", pod("p1"))  # must not raise
+
+    def test_binding_namespace_selector(self, server, client):
+        client.create("namespaces", {"kind": "Namespace",
+                                     "metadata": {"name": "prod",
+                                                  "labels": {"env": "prod"}}},
+                      namespace=None)
+        client.create("namespaces", {"kind": "Namespace",
+                                     "metadata": {"name": "dev",
+                                                  "labels": {"env": "dev"}}},
+                      namespace=None)
+        make_policy(client, "prod-only", "false", ns_labels={"env": "prod"})
+        client.create("pods", dict(pod("p-dev"),
+                                   metadata={"name": "p-dev",
+                                             "namespace": "dev"}))
+        with pytest.raises(APIError):
+            client.create("pods", dict(pod("p-prod"),
+                                       metadata={"name": "p-prod",
+                                                 "namespace": "prod"}))
+
+    def test_failure_policy_fail_vs_ignore(self, server, client):
+        make_policy(client, "broken-fail", "object.spec..bogus(",
+                    failure_policy="Fail")
+        with pytest.raises(APIError) as e:
+            client.create("pods", pod("p1"))
+        assert e.value.code == 500
+        client.delete("validatingadmissionpolicies", "broken-fail",
+                      namespace=None)
+        make_policy(client, "broken-ignore", "object.spec..bogus(",
+                    failure_policy="Ignore")
+        client.create("pods", pod("p2"))  # must not raise
+
+    def test_update_operation_scoping(self, server, client):
+        make_policy(client, "no-updates", "false",
+                    operations=("UPDATE",))
+        client.create("pods", pod("p1"))  # CREATE unaffected
+        with pytest.raises(APIError):
+            got = client.get("pods", "p1")
+            got["metadata"]["labels"] = {"x": "y"}
+            client.update("pods", got)
+
+    def test_old_object_on_update(self, server, client):
+        # scale-down forbidden: oldObject is the live pre-write object
+        make_policy(client, "no-scale-down",
+                    "oldObject == null || "
+                    "object.spec.replicas >= oldObject.spec.replicas",
+                    resources=("replicasets",), operations=("UPDATE",))
+        client.create("replicasets", {
+            "kind": "ReplicaSet", "metadata": {"name": "web"},
+            "spec": {"replicas": 3,
+                     "template": {"spec": {"containers": [{"name": "c"}]}}}})
+        got = client.get("replicasets", "web")
+        got["spec"]["replicas"] = 5
+        client.update("replicasets", got)  # up is fine
+        got = client.get("replicasets", "web")
+        got["spec"]["replicas"] = 2
+        with pytest.raises(APIError) as e:
+            client.update("replicasets", got)
+        assert e.value.code == 422
+
+    def test_policy_delete_restores_writes(self, server, client):
+        make_policy(client, "temp", "false")
+        with pytest.raises(APIError):
+            client.create("pods", pod("p1"))
+        client.delete("validatingadmissionpolicies", "temp", namespace=None)
+        client.create("pods", pod("p1"))
+
+
+class _Hook(BaseHTTPRequestHandler):
+    """Scriptable admission webhook: the test sets `responder` on the
+    server object."""
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        review = json.loads(self.rfile.read(length))
+        resp = self.server.responder(review)  # type: ignore[attr-defined]
+        body = json.dumps({"apiVersion": "admission.k8s.io/v1",
+                           "kind": "AdmissionReview",
+                           "response": resp}).encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *a):
+        pass
+
+
+@pytest.fixture()
+def hook_server():
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _Hook)
+    httpd.responder = lambda review: {"allowed": True}  # type: ignore[attr-defined]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    yield httpd
+    httpd.shutdown()
+
+
+def hook_url(httpd):
+    return f"http://127.0.0.1:{httpd.server_address[1]}/admit"
+
+
+class TestWebhooks:
+    def test_validating_webhook_denies(self, server, client, hook_server):
+        hook_server.responder = lambda review: {
+            "allowed": False,
+            "status": {"message": "nope from webhook", "code": 403}}
+        client.create("validatingwebhookconfigurations", {
+            "kind": "ValidatingWebhookConfiguration",
+            "metadata": {"name": "deny-pods"},
+            "webhooks": [{"name": "deny.example.com",
+                          "clientConfig": {"url": hook_url(hook_server)},
+                          "rules": [{"resources": ["pods"],
+                                     "operations": ["CREATE"]}]}]},
+            namespace=None)
+        with pytest.raises(APIError) as e:
+            client.create("pods", pod("p1"))
+        assert e.value.code == 403 and "nope from webhook" in str(e.value)
+        # unmatched resource passes
+        client.create("configmaps", {"kind": "ConfigMap",
+                                     "metadata": {"name": "cm"}, "data": {}})
+
+    def test_mutating_webhook_patches(self, server, client, hook_server):
+        patch = [{"op": "add", "path": "/metadata/labels",
+                  "value": {"injected": "true"}}]
+        hook_server.responder = lambda review: {
+            "allowed": True, "patchType": "JSONPatch",
+            "patch": base64.b64encode(json.dumps(patch).encode()).decode()}
+        client.create("mutatingwebhookconfigurations", {
+            "kind": "MutatingWebhookConfiguration",
+            "metadata": {"name": "label-injector"},
+            "webhooks": [{"name": "inject.example.com",
+                          "clientConfig": {"url": hook_url(hook_server)},
+                          "rules": [{"resources": ["pods"],
+                                     "operations": ["CREATE"]}]}]},
+            namespace=None)
+        client.create("pods", pod("p1"))
+        got = client.get("pods", "p1")
+        assert got["metadata"]["labels"]["injected"] == "true"
+
+    def test_failure_policy_ignore_on_unreachable(self, server, client):
+        client.create("validatingwebhookconfigurations", {
+            "kind": "ValidatingWebhookConfiguration",
+            "metadata": {"name": "gone"},
+            "webhooks": [{"name": "gone.example.com",
+                          "clientConfig":
+                              {"url": "http://127.0.0.1:9/admit"},
+                          "timeoutSeconds": 1,
+                          "failurePolicy": "Ignore",
+                          "rules": [{"resources": ["pods"],
+                                     "operations": ["*"]}]}]},
+            namespace=None)
+        client.create("pods", pod("p1"))  # must not raise
+
+    def test_failure_policy_fail_on_unreachable(self, server, client):
+        client.create("validatingwebhookconfigurations", {
+            "kind": "ValidatingWebhookConfiguration",
+            "metadata": {"name": "gone-fail"},
+            "webhooks": [{"name": "gone.example.com",
+                          "clientConfig":
+                              {"url": "http://127.0.0.1:9/admit"},
+                          "timeoutSeconds": 1,
+                          "rules": [{"resources": ["pods"],
+                                     "operations": ["*"]}]}]},
+            namespace=None)
+        with pytest.raises(APIError) as e:
+            client.create("pods", pod("p1"))
+        assert e.value.code == 500
+
+    def test_mutating_webhook_on_merge_patch(self, server, client,
+                                             hook_server):
+        client.create("pods", pod("p1"))
+        patch = [{"op": "add", "path": "/metadata/labels/stamped",
+                  "value": "yes"}]
+        hook_server.responder = lambda review: {
+            "allowed": True, "patchType": "JSONPatch",
+            "patch": base64.b64encode(json.dumps(patch).encode()).decode()}
+        client.create("mutatingwebhookconfigurations", {
+            "kind": "MutatingWebhookConfiguration",
+            "metadata": {"name": "stamper"},
+            "webhooks": [{"name": "stamp.example.com",
+                          "clientConfig": {"url": hook_url(hook_server)},
+                          "rules": [{"resources": ["pods"],
+                                     "operations": ["UPDATE"]}]}]},
+            namespace=None)
+        client.patch("pods", "p1", {"metadata": {"labels": {"edited": "1"}}})
+        got = client.get("pods", "p1")
+        assert got["metadata"]["labels"]["edited"] == "1"
+        assert got["metadata"]["labels"]["stamped"] == "yes"
+
+    def test_status_patch_skips_webhooks(self, server, client, hook_server):
+        client.create("pods", pod("p1"))
+        calls = []
+
+        def responder(review):
+            calls.append(review["request"]["operation"])
+            return {"allowed": False, "status": {"message": "no"}}
+
+        hook_server.responder = responder
+        client.create("validatingwebhookconfigurations", {
+            "kind": "ValidatingWebhookConfiguration",
+            "metadata": {"name": "blocker"},
+            "webhooks": [{"name": "b.example.com",
+                          "clientConfig": {"url": hook_url(hook_server)},
+                          "rules": [{"resources": ["pods"],
+                                     "operations": ["*"]}]}]},
+            namespace=None)
+        # status-subresource PATCH must bypass webhooks entirely
+        client.request("PATCH",
+                       "/api/v1/namespaces/default/pods/p1/status",
+                       {"status": {"phase": "Running"}},
+                       content_type="application/merge-patch+json")
+        assert calls == []
+        assert client.get("pods", "p1")["status"]["phase"] == "Running"
+
+    def test_denial_code_clamped_to_error_range(self, server, client,
+                                                hook_server):
+        # a misbehaving webhook denying with code 200 must still produce
+        # an HTTP error, not a success the client mistakes for a create
+        hook_server.responder = lambda review: {
+            "allowed": False, "status": {"message": "sneaky", "code": 200}}
+        client.create("validatingwebhookconfigurations", {
+            "kind": "ValidatingWebhookConfiguration",
+            "metadata": {"name": "sneaky"},
+            "webhooks": [{"name": "s.example.com",
+                          "clientConfig": {"url": hook_url(hook_server)},
+                          "rules": [{"resources": ["pods"],
+                                     "operations": ["*"]}]}]},
+            namespace=None)
+        with pytest.raises(APIError) as e:
+            client.create("pods", pod("px"))
+        assert 400 <= e.value.code <= 599
+
+    def test_webhook_sees_admission_review(self, server, client,
+                                           hook_server):
+        seen = {}
+
+        def responder(review):
+            seen.update(review["request"])
+            return {"allowed": True}
+
+        hook_server.responder = responder
+        client.create("validatingwebhookconfigurations", {
+            "kind": "ValidatingWebhookConfiguration",
+            "metadata": {"name": "observer"},
+            "webhooks": [{"name": "obs.example.com",
+                          "clientConfig": {"url": hook_url(hook_server)},
+                          "rules": [{"resources": ["pods"],
+                                     "operations": ["*"]}]}]},
+            namespace=None)
+        client.create("pods", pod("p9"))
+        assert seen["operation"] == "Create"
+        assert seen["name"] == "p9"
+        assert seen["object"]["metadata"]["name"] == "p9"
