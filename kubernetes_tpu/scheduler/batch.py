@@ -60,7 +60,12 @@ class BatchScheduler(Scheduler):
         from ..ops.solver import greedy_scan_solve, make_inputs
         from ..server import metrics as m
 
-        self.pump_events()
+        # pump until the watch drains — bounded: a 100k-pod backlog must
+        # reach the queue as ONE batch (not batch_size/10k sub-solves), but
+        # sustained event arrival must not starve scheduling forever
+        for _ in range(8):
+            if self.pump_events(max_events=self.batch_size) < self.batch_size:
+                break
         qps = self.queue.pop_batch(self.batch_size, timeout=timeout)
         if not qps:
             return 0
@@ -131,12 +136,34 @@ class BatchScheduler(Scheduler):
             # rejected pods. Handling mid-loop would see capacity still
             # promised to not-yet-bound assignments and double-book nodes.
             rejected = []
+            to_bind = []
             for j, pi in enumerate(device_idx):
                 nidx = int(assignment[j])
                 if nidx < 0:
                     rejected.append((j, qps[pi]))
                 else:
-                    self._bind_assignment(qps[pi], cluster.node_names[nidx])
+                    to_bind.append((qps[pi], cluster.node_names[nidx],
+                                    pod_structural_clone(qps[pi].pod)))
+            if to_bind:
+                # bulk assume under one cache lock, then hand the worker
+                # CHUNKED batches: per-pod puts left bind_many at ~53-pod
+                # batches under queue contention, while one 100k batch
+                # would hold the store lock against every consumer
+                bad = self.cache.assume_pods(
+                    [(assumed, node) for _qp, node, assumed in to_bind])
+                for i, msg in sorted(bad, reverse=True):
+                    qp, node, _assumed = to_bind.pop(i)
+                    self._handle_failure(qp, Status.error(msg))
+                CHUNK = 10_000
+                for lo in range(0, len(to_bind), CHUNK):
+                    chunk = to_bind[lo:lo + CHUNK]
+                    if self.pipeline_binds:
+                        self._ensure_bind_worker()
+                        self._bind_q.put(chunk)
+                    else:
+                        self._bind_batch(chunk)
+                if not self.pipeline_binds:
+                    self._drain_bind_results()
             if rejected:
                 self._handle_device_rejects(rejected, snapshot, cluster, sub,
                                             assignment)
@@ -426,21 +453,6 @@ class BatchScheduler(Scheduler):
                     return getattr(p, "hard_pod_affinity_weight", 1)
         return 1
 
-    def _bind_assignment(self, qp: QueuedPodInfo, node_name: str) -> None:
-        # assume on a structural clone, not a deepcopy — this runs per bind at
-        # batch rates (schedule_one.go:148 DeepCopy, tuned like store.bind)
-        assumed = pod_structural_clone(qp.pod)
-        try:
-            self.cache.assume_pod(assumed, node_name)
-        except ValueError as e:
-            self._handle_failure(qp, Status.error(str(e)))
-            return
-        if self.pipeline_binds:
-            self._ensure_bind_worker()
-            self._bind_q.put((qp, node_name, assumed))
-            return
-        self._bind_one(qp, node_name, assumed, async_mode=False)
-
     def _bind_one(self, qp: QueuedPodInfo, node_name: str, assumed,
                   async_mode: bool) -> None:
         try:
@@ -476,7 +488,7 @@ class BatchScheduler(Scheduler):
             if item is None:
                 self._bind_q.task_done()
                 return
-            items = [item]
+            batches = [item]  # each queue item is a LIST of bind triples
             done = False
             while True:
                 try:
@@ -486,11 +498,11 @@ class BatchScheduler(Scheduler):
                 if nxt is None:
                     done = True
                     break
-                items.append(nxt)
+                batches.append(nxt)
             try:
-                self._bind_batch(items)
+                self._bind_batch([t for b in batches for t in b])
             finally:
-                for _ in items:
+                for _ in batches:
                     self._bind_q.task_done()
                 if done:
                     self._bind_q.task_done()  # the sentinel
@@ -500,10 +512,19 @@ class BatchScheduler(Scheduler):
     def _bind_batch(self, items) -> None:
         triples = [(qp.pod.metadata.namespace, qp.pod.metadata.name, node)
                    for qp, node, _assumed in items]
-        try:
-            _bound, errors = self.store.bind_many(triples)
-        except Exception as e:  # store-wide failure: every bind in the batch failed
-            errors = [(qp.pod.key, str(e)) for qp, _n, _a in items]
+        # chunked: each bind_many holds the store lock once; a single
+        # 100k-bind hold would starve every other store consumer. A chunk
+        # that throws fails ONLY its own pods — earlier chunks already
+        # committed and must not be forgotten/requeued.
+        errors = []
+        for lo in range(0, len(triples), 10_000):
+            chunk = triples[lo:lo + 10_000]
+            try:
+                _bound, errs = self.store.bind_many(chunk)
+                errors.extend(errs)
+            except Exception as e:
+                errors.extend((f"{ns}/{name}", str(e))
+                              for ns, name, _node in chunk)
         errmap = dict(errors)
         with self._bind_err_lock:
             for qp, _node, assumed in items:

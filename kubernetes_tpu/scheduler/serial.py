@@ -215,11 +215,12 @@ class Scheduler:
             self._relist()
             return 0
         n = 0
-        for ev in self._watch.drain():
+        # bounded drain: events beyond the cap STAY in the watch buffer for
+        # the next pump (a plain drain() dequeues everything — breaking out
+        # of that list discarded the rest of a large backlog)
+        for ev in self._watch.drain(max_events):
             self._handle_event(ev)
             n += 1
-            if n >= max_events:
-                break
         return n
 
     def _relist(self) -> None:

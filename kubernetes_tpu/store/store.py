@@ -122,18 +122,26 @@ def pod_structural_clone(pod):
     immutable by every store consumer: the store itself never mutates stored
     objects (writes replace them), and clients mutate only top-level metadata
     dicts / spec.node_name / status fields — all cloned here."""
-    meta = copy.copy(pod.metadata)
+    meta = _shallow(pod.metadata)
     meta.labels = dict(meta.labels)
     meta.annotations = dict(meta.annotations)
     meta.owner_references = list(meta.owner_references)
     meta.finalizers = list(meta.finalizers)
-    spec = copy.copy(pod.spec)
-    status = copy.copy(pod.status)
+    spec = _shallow(pod.spec)
+    status = _shallow(pod.status)
     status.conditions = list(status.conditions)
-    new = copy.copy(pod)
+    new = _shallow(pod)
     new.metadata = meta
     new.spec = spec
     new.status = status
+    return new
+
+
+def _shallow(obj):
+    """Shallow copy without copy.copy's __reduce_ex__ machinery (~4x
+    faster; this runs 3x per bind at 100k-bind rates)."""
+    new = object.__new__(obj.__class__)
+    new.__dict__.update(obj.__dict__)
     return new
 
 
@@ -187,15 +195,20 @@ class Watch:
         except queue.Empty:
             return None
 
-    def drain(self) -> List[Event]:
+    def drain(self, max_n: Optional[int] = None) -> List[Event]:
+        """Drain buffered events; max_n bounds the take so a capped consumer
+        LEAVES the remainder buffered (a break mid-list would silently drop
+        already-dequeued events — the north-star 100k backlog lost 90% of
+        its ADDED events to exactly that)."""
         out = []
-        while True:
+        while max_n is None or len(out) < max_n:
             try:
                 ev = self._q.get_nowait()
             except queue.Empty:
                 return out
             if ev is not None:
                 out.append(ev)
+        return out
 
     def __iter__(self):
         while not self._stopped:

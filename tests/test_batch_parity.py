@@ -210,3 +210,28 @@ class TestParity:
                 for i in range(3)]
         got = assert_parity(nodes, pods)
         assert len({v for v in got.values()}) == 3
+
+
+def test_large_backlog_fully_scheduled_through_capped_pumps():
+    """A backlog far above the per-pump event cap must still be fully
+    scheduled: pump_events beyond the cap leaves events buffered for the
+    next cycle instead of dropping them (the 100k north-star truncation)."""
+    from kubernetes_tpu.scheduler import Framework
+    from kubernetes_tpu.scheduler.batch import BatchScheduler
+    from kubernetes_tpu.scheduler.plugins import default_plugins
+    from kubernetes_tpu.store import APIStore
+    from kubernetes_tpu.testing import MakeNode, MakePod
+
+    store = APIStore()
+    for i in range(200):
+        store.create("nodes", MakeNode(f"node-{i}").capacity(
+            {"cpu": "64", "memory": "256Gi", "pods": "200"}).obj())
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=30_000, solver="fast")
+    sched.sync()
+    n = 25_000  # far above the 10k per-pump cap
+    for i in range(n):
+        store.create("pods", MakePod(f"b-{i}").req({"cpu": "100m"}).obj())
+    sched.run_until_idle()
+    sched.flush_binds()
+    assert sched.scheduled_count == n

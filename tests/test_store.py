@@ -178,3 +178,22 @@ def test_watch_event_objects_are_copies():
     assert s.get("pods", "default/a").spec.node_name == ""
     s.bind("default", "a", "n1")  # must succeed
     w.stop()
+
+
+def test_bounded_drain_leaves_remainder_buffered():
+    """drain(max_n) must LEAVE excess events in the buffer — a capped
+    consumer breaking out of a full drain() silently dropped the rest of a
+    large backlog (the north-star 100k run lost 90% of its ADDED events)."""
+    from kubernetes_tpu.testing import MakePod
+
+    store = APIStore()
+    w = store.watch("pods", maxsize=50_000)
+    for i in range(30_000):
+        store.create("pods", MakePod(f"p{i}").obj())
+    first = w.drain(10_000)
+    assert len(first) == 10_000
+    assert first[0].obj.metadata.name == "p0"
+    rest = w.drain()
+    assert len(rest) == 20_000
+    assert rest[0].obj.metadata.name == "p10000"
+    assert not w.terminated
