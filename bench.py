@@ -539,6 +539,46 @@ def rung_transport(results):
             return out, time.perf_counter() - t0
 
         base, dt_wf = timed(lambda: np.asarray(waterfill_solve(inputs, groups)))
+
+        # BASELINE ladder #4: node-sharded Sinkhorn at 100k pods / 10k nodes
+        # through the mesh path (all available devices; on the 1-chip bench
+        # rig the sharding machinery still runs with a 1-wide mesh)
+        try:
+            from kubernetes_tpu.parallel.sharded import make_mesh
+
+            big_snap = make_snapshot(_nodes(sz(10_000), cpu="16", mem="64Gi"))
+            big_pods = [MakePod(f"ts-{i}").req(
+                {"cpu": "500m" if i % 2 else "250m",
+                 "memory": "1Gi"}).obj() for i in range(sz(100_000))]
+            big_cluster = build_cluster_tensors(big_snap)
+            big_batch = build_pod_batch(big_pods, big_snap, big_cluster)
+            big_inputs, _ = make_inputs(big_cluster, big_batch)
+            big_groups = make_groups(big_batch)
+            mesh = make_mesh()
+            wf_big, dt_wf_big = timed(
+                lambda: np.asarray(waterfill_solve(big_inputs, big_groups)))
+            solved, dt = timed(lambda: transport_solve(
+                big_inputs, big_groups, method="sinkhorn",
+                node_names=big_cluster.node_names, mesh=mesh))
+            a = np.asarray(solved[0])
+            placed = int((a >= 0).sum())
+            pps = len(big_pods) / dt
+            wf_pps = len(big_pods) / dt_wf_big
+            results["Transport_sinkhorn_sharded_100k"] = {
+                "pods_per_sec": round(pps, 1), "wall_s": round(dt, 3),
+                "placed": placed, "pods": len(big_pods),
+                "waterfill_placed": int((wf_big >= 0).sum()),
+                "mesh_devices": int(np.prod(list(mesh.shape.values()))),
+                "waterfill_pods_per_sec": round(wf_pps, 1),
+                "vs_waterfill": round(pps / wf_pps, 2)}
+            print(f"{'Transport_sinkhorn_sharded_100k':>28}: {pps:>9.0f} "
+                  f"pods/s  ({placed}/{len(big_pods)} placed; "
+                  f"{pps / wf_pps:.2f}x waterfill)", file=sys.stderr)
+        except Exception as e:
+            results["Transport_sinkhorn_sharded_100k"] = {"error": str(e)[:200]}
+            print(f"Transport_sinkhorn_sharded_100k: ERROR {e}",
+                  file=sys.stderr)
+
         for method in ("auction", "sinkhorn"):
             try:
                 solved, dt = timed(lambda m=method: transport_solve(

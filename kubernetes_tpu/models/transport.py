@@ -159,26 +159,35 @@ def _auction_phase(utility, jcap, supply, slots, req, free, x0, price0, level0,
         # any feasible node over staying unassigned (utility floor -inf only
         # for truly infeasible cells)
         v = jnp.where(jcap > x, utility - price[None, :], NEG_INF)
-        v1 = jnp.max(v, axis=1)
-        j_star = jnp.argmax(v, axis=1)
-        v_wo = jnp.where(
-            jnp.arange(n)[None, :] == j_star[:, None], NEG_INF, v
-        )
-        v2 = jnp.max(v_wo, axis=1)
-        v2 = jnp.where(v2 <= NEG_INF / 2, v1, v2)  # single feasible node
+        # MULTI-NODE bids: each group bids its top-K nodes per round,
+        # spreading unassigned units across them in value order. With one
+        # node per round a single huge group (G=1, supply 50k) could place
+        # only jcap units per round — 400 rounds capped it at ~13k pods.
+        k = min(16, n)
+        vk, jk = jax.lax.top_k(v, k)  # [G, K]
+        v1 = vk[:, 0]
+        # the marginal competing value: the best node OUTSIDE the top-K
+        # (or the K-th best when nothing else is feasible) — every bid in
+        # the wave uses it, which only raises bids above the minimum
+        # Bertsekas increment (aggressive bids stay eps-CS-valid)
+        rows = jnp.arange(g)[:, None].repeat(k, axis=1)
+        v_next = jnp.max(v.at[rows, jk].set(NEG_INF), axis=1)
+        v_next = jnp.where(v_next <= NEG_INF / 2,
+                           jnp.where(vk[:, k - 1] > NEG_INF / 2,
+                                     vk[:, k - 1], v1),
+                           v_next)
         bidding = (unassigned > 0) & (v1 > NEG_INF / 2)
-        beta = utility[jnp.arange(g), j_star] - v2 + eps  # bid level
-        bid_units = jnp.where(
-            bidding,
-            jnp.minimum(unassigned, jcap[jnp.arange(g), j_star] - x[jnp.arange(g), j_star]),
-            0,
-        )
-        bids = jnp.zeros_like(x).at[jnp.arange(g), j_star].add(bid_units)
-        bid_level = jnp.where(
-            bids > 0,
-            jnp.zeros_like(level).at[jnp.arange(g), j_star].set(beta),
-            NEG_INF,
-        )
+        avail = jnp.clip(
+            jnp.take_along_axis(jcap, jk, axis=1)
+            - jnp.take_along_axis(x, jk, axis=1), 0, None)  # [G, K]
+        avail = jnp.where(vk > NEG_INF / 2, avail, 0)
+        prefix = jnp.cumsum(avail, axis=1) - avail  # exclusive prefix
+        units_k = jnp.clip(unassigned[:, None] - prefix, 0, avail)
+        units_k = jnp.where(bidding[:, None], units_k, 0)
+        beta_k = jnp.take_along_axis(utility, jk, axis=1) - v_next[:, None] + eps
+        bids = jnp.zeros_like(x).at[rows, jk].add(units_k)
+        bid_level = jnp.full_like(level, NEG_INF).at[rows, jk].max(
+            jnp.where(units_k > 0, beta_k, NEG_INF))
 
         # merge holders + bids per node; greedy knapsack acceptance by level
         units = jnp.concatenate([x, bids], axis=0)  # [2G, N]
@@ -228,7 +237,7 @@ def _auction_phase(utility, jcap, supply, slots, req, free, x0, price0, level0,
         x_new = kept[:g] + kept[g:]
         level_new = jnp.minimum(kept_levels[:g], kept_levels[g:])
         level_new = jnp.where(x_new > 0, level_new, NEG_INF)
-        progress = jnp.any(bid_units > 0)
+        progress = jnp.any(units_k > 0)
         return x_new, new_price, level_new, rounds + 1, progress
 
     x, price, level, rounds, _ = jax.lax.while_loop(
@@ -254,7 +263,8 @@ def auction_solve(
     g, n = problem.utility.shape
     price0 = np.zeros(n, np.float32)
     if state is not None and node_names is not None:
-        price0 = _remap_price(state, node_names)
+        remapped = _remap_price(state, node_names)
+        price0[:len(remapped)] = remapped  # node axis may be mesh-padded
     util_range = float(jnp.max(jnp.where(problem.feasible, problem.utility, 0)))
     eps = eps_start if eps_start is not None else max(util_range / 8.0, eps_final)
     price = jnp.asarray(price0)
@@ -271,9 +281,10 @@ def auction_solve(
         if eps <= eps_final:
             break
         eps = max(eps / scale, eps_final)
+    names = tuple(node_names) if node_names else tuple(str(i) for i in range(n))
     new_state = TransportState(
-        price=np.asarray(price),
-        node_names=tuple(node_names) if node_names else tuple(str(i) for i in range(n)),
+        price=np.asarray(price)[:len(names)],
+        node_names=names,
         iterations=total_rounds,
     )
     return np.asarray(x), new_state
@@ -350,15 +361,17 @@ def sinkhorn_solve(
     gdim, n = problem.utility.shape
     g0 = np.zeros(n, np.float32)
     if state is not None and node_names is not None:
-        g0 = np.maximum(_remap_price(state, node_names), 0.0)
+        remapped = np.maximum(_remap_price(state, node_names), 0.0)
+        g0[:len(remapped)] = remapped  # node axis may be mesh-padded
     f0 = jnp.zeros(gdim, jnp.float32)
     f, g, plan = _sinkhorn_iters(
         problem.utility, problem.feasible, problem.supply, _effective_cap(problem),
         f0, jnp.asarray(g0), jnp.float32(eps), iters,
     )
+    names = tuple(node_names) if node_names else tuple(str(i) for i in range(n))
     new_state = TransportState(
-        price=np.asarray(g),
-        node_names=tuple(node_names) if node_names else tuple(str(i) for i in range(n)),
+        price=np.asarray(g)[:len(names)],
+        node_names=names,
         iterations=iters,
     )
     return np.asarray(plan), new_state
@@ -449,18 +462,38 @@ def transport_solve(
     method: str = "auction",
     state: Optional[TransportState] = None,
     node_names: Optional[List[str]] = None,
+    mesh=None,
 ) -> Optional[Tuple[np.ndarray, TransportState]]:
     """End-to-end: build → solve → round → repair → per-pod assignment.
-    Returns None when the batch isn't transport-eligible (host ports)."""
+    Returns None when the batch isn't transport-eligible (host ports).
+
+    With `mesh`, the [G, N] problem's node axis shards over the mesh's
+    "nodes" axis (parallel/sharded.py shard_group_problem) and the solver
+    runs under it — GSPMD inserts the node-axis collectives over ICI;
+    padded nodes are infeasible and never receive units. Warm duals carry
+    by node name either way."""
+    import contextlib
+
     problem = build_group_problem(inp, groups)
     if problem is None:
         return None
-    if method == "sinkhorn":
-        frac, new_state = sinkhorn_solve(problem, state, node_names)
-        x = round_plan(problem, frac)
-    else:
-        x, new_state = auction_solve(problem, state, node_names)
-        x = np.asarray(x)
+    ctx = contextlib.nullcontext()
+    if mesh is not None:
+        from ..parallel.sharded import shard_group_problem
+
+        true_n = problem.utility.shape[1]
+        problem, _ = shard_group_problem(problem, mesh)
+        if node_names is None:
+            # duals must map to TRUE nodes, never mesh padding
+            node_names = [str(i) for i in range(true_n)]
+        ctx = jax.sharding.set_mesh(mesh)
+    with ctx:
+        if method == "sinkhorn":
+            frac, new_state = sinkhorn_solve(problem, state, node_names)
+            x = round_plan(problem, frac)
+        else:
+            x, new_state = auction_solve(problem, state, node_names)
+            x = np.asarray(x)
     x = repair_plan(problem, x)
     n_pods = inp.req.shape[0]
     return assignment_from_plan(problem, x, n_pods), new_state

@@ -121,3 +121,45 @@ def sharded_feasibility_cost(inp: SolverInputs, d_max: int, mesh: Mesh):
                                 NamedSharding(mesh, P("dp", "nodes"))))
     with jax.sharding.set_mesh(mesh):
         return fn(inp, d_max)
+
+
+# PartitionSpec per GroupProblem field (models/transport.py): the node axis
+# of the [G, N] transportation problem shards over the mesh — BASELINE.json
+# ladder #4 "Sinkhorn relaxation node-sharded". The group axis stays
+# replicated (G is small after class collapse); GSPMD inserts the node-axis
+# reductions (sinkhorn row-logsumexp, auction top-k/argmax) over ICI.
+_GP_SPECS = dict(
+    utility=P(None, "nodes"), feasible=P(None, "nodes"),
+    jcap=P(None, "nodes"), supply=P(), slots=P("nodes"), req=P(),
+    alloc=P("nodes", None), used=P("nodes", None),
+)
+
+
+def shard_group_problem(problem, mesh: Mesh):
+    """Pad the node axis to the mesh multiple (padding is infeasible: zero
+    capacity/slots, -inf utility) and device_put every field with its
+    NamedSharding. Returns (sharded problem, true node count)."""
+    from ..models.transport import NEG_INF
+
+    n = problem.utility.shape[1]
+    mult = mesh.shape["nodes"]
+    pad = (-n) % mult
+    if pad:
+        # spec-driven (same pattern as _pad_nodes): every field whose spec
+        # names the nodes axis pads along it — a new field added to
+        # _GP_SPECS is padded automatically or device_put fails loudly
+        padded = {}
+        for k, spec in _GP_SPECS.items():
+            arr = getattr(problem, k)
+            axis = next((i for i, s in enumerate(spec) if s == "nodes"), None)
+            if axis is None:
+                continue
+            widths = [(0, 0)] * arr.ndim
+            widths[axis] = (0, pad)
+            fill = float(NEG_INF) if k == "utility" else 0
+            padded[k] = jnp.pad(arr, widths, constant_values=fill)
+        problem = problem._replace(**padded)
+    placed = {k: jax.device_put(getattr(problem, k),
+                                NamedSharding(mesh, _GP_SPECS[k]))
+              for k in _GP_SPECS}
+    return problem._replace(**placed), n
