@@ -54,9 +54,15 @@ from .flowcontrolapi import (
     FlowSchemaConfiguration,
     PriorityLevelConfiguration,
 )
-from .dra import DeviceClass, ResourceClaim, ResourceSlice
+from .dra import DeviceClass, ResourceClaim, ResourceClaimTemplate, ResourceSlice
 from .events import Event as CoreEvent, PodLog
-from .storage import CSINode, PersistentVolume, PersistentVolumeClaim, StorageClass
+from .storage import (
+    CSINode,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+    VolumeAttachment,
+)
 from .workloads import (
     CronJob,
     DaemonSet,
@@ -96,6 +102,8 @@ KIND_TO_RESOURCE = {
     "DeviceClass": "deviceclasses",
     "CustomResourceDefinition": "customresourcedefinitions",
     "CertificateSigningRequest": "certificatesigningrequests",
+    "VolumeAttachment": "volumeattachments",
+    "ResourceClaimTemplate": "resourceclaimtemplates",
     "PodLog": "podlogs",
     "ConfigMap": "configmaps",
     "Secret": "secrets",
@@ -138,6 +146,8 @@ RESOURCE_TO_TYPE = {
     "deviceclasses": DeviceClass,
     "customresourcedefinitions": CustomResourceDefinition,
     "certificatesigningrequests": CertificateSigningRequest,
+    "volumeattachments": VolumeAttachment,
+    "resourceclaimtemplates": ResourceClaimTemplate,
     "podlogs": PodLog,
     "configmaps": ConfigMap,
     "secrets": Secret,
@@ -152,6 +162,7 @@ RESOURCE_TO_TYPE = {
     "validatingwebhookconfigurations": ValidatingWebhookConfiguration,
 }
 CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses",
+                  "volumeattachments",
                   "csinodes", "resourceslices", "deviceclasses",
                   "priorityclasses", "customresourcedefinitions",
                   "certificatesigningrequests", "ingressclasses",
@@ -175,6 +186,7 @@ GROUP_PREFIX = {
     "persistentvolumeclaims": "/api/v1",
     "storageclasses": "/apis/storage.k8s.io/v1",
     "csinodes": "/apis/storage.k8s.io/v1",
+    "volumeattachments": "/apis/storage.k8s.io/v1",
     "services": "/api/v1",
     "endpointslices": "/apis/discovery.k8s.io/v1",
     "resourcequotas": "/api/v1",
@@ -185,6 +197,7 @@ GROUP_PREFIX = {
     "serviceaccounts": "/api/v1",
     "events": "/api/v1",
     "resourceclaims": "/apis/resource.k8s.io/v1beta1",
+    "resourceclaimtemplates": "/apis/resource.k8s.io/v1beta1",
     "resourceslices": "/apis/resource.k8s.io/v1beta1",
     "deviceclasses": "/apis/resource.k8s.io/v1beta1",
     "customresourcedefinitions": "/apis/apiextensions.k8s.io/v1",
@@ -332,10 +345,13 @@ def pod_to_dict(pod: Pod) -> Dict:
         spec["overhead"] = pod.spec.overhead
     if pod.spec.volumes:
         spec["volumes"] = [v.to_dict() for v in pod.spec.volumes]
-    if pod.spec.resource_claims:
+    if pod.spec.resource_claims or pod.spec.resource_claim_templates:
         spec["resourceClaims"] = [
             {"name": n, "resourceClaimName": rc}
             for n, rc in pod.spec.resource_claims
+        ] + [
+            {"name": n, "resourceClaimTemplateName": t}
+            for n, t in pod.spec.resource_claim_templates
         ]
     if pod.spec.service_account_name:
         spec["serviceAccountName"] = pod.spec.service_account_name
@@ -358,6 +374,10 @@ def pod_to_dict(pod: Pod) -> Dict:
     status: Dict[str, Any] = {"phase": pod.status.phase}
     if pod.status.nominated_node_name:
         status["nominatedNodeName"] = pod.status.nominated_node_name
+    if pod.status.resource_claim_statuses:
+        status["resourceClaimStatuses"] = [
+            {"name": n, "resourceClaimName": c}
+            for n, c in pod.status.resource_claim_statuses.items()]
     if pod.status.conditions:
         status["conditions"] = [
             {"type": c.type, "status": c.status,

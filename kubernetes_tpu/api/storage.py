@@ -51,6 +51,8 @@ class PersistentVolumeSpec:
     capacity: int = 0  # storage bytes
     access_modes: List[str] = field(default_factory=list)
     storage_class_name: str = ""
+    # persistentVolumeReclaimPolicy: Retain (manual default) | Delete
+    reclaim_policy: str = "Retain"
     node_affinity: Optional[NodeSelector] = None  # spec.nodeAffinity.required
     claim_ref: str = ""  # "ns/name" of the bound PVC
     csi_driver: str = ""  # spec.csi.driver (for NodeVolumeLimits counting)
@@ -82,6 +84,8 @@ class PersistentVolume:
                 capacity=quantity_value((spec.get("capacity") or {}).get("storage", 0)),
                 access_modes=list(spec.get("accessModes") or []),
                 storage_class_name=spec.get("storageClassName", ""),
+                reclaim_policy=spec.get("persistentVolumeReclaimPolicy",
+                                        "Retain"),
                 node_affinity=NodeSelector.from_dict(na),
                 claim_ref=(f"{claim.get('namespace', 'default')}/{claim['name']}"
                            if claim.get("name") else ""),
@@ -100,6 +104,8 @@ class PersistentVolume:
         }
         if self.spec.storage_class_name:
             spec["storageClassName"] = self.spec.storage_class_name
+        if self.spec.reclaim_policy != "Retain":
+            spec["persistentVolumeReclaimPolicy"] = self.spec.reclaim_policy
         if self.spec.claim_ref:
             ns, _, name = self.spec.claim_ref.partition("/")
             spec["claimRef"] = {"namespace": ns, "name": name}
@@ -256,3 +262,46 @@ class CSINode:
                      **({"allocatable": {"count": count}} if count is not None else {})}
                     for name, count in sorted(self.drivers.items())
                 ]}}
+
+
+@dataclass
+class VolumeAttachment:
+    """storage.k8s.io/v1 VolumeAttachment: the attach/detach controller's
+    record that a PV is attached to a node (reference:
+    pkg/controller/volume/attachdetach/attach_detach_controller.go; the
+    external CSI attacher flips status.attached — here the controller is
+    the attach backend for the fake runtime and attaches synchronously)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    attacher: str = ""
+    node_name: str = ""
+    pv_name: str = ""  # spec.source.persistentVolumeName
+    attached: bool = False
+
+    kind = "VolumeAttachment"
+
+    def __post_init__(self):
+        self.metadata.namespace = ""  # cluster-scoped
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "VolumeAttachment":
+        meta = ObjectMeta.from_dict(d.get("metadata") or {})
+        meta.namespace = ""
+        spec = d.get("spec") or {}
+        return VolumeAttachment(
+            metadata=meta,
+            attacher=spec.get("attacher", ""),
+            node_name=spec.get("nodeName", ""),
+            pv_name=(spec.get("source") or {}).get("persistentVolumeName", ""),
+            attached=bool((d.get("status") or {}).get("attached", False)),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        meta = self.metadata.to_dict()
+        meta.pop("namespace", None)
+        return {"apiVersion": "storage.k8s.io/v1", "kind": self.kind,
+                "metadata": meta,
+                "spec": {"attacher": self.attacher,
+                         "nodeName": self.node_name,
+                         "source": {"persistentVolumeName": self.pv_name}},
+                "status": {"attached": self.attached}}

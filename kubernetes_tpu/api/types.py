@@ -452,6 +452,10 @@ class PodSpec:
     # DRA (core/v1 PodSpec.ResourceClaims): [(claim ref name, ResourceClaim
     # object name)] — reference: PodResourceClaim, core/v1/types.go
     resource_claims: List[Tuple[str, str]] = field(default_factory=list)
+    # [(claim ref name, ResourceClaimTemplate name)] — the resourceclaim
+    # controller stamps a generated claim per pod and records it in
+    # status.resource_claim_statuses
+    resource_claim_templates: List[Tuple[str, str]] = field(default_factory=list)
     service_account_name: str = ""
 
     @staticmethod
@@ -483,6 +487,12 @@ class PodSpec:
             resource_claims=[
                 (rc.get("name", ""), rc.get("resourceClaimName", ""))
                 for rc in d.get("resourceClaims") or []
+                if not rc.get("resourceClaimTemplateName")
+            ],
+            resource_claim_templates=[
+                (rc.get("name", ""), rc.get("resourceClaimTemplateName", ""))
+                for rc in d.get("resourceClaims") or []
+                if rc.get("resourceClaimTemplateName")
             ],
             service_account_name=d.get("serviceAccountName", ""),
         )
@@ -502,6 +512,9 @@ class PodStatus:
     phase: str = PENDING
     conditions: List[PodCondition] = field(default_factory=list)
     nominated_node_name: str = ""
+    # claim ref name -> generated ResourceClaim name (status.resourceClaimStatuses,
+    # written by the resourceclaim controller for template-backed refs)
+    resource_claim_statuses: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -519,6 +532,9 @@ class Pod:
             metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
             spec=PodSpec.from_dict(d.get("spec") or {}),
             status=PodStatus(
+                resource_claim_statuses={
+                    rs.get("name", ""): rs.get("resourceClaimName", "")
+                    for rs in st.get("resourceClaimStatuses") or []},
                 phase=st.get("phase", PENDING),
                 conditions=[
                     PodCondition(

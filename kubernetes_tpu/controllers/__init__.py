@@ -17,6 +17,7 @@ from .node_lifecycle import NodeLifecycleController  # noqa: F401
 from .podgc import PodGCController  # noqa: F401
 from .podautoscaler import HorizontalPodAutoscalerController  # noqa: F401
 from .replicaset import ReplicaSetController  # noqa: F401
+from .resourceclaim import ResourceClaimController  # noqa: F401
 from .resourcequota import ResourceQuotaController  # noqa: F401
 from .serviceaccount import (  # noqa: F401
     EventTTLController,
@@ -24,4 +25,5 @@ from .serviceaccount import (  # noqa: F401
     TTLAfterFinishedController,
 )
 from .statefulset import StatefulSetController  # noqa: F401
+from .volume import AttachDetachController, PersistentVolumeBinder  # noqa: F401
 from .tainteviction import TaintEvictionController  # noqa: F401

@@ -55,14 +55,15 @@ class Controller:
             self.sync_all()
             return 0
         n = 0
-        for ev in self._watch.drain():
+        # bounded drain: events beyond the cap stay buffered for the next
+        # pump (breaking out of a full drain() would DISCARD them — the bug
+        # that truncated the scheduler's 100k backlog)
+        for ev in self._watch.drain(max_events):
             if ev.kind in self.watch_kinds:
                 key = self.key_of_object(ev.kind, ev.obj)
                 if key:
                     self._mark(key)
                 n += 1
-            if n >= max_events:
-                break
         return n
 
     def _mark(self, key: str) -> None:

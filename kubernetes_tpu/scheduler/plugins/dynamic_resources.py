@@ -60,12 +60,33 @@ class DynamicResources:
 
     # -- listers ---------------------------------------------------------------
 
-    def _claims_for(self, pod) -> Optional[List[ResourceClaim]]:
-        """None when a referenced claim is missing."""
-        if self.store is None or not pod.spec.resource_claims:
-            return []
+    @staticmethod
+    def _claim_names(pod):
+        """Resolved claim object names: direct spec references + generated
+        claims recorded by the resourceclaim controller
+        (status.resourceClaimStatuses); template refs without a recorded
+        claim yet resolve to None (pod must wait)."""
         out = []
         for _ref, claim_name in pod.spec.resource_claims:
+            out.append(claim_name)
+        for ref, _tmpl in pod.spec.resource_claim_templates:
+            out.append(pod.status.resource_claim_statuses.get(ref))
+        return out
+
+    @staticmethod
+    def _has_claims(pod) -> bool:
+        return bool(pod.spec.resource_claims
+                    or pod.spec.resource_claim_templates)
+
+    def _claims_for(self, pod) -> Optional[List[ResourceClaim]]:
+        """None when a referenced claim is missing (or a template's claim
+        has not been generated yet)."""
+        if self.store is None or not self._has_claims(pod):
+            return []
+        out = []
+        for claim_name in self._claim_names(pod):
+            if not claim_name:
+                return None
             try:
                 out.append(self.store.get(
                     "resourceclaims", f"{pod.metadata.namespace}/{claim_name}"))
@@ -107,7 +128,7 @@ class DynamicResources:
 
     def pre_enqueue(self, pod) -> Status:
         """PreEnqueue (:350): a pod whose claims are absent can't schedule."""
-        if not pod.spec.resource_claims:
+        if not self._has_claims(pod):
             return SUCCESS
         if self._claims_for(pod) is None:
             return Status.unschedulable(
@@ -122,7 +143,7 @@ class DynamicResources:
             always matters; a FOREIGN claim matters when it just released its
             devices (allocation cleared) — those devices may now satisfy this
             pod's pending claims."""
-            names = {cn for _r, cn in pod.spec.resource_claims}
+            names = {cn for cn in DynamicResources._claim_names(pod) if cn}
             if (claim.metadata.name in names
                     and claim.metadata.namespace == pod.metadata.namespace):
                 return True
@@ -138,7 +159,7 @@ class DynamicResources:
                 ClusterEventWithHint("deviceclasses", "add"))
 
     def pre_filter(self, state: CycleState, pod, snapshot):
-        if not pod.spec.resource_claims:
+        if not self._has_claims(pod):
             return None, Status.skip()
         claims = self._claims_for(pod)
         if claims is None:

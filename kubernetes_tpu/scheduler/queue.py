@@ -226,7 +226,13 @@ class SchedulingQueue:
                     tracked = self._unschedulable.get(key)
             if tracked is None:
                 return False
-            spec_changed = tracked.pod.spec != pod.spec
+            # status-only writes don't requeue (our own PodScheduled
+            # condition would loop) — EXCEPT resourceClaimStatuses: the
+            # resourceclaim controller's stamp resolves template claim
+            # references, which gates schedulability exactly like spec
+            spec_changed = (tracked.pod.spec != pod.spec
+                            or tracked.pod.status.resource_claim_statuses
+                            != pod.status.resource_claim_statuses)
             tracked.pod = pod
             if spec_changed:
                 if key in self._unschedulable:

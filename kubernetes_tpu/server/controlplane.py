@@ -21,7 +21,8 @@ DEFAULT_CONTROLLERS = (
     "disruption", "nodelifecycle", "tainteviction", "endpointslice",
     "namespace", "garbagecollector", "resourcequota", "horizontalpodautoscaler",
     "serviceaccount", "ttlafterfinished", "eventttl", "csrapproving",
-    "csrcleaner", "podgc",
+    "csrcleaner", "podgc", "persistentvolumebinder", "attachdetach",
+    "resourceclaim",
 )
 
 
@@ -47,6 +48,9 @@ def _controller_registry():
         StatefulSetController,
         TaintEvictionController,
         TTLAfterFinishedController,
+        AttachDetachController,
+        PersistentVolumeBinder,
+        ResourceClaimController,
     )
 
     return {
@@ -70,6 +74,9 @@ def _controller_registry():
         "garbagecollector": GarbageCollector,
         "resourcequota": ResourceQuotaController,
         "horizontalpodautoscaler": HorizontalPodAutoscalerController,
+        "persistentvolumebinder": PersistentVolumeBinder,
+        "attachdetach": AttachDetachController,
+        "resourceclaim": ResourceClaimController,
     }
 
 

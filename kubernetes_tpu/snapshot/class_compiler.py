@@ -221,6 +221,8 @@ def pod_class_signature(pod: Pod) -> tuple:
         len(spec.containers) + len(spec.init_containers),
         tuple(spec.volumes) if spec.volumes else (),
         tuple(spec.resource_claims) if spec.resource_claims else (),
+        tuple(spec.resource_claim_templates)
+        if spec.resource_claim_templates else (),
     )
 
 

@@ -445,7 +445,7 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
     ct_rows, st_rows = [], []
     fallback_class = np.zeros(len(rep_pods), dtype=bool)
     for ci, pod in enumerate(rep_pods):
-        if pod.spec.resource_claims:
+        if pod.spec.resource_claims or pod.spec.resource_claim_templates:
             # DRA claims need the allocator's Reserve/Unreserve/PreBind
             # transitions — serial path (dynamic_resources.py)
             fallback_class[ci] = True

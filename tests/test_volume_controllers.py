@@ -1,0 +1,339 @@
+"""PV binder, attach/detach, and resourceclaim controllers.
+
+Pins the reference contracts:
+  - pv_controller.go: an unbound PVC binds WITHOUT any pod (Immediate
+    class); smallest satisfying PV wins; user-pre-bound PVs complete;
+    deleted claims release volumes (Retain -> Released, Delete -> gone);
+    WaitForFirstConsumer claims are left to the scheduler.
+  - attach_detach_controller.go: a scheduled pod's bound PVC yields a
+    VolumeAttachment for (PV, node); pod deletion detaches.
+  - resourceclaim/controller.go: templates spawn per-pod claims recorded
+    in status.resourceClaimStatuses; orphaned generated claims are reaped;
+    the scheduler resolves template-backed claims end to end.
+"""
+
+import pytest
+
+from kubernetes_tpu.api.dra import DeviceRequest, ResourceClaimTemplate
+from kubernetes_tpu.api.storage import (
+    PersistentVolume,
+    PersistentVolumeClaim,
+    StorageClass,
+)
+from kubernetes_tpu.controllers import (
+    AttachDetachController,
+    PersistentVolumeBinder,
+    ResourceClaimController,
+)
+from kubernetes_tpu.controllers.volume import attachment_name
+from kubernetes_tpu.store import APIStore, NotFoundError
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def make_pv(name, capacity, class_name="", modes=("ReadWriteOnce",),
+            reclaim="Retain", claim_ref=""):
+    pv = PersistentVolume.from_dict({
+        "metadata": {"name": name},
+        "spec": {"capacity": {"storage": capacity},
+                 "accessModes": list(modes),
+                 **({"storageClassName": class_name} if class_name else {}),
+                 "persistentVolumeReclaimPolicy": reclaim,
+                 **({"claimRef": {"namespace": claim_ref.split("/")[0],
+                                  "name": claim_ref.split("/")[1]}}
+                    if claim_ref else {})},
+    })
+    return pv
+
+
+def make_pvc(name, request, class_name=None, modes=("ReadWriteOnce",)):
+    spec = {"accessModes": list(modes),
+            "resources": {"requests": {"storage": request}}}
+    if class_name is not None:
+        spec["storageClassName"] = class_name
+    return PersistentVolumeClaim.from_dict(
+        {"metadata": {"name": name, "namespace": "default"}, "spec": spec})
+
+
+@pytest.fixture()
+def store():
+    return APIStore()
+
+
+@pytest.fixture()
+def binder(store):
+    b = PersistentVolumeBinder(store)
+    b.sync_all()
+    return b
+
+
+class TestPVBinder:
+    def test_unbound_pvc_binds_without_a_pod(self, store, binder):
+        store.create("persistentvolumes", make_pv("pv-a", 10_000_000_000))
+        store.create("persistentvolumeclaims", make_pvc("data", 5_000_000_000))
+        binder.run_until_stable()
+        claim = store.get("persistentvolumeclaims", "default/data")
+        pv = store.get("persistentvolumes", "pv-a")
+        assert claim.spec.volume_name == "pv-a"
+        assert claim.phase == "Bound"
+        assert pv.spec.claim_ref == "default/data"
+        assert pv.phase == "Bound"
+
+    def test_smallest_satisfying_pv_wins(self, store, binder):
+        store.create("persistentvolumes", make_pv("pv-big", 100))
+        store.create("persistentvolumes", make_pv("pv-small", 10))
+        store.create("persistentvolumes", make_pv("pv-tiny", 4))
+        store.create("persistentvolumeclaims", make_pvc("c", 8))
+        binder.run_until_stable()
+        claim = store.get("persistentvolumeclaims", "default/c")
+        assert claim.spec.volume_name == "pv-small"
+
+    def test_class_must_match(self, store, binder):
+        store.create("persistentvolumes", make_pv("pv-fast", 10, "fast"))
+        store.create("persistentvolumeclaims", make_pvc("c", 5, ""))
+        binder.run_until_stable()
+        assert store.get("persistentvolumeclaims",
+                         "default/c").spec.volume_name == ""
+        store.create("persistentvolumeclaims", make_pvc("c2", 5, "fast"))
+        binder.run_until_stable()
+        assert store.get("persistentvolumeclaims",
+                         "default/c2").spec.volume_name == "pv-fast"
+
+    def test_access_modes_subset(self, store, binder):
+        store.create("persistentvolumes",
+                     make_pv("pv-rwo", 10, modes=("ReadWriteOnce",)))
+        store.create("persistentvolumeclaims",
+                     make_pvc("c", 5, modes=("ReadWriteMany",)))
+        binder.run_until_stable()
+        assert store.get("persistentvolumeclaims",
+                         "default/c").spec.volume_name == ""
+
+    def test_prebound_pv_completes_claim(self, store, binder):
+        store.create("persistentvolumes",
+                     make_pv("pv-pre", 10, claim_ref="default/mine"))
+        store.create("persistentvolumeclaims", make_pvc("mine", 5))
+        binder.run_until_stable()
+        claim = store.get("persistentvolumeclaims", "default/mine")
+        assert claim.spec.volume_name == "pv-pre"
+        assert store.get("persistentvolumes", "pv-pre").phase == "Bound"
+
+    def test_prebound_pv_waits_for_claim_created_later(self, store, binder):
+        # PV pre-bound to a claim that does NOT exist yet: it must stay
+        # Available (never Released/deleted) and bind when the claim appears
+        store.create("persistentvolumes",
+                     make_pv("pv-wait", 10, claim_ref="default/later",
+                             reclaim="Delete"))
+        binder.run_until_stable()
+        assert store.get("persistentvolumes", "pv-wait").phase == "Available"
+        store.create("persistentvolumeclaims", make_pvc("later", 5))
+        binder.run_until_stable()
+        claim = store.get("persistentvolumeclaims", "default/later")
+        assert claim.spec.volume_name == "pv-wait"
+        assert store.get("persistentvolumes", "pv-wait").phase == "Bound"
+
+    def test_claim_naming_missing_pv_stays_pending(self, store, binder):
+        c = make_pvc("c", 5)
+        c.spec.volume_name = "does-not-exist"
+        store.create("persistentvolumeclaims", c)
+        binder.run_until_stable()
+        assert store.get("persistentvolumeclaims",
+                         "default/c").phase == "Pending"
+
+    def test_user_prebound_claim_binds_when_pv_appears(self, store, binder):
+        c = make_pvc("c", 5)
+        c.spec.volume_name = "pv-late"
+        store.create("persistentvolumeclaims", c)
+        binder.run_until_stable()
+        assert store.get("persistentvolumeclaims",
+                         "default/c").phase == "Pending"
+        store.create("persistentvolumes", make_pv("pv-late", 10))
+        binder.run_until_stable()
+        assert store.get("persistentvolumeclaims",
+                         "default/c").phase == "Bound"
+        assert store.get("persistentvolumes",
+                         "pv-late").spec.claim_ref == "default/c"
+
+    def test_wffc_claims_left_to_scheduler(self, store, binder):
+        store.create("storageclasses", StorageClass.from_dict({
+            "metadata": {"name": "wffc"},
+            "volumeBindingMode": "WaitForFirstConsumer"}))
+        store.create("persistentvolumes", make_pv("pv-w", 10, "wffc"))
+        store.create("persistentvolumeclaims", make_pvc("c", 5, "wffc"))
+        binder.run_until_stable()
+        assert store.get("persistentvolumeclaims",
+                         "default/c").spec.volume_name == ""
+
+    def test_deleted_claim_releases_retain_pv(self, store, binder):
+        store.create("persistentvolumes", make_pv("pv-r", 10))
+        store.create("persistentvolumeclaims", make_pvc("c", 5))
+        binder.run_until_stable()
+        store.delete("persistentvolumeclaims", "default/c")
+        binder.run_until_stable()
+        assert store.get("persistentvolumes", "pv-r").phase == "Released"
+
+    def test_deleted_claim_reclaims_delete_pv(self, store, binder):
+        store.create("persistentvolumes",
+                     make_pv("pv-d", 10, reclaim="Delete"))
+        store.create("persistentvolumeclaims", make_pvc("c", 5))
+        binder.run_until_stable()
+        store.delete("persistentvolumeclaims", "default/c")
+        binder.run_until_stable()
+        with pytest.raises(NotFoundError):
+            store.get("persistentvolumes", "pv-d")
+
+    def test_default_class_resolution(self, store, binder):
+        store.create("storageclasses", StorageClass.from_dict({
+            "metadata": {"name": "standard",
+                         "annotations": {
+                             "storageclass.kubernetes.io/is-default-class":
+                                 "true"}},
+            "volumeBindingMode": "Immediate"}))
+        store.create("persistentvolumes", make_pv("pv-s", 10, "standard"))
+        # storageClassName ABSENT -> default class applies
+        store.create("persistentvolumeclaims", make_pvc("c", 5, None))
+        binder.run_until_stable()
+        assert store.get("persistentvolumeclaims",
+                         "default/c").spec.volume_name == "pv-s"
+
+
+class TestAttachDetach:
+    def test_attach_and_detach(self, store):
+        binder = PersistentVolumeBinder(store)
+        binder.sync_all()
+        ad = AttachDetachController(store)
+        ad.sync_all()
+        store.create("nodes", MakeNode("n1").capacity({"cpu": "8"}).obj())
+        store.create("persistentvolumes", make_pv("pv-a", 10))
+        store.create("persistentvolumeclaims", make_pvc("data", 5))
+        binder.run_until_stable()
+        pod = MakePod("p").req({"cpu": "100m"}).obj()
+        from kubernetes_tpu.api.types import Volume as PodVolume
+
+        pod.spec.volumes = [PodVolume(name="v", pvc_claim_name="data")]
+        store.create("pods", pod)
+        store.bind("default", "p", "n1")
+        ad.run_until_stable()
+        va = store.get("volumeattachments", attachment_name("pv-a", "n1"))
+        assert va.attached and va.node_name == "n1" and va.pv_name == "pv-a"
+        store.delete("pods", "default/p")
+        ad.run_until_stable()
+        with pytest.raises(NotFoundError):
+            store.get("volumeattachments", attachment_name("pv-a", "n1"))
+
+
+class TestResourceClaimController:
+    def _template(self, store, name="gpu-tmpl"):
+        t = ResourceClaimTemplate(
+            requests=[DeviceRequest(name="gpu",
+                                    device_class_name="gpu.example.com")])
+        t.metadata.name = name
+        t.metadata.namespace = "default"
+        store.create("resourceclaimtemplates", t)
+
+    def test_template_spawns_claim(self, store):
+        self._template(store)
+        rc = ResourceClaimController(store)
+        rc.sync_all()
+        pod = MakePod("worker").req({"cpu": "100m"}).obj()
+        pod.spec.resource_claim_templates = [("gpu", "gpu-tmpl")]
+        store.create("pods", pod)
+        rc.run_until_stable()
+        claim = store.get("resourceclaims", "default/worker-gpu")
+        assert claim.requests[0].device_class_name == "gpu.example.com"
+        assert claim.metadata.owner_references[0]["name"] == "worker"
+        pod = store.get("pods", "default/worker")
+        assert pod.status.resource_claim_statuses == {"gpu": "worker-gpu"}
+
+    def test_orphan_reaped(self, store):
+        self._template(store)
+        rc = ResourceClaimController(store)
+        rc.sync_all()
+        pod = MakePod("gone").req({"cpu": "100m"}).obj()
+        pod.spec.resource_claim_templates = [("gpu", "gpu-tmpl")]
+        store.create("pods", pod)
+        rc.run_until_stable()
+        assert store.get("resourceclaims", "default/gone-gpu")
+        store.delete("pods", "default/gone")
+        rc.run_until_stable()
+        with pytest.raises(NotFoundError):
+            store.get("resourceclaims", "default/gone-gpu")
+
+    def test_recreated_pod_regenerates_claim(self, store):
+        # same-name pod recreated with a new uid while the old generated
+        # claim lingers: the stale claim must NOT be adopted — it is reaped
+        # and a fresh one generated for the new incarnation
+        self._template(store)
+        rc = ResourceClaimController(store)
+        rc.sync_all()
+        pod = MakePod("w3").req({"cpu": "100m"}).obj()
+        pod.spec.resource_claim_templates = [("gpu", "gpu-tmpl")]
+        store.create("pods", pod)
+        rc.run_until_stable()
+        old_claim = store.get("resourceclaims", "default/w3-gpu")
+        old_uid = old_claim.metadata.owner_references[0]["uid"]
+        store.delete("pods", "default/w3")
+        # recreate BEFORE the controller reaps
+        pod2 = MakePod("w3").req({"cpu": "100m"}).obj()
+        pod2.spec.resource_claim_templates = [("gpu", "gpu-tmpl")]
+        store.create("pods", pod2)
+        rc.run_until_stable()
+        claim = store.get("resourceclaims", "default/w3-gpu")
+        new_uid = claim.metadata.owner_references[0]["uid"]
+        assert new_uid == pod2.metadata.uid != old_uid
+        got = store.get("pods", "default/w3")
+        assert got.status.resource_claim_statuses == {"gpu": "w3-gpu"}
+
+    def test_periodic_sweep_reaps_without_events(self, store):
+        self._template(store)
+        rc = ResourceClaimController(store)
+        rc.sync_all()
+        pod = MakePod("w2").req({"cpu": "100m"}).obj()
+        pod.spec.resource_claim_templates = [("gpu", "gpu-tmpl")]
+        store.create("pods", pod)
+        rc.run_until_stable()
+        store.delete("pods", "default/w2")
+        # drop the delete event on the floor (fresh controller, no watch
+        # history) — only the sweep can find the orphan
+        rc2 = ResourceClaimController(store)
+        rc2.sync_all()
+        rc2._dirty.clear()
+        rc2.reap_orphans()
+        with pytest.raises(NotFoundError):
+            store.get("resourceclaims", "default/w2-gpu")
+
+    def test_scheduler_resolves_template_claim(self, store):
+        """End to end: template-backed pod waits for its generated claim,
+        then schedules through the DRA plugin once the controller stamps
+        status.resourceClaimStatuses."""
+        from kubernetes_tpu.api.dra import Device, DeviceClass, ResourceSlice
+        from kubernetes_tpu.scheduler import Framework
+        from kubernetes_tpu.scheduler.serial import Scheduler
+        from kubernetes_tpu.scheduler.plugins import default_plugins
+        from kubernetes_tpu.utils.featuregate import feature_gates
+
+        self._template(store)
+        dc = DeviceClass(); dc.metadata.name = "gpu.example.com"
+        store.create("deviceclasses", dc)
+        store.create("nodes", MakeNode("n1").capacity(
+            {"cpu": "8", "memory": "16Gi", "pods": "10"}).obj())
+        sl = ResourceSlice(node_name="n1",
+                           devices=[Device(name="gpu0")])
+        sl.metadata.name = "n1-slice"
+        store.create("resourceslices", sl)
+        rc = ResourceClaimController(store)
+        rc.sync_all()
+        feature_gates.set("DynamicResourceAllocation", True)
+        try:
+            sched = Scheduler(store, Framework(default_plugins()))
+            sched.sync()
+            pod = MakePod("worker").req({"cpu": "100m"}).obj()
+            pod.spec.resource_claim_templates = [("gpu", "gpu-tmpl")]
+            store.create("pods", pod)
+            rc.run_until_stable()
+            sched.run_until_idle()
+            assert store.get("pods",
+                             "default/worker").spec.node_name == "n1"
+            claim = store.get("resourceclaims", "default/worker-gpu")
+            assert claim.allocation is not None
+            assert claim.allocation.node_name == "n1"
+        finally:
+            feature_gates.set("DynamicResourceAllocation", False)
