@@ -15,7 +15,7 @@ from __future__ import annotations
 import itertools
 import threading
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 # container states (api.proto ContainerState)
 CONTAINER_CREATED = "CONTAINER_CREATED"
@@ -79,6 +79,17 @@ class CRIRuntime:
     def pull_image(self, image: str) -> None:
         raise NotImplementedError
 
+    def exec_sync(self, pod_key: str, container: str, command: List[str],
+                  stdin: bytes = b"") -> "Tuple[bytes, bytes, int]":
+        """Run a command in the container (CRI ExecSync rpc,
+        cri-api api.proto). Returns (stdout, stderr, exit_code)."""
+        raise NotImplementedError
+
+    def port_data(self, pod_key: str, port: int, data: bytes) -> bytes:
+        """One port-forward connection round: bytes in, bytes out (the data
+        channel of the CRI PortForward stream)."""
+        raise NotImplementedError
+
 
 class FakeRuntime(CRIRuntime):
     """In-memory runtime. Containers run until `exit_container` is called or
@@ -95,6 +106,8 @@ class FakeRuntime(CRIRuntime):
         self.run_durations: Dict[str, float] = {}  # image -> seconds until exit 0
         self.fail_images: Dict[str, int] = {}  # image -> exit code on completion
         self.calls: List[str] = []  # rpc log (FakeRuntime.CalledFunctions)
+        self._exec_handler: Optional[Callable] = None
+        self._port_handlers: Dict[int, Callable[[bytes], bytes]] = {}
 
     # -- RuntimeService --------------------------------------------------------
 
@@ -161,6 +174,55 @@ class FakeRuntime(CRIRuntime):
         with self._lock:
             self.calls.append("PullImage")
             self.pulled_images.append(image)
+
+    def exec_sync(self, pod_key: str, container: str, command: List[str],
+                  stdin: bytes = b"") -> Tuple[bytes, bytes, int]:
+        """Emulated ExecSync: a handful of real shell semantics (echo, cat,
+        true/false, env) so exec round-trips carry meaningful bytes; tests
+        override per-command behavior with `set_exec_handler`."""
+        with self._lock:
+            self.calls.append("ExecSync")
+            handler = self._exec_handler
+        if handler is not None:
+            return handler(pod_key, container, command, stdin)
+        if not command:
+            return b"", b"exec requires a command\n", 1
+        prog = command[0]
+        if prog == "echo":
+            return (" ".join(command[1:]) + "\n").encode(), b"", 0
+        if prog == "cat":
+            return stdin, b"", 0
+        if prog == "true":
+            return b"", b"", 0
+        if prog == "false":
+            return b"", b"", 1
+        if prog == "hostname":
+            return (pod_key.split("/", 1)[-1] + "\n").encode(), b"", 0
+        if prog == "env":
+            return f"POD={pod_key}\nCONTAINER={container}\n".encode(), b"", 0
+        return (f"exec: {' '.join(command)}\n").encode(), b"", 0
+
+    def set_exec_handler(self, fn: Optional[Callable]) -> None:
+        with self._lock:
+            self._exec_handler = fn
+
+    def port_data(self, pod_key: str, port: int, data: bytes) -> bytes:
+        """Echo backend by default; tests register per-port servers with
+        `set_port_handler` (e.g. a canned HTTP response)."""
+        with self._lock:
+            self.calls.append("PortForward")
+            handler = self._port_handlers.get(port)
+        if handler is not None:
+            return handler(data)
+        return b"ECHO:" + data
+
+    def set_port_handler(self, port: int,
+                         fn: Optional[Callable[[bytes], bytes]]) -> None:
+        with self._lock:
+            if fn is None:
+                self._port_handlers.pop(port, None)
+            else:
+                self._port_handlers[port] = fn
 
     # -- test hooks ------------------------------------------------------------
 

@@ -271,7 +271,11 @@ class Kubelet:
         register_node(self.store, self.node_name, self.capacity, self.labels)
         self.heartbeat()
         _, rv = self.store.list("pods")
-        self._watch = self.store.watch("pods", since_rv=rv)
+        # pods for config + exec/port-forward session channels (the
+        # kubelet-server surface of pkg/kubelet/server/server.go)
+        self._watch = self.store.watch(
+            ("pods", "podexecs", "podportforwards"), since_rv=rv)
+        self._serve_pending_sessions()
         # adopt pods already bound here (restart recovery: state comes from
         # the store + runtime relist, kubelet is stateless modulo checkpoints)
         pods, _ = self.store.list("pods", lambda p: p.spec.node_name == self.node_name)
@@ -315,7 +319,10 @@ class Kubelet:
             # stateless modulo checkpoints)
             self._watch.stop()
             _, rv = self.store.list("pods")
-            self._watch = self.store.watch("pods", since_rv=rv)
+            self._watch = self.store.watch(
+                ("pods", "podexecs", "podportforwards"), since_rv=rv)
+            # sessions created during the watch gap would otherwise be lost
+            self._serve_pending_sessions()
             pods, _ = self.store.list(
                 "pods", lambda p: p.spec.node_name == self.node_name)
             live = {p.key for p in pods if not p.is_terminal()}
@@ -328,6 +335,14 @@ class Kubelet:
             return 0
         n = 0
         for ev in self._watch.drain():
+            if ev.kind == "podexecs":
+                if ev.type != "DELETED":
+                    self._serve_exec(ev.obj)
+                continue
+            if ev.kind == "podportforwards":
+                if ev.type != "DELETED":
+                    self._serve_portforward(ev.obj)
+                continue
             pod = ev.obj
             if pod.spec.node_name != self.node_name:
                 continue
@@ -339,6 +354,110 @@ class Kubelet:
             elif pod.key not in self.workers:
                 self._start_pod(pod)
         return n
+
+    # -- exec / attach / port-forward (kubelet server analog) ------------------
+
+    def _serve_pending_sessions(self) -> None:
+        """Answer sessions whose events this kubelet never saw (fresh
+        registration, or a watch-eviction relist gap)."""
+        for sess in self.store.list("podexecs", lambda s: not s.done)[0]:
+            self._serve_exec(sess)
+        for sess in self.store.list("podportforwards",
+                                    lambda s: not s.done)[0]:
+            self._serve_portforward(sess)
+
+    def _owns_session_pod(self, sess):
+        """The pod this session targets, when it is bound HERE; else None."""
+        from ..store import NotFoundError
+
+        try:
+            pod = self.store.get(
+                "pods", f"{sess.metadata.namespace}/{sess.pod_name}")
+        except NotFoundError:
+            return None
+        return pod if pod.spec.node_name == self.node_name else None
+
+    def _serve_exec(self, sess) -> None:
+        import base64
+
+        from ..api.execapi import ATTACH_COMMAND
+
+        if sess.done:
+            return
+        pod = self._owns_session_pod(sess)
+        if pod is None:
+            return
+        pod_key = pod.key
+        container = sess.container or (
+            pod.spec.containers[0].name if pod.spec.containers else "")
+        try:
+            # inside the guard: malformed base64 from a client must fail
+            # THIS session, never the kubelet's sync loop
+            stdin = base64.b64decode(sess.stdin) if sess.stdin else b""
+            if sess.command == [ATTACH_COMMAND]:
+                # attach: stdin goes to the container (folded into its log —
+                # the fake runtime's terminal), output = recent log lines
+                if stdin:
+                    self._log_line(
+                        pod, container,
+                        "stdin: "
+                        + stdin.decode(errors="replace").rstrip("\n"))
+                from ..store import NotFoundError
+
+                try:
+                    log = self.store.get("podlogs", pod_key)
+                    out = "\n".join(log.entries[-10:]) + "\n"
+                except NotFoundError:
+                    out = ""
+                stdout, stderr, code = out.encode(), b"", 0
+            else:
+                stdout, stderr, code = self.runtime.exec_sync(
+                    pod_key, container, sess.command, stdin)
+            err_text = ""
+        except Exception as e:  # runtime failure surfaces in the session
+            stdout, stderr, code = b"", b"", 1
+            err_text = str(e)
+
+        def finish(s):
+            s.stdout = stdout.decode(errors="replace")
+            s.stderr = stderr.decode(errors="replace")
+            s.exit_code = int(code)
+            s.done = True
+            s.error = err_text
+            return s
+
+        try:
+            self.store.guaranteed_update("podexecs", sess.key, finish)
+        except Exception:
+            pass  # session deleted under us (client gave up)
+
+    def _serve_portforward(self, sess) -> None:
+        import base64
+
+        if sess.done:
+            return
+        pod = self._owns_session_pod(sess)
+        if pod is None:
+            return
+        try:
+            data = base64.b64decode(sess.data) if sess.data else b""
+            answer = self.runtime.port_data(pod.key, sess.port, data)
+            response = base64.b64encode(answer).decode()
+            err_text = ""
+        except Exception as e:
+            response = ""
+            err_text = str(e)
+
+        def finish(s):
+            s.response = response
+            s.done = True
+            s.error = err_text
+            return s
+
+        try:
+            self.store.guaranteed_update("podportforwards", sess.key, finish)
+        except Exception:
+            pass
 
     def _retry_config_blocked(self) -> None:
         """Pods blocked on missing ConfigMap/Secret refs get re-attempted

@@ -56,6 +56,7 @@ from .flowcontrolapi import (
 )
 from .dra import DeviceClass, ResourceClaim, ResourceClaimTemplate, ResourceSlice
 from .events import Event as CoreEvent, PodLog
+from .execapi import PodExec, PodPortForward
 from .storage import (
     CSINode,
     PersistentVolume,
@@ -105,6 +106,8 @@ KIND_TO_RESOURCE = {
     "VolumeAttachment": "volumeattachments",
     "ResourceClaimTemplate": "resourceclaimtemplates",
     "PodLog": "podlogs",
+    "PodExec": "podexecs",
+    "PodPortForward": "podportforwards",
     "ConfigMap": "configmaps",
     "Secret": "secrets",
     "Ingress": "ingresses",
@@ -149,6 +152,8 @@ RESOURCE_TO_TYPE = {
     "volumeattachments": VolumeAttachment,
     "resourceclaimtemplates": ResourceClaimTemplate,
     "podlogs": PodLog,
+    "podexecs": PodExec,
+    "podportforwards": PodPortForward,
     "configmaps": ConfigMap,
     "secrets": Secret,
     "ingresses": Ingress,
@@ -203,6 +208,8 @@ GROUP_PREFIX = {
     "customresourcedefinitions": "/apis/apiextensions.k8s.io/v1",
     "certificatesigningrequests": "/apis/certificates.k8s.io/v1",
     "podlogs": "/api/v1",
+    "podexecs": "/api/v1",
+    "podportforwards": "/api/v1",
     "configmaps": "/api/v1",
     "secrets": "/api/v1",
     "ingresses": "/apis/networking.k8s.io/v1",

@@ -197,6 +197,81 @@ class JoinedNode:
         for key in list(self.running):
             if key not in seen:
                 self.running.pop(key, None)
+        n += self._serve_stream_sessions(seen)
+        return n
+
+    def _serve_stream_sessions(self, my_pods) -> int:
+        """Answer exec/attach/port-forward sessions for pods on this node —
+        the HTTP face of the kubelet server's streaming endpoints. The
+        command emulation is FakeRuntime's exec_sync/port_data (one table,
+        shared with in-process kubelets, not a drifting copy)."""
+        import base64
+
+        from ..agent.cri import FakeRuntime
+        from ..api.execapi import ATTACH_COMMAND
+
+        if not hasattr(self, "_exec_runtime"):
+            self._exec_runtime = FakeRuntime()
+        n = 0
+        try:
+            sessions, _ = self.client.list("podexecs")
+        except APIError:
+            sessions = []
+        for s in sessions:
+            spec, st = s.get("spec") or {}, s.get("status") or {}
+            ns = (s.get("metadata") or {}).get("namespace", "default")
+            pod_key = f"{ns}/{spec.get('podName', '')}"
+            if st.get("done") or pod_key not in my_pods:
+                continue
+            try:
+                # per-session guard: one malformed session must not starve
+                # the rest of this pass (it gets marked done with an error)
+                stdin = base64.b64decode(spec.get("stdin") or "")
+                cmd = list(spec.get("command") or [])
+                if cmd == [ATTACH_COMMAND]:
+                    out = "attached (hollow)\n" + stdin.decode(
+                        errors="replace")
+                    err_b, code, error = "", 0, ""
+                else:
+                    o, e, code = self._exec_runtime.exec_sync(
+                        pod_key, spec.get("container", ""), cmd, stdin)
+                    out = o.decode(errors="replace")
+                    err_b, error = e.decode(errors="replace"), ""
+            except Exception as ex:
+                out, err_b, code, error = "", "", 1, str(ex)
+            s.setdefault("status", {}).update(
+                {"stdout": out, "stderr": err_b, "exitCode": code,
+                 "done": True, **({"error": error} if error else {})})
+            try:
+                self.client.update("podexecs", s, ns)
+                n += 1
+            except APIError:
+                pass  # deleted (client gave up) or conflict: next pass
+        try:
+            forwards, _ = self.client.list("podportforwards")
+        except APIError:
+            forwards = []
+        for s in forwards:
+            spec, st = s.get("spec") or {}, s.get("status") or {}
+            ns = (s.get("metadata") or {}).get("namespace", "default")
+            pod_key = f"{ns}/{spec.get('podName', '')}"
+            if st.get("done") or pod_key not in my_pods:
+                continue
+            try:
+                data = base64.b64decode(spec.get("data") or "")
+                answer = self._exec_runtime.port_data(
+                    pod_key, int(spec.get("port", 0) or 0), data)
+                s.setdefault("status", {}).update(
+                    {"data": base64.b64encode(answer).decode(),
+                     "done": True})
+            except Exception as ex:
+                s.setdefault("status", {}).update(
+                    {"done": True, "error": str(ex)})
+            try:
+                self.client.update("podportforwards", s, ns)
+                n += 1
+            except APIError:
+                pass
         return n
 
     def _append_log(self, pod, message: str) -> None:

@@ -544,6 +544,101 @@ def cmd_auth_can_i(client: RESTClient, args) -> int:
     return 0 if allowed else 1
 
 
+def cmd_exec(client: RESTClient, args) -> int:
+    """kubectl exec [-i] [-c container] POD -- CMD... over the store-channel
+    session (reference: kubectl/pkg/cmd/exec/exec.go); exits with the
+    remote command's exit code."""
+    command = list(args.command)
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        raise CLIError("exec requires a command after --")
+    ns = args.namespace or "default"
+    stdin = b""
+    if getattr(args, "stdin", False):
+        stdin = sys.stdin.buffer.read()
+    try:
+        out = client.exec(args.pod, command, ns,
+                          container=args.container or "", stdin=stdin)
+    except APIError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    sys.stdout.write(out.get("stdout", ""))
+    sys.stderr.write(out.get("stderr", ""))
+    return int(out.get("exitCode", 0) or 0)
+
+
+def cmd_attach(client: RESTClient, args) -> int:
+    """kubectl attach: the running container's recent output; -i forwards
+    stdin to the container."""
+    ns = args.namespace or "default"
+    stdin = b""
+    if getattr(args, "stdin", False):
+        stdin = sys.stdin.buffer.read()
+    try:
+        out = client.attach(args.pod, ns, container=args.container or "",
+                            stdin=stdin)
+    except APIError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    sys.stdout.write(out.get("stdout", ""))
+    return int(out.get("exitCode", 0) or 0)
+
+
+def cmd_port_forward(client: RESTClient, args) -> int:
+    """kubectl port-forward POD LOCAL:REMOTE — a local TCP listener whose
+    connections round-trip through the pod's port-forward channel. Serves
+    until interrupted; --one-connection exits after the first round
+    (scriptable/testable mode)."""
+    import socket
+
+    local, _, remote = args.ports.partition(":")
+    if not remote:
+        remote = local
+    local_port, remote_port = int(local), int(remote)
+    ns = args.namespace or "default"
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", local_port))
+    srv.listen(4)
+    bound = srv.getsockname()[1]
+    print(f"Forwarding from 127.0.0.1:{bound} -> {remote_port}")
+    sys.stdout.flush()
+    try:
+        while True:
+            conn, _addr = srv.accept()
+            try:
+                conn.settimeout(5.0)
+                chunks = []
+                try:
+                    while True:
+                        b = conn.recv(65536)
+                        if not b:
+                            break
+                        chunks.append(b)
+                        if len(b) < 65536:
+                            break  # request fits; answer now
+                except TimeoutError:
+                    pass
+                data = b"".join(chunks)
+                try:
+                    answer = client.port_forward(args.pod, remote_port,
+                                                 data, ns)
+                    conn.sendall(answer)
+                except APIError as e:
+                    # one failed round must not kill the listener
+                    print(f"error forwarding connection: {e}",
+                          file=sys.stderr)
+            finally:
+                conn.close()
+            if getattr(args, "one_connection", False):
+                return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        srv.close()
+
+
 def cmd_logs(client: RESTClient, args) -> int:
     """kubectl logs [-f]: the pods/{name}/log subresource (text/plain);
     --follow streams new lines by watching the pod's PodLog channel."""
@@ -1226,6 +1321,26 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--tail", type=int, default=0)
     p.add_argument("-f", "--follow", action="store_true")
     p.set_defaults(fn=cmd_logs)
+
+    p = sub.add_parser("exec")
+    p.add_argument("pod")
+    p.add_argument("-c", "--container", default="")
+    p.add_argument("-i", "--stdin", action="store_true")
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="command after --")
+    p.set_defaults(fn=cmd_exec)
+
+    p = sub.add_parser("attach")
+    p.add_argument("pod")
+    p.add_argument("-c", "--container", default="")
+    p.add_argument("-i", "--stdin", action="store_true")
+    p.set_defaults(fn=cmd_attach)
+
+    p = sub.add_parser("port-forward")
+    p.add_argument("pod")
+    p.add_argument("ports", help="LOCAL:REMOTE (or one port for both)")
+    p.add_argument("--one-connection", action="store_true")
+    p.set_defaults(fn=cmd_port_forward)
 
     p = sub.add_parser("scale")
     p.add_argument("resource")

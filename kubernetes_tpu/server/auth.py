@@ -212,7 +212,9 @@ def default_component_authorizer() -> RBACAuthorizer:
     a.grant("group:system:nodes",
             ["get", "list", "watch", "create", "update", "patch", "delete"],
             ["pods", "nodes", "leases", "events", "podlogs",
-             "pods/status", "nodes/status"])
+             "pods/status", "nodes/status",
+             # streaming session channels the kubelet answers
+             "podexecs", "podportforwards"])
     # nodes may renew their own credential (certificatesigningrequests
     # recognizer allows requestor == requested node identity)
     a.grant("group:system:nodes", ["create", "get", "list", "watch"],
@@ -228,5 +230,7 @@ def default_component_authorizer() -> RBACAuthorizer:
     # Wildcard-with-carve-out keeps CRD-served plurals readable by default
     # while secrets require an explicit grant.
     a.grant("group:system:authenticated", ["get", "list", "watch"], ["*"],
-            except_resources=("secrets",))
+            # exec stdin/stdout and port-forward bytes are exactly as
+            # sensitive as secret payloads: carved out of wildcard reads
+            except_resources=("secrets", "podexecs", "podportforwards"))
     return a

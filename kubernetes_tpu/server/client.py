@@ -191,6 +191,49 @@ class RESTClient:
                             self._path(resource, ns, meta["name"], "status"),
                             obj_dict)
 
+    def exec(self, name: str, command, namespace: str = "default",
+             container: str = "", stdin: bytes = b"",
+             timeout_seconds: float = 10.0) -> Dict:
+        """Run a command in a pod's container (pods/{name}/exec session
+        channel). Returns {stdout, stderr, exitCode}."""
+        import base64
+
+        body = {"command": list(command), "container": container,
+                "timeoutSeconds": timeout_seconds}
+        if stdin:
+            body["stdin"] = base64.b64encode(stdin).decode()
+        return self.request("POST", self._path("pods", namespace, name, "exec"),
+                            body, timeout=timeout_seconds + 5)
+
+    def attach(self, name: str, namespace: str = "default",
+               container: str = "", stdin: bytes = b"",
+               timeout_seconds: float = 10.0) -> Dict:
+        """Attach to the running container: recent output + optional stdin."""
+        import base64
+
+        body = {"container": container, "timeoutSeconds": timeout_seconds}
+        if stdin:
+            body["stdin"] = base64.b64encode(stdin).decode()
+        return self.request("POST",
+                            self._path("pods", namespace, name, "attach"),
+                            body, timeout=timeout_seconds + 5)
+
+    def port_forward(self, name: str, port: int, data: bytes,
+                     namespace: str = "default",
+                     timeout_seconds: float = 10.0) -> bytes:
+        """One port-forward connection round: bytes out, bytes back."""
+        import base64
+
+        out = self.request(
+            "POST", self._path("pods", namespace, name, "portforward"),
+            {"port": port, "data": base64.b64encode(data).decode(),
+             "timeoutSeconds": timeout_seconds},
+            timeout=timeout_seconds + 5)
+        if out.get("error"):
+            # backend failure must not masquerade as an empty response
+            raise APIError(502, out["error"])
+        return base64.b64decode(out.get("data", ""))
+
     def evict(self, name: str, namespace: str = "default") -> Dict:
         """PDB-respecting eviction (pods/{name}/eviction); 429 when a
         matching budget has no disruptions left."""

@@ -388,6 +388,11 @@ class _Handler(BaseHTTPRequestHandler):
             resource = "serviceaccounts/token"
         elif self.command == "POST" and sub == "eviction" and resource == "pods":
             resource = "pods/eviction"
+        elif self.command == "POST" and resource == "pods" \
+                and sub in ("exec", "attach", "portforward"):
+            # running commands in containers is a bigger power than creating
+            # pods (the reference's pods/exec RBAC resource)
+            resource = f"pods/{sub}"
         elif self.command in ("PUT", "PATCH") and sub == "status":
             resource = f"{resource}/status"
         return verb, resource
@@ -805,6 +810,9 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._send_json(201, {"kind": "Status", "status": "Success"})
             return
+        if sub in ("exec", "attach", "portforward") and resource == "pods":
+            self._pod_stream_session(ns, name, sub, body)
+            return
         if sub == "token" and resource == "serviceaccounts":
             # TokenRequest subresource: mint a signed bearer credential for
             # the service account identity (registry/core/serviceaccount/
@@ -939,6 +947,89 @@ class _Handler(BaseHTTPRequestHandler):
         new_obj.metadata.uid = obj.metadata.uid
         new_obj.metadata.resource_version = obj.metadata.resource_version
         return new_obj, patches, None
+
+    def _pod_stream_session(self, ns: str, name: str, sub: str, body) -> None:
+        """exec / attach / port-forward over a store-channel session
+        (api/execapi.py): create the session, long-poll until the pod's
+        kubelet answers, return the result, delete the session. Replaces
+        the reference's SPDY stream through the apiserver proxy
+        (kubelet/server/server.go; kubectl/pkg/cmd/exec/exec.go)."""
+        import time as _time
+        import uuid as _uuid
+
+        from ..api.execapi import ATTACH_COMMAND, PodExec, PodPortForward
+
+        if not isinstance(body, dict):
+            self._error(400, "body must be a JSON object", "BadRequest")
+            return
+        try:
+            pod = self.store.get("pods", f"{ns}/{name}")
+        except NotFoundError as e:
+            self._error(404, str(e), "NotFound")
+            return
+        if not pod.spec.node_name:
+            self._error(409, f"pod {name} is not scheduled to a node yet",
+                        "Conflict")
+            return
+        try:
+            timeout = min(float(body.get("timeoutSeconds", 10) or 10), 30.0)
+            port = int(body.get("port", 0) or 0)
+        except (TypeError, ValueError) as e:
+            self._error(400, f"invalid session parameters: {e}", "BadRequest")
+            return
+        owner = [{"apiVersion": "v1", "kind": "Pod", "name": name,
+                  "uid": pod.metadata.uid, "controller": True}]
+        sid = f"{sub}-{name}-{_uuid.uuid4().hex[:8]}"
+        if sub == "portforward":
+            sess = PodPortForward(pod_name=name, port=port,
+                                  data=body.get("data", ""))
+            kind = "podportforwards"
+        else:
+            command = list(body.get("command") or [])
+            if sub == "attach":
+                command = [ATTACH_COMMAND]
+            elif not command:
+                self._error(400, "exec requires a command", "BadRequest")
+                return
+            sess = PodExec(pod_name=name, container=body.get("container", ""),
+                           command=command, stdin=body.get("stdin", ""),
+                           tty=bool(body.get("tty", False)))
+            kind = "podexecs"
+        sess.metadata.name = sid
+        sess.metadata.namespace = ns
+        sess.metadata.owner_references = owner
+        self.store.create(kind, sess)
+        deadline = _time.monotonic() + timeout
+        result = None
+        while _time.monotonic() < deadline:
+            try:
+                cur = self.store.get(kind, f"{ns}/{sid}")
+            except NotFoundError:
+                break  # pod (and session) deleted mid-round
+            if cur.done:
+                result = cur
+                break
+            _time.sleep(0.02)
+        try:
+            self.store.delete(kind, f"{ns}/{sid}")
+        except NotFoundError:
+            pass
+        if result is None:
+            self._error(504, f"{sub} timed out after {timeout:.0f}s waiting "
+                        "for the node agent", "Timeout")
+            return
+        if kind == "podportforwards":
+            self._send_json(200, {"kind": "Status", "status": "Success",
+                                  "data": result.response,
+                                  **({"error": result.error}
+                                     if result.error else {})})
+        else:
+            self._send_json(200, {"kind": "Status", "status": "Success",
+                                  "stdout": result.stdout,
+                                  "stderr": result.stderr,
+                                  "exitCode": result.exit_code,
+                                  **({"error": result.error}
+                                     if result.error else {})})
 
     def _admission_verdict(self, resource: str, operation: str, obj, user=None):
         """Run the admission chain; returns None on admit or an
