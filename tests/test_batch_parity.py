@@ -17,19 +17,30 @@ from kubernetes_tpu.store import APIStore
 from kubernetes_tpu.testing import MakeNode, MakePod
 
 
-def run_both(nodes, pods):
+def run_one(cls, nodes, pods, solver=None, preload=()):
+    """Build a store (preloaded pods are pre-bound state), run one scheduler
+    class to idle, return the store."""
+    store = APIStore()
+    for n in nodes:
+        store.create("nodes", n)
+    for p in preload:
+        store.create("pods", p)
+    for p in pods:
+        store.create("pods", p)
+    kwargs = {"solver": solver} if solver else {}
+    sched = cls(store, Framework(default_plugins()), **kwargs)
+    sched.sync()
+    sched.run_until_idle()
+    return store
+
+
+def run_both(nodes, pods, solver=None):
     """Run serial and batch schedulers over identical stores; return the two
     {pod name: node name} assignment maps."""
     results = []
     for cls in (Scheduler, BatchScheduler):
-        store = APIStore()
-        for n in nodes:
-            store.create("nodes", n)
-        for p in pods:
-            store.create("pods", p)
-        sched = cls(store, Framework(default_plugins()))
-        sched.sync()
-        sched.run_until_idle()
+        store = run_one(cls, nodes, pods,
+                        solver=solver if cls is BatchScheduler else None)
         got, _ = store.list("pods")
         results.append({p.metadata.name: p.spec.node_name for p in got})
     return results
