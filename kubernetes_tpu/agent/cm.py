@@ -1,0 +1,206 @@
+"""Kubelet resource managers: static CPU pinning + NUMA topology hints.
+
+reference: pkg/kubelet/cm/cpumanager/policy_static.go (the static policy:
+guaranteed-QoS pods with integer CPU requests get EXCLUSIVE cpus carved out
+of the shared pool, checkpointed so restarts keep assignments) and
+pkg/kubelet/cm/topologymanager (per-resource NUMA hints merged into one
+affinity; best-effort admits unaligned allocations, restricted rejects the
+pod with TopologyAffinityError).
+
+Device locality IS the product on a TPU host — the chip sits on one NUMA
+node and the feeding dataloader threads must pin beside it — so the static
+policy here prefers single-NUMA allocations exactly as the reference's hint
+merge does, and the chosen cpus are deterministic (lowest ids within the
+chosen NUMA node first) for reproducible tests.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+
+@dataclass
+class CPUTopology:
+    """n_cpus spread evenly over numa_nodes (cpu i lives on NUMA
+    i // (n_cpus // numa_nodes)) — the discovery result of cadvisor's
+    topology probe, simplified."""
+
+    n_cpus: int = 8
+    numa_nodes: int = 2
+
+    def numa_of(self, cpu: int) -> int:
+        per = max(1, self.n_cpus // max(1, self.numa_nodes))
+        return min(cpu // per, self.numa_nodes - 1)
+
+    def cpus_of_numa(self, numa: int) -> List[int]:
+        return [c for c in range(self.n_cpus) if self.numa_of(c) == numa]
+
+
+class TopologyAffinityError(Exception):
+    """restricted policy: no single-NUMA allocation exists
+    (topologymanager scope container, policy restricted)."""
+
+
+def pod_is_guaranteed(pod) -> bool:
+    """Guaranteed QoS (qos.GetPodQOS): every container's requests == limits
+    for cpu and memory, and both are set."""
+    containers = list(pod.spec.containers) + list(pod.spec.init_containers)
+    if not containers:
+        return False
+    for c in containers:
+        req = (c.resources or {}).get("requests") or {}
+        lim = (c.resources or {}).get("limits") or {}
+        for res in ("cpu", "memory"):
+            if res not in req or res not in lim:
+                return False
+            if req[res] != lim[res]:
+                return False
+    return True
+
+
+def _integer_cpus(container) -> int:
+    """Exclusive-cpu count for a container: its integer cpu request, or 0
+    when fractional (fractional guaranteed containers stay in the shared
+    pool — policy_static.go guaranteedCPUs)."""
+    from ..api.resources import parse_quantity_milli
+
+    req = (container.resources or {}).get("requests") or {}
+    if "cpu" not in req:
+        return 0
+    millis = parse_quantity_milli(req["cpu"])
+    if millis <= 0 or millis % 1000:
+        return 0
+    return millis // 1000
+
+
+class CPUManager:
+    """Static policy + topology hints, checkpointed.
+
+    State: pod key -> container -> sorted cpu ids. The shared pool is
+    everything unassigned; non-guaranteed pods always run there."""
+
+    CHECKPOINT_KEY = "cpu-manager-state"
+
+    def __init__(self, topology: Optional[CPUTopology] = None,
+                 checkpoints=None, topology_policy: str = "best-effort"):
+        self.topology = topology or CPUTopology()
+        self.checkpoints = checkpoints
+        self.topology_policy = topology_policy
+        self.assignments: Dict[str, Dict[str, List[int]]] = {}
+        self._restore()
+
+    # -- pool accounting -------------------------------------------------------
+
+    def _used(self) -> Set[int]:
+        return {c for pods in self.assignments.values()
+                for cpus in pods.values() for c in cpus}
+
+    def shared_pool(self) -> List[int]:
+        used = self._used()
+        return [c for c in range(self.topology.n_cpus) if c not in used]
+
+    # -- allocation ------------------------------------------------------------
+
+    def _pick(self, n: int) -> Optional[List[int]]:
+        """n cpus from the free pool, single-NUMA when possible (the
+        topology manager's merged hint); deterministic lowest-id order."""
+        free = self.shared_pool()
+        if len(free) < n:
+            return None
+        by_numa: Dict[int, List[int]] = {}
+        for c in free:
+            by_numa.setdefault(self.topology.numa_of(c), []).append(c)
+        aligned = [cpus for _numa, cpus in sorted(by_numa.items())
+                   if len(cpus) >= n]
+        if aligned:
+            return sorted(aligned[0])[:n]
+        if self.topology_policy == "restricted":
+            raise TopologyAffinityError(
+                f"no single-NUMA placement for {n} exclusive cpus "
+                f"(free per NUMA: "
+                f"{ {k: len(v) for k, v in sorted(by_numa.items())} })")
+        return sorted(free)[:n]  # best-effort: spill across NUMA nodes
+
+    def allocate_pod(self, pod) -> Dict[str, List[int]]:
+        """Exclusive cpus for every eligible container of a guaranteed pod;
+        {} for pods that stay entirely in the shared pool. Raises
+        TopologyAffinityError (restricted) or RuntimeError (pool empty) —
+        the caller fails pod admission like the reference kubelet."""
+        key = pod.key
+        if key in self.assignments:
+            return self.assignments[key]
+        if not pod_is_guaranteed(pod):
+            return {}
+        got: Dict[str, List[int]] = {}
+        try:
+            # init containers allocate too (policy_static.go allocates for
+            # them; the reference lets app containers REUSE released init
+            # cpus — this build holds both conservatively, which only
+            # over-reserves, never under-aligns)
+            for c in list(pod.spec.init_containers) + list(pod.spec.containers):
+                n = _integer_cpus(c)
+                if n == 0:
+                    continue
+                picked = self._pick(n)
+                if picked is None:
+                    raise RuntimeError(
+                        f"not enough free exclusive cpus for "
+                        f"{key}/{c.name} (want {n}, free "
+                        f"{len(self.shared_pool())})")
+                got[c.name] = picked
+                # commit incrementally so _pick sees earlier containers
+                self.assignments.setdefault(key, {})[c.name] = picked
+        except Exception:
+            self.assignments.pop(key, None)  # all-or-nothing per pod
+            raise
+        if got:
+            self._persist()
+        return got
+
+    def release_pod(self, pod_key: str) -> None:
+        if self.assignments.pop(pod_key, None) is not None:
+            self._persist()
+
+    def reconcile(self, live_pod_keys) -> int:
+        """Drop assignments for pods that no longer exist (restart
+        recovery: checkpointed state vs the live pod list —
+        policy_static.go removeStaleState). Returns #released."""
+        live = set(live_pod_keys)  # hoisted: a generator arg would empty
+        stale = [k for k in self.assignments if k not in live]
+        for k in stale:
+            self.assignments.pop(k, None)
+        if stale:
+            self._persist()
+        return len(stale)
+
+    # -- checkpointing ---------------------------------------------------------
+
+    def _persist(self) -> None:
+        if self.checkpoints is None:
+            return
+        self.checkpoints.save(self.CHECKPOINT_KEY, {
+            "topology": {"nCPUs": self.topology.n_cpus,
+                         "numaNodes": self.topology.numa_nodes},
+            "assignments": {k: {c: list(v) for c, v in pods.items()}
+                            for k, pods in self.assignments.items()},
+        })
+
+    def _restore(self) -> None:
+        if self.checkpoints is None:
+            return
+        data = self.checkpoints.load(self.CHECKPOINT_KEY)
+        if not data:
+            return
+        saved = data.get("topology") or {}
+        if (saved.get("nCPUs") != self.topology.n_cpus
+                or saved.get("numaNodes") != self.topology.numa_nodes):
+            # topology changed under the checkpoint: stale cpu ids would be
+            # meaningless — discard, like the reference's restore failure
+            # ("configured topology differs from state checkpoint")
+            self.assignments = {}
+            self._persist()
+            return
+        self.assignments = {
+            k: {c: [int(x) for x in v] for c, v in pods.items()}
+            for k, pods in (data.get("assignments") or {}).items()}

@@ -257,6 +257,17 @@ class Kubelet:
         self._last_heartbeat = float("-inf")
         self.checkpoints = (CheckpointManager(checkpoint_dir)
                             if checkpoint_dir else None)
+        # cm/: static CPU pinning + NUMA topology hints (policy_static.go,
+        # topologymanager) — exclusive cpus for guaranteed-QoS pods,
+        # checkpointed beside the kubelet's other local state
+        from ..api.resources import parse_quantity_milli
+        from .cm import CPUManager, CPUTopology
+
+        n_cpus = max(1, parse_quantity_milli(
+            self.capacity.get("cpu", "8")) // 1000)
+        self.cpu_manager = CPUManager(
+            CPUTopology(n_cpus=n_cpus, numa_nodes=2 if n_cpus >= 2 else 1),
+            checkpoints=self.checkpoints)
         self._watch = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -279,9 +290,16 @@ class Kubelet:
         # adopt pods already bound here (restart recovery: state comes from
         # the store + runtime relist, kubelet is stateless modulo checkpoints)
         pods, _ = self.store.list("pods", lambda p: p.spec.node_name == self.node_name)
+        # restart recovery ORDER: checkpointed cpu assignments are pruned
+        # against the live pod list FIRST (removeStaleState), so re-adopted
+        # guaranteed pods keep their exact pre-restart cpus and dead pods'
+        # cpus return to the shared pool
+        self.cpu_manager.reconcile(
+            [p.key for p in pods if not p.is_terminal()])
         for p in pods:
             if not p.is_terminal():
                 self._start_pod(p)
+        self._publish_cpu_assignments()
         if self.checkpoints is not None:
             self.checkpoints.save("node-registration", {"node": self.node_name})
 
@@ -524,6 +542,25 @@ class Kubelet:
                 self._log_line(pod, "kubelet", msg)
             return
         self._config_errors.pop(pod.key, None)
+        # cm admission: exclusive-cpu carve-out BEFORE containers start
+        # (SyncPod's cm admission step); failure fails the POD, like the
+        # reference's TopologyAffinityError / SMTAlignmentError admission
+        from .cm import TopologyAffinityError
+
+        try:
+            pinned = self.cpu_manager.allocate_pod(pod)
+        except (TopologyAffinityError, RuntimeError) as e:
+            reason = ("TopologyAffinityError"
+                      if isinstance(e, TopologyAffinityError)
+                      else "InsufficientExclusiveCPUs")
+            self._log_line(pod, "kubelet", f"{reason}: {e}")
+            self._write_phase(pod.key, FAILED)
+            return
+        if pinned:
+            for cname, cpus in pinned.items():
+                self._log_line(pod, cname,
+                               f"Pinned to exclusive CPUs {cpus}")
+            self._publish_cpu_assignments()
         existing = (self.runtime.sandbox_for(pod.key)
                     if hasattr(self.runtime, "sandbox_for") else None)
         if existing is not None:
@@ -555,6 +592,25 @@ class Kubelet:
             self.runtime.stop_pod_sandbox(worker.sandbox_id)
             self.runtime.remove_pod_sandbox(worker.sandbox_id)
             self._log_line(worker.pod, "sandbox", "Stopped pod sandbox")
+        if pod_key in self.cpu_manager.assignments:
+            self.cpu_manager.release_pod(pod_key)
+            self._publish_cpu_assignments()
+
+    def _publish_cpu_assignments(self) -> None:
+        """Mirror the pinning state into a node annotation so `ktl describe
+        node` can render it (the reference surfaces cm state via podresources
+        gRPC; an annotation is this build's API-visible equivalent)."""
+        payload = json.dumps(self.cpu_manager.assignments, sort_keys=True)
+
+        def stamp(node):
+            node.metadata.annotations[
+                "cpumanager.kubernetes-tpu.io/assignments"] = payload
+            return node
+
+        try:
+            self.store.guaranteed_update("nodes", self.node_name, stamp)
+        except Exception:
+            pass  # node deleted mid-shutdown: nothing to annotate
 
     def _handle_pleg_event(self, ev: PodLifecycleEvent) -> None:
         worker = self.workers.get(ev.pod_key)
@@ -675,6 +731,14 @@ class Kubelet:
     # -- status writes ---------------------------------------------------------
 
     def _write_phase(self, pod_key: str, phase: str) -> None:
+        if phase in (SUCCEEDED, FAILED) \
+                and pod_key in self.cpu_manager.assignments:
+            # terminated pods return their exclusive cpus to the shared
+            # pool immediately (removeStaleState runs continuously in the
+            # reference, not just at startup) — every terminal transition
+            # funnels through here, so completed Jobs can't drain the pool
+            self.cpu_manager.release_pod(pod_key)
+            self._publish_cpu_assignments()
         ns, name = pod_key.split("/", 1)
         try:
             self.store.update_pod_status(ns, name,

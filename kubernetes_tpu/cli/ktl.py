@@ -919,6 +919,19 @@ def _describe_node(obj) -> None:
         print("Conditions:")
         for c in conds:
             print(f"  {c.get('type', '')}={c.get('status', '')}")
+    pinned = (meta.get("annotations") or {}).get(
+        "cpumanager.kubernetes-tpu.io/assignments")
+    if pinned:
+        try:
+            assignments = json.loads(pinned)
+        except ValueError:
+            assignments = None
+        if assignments:
+            print("CPU Manager (static policy, exclusive CPUs):")
+            for pod_key, containers in sorted(assignments.items()):
+                for cname, cpus in sorted(containers.items()):
+                    print(f"  {pod_key}/{cname}: "
+                          f"{','.join(str(c) for c in cpus)}")
 
 
 def cmd_describe(client: RESTClient, args) -> int:
