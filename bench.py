@@ -712,6 +712,30 @@ def rung_preemption_async(results):
     _preemption_run(results, "PreemptionAsync", 160, async_preparation=True)
 
 
+def rung_watch_fanout(results):
+    """Apiserver watch fan-out at kubemark scale: 5k streaming watchers
+    through the select-based mux, measuring deliveries/s (VERDICT r4 #8;
+    reference: cacher fan-out, storage/cacher/cacher.go:261)."""
+    from kubernetes_tpu.perf.watch_scale import run as watch_run
+
+    try:
+        out = watch_run(n_watchers=sz(5000, floor=64),
+                        n_events=sz(100, floor=8))
+        results["ApiserverWatchFanout_5k"] = out
+        if "error" in out:
+            print(f"ApiserverWatchFanout_5k: ERROR {out['error']}",
+                  file=sys.stderr)
+        else:
+            print(f"{'ApiserverWatchFanout_5k':>28}: "
+                  f"{out['deliveries_per_s']:>9.0f} deliveries/s  "
+                  f"({out['streams_established']} streams, "
+                  f"{out['deliveries']} delivered in {out['fanout_s']}s)",
+                  file=sys.stderr)
+    except Exception as e:
+        results["ApiserverWatchFanout_5k"] = {"error": str(e)[:200]}
+        print(f"ApiserverWatchFanout_5k: ERROR {e}", file=sys.stderr)
+
+
 RUNGS = [
     ("SchedulingBasic", rung_basic),
     ("TopologySpreading", rung_topology_spread),
@@ -727,6 +751,7 @@ RUNGS = [
     ("NorthStarWarm", rung_north_star_warm),
     ("NorthStarEndToEnd", rung_north_star_endtoend),
     ("Transport", rung_transport),
+    ("ApiserverWatchFanout", rung_watch_fanout),
 ]
 
 

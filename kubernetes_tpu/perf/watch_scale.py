@@ -1,0 +1,141 @@
+"""Apiserver watch fan-out at scale: N streaming watchers, one select loop.
+
+The kubemark question for the HTTP surface (VERDICT r4 weak #6): the
+thread-per-watch ThreadingHTTPServer is fine at hundreds of watchers —
+prove (and measure) it at thousands. Server side each watch costs one
+mostly-BLOCKED thread (cheap) plus per-event fan-out work; the fan-out
+serialization is shared across watchers via the event wire cache
+(rest.py, the cacher's cachingObject analog).
+
+The client half multiplexes every stream over ONE thread with selectors
+(a 5k-thread client would drown the measurement on a small host). Events
+are counted by scanning for the type marker; chunked framing is skipped
+by carrying a tail across reads.
+
+run() returns the recorded numbers; __main__ prints one JSON line —
+the `ApiserverWatchFanout` bench rung wraps this.
+"""
+
+from __future__ import annotations
+
+import json
+import selectors
+import socket
+import time
+from typing import Dict
+
+
+def run(n_watchers: int = 5000, n_events: int = 100,
+        connect_timeout: float = 120.0,
+        drain_timeout: float = 300.0) -> Dict:
+    from ..server import APIServer
+    from ..store import APIStore
+    from ..testing import MakePod
+
+    store = APIStore()
+    srv = APIServer(store).start()
+    host, port = srv._httpd.server_address[:2]
+    out: Dict = {"watchers": n_watchers, "events": n_events}
+    socks = []
+    try:
+        rv = store.list("pods")[1]
+        t0 = time.perf_counter()
+        request = (f"GET /api/v1/namespaces/default/pods?watch=true"
+                   f"&resourceVersion={rv} HTTP/1.1\r\n"
+                   f"Host: {host}\r\nUser-Agent: watch-scale\r\n\r\n"
+                   ).encode()
+        sel = selectors.DefaultSelector()
+        for i in range(n_watchers):
+            s = socket.create_connection((host, port), timeout=10)
+            s.setblocking(False)
+            s.sendall(request)
+            socks.append(s)
+        # wait until every stream has response headers (the server thread
+        # pool is warming 1 thread per watcher here)
+        got_headers = 0
+        buffers = {}
+        for s in socks:
+            sel.register(s, selectors.EVENT_READ)
+            buffers[s] = b""
+        deadline = time.monotonic() + connect_timeout
+        while got_headers < n_watchers and time.monotonic() < deadline:
+            for key, _ in sel.select(timeout=1.0):
+                s = key.fileobj
+                try:
+                    chunk = s.recv(65536)
+                except BlockingIOError:
+                    continue
+                if buffers[s] == b"" and chunk:
+                    got_headers += 1
+                buffers[s] += chunk
+        connect_s = time.perf_counter() - t0
+        out["connect_s"] = round(connect_s, 2)
+        out["streams_established"] = got_headers
+        if got_headers < n_watchers:
+            out["error"] = (f"only {got_headers}/{n_watchers} streams "
+                            f"established in {connect_timeout:.0f}s")
+            return out
+
+        # fan-out: E pod creates -> N*E deliveries
+        marker = b'"type": "ADDED"'
+        counts = {s: buffers[s].count(marker) for s in socks}
+        tails = {s: buffers[s][-32:] for s in socks}
+        t1 = time.perf_counter()
+        for i in range(n_events):
+            store.create("pods", MakePod(f"fan-{i}").req(
+                {"cpu": "100m"}).obj())
+        want = n_events
+        done = 0
+        closed = 0
+        deadline = time.monotonic() + drain_timeout
+        while done + closed < n_watchers and time.monotonic() < deadline:
+            for key, _ in sel.select(timeout=1.0):
+                s = key.fileobj
+                try:
+                    chunk = s.recv(262144)
+                except BlockingIOError:
+                    continue
+                if not chunk:
+                    sel.unregister(s)
+                    if counts[s] < want:
+                        closed += 1  # server evicted: never completing
+                    continue
+                data = tails[s] + chunk
+                if counts[s] < want:
+                    before = counts[s]
+                    counts[s] = before + data.count(marker)
+                    # marker may span the carry boundary; the 32-byte tail
+                    # overlap makes double counting impossible only because
+                    # we count on tail+chunk and subtract tail's own hits
+                    counts[s] -= tails[s].count(marker)
+                    if before < want <= counts[s]:
+                        done += 1
+                tails[s] = data[-32:]
+        fan_s = time.perf_counter() - t1
+        delivered = sum(min(c, want) for c in counts.values())
+        out["watchers_complete"] = done
+        out["deliveries"] = delivered
+        out["fanout_s"] = round(fan_s, 3)
+        out["deliveries_per_s"] = round(delivered / fan_s, 1)
+        out["events_per_s_per_watcher"] = round(
+            delivered / fan_s / max(1, n_watchers), 2)
+        if done < n_watchers:
+            incomplete = sum(1 for c in counts.values() if c < want)
+            out["error"] = (f"{incomplete} watchers missed events "
+                            f"within {drain_timeout:.0f}s")
+        return out
+    finally:
+        for s in socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+        srv.stop()
+
+
+if __name__ == "__main__":
+    import sys
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 5000
+    e = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+    print(json.dumps(run(n_watchers=n, n_events=e)))

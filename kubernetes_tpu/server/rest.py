@@ -123,6 +123,12 @@ def json_merge_patch(target, patch):
     return out
 
 
+def _IDENTITY_VIEW(d):
+    """Shared identity view: its object identity marks a watch event as
+    safely cacheable across watchers (no redaction applied)."""
+    return d
+
+
 class _PatchParseError(Exception):
     """Carries a buffered (code, msg, reason) verdict out of the PATCH
     transaction block."""
@@ -607,7 +613,7 @@ class _Handler(BaseHTTPRequestHandler):
         read grant (e.g. the system:authenticated read-all bootstrap rule)
         sees the CSR with the credential blanked."""
         if resource != "certificatesigningrequests" or user is None:
-            return lambda d: d
+            return _IDENTITY_VIEW
         privileged = (getattr(self.server, "authorizer", None) is None
                       or "system:masters" in user.groups)
 
@@ -626,7 +632,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _watch(self, resource: str, ns: Optional[str], since_rv: int,
                field_pred=None, view=None, label_sel=None) -> None:
         if view is None:
-            view = lambda d: d  # noqa: E731
+            view = _IDENTITY_VIEW
         if label_sel is not None:
             # fold the label selector into the scope predicate so label
             # changes ride the same ADDED/MODIFIED/DELETED transition logic
@@ -647,6 +653,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
+        detached = False
         try:
             import time as _time
 
@@ -658,27 +665,20 @@ class _Handler(BaseHTTPRequestHandler):
                 # as a liveness probe reaping dead clients. Fires on QUIET
                 # streams and on busy-but-filtered ones alike — 5s since the
                 # last actual send, not 5 queue timeouts.
+                from .watchmux import bookmark_frame
+
                 nonlocal last_sent
                 if _time.monotonic() - last_sent < 5.0:
                     return
                 last_sent = _time.monotonic()
-                bl = json.dumps(
-                    {"type": "BOOKMARK",
-                     "object": {"metadata": {"resourceVersion": str(self.store.rv)}}}
-                ).encode() + b"\n"
-                self.wfile.write(f"{len(bl):x}\r\n".encode() + bl + b"\r\n")
+                self.wfile.write(bookmark_frame(self.store.rv))
                 self.wfile.flush()
 
-            while True:
-                ev = w.get(timeout=1.0)
-                if ev is None:
-                    if w.terminated or self.server.shutting_down:  # type: ignore[attr-defined]
-                        break  # evicted slow watcher: close; client relists
-                    maybe_bookmark()
-                    continue
+            def render(ev):
+                """One event -> chunk-framed wire bytes, or None when the
+                event is invisible to this watcher."""
                 if ns and getattr(ev.obj.metadata, "namespace", "") != ns:
-                    maybe_bookmark()
-                    continue
+                    return None
                 etype = ev.type
                 if field_pred is not None:
                     # the cacher's transition rule: evaluate the selector on
@@ -697,21 +697,70 @@ class _Handler(BaseHTTPRequestHandler):
                     elif prev_ok:
                         etype = "DELETED"  # left scope (or real delete)
                     else:
-                        maybe_bookmark()
-                        continue  # never visible to this watcher
+                        return None  # never visible to this watcher
+                line = None
+                cacheable = view is _IDENTITY_VIEW and etype == ev.type
+                if cacheable:
+                    # serialize ONCE per event across all watchers (the
+                    # cacher's cachingObject, cacher.go) — at 5k watch
+                    # streams per-watcher dumps dominate the fan-out cost.
+                    # Only the untransformed view is cacheable: redacted
+                    # views and selector-rewritten event types are not.
+                    line = getattr(ev, "_wire_line", None)
+                if line is None:
+                    line = json.dumps({"type": etype,
+                                       "object": view(to_dict(ev.obj))
+                                       }).encode() + b"\n"
+                    if cacheable:
+                        # Event is a frozen dataclass: plain attribute
+                        # assignment raises FrozenInstanceError — the cache
+                        # write must go through object.__setattr__
+                        object.__setattr__(ev, "_wire_line", line)
+                return f"{len(line):x}\r\n".encode() + line + b"\r\n"
+
+            mux = getattr(self.server, "watch_mux", None)
+            if mux is not None:
+                # hand the stream to the select-based mux: ONE thread fans
+                # out to every watcher (thread-per-watch collapsed 10x at
+                # 5k streams — see server/watchmux.py). The dup'd fd keeps
+                # the TCP stream alive after this handler thread exits.
+                self.wfile.flush()
+                sock = self.connection.dup()
+                self.server.mark_detached(self.connection)  # type: ignore[attr-defined]
+                store = self.store
+                mux.add(sock, w, render, rv_fn=lambda: store.rv)
+                self.close_connection = True
+                detached = True
+                return  # the finally below must NOT stop the watch
+            while True:
+                ev = w.get(timeout=1.0)
+                if ev is None:
+                    if w.terminated or self.server.shutting_down:  # type: ignore[attr-defined]
+                        break  # evicted slow watcher: close; client relists
+                    maybe_bookmark()
+                    continue
+                # burst batching: everything already buffered rides ONE
+                # write+flush
+                payload = bytearray()
+                for e in [ev] + w.drain(512):
+                    frame = render(e)
+                    if frame is not None:
+                        payload += frame
+                if not payload:
+                    maybe_bookmark()
+                    continue
                 last_sent = _time.monotonic()
-                line = json.dumps({"type": etype,
-                                   "object": view(to_dict(ev.obj))}).encode() + b"\n"
-                self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                self.wfile.write(bytes(payload))
                 self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError):
             pass
         finally:
-            w.stop()
-            try:
-                self.wfile.write(b"0\r\n\r\n")
-            except Exception:
-                pass
+            if not detached:
+                w.stop()
+                try:
+                    self.wfile.write(b"0\r\n\r\n")
+                except Exception:
+                    pass
 
     def _metrics(self) -> None:
         from .metrics import global_registry
@@ -1501,6 +1550,35 @@ def _install_flowcontrol_wrappers(cls) -> None:
 _install_flowcontrol_wrappers(_Handler)
 
 
+class _Server(ThreadingHTTPServer):
+    # kubemark-scale watch storms: thousands of near-simultaneous connects
+    # overflow the stdlib default backlog of 5, sending clients into
+    # seconds-long SYN retries (500 watchers took 84s to connect; with a
+    # real backlog they take under a second)
+    request_queue_size = 1024
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._detached_conns = set()
+        self._detached_lock = threading.Lock()
+
+    def mark_detached(self, request) -> None:
+        """The watch mux took a dup of this connection: the handler teardown
+        must not shutdown() the TCP stream (a SHUT_WR sends FIN through
+        every dup), only close its own fd."""
+        with self._detached_lock:
+            self._detached_conns.add(request)
+
+    def shutdown_request(self, request):
+        with self._detached_lock:
+            detached = request in self._detached_conns
+            self._detached_conns.discard(request)
+        if detached:
+            self.close_request(request)  # close the fd; the dup lives on
+        else:
+            super().shutdown_request(request)
+
+
 class APIServer:
     """Embeds the store behind HTTP. start() binds a port; .url for clients."""
 
@@ -1509,7 +1587,7 @@ class APIServer:
                  authenticator=None, authorizer=None, flowcontrol=None,
                  audit=None, token_signer=None):
         self.store = store
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd = _Server((host, port), _Handler)
         self._httpd.store = store  # type: ignore[attr-defined]
         self._httpd.verbose = verbose  # type: ignore[attr-defined]
         self._httpd.shutting_down = False  # type: ignore[attr-defined]
@@ -1518,6 +1596,11 @@ class APIServer:
 
         self._httpd.crds = DynamicRegistry(store)  # type: ignore[attr-defined]
         self._httpd.ipalloc = ClusterIPAllocator(store)  # type: ignore[attr-defined]
+        from .watchmux import WatchMux
+
+        # all watch streams fan out through ONE select-based writer thread
+        self._mux = WatchMux()
+        self._httpd.watch_mux = self._mux  # type: ignore[attr-defined]
         from .admissionpolicy import WebhookAdmission
 
         # live Mutating/ValidatingWebhookConfiguration objects; the phase
@@ -1564,6 +1647,7 @@ class APIServer:
 
     def stop(self) -> None:
         self._httpd.shutting_down = True  # type: ignore[attr-defined]
+        self._mux.stop()
         self._httpd.shutdown()
         if self._thread is not None:
             self._thread.join(timeout=2)

@@ -167,6 +167,10 @@ class Watch:
                        else {kind} if isinstance(kind, str) else set(kind))
         self._stopped = False
         self.terminated = False  # True when evicted for falling behind
+        # optional ping invoked after each delivery — the select-based
+        # watch mux (server/watchmux.py) wakes on it instead of spending a
+        # blocked thread per stream
+        self.on_event = None
 
     def _deliver(self, ev: Event) -> None:
         if self.terminated or self._stopped:
@@ -174,6 +178,9 @@ class Watch:
         if self._kinds is None or ev.kind in self._kinds:
             try:
                 self._q.put_nowait(ev)
+                cb = self.on_event
+                if cb is not None:
+                    cb()
             except queue.Full:
                 # slow watcher: evict rather than buffer forever; drop one
                 # event to make room for the end-of-stream sentinel (the
