@@ -248,11 +248,17 @@ class RESTClient:
     def watch(self, resource: str, since_rv: int = -1,
               namespace: Optional[str] = None,
               field_selector: str = "",
-              label_selector: str = "") -> Iterator[Tuple[str, Dict]]:
-        """Yields (event_type, object_dict); blocks on the streaming response."""
+              label_selector: str = "",
+              send_initial_events: bool = False) -> Iterator[Tuple[str, Dict]]:
+        """Yields (event_type, object_dict); blocks on the streaming
+        response. send_initial_events=True is the WatchList mode
+        (KEP-3157): current objects stream first as ADDED, then a BOOKMARK
+        annotated k8s.io/initial-events-end, then live events."""
         from urllib.parse import quote
 
         path = self._path(resource, namespace) + f"?watch=true&resourceVersion={since_rv}"
+        if send_initial_events:
+            path += "&sendInitialEvents=true"
         if field_selector:
             path += f"&fieldSelector={quote(field_selector)}"
         if label_selector:
@@ -273,7 +279,8 @@ class Informer:
 
     def __init__(self, client: RESTClient, resource: str,
                  on_event: Optional[Callable[[str, Any], None]] = None,
-                 field_selector: str = "", label_selector: str = ""):
+                 field_selector: str = "", label_selector: str = "",
+                 watch_list: bool = False):
         self.client = client
         self.resource = resource
         self.cache: Dict[str, Any] = {}
@@ -281,8 +288,35 @@ class Informer:
         # server-side scope (e.g. spec.nodeName=<me> for a kubelet informer)
         self.field_selector = field_selector
         self.label_selector = label_selector
+        # WatchList mode (KEP-3157; reflector.go:121-143): NO separate LIST
+        # — every (re)connect streams current objects as initial ADDED
+        # events ending in an annotated bookmark, and the cache swap at the
+        # bookmark replaces the relist path entirely
+        self.watch_list = watch_list
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def _swap_cache(self, fresh: Dict[str, Any]) -> None:
+        """Replace the cache, emitting synthetic deltas for changes missed
+        while disconnected (shared_informer replace semantics). Applied
+        key-by-key — a clear()+update() would give concurrent readers an
+        empty-cache window mid-resync. Survivors emit MODIFIED only when
+        their resourceVersion moved (DeltaFIFO Replace dedup), so a
+        transient blip doesn't replay a full-cluster reconcile storm."""
+        old = dict(self.cache)
+        gone = set(old) - set(fresh)
+        for k in gone:
+            self.cache.pop(k, None)
+        self.cache.update(fresh)
+        if self.on_event:
+            for k in gone:
+                self.on_event("DELETED", old[k])
+            for k in set(fresh) - set(old):
+                self.on_event("ADDED", fresh[k])
+            for k in set(fresh) & set(old):
+                if (old[k].metadata.resource_version
+                        != fresh[k].metadata.resource_version):
+                    self.on_event("MODIFIED", fresh[k])
 
     def _key(self, obj_dict: Dict) -> str:
         meta = obj_dict.get("metadata") or {}
@@ -290,30 +324,45 @@ class Informer:
         return f"{ns}/{meta['name']}" if ns else meta["name"]
 
     def start(self) -> "Informer":
-        items, rv = self.client.list(self.resource,
-                                     field_selector=self.field_selector,
-                                     label_selector=self.label_selector)
-        for it in items:
-            self.cache[self._key(it)] = from_dict(self.resource, it)
+        if self.watch_list:
+            rv = -1  # the stream itself primes the cache
+        else:
+            items, rv = self.client.list(self.resource,
+                                         field_selector=self.field_selector,
+                                         label_selector=self.label_selector)
+            for it in items:
+                self.cache[self._key(it)] = from_dict(self.resource, it)
 
         def loop():
             nonlocal rv
             while not self._stop.is_set():
                 try:
-                    for etype, obj_dict in self.client.watch(
-                            self.resource, since_rv=rv,
-                            field_selector=self.field_selector,
-                            label_selector=self.label_selector):
+                    syncing = self.watch_list
+                    fresh: Dict[str, Any] = {}
+                    stream = self.client.watch(
+                        self.resource,
+                        since_rv=-1 if self.watch_list else rv,
+                        field_selector=self.field_selector,
+                        label_selector=self.label_selector,
+                        send_initial_events=self.watch_list)
+                    for etype, obj_dict in stream:
                         if self._stop.is_set():
                             return
                         if etype == "BOOKMARK":
                             # rv checkpoint only (reflector.go:156) — no object
-                            rv = int((obj_dict.get("metadata") or {}).get(
-                                "resourceVersion", rv))
+                            meta = obj_dict.get("metadata") or {}
+                            rv = int(meta.get("resourceVersion", rv))
+                            if syncing and (meta.get("annotations") or {}).get(
+                                    "k8s.io/initial-events-end") == "true":
+                                self._swap_cache(fresh)
+                                syncing = False
                             continue
                         obj = from_dict(self.resource, obj_dict)
                         key = self._key(obj_dict)
                         rv = int((obj_dict.get("metadata") or {}).get("resourceVersion", rv))
+                        if syncing:
+                            fresh[key] = obj  # initial burst: swap at the end bookmark
+                            continue
                         if etype == "DELETED":
                             self.cache.pop(key, None)
                         else:
@@ -326,6 +375,8 @@ class Informer:
                     import time
 
                     time.sleep(0.2)
+                    if self.watch_list:
+                        continue  # reconnect re-syncs via initial events
                     # Reflector contract: RELIST then rewatch — retrying the
                     # stale rv after a 410 Expired would loop forever and
                     # freeze the cache.
@@ -333,20 +384,11 @@ class Informer:
                         items, rv = self.client.list(
                             self.resource, field_selector=self.field_selector,
                             label_selector=self.label_selector)
-                        fresh = {self._key(it): from_dict(self.resource, it) for it in items}
-                        # synthetic deltas for changes missed during the outage
-                        # (informers emit ADDED/MODIFIED/DELETED on cache
-                        # replace — tools/cache shared_informer semantics)
-                        old = dict(self.cache)
-                        self.cache.clear()
-                        self.cache.update(fresh)
-                        if self.on_event:
-                            for k in set(old) - set(fresh):
-                                self.on_event("DELETED", old[k])
-                            for k in set(fresh) - set(old):
-                                self.on_event("ADDED", fresh[k])
-                            for k in set(fresh) & set(old):
-                                self.on_event("MODIFIED", fresh[k])
+                        # synthetic deltas for changes missed during the
+                        # outage (shared_informer replace semantics)
+                        self._swap_cache({self._key(it):
+                                          from_dict(self.resource, it)
+                                          for it in items})
                     except Exception:
                         pass  # server unreachable: retry the whole cycle
 

@@ -581,7 +581,9 @@ class _Handler(BaseHTTPRequestHandler):
         view = self._view_transform(resource, user)
         if is_watch:
             self._watch(resource, ns, int(q.get("resourceVersion", ["-1"])[0]),
-                        field_pred, view=view, label_sel=label_sel)
+                        field_pred, view=view, label_sel=label_sel,
+                        send_initial_events=q.get(
+                            "sendInitialEvents", ["false"])[0] == "true")
             return
         try:
             if name is not None:
@@ -630,7 +632,8 @@ class _Handler(BaseHTTPRequestHandler):
         return view
 
     def _watch(self, resource: str, ns: Optional[str], since_rv: int,
-               field_pred=None, view=None, label_sel=None) -> None:
+               field_pred=None, view=None, label_sel=None,
+               send_initial_events: bool = False) -> None:
         if view is None:
             view = _IDENTITY_VIEW
         if label_sel is not None:
@@ -643,6 +646,26 @@ class _Handler(BaseHTTPRequestHandler):
                 if not _ls.matches(o.metadata.labels):
                     return False
                 return _fp is None or _fp(o)
+        initial = None
+        if send_initial_events:
+            # WatchList (KEP-3157; reflector.go:121-143 streaming lists):
+            # the LIST rides the watch stream as ADDED events followed by
+            # an initial-events-end bookmark — clients prime caches without
+            # a separate large LIST response. list+watch(list_rv) is
+            # consistent: the store replays history after the list's RV.
+            # The watcher's scope pushes INTO the list (a node-scoped
+            # kubelet informer must not deep-copy every pod in the cluster
+            # just to discard them in render)
+            def _initial_pred(o, _ns=ns, _fp=field_pred, _ls=label_sel):
+                if _ns and getattr(o.metadata, "namespace", "") != _ns:
+                    return False
+                if _ls is not None and not _ls.matches(o.metadata.labels):
+                    return False
+                return _fp is None or _fp(o)
+
+            initial, since_rv = self.store.list(
+                resource,
+                _initial_pred if (ns or field_pred or label_sel) else None)
         try:
             w = self.store.watch(resource, since_rv=since_rv)
         except ResourceVersionTooOldError as e:
@@ -718,6 +741,27 @@ class _Handler(BaseHTTPRequestHandler):
                         object.__setattr__(ev, "_wire_line", line)
                 return f"{len(line):x}\r\n".encode() + line + b"\r\n"
 
+            if initial is not None:
+                from ..store import Event as _StoreEvent
+
+                burst = bytearray()
+                for o in initial:
+                    frame = render(_StoreEvent(
+                        type="ADDED", kind=resource, obj=o,
+                        resource_version=since_rv))
+                    if frame is not None:
+                        burst += frame
+                endline = json.dumps({
+                    "type": "BOOKMARK",
+                    "object": {"metadata": {
+                        "resourceVersion": str(since_rv),
+                        "annotations": {
+                            "k8s.io/initial-events-end": "true"}}},
+                }).encode() + b"\n"
+                burst += f"{len(endline):x}\r\n".encode() + endline + b"\r\n"
+                self.wfile.write(bytes(burst))
+                self.wfile.flush()
+                last_sent = _time.monotonic()
             mux = getattr(self.server, "watch_mux", None)
             if mux is not None:
                 # hand the stream to the select-based mux: ONE thread fans

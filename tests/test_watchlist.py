@@ -1,0 +1,128 @@
+"""WatchList streaming (KEP-3157; reflector.go:121-143): LIST rides the
+watch stream as initial ADDED events ending in an annotated bookmark."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubernetes_tpu.server import APIServer, Informer, RESTClient
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakePod
+
+
+@pytest.fixture()
+def server():
+    srv = APIServer(APIStore()).start()
+    yield srv
+    srv.stop()
+
+
+class TestWatchListServer:
+    def test_initial_events_then_end_bookmark_then_live(self, server):
+        store = server.store
+        for i in range(3):
+            store.create("pods", MakePod(f"pre-{i}").obj())
+        req = urllib.request.Request(
+            f"{server.url}/api/v1/namespaces/default/pods?watch=true"
+            f"&resourceVersion=-1&sendInitialEvents=true")
+        resp = urllib.request.urlopen(req, timeout=10)
+        seen = []
+        end_rv = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            line = resp.readline()
+            if not line.strip():
+                continue
+            ev = json.loads(line)
+            meta = ev["object"].get("metadata") or {}
+            if ev["type"] == "BOOKMARK":
+                anns = meta.get("annotations") or {}
+                if anns.get("k8s.io/initial-events-end") == "true":
+                    end_rv = int(meta["resourceVersion"])
+                    break
+            else:
+                seen.append((ev["type"], meta.get("name")))
+        assert seen == [("ADDED", "pre-0"), ("ADDED", "pre-1"),
+                        ("ADDED", "pre-2")]
+        assert end_rv is not None and end_rv >= 3
+        # live events continue on the SAME stream
+        store.create("pods", MakePod("live").obj())
+        deadline = time.monotonic() + 10
+        got_live = False
+        while time.monotonic() < deadline:
+            line = resp.readline()
+            if not line.strip():
+                continue
+            ev = json.loads(line)
+            if ev["type"] == "ADDED" and \
+                    ev["object"]["metadata"]["name"] == "live":
+                got_live = True
+                break
+        assert got_live
+        resp.close()
+
+
+class TestWatchListInformer:
+    def test_informer_primes_without_list(self, server):
+        store = server.store
+        for i in range(5):
+            store.create("pods", MakePod(f"p{i}").obj())
+        events = []
+        inf = Informer(RESTClient(server.url), "pods",
+                       on_event=lambda t, o: events.append(
+                           (t, o.metadata.name)),
+                       watch_list=True)
+        inf.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(inf.cache) < 5:
+            time.sleep(0.05)
+        assert len(inf.cache) == 5
+        store.create("pods", MakePod("new").obj())
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                ("ADDED", "new") not in events:
+            time.sleep(0.05)
+        assert ("ADDED", "new") in events
+        # initial sync emitted MODIFIED/ADDED swap deltas, not raw replays
+        inf.stop()
+
+    def test_informer_resyncs_after_disconnect(self, server):
+        """A severed stream reconnects through a fresh initial-events sync:
+        the cache converges to post-outage state with synthetic deltas, no
+        spurious MODIFIED for untouched survivors."""
+        store = server.store
+        store.create("pods", MakePod("keep").obj())
+        store.create("pods", MakePod("doomed").obj())
+        events = []
+        inf = Informer(RESTClient(server.url), "pods",
+                       on_event=lambda t, o: events.append(
+                           (t, o.metadata.name)),
+                       watch_list=True)
+        inf.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(inf.cache) < 2:
+            time.sleep(0.05)
+        assert len(inf.cache) == 2
+        # sever every live stream mid-flight (the mux keeps serving new
+        # connections; the client must reconnect + re-sync)
+        with server._mux._lock:
+            for st in server._mux._streams:
+                st.sock.close()
+        store.delete("pods", "default/doomed")
+        store.create("pods", MakePod("born").obj())
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if "default/born" in inf.cache and \
+                    "default/doomed" not in inf.cache:
+                break
+            time.sleep(0.05)
+        assert "default/born" in inf.cache
+        assert "default/doomed" not in inf.cache
+        assert ("DELETED", "doomed") in events
+        assert ("ADDED", "born") in events
+        # 'keep' never changed: the resync must not replay it as MODIFIED
+        assert ("MODIFIED", "keep") not in events
+        inf.stop()
