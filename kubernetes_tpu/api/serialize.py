@@ -47,6 +47,7 @@ from .admissionregistration import (
     ValidatingAdmissionPolicyBinding,
     ValidatingWebhookConfiguration,
 )
+from .apiservice import APIService
 from .certificates import CertificateSigningRequest
 from .config import ConfigMap, Secret
 from .crd import CustomResourceDefinition
@@ -103,6 +104,7 @@ KIND_TO_RESOURCE = {
     "DeviceClass": "deviceclasses",
     "CustomResourceDefinition": "customresourcedefinitions",
     "CertificateSigningRequest": "certificatesigningrequests",
+    "APIService": "apiservices",
     "VolumeAttachment": "volumeattachments",
     "ResourceClaimTemplate": "resourceclaimtemplates",
     "PodLog": "podlogs",
@@ -149,6 +151,7 @@ RESOURCE_TO_TYPE = {
     "deviceclasses": DeviceClass,
     "customresourcedefinitions": CustomResourceDefinition,
     "certificatesigningrequests": CertificateSigningRequest,
+    "apiservices": APIService,
     "volumeattachments": VolumeAttachment,
     "resourceclaimtemplates": ResourceClaimTemplate,
     "podlogs": PodLog,
@@ -167,7 +170,7 @@ RESOURCE_TO_TYPE = {
     "validatingwebhookconfigurations": ValidatingWebhookConfiguration,
 }
 CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses",
-                  "volumeattachments",
+                  "volumeattachments", "apiservices",
                   "csinodes", "resourceslices", "deviceclasses",
                   "priorityclasses", "customresourcedefinitions",
                   "certificatesigningrequests", "ingressclasses",
@@ -192,6 +195,7 @@ GROUP_PREFIX = {
     "storageclasses": "/apis/storage.k8s.io/v1",
     "csinodes": "/apis/storage.k8s.io/v1",
     "volumeattachments": "/apis/storage.k8s.io/v1",
+    "apiservices": "/apis/apiregistration.k8s.io/v1",
     "services": "/api/v1",
     "endpointslices": "/apis/discovery.k8s.io/v1",
     "resourcequotas": "/api/v1",

@@ -5,6 +5,7 @@ from .certificates import (  # noqa: F401
     CSRCleanerController,
     CSRSigningController,
 )
+from .apiservice import APIServiceAvailabilityController  # noqa: F401
 from .base import Controller  # noqa: F401
 from .daemonset import DaemonSetController  # noqa: F401
 from .deployment import DeploymentController  # noqa: F401

@@ -22,7 +22,7 @@ DEFAULT_CONTROLLERS = (
     "namespace", "garbagecollector", "resourcequota", "horizontalpodautoscaler",
     "serviceaccount", "ttlafterfinished", "eventttl", "csrapproving",
     "csrcleaner", "podgc", "persistentvolumebinder", "attachdetach",
-    "resourceclaim",
+    "resourceclaim", "apiserviceavailability",
 )
 
 
@@ -48,6 +48,7 @@ def _controller_registry():
         StatefulSetController,
         TaintEvictionController,
         TTLAfterFinishedController,
+        APIServiceAvailabilityController,
         AttachDetachController,
         PersistentVolumeBinder,
         ResourceClaimController,
@@ -77,6 +78,7 @@ def _controller_registry():
         "persistentvolumebinder": PersistentVolumeBinder,
         "attachdetach": AttachDetachController,
         "resourceclaim": ResourceClaimController,
+        "apiserviceavailability": APIServiceAvailabilityController,
     }
 
 

@@ -123,6 +123,22 @@ def json_merge_patch(target, patch):
     return out
 
 
+def _builtin_groups():
+    """API groups this server serves natively (from the registered
+    prefixes) — never proxied to an extension apiserver."""
+    from ..api.serialize import GROUP_PREFIX
+
+    groups = set()
+    for prefix in GROUP_PREFIX.values():
+        parts = [p for p in prefix.split("/") if p]
+        if parts and parts[0] == "apis" and len(parts) >= 2:
+            groups.add(parts[1])
+    return groups
+
+
+_BUILTIN_GROUPS = None
+
+
 def _IDENTITY_VIEW(d):
     """Shared identity view: its object identity marks a watch event as
     safely cacheable across watchers (no redaction applied)."""
@@ -169,6 +185,117 @@ class _Handler(BaseHTTPRequestHandler):
         if resource in RESOURCE_TO_TYPE:
             return resource in CLUSTER_SCOPED
         return crd is not None and crd.scope == "Cluster"
+
+    def _try_aggregate(self) -> bool:
+        """The aggregation layer (kube-aggregator; delegation chain
+        apiextensions -> core -> aggregator, server.go:173): a request
+        under /apis/{group}/... whose group no built-in or CRD serves, but
+        an Available APIService claims, is reverse-proxied WHOLESALE to
+        the extension apiserver. The authenticated identity forwards as
+        X-Remote-User (the reference's front-proxy request headers).
+        Returns True when the request was handled here."""
+        global _BUILTIN_GROUPS
+        import urllib.error
+        import urllib.request as _ur
+
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        if len(parts) < 3 or parts[0] != "apis":
+            return False
+        group = parts[1]
+        if _BUILTIN_GROUPS is None:
+            _BUILTIN_GROUPS = _builtin_groups()
+        if group in _BUILTIN_GROUPS or group in (
+                "apiregistration.k8s.io", "authorization.k8s.io",
+                "authentication.k8s.io", "admission.k8s.io"):
+            return False
+        parsed = _parse_path(url.path)
+        reg = getattr(self.server, "crds", None)
+        if reg is not None and parsed is not None:
+            crd = reg.resolve(parsed[0])
+            # apiextensions precedes aggregation — for the CRD's OWN group
+            # only (a same-named plural in another group must still proxy)
+            if crd is not None and crd.group == group:
+                return False
+        try:
+            svcs, _ = self.store.list(
+                "apiservices", lambda s: s.group == group and not s.local)
+        except Exception:
+            return False
+        if not svcs:
+            return False
+        # the request's version segment picks its APIService; ties and
+        # unversioned requests fall to the highest groupPriorityMinimum
+        version = parts[2] if len(parts) > 2 else ""
+        matching = [s for s in svcs if s.version == version] or svcs
+        svc = sorted(matching, key=lambda s: -s.group_priority_minimum)[0]
+        # aggregated requests pass the SAME authn/authz gate as local ones
+        # — the proxy must never launder a request past RBAC
+        verb, authz_resource = self._request_attrs(parsed)
+        user = self._authenticated_user(
+            verb, authz_resource or f"{group}/*")
+        if user is None:
+            return True  # 401/403 already sent
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        body = self.rfile.read(length) if length else None
+        if not svc.available:
+            # body already drained: keep-alive connections stay in sync
+            self._error(503, f"APIService {svc.metadata.name} is not "
+                        f"available: {svc.available_message or 'unknown'}",
+                        "ServiceUnavailable")
+            return True
+        target = svc.service_url.rstrip("/") + url.path + (
+            f"?{url.query}" if url.query else "")
+        headers = {"Content-Type": self.headers.get("Content-Type",
+                                                    "application/json")}
+        headers["X-Remote-User"] = user.name
+        if user.groups:
+            headers["X-Remote-Group"] = ",".join(user.groups)
+        req = _ur.Request(target, data=body, method=self.command,
+                          headers=headers)
+        is_watch = "watch=true" in (url.query or "")
+        try:
+            resp = _ur.urlopen(req, timeout=3600 if is_watch else 30)
+        except urllib.error.HTTPError as e:
+            payload = e.read()
+            self._audit_record(e.code)
+            self.send_response(e.code)
+            self.send_header("Content-Type", e.headers.get(
+                "Content-Type", "application/json"))
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+            return True
+        except (urllib.error.URLError, OSError) as e:
+            self._error(502, f"error trying to reach APIService "
+                        f"{svc.metadata.name}: {e}", "BadGateway")
+            return True
+        with resp:
+            ctype = resp.headers.get("Content-Type", "application/json")
+            self._audit_record(resp.status)
+            self.send_response(resp.status)
+            self.send_header("Content-Type", ctype)
+            if is_watch:
+                # stream the backend's watch through without buffering
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    while True:
+                        chunk = resp.read(65536)
+                        if not chunk:
+                            break
+                        self.wfile.write(
+                            f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError, OSError):
+                    pass
+                return True
+            payload = resp.read()
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+        return True
 
     def _parse_obj(self, resource: str, body, crd):
         """-> (obj, None) or (None, (code, msg, reason)). Dynamic objects get
@@ -488,6 +615,8 @@ class _Handler(BaseHTTPRequestHandler):
     # ---- GET: get / list / watch / health / metrics --------------------------
 
     def do_GET(self):
+        if self._try_aggregate():
+            return
         url = urlparse(self.path)
         if url.path == "/healthz" or url.path == "/readyz":
             self._send_json(200, {"status": "ok"})
@@ -828,6 +957,8 @@ class _Handler(BaseHTTPRequestHandler):
     # ---- POST: create / binding ----------------------------------------------
 
     def do_POST(self):
+        if self._try_aggregate():
+            return
         path = urlparse(self.path).path
         if path == "/apis/authorization.k8s.io/v1/selfsubjectaccessreviews":
             self._self_subject_access_review()
@@ -1217,6 +1348,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(200, to_dict(updated))
 
     def do_PUT(self):
+        if self._try_aggregate():
+            return
         parsed = _parse_path(urlparse(self.path).path)
         if parsed is None or parsed[2] is None:
             self._error(404, "unknown path")
@@ -1292,6 +1425,8 @@ class _Handler(BaseHTTPRequestHandler):
         semantics) — reference: apiserver/pkg/endpoints/handlers/patch.go.
         get + merge + admission + OCC update run under one store transaction
         so concurrent patches serialize instead of clobbering."""
+        if self._try_aggregate():
+            return
         parsed = _parse_path(urlparse(self.path).path)
         if parsed is None or parsed[2] is None:
             self._error(404, "unknown path")
@@ -1530,6 +1665,8 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_json(201 if created else 200, to_dict(result))
 
     def do_DELETE(self):
+        if self._try_aggregate():
+            return
         parsed = _parse_path(urlparse(self.path).path)
         if parsed is None or parsed[2] is None:
             self._error(404, "unknown path")
