@@ -108,6 +108,9 @@ class FakeRuntime(CRIRuntime):
         self.calls: List[str] = []  # rpc log (FakeRuntime.CalledFunctions)
         self._exec_handler: Optional[Callable] = None
         self._port_handlers: Dict[int, Callable[[bytes], bytes]] = {}
+        # (pod_key, path) -> bytes: the fake container filesystem cat/tee
+        # (and therefore `ktl cp`) operate on
+        self._files: Dict[tuple, bytes] = {}
 
     # -- RuntimeService --------------------------------------------------------
 
@@ -137,6 +140,12 @@ class FakeRuntime(CRIRuntime):
     def remove_pod_sandbox(self, sandbox_id: str) -> None:
         with self._lock:
             self.calls.append("RemovePodSandbox")
+            sb = self.sandboxes.get(sandbox_id)
+            if sb is not None:
+                # pod filesystems are ephemeral: a recreated same-name pod
+                # must NOT inherit the dead pod's files
+                self._files = {k: v for k, v in self._files.items()
+                               if k[0] != sb.pod_key}
             self.sandboxes.pop(sandbox_id, None)
 
     def list_pod_sandboxes(self) -> List[PodSandboxStatus]:
@@ -191,6 +200,19 @@ class FakeRuntime(CRIRuntime):
         if prog == "echo":
             return (" ".join(command[1:]) + "\n").encode(), b"", 0
         if prog == "cat":
+            if len(command) > 1:
+                # per-pod in-memory filesystem (backs `ktl cp` reads)
+                with self._lock:
+                    data = self._files.get((pod_key, command[1]))
+                if data is None:
+                    return (b"", f"cat: {command[1]}: No such file or "
+                            f"directory\n".encode(), 1)
+                return data, b"", 0
+            return stdin, b"", 0
+        if prog == "tee":
+            if len(command) > 1:
+                with self._lock:
+                    self._files[(pod_key, command[1])] = stdin
             return stdin, b"", 0
         if prog == "true":
             return b"", b"", 0

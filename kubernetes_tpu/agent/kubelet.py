@@ -438,6 +438,7 @@ class Kubelet:
 
         def finish(s):
             s.stdout = stdout.decode(errors="replace")
+            s.stdout_b64 = base64.b64encode(stdout).decode()
             s.stderr = stderr.decode(errors="replace")
             s.exit_code = int(code)
             s.done = True

@@ -41,6 +41,8 @@ class PodExec:
     tty: bool = False
     # status
     stdout: str = ""
+    stdout_b64: str = ""  # byte-faithful copy (text stdout is lossy for
+    # binary content — ktl cp reads this)
     stderr: str = ""
     exit_code: Optional[int] = None
     done: bool = False
@@ -64,6 +66,7 @@ class PodExec:
             stdin=spec.get("stdin", ""),
             tty=bool(spec.get("tty", False)),
             stdout=st.get("stdout", ""),
+            stdout_b64=st.get("stdoutB64", ""),
             stderr=st.get("stderr", ""),
             exit_code=st.get("exitCode"),
             done=bool(st.get("done", False)),
@@ -74,6 +77,8 @@ class PodExec:
         status: Dict[str, Any] = {"done": self.done}
         if self.stdout:
             status["stdout"] = self.stdout
+        if self.stdout_b64:
+            status["stdoutB64"] = self.stdout_b64
         if self.stderr:
             status["stderr"] = self.stderr
         if self.exit_code is not None:

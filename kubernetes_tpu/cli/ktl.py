@@ -639,6 +639,113 @@ def cmd_port_forward(client: RESTClient, args) -> int:
         srv.close()
 
 
+def cmd_cp(client: RESTClient, args) -> int:
+    """kubectl cp over the exec channel (cp.go rides exec+tar in the
+    reference; here cat/tee against the container filesystem): `ktl cp
+    pod:/path local` and `ktl cp local pod:/path`."""
+    import base64
+    import os
+
+    ns = args.namespace or "default"
+
+    def split(spec):
+        # kubectl's disambiguation: a side is remote only when the prefix
+        # before ':' looks like a pod name (no path separator) AND no local
+        # file of that exact name exists — `./backup:2026.txt` stays local
+        pod, sep, path = spec.partition(":")
+        if not sep or "/" in pod or os.path.exists(spec):
+            return None, spec
+        return pod, path
+
+    src_pod, src_path = split(args.src)
+    dst_pod, dst_path = split(args.dst)
+    if (src_pod is None) == (dst_pod is None):
+        raise CLIError("cp needs exactly one pod:path side")
+    try:
+        if src_pod is not None:
+            out = client.exec(src_pod, ["cat", src_path], ns,
+                              container=args.container or "")
+            if int(out.get("exitCode", 0) or 0) != 0:
+                sys.stderr.write(out.get("stderr", ""))
+                return 1
+            # byte-faithful channel: the text stdout is lossy for binary
+            # content (decoded with errors=replace on the agent)
+            if out.get("stdoutB64"):
+                data = base64.b64decode(out["stdoutB64"])
+            else:
+                data = out.get("stdout", "").encode()
+            with open(dst_path, "wb") as f:
+                f.write(data)
+        else:
+            with open(src_path, "rb") as f:
+                data = f.read()
+            out = client.exec(dst_pod, ["tee", dst_path], ns,
+                              container=args.container or "", stdin=data)
+            if int(out.get("exitCode", 0) or 0) != 0:
+                sys.stderr.write(out.get("stderr", ""))
+                return 1
+    except (APIError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    return 0
+
+
+def cmd_diff(client: RESTClient, args) -> int:
+    """kubectl diff: live object vs what applying the manifest would
+    produce — computed with the SAME server-side-apply merge the server
+    runs (server/fieldmanager.py), so the preview matches the write.
+    Exit 1 when differences exist (the kubectl contract), 0 when clean."""
+    import difflib
+
+    from ..server.fieldmanager import Conflict, apply_patch
+
+    changed = False
+    for doc in load_manifests(args.filename):
+        kind = doc.get("kind", "")
+        resource = resolve_kind(client, kind)
+        if resource is None:
+            print(f"error: unsupported kind {kind!r}", file=sys.stderr)
+            return 2
+        meta = doc.get("metadata") or {}
+        ns = args.namespace or meta.get("namespace") or "default"
+        ns_arg = None if resource in CLUSTER_SCOPED else ns
+        name = meta.get("name", "")
+        try:
+            live = client.get(resource, name, ns_arg)
+        except APIError as e:
+            if e.code != 404:
+                print(f"error: {e}", file=sys.stderr)
+                return 2
+            live = None
+        try:
+            merged = apply_patch(live, doc, args.field_manager, force=True)
+        except Conflict as e:  # force=True never raises; defensive
+            print(f"error: {e}", file=sys.stderr)
+            return 2
+        def dump(d):
+            if d is None:
+                return []
+            d = {k: v for k, v in d.items() if k != "metadata"} | {
+                "metadata": {k: v for k, v in (d.get("metadata") or
+                                               {}).items()
+                             if k not in ("resourceVersion",
+                                          "managedFields", "uid",
+                                          "creationTimestamp")}}
+            return json.dumps(d, indent=2, sort_keys=True,
+                              default=str).splitlines(keepends=True)
+
+        diff = list(difflib.unified_diff(
+            dump(live), dump(merged),
+            fromfile=f"live/{resource}/{name}",
+            tofile=f"merged/{resource}/{name}"))
+        if diff:
+            changed = True
+            sys.stdout.writelines(diff)
+            if not diff[-1].endswith("\n"):
+                print()
+    return 1 if changed else 0
+
+
 def cmd_logs(client: RESTClient, args) -> int:
     """kubectl logs [-f]: the pods/{name}/log subresource (text/plain);
     --follow streams new lines by watching the pod's PodLog channel."""
@@ -1354,6 +1461,17 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("ports", help="LOCAL:REMOTE (or one port for both)")
     p.add_argument("--one-connection", action="store_true")
     p.set_defaults(fn=cmd_port_forward)
+
+    p = sub.add_parser("cp")
+    p.add_argument("src", help="pod:/path or a local file")
+    p.add_argument("dst", help="pod:/path or a local file")
+    p.add_argument("-c", "--container", default="")
+    p.set_defaults(fn=cmd_cp)
+
+    p = sub.add_parser("diff")
+    p.add_argument("-f", "--filename", required=True)
+    p.add_argument("--field-manager", default="ktl")
+    p.set_defaults(fn=cmd_diff)
 
     p = sub.add_parser("scale")
     p.add_argument("resource")

@@ -1250,6 +1250,8 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json(200, {"kind": "Status", "status": "Success",
                                   "stdout": result.stdout,
+                                  **({"stdoutB64": result.stdout_b64}
+                                     if result.stdout_b64 else {}),
                                   "stderr": result.stderr,
                                   "exitCode": result.exit_code,
                                   **({"error": result.error}

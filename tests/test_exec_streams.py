@@ -274,3 +274,85 @@ class TestHardening:
                         groups=("system:nodes", "system:authenticated"))
         assert a.authorize(node, "list", "podexecs")
         assert a.authorize(node, "update", "podportforwards")
+
+
+class TestCpAndDiff:
+    def test_cp_round_trip(self, cluster, tmp_path):
+        """ktl cp local -> pod:/path -> local through the exec channel."""
+        _store, srv, _rt = cluster
+        src = tmp_path / "config.txt"
+        src.write_text("tpu settings\nbatch=100000\n")
+        rc, _, err = run_ktl(srv, "cp", str(src), "web:/etc/config.txt")
+        assert rc == 0, err
+        back = tmp_path / "back.txt"
+        rc, _, err = run_ktl(srv, "cp", "web:/etc/config.txt", str(back))
+        assert rc == 0, err
+        assert back.read_text() == "tpu settings\nbatch=100000\n"
+
+    def test_cp_missing_remote_file_fails(self, cluster, tmp_path):
+        _store, srv, _rt = cluster
+        rc, _, err = run_ktl(srv, "cp", "web:/no/such", str(tmp_path / "x"))
+        assert rc == 1
+        assert "No such file" in err
+
+    def test_diff_shows_changes_and_exit_codes(self, cluster, tmp_path):
+        _store, srv, _rt = cluster
+        manifest = tmp_path / "cm.json"
+        manifest.write_text(json.dumps({
+            "kind": "ConfigMap", "metadata": {"name": "cm",
+                                              "namespace": "default"},
+            "data": {"k": "1"}}))
+        rc, _, _ = run_ktl(srv, "apply", "-f", str(manifest))
+        assert rc == 0
+        manifest.write_text(json.dumps({
+            "kind": "ConfigMap", "metadata": {"name": "cm",
+                                              "namespace": "default"},
+            "data": {"k": "2"}}))
+        rc, out, _ = run_ktl(srv, "diff", "-f", str(manifest))
+        assert rc == 1  # differences exist
+        assert '-    "k": "1"' in out and '+    "k": "2"' in out
+        # apply it, then diff again: clean -> rc 0
+        rc, _, _ = run_ktl(srv, "apply", "-f", str(manifest))
+        assert rc == 0
+        rc, out, _ = run_ktl(srv, "diff", "-f", str(manifest))
+        assert rc == 0, out
+
+    def test_cp_binary_round_trip(self, cluster, tmp_path):
+        """Binary content survives pod round-trips byte-for-byte (the text
+        stdout channel is lossy; cp rides stdoutB64)."""
+        _store, srv, _rt = cluster
+        src = tmp_path / "img.bin"
+        payload = bytes(range(256)) * 3 + b"\x89PNG\r\n"
+        src.write_bytes(payload)
+        rc, _, err = run_ktl(srv, "cp", str(src), "web:/data/img.bin")
+        assert rc == 0, err
+        back = tmp_path / "back.bin"
+        rc, _, err = run_ktl(srv, "cp", "web:/data/img.bin", str(back))
+        assert rc == 0, err
+        assert back.read_bytes() == payload
+
+    def test_cp_local_colon_filename_stays_local(self, cluster, tmp_path):
+        _store, srv, _rt = cluster
+        weird = tmp_path / "backup:2026.txt"
+        weird.write_text("colons happen\n")
+        rc, _, err = run_ktl(srv, "cp", str(weird), "web:/tmp/b.txt")
+        assert rc == 0, err
+        rc, out, _ = run_ktl(srv, "exec", "web", "--", "cat", "/tmp/b.txt")
+        assert out == "colons happen\n"
+
+    def test_recreated_pod_gets_fresh_filesystem(self, cluster, tmp_path):
+        _store, srv, _rt = cluster
+        src = tmp_path / "f.txt"
+        src.write_text("old pod data")
+        rc, _, _ = run_ktl(srv, "cp", str(src), "web:/f.txt")
+        assert rc == 0
+        store = _store
+        store.delete("pods", "default/web")
+        time.sleep(0.3)  # kubelet reaps the sandbox (ticking loop)
+        pod = MakePod("web").req({"cpu": "100m"}).obj()
+        store.create("pods", pod)
+        store.bind("default", "web", "n1")
+        time.sleep(0.3)
+        rc, _, err = run_ktl(srv, "cp", "web:/f.txt", str(tmp_path / "o"))
+        assert rc == 1
+        assert "No such file" in err
