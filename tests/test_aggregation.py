@@ -219,3 +219,49 @@ class TestAggregationSecurity:
         with pytest.raises(APIError) as e:
             c.request("GET", "/apis/metrics.example.com/v2/nodes")
         assert e.value.code == 502
+
+
+class TestAggregatedWatch:
+    def test_watch_streams_through_proxy(self, server):
+        """?watch=true on an aggregated group streams the backend's chunks
+        without buffering the whole (endless) response."""
+        import urllib.request
+
+        class _Streamer(BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                for i in range(3):
+                    line = json.dumps({"type": "ADDED", "object": {
+                        "metadata": {"name": f"w{i}"}}}).encode() + b"\n"
+                    self.wfile.write(
+                        f"{len(line):x}\r\n".encode() + line + b"\r\n")
+                    self.wfile.flush()
+                    time.sleep(0.05)
+                self.wfile.write(b"0\r\n\r\n")
+
+            def log_message(self, *a):
+                pass
+
+        backend = ThreadingHTTPServer(("127.0.0.1", 0), _Streamer)
+        t = threading.Thread(target=backend.serve_forever, daemon=True)
+        t.start()
+        try:
+            server.store.create("apiservices", APIService(
+                group="streams.example.com",
+                service_url=f"http://127.0.0.1:{backend.server_address[1]}",
+                available=True))
+            req = urllib.request.Request(
+                f"{server.url}/apis/streams.example.com/v1/widgets"
+                f"?watch=true")
+            names = []
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                for raw in resp:
+                    if raw.strip():
+                        names.append(json.loads(raw)["object"]["metadata"]
+                                     ["name"])
+            assert names == ["w0", "w1", "w2"]
+        finally:
+            backend.shutdown()

@@ -116,3 +116,27 @@ class TestWatchMux:
                           "spec": {"containers": [{"name": "c"}]}})
         t.join(timeout=10)
         assert got == [("ADDED", "a"), ("DELETED", "a"), ("ADDED", "b")]
+
+    def test_evicted_watch_terminates_stream(self, server):
+        """A watch evicted for falling behind (queue overflow) must end its
+        HTTP stream so the client relists — the mux path keeps the store's
+        slow-watcher contract."""
+        store = server.store
+        _, rv = store.list("pods")
+        resp = open_watch(server, rv)
+        assert wait_streams(server, 1)
+        # overflow the watch's bounded buffer faster than the mux drains:
+        # grab the mux's registered Watch and shrink it artificially
+        with server._mux._lock:
+            st = server._mux._streams[0]
+        st.watch.terminated = True  # simulate the store's eviction verdict
+        # the next pump pass closes the stream with the final chunk
+        deadline = time.monotonic() + 5
+        got_eof = False
+        while time.monotonic() < deadline:
+            line = resp.readline()
+            if line == b"":
+                got_eof = True
+                break
+        assert got_eof
+        assert wait_streams(server, 0)
