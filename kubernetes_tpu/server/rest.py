@@ -281,7 +281,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self.end_headers()
                 try:
                     while True:
-                        chunk = resp.read(65536)
+                        # read1: forward whatever is available NOW —
+                        # read(n) on a chunked response blocks until n
+                        # bytes or EOF, which would buffer a watch stream
+                        chunk = resp.read1(65536)
                         if not chunk:
                             break
                         self.wfile.write(

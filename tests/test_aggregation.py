@@ -223,9 +223,13 @@ class TestAggregationSecurity:
 
 class TestAggregatedWatch:
     def test_watch_streams_through_proxy(self, server):
-        """?watch=true on an aggregated group streams the backend's chunks
-        without buffering the whole (endless) response."""
+        """?watch=true on an aggregated group streams events AS THEY ARRIVE:
+        the first event must be readable through the proxy while the
+        backend stream is STILL OPEN (a buffering proxy passes nothing
+        until EOF — resp.read vs read1 regression guard)."""
         import urllib.request
+
+        release = threading.Event()
 
         class _Streamer(BaseHTTPRequestHandler):
             def do_GET(self):
@@ -233,13 +237,19 @@ class TestAggregatedWatch:
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Transfer-Encoding", "chunked")
                 self.end_headers()
-                for i in range(3):
+
+                def send(i):
                     line = json.dumps({"type": "ADDED", "object": {
                         "metadata": {"name": f"w{i}"}}}).encode() + b"\n"
                     self.wfile.write(
                         f"{len(line):x}\r\n".encode() + line + b"\r\n")
                     self.wfile.flush()
-                    time.sleep(0.05)
+
+                send(0)
+                # hold the stream OPEN until the test confirms delivery
+                release.wait(timeout=10)
+                send(1)
+                send(2)
                 self.wfile.write(b"0\r\n\r\n")
 
             def log_message(self, *a):
@@ -258,10 +268,15 @@ class TestAggregatedWatch:
                 f"?watch=true")
             names = []
             with urllib.request.urlopen(req, timeout=10) as resp:
+                first = resp.readline()
+                assert json.loads(first)["object"]["metadata"]["name"] \
+                    == "w0", "first event must stream BEFORE backend EOF"
+                release.set()  # only now may the backend finish
                 for raw in resp:
                     if raw.strip():
                         names.append(json.loads(raw)["object"]["metadata"]
                                      ["name"])
-            assert names == ["w0", "w1", "w2"]
+            assert names == ["w1", "w2"]
         finally:
+            release.set()
             backend.shutdown()

@@ -118,25 +118,38 @@ class TestWatchMux:
         assert got == [("ADDED", "a"), ("DELETED", "a"), ("ADDED", "b")]
 
     def test_evicted_watch_terminates_stream(self, server):
-        """A watch evicted for falling behind (queue overflow) must end its
-        HTTP stream so the client relists — the mux path keeps the store's
-        slow-watcher contract."""
+        """A watch evicted for falling behind (REAL queue overflow through
+        Watch._deliver) must end its HTTP stream so the client relists —
+        the mux path keeps the store's slow-watcher contract."""
+        import queue as _queue
+
         store = server.store
         _, rv = store.list("pods")
         resp = open_watch(server, rv)
         assert wait_streams(server, 1)
-        # overflow the watch's bounded buffer faster than the mux drains:
-        # grab the mux's registered Watch and shrink it artificially
         with server._mux._lock:
             st = server._mux._streams[0]
-        st.watch.terminated = True  # simulate the store's eviction verdict
-        # the next pump pass closes the stream with the final chunk
+        # shrink the REGISTERED watch's bounded buffer to 1, then publish
+        # two events before the mux can drain: the second delivery hits
+        # queue.Full and runs the store's real eviction path (terminated +
+        # unsubscribe + sentinel)
+        st.watch._q = _queue.Queue(maxsize=1)
+        with store._lock:  # publish back-to-back with the mux locked out
+            for i in range(3):
+                store.create("pods", MakePod(f"burst{i}").obj())
         deadline = time.monotonic() + 5
-        got_eof = False
-        while time.monotonic() < deadline:
-            line = resp.readline()
-            if line == b"":
-                got_eof = True
-                break
-        assert got_eof
-        assert wait_streams(server, 0)
+        while time.monotonic() < deadline and not st.watch.terminated:
+            time.sleep(0.01)
+        assert st.watch.terminated
+        try:
+            deadline = time.monotonic() + 5
+            got_eof = False
+            while time.monotonic() < deadline:
+                line = resp.readline()
+                if line == b"":
+                    got_eof = True
+                    break
+            assert got_eof
+            assert wait_streams(server, 0)
+        finally:
+            resp.close()
