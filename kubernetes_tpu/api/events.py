@@ -127,21 +127,27 @@ class EventRecorder:
                         return
                     except Exception:
                         self._known.pop(agg, None)  # deleted (TTL): recreate
-                try:
-                    self.store.create("events", Event(
-                        metadata=ObjectMeta(name=ev_name, namespace=namespace,
+                # consume=True: the recorder never touches the object
+                # again, so the store takes ownership without paying
+                # create()'s isolation deepcopy (events are emitted per
+                # victim under preemption storms)
+                _created, errs = self.store.create_many(
+                    "events", [Event(
+                        metadata=ObjectMeta(name=ev_name,
+                                            namespace=namespace,
                                             uid=new_uid()),
                         involved_kind=kind, involved_name=name,
                         involved_namespace=namespace,
                         reason=reason, message=message, type=etype,
                         source=self.component,
-                        first_timestamp=now, last_timestamp=now))
-                    self._known[agg] = ev_name
-                    if len(self._known) > 10_000:
-                        self._known.clear()  # bounded memory; worst case re-create
-                except Exception:
+                        first_timestamp=now, last_timestamp=now)],
+                    consume=True)
+                if errs:
+                    # already exists (evicted from _known): bump the count
                     self.store.guaranteed_update("events", key, bump)
-                    self._known[agg] = ev_name
+                self._known[agg] = ev_name
+                if len(self._known) > 10_000:
+                    self._known.clear()  # bounded memory; worst case re-create
         except Exception:
             pass  # best effort
 

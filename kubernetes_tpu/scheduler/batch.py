@@ -19,7 +19,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..snapshot.tensorizer import TensorCache, build_cluster_tensors, build_pod_batch
-from ..store import APIStore, pod_structural_clone
+from ..store import APIStore, pod_bind_clone, pod_structural_clone
 from .framework import Status
 from .queue import QueuedPodInfo
 from .runtime import Framework
@@ -34,7 +34,8 @@ class BatchScheduler(Scheduler):
     the batch has no topology-spread constraints, exact otherwise)."""
 
     def __init__(self, store: APIStore, framework: Framework, batch_size: int = 4096,
-                 solver: str = "exact", pipeline_binds: bool = True, **kw):
+                 solver: str = "exact", pipeline_binds: bool = True,
+                 columnar: bool = True, **kw):
         super().__init__(store, framework, **kw)
         self.batch_size = batch_size
         self.solver = solver
@@ -42,6 +43,11 @@ class BatchScheduler(Scheduler):
         self.transport_state = None  # warm duals carried across batches
         # generation-diff incremental tensorization (cache.go:186 analog)
         self._tensor_cache = TensorCache()
+        # columnar=True is the batched host pipeline: coalesced watch ingest,
+        # structural+scatter-add assume accounting, self-bind short-circuit.
+        # False restores the per-pod paths (the parity oracle for tests).
+        self.columnar = columnar
+        self.watch_coalesce = columnar
         # Bind pipelining (schedule_one.go:120-132 bindingCycle-in-goroutine
         # analog): assume_pod runs synchronously so the next solve's snapshot
         # sees the capacity, while the store.bind writes flush on a worker
@@ -52,6 +58,10 @@ class BatchScheduler(Scheduler):
         self._bind_errors: List = []
         self._bind_successes = 0  # folded into scheduled_count on the
         self._bind_err_lock = threading.Lock()  # scheduling thread (no race)
+        # async bind failures, surfaced to schedule_batch callers (the worker
+        # requeues them internally, but "my bind_many failed" was invisible):
+        # [(pod key, message)], drained via take_bind_failures()
+        self.bind_failures: List = []
 
     def schedule_batch(self, timeout: Optional[float] = 0.0) -> int:
         """Drain up to batch_size pods, solve jointly, bind. Returns #pods handled."""
@@ -137,23 +147,49 @@ class BatchScheduler(Scheduler):
             # promised to not-yet-bound assignments and double-book nodes.
             rejected = []
             to_bind = []
-            for j, pi in enumerate(device_idx):
-                nidx = int(assignment[j])
+            bind_rows: List[int] = []  # full-batch pod row per to_bind entry
+            bind_nodes: List[int] = []  # cluster node index per to_bind entry
+            use_columnar = self.columnar and batch.raw_req is not None
+            clone = pod_bind_clone if use_columnar else pod_structural_clone
+            node_names = cluster.node_names
+            # .tolist() once: per-element int() of numpy scalars is
+            # measurable at 100k pods
+            assign_list = np.asarray(assignment).tolist()
+            for j, pi in enumerate(device_idx.tolist()):
+                nidx = assign_list[j]
                 if nidx < 0:
                     rejected.append((j, qps[pi]))
                 else:
-                    to_bind.append((qps[pi], cluster.node_names[nidx],
-                                    pod_structural_clone(qps[pi].pod)))
+                    to_bind.append((qps[pi], node_names[nidx],
+                                    clone(qps[pi].pod)))
+                    bind_rows.append(pi)
+                    bind_nodes.append(nidx)
             if to_bind:
                 # bulk assume under one cache lock, then hand the worker
                 # CHUNKED batches: per-pod puts left bind_many at ~53-pod
                 # batches under queue contention, while one 100k batch
                 # would hold the store lock against every consumer
-                bad = self.cache.assume_pods(
-                    [(assumed, node) for _qp, node, assumed in to_bind])
+                pairs = [(assumed, node) for _qp, node, assumed in to_bind]
+                if use_columnar:
+                    batch_has_ports = bool(
+                        batch.class_has_host_ports is None
+                        or batch.class_has_host_ports[
+                            batch.class_of_pod[bind_rows]].any())
+                    # structural phase only; resource totals follow as one
+                    # scatter-add in _columnar_account
+                    bad = self.cache.assume_pods_structural(
+                        pairs, check_ports=batch_has_ports)
+                else:
+                    bad = self.cache.assume_pods(pairs)
                 for i, msg in sorted(bad, reverse=True):
                     qp, node, _assumed = to_bind.pop(i)
+                    bind_rows.pop(i)
+                    bind_nodes.pop(i)
                     self._handle_failure(qp, Status.error(msg))
+                if use_columnar and to_bind:
+                    self._columnar_account(batch, cluster, snapshot,
+                                           bind_rows, bind_nodes,
+                                           batch_has_ports)
                 CHUNK = 10_000
                 for lo in range(0, len(to_bind), CHUNK):
                     chunk = to_bind[lo:lo + CHUNK]
@@ -175,6 +211,34 @@ class BatchScheduler(Scheduler):
         self.batches_solved += 1
         m.batch_solve_duration.observe(time.perf_counter() - t_batch)
         return len(qps)
+
+    def _columnar_account(self, batch, cluster, snapshot, bind_rows,
+                          bind_nodes, has_ports: bool = True) -> None:
+        """Phase 2 of the columnar assume: per-node requested-resource deltas
+        for the whole solved batch as numpy scatter-adds keyed by the
+        tensorizer's node index — one Resource poke per touched node in the
+        cache, and (when nothing foreign intervened and no host ports are in
+        play) a direct feed of TensorCache's generation diff so solve(N+1)
+        skips the per-node requantize walk entirely."""
+        rows = np.asarray(bind_rows, dtype=np.int64)
+        nodes = np.asarray(bind_nodes, dtype=np.int64)
+        n, r = cluster.n, len(cluster.resource_dims)
+        d_used = np.zeros((n, r), dtype=np.int64)
+        d_used_nz = np.zeros((n, r), dtype=np.int64)
+        np.add.at(d_used, nodes, batch.raw_req[rows])
+        np.add.at(d_used_nz, nodes, batch.raw_req_nz[rows])
+        d_count = np.bincount(nodes, minlength=n)
+        touched = np.unique(nodes)
+        final_gen = self.cache.apply_node_resource_deltas(
+            cluster.resource_dims,
+            [(cluster.node_names[i], d_used[i], d_used_nz[i])
+             for i in touched],
+            expected_gen=snapshot.generation)
+        if final_gen is not None and not has_ports:
+            self._tensor_cache.apply_assume_deltas(
+                touched, d_used[touched], d_used_nz[touched],
+                d_count[touched], tensorized_gen=snapshot.generation,
+                assume_gen=final_gen)
 
     def _handle_device_rejects(self, rejected, snapshot, cluster, sub,
                                assignment) -> None:
@@ -520,11 +584,19 @@ class BatchScheduler(Scheduler):
         for lo in range(0, len(triples), 10_000):
             chunk = triples[lo:lo + 10_000]
             try:
-                _bound, errs = self.store.bind_many(chunk)
+                _bound, errs = self.store.bind_many(
+                    chunk, origin=self._bind_origin)
                 errors.extend(errs)
             except Exception as e:
                 errors.extend((f"{ns}/{name}", str(e))
                               for ns, name, _node in chunk)
+        if not errors:
+            # common case: whole batch committed — one cache lock for the
+            # finish_binding sweep instead of one acquire per pod
+            self.cache.finish_binding_bulk([a for _qp, _node, a in items])
+            with self._bind_err_lock:
+                self._bind_successes += len(items)
+            return
         errmap = dict(errors)
         with self._bind_err_lock:
             for qp, _node, assumed in items:
@@ -539,13 +611,27 @@ class BatchScheduler(Scheduler):
     def _drain_bind_results(self) -> None:
         """Fold completed async binds into counters and re-handle failures on
         the scheduling thread (handleBindingCycleError -> requeue). Does NOT
-        wait for in-flight binds — callable every cycle under sustained load."""
+        wait for in-flight binds — callable every cycle under sustained load.
+        Failures are requeued AND recorded in bind_failures so callers of
+        schedule_batch can observe them (take_bind_failures)."""
         with self._bind_err_lock:
             done, self._bind_successes = self._bind_successes, 0
             errs, self._bind_errors = self._bind_errors, []
         self.scheduled_count += done
         for qp, status in errs:
+            self.bind_failures.append((qp.pod.key, status.message()))
             self._handle_failure(qp, status)
+        if len(self.bind_failures) > 100_000:
+            del self.bind_failures[:50_000]  # bounded if never drained
+
+    def take_bind_failures(self) -> List:
+        """Drain the (pod key, error message) log of asynchronous bind
+        failures observed since the last call. The pods themselves were
+        already requeued via the normal failure path; this surfaces WHAT
+        failed to callers of schedule_batch/flush_binds, which otherwise
+        only ever see success counts."""
+        out, self.bind_failures = self.bind_failures, []
+        return out
 
     def flush_binds(self) -> None:
         """Wait for queued store.bind writes, then drain results."""
@@ -611,4 +697,6 @@ def _subset_batch(batch, idx):
         req=batch.req[idx],
         req_nz=batch.req_nz[idx],
         balanced_active=batch.balanced_active[idx],
+        raw_req=None if batch.raw_req is None else batch.raw_req[idx],
+        raw_req_nz=None if batch.raw_req_nz is None else batch.raw_req_nz[idx],
     )

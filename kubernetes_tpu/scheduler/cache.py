@@ -175,6 +175,123 @@ class Cache:
                     failed.append((i, str(e)))
         return failed
 
+    # -- columnar assume (the batched solver's accounting path) ----------------
+
+    def assume_pods_structural(self, pairs,
+                               check_ports: bool = True) -> List[Tuple[int, str]]:
+        """Phase 1 of the columnar assume: per-pod bookkeeping ONLY —
+        validation, _pod_nodes/_assumed entries, PodInfo appends (pods lists,
+        affinity sublists, host ports). Requested-resource totals and
+        generations are NOT touched; the caller must follow up with
+        apply_node_resource_deltas (computed as numpy scatter-adds over the
+        solver batch — the per-pod Resource.add loop was a top stage of the
+        100k assume). Between the two calls the touched NodeInfos are
+        transiently inconsistent (pods appended, requested stale); the
+        scheduling thread is the only snapshot taker, so no consumer can
+        observe the gap. check_ports=False skips the host-port scan when the
+        caller proved no pod in the batch declares host ports (the
+        tensorizer's per-class flag). Returns (index, error) for entries
+        that failed."""
+        from .framework import _host_ports
+
+        failed = []
+        with self._lock:
+            pod_nodes = self._pod_nodes
+            assumed = self._assumed
+            nodes = self._nodes
+            for i, (pod, node_name) in enumerate(pairs):
+                key = pod.key
+                if key in pod_nodes:
+                    failed.append((i, f"pod {key} is already in the cache"))
+                    continue
+                pod.spec.node_name = node_name
+                ni = nodes.get(node_name)
+                if ni is None:
+                    ni = NodeInfo()
+                    nodes[node_name] = ni
+                pi = PodInfo(pod)
+                ni.pods.append(pi)
+                if (pi.required_affinity_terms or pi.preferred_affinity_terms
+                        or pi.required_anti_affinity_terms
+                        or pi.preferred_anti_affinity_terms):
+                    ni.pods_with_affinity.append(pi)
+                    if pi.required_anti_affinity_terms:
+                        ni.pods_with_required_anti_affinity.append(pi)
+                if check_ports:
+                    for port in _host_ports(pod):
+                        ni.used_ports.add(port)
+                pod_nodes[key] = node_name
+                assumed[key] = 0.0
+        return failed
+
+    def apply_node_resource_deltas(self, resource_dims, node_deltas,
+                                   expected_gen: Optional[int] = None
+                                   ) -> Optional[int]:
+        """Phase 2 of the columnar assume: per-NODE aggregate requested /
+        non-zero-requested updates (one Resource poke per touched node
+        instead of two Resource.adds per pod) plus the generation touch that
+        makes update_snapshot clone exactly these nodes. node_deltas =
+        [(node_name, d_raw, d_raw_nz)] with d_* int64 vectors laid out by
+        resource_dims (milli-CPU, bytes, bytes, then scalar counts — the
+        tensorizer's raw layout, so the same scatter-add feeds both this and
+        TensorCache.apply_assume_deltas).
+
+        Returns the generation after the touches IF the cache was still at
+        expected_gen on entry — proving, under one lock hold, that every
+        generation between the two is one of these touches (the TensorCache
+        fast path's precondition). Returns None when a foreign mutation got
+        in first (e.g. a bind-worker forget_pod): the deltas still apply,
+        but the caller must leave requantization to the normal diff path."""
+        from ..api.resources import CPU, EPHEMERAL_STORAGE, MEMORY
+
+        with self._lock:
+            clean = expected_gen is None or self._generation == expected_gen
+            for node_name, d_raw, d_raw_nz in node_deltas:
+                ni = self._nodes.get(node_name)
+                if ni is None:
+                    continue
+                for res, vec in ((ni.requested, d_raw),
+                                 (ni.non_zero_requested, d_raw_nz)):
+                    for di, dim in enumerate(resource_dims):
+                        v = int(vec[di])
+                        if not v:
+                            continue
+                        if dim == CPU:
+                            res.milli_cpu += v
+                        elif dim == MEMORY:
+                            res.memory += v
+                        elif dim == EPHEMERAL_STORAGE:
+                            res.ephemeral_storage += v
+                        else:
+                            res.scalar[dim] = res.scalar.get(dim, 0) + v
+                self._touch(ni)
+            return self._generation if clean else None
+
+    def confirm_assumed_bulk(self, pairs) -> List[int]:
+        """Self-bind short-circuit: confirm assumed pods whose bind MODIFIED
+        events came back from our own bind_many — equivalent to add_pod's
+        confirmation branch (drop the assume record, accounting already
+        matches) without a per-event ingest. pairs = [(pod key, node_name)];
+        returns the indices that did NOT match an assume on that node — the
+        caller must push those through the full ingest path (foreign bind,
+        expired assume, node mismatch)."""
+        leftover = []
+        with self._lock:
+            for i, (key, node_name) in enumerate(pairs):
+                if key in self._assumed and self._pod_nodes.get(key) == node_name:
+                    del self._assumed[key]
+                else:
+                    leftover.append(i)
+        return leftover
+
+    @property
+    def generation(self) -> int:
+        """Current mutation counter (snapshots stamp it; TensorCache compares
+        it to decide whether its columnar assume deltas fully explain the
+        diff since the last tensorize)."""
+        with self._lock:
+            return self._generation
+
     def _assume_internal(self, pod: Pod, node_name: str) -> None:
         key = pod.key
         if key in self._pod_nodes:
@@ -187,6 +304,18 @@ class Cache:
         with self._lock:
             if pod.key in self._assumed:
                 self._assumed[pod.key] = self._clock.now() + self._ttl
+
+    def finish_binding_bulk(self, pods) -> None:
+        """finish_binding for a whole committed bind batch: one lock, one
+        clock read (the bind worker's per-pod acquires were measurable at
+        100k-bind scale)."""
+        with self._lock:
+            deadline = self._clock.now() + self._ttl
+            assumed = self._assumed
+            for pod in pods:
+                key = pod.key
+                if key in assumed:
+                    assumed[key] = deadline
 
     def forget_pod(self, pod: Pod) -> None:
         with self._lock:

@@ -207,8 +207,25 @@ class DefaultPreemption:
             )
         except Exception:
             pass
-        # victim narration (prepareCandidate's "Preempted" event) — uses the
-        # scheduler's recorder (shared clock/aggregation) when injected
+        # async mode moves the WHOLE per-victim preparation — narration
+        # events and DELETE writes — onto the worker (the reference's
+        # prepareCandidateAsync runs everything after nomination in a
+        # goroutine). Each recorder.event is a store write (~ms); paying
+        # victims x that on the scheduling thread was why PreemptionAsync
+        # benched no faster than the serial mode.
+        if self.async_preparation:
+            self._ensure_prep_worker()
+            self._prep_q.put((list(cand.victims), pod.metadata.name,
+                              cand.node_name))
+        else:
+            self._narrate_victims(cand.victims, pod.metadata.name,
+                                  cand.node_name)
+            self._delete_victims(cand.victims)
+
+    def _narrate_victims(self, victims, preemptor_name: str,
+                         node_name: str) -> None:
+        """Victim narration (prepareCandidate's "Preempted" event) — uses the
+        scheduler's recorder (shared clock/aggregation) when injected."""
         try:
             recorder = getattr(self, "_recorder", None)
             if recorder is None:
@@ -216,17 +233,12 @@ class DefaultPreemption:
 
                 recorder = self._recorder = EventRecorder(
                     self.store, component="default-scheduler")
-            for v in cand.victims:
+            for v in victims:
                 recorder.event(
                     v, "Normal", "Preempted",
-                    f"Preempted by pod {pod.metadata.name} on node {cand.node_name}")
+                    f"Preempted by pod {preemptor_name} on node {node_name}")
         except Exception:
             pass
-        if self.async_preparation:
-            self._ensure_prep_worker()
-            self._prep_q.put(list(cand.victims))
-        else:
-            self._delete_victims(cand.victims)
 
     def _ensure_prep_worker(self) -> None:
         import queue as _q
@@ -239,8 +251,9 @@ class DefaultPreemption:
 
     def _prep_loop(self) -> None:
         while True:
-            victims = self._prep_q.get()
+            victims, preemptor_name, node_name = self._prep_q.get()
             try:
+                self._narrate_victims(victims, preemptor_name, node_name)
                 self._delete_victims(victims)
             finally:
                 self._prep_q.task_done()

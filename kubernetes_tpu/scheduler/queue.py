@@ -95,6 +95,43 @@ class SchedulingQueue:
             self._push_active(qp)
             self._lock.notify()
 
+    def add_batch(self, pods: List[Pod], pre_gated: bool = False) -> None:
+        """Bulk admission for a coalesced watch chunk: ONE lock acquisition
+        and one O(n+m) heapify instead of n heappushes (the per-pod adds were
+        a top stage of the 100k-backlog ingest). Pop order is identical to n
+        add() calls — the heap key (sort_key, seq) is a total order, so heap
+        layout doesn't matter. PreEnqueue gating still applies per pod via
+        _pre_enqueue (gated pods park in unschedulable, as add() does);
+        pre_gated=True skips that re-check when the caller just ran
+        PreEnqueue on every pod itself (the coalesced ingest path — add()
+        semantics double-run it, microseconds apart, with the same answer)."""
+        if not pods:
+            return
+        with self._lock:
+            now = self._clock.now()
+            entries = []
+            for pod in pods:
+                qp = QueuedPodInfo(pod=pod, timestamp=now)
+                key = qp.key
+                self._unschedulable.pop(key, None)
+                if key in self._in_active:
+                    continue
+                if (not pre_gated and self._pre_enqueue is not None
+                        and not self._pre_enqueue(pod)):
+                    self._unschedulable[key] = qp  # still gated: stay parked
+                    continue
+                self._in_active[key] = qp
+                entries.append((self._sort_key(qp), next(self._seq), qp))
+            if not entries:
+                return
+            if len(entries) >= len(self._active):
+                self._active.extend(entries)
+                heapq.heapify(self._active)
+            else:
+                for e in entries:
+                    heapq.heappush(self._active, e)
+            self._lock.notify_all()
+
     def _push_active(self, qp: QueuedPodInfo) -> None:
         self._unschedulable.pop(qp.key, None)
         if qp.key in self._in_active:
@@ -197,6 +234,16 @@ class SchedulingQueue:
             return out
         out.append(first)
         with self._lock:
+            if len(self._active) + 1 <= max_n:
+                # draining everything: one Timsort beats n heappops and pops
+                # in the identical (sort_key, seq) total order
+                drained = sorted(self._active)
+                self._active = []
+                for _, _, qp in drained:
+                    self._in_active.pop(qp.key, None)
+                    qp.attempts += 1
+                    out.append(qp)
+                return out
             while self._active and len(out) < max_n:
                 _, _, qp = heapq.heappop(self._active)
                 self._in_active.pop(qp.key, None)

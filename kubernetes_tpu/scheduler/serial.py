@@ -20,7 +20,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..api import Pod
-from ..store import ADDED, DELETED, MODIFIED, APIStore, pod_structural_clone
+from ..store import (ADDED, DELETED, MODIFIED, APIStore, CoalescedEvent,
+                     pod_structural_clone)
 from ..utils import Clock
 from .cache import Cache
 from .framework import CycleState, NodeInfo, Snapshot, Status
@@ -33,6 +34,10 @@ STORAGE_KINDS = ("persistentvolumeclaims", "persistentvolumes",
 
 MIN_FEASIBLE_NODES_TO_FIND = 100  # schedule_one.go:52
 MIN_FEASIBLE_NODES_PERCENTAGE_TO_FIND = 5  # schedule_one.go:57
+
+import itertools as _itertools
+
+_scheduler_origin_seq = _itertools.count()
 
 
 def num_feasible_nodes_to_find(num_all_nodes: int, percentage: int = 0) -> int:
@@ -111,6 +116,11 @@ class Scheduler:
         )
         self.percentage = percentage_of_nodes_to_score
         self._watch = None
+        # coalesced watch ingest: batched store writes arrive as ONE
+        # CoalescedEvent; _bind_origin tags our own bind_many batches so
+        # their MODIFIED events short-circuit to a bulk assume-confirm
+        self.watch_coalesce = True
+        self._bind_origin = f"scheduler-{next(_scheduler_origin_seq)}"
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.scheduled_count = 0
@@ -190,8 +200,10 @@ class Scheduler:
         # falls behind it is evicted and relists (pump_events). Subscribed to
         # exactly the kinds _handle_event consumes: high-volume kinds it would
         # ignore (its own Scheduled/FailedScheduling events!) never enqueue.
+        # Coalesced: a 100k bind_many backlog is a handful of buffered items.
         self._watch = self.store.watch(
-            kind=self._watched_kinds(), since_rv=rv, maxsize=200_000)
+            kind=self._watched_kinds(), since_rv=rv, maxsize=200_000,
+            coalesce=self.watch_coalesce)
 
     def _push_ns_labels(self):
         for fw in self.profiles.values():
@@ -217,11 +229,68 @@ class Scheduler:
         n = 0
         # bounded drain: events beyond the cap STAY in the watch buffer for
         # the next pump (a plain drain() dequeues everything — breaking out
-        # of that list discarded the rest of a large backlog)
+        # of that list discarded the rest of a large backlog). A coalesced
+        # batch counts as one buffered item but reports its true size.
         for ev in self._watch.drain(max_events):
-            self._handle_event(ev)
-            n += 1
+            if type(ev) is CoalescedEvent:
+                n += self._handle_coalesced(ev)
+            else:
+                self._handle_event(ev)
+                n += 1
         return n
+
+    def _handle_coalesced(self, cev: CoalescedEvent) -> int:
+        """Batched ingest of one CoalescedEvent (a bind_many / create_many
+        chunk). Two bulk fast paths, both falling back to the per-event
+        handler for anything that doesn't match:
+
+          - our own bind MODIFIED batch (origin == _bind_origin): bulk
+            assume-confirm — one cache lock instead of 100k per-event
+            ingests; events the cache can't confirm (foreign rebind, expired
+            assume) take the full path and correct the cache;
+          - pending-pod ADDED batch: PreEnqueue-gate per pod, then ONE
+            SchedulingQueue.add_batch admission (single lock + heapify).
+
+        Returns the number of per-object events ingested."""
+        events = cev.events
+        if cev.kind != "pods":
+            for ev in events:
+                self._handle_event(ev)
+            return len(events)
+        if (cev.type == MODIFIED and cev.origin is not None
+                and cev.origin == self._bind_origin):
+            pairs = [(ev.obj.key, ev.obj.spec.node_name) for ev in events]
+            for i in self.cache.confirm_assumed_bulk(pairs):
+                self._handle_pod(MODIFIED, events[i].obj)
+            return len(events)
+        if cev.type == ADDED:
+            admit: List[Pod] = []
+            for ev in events:
+                pod = ev.obj
+                if (pod.spec.node_name or pod.is_terminal()
+                        or self._fw(pod) is None):
+                    self._handle_pod(ADDED, pod)  # not a plain pending pod
+                elif self._gate_pending_pod(pod):
+                    admit.append(pod)
+            self.queue.add_batch(admit, pre_gated=True)
+            return len(events)
+        for ev in events:
+            self._handle_event(ev)
+        return len(events)
+
+    def _gate_pending_pod(self, pod: Pod) -> bool:
+        """PreEnqueue-gate one unbound pod (shared by the per-event and
+        coalesced ingest paths so the two can't drift): True means admit to
+        the active queue; a gated pod is parked unschedulable with its
+        rejecting plugin recorded, exactly as handleSchedulingFailure
+        would."""
+        st = (self._fw(pod) or self.framework).run_pre_enqueue(pod)
+        if st.is_success():
+            return True
+        self.queue.add_unschedulable(QueuedPodInfo(
+            pod=pod, timestamp=self.clock.now(),
+            unschedulable_plugins=(st.plugin,)))
+        return False
 
     def _relist(self) -> None:
         """Rebuild cache + listers from a fresh consistent LIST and rewatch
@@ -266,7 +335,8 @@ class Scheduler:
                     lister.add(obj)
         self._push_ns_labels()
         self._watch = self.store.watch(
-            kind=self._watched_kinds(), since_rv=rv, maxsize=200_000)
+            kind=self._watched_kinds(), since_rv=rv, maxsize=200_000,
+            coalesce=self.watch_coalesce)
         self.queue.move_all_to_active_or_backoff()
 
     _EVENT_ACTION = {ADDED: "add", MODIFIED: "update", DELETED: "delete"}
@@ -393,12 +463,8 @@ class Scheduler:
         else:
             if etype == MODIFIED and self.queue.update(pod):
                 return  # status-only updates of queued pods don't requeue
-            st = (self._fw(pod) or self.framework).run_pre_enqueue(pod)
-            if st.is_success():
+            if self._gate_pending_pod(pod):
                 self.queue.add(pod)
-            else:
-                self.queue.add_unschedulable(QueuedPodInfo(pod=pod, timestamp=self.clock.now(),
-                                                           unschedulable_plugins=(st.plugin,)))
 
     # -- core scheduling (schedule_one.go) -------------------------------------
 

@@ -51,6 +51,34 @@ def _quantize(r: Resource, resource_dims: Sequence[str], is_request: bool) -> Li
     return out
 
 
+def _raw_vec(r: Resource, resource_dims: Sequence[str]) -> List[int]:
+    """Unquantized resource vector (milli-CPU, bytes, bytes, scalar counts).
+    The columnar accounting path accumulates THESE and quantizes the totals,
+    so its rows stay bit-identical to quantizing NodeInfo.requested (sum of
+    per-pod MiB ceilings != ceiling of the byte sum)."""
+    out = []
+    for name in resource_dims:
+        if name == CPU:
+            out.append(r.milli_cpu)
+        elif name == MEMORY:
+            out.append(r.memory)
+        elif name == EPHEMERAL_STORAGE:
+            out.append(r.ephemeral_storage)
+        else:
+            out.append(r.scalar.get(name, 0))
+    return out
+
+
+def _quantize_raw_rows(raw: np.ndarray, resource_dims: Sequence[str]) -> np.ndarray:
+    """Vectorized request-side quantization of raw [K, R] rows — the columnar
+    equivalent of _quantize(..., is_request=True) per node."""
+    out = raw.astype(np.int64, copy=True)
+    for di, name in enumerate(resource_dims):
+        if name in (MEMORY, EPHEMERAL_STORAGE):
+            out[:, di] = -(-out[:, di] // MI)
+    return out.astype(np.int32)
+
+
 @dataclass
 class ClusterTensors:
     """Node-axis tensors + class tables + topology-spread tensors (all numpy;
@@ -113,6 +141,13 @@ class PodBatchTensors:
     # batch driver routes these to the serial fallback
     fallback_class: np.ndarray  # [C] bool
 
+    # columnar accounting inputs (see _raw_vec): unquantized per-pod request
+    # vectors and the per-class host-port flag that gates the tensor-cache
+    # assume fast path (host-port pods need a port-row recompute)
+    raw_req: Optional[np.ndarray] = None  # [P, R] int64
+    raw_req_nz: Optional[np.ndarray] = None  # [P, R] int64
+    class_has_host_ports: Optional[np.ndarray] = None  # [C] bool
+
     @property
     def p(self) -> int:
         return len(self.pods)
@@ -168,6 +203,14 @@ class TensorCache:
         self._dirty_all = True
         # previous PodBatchTensors (pod-axis reuse for same-backlog re-solves)
         self._last_batch = None
+        # columnar assume state: raw (unquantized) per-node request totals,
+        # the cache generation the current tensors are consistent with, and
+        # the rows + generation a pending apply_assume_deltas covers
+        self._raw_used: Optional[np.ndarray] = None  # [N, R] int64
+        self._raw_used_nz: Optional[np.ndarray] = None
+        self._tensorized_gen: Optional[int] = None
+        self._assume_gen: Optional[int] = None
+        self._assume_rows: Optional[set] = None
 
     # -- cluster tensors -------------------------------------------------------
 
@@ -178,6 +221,27 @@ class TensorCache:
         prev_nis = self.node_infos
         if (self.cluster is None or prev_nis is None or len(prev_nis) != len(nis)):
             return self._full(snapshot)
+        if (self._assume_gen is not None
+                and snapshot.generation == self._assume_gen):
+            # columnar fast path: every cache mutation since the last
+            # tensorize was our own assume batch, whose deltas are already
+            # applied to used/used_nz/pod_count (apply_assume_deltas) — no
+            # per-node requantize, no label/taint/port re-checks (assumes
+            # touch existing nodes' accounting only). The rows still go back
+            # as `changed` so selector-class counts recount them when a
+            # constrained batch follows.
+            changed = sorted(self._assume_rows)
+            self._assume_gen = None
+            self._assume_rows = None
+            cluster = self.cluster
+            for i in changed:
+                cluster.cols.node_infos[i] = nis[i]
+            self.snap = snapshot
+            self.node_infos = list(nis)
+            self._tensorized_gen = snapshot.generation
+            return cluster, changed
+        self._assume_gen = None
+        self._assume_rows = None
         changed = [i for i in range(len(nis)) if nis[i] is not prev_nis[i]]
         cluster = self.cluster
         for i in changed:
@@ -194,6 +258,7 @@ class TensorCache:
         if not changed:
             self.snap = snapshot
             self.node_infos = list(nis)
+            self._tensorized_gen = snapshot.generation
             return cluster, []
         self._dirty_rows.update(changed)
         dims = cluster.resource_dims
@@ -209,6 +274,9 @@ class TensorCache:
                 _quantize(ni.non_zero_requested, dims, is_request=True), dtype=np.int32)
             cluster.pod_count[i] = len(ni.pods)
             cluster.max_pods[i] = ni.allocatable.allowed_pod_number
+            if self._raw_used is not None:
+                self._raw_used[i] = _raw_vec(ni.requested, dims)
+                self._raw_used_nz[i] = _raw_vec(ni.non_zero_requested, dims)
         # port usage rows (NodeColumns caches them for class table compile)
         cols = cluster.cols
         for i in changed:
@@ -226,6 +294,7 @@ class TensorCache:
             cols.port_matrix[i] = row
         self.snap = snapshot
         self.node_infos = list(nis)
+        self._tensorized_gen = snapshot.generation
         return cluster, changed
 
     def _full(self, snapshot: Snapshot) -> Tuple[ClusterTensors, None]:
@@ -239,7 +308,49 @@ class TensorCache:
         self._device_selcls_host = None
         self._dirty_rows.clear()
         self._dirty_all = True
+        dims = self.cluster.resource_dims
+        self._raw_used = np.array(
+            [_raw_vec(ni.requested, dims) for ni in self.node_infos],
+            dtype=np.int64).reshape(len(self.node_infos), len(dims))
+        self._raw_used_nz = np.array(
+            [_raw_vec(ni.non_zero_requested, dims) for ni in self.node_infos],
+            dtype=np.int64).reshape(len(self.node_infos), len(dims))
+        self._tensorized_gen = snapshot.generation
+        self._assume_gen = None
+        self._assume_rows = None
         return self.cluster, None
+
+    def apply_assume_deltas(self, rows: np.ndarray, d_raw_used: np.ndarray,
+                            d_raw_used_nz: np.ndarray, d_count: np.ndarray,
+                            tensorized_gen: int, assume_gen: int) -> bool:
+        """Columnar assume accounting: fold a solved batch's per-node raw
+        request deltas (numpy scatter-adds keyed by the tensorizer's node
+        index, computed by the batch scheduler) straight into the cluster
+        tensors, then requantize only the touched rows — vectorized. Records
+        assume_gen (the cache generation after the matching
+        Cache.apply_node_resource_deltas) so the next cluster_tensors can
+        prove the snapshot diff is fully explained by this batch and skip the
+        per-node walk entirely. Returns False (no-op) when the current
+        tensors aren't at tensorized_gen — a foreign mutation slipped in and
+        the normal incremental path must requantize instead."""
+        if (self.cluster is None or self._raw_used is None
+                or self._tensorized_gen != tensorized_gen):
+            return False
+        rows = np.asarray(rows)
+        dims = self.cluster.resource_dims
+        self._raw_used[rows] += d_raw_used
+        self._raw_used_nz[rows] += d_raw_used_nz
+        self.cluster.used[rows] = _quantize_raw_rows(self._raw_used[rows], dims)
+        self.cluster.used_nz[rows] = _quantize_raw_rows(self._raw_used_nz[rows], dims)
+        self.cluster.pod_count[rows] = (
+            self.cluster.pod_count[rows]
+            + d_count.astype(self.cluster.pod_count.dtype))
+        self._dirty_rows.update(int(i) for i in rows)
+        if self._assume_rows is None:
+            self._assume_rows = set()
+        self._assume_rows.update(int(i) for i in rows)
+        self._assume_gen = assume_gen
+        return True
 
     # -- persistent HBM mirrors (the diff -> device stream of cache.go:186) ----
 
@@ -345,39 +456,13 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
     if (prev is not None and len(prev.pods) == len(pods)
             and all(a is b for a, b in zip(prev.pods, pods))):
         pod_axis = prev
-    if pod_axis is not None:
-        rep_pods = list(pod_axis.tables.rep_pods)
-        class_of_pod = pod_axis.class_of_pod
-    else:
-        sig_to_class: Dict[tuple, int] = {}
-        rep_pods = []
-        class_of_pod = np.zeros(len(pods), dtype=np.int32)
-        for pi, pod in enumerate(pods):
-            sig = pod_class_signature(pod)
-            ci = sig_to_class.get(sig)
-            if ci is None:
-                ci = len(rep_pods)
-                sig_to_class[sig] = ci
-                rep_pods.append(pod)
-            class_of_pod[pi] = ci
-
-    tables = compile_class_tables(rep_pods, cluster.cols)
-
     r = len(cluster.resource_dims)
-    if (pod_axis is not None
-            and getattr(pod_axis, "_resource_dims", None) == tuple(cluster.resource_dims)):
-        req = pod_axis.req  # already int32; passed through copy-free below
-        req_nz = pod_axis.req_nz
-        balanced_active = pod_axis.balanced_active
-        skip_req_loop = True
-    else:
-        skip_req_loop = False
-        req = np.zeros((len(pods), r), dtype=np.int64)
-        req_nz = np.zeros((len(pods), r), dtype=np.int64)
-        balanced_active = np.zeros(len(pods), dtype=bool)
     # memoize by container-resources signature: template-stamped pods (the
     # overwhelmingly common case) compute their request vectors exactly once
-    req_cache: Dict[tuple, Tuple[List[int], List[int], bool]] = {}
+    # (entry index, (Resource, non-zero Resource) for PodInfo seeding)
+    req_cache: Dict[tuple, tuple] = {}
+    req_entries: List[tuple] = []  # (quant, quant_nz, active, raw, raw_nz)
+
     def _res_sig(res: dict) -> tuple:
         # {"requests": {...}, "limits": {...}, "claims": [...]} -> hashable
         # value key (cheaper than repr at 100k-pod scale); non-dict values
@@ -388,7 +473,7 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
             (k, tuple(sorted(v.items())) if isinstance(v, dict) else repr(v))
             for k, v in sorted(res.items()))
 
-    for pi, pod in (() if skip_req_loop else list(enumerate(pods))):
+    def _req_entry(pod) -> tuple:
         sig = (
             tuple(_res_sig(c.resources) for c in pod.spec.containers),
             tuple(_res_sig(c.resources) for c in pod.spec.init_containers),
@@ -398,15 +483,77 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
         if got is None:
             pr = compute_pod_resource_request(pod)
             prnz = compute_pod_resource_request(pod, non_zero=True)
-            got = (
+            req_entries.append((
                 _quantize(pr, cluster.resource_dims, is_request=True),
                 _quantize(prnz, cluster.resource_dims, is_request=True),
                 # BalancedAllocation PreScore skip rule: best-effort over the
                 # configured resources (balanced_allocation.go PreScore)
                 pr.milli_cpu != 0 or pr.memory != 0,
-            )
+                _raw_vec(pr, cluster.resource_dims),
+                _raw_vec(prnz, cluster.resource_dims),
+            ))
+            got = (len(req_entries) - 1, (pr, prnz))
             req_cache[sig] = got
-        req[pi], req_nz[pi], balanced_active[pi] = got
+        # Seed PodInfo's memoized request pair so a later cache assume of
+        # this pod (or its structural clones — they share __dict__) costs
+        # dict lookups instead of recomputing both Resource sums. The shared
+        # Resource objects are read-only by PodInfo's existing contract.
+        if "_req_cache" not in pod.__dict__:
+            pod.__dict__["_req_cache"] = got[1]
+        return got
+
+    entry_rows: List[int] = []
+    if pod_axis is not None:
+        rep_pods = list(pod_axis.tables.rep_pods)
+        class_of_pod = pod_axis.class_of_pod
+        if getattr(pod_axis, "_resource_dims", None) == tuple(cluster.resource_dims):
+            req = pod_axis.req  # already int32; passed through copy-free below
+            req_nz = pod_axis.req_nz
+            balanced_active = pod_axis.balanced_active
+            raw_req = pod_axis.raw_req
+            raw_req_nz = pod_axis.raw_req_nz
+        else:
+            for pod in pods:
+                entry_rows.append(_req_entry(pod)[0])
+    else:
+        # ONE fused pass per pod: class signature + request-memo row (two
+        # separate 100k-pod loops were measurable); per-pod array writes are
+        # replaced by a vectorized gather over the unique memo entries below
+        sig_to_class: Dict[tuple, int] = {}
+        rep_pods = []
+        class_rows: List[int] = []
+        for pod in pods:
+            sig = pod_class_signature(pod)
+            ci = sig_to_class.get(sig)
+            if ci is None:
+                ci = len(rep_pods)
+                sig_to_class[sig] = ci
+                rep_pods.append(pod)
+            class_rows.append(ci)
+            entry_rows.append(_req_entry(pod)[0])
+        class_of_pod = np.asarray(class_rows, dtype=np.int32)
+
+    if entry_rows:
+        eidx = np.asarray(entry_rows)
+        ne = len(req_entries)
+        req = np.array([e[0] for e in req_entries],
+                       dtype=np.int64).reshape(ne, r)[eidx]
+        req_nz = np.array([e[1] for e in req_entries],
+                          dtype=np.int64).reshape(ne, r)[eidx]
+        balanced_active = np.array([e[2] for e in req_entries],
+                                   dtype=bool)[eidx]
+        raw_req = np.array([e[3] for e in req_entries],
+                           dtype=np.int64).reshape(ne, r)[eidx]
+        raw_req_nz = np.array([e[4] for e in req_entries],
+                              dtype=np.int64).reshape(ne, r)[eidx]
+    elif pod_axis is None:
+        req = np.zeros((0, r), dtype=np.int64)
+        req_nz = np.zeros((0, r), dtype=np.int64)
+        raw_req = np.zeros((0, r), dtype=np.int64)
+        raw_req_nz = np.zeros((0, r), dtype=np.int64)
+        balanced_active = np.zeros(0, dtype=bool)
+
+    tables = compile_class_tables(rep_pods, cluster.cols)
 
     # -- topology keys + selector classes (shared by PTS + IPA) ----------------
     topo_key_idx: Dict[str, int] = {k: i for i, k in enumerate(cluster.topo_keys)}
@@ -501,7 +648,11 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
     # relabel must invalidate cached counts
     ns_fp = tuple(sorted(
         (ns, tuple(sorted(lbls.items()))) for ns, lbls in ns_labels.items()))
-    if (reuse is not None and changed_nodes is not None
+    if sc == 0:
+        # no selector classes registered (constraint-free batch): skip the
+        # per-node pod walks outright — the count tensor is empty either way
+        selcls_count = np.zeros((0, cluster.n), dtype=np.int32)
+    elif (reuse is not None and changed_nodes is not None
             and reuse.selcls_keys == selcls_key_tuple
             and reuse.ns_fingerprint == ns_fp
             and reuse.selcls_count is not None
@@ -540,6 +691,11 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
     ct_class, ct_key, ct_sel, ct_max_skew, ct_min_domains, ct_self = rows_to_arrays(ct_rows, True)
     st_class, st_key, st_sel, st_max_skew, st_self = rows_to_arrays(st_rows, False)
 
+    from ..scheduler.framework import _host_ports
+
+    class_has_host_ports = np.array(
+        [any(True for _ in _host_ports(p)) for p in rep_pods], dtype=bool)
+
     out = PodBatchTensors(
         pods=list(pods),
         class_of_pod=class_of_pod,
@@ -554,6 +710,9 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
         class_matches_selcls=class_matches,
         ipa=ipa,
         fallback_class=fallback_class,
+        raw_req=np.asarray(raw_req, dtype=np.int64),
+        raw_req_nz=np.asarray(raw_req_nz, dtype=np.int64),
+        class_has_host_ports=class_has_host_ports,
     )
     if reuse is not None:
         # the cached req vectors are only valid against the same resource-dim

@@ -47,6 +47,24 @@ class Event:
     prev: Any = None
 
 
+@dataclass(frozen=True)
+class CoalescedEvent:
+    """One multi-object event for a whole batched write (bind_many /
+    create_many chunk) — the internal fast-path channel. Only watchers that
+    subscribed with coalesce=True receive these; every other watcher sees the
+    per-object `events` individually, so the external watch API is unchanged.
+
+    origin is the writer's opaque tag (a scheduler passes its own so it can
+    short-circuit re-ingesting its own bind confirmations); None for writers
+    that don't tag. resource_version is the LAST rv in the batch."""
+
+    type: str
+    kind: str
+    events: Tuple[Event, ...]
+    resource_version: int
+    origin: Optional[str] = None
+
+
 class ConflictError(Exception):
     pass
 
@@ -145,6 +163,21 @@ def _shallow(obj):
     return new
 
 
+def pod_bind_clone(pod):
+    """Minimal clone for the bind hot path: fresh Pod/ObjectMeta/PodSpec
+    shells only. A bind mutates exactly spec.node_name and
+    metadata.resource_version, so status and every metadata container
+    (labels, annotations, owner_references, finalizers) stay SHARED with the
+    source — the same read-only contract pod_structural_clone already applies
+    to containers/tolerations/affinity, extended to the remaining members.
+    Any later write that does touch those goes through pod_structural_clone
+    (update_pod_status, caller-facing returns), which re-privatizes them."""
+    new = _shallow(pod)
+    new.metadata = _shallow(pod.metadata)
+    new.spec = _shallow(pod.spec)
+    return new
+
+
 class Watch:
     """A single watch subscription. Iterate or .get(timeout). Call .stop() to end.
 
@@ -157,7 +190,7 @@ class Watch:
     DEFAULT_MAXSIZE = 10_000
 
     def __init__(self, store: "APIStore", kind=None,
-                 maxsize: int = DEFAULT_MAXSIZE):
+                 maxsize: int = DEFAULT_MAXSIZE, coalesce: bool = False):
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize=maxsize or 0)
         self._store = store
         # kind: None = all kinds; a str = one kind; a set/tuple = several
@@ -165,6 +198,11 @@ class Watch:
         # kinds they ignore — e.g. events — never fill their buffers)
         self._kinds = (None if kind is None
                        else {kind} if isinstance(kind, str) else set(kind))
+        # coalesce=True opts into the internal fast-path channel: a batched
+        # write (bind_many/create_many chunk) arrives as ONE CoalescedEvent
+        # (counting as one buffered item) instead of N per-object events.
+        # Consumers must handle both — history replay is always per-object.
+        self.coalesce = coalesce
         self._stopped = False
         self.terminated = False  # True when evicted for falling behind
         # optional ping invoked after each delivery — the select-based
@@ -182,19 +220,36 @@ class Watch:
                 if cb is not None:
                     cb()
             except queue.Full:
-                # slow watcher: evict rather than buffer forever; drop one
-                # event to make room for the end-of-stream sentinel (the
-                # stream is void anyway — the consumer must relist)
-                self.terminated = True
-                self._store._unsubscribe(self)
-                try:
-                    self._q.get_nowait()
-                except queue.Empty:
-                    pass
-                try:
-                    self._q.put_nowait(None)
-                except queue.Full:
-                    pass
+                self._overflow()
+
+    def _deliver_coalesced(self, cev: "CoalescedEvent") -> None:
+        """Deliver a whole batched write as one buffered item (fast-path
+        channel; only called for coalesce=True watchers)."""
+        if self.terminated or self._stopped:
+            return
+        if self._kinds is None or cev.kind in self._kinds:
+            try:
+                self._q.put_nowait(cev)
+                cb = self.on_event
+                if cb is not None:
+                    cb()
+            except queue.Full:
+                self._overflow()
+
+    def _overflow(self) -> None:
+        # slow watcher: evict rather than buffer forever; drop one
+        # event to make room for the end-of-stream sentinel (the
+        # stream is void anyway — the consumer must relist)
+        self.terminated = True
+        self._store._unsubscribe(self)
+        try:
+            self._q.get_nowait()
+        except queue.Empty:
+            pass
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            pass
 
     def get(self, timeout: Optional[float] = None) -> Optional[Event]:
         try:
@@ -279,10 +334,20 @@ class APIStore:
         """Copy for WATCH EVENTS — the fan-out hot path under churn. Event
         objects carry the client-go read-only contract (that is what the
         mutation detector polices), so pods take the ~20x cheaper structural
-        clone; other kinds keep deepcopy. get/list/storage copies stay on
-        _copy: their callers never signed the event contract."""
-        if self._deep_copy and type(obj) is Pod:
-            return pod_structural_clone(obj)
+        clone; core Events (recorder narration — one store write per victim
+        under preemption storms) take a flat-field clone; other kinds keep
+        deepcopy. get/list/storage copies stay on _copy: their callers never
+        signed the event contract."""
+        if self._deep_copy:
+            if type(obj) is Pod:
+                return pod_structural_clone(obj)
+            if type(obj).__name__ == "Event" and hasattr(obj, "involved_kind"):
+                # core/v1 Event: scalar fields + metadata — a fresh shell
+                # with a private metadata is full isolation minus the shared
+                # metadata containers, same contract as pod events
+                new = _shallow(obj)
+                new.metadata = _shallow(obj.metadata)
+                return new
         return self._copy(obj)
 
     def _emit(self, etype: str, kind: str, obj, prev=None) -> None:
@@ -316,6 +381,34 @@ class APIStore:
         for w in list(self._watchers):
             w._deliver(ev)
 
+    def _emit_batch(self, etype: str, kind: str, events: List[Event],
+                    origin: Optional[str]) -> None:
+        """Emit one batched write: per-object events go to history and every
+        per-object watcher (external semantics unchanged — ordering and rv
+        monotonicity are the list order), while coalesce=True watchers get a
+        single CoalescedEvent for the whole batch (the internal fast path;
+        one buffered item, one wake-up)."""
+        if not events:
+            return
+        if self._mutation_detector is not None:
+            for ev in events:
+                self._mutation_detector.record(ev)
+        self._history.extend(events)
+        if len(self._history) > self._history_limit:
+            drop = len(self._history) - self._history_limit + self._history_limit // 4
+            self._history_floor_rv = self._history[drop - 1].resource_version
+            del self._history[:drop]
+        cev = None
+        for w in list(self._watchers):
+            if w.coalesce:
+                if cev is None:
+                    cev = CoalescedEvent(etype, kind, tuple(events),
+                                         events[-1].resource_version, origin)
+                w._deliver_coalesced(cev)
+            else:
+                for ev in events:
+                    w._deliver(ev)
+
     # -- CRUD ------------------------------------------------------------------
 
     def create(self, kind: str, obj) -> Any:
@@ -330,6 +423,38 @@ class APIStore:
             objs[key] = obj
             self._emit(ADDED, kind, obj)
             return obj
+
+    def create_many(self, kind: str, objects: Iterable[Any],
+                    origin: Optional[str] = None,
+                    consume: bool = False) -> Tuple[int, List[Tuple[str, str]]]:
+        """Bulk create under ONE lock acquisition with ONE coalesced ADDED
+        event for the batch (per-object events still reach history and
+        per-object watchers — see _emit_batch). Per-object failures
+        (AlreadyExists) don't abort the batch; returns (created_count,
+        [(key, error message), ...]) like bind_many.
+
+        consume=True transfers OWNERSHIP of the passed objects to the store
+        (no isolation copy — the bulk-loader contract: the caller must never
+        touch them again). Default False keeps create()'s copy semantics."""
+        errors: List[Tuple[str, str]] = []
+        created = 0
+        events: List[Event] = []
+        with self._lock:
+            objs = self._objects.setdefault(kind, {})
+            for obj in objects:
+                key = self.object_key(obj)
+                if key in objs:
+                    errors.append((key, f"{kind} {key} already exists"))
+                    continue
+                if not consume:
+                    obj = self._copy(obj)
+                self._rv += 1
+                obj.metadata.resource_version = self._rv
+                objs[key] = obj
+                events.append(Event(ADDED, kind, self._event_copy(obj), self._rv))
+                created += 1
+            self._emit_batch(ADDED, kind, events, origin)
+        return created, errors
 
     def get(self, kind: str, key: str) -> Any:
         """Returns a copy (when deep_copy_on_write) — like a REST GET, each read is a
@@ -376,10 +501,20 @@ class APIStore:
             if key not in objs:
                 raise NotFoundError(f"{kind} {key} not found")
             old = objs.pop(key)
-            obj = self._copy(old)
-            self._rv += 1
             # The DELETED event carries the object at its post-delete RV (client-go
             # convention: watchers track progress from obj.metadata.resourceVersion).
+            # Pods take structural clones (hot under preemption victim storms:
+            # the async preparation worker deletes victims at batch rate);
+            # other kinds keep the deepcopy + event-copy pair.
+            if self._deep_copy and type(old) is Pod:
+                obj = pod_structural_clone(old)
+                self._rv += 1
+                obj.metadata.resource_version = self._rv
+                self._emit_prepared(DELETED, kind,
+                                    pod_structural_clone(obj), prev=old)
+                return obj
+            obj = self._copy(old)
+            self._rv += 1
             obj.metadata.resource_version = self._rv
             self._emit(DELETED, kind, obj, prev=old)
             return obj
@@ -419,13 +554,16 @@ class APIStore:
     # -- watch -----------------------------------------------------------------
 
     def watch(self, kind=None, since_rv: int = -1,
-              maxsize: int = Watch.DEFAULT_MAXSIZE) -> Watch:
+              maxsize: int = Watch.DEFAULT_MAXSIZE,
+              coalesce: bool = False) -> Watch:
         """Subscribe to events. since_rv >= 0 replays history events with rv > since_rv
         first (the Reflector resume contract); since_rv == -1 means 'from now'.
         Raises ResourceVersionTooOldError if since_rv predates retained history
         or the replay alone would overflow the watch buffer — the caller must
         relist (410 Gone analog). maxsize bounds the per-watcher buffer; a
-        consumer that falls that far behind is evicted (Watch.terminated)."""
+        consumer that falls that far behind is evicted (Watch.terminated).
+        coalesce=True opts into CoalescedEvent delivery for batched writes
+        (replay is still per-object)."""
         with self._lock:
             if 0 <= since_rv < self._history_floor_rv:
                 raise ResourceVersionTooOldError(
@@ -439,7 +577,7 @@ class APIStore:
                     raise ResourceVersionTooOldError(
                         f"replay of {len(replay)} events from rv {since_rv} exceeds "
                         f"the watch buffer ({maxsize}); relist required")
-            w = Watch(self, kind, maxsize=maxsize)
+            w = Watch(self, kind, maxsize=maxsize, coalesce=coalesce)
             for ev in replay:
                 w._deliver(ev)
             self._watchers.append(w)
@@ -474,43 +612,53 @@ class APIStore:
             pod = self._pod_internal(key)
             if pod.spec.node_name:
                 raise AlreadyBoundError(f"pod {key} is already bound to {pod.spec.node_name}")
-            new = pod_structural_clone(pod)
+            new = pod_bind_clone(pod)
             new.spec.node_name = node_name
             self._rv += 1
             new.metadata.resource_version = self._rv
             self._objects["pods"][key] = new
-            self._emit_prepared(MODIFIED, "pods", pod_structural_clone(new),
+            self._emit_prepared(MODIFIED, "pods", pod_bind_clone(new),
                                 prev=pod)
             # the caller's copy is distinct from both the stored object and
-            # the event object (mutating it must corrupt neither)
+            # the event object (mutating it must corrupt neither); the full
+            # structural clone re-privatizes the metadata containers too
             return pod_structural_clone(new)
 
-    def bind_many(self, bindings: Iterable[Tuple[str, str, str]]) -> Tuple[int, List[Tuple[str, str]]]:
+    def bind_many(self, bindings: Iterable[Tuple[str, str, str]],
+                  origin: Optional[str] = None) -> Tuple[int, List[Tuple[str, str]]]:
         """Batched bind: one lock acquisition for a whole solver batch.
         bindings = (namespace, name, node_name) triples. Returns
         (bound_count, [(key, error message) ...]) — per-pod failures do not
         abort the batch (each binding is its own transaction, like N
-        BindingREST calls back-to-back)."""
+        BindingREST calls back-to-back).
+
+        origin tags the batch's CoalescedEvent so the writer can recognize
+        (and bulk-confirm) its own bind MODIFIED events on re-ingest; foreign
+        consumers and per-object watchers are unaffected."""
         errors: List[Tuple[str, str]] = []
         bound = 0
+        events: List[Event] = []
         with self._lock:
+            pods = self._objects.setdefault("pods", {})
             for namespace, name, node_name in bindings:
                 key = f"{namespace}/{name}"
-                try:
-                    pod = self._pod_internal(key)
-                    if pod.spec.node_name:
-                        raise AlreadyBoundError(
-                            f"pod {key} is already bound to {pod.spec.node_name}")
-                    new = pod_structural_clone(pod)
-                    new.spec.node_name = node_name
-                    self._rv += 1
-                    new.metadata.resource_version = self._rv
-                    self._objects["pods"][key] = new
-                    self._emit_prepared(MODIFIED, "pods",
-                                        pod_structural_clone(new), prev=pod)
-                    bound += 1
-                except (NotFoundError, AlreadyBoundError) as e:
-                    errors.append((key, str(e)))
+                pod = pods.get(key)
+                if pod is None:
+                    errors.append((key, f"pods {key} not found"))
+                    continue
+                if pod.spec.node_name:
+                    errors.append(
+                        (key, f"pod {key} is already bound to {pod.spec.node_name}"))
+                    continue
+                new = pod_bind_clone(pod)
+                new.spec.node_name = node_name
+                self._rv += 1
+                new.metadata.resource_version = self._rv
+                pods[key] = new
+                events.append(Event(MODIFIED, "pods", pod_bind_clone(new),
+                                    self._rv, pod))
+                bound += 1
+            self._emit_batch(MODIFIED, "pods", events, origin)
         return bound, errors
 
     def update_pod_status(self, namespace: str, name: str, mutate_status: Callable[[Any], None]) -> Any:

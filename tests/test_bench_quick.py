@@ -1,0 +1,35 @@
+"""bench.py --quick: the tier-1 perf smoke — runs in <=60s on the CPU
+backend and emits one parseable JSON line on stdout, so a regression in the
+batched host pipeline (coalesced ingest, bulk admission, bind path) is
+caught without the full ladder."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_quick_runs_and_emits_json():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--quick"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    # stdout is exactly one JSON object (the last non-empty line)
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert lines, proc.stderr[-2000:]
+    out = json.loads(lines[-1])
+    assert out.get("quick") is True
+    assert out["unit"] == "pods/s"
+    workloads = out["workloads"]
+    # the quick ladder covers the host pipeline end-to-end
+    assert "NorthStar_100k_10k_endtoend" in workloads
+    ns = workloads["NorthStar_100k_10k_endtoend"]
+    assert "error" not in ns, ns
+    assert ns["placed"] == ns["pods"] > 0
+    assert ns["pods_per_sec"] > 0
+    basic = workloads.get("SchedulingBasic", {})
+    assert "error" not in basic, basic

@@ -1,0 +1,375 @@
+"""Columnar host pipeline: batched watch ingest, bulk queue admission,
+self-bind short-circuit, and the coalesced/per-pod parity contract.
+
+Covers the ISSUE 1 acceptance surface:
+  - external watchers still see per-object events (ordering + rv
+    monotonicity) when writers go through bind_many/create_many chunking;
+  - the scheduler's own bind MODIFIED events bulk-confirm assumes
+    (self-bind short-circuit) while FOREIGN binds take the full ingest
+    path and correct the cache;
+  - the coalesced pipeline and the per-pod pipeline produce the same
+    pod -> node map for the exact solver;
+  - async bind failures are surfaced to schedule_batch callers.
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.scheduler import Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.scheduler.queue import SchedulingQueue
+from kubernetes_tpu.store import ADDED, MODIFIED, APIStore, CoalescedEvent
+from kubernetes_tpu.testing import MakeNode, MakePod
+from kubernetes_tpu.utils import FakeClock
+
+
+def _nodes(n, cpu="8", mem="32Gi"):
+    return [MakeNode(f"node-{i}")
+            .labels({"kubernetes.io/hostname": f"node-{i}"})
+            .capacity({"cpu": cpu, "memory": mem, "pods": "110"}).obj()
+            for i in range(n)]
+
+
+def _pods(n, prefix="p", cpu="500m", mem="1Gi"):
+    return [MakePod(f"{prefix}-{i}").req({"cpu": cpu, "memory": mem}).obj()
+            for i in range(n)]
+
+
+# -- external watch semantics --------------------------------------------------
+
+
+def test_external_watcher_sees_per_object_events_from_batched_writes():
+    store = APIStore()
+    w = store.watch(kind=("pods",))  # plain per-object subscriber
+    pods = _pods(25)
+    created, errs = store.create_many("pods", pods[:13])
+    assert created == 13 and not errs
+    created, errs = store.create_many("pods", pods[13:])
+    assert created == 12 and not errs
+    bound, errs = store.bind_many(
+        [("default", f"p-{i}", f"node-{i % 4}") for i in range(25)],
+        origin="some-scheduler")
+    assert bound == 25 and not errs
+
+    evs = w.drain()
+    assert len(evs) == 50  # 25 ADDED + 25 MODIFIED, one per object
+    assert all(type(e) is not CoalescedEvent for e in evs)
+    assert [e.type for e in evs[:25]] == [ADDED] * 25
+    assert [e.type for e in evs[25:]] == [MODIFIED] * 25
+    # per-object creation order is preserved, rv strictly monotonic
+    assert [e.obj.metadata.name for e in evs[:25]] == [p.metadata.name for p in pods]
+    rvs = [e.resource_version for e in evs]
+    assert rvs == sorted(rvs) and len(set(rvs)) == len(rvs)
+    for e in evs[25:]:
+        assert e.obj.spec.node_name
+        assert e.prev is not None and not e.prev.spec.node_name
+
+
+def test_coalesced_watcher_gets_one_event_per_chunk_with_origin():
+    store = APIStore()
+    w = store.watch(kind=("pods",), coalesce=True)
+    store.create_many("pods", _pods(10))
+    store.bind_many([("default", f"p-{i}", "node-0") for i in range(10)],
+                    origin="me")
+    items = w.drain()
+    assert len(items) == 2
+    add, mod = items
+    assert type(add) is CoalescedEvent and add.type == ADDED
+    assert len(add.events) == 10 and add.origin is None
+    assert type(mod) is CoalescedEvent and mod.type == MODIFIED
+    assert mod.origin == "me"
+    assert mod.resource_version == mod.events[-1].resource_version
+
+
+def test_watch_replay_after_batched_writes_is_per_object():
+    store = APIStore()
+    rv0 = store.rv
+    store.create_many("pods", _pods(6))
+    w = store.watch(kind=("pods",), since_rv=rv0, coalesce=True)
+    evs = w.drain()
+    assert len(evs) == 6  # replay is history-backed: always per-object
+    assert all(type(e) is not CoalescedEvent for e in evs)
+
+
+def test_create_many_per_object_errors_do_not_abort_batch():
+    store = APIStore()
+    store.create("pods", MakePod("p-1").obj())
+    created, errs = store.create_many("pods", _pods(3))
+    assert created == 2
+    assert len(errs) == 1 and errs[0][0] == "default/p-1"
+
+
+def test_mutation_detector_covers_coalesced_events():
+    store = APIStore(mutation_detector=True)
+    w = store.watch(kind=("pods",), coalesce=True)
+    store.create_many("pods", _pods(3))
+    (cev,) = w.drain()
+    store.check_mutations()
+    cev.events[1].obj.metadata.labels["oops"] = "mutated"
+    from kubernetes_tpu.store import MutationDetectedError
+
+    with pytest.raises(MutationDetectedError):
+        store.check_mutations()
+
+
+# -- scheduler ingest: self-bind short-circuit + foreign binds -----------------
+
+
+def _synced_sched(n_nodes=8, **kw):
+    store = APIStore()
+    for n in _nodes(n_nodes):
+        store.create("nodes", n)
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=1024, solver="exact",
+                           pipeline_binds=False, **kw)
+    sched.sync()
+    return store, sched
+
+
+def test_self_bind_short_circuit_confirms_assumes():
+    store, sched = _synced_sched()
+    store.create_many("pods", _pods(40))
+    sched.run_until_idle()
+    sched.pump_events()
+    assert sched.scheduled_count == 40
+    # every assume was confirmed by our own coalesced bind events
+    assert not sched.cache._assumed
+    assert sched.cache.pod_count() == 40
+    bound = [p for p in store.list("pods")[0] if p.spec.node_name]
+    assert len(bound) == 40
+
+
+def test_foreign_bind_modified_takes_full_ingest_path():
+    store, sched = _synced_sched()
+    # a pod this scheduler never assumed is bound by someone else's
+    # bind_many (different origin tag)
+    foreign = MakePod("foreign-1").req({"cpu": "1"}).obj()
+    foreign.spec.scheduler_name = "other-scheduler"  # not ours to schedule
+    store.create("pods", foreign)
+    bound, errs = store.bind_many([("default", "foreign-1", "node-3")],
+                                  origin="other-scheduler-origin")
+    assert bound == 1 and not errs
+    sched.pump_events()
+    # full ingest path accounted it in the cache
+    assert sched.cache.pod_count() == 1
+    assert not sched.cache.is_assumed("default/foreign-1")
+    snap = sched.cache.update_snapshot()
+    ni = snap.get("node-3")
+    assert len(ni.pods) == 1
+    assert ni.requested.milli_cpu == 1000
+
+
+def test_mixed_confirm_leftovers_fall_back_to_full_path():
+    from kubernetes_tpu.scheduler.cache import Cache
+
+    cache = Cache(clock=FakeClock())
+    for n in _nodes(2):
+        cache.add_node(n)
+    a = MakePod("a").req({"cpu": "1"}).obj()
+    cache.assume_pod(a, "node-0")
+    leftover = cache.confirm_assumed_bulk(
+        [("default/a", "node-0"),   # assumed here: confirmed
+         ("default/b", "node-0"),   # never assumed: leftover
+         ("default/a", "node-1")])  # wrong node now that a is confirmed
+    assert leftover == [1, 2]
+    assert not cache.is_assumed("default/a")
+
+
+# -- columnar accounting parity ------------------------------------------------
+
+
+def _run_pipeline(columnar: bool, batched_writes: bool):
+    store = APIStore()
+    for n in _nodes(24, cpu="8", mem="32Gi"):
+        store.create("nodes", n)
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=4096, solver="exact",
+                           columnar=columnar)
+    sched.sync()
+    pods = []
+    for i in range(180):
+        p = (MakePod(f"px-{i}").labels({"app": "spread"})
+             .req({"cpu": "200m", "memory": "300Mi"}))
+        if i % 3 == 0:
+            p = p.topology_spread(2, "kubernetes.io/hostname",
+                                  "DoNotSchedule", {"app": "spread"})
+        pods.append(p.obj())
+    if batched_writes:
+        created, errs = store.create_many("pods", pods)
+        assert created == len(pods) and not errs
+    else:
+        for p in pods:
+            store.create("pods", p)
+    sched.run_until_idle()
+    sched.pump_events()
+    return {p.key: p.spec.node_name for p in store.list("pods")[0]}, sched
+
+
+def test_columnar_and_per_pod_pipelines_place_identically():
+    """Acceptance: coalesced/columnar pipeline and the per-pod pipeline
+    produce the SAME pod -> node map for the exact solver."""
+    fast_map, fast_sched = _run_pipeline(columnar=True, batched_writes=True)
+    slow_map, slow_sched = _run_pipeline(columnar=False, batched_writes=False)
+    assert fast_sched.columnar and not slow_sched.columnar
+    assert all(v for v in fast_map.values())
+    assert fast_map == slow_map
+
+
+def test_columnar_assume_matches_per_pod_cache_state():
+    """After a batch, columnar accounting leaves the cache bit-identical to
+    the per-pod path: same requested totals, same pod sets, and the next
+    snapshot's tensors match."""
+    from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors
+
+    maps = []
+    tensors = []
+    for columnar in (True, False):
+        store = APIStore()
+        for n in _nodes(6, cpu="4", mem="16Gi"):
+            store.create("nodes", n)
+        sched = BatchScheduler(store, Framework(default_plugins()),
+                               batch_size=512, solver="exact",
+                               columnar=columnar)
+        sched.sync()
+        store.create_many("pods", _pods(50, prefix="cp", cpu="300m",
+                                        mem="700Mi"))
+        sched.run_until_idle()
+        sched.pump_events()
+        snap = sched.cache.update_snapshot()
+        cl = build_cluster_tensors(snap)
+        tensors.append((cl.used.copy(), cl.used_nz.copy(),
+                        cl.pod_count.copy()))
+        maps.append({ni.node.metadata.name:
+                     (ni.requested.milli_cpu, ni.requested.memory,
+                      sorted(pi.pod.key for pi in ni.pods))
+                     for ni in snap.node_info_list})
+    assert maps[0] == maps[1]
+    for a, b in zip(tensors[0], tensors[1]):
+        assert np.array_equal(a, b)
+
+
+def test_columnar_fast_path_and_incremental_requantize_agree():
+    """The TensorCache rows after a columnar-assume fast path equal a from-
+    scratch tensorize of the same cache state (solve(N+1) inputs parity)."""
+    from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors
+
+    store, sched = _synced_sched(n_nodes=10)
+    store.create_many("pods", _pods(60, prefix="fp", cpu="250m", mem="600Mi"))
+    sched.run_until_idle()
+    sched.pump_events()
+    snap = sched.cache.update_snapshot()
+    cluster, _changed = sched._tensor_cache.cluster_tensors(snap)
+    fresh = build_cluster_tensors(snap)
+    assert np.array_equal(cluster.used, fresh.used)
+    assert np.array_equal(cluster.used_nz, fresh.used_nz)
+    assert np.array_equal(cluster.pod_count, fresh.pod_count)
+
+
+# -- bulk queue admission ------------------------------------------------------
+
+
+def test_add_batch_pop_order_matches_per_pod_adds():
+    clock = FakeClock()
+    pods = []
+    for i in range(30):
+        p = MakePod(f"q-{i}").obj()
+        p.spec.priority = (i * 7) % 5
+        pods.append(p)
+    q1 = SchedulingQueue(clock=clock)
+    for p in pods:
+        q1.add(p)
+    q2 = SchedulingQueue(clock=clock)
+    q2.add_batch(pods)
+    order1 = [qp.pod.metadata.name for qp in q1.pop_batch(100, timeout=0.0)]
+    order2 = [qp.pod.metadata.name for qp in q2.pop_batch(100, timeout=0.0)]
+    assert order1 == order2
+    # priority-descending, arrival order within a priority
+    prios = {p.metadata.name: p.spec.priority for p in pods}
+    assert [prios[n] for n in order1] == sorted(
+        (prios[n] for n in order1), reverse=True)
+
+
+def test_add_batch_respects_pre_enqueue_gate():
+    gated = {"q-3", "q-4"}
+    q = SchedulingQueue(
+        clock=FakeClock(),
+        pre_enqueue=lambda pod: pod.metadata.name not in gated)
+    pods = [MakePod(f"q-{i}").obj() for i in range(6)]
+    q.add_batch(pods)
+    active, backoff, unsched = q.lengths()
+    assert (active, backoff, unsched) == (4, 0, 2)
+    # pre_gated callers already ran the gate themselves: everything lands
+    q2 = SchedulingQueue(
+        clock=FakeClock(),
+        pre_enqueue=lambda pod: pod.metadata.name not in gated)
+    q2.add_batch(pods, pre_gated=True)
+    assert q2.lengths() == (6, 0, 0)
+
+
+# -- bind-worker error propagation --------------------------------------------
+
+
+def test_async_bind_failures_surface_to_callers():
+    store = APIStore()
+    for n in _nodes(4):
+        store.create("nodes", n)
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=64, solver="exact")
+    sched.sync()
+    store.create_many("pods", _pods(5, prefix="bf"))
+    sched.pump_events()
+
+    real_bind_many = store.bind_many
+
+    def failing_bind_many(bindings, origin=None):
+        raise RuntimeError("etcd is on fire")
+
+    store.bind_many = failing_bind_many
+    try:
+        handled = sched.schedule_batch(timeout=0.0)
+        assert handled == 5
+        sched.flush_binds()
+    finally:
+        store.bind_many = real_bind_many
+    failures = sched.take_bind_failures()
+    assert len(failures) == 5
+    assert all("etcd is on fire" in msg for _key, msg in failures)
+    assert sched.take_bind_failures() == []  # drained
+    assert sched.scheduled_count == 0
+    # the pods were requeued through the normal failure path (unschedulable
+    # tier; a cluster event moves them back)
+    assert sched.queue.lengths()[2] == 5
+    # and nothing is left assumed in the cache
+    assert not sched.cache._assumed
+
+
+def test_partial_bind_errors_fail_only_their_pods():
+    store = APIStore()
+    for n in _nodes(4):
+        store.create("nodes", n)
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=64, solver="exact")
+    sched.sync()
+    store.create_many("pods", _pods(6, prefix="pb"))
+    # inject a per-pod failure for pb-2 only: the rest of the chunk commits
+    real_bind_many = store.bind_many
+
+    def patched(bindings, origin=None):
+        keep = [b for b in bindings if b[1] != "pb-2"]
+        bound, errs = real_bind_many(keep, origin=origin)
+        errs = list(errs) + [("default/pb-2", "injected bind failure")]
+        return bound, errs
+
+    store.bind_many = patched
+    try:
+        assert sched.schedule_batch(timeout=0.0) == 6
+        sched.flush_binds()
+    finally:
+        store.bind_many = real_bind_many
+    failures = sched.take_bind_failures()
+    assert [k for k, _ in failures] == ["default/pb-2"]
+    assert sched.scheduled_count == 5
+    # the failed pod was forgotten from the cache (its assume rolled back)
+    assert not sched.cache.is_assumed("default/pb-2")
+    assert sched.cache.pod_count() == 5
