@@ -534,6 +534,64 @@ def rung_north_star_endtoend(results):
         print(f"NorthStar_100k_10k_endtoend: ERROR {e}", file=sys.stderr)
 
 
+def rung_gang(results):
+    """GangScheduling_2k_250: 8 PodGroups x 250 members bound end-to-end —
+    store ingest, queue gang staging, the all-or-nothing veto, slice-packing
+    score, and batched binds all inside the timed window. Fixed-size (no
+    SMOKE shrink): the rung IS the quick-tier gang smoke and 2k pods solves
+    in a few seconds on the CPU rig."""
+    from kubernetes_tpu.scheduler import Framework
+    from kubernetes_tpu.scheduler.batch import BatchScheduler
+    from kubernetes_tpu.scheduler.plugins import default_plugins
+    from kubernetes_tpu.store import APIStore
+    from kubernetes_tpu.testing import MakeNode, MakePod, make_pod_group
+
+    try:
+        n_gangs, members, n_nodes, n_slices = 8, 250, 256, 4
+
+        def gang_nodes():
+            return [MakeNode(f"node-{i}").tpu_slice(i % n_slices)
+                    .capacity({"cpu": "16", "memory": "64Gi",
+                               "pods": "110"}).obj() for i in range(n_nodes)]
+
+        def gang_pods():
+            return [MakePod(f"gp-{g}-{i}").gang(f"train-{g}")
+                    .req({"cpu": "500m", "memory": "1Gi"}).obj()
+                    for g in range(n_gangs) for i in range(members)]
+
+        def run_once():
+            store = APIStore()
+            for n in gang_nodes():
+                store.create("nodes", n)
+            sched = BatchScheduler(store, Framework(default_plugins()),
+                                   batch_size=4096, solver="fast")
+            sched.sync()
+            for g in range(n_gangs):
+                store.create("podgroups", make_pod_group(f"train-{g}", members))
+            store.create_many("pods", gang_pods(), consume=True)
+            t0 = time.perf_counter()
+            sched.run_until_idle()
+            dt = time.perf_counter() - t0
+            return sched, store, dt
+
+        run_once()  # warm-up: compile at the real shapes
+        sched, store, dt = run_once()
+        n_pods = n_gangs * members
+        bound = sched.scheduled_count
+        pps = bound / dt if dt > 0 else 0.0
+        results["GangScheduling_2k_250"] = {
+            "pods_per_sec": round(pps, 1), "wall_s": round(dt, 3),
+            "placed": bound, "pods": n_pods, "gangs": n_gangs,
+            "gang_vetoes": sched.gang_vetoes,
+            "solver": "fast+gang+store-binds"}
+        print(f"{'GangScheduling_2k_250':>28}: {pps:>9.0f} pods/s  "
+              f"({bound}/{n_pods} bound in {n_gangs} gangs, "
+              f"{sched.gang_vetoes} vetoes, {dt:.3f}s)", file=sys.stderr)
+    except Exception as e:
+        results["GangScheduling_2k_250"] = {"error": str(e)[:200]}
+        print(f"GangScheduling_2k_250: ERROR {e}", file=sys.stderr)
+
+
 def rung_transport(results):
     """Auction + Sinkhorn global solvers at 50k pods / 5k nodes (BASELINE.json
     ladder steps 3-4): throughput, placements, and mean assignment score vs
@@ -773,6 +831,7 @@ RUNGS = [
     ("NorthStar", rung_north_star),
     ("NorthStarWarm", rung_north_star_warm),
     ("NorthStarEndToEnd", rung_north_star_endtoend),
+    ("GangScheduling", rung_gang),
     ("Transport", rung_transport),
     ("ApiserverWatchFanout", rung_watch_fanout),
 ]
@@ -781,7 +840,8 @@ RUNGS = [
 # exercise the host pipeline end-to-end, <=60s wall, same JSON line on
 # stdout. Catches perf-path regressions (a broken coalesced ingest or bind
 # path fails loudly here) without the full ladder's budget.
-QUICK_RUNGS = ("SchedulingBasic", "MixedChurn", "NorthStarEndToEnd")
+QUICK_RUNGS = ("SchedulingBasic", "MixedChurn", "NorthStarEndToEnd",
+               "GangScheduling")
 QUICK_BUDGET_S = 55.0
 
 

@@ -7,6 +7,14 @@ from .labels import (  # noqa: F401
     Requirement,
     Selector,
 )
+from .podgroup import (  # noqa: F401
+    LABEL_TPU_SLICE,
+    POD_GROUP_LABEL,
+    PodGroup,
+    PodGroupSpec,
+    PodGroupStatus,
+    pod_group_key,
+)
 from .resources import (  # noqa: F401
     Resource,
     compute_pod_resource_request,

@@ -1,0 +1,105 @@
+"""Gang-scheduling API surface: the PodGroup object and its label convention.
+
+The distributed-training workload the ROADMAP opens with this PR: a multi-host
+training job is a set of ranks that must start together (all-or-nothing) or the
+half-placed job deadlocks holding capacity. The API mirrors the coscheduling
+ecosystem's shape (reference: sigs.k8s.io/scheduler-plugins
+apis/scheduling/v1alpha1 PodGroup — minMember + a pod label naming the group),
+narrowed to what the batched TPU solver consumes:
+
+  - a PodGroup object (kind "podgroups" in the store) with spec.min_member:
+    the quorum of members that must be placeable in one solve for ANY member
+    to bind;
+  - pods join a group by carrying the POD_GROUP_LABEL whose value is the
+    PodGroup's name in the pod's own namespace (groups never span namespaces);
+  - nodes advertise their TPU slice (ICI domain) via LABEL_TPU_SLICE — the
+    cluster-level analog of a jax device's slice_index
+    (parallel/multislice.slice_topology) — which the gang packing score uses
+    to keep a gang's ranks on one interconnect.
+
+PodGroups are stored and watched like any object; the scheduler's gang
+directory (scheduler/gang.py) is their consumer.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Mapping
+
+from .types import ObjectMeta
+
+# Pods opt into a gang with this label; the value names a PodGroup in the
+# pod's namespace.
+POD_GROUP_LABEL = "pod-group.scheduling/name"
+
+# Node label carrying the TPU slice (ICI domain) the node's chips belong to.
+# Nodes of one slice share terabit ICI; crossing slices pays DCN — the gang
+# packing score prefers placing a whole gang inside one slice.
+LABEL_TPU_SLICE = "tpu.scheduling/slice"
+
+
+@dataclass
+class PodGroupSpec:
+    # quorum: the minimum number of members that must be schedulable together
+    # before any member binds (all-or-nothing floor, not a replica target)
+    min_member: int = 1
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "PodGroupSpec":
+        return PodGroupSpec(min_member=int(d.get("minMember", 1) or 1))
+
+
+@dataclass
+class PodGroupStatus:
+    phase: str = "Pending"  # Pending | Scheduled (best-effort, controller-set)
+    scheduled: int = 0  # members observed bound
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "PodGroupStatus":
+        return PodGroupStatus(
+            phase=d.get("phase", "Pending"),
+            scheduled=int(d.get("scheduled", 0) or 0),
+        )
+
+
+@dataclass
+class PodGroup:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodGroupSpec = field(default_factory=PodGroupSpec)
+    status: PodGroupStatus = field(default_factory=PodGroupStatus)
+
+    kind = "PodGroup"
+
+    @property
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+    @staticmethod
+    def from_dict(d: Mapping) -> "PodGroup":
+        return PodGroup(
+            metadata=ObjectMeta.from_dict(d.get("metadata") or {}),
+            spec=PodGroupSpec.from_dict(d.get("spec") or {}),
+            status=PodGroupStatus.from_dict(d.get("status") or {}),
+        )
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "apiVersion": "scheduling.x-k8s.io/v1alpha1",
+            "kind": "PodGroup",
+            "metadata": self.metadata.to_dict(),
+            "spec": {"minMember": self.spec.min_member},
+        }
+        if self.status.phase != "Pending" or self.status.scheduled:
+            out["status"] = {"phase": self.status.phase,
+                             "scheduled": self.status.scheduled}
+        return out
+
+
+def pod_group_key(pod) -> str:
+    """Group key ("namespace/name") for a labeled pod, or "" when the pod is
+    not a gang member. Groups are namespace-scoped: the label value names a
+    PodGroup in the pod's own namespace."""
+    name = pod.metadata.labels.get(POD_GROUP_LABEL)
+    if not name:
+        return ""
+    return f"{pod.metadata.namespace}/{name}"

@@ -65,6 +65,7 @@ from .storage import (
     StorageClass,
     VolumeAttachment,
 )
+from .podgroup import PodGroup
 from .workloads import (
     CronJob,
     DaemonSet,
@@ -121,6 +122,7 @@ KIND_TO_RESOURCE = {
     "ValidatingAdmissionPolicyBinding": "validatingadmissionpolicybindings",
     "MutatingWebhookConfiguration": "mutatingwebhookconfigurations",
     "ValidatingWebhookConfiguration": "validatingwebhookconfigurations",
+    "PodGroup": "podgroups",
 }
 RESOURCE_TO_TYPE = {
     "pods": Pod,
@@ -168,6 +170,7 @@ RESOURCE_TO_TYPE = {
     "validatingadmissionpolicybindings": ValidatingAdmissionPolicyBinding,
     "mutatingwebhookconfigurations": MutatingWebhookConfiguration,
     "validatingwebhookconfigurations": ValidatingWebhookConfiguration,
+    "podgroups": PodGroup,
 }
 CLUSTER_SCOPED = {"nodes", "namespaces", "persistentvolumes", "storageclasses",
                   "volumeattachments", "apiservices",
@@ -227,6 +230,7 @@ GROUP_PREFIX = {
     "mutatingwebhookconfigurations": "/apis/admissionregistration.k8s.io/v1",
     "validatingwebhookconfigurations":
         "/apis/admissionregistration.k8s.io/v1",
+    "podgroups": "/apis/scheduling.x-k8s.io/v1alpha1",
 }
 
 

@@ -486,7 +486,9 @@ def transport_solve(
         if node_names is None:
             # duals must map to TRUE nodes, never mesh padding
             node_names = [str(i) for i in range(true_n)]
-        ctx = jax.sharding.set_mesh(mesh)
+        from ..parallel import mesh_context
+
+        ctx = mesh_context(mesh)
     with ctx:
         if method == "sinkhorn":
             frac, new_state = sinkhorn_solve(problem, state, node_names)

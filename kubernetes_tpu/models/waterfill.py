@@ -39,13 +39,14 @@ from ..ops.solver import (
 from ..scheduler.framework import MAX_NODE_SCORE
 
 
-@functools.partial(jax.jit, static_argnames=("j_max", "k_slots"))
+@functools.partial(jax.jit, static_argnames=("j_max", "k_slots", "has_gang"))
 def waterfill_group(
     alloc, used, used_nz, pod_count, max_pods,
     filter_ok_row, port_conflict_row, has_port,
     napref_row, has_napref, taint_row, img_row,
     req, req_nz, bal_active, group_size,
     j_max: int, k_slots: int,
+    gang_row=None, has_gang: bool = False,
 ):
     """Place `group_size` (dynamic, <= k_slots) identical pods. k_slots is the
     static top-k width — bucketed to powers of two by the caller so batch-size
@@ -69,6 +70,10 @@ def waterfill_group(
     napref = jnp.where(has_napref, default_normalize(napref_row, feas0, reverse=False), 0)
     taint = default_normalize(taint_row, feas0, reverse=True)
     static = 2 * napref + 3 * taint + img_row  # int32 [N]
+    if has_gang:
+        # gang slice-packing bonus (scheduler/gang.py) — static per node like
+        # img_row; the caller's slot guard budgets the extra score range
+        static = static + gang_row
 
     # dynamic components as a function of j = pods already added (0..j_max-1),
     # via the SAME formula helpers the scan solver uses (one source of truth
@@ -122,21 +127,24 @@ def waterfill_solve(inp: SolverInputs, groups: List[Tuple[np.ndarray, int]]):
     """
     p = inp.req.shape[0]
     n = inp.alloc.shape[0]
+    has_gang = inp.gang_bonus is not None
     # j_max must cover every node's remaining pod headroom, or schedulable pods
     # would be silently clipped; the int32 sort key bounds slots at ~2.6M
-    # (max_total_score 800 * slots < 2^31). Derived from STATIC capacity
-    # (max_pods) when it fits: headroom shrinks as the cluster fills and a
-    # headroom-derived bucket would recompile at every power-of-two boundary
-    # — each mid-run XLA compile costs tens of seconds on TPU. Only when the
-    # static bound blows the int32 key range does the tighter dynamic
-    # headroom (then a raw, unbucketed one) come in.
+    # (max_total_score 800 * slots < 2^31; gang batches add GANG_SLICE_BONUS
+    # to the score range, so their slot cap tightens to ~2.3M). Derived from
+    # STATIC capacity (max_pods) when it fits: headroom shrinks as the
+    # cluster fills and a headroom-derived bucket would recompile at every
+    # power-of-two boundary — each mid-run XLA compile costs tens of seconds
+    # on TPU. Only when the static bound blows the int32 key range does the
+    # tighter dynamic headroom (then a raw, unbucketed one) come in.
+    max_slots = 2_300_000 if has_gang else 2_600_000
     cap = max(1, int(np.asarray(inp.max_pods).max(initial=1)))
     j_max = 1 << (cap - 1).bit_length()
-    if n * j_max > 2_600_000:
+    if n * j_max > max_slots:
         headroom = max(1, int(np.asarray(inp.max_pods - inp.pod_count).max(initial=1)))
         j_max = 1 << (headroom - 1).bit_length()
-        if n * j_max > 2_600_000:
-            if n * headroom > 2_600_000:
+        if n * j_max > max_slots:
+            if n * headroom > max_slots:
                 return None
             j_max = headroom
     assignment = np.full(p, -1, dtype=np.int32)
@@ -163,6 +171,8 @@ def waterfill_solve(inp: SolverInputs, groups: List[Tuple[np.ndarray, int]]):
             inp.req[pi0], inp.req_nz[pi0], inp.balanced_active[pi0],
             jnp.int32(len(members)),
             j_max=j_max, k_slots=k_slots,
+            gang_row=inp.gang_bonus[cls] if has_gang else None,
+            has_gang=has_gang,
         )
         chosen = np.full(len(members), -1, dtype=np.int32)
         got = np.asarray(chosen_nodes)[: len(members)]

@@ -89,6 +89,11 @@ class SolverInputs(NamedTuple):
     req_nz: jnp.ndarray  # [P, R]
     class_of_pod: jnp.ndarray  # [P]
     balanced_active: jnp.ndarray  # [P] bool
+    # gang slice-packing bonus (scheduler/gang.py): per-(class, node) static
+    # score added when the batch carries gang members; None for gang-free
+    # batches — the has_gang static gate keeps it out of the compiled program
+    # entirely (never traced, never uploaded)
+    gang_bonus: Optional[jnp.ndarray] = None  # [C, N] int32
 
 
 def _pad_ct(*arrays, sentinel_class=-1):
@@ -162,6 +167,9 @@ def make_inputs(cluster, batch, device=None) -> Tuple[SolverInputs, int]:
         req=jnp.asarray(batch.req), req_nz=jnp.asarray(batch.req_nz),
         class_of_pod=jnp.asarray(batch.class_of_pod),
         balanced_active=jnp.asarray(batch.balanced_active),
+        gang_bonus=(jnp.asarray(batch.gang_bonus)
+                    if getattr(batch, "gang_bonus", None) is not None
+                    else None),
     )
     return inputs, d_max
 
@@ -252,9 +260,11 @@ def pod_row_feasibility_score(inp: SolverInputs, req, req_nz, cls, bal_active):
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("d_max", "has_ipa", "has_ct", "has_st"))
+@functools.partial(jax.jit, static_argnames=("d_max", "has_ipa", "has_ct",
+                                             "has_st", "has_gang"))
 def greedy_scan_solve(inp: SolverInputs, d_max: int, has_ipa: bool = True,
-                      has_ct: bool = True, has_st: bool = True):
+                      has_ct: bool = True, has_st: bool = True,
+                      has_gang: bool = False):
     """Sequential-within-batch greedy assignment, one lax.scan step per pod.
 
     Exactly the serial pipeline: filter -> score -> argmax (lowest index wins
@@ -448,6 +458,10 @@ def greedy_scan_solve(inp: SolverInputs, d_max: int, has_ipa: bool = True,
             ipa_score = jnp.int32(0)
 
         total = least + bal + 2 * napref + 3 * taint + 2 * pts + 2 * ipa_score + img
+        if has_gang:
+            # gang slice packing (scheduler/gang.py): a static per-class row,
+            # like img — feasibility already masked the infeasible nodes
+            total = total + inp.gang_bonus[cls]
 
         # --- selectHost: deterministic argmax (lowest index on ties) ---
         masked = jnp.where(feas, total, INT_MIN)

@@ -18,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import mesh_context
 from ..ops.solver import SolverInputs, greedy_scan_solve
 from ..scheduler.framework import MAX_NODE_SCORE
 
@@ -52,6 +53,7 @@ _SPECS = dict(
     sym_grp=P(), sym_weight=P(),
     class_self_ok=P(), class_has_ra=P(),
     req=P(), req_nz=P(), class_of_pod=P(), balanced_active=P(),
+    gang_bonus=P(None, "nodes"),
 )
 
 
@@ -63,6 +65,8 @@ def _pad_nodes(inp: SolverInputs, multiple: int) -> Tuple[SolverInputs, int]:
     if pad == 0:
         return inp, n
     def pad_node_axis(name, arr):
+        if arr is None:  # optional field absent (e.g. gang_bonus)
+            return None
         spec = _SPECS[name]
         axis = None
         for i, s in enumerate(spec):
@@ -85,7 +89,8 @@ def shard_inputs(inp: SolverInputs, mesh: Mesh) -> Tuple[SolverInputs, int]:
     """device_put every field with its NamedSharding (node axis over the mesh)."""
     inp, n = _pad_nodes(inp, mesh.shape["nodes"])
     placed = {
-        k: jax.device_put(v, NamedSharding(mesh, _SPECS[k]))
+        k: (v if v is None
+            else jax.device_put(v, NamedSharding(mesh, _SPECS[k])))
         for k, v in inp._asdict().items()
     }
     return SolverInputs(**placed), n
@@ -96,7 +101,7 @@ def sharded_greedy_solve(inp: SolverInputs, d_max: int, mesh: Mesh):
     per-step filter/score over the mesh and inserts the argmax/segment-sum
     collectives. Assignment indices refer to the padded node axis; callers must
     treat idx >= true_n as unschedulable (cannot happen: padding is infeasible)."""
-    with jax.sharding.set_mesh(mesh):
+    with mesh_context(mesh):
         return greedy_scan_solve(inp, d_max)
 
 
@@ -119,7 +124,7 @@ def sharded_feasibility_cost(inp: SolverInputs, d_max: int, mesh: Mesh):
     fn = jax.jit(feasibility_cost_matrices, static_argnames=("d_max",),
                  out_shardings=(NamedSharding(mesh, P("dp", "nodes")),
                                 NamedSharding(mesh, P("dp", "nodes"))))
-    with jax.sharding.set_mesh(mesh):
+    with mesh_context(mesh):
         return fn(inp, d_max)
 
 

@@ -62,6 +62,18 @@ class BatchScheduler(Scheduler):
         # requeues them internally, but "my bind_many failed" was invisible):
         # [(pod key, message)], drained via take_bind_failures()
         self.bind_failures: List = []
+        # gang scheduling (scheduler/gang.py): PodGroup quorums + placed
+        # members, fed by the watch plumbing in serial.py; the queue holds
+        # gang members in staging until quorum, and schedule_batch enforces
+        # the all-or-nothing veto. Inactive (one attr read) until a PodGroup
+        # exists.
+        from .gang import GangDirectory
+
+        self.gangs = GangDirectory()
+        self.queue.set_gang_hooks(self.gangs.group_of,
+                                  self.gangs.quorum_ready,
+                                  lambda: self.gangs.active)
+        self.gang_vetoes = 0  # gangs stripped post-solve (observability)
 
     def schedule_batch(self, timeout: Optional[float] = 0.0) -> int:
         """Drain up to batch_size pods, solve jointly, bind. Returns #pods handled."""
@@ -92,7 +104,8 @@ class BatchScheduler(Scheduler):
         batch = build_pod_batch(
             pods, snapshot, cluster, ns_labels=self._ns_labels,
             hard_pod_affinity_weight=self._hard_pod_affinity_weight(),
-            reuse=self._tensor_cache, changed_nodes=changed_nodes)
+            reuse=self._tensor_cache, changed_nodes=changed_nodes,
+            gangs=self.gangs)
 
         fallback_mask = batch.fallback_class[batch.class_of_pod]
         device_idx = np.nonzero(~fallback_mask)[0]
@@ -100,15 +113,22 @@ class BatchScheduler(Scheduler):
 
         if device_idx.size:
             sub = _subset_batch(batch, device_idx)
+            # gang members present in the device batch? (solver bias + the
+            # all-or-nothing post-solve pass). The native and transport
+            # backends don't model the slice-packing bonus, so gang batches
+            # take the fast/exact paths (which do).
+            has_gang = (sub.gang_of_pod is not None
+                        and bool((sub.gang_of_pod >= 0).any()))
             # 'fast' means fast-when-legal: the water-fill kernel has no
             # topology-spread or inter-pod-affinity handling, so constrained
             # batches always take the exact scan path regardless of mode.
             constraint_free = (batch.ct_class.size == 0 and batch.st_class.size == 0
                                and not batch.ipa.has_any)
             use_fast = self.solver in ("fast", "auto") and constraint_free
-            use_transport = self.solver in ("auction", "sinkhorn") and constraint_free
+            use_transport = (self.solver in ("auction", "sinkhorn")
+                             and constraint_free and not has_gang)
             assignment = None
-            if self.solver == "native" and constraint_free:
+            if self.solver == "native" and constraint_free and not has_gang:
                 from ..native import native_available, native_greedy_solve
 
                 if native_available():
@@ -140,8 +160,36 @@ class BatchScheduler(Scheduler):
                 assignment, _, _ = greedy_scan_solve(
                     inputs, d_max, has_ipa=bool(batch.ipa.has_any),
                     has_ct=bool(batch.ct_class.size),
-                    has_st=bool(batch.st_class.size))
+                    has_st=bool(batch.st_class.size),
+                    has_gang=bool(has_gang and sub.gang_bonus is not None))
             assignment = np.asarray(assignment)
+            # All-or-nothing gang veto (scheduler/gang.py), BEFORE any assume
+            # or bind: a gang whose in-batch placements (plus members already
+            # placed) miss min_member is stripped wholesale — its placed rows
+            # become unplaced for every downstream consumer (bind loop,
+            # capacity fold in _handle_device_rejects) — and requeued as a
+            # unit. gang_requeue: gang id -> members collected for requeue.
+            gang_requeue: Dict[int, List[QueuedPodInfo]] = {}
+            hopeless: set = set()
+            veto = None
+            if has_gang:
+                from .gang import gang_veto_mask
+
+                gkeys = batch.gang_keys
+                need = np.array(
+                    [max(0, (self.gangs.min_member(k) or 0)
+                         - self.gangs.placed_count(k)) for k in gkeys],
+                    dtype=np.int64)
+                veto, _satisfied = gang_veto_mask(
+                    assignment, np.asarray(sub.gang_of_pod), need)
+                # a gang needing more members than one solve can ever see is
+                # unsatisfiable by this configuration — park it with a
+                # diagnostic instead of livelocking through backoff retries
+                hopeless.update(np.nonzero(need > self.batch_size)[0].tolist())
+                if veto.any():
+                    self.gang_vetoes += int(
+                        np.unique(sub.gang_of_pod[veto]).size)
+                    assignment = np.where(veto, -1, assignment)
             # Two phases: bind every device assignment FIRST, then handle the
             # rejected pods. Handling mid-loop would see capacity still
             # promised to not-yet-bound assignments and double-book nodes.
@@ -149,21 +197,40 @@ class BatchScheduler(Scheduler):
             to_bind = []
             bind_rows: List[int] = []  # full-batch pod row per to_bind entry
             bind_nodes: List[int] = []  # cluster node index per to_bind entry
+            bind_gang: List[int] = []  # gang id per entry (gang batches only)
             use_columnar = self.columnar and batch.raw_req is not None
             clone = pod_bind_clone if use_columnar else pod_structural_clone
             node_names = cluster.node_names
+            sub_gang = (np.asarray(sub.gang_of_pod).tolist()
+                        if has_gang else None)
+            veto_list = veto.tolist() if veto is not None else None
             # .tolist() once: per-element int() of numpy scalars is
             # measurable at 100k pods
             assign_list = np.asarray(assignment).tolist()
             for j, pi in enumerate(device_idx.tolist()):
+                gid = sub_gang[j] if sub_gang is not None else -1
+                if veto_list is not None and veto_list[j]:
+                    gang_requeue.setdefault(gid, []).append(qps[pi])
+                    continue
                 nidx = assign_list[j]
                 if nidx < 0:
-                    rejected.append((j, qps[pi]))
+                    if gid >= 0:
+                        # unplaced extra of a SATISFIED gang: fail it alone —
+                        # and never preempt to place part of a gang, so it
+                        # skips the _batch_preempt path entirely
+                        self._handle_failure(qps[pi], Status.unschedulable(
+                            f"0/{len(node_names)} nodes are available "
+                            "(gang member; preemption skipped)",
+                            plugin="NodeResourcesFit"))
+                    else:
+                        rejected.append((j, qps[pi]))
                 else:
                     to_bind.append((qps[pi], node_names[nidx],
                                     clone(qps[pi].pod)))
                     bind_rows.append(pi)
                     bind_nodes.append(nidx)
+                    if sub_gang is not None:
+                        bind_gang.append(gid)
             if to_bind:
                 # bulk assume under one cache lock, then hand the worker
                 # CHUNKED batches: per-pod puts left bind_many at ~53-pod
@@ -181,11 +248,46 @@ class BatchScheduler(Scheduler):
                         pairs, check_ports=batch_has_ports)
                 else:
                     bad = self.cache.assume_pods(pairs)
+                bad_gangs = set()
                 for i, msg in sorted(bad, reverse=True):
                     qp, node, _assumed = to_bind.pop(i)
                     bind_rows.pop(i)
                     bind_nodes.pop(i)
-                    self._handle_failure(qp, Status.error(msg))
+                    gid = bind_gang.pop(i) if bind_gang else -1
+                    if gid >= 0:
+                        bad_gangs.add(gid)
+                        gang_requeue.setdefault(gid, []).append(qp)
+                    else:
+                        self._handle_failure(qp, Status.error(msg))
+                if bad_gangs:
+                    # all-or-nothing at assume time: a gang that lost a
+                    # member releases every already-assumed sibling BEFORE
+                    # any bind fires. On the columnar path phase 2 hasn't
+                    # run yet, so the release must be the structural inverse
+                    # (forget_pods_structural) — forget_pod would subtract
+                    # resource totals that were never added.
+                    released = []
+                    for i in range(len(to_bind) - 1, -1, -1):
+                        gid = bind_gang[i]
+                        if gid in bad_gangs:
+                            qp, _node, assumed = to_bind.pop(i)
+                            bind_rows.pop(i)
+                            bind_nodes.pop(i)
+                            bind_gang.pop(i)
+                            released.append(assumed)
+                            gang_requeue.setdefault(gid, []).append(qp)
+                    if use_columnar:
+                        self.cache.forget_pods_structural(
+                            released, check_ports=batch_has_ports)
+                    else:
+                        for assumed in released:
+                            self.cache.forget_pod(assumed)
+                if bind_gang:
+                    # surviving members count toward quorum from assume on
+                    # (our own bind confirmations bypass the event stream)
+                    for i, (_qp, _node, assumed) in enumerate(to_bind):
+                        if bind_gang[i] >= 0:
+                            self.gangs.note_assumed(assumed)
                 if use_columnar and to_bind:
                     self._columnar_account(batch, cluster, snapshot,
                                            bind_rows, bind_nodes,
@@ -203,14 +305,52 @@ class BatchScheduler(Scheduler):
             if rejected:
                 self._handle_device_rejects(rejected, snapshot, cluster, sub,
                                             assignment)
+            if gang_requeue:
+                self._requeue_gangs(gang_requeue, batch.gang_keys or [],
+                                    hopeless)
 
         # Serial fallback, in original priority order among themselves.
+        # NOTE: gang members whose class needs the serial path (volumes, DRA)
+        # schedule individually — all-or-nothing is enforced for device-path
+        # classes, the shape training gangs actually take.
         for pi in fallback_idx:
             self._serial_one(qps[pi])
 
         self.batches_solved += 1
         m.batch_solve_duration.observe(time.perf_counter() - t_batch)
         return len(qps)
+
+    def _requeue_gangs(self, groups: Dict[int, List[QueuedPodInfo]],
+                       keys: List[str],
+                       hopeless: frozenset = frozenset()) -> None:
+        """Gang-aware rejection handling: a vetoed (or assume-rolled-back)
+        gang re-enters the queue AS A UNIT — one shared backoff expiry via
+        SchedulingQueue.add_gang_backoff, so the members re-stage and
+        re-admit together instead of dribbling through the unschedulable map
+        one cluster event at a time. One FailedScheduling narration per gang
+        (not per member: a 250-rank gang must not write 250 events per
+        veto). `hopeless` gangs (min_member beyond what one solve can see)
+        park unschedulable with a diagnostic instead — retrying on a timer
+        would livelock."""
+        for gid, members in groups.items():
+            key = keys[gid] if 0 <= gid < len(keys) else "<unknown>"
+            if gid in hopeless:
+                status = Status.unschedulable(
+                    f"pod group {key} needs more members than the solver "
+                    f"batch size ({self.batch_size}) can place together; "
+                    "raise batch_size or lower minMember",
+                    plugin="GangScheduling")
+                for m in members:
+                    self._handle_failure(m, status)
+                continue
+            self.failed_count += len(members)
+            for m in members:
+                m.unschedulable_plugins = ("GangScheduling",)
+            self.recorder.event(
+                members[0].pod, "Warning", "FailedScheduling",
+                f"pod group {key}: {len(members)} member(s) cannot be placed "
+                "together (all-or-nothing); gang requeued")
+            self.queue.add_gang_backoff(members)
 
     def _columnar_account(self, batch, cluster, snapshot, bind_rows,
                           bind_nodes, has_ports: bool = True) -> None:
@@ -529,6 +669,8 @@ class BatchScheduler(Scheduler):
                 self.scheduled_count += 1
         except Exception as e:
             self.cache.forget_pod(assumed)
+            if self.gangs is not None:
+                self.gangs.note_forgotten(assumed)
             if async_mode:
                 # surfaced on the scheduling thread at the next drain; handling
                 # failures re-enters the queue, which isn't bind-thread-safe
@@ -606,6 +748,8 @@ class BatchScheduler(Scheduler):
                     self._bind_successes += 1
                 else:
                     self.cache.forget_pod(assumed)
+                    if self.gangs is not None:
+                        self.gangs.note_forgotten(assumed)
                     self._bind_errors.append((qp, Status.error(msg)))
 
     def _drain_bind_results(self) -> None:
@@ -699,4 +843,6 @@ def _subset_batch(batch, idx):
         balanced_active=batch.balanced_active[idx],
         raw_req=None if batch.raw_req is None else batch.raw_req[idx],
         raw_req_nz=None if batch.raw_req_nz is None else batch.raw_req_nz[idx],
+        gang_of_pod=(None if batch.gang_of_pod is None
+                     else batch.gang_of_pod[idx]),
     )

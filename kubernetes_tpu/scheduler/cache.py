@@ -224,6 +224,40 @@ class Cache:
                 assumed[key] = 0.0
         return failed
 
+    def forget_pods_structural(self, pods, check_ports: bool = True) -> None:
+        """Rollback of assume_pods_structural BEFORE the matching
+        apply_node_resource_deltas: undo exactly what phase 1 did — the
+        _pod_nodes/_assumed entries, the PodInfo appends (pods lists,
+        affinity sublists), and (when phase 1 scanned them) the host-port
+        claims — WITHOUT the requested-resource subtraction forget_pod
+        performs, because phase 2 never added those totals. Subtracting them
+        here would drive NodeInfo.requested negative (the gang all-or-nothing
+        rollback found this the hard way). check_ports must mirror the
+        assume call's flag, or a port another pod legitimately owns could be
+        released."""
+        from .framework import _host_ports
+
+        with self._lock:
+            for pod in pods:
+                key = pod.key
+                node_name = self._pod_nodes.pop(key, None)
+                self._assumed.pop(key, None)
+                if node_name is None:
+                    continue
+                ni = self._nodes.get(node_name)
+                if ni is None:
+                    continue
+                for lst in (ni.pods, ni.pods_with_affinity,
+                            ni.pods_with_required_anti_affinity):
+                    for i in range(len(lst) - 1, -1, -1):
+                        if lst[i].pod.key == key:
+                            lst.pop(i)
+                            break
+                if check_ports:
+                    for port in _host_ports(pod):
+                        ni.used_ports.discard(port)
+                self._touch(ni)
+
     def apply_node_resource_deltas(self, resource_dims, node_deltas,
                                    expected_gen: Optional[int] = None
                                    ) -> Optional[int]:

@@ -8,6 +8,14 @@ flush cadence: backoff every 1s, unschedulable every 30s (:350).
 QueueingHints are simplified to event-kind gating: on a cluster event, all
 unschedulable pods move to backoff/active (the pre-hints behavior); per-plugin
 hint functions can be layered on later without changing this interface.
+
+Gang gating (scheduler/gang.py): with gang hooks installed, members of a
+PodGroup are held in a STAGING area — a fourth tier next to active/backoff/
+unschedulable — until the group reaches quorum (staged + already-placed >=
+min_member), then the whole gang is admitted contiguously (one timestamp,
+consecutive seqs) so a single solver batch sees it together. A failed gang
+re-enters through add_gang_backoff as a unit: one shared expiry, so the
+members re-stage and re-admit together.
 """
 
 from __future__ import annotations
@@ -77,6 +85,23 @@ class SchedulingQueue:
         self._unschedulable: Dict[str, QueuedPodInfo] = {}
         self._in_active: Dict[str, QueuedPodInfo] = {}
         self._closed = False
+        # gang staging (scheduler/gang.py): group key -> {pod key: qp}. Hooks
+        # are installed by the batch scheduler via set_gang_hooks; without
+        # them (or while gang_active() is False) every gang path is skipped.
+        self._gang_of = None  # (pod) -> Optional[str]
+        self._gang_ready = None  # (group, staged_count) -> bool
+        self._gang_active = None  # () -> bool
+        self._gang_staging: Dict[str, Dict[str, QueuedPodInfo]] = {}
+
+    def set_gang_hooks(self, gang_of, gang_ready, gang_active) -> None:
+        """Install gang gating: gang_of(pod) names the pod's group (None for
+        non-members), gang_ready(group, staged) decides quorum, gang_active()
+        is the batch-level fast-out (False until any PodGroup exists, so
+        gang-free clusters pay one call per admission batch)."""
+        with self._lock:
+            self._gang_of = gang_of
+            self._gang_ready = gang_ready
+            self._gang_active = gang_active
 
     # -- ordering --------------------------------------------------------------
 
@@ -109,6 +134,8 @@ class SchedulingQueue:
             return
         with self._lock:
             now = self._clock.now()
+            gang_of = (self._gang_of if self._gang_active is not None
+                       and self._gang_active() else None)
             entries = []
             for pod in pods:
                 qp = QueuedPodInfo(pod=pod, timestamp=now)
@@ -120,6 +147,14 @@ class SchedulingQueue:
                         and not self._pre_enqueue(pod)):
                     self._unschedulable[key] = qp  # still gated: stay parked
                     continue
+                if gang_of is not None:
+                    group = gang_of(pod)
+                    if group is not None:
+                        for m in self._gang_stage(group, qp):
+                            self._in_active[m.key] = m
+                            entries.append((self._sort_key(m),
+                                            next(self._seq), m))
+                        continue
                 self._in_active[key] = qp
                 entries.append((self._sort_key(qp), next(self._seq), qp))
             if not entries:
@@ -139,8 +174,83 @@ class SchedulingQueue:
         if self._pre_enqueue is not None and not self._pre_enqueue(qp.pod):
             self._unschedulable[qp.key] = qp  # still gated: stay parked
             return
+        if self._gang_active is not None and self._gang_active():
+            group = self._gang_of(qp.pod)
+            if group is not None:
+                for m in self._gang_stage(group, qp):
+                    self._heap_push(m)
+                return
+        self._heap_push(qp)
+
+    def _heap_push(self, qp: QueuedPodInfo) -> None:
         self._in_active[qp.key] = qp
         heapq.heappush(self._active, (self._sort_key(qp), next(self._seq), qp))
+
+    # -- gang staging (scheduler/gang.py) --------------------------------------
+
+    def _gang_stage(self, group: str, qp: QueuedPodInfo) -> List[QueuedPodInfo]:
+        """Park one gang member in staging; returns the members to admit NOW
+        ([] while the group is below quorum). Admitted members share one
+        timestamp, so with equal priorities the (sort_key, seq) total order
+        pops them contiguously — one solver batch sees the whole gang."""
+        self._gang_staging.setdefault(group, {})[qp.key] = qp
+        return self._gang_collect(group, requester=qp)
+
+    def _gang_collect(self, group: str,
+                      requester: Optional[QueuedPodInfo] = None
+                      ) -> List[QueuedPodInfo]:
+        staged = self._gang_staging.get(group)
+        if (not staged or self._gang_ready is None
+                or not self._gang_ready(group, len(staged))):
+            return []
+        if self._pre_enqueue is not None:
+            # gates may have closed on members staged earlier; a newly-gated
+            # member breaks quorum and the gang keeps waiting (the reference
+            # re-runs PreEnqueue on every promotion into activeQ)
+            for key, m in list(staged.items()):
+                if m is requester:
+                    continue
+                if not self._pre_enqueue(m.pod):
+                    staged.pop(key)
+                    self._unschedulable[key] = m
+            if not staged or not self._gang_ready(group, len(staged)):
+                if not staged:
+                    self._gang_staging.pop(group, None)
+                return []
+        self._gang_staging.pop(group, None)
+        now = self._clock.now()
+        members = list(staged.values())
+        for m in members:
+            m.timestamp = now
+        return members
+
+    def reconsider_gangs(self) -> None:
+        """Re-evaluate every staged group's quorum — called on PodGroup
+        events (a created/raised-quorum PodGroup can unblock members that
+        arrived before it)."""
+        with self._lock:
+            moved = False
+            for group in list(self._gang_staging):
+                for m in self._gang_collect(group):
+                    self._heap_push(m)
+                    moved = True
+            if moved:
+                self._lock.notify_all()
+
+    def add_gang_backoff(self, members: List[QueuedPodInfo]) -> None:
+        """Requeue a failed gang as a UNIT: every member enters the backoff
+        queue under ONE shared expiry (the slowest member's backoff), so the
+        gang re-stages and re-admits together when it fires — never member by
+        member through the unschedulable map."""
+        if not members:
+            return
+        with self._lock:
+            now = self._clock.now()
+            dur = max(self._backoff_duration(m.attempts) for m in members)
+            ready = now + dur
+            for m in members:
+                m.timestamp = now
+                heapq.heappush(self._backoff, (ready, next(self._seq), m))
 
     def add_unschedulable(self, qp: QueuedPodInfo) -> None:
         """AddUnschedulableIfNotPresent (:741): failed pods wait for an event
@@ -208,6 +318,23 @@ class SchedulingQueue:
                     self._unschedulable.pop(key)
                     self._push_active(qp)
                     moved = True
+            # gang staging safety net: members of a group with NO PodGroup
+            # (quorum hook returns None — deleted, or never created) must
+            # not be stranded; after the same 30s window they release as
+            # ORDINARY pods. Groups with a live PodGroup below quorum keep
+            # waiting — releasing those would break all-or-nothing.
+            for group in list(self._gang_staging):
+                staged = self._gang_staging[group]
+                if (self._gang_ready is None
+                        or self._gang_ready(group, len(staged)) is not None):
+                    continue
+                for key, qp in list(staged.items()):
+                    if now - qp.timestamp > FLUSH_UNSCHEDULABLE_TIMEOUT:
+                        staged.pop(key)
+                        self._heap_push(qp)
+                        moved = True
+                if not staged:
+                    self._gang_staging.pop(group, None)
             if moved:
                 self._lock.notify_all()
 
@@ -262,6 +389,7 @@ class SchedulingQueue:
         with self._lock:
             key = pod.key
             tracked = None
+            staged_in = None
             if key in self._in_active:
                 tracked = self._in_active[key]
             else:
@@ -271,6 +399,12 @@ class SchedulingQueue:
                         break
                 if tracked is None:
                     tracked = self._unschedulable.get(key)
+                if tracked is None:
+                    for group, staged in self._gang_staging.items():
+                        if key in staged:
+                            tracked = staged[key]
+                            staged_in = group
+                            break
             if tracked is None:
                 return False
             # status-only writes don't requeue (our own PodScheduled
@@ -280,7 +414,21 @@ class SchedulingQueue:
             spec_changed = (tracked.pod.spec != pod.spec
                             or tracked.pod.status.resource_claim_statuses
                             != pod.status.resource_claim_statuses)
+            labels_changed = tracked.pod.metadata.labels != pod.metadata.labels
             tracked.pod = pod
+            if (spec_changed or labels_changed) and staged_in is not None:
+                # a spec or label change while staged (labels carry gang
+                # membership): route the member back through _push_active so
+                # it re-stages under its current group (or leaves staging if
+                # no longer a member)
+                staged = self._gang_staging.get(staged_in)
+                if staged is not None:
+                    staged.pop(key, None)
+                    if not staged:
+                        self._gang_staging.pop(staged_in, None)
+                self._push_active(tracked)
+                self._lock.notify()
+                return True
             if spec_changed:
                 if key in self._unschedulable:
                     self._unschedulable.pop(key)
@@ -307,6 +455,10 @@ class SchedulingQueue:
     def delete_key(self, key: str) -> None:
         with self._lock:
             self._unschedulable.pop(key, None)
+            for group in list(self._gang_staging):
+                staged = self._gang_staging[group]
+                if staged.pop(key, None) is not None and not staged:
+                    self._gang_staging.pop(group, None)
             if key in self._in_active:
                 self._in_active.pop(key)
                 self._active = [(k, s, qp) for k, s, qp in self._active if qp.key != key]
@@ -319,7 +471,9 @@ class SchedulingQueue:
         with self._lock:
             return (list(self._in_active)
                     + [qp.key for _, _, qp in self._backoff]
-                    + list(self._unschedulable))
+                    + list(self._unschedulable)
+                    + [k for staged in self._gang_staging.values()
+                       for k in staged])
 
     def close(self) -> None:
         with self._lock:
@@ -329,5 +483,14 @@ class SchedulingQueue:
     # -- introspection ---------------------------------------------------------
 
     def lengths(self) -> Tuple[int, int, int]:
+        """(active, backoff, unschedulable); gang members waiting in staging
+        count as unschedulable — they are parked waiting for quorum, the same
+        observable meaning."""
         with self._lock:
-            return len(self._active), len(self._backoff), len(self._unschedulable)
+            staged = sum(len(s) for s in self._gang_staging.values())
+            return (len(self._active), len(self._backoff),
+                    len(self._unschedulable) + staged)
+
+    def gang_staged_count(self) -> int:
+        with self._lock:
+            return sum(len(s) for s in self._gang_staging.values())

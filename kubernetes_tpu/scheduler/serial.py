@@ -115,6 +115,9 @@ class Scheduler:
             **backoff_kw,
         )
         self.percentage = percentage_of_nodes_to_score
+        # gang directory (scheduler/gang.py) — installed by BatchScheduler;
+        # None on the serial loop, and every hook below is gated on it
+        self.gangs = None
         self._watch = None
         # coalesced watch ingest: batched store writes arrive as ONE
         # CoalescedEvent; _bind_origin tags our own bind_many batches so
@@ -184,9 +187,14 @@ class Scheduler:
         pending pods; then start WATCH from that RV. All kinds are listed under
         one consistent RV so no event can fall between list and watch."""
         lists, rv = self.store.list_many(
-            ("nodes", "pods", "namespaces") + STORAGE_KINDS)
+            ("nodes", "pods", "namespaces", "podgroups") + STORAGE_KINDS)
         for n in lists["nodes"]:
             self.cache.add_node(n)
+        if self.gangs is not None:
+            # quorums must be known BEFORE pods are ingested, or the gang
+            # members of the initial backlog would all park waiting
+            for pg in lists["podgroups"]:
+                self.gangs.observe_podgroup(ADDED, pg)
         for p in lists["pods"]:
             self._handle_pod(ADDED, p)
         for ns in lists["namespaces"]:
@@ -214,7 +222,7 @@ class Scheduler:
     @staticmethod
     def _watched_kinds() -> tuple:
         """The kinds _handle_event consumes (eventhandlers.go informer set)."""
-        return (("nodes", "pods", "namespaces") + STORAGE_KINDS
+        return (("nodes", "pods", "namespaces", "podgroups") + STORAGE_KINDS
                 + ("resourceclaims", "resourceslices", "deviceclasses"))
 
     def pump_events(self, max_events: int = 10_000) -> int:
@@ -309,11 +317,17 @@ class Scheduler:
                 lister.clear()
         self._ns_labels.clear()
         lists, rv = self.store.list_many(
-            ("nodes", "pods", "namespaces") + STORAGE_KINDS)
+            ("nodes", "pods", "namespaces", "podgroups") + STORAGE_KINDS)
         known_pending = set()
         for n in lists["nodes"]:
             self.cache.add_node(n)
+        if self.gangs is not None:
+            self.gangs.reset()
+            for pg in lists["podgroups"]:
+                self.gangs.observe_podgroup(ADDED, pg)
         for p in lists["pods"]:
+            if self.gangs is not None:
+                self.gangs.observe_pod(ADDED, p)
             if p.spec.node_name:
                 if not p.is_terminal():
                     self.cache.add_pod(p)
@@ -422,6 +436,14 @@ class Scheduler:
                     lister.add(ev.obj)
             # a new/changed PV or class can unblock pending claims
             self._move_for_event(ev.kind, ev.type, ev.obj)
+        elif ev.kind == "podgroups":
+            # gang quorum plumbing (scheduler/gang.py): a created or raised
+            # PodGroup can complete a staged gang's quorum, a delete orphans
+            # its members (they schedule as ordinary pods from then on)
+            if self.gangs is not None:
+                self.gangs.observe_podgroup(ev.type, ev.obj)
+                self.queue.reconsider_gangs()
+            self._move_for_event("podgroups", ev.type, ev.obj)
         elif ev.kind in ("resourceclaims", "resourceslices", "deviceclasses"):
             # DRA objects gate pods via DynamicResources' hints (claims read
             # through the store lister — no local cache to update)
@@ -432,6 +454,21 @@ class Scheduler:
         # (eventhandlers.go responsibleForPod); bound pods still feed the cache.
         if not pod.spec.node_name and self._fw(pod) is None:
             return
+        if self.gangs is not None:
+            # gang quorum accounting: bound members count, deletes/terminals
+            # free the slot (one labels.get for unlabeled pods). Our own bind
+            # confirmations bypass this path — they were counted at assume.
+            self.gangs.observe_pod(etype, pod)
+            if self.gangs.active and (etype == DELETED or pod.is_terminal()
+                                      or pod.spec.node_name):
+                from ..api.podgroup import pod_group_key
+
+                # membership changed: a staged gang may have reached quorum
+                # (e.g. a straggler whose siblings are now bound). Gated on
+                # actual gang membership — unlabeled pod churn must not pay
+                # a queue-lock + staging scan per event.
+                if pod_group_key(pod):
+                    self.queue.reconsider_gangs()
         # Pod informer filters terminal pods (scheduler.go:582); a queued pod
         # turning terminal generates a queue delete (predicate stops matching).
         if pod.is_terminal():
@@ -651,6 +688,8 @@ class Scheduler:
                 raise RuntimeError(f"prebind: {st.message()}")
             self._bind(pod, result.suggested_host)
             self.cache.finish_binding(assumed)
+            if self.gangs is not None:
+                self.gangs.note_assumed(assumed)
             framework.run_post_bind(state, assumed, result.suggested_host)
             self.scheduled_count += 1
             self.recorder.event(

@@ -148,6 +148,14 @@ class PodBatchTensors:
     raw_req_nz: Optional[np.ndarray] = None  # [P, R] int64
     class_has_host_ports: Optional[np.ndarray] = None  # [C] bool
 
+    # gang rows (scheduler/gang.py): group id per pod (-1 = not a member),
+    # the group keys those ids index, and the per-(class, node) slice-packing
+    # score bonus. All None when the batch has no gang members — the solvers
+    # compile their gang-free variants (pay-for-what-you-use).
+    gang_of_pod: Optional[np.ndarray] = None  # [P] int32
+    gang_keys: Optional[List[str]] = None  # [G]
+    gang_bonus: Optional[np.ndarray] = None  # [C, N] int32
+
     @property
     def p(self) -> int:
         return len(self.pods)
@@ -439,14 +447,22 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
                     cluster: ClusterTensors, ns_labels=None,
                     hard_pod_affinity_weight: int = 1,
                     reuse: Optional[TensorCache] = None,
-                    changed_nodes: Optional[List[int]] = None) -> PodBatchTensors:
+                    changed_nodes: Optional[List[int]] = None,
+                    gangs=None) -> PodBatchTensors:
     """Group pods into classes, compile class tables, build PTS + IPA tensors.
 
     reuse + changed_nodes (from TensorCache.cluster_tensors) enable the
     incremental count path: when this batch registers the same selector
     classes as the previous one, per-node match counts are recomputed only
-    for changed nodes instead of scanning every bound pod."""
+    for changed nodes instead of scanning every bound pod.
+
+    gangs (a scheduler.gang.GangDirectory) threads group-id rows through the
+    batch: each pod's PodGroup index plus the per-class slice-packing bonus.
+    Skipped entirely while the directory is inactive (no PodGroups)."""
     ns_labels = ns_labels or {}
+    gang_of_pod = gang_keys = gang_bonus = None
+    if gangs is not None and gangs.active:
+        gang_of_pod, gang_keys = gangs.batch_rows(pods)
     # pod-axis reuse: re-solving the SAME pending backlog after cluster churn
     # (the incremental re-solve of BASELINE.json's ladder) skips the per-pod
     # signature/quantization loops — identity comparison against the previous
@@ -554,6 +570,16 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
         balanced_active = np.zeros(0, dtype=bool)
 
     tables = compile_class_tables(rep_pods, cluster.cols)
+
+    if gang_of_pod is not None:
+        # per-(class, node) topology-packing bonus: classes are gang-
+        # exclusive (the gang label is part of pod_class_signature), so the
+        # bias can ride the class axis like every other static score table
+        from ..scheduler.gang import gang_slice_bonus
+
+        gang_bonus = gang_slice_bonus(
+            cluster, class_of_pod, np.asarray(req, dtype=np.int64),
+            tables.filter_ok, gang_of_pod, len(rep_pods))
 
     # -- topology keys + selector classes (shared by PTS + IPA) ----------------
     topo_key_idx: Dict[str, int] = {k: i for i, k in enumerate(cluster.topo_keys)}
@@ -713,6 +739,9 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
         raw_req=np.asarray(raw_req, dtype=np.int64),
         raw_req_nz=np.asarray(raw_req_nz, dtype=np.int64),
         class_has_host_ports=class_has_host_ports,
+        gang_of_pod=gang_of_pod,
+        gang_keys=gang_keys or None,
+        gang_bonus=gang_bonus,
     )
     if reuse is not None:
         # the cached req vectors are only valid against the same resource-dim

@@ -46,6 +46,14 @@ class MakePod:
         self._pod.metadata.labels.update(labels)
         return self
 
+    def gang(self, group_name: str) -> "MakePod":
+        """Join the PodGroup `group_name` (in the pod's namespace) via the
+        pod-group.scheduling/name label convention (api/podgroup.py)."""
+        from .api.podgroup import POD_GROUP_LABEL
+
+        self._pod.metadata.labels[POD_GROUP_LABEL] = group_name
+        return self
+
     def req(self, requests: Dict[str, str], image: str = "", host_port: int = 0) -> "MakePod":
         """Add a container with the given resource requests."""
         c = Container(
@@ -198,6 +206,14 @@ class MakeNode:
         self._node.metadata.labels.update(labels)
         return self
 
+    def tpu_slice(self, slice_id) -> "MakeNode":
+        """Advertise the node's TPU slice (ICI domain) — api/podgroup.py
+        LABEL_TPU_SLICE, consumed by the gang slice-packing score."""
+        from .api.podgroup import LABEL_TPU_SLICE
+
+        self._node.metadata.labels[LABEL_TPU_SLICE] = str(slice_id)
+        return self
+
     def capacity(self, cap: Dict[str, str]) -> "MakeNode":
         cap = dict(cap)
         cap.setdefault("pods", "110")
@@ -223,3 +239,13 @@ class MakeNode:
 
     def obj(self) -> Node:
         return self._node
+
+
+def make_pod_group(name: str, min_member: int, namespace: str = "default"):
+    """PodGroup builder (api/podgroup.py) for tests and benches."""
+    from .api.podgroup import PodGroup, PodGroupSpec
+
+    return PodGroup(
+        metadata=ObjectMeta(name=name, namespace=namespace, uid=new_uid()),
+        spec=PodGroupSpec(min_member=min_member),
+    )

@@ -33,3 +33,10 @@ def test_bench_quick_runs_and_emits_json():
     assert ns["pods_per_sec"] > 0
     basic = workloads.get("SchedulingBasic", {})
     assert "error" not in basic, basic
+    # the gang rung (ISSUE 2): every member of every gang binds, all-or-
+    # nothing never fires on the happy path
+    gang = workloads["GangScheduling_2k_250"]
+    assert "error" not in gang, gang
+    assert gang["placed"] == gang["pods"] > 0
+    assert gang["gangs"] == 8
+    assert gang["pods_per_sec"] > 0

@@ -1,0 +1,493 @@
+"""Gang scheduling: all-or-nothing PodGroup placement (ISSUE 2 acceptance).
+
+The invariant under test everywhere: NO pod of an unplaceable gang is ever
+bound — not under insufficient capacity, not when the device solver rejects a
+subset, not under preemption pressure — and a gang that loses a member at
+assume time releases every already-assumed sibling through the normal Cache
+accounting. Placed gangs land slice-packed when a TPU slice has room.
+"""
+
+import numpy as np
+
+from kubernetes_tpu.api.podgroup import (
+    POD_GROUP_LABEL,
+    PodGroup,
+    pod_group_key,
+)
+from kubernetes_tpu.scheduler import Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.gang import GangDirectory, gang_veto_mask
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.scheduler.queue import SchedulingQueue
+from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod, make_pod_group
+from kubernetes_tpu.utils import FakeClock
+
+
+def _nodes(n, cpu="8", mem="32Gi", slices=0):
+    out = []
+    for i in range(n):
+        mk = MakeNode(f"node-{i}").capacity(
+            {"cpu": cpu, "memory": mem, "pods": "110"})
+        if slices:
+            mk = mk.tpu_slice(i % slices)
+        out.append(mk.obj())
+    return out
+
+
+def _gang_pods(n, group, cpu="2", mem="2Gi", prefix="g"):
+    return [MakePod(f"{prefix}-{i}").gang(group)
+            .req({"cpu": cpu, "memory": mem}).obj() for i in range(n)]
+
+
+def _sched(store, clock=None, solver="fast", **kw):
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=1024, solver=solver,
+                           pipeline_binds=False, clock=clock, **kw)
+    sched.sync()
+    return sched
+
+
+def _used_tensors(sched):
+    cl = build_cluster_tensors(sched.cache.update_snapshot())
+    return cl.used.copy(), cl.used_nz.copy(), cl.pod_count.copy()
+
+
+def _bound(store, prefix):
+    return sorted(p.metadata.name for p in store.list("pods")[0]
+                  if p.metadata.name.startswith(prefix) and p.spec.node_name)
+
+
+# -- API surface ---------------------------------------------------------------
+
+
+def test_podgroup_roundtrips_and_is_watchable():
+    store = APIStore()
+    w = store.watch(kind=("podgroups",))
+    pg = make_pod_group("train", 16)
+    store.create("podgroups", pg)
+    got = store.get("podgroups", "default/train")
+    assert got.spec.min_member == 16
+    (ev,) = w.drain()
+    assert ev.kind == "podgroups" and ev.obj.spec.min_member == 16
+    # wire round-trip
+    again = PodGroup.from_dict(got.to_dict())
+    assert again.spec.min_member == 16 and again.key == "default/train"
+    from kubernetes_tpu.api.serialize import from_dict, to_dict
+
+    assert to_dict(from_dict("podgroups", to_dict(got))) == to_dict(got)
+
+
+def test_pod_group_key_label_convention():
+    p = MakePod("r0", namespace="ml").gang("train").obj()
+    assert p.metadata.labels[POD_GROUP_LABEL] == "train"
+    assert pod_group_key(p) == "ml/train"
+    assert pod_group_key(MakePod("plain").obj()) == ""
+
+
+# -- queue staging -------------------------------------------------------------
+
+
+def test_gang_stages_until_quorum_then_admits_contiguously():
+    clock = FakeClock()
+    gangs = GangDirectory()
+    q = SchedulingQueue(clock=clock)
+    q.set_gang_hooks(gangs.group_of, gangs.quorum_ready,
+                     lambda: gangs.active)
+    gangs.observe_podgroup("ADDED", make_pod_group("t", 3))
+    members = _gang_pods(3, "t")
+    filler = [MakePod(f"f-{i}").obj() for i in range(4)]
+    # interleave: member, fillers, member, member — quorum lands on the last
+    q.add(members[0])
+    q.add_batch(filler[:2])
+    q.add(members[1])
+    q.add_batch(filler[2:])
+    assert q.lengths()[0] == 4 and q.gang_staged_count() == 2
+    q.add(members[2])
+    assert q.gang_staged_count() == 0
+    order = [qp.pod.metadata.name for qp in q.pop_batch(100, timeout=0.0)]
+    gi = [order.index(m.metadata.name) for m in members]
+    # admitted contiguously: the three members pop back to back
+    assert max(gi) - min(gi) == 2
+
+
+def test_gang_waits_for_podgroup_object_then_reconsider_admits():
+    clock = FakeClock()
+    gangs = GangDirectory()
+    # directory starts inactive: labeled pods schedule as ordinary pods
+    q = SchedulingQueue(clock=clock)
+    q.set_gang_hooks(gangs.group_of, gangs.quorum_ready,
+                     lambda: gangs.active)
+    q.add_batch(_gang_pods(2, "late"))
+    assert q.lengths()[0] == 2  # no PodGroup anywhere -> not gang-gated
+    # now a DIFFERENT group exists -> directory active -> members of "late"
+    # stage (their own quorum is unknown: PodGroup not created yet)
+    gangs.observe_podgroup("ADDED", make_pod_group("other", 2))
+    q.add_batch(_gang_pods(2, "late", prefix="l2"))
+    assert q.gang_staged_count() == 2
+    gangs.observe_podgroup("ADDED", make_pod_group("late", 2))
+    q.reconsider_gangs()
+    assert q.gang_staged_count() == 0
+    assert q.lengths()[0] == 4
+
+
+def test_gang_delete_and_tracked_keys_cover_staging():
+    gangs = GangDirectory()
+    gangs.observe_podgroup("ADDED", make_pod_group("t", 5))
+    q = SchedulingQueue(clock=FakeClock())
+    q.set_gang_hooks(gangs.group_of, gangs.quorum_ready,
+                     lambda: gangs.active)
+    members = _gang_pods(3, "t")
+    q.add_batch(members)
+    assert set(q.tracked_keys()) == {m.key for m in members}
+    q.delete(members[1])
+    assert set(q.tracked_keys()) == {members[0].key, members[2].key}
+    assert q.lengths() == (0, 0, 2)  # staged counts as unschedulable
+
+
+# -- all-or-nothing: the veto math --------------------------------------------
+
+
+def test_gang_veto_mask_vectorized():
+    assignment = np.array([0, 1, -1, 2, 3, -1, 5])
+    gang_rows = np.array([0, 0, 0, 1, 1, -1, -1])
+    need = np.array([3, 2])
+    veto, satisfied = gang_veto_mask(assignment, gang_rows, need)
+    # gang 0 placed 2 < 3 -> all three rows vetoed; gang 1 placed 2 >= 2 ok
+    assert veto.tolist() == [True, True, True, False, False, False, False]
+    assert satisfied.tolist() == [False, True]
+    # already-placed members reduce need: same placements, need met
+    veto2, sat2 = gang_veto_mask(assignment, gang_rows, np.array([2, 2]))
+    assert not veto2.any() and sat2.all()
+
+
+# -- acceptance (a): insufficient capacity ------------------------------------
+
+
+def test_insufficient_capacity_binds_no_member():
+    store = APIStore()
+    for n in _nodes(2, cpu="4", mem="8Gi"):
+        store.create("nodes", n)
+    sched = _sched(store)
+    store.create("podgroups", make_pod_group("big", 6))
+    # 6 x 2cpu = 12 > 8 available: the gang can never fully place
+    store.create_many("pods", _gang_pods(6, "big"))
+    pre = _used_tensors(sched)
+    sched.run_until_idle()
+    sched.pump_events()
+    assert _bound(store, "g-") == []
+    assert sched.gang_vetoes >= 1
+    assert not sched.cache._assumed  # nothing leaked
+    assert sched.take_bind_failures() == []
+    for a, b in zip(pre, _used_tensors(sched)):
+        assert np.array_equal(a, b)
+    # the gang is waiting in backoff as a unit, not lost
+    assert sched.queue.lengths()[1] == 6
+
+
+def test_exact_solver_enforces_the_same_veto():
+    store = APIStore()
+    for n in _nodes(2, cpu="4", mem="8Gi"):
+        store.create("nodes", n)
+    sched = _sched(store, solver="exact")
+    store.create("podgroups", make_pod_group("big", 6))
+    store.create_many("pods", _gang_pods(6, "big"))
+    sched.run_until_idle()
+    sched.pump_events()
+    assert _bound(store, "g-") == []
+    assert not sched.cache._assumed
+
+
+# -- acceptance (b): device rejects -------------------------------------------
+
+
+def test_partial_device_reject_vetoes_whole_gang_but_not_neighbors():
+    store = APIStore()
+    # room for exactly 4 gang-sized pods + the two small neighbors
+    for n in _nodes(2, cpu="5", mem="16Gi"):
+        store.create("nodes", n)
+    sched = _sched(store)
+    store.create("podgroups", make_pod_group("big", 6))
+    store.create_many("pods", _gang_pods(6, "big"))  # 4 of 6 would fit
+    store.create_many("pods", [MakePod(f"x-{i}").req({"cpu": "500m"}).obj()
+                               for i in range(2)])
+    sched.run_until_idle()
+    sched.pump_events()
+    assert _bound(store, "g-") == []  # no partial gang
+    assert _bound(store, "x-") == ["x-0", "x-1"]  # neighbors unaffected
+    assert not sched.cache._assumed
+
+
+def test_satisfied_gang_extras_fail_individually_without_preemption():
+    store = APIStore()
+    for n in _nodes(2, cpu="4", mem="8Gi"):
+        store.create("nodes", n)
+    sched = _sched(store)
+    # min_member 4 of 6: quorum met with 4 placements, 2 extras fail alone
+    store.create("podgroups", make_pod_group("big", 4))
+    store.create_many("pods", _gang_pods(6, "big"))
+    sched.run_until_idle()
+    sched.pump_events()
+    assert len(_bound(store, "g-")) == 4
+    assert sched.preemption_count == 0
+    assert sched.gang_vetoes == 0
+
+
+# -- acceptance (c): preemption pressure --------------------------------------
+
+
+def test_preemption_never_evicts_victims_for_a_partial_gang():
+    store = APIStore()
+    for n in _nodes(4, cpu="4", mem="8Gi"):
+        store.create("nodes", n)
+    # fill every node with preemptible low-priority pods
+    for i in range(4):
+        low = MakePod(f"low-{i}").priority(1).req({"cpu": "3"}).obj()
+        low.spec.node_name = f"node-{i}"
+        store.create("pods", low)
+    sched = _sched(store)
+    store.create("podgroups", make_pod_group("big", 8))
+    # even evicting EVERY victim frees 4x4=16 cpu; the gang needs 8x3=24:
+    # placing a part of it via preemption would strand victims for nothing
+    pods = _gang_pods(8, "big", cpu="3")
+    for p in pods:
+        p.spec.priority = 100
+    store.create_many("pods", pods)
+    sched.run_until_idle()
+    sched.pump_events()
+    assert _bound(store, "g-") == []
+    assert sched.preemption_count == 0  # no victim ever selected
+    assert len(store.list("pods")[0]) == 12  # no victim deleted
+    assert all(not p.status.nominated_node_name
+               for p in store.list("pods")[0])
+
+
+# -- rollback: a gang that loses a member at assume releases the rest ---------
+
+
+def test_assume_failure_releases_every_assumed_member():
+    store = APIStore()
+    for n in _nodes(4, cpu="8", mem="16Gi"):
+        store.create("nodes", n)
+    sched = _sched(store)
+    store.create("podgroups", make_pod_group("big", 4))
+    members = _gang_pods(4, "big")
+    store.create_many("pods", members)
+    # collide one member's cache entry so ITS assume fails while the
+    # siblings' assumes succeed — the rollback must release them all
+    from kubernetes_tpu.store import pod_structural_clone
+
+    ghost = pod_structural_clone(members[0])
+    sched.pump_events()
+    sched.cache.assume_pod(ghost, "node-0")
+    pre = _used_tensors(sched)  # ghost included: the post-rollback target
+    sched.run_until_idle()
+    sched.pump_events()
+    assert _bound(store, "g-") == []
+    assert sched.take_bind_failures() == []
+    # every sibling's assume was rolled back: node deltas at pre-solve values
+    for a, b in zip(pre, _used_tensors(sched)):
+        assert np.array_equal(a, b)
+    # only the ghost remains assumed
+    assert set(sched.cache._assumed) == {"default/g-0"}
+
+
+# -- gang-aware requeue: the unit re-enters together --------------------------
+
+
+def test_vetoed_gang_requeues_as_unit_with_backoff():
+    clock = FakeClock()
+    store = APIStore()
+    for n in _nodes(2, cpu="4", mem="8Gi"):
+        store.create("nodes", n)
+    sched = _sched(store, clock=clock)
+    store.create("podgroups", make_pod_group("big", 6))
+    store.create_many("pods", _gang_pods(6, "big"))
+    sched.run_until_idle()
+    sched.pump_events()
+    active, backoff, unsched = sched.queue.lengths()
+    assert (active, backoff, unsched) == (0, 6, 0)  # whole gang in backoff
+    # backoff expiry: the unit re-stages and re-admits together
+    clock.step(2.0)
+    sched.queue.flush_backoff_completed()
+    assert sched.queue.lengths()[0] == 6
+    assert sched.queue.gang_staged_count() == 0
+    # re-solve vetoes again, bumping attempts -> longer backoff next round
+    assert sched.schedule_batch(timeout=0.0) == 6
+    assert sched.gang_vetoes >= 2
+
+
+def test_gang_becomes_schedulable_when_capacity_arrives():
+    clock = FakeClock()
+    store = APIStore()
+    for n in _nodes(2, cpu="4", mem="8Gi"):
+        store.create("nodes", n)
+    sched = _sched(store, clock=clock)
+    store.create("podgroups", make_pod_group("big", 6))
+    store.create_many("pods", _gang_pods(6, "big"))
+    sched.run_until_idle()
+    sched.pump_events()
+    assert _bound(store, "g-") == []
+    # capacity arrives: two more nodes
+    for n in _nodes(2, cpu="8", mem="8Gi"):
+        n.metadata.name += "-new"
+        n.metadata.labels["kubernetes.io/hostname"] = n.metadata.name
+        store.create("nodes", n)
+    clock.step(3.0)
+    sched.pump_events()
+    sched.queue.flush_backoff_completed()
+    sched.run_until_idle()
+    sched.pump_events()
+    assert len(_bound(store, "g-")) == 6
+
+
+# -- slice packing -------------------------------------------------------------
+
+
+def test_placed_gang_lands_on_one_slice_when_a_slice_has_room():
+    store = APIStore()
+    # slice 0: 4 nodes that exactly fit the gang; slice 1: 4 EMPTIER nodes
+    # (higher least-allocated scores) that would win without the bonus
+    for i in range(4):
+        store.create("nodes", MakeNode(f"s0-{i}").tpu_slice(0)
+                     .capacity({"cpu": "4", "memory": "8Gi"}).obj())
+    for i in range(4):
+        store.create("nodes", MakeNode(f"s1-{i}").tpu_slice(1)
+                     .capacity({"cpu": "16", "memory": "64Gi"}).obj())
+    sched = _sched(store)
+    store.create("podgroups", make_pod_group("train", 8))
+    store.create_many("pods", _gang_pods(8, "train", cpu="2", mem="2Gi"))
+    sched.run_until_idle()
+    sched.pump_events()
+    placements = {p.metadata.name: p.spec.node_name
+                  for p in store.list("pods")[0]
+                  if p.metadata.name.startswith("g-")}
+    assert all(placements.values())
+    slices = {v.split("-")[0] for v in placements.values()}
+    # best-fit packing: the exactly-fitting slice 0 wins over the roomier one
+    assert slices == {"s0"}
+
+
+def test_two_gangs_pack_onto_their_own_slices():
+    store = APIStore()
+    for s in range(2):
+        for i in range(4):
+            store.create("nodes", MakeNode(f"s{s}-{i}").tpu_slice(s)
+                         .capacity({"cpu": "8", "memory": "16Gi"}).obj())
+    sched = _sched(store)
+    store.create("podgroups", make_pod_group("a", 8))
+    store.create("podgroups", make_pod_group("b", 8))
+    pods = (_gang_pods(8, "a", cpu="2", mem="2Gi", prefix="a")
+            + _gang_pods(8, "b", cpu="2", mem="2Gi", prefix="b"))
+    store.create_many("pods", pods)
+    sched.run_until_idle()
+    sched.pump_events()
+    for prefix in ("a", "b"):
+        got = {p.spec.node_name.split("-")[0]
+               for p in store.list("pods")[0]
+               if p.metadata.name.startswith(f"{prefix}-")}
+        assert len(got) == 1, f"gang {prefix} scattered: {got}"
+
+
+# -- pay-for-what-you-use ------------------------------------------------------
+
+
+def test_no_podgroups_means_no_gang_rows_anywhere():
+    store = APIStore()
+    for n in _nodes(4):
+        store.create("nodes", n)
+    sched = _sched(store)
+    # gang-labeled pods WITHOUT any PodGroup: ordinary pods end to end
+    store.create_many("pods", _gang_pods(5, "nobody"))
+    sched.pump_events()
+    qps = sched.queue.pop_batch(100, timeout=0.0)
+    assert len(qps) == 5  # never staged
+    snap = sched.cache.update_snapshot()
+    cluster, changed = sched._tensor_cache.cluster_tensors(snap)
+    from kubernetes_tpu.snapshot.tensorizer import build_pod_batch
+
+    batch = build_pod_batch([qp.pod for qp in qps], snap, cluster,
+                            gangs=sched.gangs)
+    assert batch.gang_of_pod is None
+    assert batch.gang_bonus is None
+    for qp in qps:
+        sched.queue.add(qp.pod)
+    sched.run_until_idle()
+    sched.pump_events()
+    assert len(_bound(store, "g-")) == 5
+
+
+def test_orphaned_staged_members_release_after_timeout():
+    """PodGroup deleted while members wait in staging: the 30s staleness
+    sweep releases them as ORDINARY pods — never stranded forever."""
+    clock = FakeClock()
+    store = APIStore()
+    for n in _nodes(4):
+        store.create("nodes", n)
+    sched = _sched(store, clock=clock)
+    store.create("podgroups", make_pod_group("doomed", 3))
+    store.create("podgroups", make_pod_group("other", 2))  # keeps gangs active
+    store.create_many("pods", _gang_pods(2, "doomed"))  # below quorum: staged
+    sched.pump_events()
+    assert sched.queue.gang_staged_count() == 2
+    store.delete("podgroups", "default/doomed")
+    sched.pump_events()
+    # still staged (reconsider can't tell "deleted" from "not created yet")
+    assert sched.queue.gang_staged_count() == 2
+    clock.step(31.0)
+    sched.queue.flush_unschedulable_left_over()
+    assert sched.queue.gang_staged_count() == 0
+    sched.run_until_idle()
+    sched.pump_events()
+    assert len(_bound(store, "g-")) == 2  # scheduled individually
+    # a group with a LIVE PodGroup below quorum keeps waiting past 30s
+    store.create_many("pods", _gang_pods(1, "other", prefix="o"))
+    sched.pump_events()
+    clock.step(31.0)
+    sched.queue.flush_unschedulable_left_over()
+    assert sched.queue.gang_staged_count() == 1
+
+
+def test_min_member_beyond_batch_size_parks_with_diagnostic():
+    """A gang one solve can never see whole must not livelock through
+    backoff: it parks unschedulable with an actionable message."""
+    store = APIStore()
+    for n in _nodes(8):
+        store.create("nodes", n)
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=4, solver="fast",
+                           pipeline_binds=False)
+    sched.sync()
+    store.create("podgroups", make_pod_group("wide", 6))
+    store.create_many("pods", _gang_pods(6, "wide", cpu="500m", mem="512Mi"))
+    sched.run_until_idle()
+    sched.pump_events()
+    assert _bound(store, "g-") == []
+    # parked unschedulable (event-gated), NOT spinning in timed backoff
+    active, backoff, unsched = sched.queue.lengths()
+    assert backoff == 0 and unsched == 6
+    msgs = [c.message for p in store.list("pods")[0]
+            for c in p.status.conditions if c.type == "PodScheduled"]
+    assert any("batch size" in m for m in msgs)
+
+
+def test_bound_members_count_toward_quorum():
+    """A straggler (e.g. after a bind failure) re-admits alone because its
+    bound siblings satisfy the quorum."""
+    store = APIStore()
+    for n in _nodes(4, cpu="8", mem="16Gi"):
+        store.create("nodes", n)
+    # 3 members already bound (by a previous life of the scheduler)
+    for i in range(3):
+        p = MakePod(f"g-{i}").gang("train").req({"cpu": "2"}).obj()
+        p.spec.node_name = f"node-{i}"
+        store.create("pods", p)
+    store.create("podgroups", make_pod_group("train", 4))
+    sched = _sched(store)
+    assert sched.gangs.placed_count("default/train") == 3
+    straggler = MakePod("g-3").gang("train").req({"cpu": "2"}).obj()
+    store.create("pods", straggler)
+    sched.run_until_idle()
+    sched.pump_events()
+    assert len(_bound(store, "g-")) == 4
