@@ -488,8 +488,12 @@ def rung_north_star_endtoend(results):
         warm_store = APIStore()
         for n in _nodes(n_nodes, cpu="16", mem="64Gi"):
             warm_store.create("nodes", n)
+        # warm-up runs with the flight recorder DISABLED — exercising the
+        # recorder-off hot path every bench run (parity with recorder-on is
+        # pinned by tests/test_flightrec.py)
         warm = BatchScheduler(warm_store, Framework(default_plugins()),
-                              batch_size=n_pods, solver="fast")
+                              batch_size=n_pods, solver="fast",
+                              flight_recorder=False)
         warm.sync()
         warm_store.create_many(
             "pods", (MakePod(f"w-{i}").req(
@@ -515,6 +519,7 @@ def rung_north_star_endtoend(results):
         gc.collect()
         gc.freeze()
         gc.disable()
+        sched.flightrec.clear()  # stage table covers EXACTLY the timed window
         t0 = time.perf_counter()
         sched.run_until_idle()
         dt = time.perf_counter() - t0
@@ -522,13 +527,30 @@ def rung_north_star_endtoend(results):
         gc.unfreeze()
         bound = sched.scheduled_count
         pps = bound / dt
+        # machine-generated stage breakdown (scheduler/flightrec.py): the
+        # source of ROADMAP's stage table. Serial rows sum to ~wall; "bind"
+        # is the worker's wall, overlapped with the solve. instrumentation_s
+        # is the recorder's measured self-time (record building, histogram
+        # observation, timing taps) — the only unmeasured cost is the ~10
+        # StageClock perf_counter reads per batch. Divided by wall it bounds
+        # the overhead budget without differencing two noisy runs.
+        table = sched.flightrec.stage_table()
+        stages = {k: round(v["total_ms"] / 1000, 4) for k, v in table.items()}
+        serial_sum = round(sum(v["total_ms"] for v in table.values()
+                               if not v["overlapped"]) / 1000, 4)
         results["NorthStar_100k_10k_endtoend"] = {
             "pods_per_sec": round(pps, 1), "wall_s": round(dt, 3),
             "vs_target": round(pps / NORTH_STAR, 2),
-            "placed": bound, "pods": n_pods, "solver": "fast+store-binds"}
+            "placed": bound, "pods": n_pods, "solver": "fast+store-binds",
+            "stages": stages,
+            "stages_serial_sum_s": serial_sum,
+            "instrumentation_s": round(sched.flightrec.self_seconds, 6)}
         print(f"{'NorthStar_100k_10k_endtoend':>28}: {pps:>9.0f} pods/s  "
               f"({bound}/{n_pods} BOUND through the store in {dt:.3f}s)",
               file=sys.stderr)
+        print("    stages: " + "  ".join(
+            f"{k}={v:.3f}s" for k, v in sorted(
+                stages.items(), key=lambda kv: -kv[1])), file=sys.stderr)
     except Exception as e:
         results["NorthStar_100k_10k_endtoend"] = {"error": str(e)[:200]}
         print(f"NorthStar_100k_10k_endtoend: ERROR {e}", file=sys.stderr)

@@ -5,8 +5,8 @@ Talks HTTP to the API server (KTL_SERVER env or --server).
 
 Commands: get, describe, create -f, apply -f (server-side merge patch),
 delete, scale, cordon, uncordon, taint, drain, label, annotate, patch,
-rollout status|restart, set image, top nodes|pods, wait, autoscale,
-api-resources, version.
+rollout status|restart, set image, top nodes|pods, sched stats, wait,
+autoscale, api-resources, version.
 """
 
 from __future__ import annotations
@@ -1280,6 +1280,86 @@ def cmd_top(client: RESTClient, args) -> int:
     return 0
 
 
+def _render_sched_stats(doc: Dict) -> str:
+    """The live stage table of every registered batch scheduler: counters
+    header + a per-stage TOTAL/MEAN/BATCHES table (the flight recorder's
+    aggregate view; overlapped stages — the bind worker — are marked so the
+    serial rows still explain wall time)."""
+    if not doc:
+        return ("no batch scheduler registered in the server process "
+                "(is the control plane running in-process?)")
+    out = []
+    for name, st in sorted(doc.items()):
+        if "error" in st and len(st) == 1:
+            out.append(f"{name}: error: {st['error']}")
+            continue
+        q = st.get("queue") or {}
+        rec = st.get("recorder") or {}
+        out.append(
+            f"{name}  solver={st.get('solver')} "
+            f"batches={st.get('batches_solved', 0)} "
+            f"scheduled={st.get('scheduled', 0)} "
+            f"failed={st.get('failed', 0)} "
+            f"preemptions={st.get('preemptions', 0)}")
+        out.append(
+            f"queue: active={q.get('active', 0)} "
+            f"backoff={q.get('backoff', 0)} "
+            f"unschedulable={q.get('unschedulable', 0)}   "
+            f"recorder: {'on' if rec.get('enabled') else 'off'} "
+            f"{rec.get('records', 0)}/{rec.get('capacity', 0)} batches")
+        gang = st.get("gang")
+        if gang:
+            out.append(
+                f"gang: staged={gang.get('staged', 0)} "
+                f"vetoes={gang.get('vetoes', 0)} "
+                f"quorum_expired_assumes="
+                f"{gang.get('quorum_expired_assumes', 0)}")
+        stages = st.get("stages") or {}
+        if stages:
+            last = (st.get("last_batch") or {}).get("stages") or {}
+            rows = []
+            for stage, row in stages.items():
+                mean = row.get("mean_ms")
+                rows.append([
+                    stage + (" *" if row.get("overlapped") else ""),
+                    f"{row.get('total_ms', 0):.1f}",
+                    f"{mean:.2f}" if mean is not None else "-",
+                    f"{last[stage]:.2f}" if stage in last else "-",
+                    str(row.get("batches", 0)),
+                ])
+            out.append(fmt_table(
+                ["STAGE", "TOTAL(ms)", "MEAN(ms)", "LAST(ms)", "BATCHES"],
+                rows))
+            out.append("(* overlapped with the scheduling thread)")
+        else:
+            out.append("no batches recorded yet")
+        out.append("")
+    return "\n".join(out).rstrip()
+
+
+def cmd_sched(client: RESTClient, args) -> int:
+    """ktl sched stats [--watch] — the batched solver's flight-recorder view
+    served from /debug/schedstats (the kubectl-less sibling of `kubectl get
+    --raw /debug/...`)."""
+    import time as _time
+
+    if args.action != "stats":
+        raise CLIError(f"unknown sched action {args.action!r}")
+    while True:
+        doc = client.request("GET", "/debug/schedstats")
+        if args.output == "json":
+            print(json.dumps(doc, indent=2))
+        else:
+            if args.watch:
+                # ANSI clear+home, like `watch`: live-updating stage table
+                sys.stdout.write("\x1b[2J\x1b[H")
+            print(_render_sched_stats(doc))
+        if not args.watch:
+            return 0
+        sys.stdout.flush()
+        _time.sleep(args.interval)
+
+
 def cmd_wait(client: RESTClient, args) -> int:
     """kubectl wait --for=condition=X|delete (kubectl/pkg/cmd/wait)."""
     import time
@@ -1519,6 +1599,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p = sub.add_parser("top")
     p.add_argument("what", choices=["nodes", "node", "no", "pods", "pod", "po"])
     p.set_defaults(fn=cmd_top)
+
+    p = sub.add_parser("sched")
+    p.add_argument("action", choices=["stats"])
+    p.add_argument("-o", "--output", default="table",
+                   choices=["table", "json"])
+    p.add_argument("-w", "--watch", action="store_true")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.set_defaults(fn=cmd_sched)
 
     p = sub.add_parser("wait")
     p.add_argument("target")  # [resource/]name

@@ -11,6 +11,7 @@ from .framework import (  # noqa: F401
     Snapshot,
     Status,
 )
+from .flightrec import FlightRecorder, StageClock  # noqa: F401
 from .gang import GangDirectory  # noqa: F401
 from .queue import QueuedPodInfo, SchedulingQueue  # noqa: F401
 from .runtime import DEFAULT_WEIGHTS, Framework  # noqa: F401

@@ -14,12 +14,14 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..snapshot.tensorizer import TensorCache, build_cluster_tensors, build_pod_batch
 from ..store import APIStore, pod_bind_clone, pod_structural_clone
+from .flightrec import FlightRecorder, StageClock, register_scheduler
 from .framework import Status
 from .queue import QueuedPodInfo
 from .runtime import Framework
@@ -35,11 +37,26 @@ class BatchScheduler(Scheduler):
 
     def __init__(self, store: APIStore, framework: Framework, batch_size: int = 4096,
                  solver: str = "exact", pipeline_binds: bool = True,
-                 columnar: bool = True, **kw):
+                 columnar: bool = True, flight_recorder: bool = True,
+                 flight_capacity: int = FlightRecorder.DEFAULT_CAPACITY, **kw):
         super().__init__(store, framework, **kw)
         self.batch_size = batch_size
         self.solver = solver
         self.batches_solved = 0
+        # flight recorder (scheduler/flightrec.py): per-batch stage timing +
+        # bounded trace ring, surfaced via /debug/schedstats and `ktl sched
+        # stats`. Stage marks are per BATCH (a handful of perf_counter reads),
+        # so enabled-vs-disabled placement parity and the <2% overhead budget
+        # both hold (tests/test_flightrec.py, tests/test_bench_quick.py).
+        self.flightrec = FlightRecorder(capacity=flight_capacity,
+                                        enabled=flight_recorder)
+        self.queue.stat_sink = self.flightrec
+        register_scheduler(self._bind_origin, self)
+        # per-batch unschedulable-reason attribution (set during
+        # schedule_batch; _handle_failure taps Status.plugin into it)
+        self._batch_reasons: Optional[Dict[str, int]] = None
+        self.preempt_victims_total = 0  # victims chosen by _batch_preempt
+        self.trace_threshold = 1.0  # ScheduleBatch Trace log threshold (s)
         self.transport_state = None  # warm duals carried across batches
         # generation-diff incremental tensorization (cache.go:186 analog)
         self._tensor_cache = TensorCache()
@@ -76,30 +93,99 @@ class BatchScheduler(Scheduler):
         self.gang_vetoes = 0  # gangs stripped post-solve (observability)
 
     def schedule_batch(self, timeout: Optional[float] = 0.0) -> int:
-        """Drain up to batch_size pods, solve jointly, bind. Returns #pods handled."""
-        import time
+        """Drain up to batch_size pods, solve jointly, bind. Returns #pods handled.
 
+        Instrumented per BATCH (never per pod): a StageClock marks each
+        pipeline stage boundary, the marks feed the scheduler_batch_stage
+        histograms + a utiltrace-style Trace (logged past trace_threshold),
+        and one flight-recorder record captures the batch's outcome, counts,
+        and unschedulable-reason attribution. batch_solve_duration is
+        observed in a try/finally with an outcome label
+        (scheduled/unschedulable/error — mirroring scheduling_attempts) on
+        EVERY path that pops a batch, including the no-nodes early return
+        and errors. An empty pop schedules nothing and observes nothing by
+        design (its pump cost folds into the aggregate outside buckets)."""
         from ..ops.solver import greedy_scan_solve, make_inputs
         from ..server import metrics as m
+        from ..utils.tracing import Trace
 
+        fr = self.flightrec
+        clock = StageClock()
+        # queue_add/confirm accrue into the recorder's outside buckets at
+        # their own call sites (inside this pump); difference them out so the
+        # "ingest" residual stays disjoint from its sub-stages
+        sub0 = fr.outside_seconds("queue_add", "confirm")
         # pump until the watch drains — bounded: a 100k-pod backlog must
         # reach the queue as ONE batch (not batch_size/10k sub-solves), but
         # sustained event arrival must not starve scheduling forever
         for _ in range(8):
             if self.pump_events(max_events=self.batch_size) < self.batch_size:
                 break
+        clock.mark("ingest")
+        clock.sub("ingest", fr.outside_seconds("queue_add", "confirm") - sub0)
         qps = self.queue.pop_batch(self.batch_size, timeout=timeout)
+        clock.mark("pop")
         if not qps:
+            # no batch to pin these marks to: fold idle pump/poll time into
+            # the aggregate buckets (confirm-heavy idle cycles still show)
+            for name, sec in clock.stages.items():
+                fr.add_outside(name, sec)
             return 0
-        t_batch = time.perf_counter()
         m.batch_size_gauge.set(len(qps))
+        trace = Trace("ScheduleBatch", pods=len(qps))
+        failed0 = self.failed_count
+        victims0 = self.preempt_victims_total
+        self._batch_reasons = reasons = {}
+        outcome = "error"  # overwritten unless the body raises
+        out: Dict = {}
+        try:
+            self._schedule_batch_inner(qps, clock, trace, m,
+                                       greedy_scan_solve, make_inputs, out)
+            outcome = ("scheduled"
+                       if out.get("dispatched", 0)
+                       + out.get("serial_scheduled", 0) > 0
+                       else "unschedulable")
+            return len(qps)
+        finally:
+            self._batch_reasons = None
+            self.batches_solved += 1
+            t_fin = time.perf_counter()
+            total = clock.total()
+            for name, sec in clock.stages.items():
+                m.batch_stage_duration.observe(sec, name)
+            m.batch_solve_duration.observe(total, outcome)
+            if self.gangs is not None and self.gangs.active:
+                m.gang_staged.set(self.queue.gang_staged_count())
+            fr.record(
+                pods=len(qps), nodes=out.get("nodes", 0), outcome=outcome,
+                solver=self.solver, stages=clock.stages, total_s=total,
+                scheduled=out.get("dispatched", 0)
+                + out.get("serial_scheduled", 0),
+                unschedulable=self.failed_count - failed0,
+                fallback=out.get("fallback", 0),
+                preempted=self.preempt_victims_total - victims0,
+                reasons=reasons, gang=out.get("gang"),
+                solver_iterations=getattr(self.transport_state,
+                                          "iterations", None))
+            trace.log_if_long(self.trace_threshold)
+            fr.note_self_time(time.perf_counter() - t_fin)
+
+    def _schedule_batch_inner(self, qps, clock, trace, m,
+                              greedy_scan_solve, make_inputs, out) -> None:
+        """The batch pipeline body (schedule_batch owns the try/finally
+        bookkeeping around it). Fills `out` with nodes/dispatched/fallback/
+        gang counts for the flight record."""
         snapshot = self.cache.update_snapshot()
+        out["nodes"] = len(snapshot)
         if len(snapshot) == 0:
+            clock.mark("tensorize")
             for qp in qps:
                 self._handle_failure(qp, Status.unschedulable("no nodes available to schedule pods"))
-            return len(qps)
+            return
 
         cluster, changed_nodes = self._tensor_cache.cluster_tensors(snapshot)
+        clock.mark("tensorize")
+        trace.step("Tensorized cluster", nodes=len(snapshot))
         pods = [qp.pod for qp in qps]
         batch = build_pod_batch(
             pods, snapshot, cluster, ns_labels=self._ns_labels,
@@ -110,6 +196,10 @@ class BatchScheduler(Scheduler):
         fallback_mask = batch.fallback_class[batch.class_of_pod]
         device_idx = np.nonzero(~fallback_mask)[0]
         fallback_idx = np.nonzero(fallback_mask)[0]
+        out["fallback"] = int(fallback_idx.size)
+        clock.mark("build_pod_batch")
+        trace.step("Built pod batch", device=int(device_idx.size),
+                   fallback=int(fallback_idx.size))
 
         if device_idx.size:
             sub = _subset_batch(batch, device_idx)
@@ -172,9 +262,14 @@ class BatchScheduler(Scheduler):
             gang_requeue: Dict[int, List[QueuedPodInfo]] = {}
             hopeless: set = set()
             veto = None
+            gang_info: Optional[Dict[str, int]] = None
             if has_gang:
                 from .gang import gang_veto_mask
 
+                gang_info = out["gang"] = {
+                    "staged": self.queue.gang_staged_count(),
+                    "vetoed": 0, "assume_vetoed": 0, "released": 0,
+                    "hopeless": 0}
                 gkeys = batch.gang_keys
                 need = np.array(
                     [max(0, (self.gangs.min_member(k) or 0)
@@ -187,9 +282,13 @@ class BatchScheduler(Scheduler):
                 # diagnostic instead of livelocking through backoff retries
                 hopeless.update(np.nonzero(need > self.batch_size)[0].tolist())
                 if veto.any():
-                    self.gang_vetoes += int(
-                        np.unique(sub.gang_of_pod[veto]).size)
+                    n_vetoed = int(np.unique(sub.gang_of_pod[veto]).size)
+                    self.gang_vetoes += n_vetoed
+                    gang_info["vetoed"] = n_vetoed
+                    m.gang_vetoed_total.inc(n_vetoed, reason="solver")
                     assignment = np.where(veto, -1, assignment)
+            clock.mark("solve")
+            trace.step("Device solve done", solver=self.solver)
             # Two phases: bind every device assignment FIRST, then handle the
             # rejected pods. Handling mid-loop would see capacity still
             # promised to not-yet-bound assignments and double-book nodes.
@@ -266,6 +365,10 @@ class BatchScheduler(Scheduler):
                     # run yet, so the release must be the structural inverse
                     # (forget_pods_structural) — forget_pod would subtract
                     # resource totals that were never added.
+                    if gang_info is not None:
+                        gang_info["assume_vetoed"] = len(bad_gangs)
+                        m.gang_vetoed_total.inc(len(bad_gangs),
+                                                reason="assume")
                     released = []
                     for i in range(len(to_bind) - 1, -1, -1):
                         gid = bind_gang[i]
@@ -276,6 +379,8 @@ class BatchScheduler(Scheduler):
                             bind_gang.pop(i)
                             released.append(assumed)
                             gang_requeue.setdefault(gid, []).append(qp)
+                    if gang_info is not None:
+                        gang_info["released"] = len(released)
                     if use_columnar:
                         self.cache.forget_pods_structural(
                             released, check_ports=batch_has_ports)
@@ -292,6 +397,10 @@ class BatchScheduler(Scheduler):
                     self._columnar_account(batch, cluster, snapshot,
                                            bind_rows, bind_nodes,
                                            batch_has_ports)
+                clock.mark("assume")
+                trace.step("Assumed placements", bound=len(to_bind))
+                out["dispatched"] = len(to_bind)
+                sync_bind_s = 0.0
                 CHUNK = 10_000
                 for lo in range(0, len(to_bind), CHUNK):
                     chunk = to_bind[lo:lo + CHUNK]
@@ -299,26 +408,44 @@ class BatchScheduler(Scheduler):
                         self._ensure_bind_worker()
                         self._bind_q.put(chunk)
                     else:
+                        t0 = time.perf_counter()
                         self._bind_batch(chunk)
+                        sync_bind_s += time.perf_counter() - t0
                 if not self.pipeline_binds:
                     self._drain_bind_results()
+                clock.mark("dispatch")
+                # synchronous binds ran inside the dispatch span AND are
+                # observed as the "bind" stage by _bind_batch — keep the
+                # stages disjoint (measured locally, so this holds with the
+                # flight recorder disabled too)
+                clock.sub("dispatch", sync_bind_s)
+                trace.step("Dispatched binds")
             if rejected:
                 self._handle_device_rejects(rejected, snapshot, cluster, sub,
                                             assignment)
             if gang_requeue:
+                if gang_info is not None:
+                    gang_info["hopeless"] = sum(
+                        1 for g in gang_requeue if g in hopeless)
                 self._requeue_gangs(gang_requeue, batch.gang_keys or [],
                                     hopeless)
+            if rejected or gang_requeue:
+                clock.mark("reject")
+                trace.step("Handled rejects", rejected=len(rejected))
+            else:
+                clock.skip()
 
         # Serial fallback, in original priority order among themselves.
         # NOTE: gang members whose class needs the serial path (volumes, DRA)
         # schedule individually — all-or-nothing is enforced for device-path
         # classes, the shape training gangs actually take.
-        for pi in fallback_idx:
-            self._serial_one(qps[pi])
-
-        self.batches_solved += 1
-        m.batch_solve_duration.observe(time.perf_counter() - t_batch)
-        return len(qps)
+        if len(fallback_idx):
+            fb0 = self.scheduled_count
+            for pi in fallback_idx:
+                self._serial_one(qps[pi])
+            out["serial_scheduled"] = self.scheduled_count - fb0
+            clock.mark("fallback")
+            trace.step("Serial fallback done", pods=len(fallback_idx))
 
     def _requeue_gangs(self, groups: Dict[int, List[QueuedPodInfo]],
                        keys: List[str],
@@ -344,6 +471,10 @@ class BatchScheduler(Scheduler):
                     self._handle_failure(m, status)
                 continue
             self.failed_count += len(members)
+            if self._batch_reasons is not None:
+                self._batch_reasons["GangScheduling"] = (
+                    self._batch_reasons.get("GangScheduling", 0)
+                    + len(members))
             for m in members:
                 m.unschedulable_plugins = ("GangScheduling",)
             self.recorder.event(
@@ -621,6 +752,7 @@ class BatchScheduler(Scheduler):
                 continue
             nn, cand = chosen
             victims = cand.victims
+            self.preempt_victims_total += len(victims)
             vkeys = {v.key for v in victims}
             freed_now = np.zeros(r, np.int64)
             for vi in node_victims[nn]:
@@ -649,6 +781,50 @@ class BatchScheduler(Scheduler):
                 f"preempted {len(victims)} pod(s) on {node_names[nn]}; "
                 "waiting for victims to terminate", plugin="NodeResourcesFit"))
         return remaining
+
+    def _handle_failure(self, qp: QueuedPodInfo, status: Status,
+                        failed_nodes: Optional[Dict[str, Status]] = None) -> None:
+        """Taps the failure's attribution (plugin, else the reason text) into
+        the current batch's flight record before the shared requeue path."""
+        sink = self._batch_reasons
+        if sink is not None:
+            key = status.plugin or (status.reasons[0][:80] if status.reasons
+                                    else status.code.name.lower())
+            sink[key] = sink.get(key, 0) + 1
+        super()._handle_failure(qp, status, failed_nodes)
+
+    def sched_stats(self) -> Dict:
+        """The /debug/schedstats payload: live counters + the flight
+        recorder's aggregate stage table and last-batch record (the
+        machine-generated successor of ROADMAP's hand-maintained table)."""
+        active, backoff, unsched = self.queue.lengths()
+        gang = None
+        if self.gangs is not None and self.gangs.active:
+            from ..server import metrics as m
+
+            expired = self.gangs.quorum_expired_count(self.cache.contains)
+            m.gang_quorum_expired_assumes.set(expired)
+            gang = {"staged": self.queue.gang_staged_count(),
+                    "vetoes": self.gang_vetoes,
+                    "quorum_expired_assumes": expired}
+        fr = self.flightrec
+        return {
+            "solver": self.solver,
+            "batch_size": self.batch_size,
+            "batches_solved": self.batches_solved,
+            "scheduled": self.scheduled_count,
+            "failed": self.failed_count,
+            "preemptions": self.preemption_count,
+            "preempt_victims": self.preempt_victims_total,
+            "queue": {"active": active, "backoff": backoff,
+                      "unschedulable": unsched},
+            "gang": gang,
+            "recorder": {"enabled": fr.enabled, "capacity": fr.capacity,
+                         "records": len(fr),
+                         "self_seconds": round(fr.self_seconds, 6)},
+            "stages": fr.stage_table(),
+            "last_batch": fr.last(),
+        }
 
     def _hard_pod_affinity_weight(self) -> int:
         for fw in self.profiles.values():
@@ -716,6 +892,18 @@ class BatchScheduler(Scheduler):
                 return
 
     def _bind_batch(self, items) -> None:
+        t0 = time.perf_counter()
+        try:
+            self._bind_batch_inner(items)
+        finally:
+            t1 = time.perf_counter()
+            self.flightrec.add_outside("bind", t1 - t0)
+            from ..server import metrics as m
+
+            m.batch_stage_duration.observe(t1 - t0, "bind")
+            self.flightrec.note_self_time(time.perf_counter() - t1)
+
+    def _bind_batch_inner(self, items) -> None:
         triples = [(qp.pod.metadata.namespace, qp.pod.metadata.name, node)
                    for qp, node, _assumed in items]
         # chunked: each bind_many holds the store lock once; a single
@@ -762,6 +950,9 @@ class BatchScheduler(Scheduler):
             done, self._bind_successes = self._bind_successes, 0
             errs, self._bind_errors = self._bind_errors, []
         self.scheduled_count += done
+        if errs:
+            self.flightrec.note_bind_failures(
+                [(qp.pod.key, status.message()) for qp, status in errs])
         for qp, status in errs:
             self.bind_failures.append((qp.pod.key, status.message()))
             self._handle_failure(qp, status)
@@ -778,9 +969,14 @@ class BatchScheduler(Scheduler):
         return out
 
     def flush_binds(self) -> None:
-        """Wait for queued store.bind writes, then drain results."""
+        """Wait for queued store.bind writes, then drain results. The wait is
+        recorded as the "bind_wait" stage — the scheduling thread's stall on
+        in-flight binds, the residual the stage table needs to explain wall
+        time when binds don't fully overlap the next solve."""
+        t0 = time.perf_counter()
         if self._bind_worker is not None:
             self._bind_q.join()
+        self.flightrec.add_outside("bind_wait", time.perf_counter() - t0)
         self._drain_bind_results()
 
     def _serial_one(self, qp: QueuedPodInfo) -> None:

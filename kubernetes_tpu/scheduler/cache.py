@@ -360,6 +360,14 @@ class Cache:
         with self._lock:
             return key in self._assumed
 
+    def contains(self, key: str) -> bool:
+        """Whether the cache accounts for this pod at all (bound or assumed).
+        A gang member whose assume EXPIRED out of the cache reads False while
+        the GangDirectory may still count it toward quorum — the leak the
+        scheduler_gang_quorum_expired_assumes gauge measures."""
+        with self._lock:
+            return key in self._pod_nodes
+
     def cleanup_expired_assumed_pods(self) -> List[str]:
         with self._lock:
             now = self._clock.now()

@@ -1,0 +1,270 @@
+"""Pipeline flight recorder: per-batch stage timing for the batched solver.
+
+The north-star chase is steered by a stage table (ROADMAP.md): where do the
+milliseconds of a 100k-pod schedule->bind->confirm window go? kube-scheduler
+answers this with per-extension-point histograms and utiltrace steps
+(schedule_one.go:411); placement-quality work (Tesserae, CvxCluster —
+PAPERS.md) additionally needs per-decision attribution. Three pieces:
+
+  StageClock     — cheap per-BATCH wall-clock marks (one perf_counter read per
+                   stage boundary, never per pod; a 100k-pod batch pays ~10
+                   reads total, so the <2% overhead budget holds by
+                   construction).
+  FlightRecorder — bounded ring of per-batch records: pod/node counts,
+                   per-stage ms, outcome, gang veto/release counts,
+                   preemption victims, unschedulable-reason attribution, and
+                   the async bind failures drained from the bind worker.
+                   Work that runs OUTSIDE a batch (self-bind confirm re-ingest
+                   on a later pump, the overlapped bind worker, flush waits)
+                   accumulates into per-stage "outside" buckets so the
+                   aggregate stage table still sums to ~wall time.
+  registry       — weak registry of live BatchSchedulers so the API server's
+                   /debug/schedstats and `ktl sched stats` can read the stage
+                   table of an in-process scheduler without new plumbing
+                   (the configz register/snapshot pattern, utils/tracing.py).
+
+Everything is O(1) per batch and allocation-light; `enabled=False` skips the
+ring-buffer append (placement parity with the recorder on is pinned by
+tests/test_flightrec.py). bench.py consumes the recorder to emit the
+machine-generated `stages` breakdown that replaced ROADMAP's hand-estimates.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+# Serial-thread stages of one schedule_batch call, in pipeline order.
+# "ingest" is the watch pump residual (decode + cache ingest) with the
+# separately-attributed sub-stages (queue_add, confirm) subtracted out, so
+# the serial stages stay disjoint and sum cleanly.
+BATCH_STAGES = ("ingest", "pop", "tensorize", "build_pod_batch", "solve",
+                "assume", "dispatch", "reject", "fallback")
+# Stages accumulated outside the per-batch window: bulk queue admission
+# (inside the pump), self-bind confirm re-ingest (a later pump), the bind
+# worker's store.bind_many wall (overlapped with the next solve), and the
+# scheduling thread's wait for in-flight binds (flush_binds).
+OUTSIDE_STAGES = ("queue_add", "confirm", "bind", "bind_wait")
+# Overlapped with the serial thread — excluded from "does the serial stage
+# sum explain the wall clock" checks.
+OVERLAPPED_STAGES = ("bind",)
+
+
+class StageClock:
+    """Per-batch stage boundary marks. mark(name) attributes the time since
+    the previous boundary; skip() moves the boundary without attributing
+    (work another accumulator already claimed)."""
+
+    __slots__ = ("t0", "_last", "stages")
+
+    def __init__(self):
+        self.t0 = self._last = time.perf_counter()
+        self.stages: Dict[str, float] = {}
+
+    def mark(self, name: str) -> float:
+        now = time.perf_counter()
+        dt = now - self._last
+        self.stages[name] = self.stages.get(name, 0.0) + dt
+        self._last = now
+        return dt
+
+    def skip(self) -> None:
+        self._last = time.perf_counter()
+
+    def add(self, name: str, seconds: float) -> None:
+        if seconds > 0:
+            self.stages[name] = self.stages.get(name, 0.0) + seconds
+
+    def sub(self, name: str, seconds: float) -> None:
+        """Remove sub-stage time another bucket owns (floored at 0)."""
+        if seconds > 0 and name in self.stages:
+            self.stages[name] = max(0.0, self.stages[name] - seconds)
+
+    def total(self) -> float:
+        return time.perf_counter() - self.t0
+
+
+class FlightRecorder:
+    """Bounded ring of per-batch trace records (last N batches)."""
+
+    DEFAULT_CAPACITY = 64
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, enabled: bool = True):
+        self.capacity = capacity
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._records: deque = deque(maxlen=capacity)
+        self._seq = 0
+        # aggregate per-stage seconds since clear(), across ALL batches —
+        # survives ring eviction so the stage table covers the full window
+        self._stage_totals: Dict[str, float] = {}
+        self._stage_batches: Dict[str, int] = {}
+        # per-stage seconds accrued outside any batch (see OUTSIDE_STAGES)
+        self._outside: Dict[str, float] = {}
+        # async bind failures observed since the last record (attached to it)
+        self._pending_bind_failures: List = []
+        # instrumentation self-time: seconds spent building records,
+        # observing histograms, and in the timing taps (queue_add / confirm
+        # / bind wrappers note their own cost here). Everything measured
+        # except the ~10 StageClock perf_counter reads per batch — bench
+        # divides this by wall to bound the <2% overhead budget instead of
+        # differencing two noisy runs.
+        self._self_s = 0.0
+
+    # -- ingest ----------------------------------------------------------------
+
+    def add_outside(self, stage: str, seconds: float) -> None:
+        if not self.enabled or seconds <= 0:
+            return
+        with self._lock:
+            self._outside[stage] = self._outside.get(stage, 0.0) + seconds
+
+    def outside_seconds(self, *stages: str) -> float:
+        """Sum of the named outside buckets (the scheduler differences this
+        around a pump to keep 'ingest' disjoint from its sub-stages)."""
+        with self._lock:
+            return sum(self._outside.get(s, 0.0) for s in stages)
+
+    def note_bind_failures(self, failures: List) -> None:
+        """Bind-worker failures surfaced at drain time; attached to the next
+        batch record (take_bind_failures keeps its own drain semantics)."""
+        if not self.enabled or not failures:
+            return
+        with self._lock:
+            self._pending_bind_failures.extend(failures)
+            del self._pending_bind_failures[:-200]  # bounded if batches stop
+
+    def note_self_time(self, seconds: float) -> None:
+        with self._lock:
+            self._self_s += seconds
+
+    def record(self, *, pods: int, nodes: int, outcome: str, solver: str,
+               stages: Dict[str, float], total_s: float, scheduled: int = 0,
+               unschedulable: int = 0, fallback: int = 0, preempted: int = 0,
+               reasons: Optional[Dict[str, int]] = None,
+               gang: Optional[Dict[str, int]] = None,
+               solver_iterations: Optional[int] = None) -> Optional[Dict]:
+        """Append one batch record (stage values in SECONDS; stored as ms).
+        Returns the record, or None when disabled."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            self._seq += 1
+            rec = {
+                "seq": self._seq,
+                "ts": time.time(),
+                "pods": pods,
+                "nodes": nodes,
+                "outcome": outcome,
+                "solver": solver,
+                "total_ms": round(total_s * 1000, 3),
+                "stages": {k: round(v * 1000, 3) for k, v in stages.items()},
+                "scheduled": scheduled,
+                "unschedulable": unschedulable,
+                "fallback": fallback,
+                "preempted": preempted,
+                "reasons": dict(reasons or {}),
+                "gang": gang,
+                "solver_iterations": solver_iterations,
+                "bind_failures": list(self._pending_bind_failures),
+            }
+            self._pending_bind_failures.clear()
+            self._records.append(rec)
+            for k, v in stages.items():
+                self._stage_totals[k] = self._stage_totals.get(k, 0.0) + v
+                self._stage_batches[k] = self._stage_batches.get(k, 0) + 1
+            return rec
+
+    # -- read side -------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._records)
+
+    def records(self) -> List[Dict]:
+        with self._lock:
+            return list(self._records)
+
+    def last(self) -> Optional[Dict]:
+        with self._lock:
+            return self._records[-1] if self._records else None
+
+    @property
+    def self_seconds(self) -> float:
+        with self._lock:
+            return self._self_s
+
+    def stage_table(self) -> Dict[str, Dict]:
+        """Aggregate per-stage view across every batch since clear() plus the
+        outside buckets: {stage: {total_ms, mean_ms, batches, overlapped}}.
+        The non-overlapped rows sum to ~the window's serial wall time — the
+        machine-generated successor of ROADMAP's hand-maintained table."""
+        with self._lock:
+            totals = dict(self._stage_totals)
+            batches = dict(self._stage_batches)
+            outside = dict(self._outside)
+        out: Dict[str, Dict] = {}
+        for name in list(BATCH_STAGES) + list(OUTSIDE_STAGES):
+            sec = totals.get(name, 0.0) + outside.get(name, 0.0)
+            n = batches.get(name, 0)
+            if sec == 0.0 and n == 0:
+                continue
+            out[name] = {
+                "total_ms": round(sec * 1000, 3),
+                "mean_ms": round(sec * 1000 / n, 3) if n else None,
+                "batches": n,
+                "overlapped": name in OVERLAPPED_STAGES,
+            }
+        # anything recorded under a name this module doesn't know keeps
+        # rendering (forward compatibility for new stages)
+        for name in set(totals) | set(outside):
+            if name not in out:
+                sec = totals.get(name, 0.0) + outside.get(name, 0.0)
+                out[name] = {"total_ms": round(sec * 1000, 3),
+                             "mean_ms": None,
+                             "batches": batches.get(name, 0),
+                             "overlapped": False}
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._records.clear()
+            self._stage_totals.clear()
+            self._stage_batches.clear()
+            self._outside.clear()
+            self._pending_bind_failures.clear()
+            self._self_s = 0.0
+
+
+# -- live-scheduler registry (the configz pattern) ------------------------------
+
+_registry_lock = threading.Lock()
+_schedulers: "weakref.WeakValueDictionary[str, object]" = \
+    weakref.WeakValueDictionary()
+
+
+def register_scheduler(name: str, sched) -> None:
+    """Register a live scheduler for /debug/schedstats. Weak: a stopped and
+    collected scheduler drops out without an unregister call."""
+    with _registry_lock:
+        _schedulers[name] = sched
+
+
+def schedstats_snapshot() -> Dict[str, Dict]:
+    """{scheduler name: sched_stats()} over every live registered scheduler —
+    what GET /debug/schedstats and `ktl sched stats` serve."""
+    with _registry_lock:
+        live = dict(_schedulers)
+    out = {}
+    for name, sched in live.items():
+        stats: Callable = getattr(sched, "sched_stats", None)
+        if stats is None:
+            continue
+        try:
+            out[name] = stats()
+        except Exception as e:  # a wedged scheduler must not 500 the endpoint
+            out[name] = {"error": str(e)}
+    return out

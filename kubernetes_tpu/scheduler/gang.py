@@ -148,6 +148,17 @@ class GangDirectory:
                 if not got:
                     self._placed.pop(group, None)
 
+    def quorum_expired_count(self, contains) -> int:
+        """How many placed members still counted toward some quorum are no
+        longer known to the cache at all (their assume expired without a bind
+        confirmation). The ROADMAP open item 'counting expired assumes back
+        out of the quorum' is unfixed — this makes the leak observable
+        (scheduler_gang_quorum_expired_assumes). `contains` is
+        Cache.contains; called OUTSIDE our lock, stats-path only."""
+        with self._lock:
+            keys = [k for placed in self._placed.values() for k in placed]
+        return sum(1 for k in keys if not contains(k))
+
     def reset(self) -> None:
         """Relist: state is rebuilt from the fresh LIST."""
         with self._lock:

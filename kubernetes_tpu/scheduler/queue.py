@@ -92,6 +92,10 @@ class SchedulingQueue:
         self._gang_ready = None  # (group, staged_count) -> bool
         self._gang_active = None  # () -> bool
         self._gang_staging: Dict[str, Dict[str, QueuedPodInfo]] = {}
+        # stage-timing sink (a FlightRecorder, installed by BatchScheduler):
+        # bulk-admission wall time accrues to its "queue_add" bucket so the
+        # batch pipeline's stage table can attribute ingest sub-stages
+        self.stat_sink = None
 
     def set_gang_hooks(self, gang_of, gang_ready, gang_active) -> None:
         """Install gang gating: gang_of(pod) names the pod's group (None for
@@ -132,6 +136,24 @@ class SchedulingQueue:
         semantics double-run it, microseconds apart, with the same answer)."""
         if not pods:
             return
+        sink = self.stat_sink
+        if sink is not None and sink.enabled:
+            import time as _time
+
+            t0 = _time.perf_counter()
+            try:
+                self._add_batch_locked(pods, pre_gated)
+            finally:
+                t1 = _time.perf_counter()
+                sink.add_outside("queue_add", t1 - t0)
+                from ..server import metrics as m
+
+                m.batch_stage_duration.observe(t1 - t0, "queue_add")
+                sink.note_self_time(_time.perf_counter() - t1)
+            return
+        self._add_batch_locked(pods, pre_gated)
+
+    def _add_batch_locked(self, pods: List[Pod], pre_gated: bool) -> None:
         with self._lock:
             now = self._clock.now()
             gang_of = (self._gang_of if self._gang_active is not None
@@ -323,6 +345,7 @@ class SchedulingQueue:
             # not be stranded; after the same 30s window they release as
             # ORDINARY pods. Groups with a live PodGroup below quorum keep
             # waiting — releasing those would break all-or-nothing.
+            released = 0
             for group in list(self._gang_staging):
                 staged = self._gang_staging[group]
                 if (self._gang_ready is None
@@ -333,10 +356,15 @@ class SchedulingQueue:
                         staged.pop(key)
                         self._heap_push(qp)
                         moved = True
+                        released += 1
                 if not staged:
                     self._gang_staging.pop(group, None)
             if moved:
                 self._lock.notify_all()
+        if released:
+            from ..server import metrics as m
+
+            m.gang_orphan_released_total.inc(released)
 
     # -- pop -------------------------------------------------------------------
 

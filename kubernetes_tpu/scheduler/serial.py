@@ -119,6 +119,9 @@ class Scheduler:
         # None on the serial loop, and every hook below is gated on it
         self.gangs = None
         self._watch = None
+        # pipeline flight recorder (scheduler/flightrec.py) — installed by
+        # BatchScheduler; None on the serial loop, every hook gated on it
+        self.flightrec = None
         # coalesced watch ingest: batched store writes arrive as ONE
         # CoalescedEvent; _bind_origin tags our own bind_many batches so
         # their MODIFIED events short-circuit to a bulk assume-confirm
@@ -267,9 +270,18 @@ class Scheduler:
             return len(events)
         if (cev.type == MODIFIED and cev.origin is not None
                 and cev.origin == self._bind_origin):
+            fr = self.flightrec
+            t0 = time.perf_counter() if fr is not None and fr.enabled else 0.0
             pairs = [(ev.obj.key, ev.obj.spec.node_name) for ev in events]
             for i in self.cache.confirm_assumed_bulk(pairs):
                 self._handle_pod(MODIFIED, events[i].obj)
+            if t0:
+                t1 = time.perf_counter()
+                fr.add_outside("confirm", t1 - t0)
+                from ..server import metrics as m
+
+                m.batch_stage_duration.observe(t1 - t0, "confirm")
+                fr.note_self_time(time.perf_counter() - t1)
             return len(events)
         if cev.type == ADDED:
             admit: List[Pod] = []

@@ -11,6 +11,19 @@ import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
+def escape_label_value(value) -> str:
+    """Prometheus text-format label escaping (backslash, double-quote,
+    newline — exposition format spec). Pod names and failure messages flow
+    into label values, so unescaped quotes/backslashes would corrupt the
+    exposition for any real scraper."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _render_labels(key: Tuple) -> str:
+    return ",".join(f'{k}="{escape_label_value(v)}"' for k, v in key)
+
+
 class Counter:
     def __init__(self, name: str, help_: str = ""):
         self.name = name
@@ -23,11 +36,16 @@ class Counter:
         with self._lock:
             self._values[key] = self._values.get(key, 0.0) + value
 
+    def value(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0.0)
+
     def render(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} counter"]
         with self._lock:
             for key, v in sorted(self._values.items()):
-                lbl = ",".join(f'{k}="{val}"' for k, val in key)
+                lbl = _render_labels(key)
                 out.append(f"{self.name}{{{lbl}}} {v}" if lbl else f"{self.name} {v}")
         return out
 
@@ -66,16 +84,68 @@ class Histogram:
                     return
             self._counts[-1] += 1
 
-    def render(self) -> List[str]:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+    def render(self, label: str = "") -> List[str]:
+        """Sample lines; `label` is a pre-rendered 'k="v"' prefix merged into
+        each line's label set (LabeledHistogram children)."""
+        out = ([] if label else
+               [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"])
+        sep = f"{label}," if label else ""
+        suffix = f"{{{label}}}" if label else ""
         with self._lock:
             cum = 0
             for i, b in enumerate(self.buckets):
                 cum += self._counts[i]
-                out.append(f'{self.name}_bucket{{le="{b}"}} {cum}')
-            out.append(f'{self.name}_bucket{{le="+Inf"}} {self._total}')
-            out.append(f"{self.name}_sum {self._sum}")
-            out.append(f"{self.name}_count {self._total}")
+                out.append(f'{self.name}_bucket{{{sep}le="{b}"}} {cum}')
+            out.append(f'{self.name}_bucket{{{sep}le="+Inf"}} {self._total}')
+            out.append(f"{self.name}_sum{suffix} {self._sum}")
+            out.append(f"{self.name}_count{suffix} {self._total}")
+        return out
+
+    def snapshot(self) -> Tuple[float, int]:
+        """(sum, count) under the lock — the stats surfaces read these."""
+        with self._lock:
+            return self._sum, self._total
+
+
+class LabeledHistogram:
+    """A histogram family keyed by ONE label (the reference's HistogramVec
+    restricted to the single-label shape every call site here uses). Children
+    are created on first observe; exposition merges the label into each
+    bucket/sum/count line."""
+
+    def __init__(self, name: str, help_: str = "", label: str = "le_label",
+                 buckets: Sequence[float] = Histogram.DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.label = label
+        self.buckets = tuple(buckets)
+        self._children: Dict[str, Histogram] = {}
+        self._lock = threading.Lock()
+
+    def child(self, value: str) -> Histogram:
+        with self._lock:
+            got = self._children.get(value)
+            if got is None:
+                got = self._children[value] = Histogram(
+                    self.name, self.help, self.buckets)
+            return got
+
+    def observe(self, value: float, label_value: str) -> None:
+        self.child(label_value).observe(value)
+
+    def snapshot(self) -> Dict[str, Tuple[float, int]]:
+        with self._lock:
+            children = dict(self._children)
+        return {k: h.snapshot() for k, h in children.items()}
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            children = sorted(self._children.items())
+        for v, h in children:
+            out.extend(h.render(
+                label=f'{self.label}="{escape_label_value(v)}"'))
         return out
 
 
@@ -92,6 +162,10 @@ class Registry:
 
     def histogram(self, name: str, help_: str = "", buckets=Histogram.DEFAULT_BUCKETS) -> Histogram:
         return self._add(Histogram(name, help_, buckets))
+
+    def labeled_histogram(self, name: str, help_: str = "", label: str = "label",
+                          buckets=Histogram.DEFAULT_BUCKETS) -> LabeledHistogram:
+        return self._add(LabeledHistogram(name, help_, label, buckets))
 
     def _add(self, m):
         with self._lock:
@@ -116,7 +190,30 @@ scheduling_attempt_duration = global_registry.histogram(
     "scheduler_scheduling_attempt_duration_seconds", "Scheduling attempt latency")
 pending_pods = global_registry.gauge(
     "scheduler_pending_pods", "Pending pods by queue")
-batch_solve_duration = global_registry.histogram(
-    "scheduler_batch_solve_duration_seconds", "TPU batch solve latency")
+batch_solve_duration = global_registry.labeled_histogram(
+    "scheduler_batch_solve_duration_seconds",
+    "TPU batch solve latency by outcome", label="outcome")
 batch_size_gauge = global_registry.gauge(
     "scheduler_batch_size", "Pods in the last solved batch")
+
+# per-stage timing of the batched schedule->bind->confirm loop (the
+# extension-point histograms of framework_duration_seconds, reframed for the
+# pipeline stages the ROADMAP table tracks). Buckets reach down to 100us:
+# most stages of a small batch land well under the serial path's 1ms floor.
+STAGE_BUCKETS = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10)
+batch_stage_duration = global_registry.labeled_histogram(
+    "scheduler_batch_stage_duration_seconds",
+    "Batched pipeline stage latency", label="stage", buckets=STAGE_BUCKETS)
+
+# gang scheduling observability (ROADMAP gang-pipeline open items)
+gang_staged = global_registry.gauge(
+    "scheduler_gang_staged", "Gang members parked in queue staging")
+gang_vetoed_total = global_registry.counter(
+    "scheduler_gang_vetoed_total", "Gangs stripped post-solve by reason")
+gang_orphan_released_total = global_registry.counter(
+    "scheduler_gang_orphan_released_total",
+    "Staged gang members released as ordinary pods (PodGroup gone)")
+gang_quorum_expired_assumes = global_registry.gauge(
+    "scheduler_gang_quorum_expired_assumes",
+    "Placed gang members still counted toward quorum whose cache entry "
+    "expired (the not-yet-fixed quorum leak, now measurable)")

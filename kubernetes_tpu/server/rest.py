@@ -392,7 +392,7 @@ class _Handler(BaseHTTPRequestHandler):
     _FC_VERBS = {"GET": "get", "POST": "create", "PUT": "update",
                  "PATCH": "patch", "DELETE": "delete"}
     _FC_EXEMPT_PATHS = ("/healthz", "/readyz", "/metrics", "/version",
-                        "/configz")
+                        "/configz", "/debug/schedstats")
 
     def _flow_dispatch(self, orig: "Callable[[], None]") -> None:
         """Seat-accounted dispatch. Health/metrics always pass (the probe
@@ -636,6 +636,20 @@ class _Handler(BaseHTTPRequestHandler):
             # configs may be arbitrary objects; coerce like the JSON logger
             body = json.dumps(configz_snapshot(), default=lambda o: vars(o)
                               if hasattr(o, "__dict__") else str(o)).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if url.path == "/debug/schedstats":
+            # pipeline flight recorder (scheduler/flightrec.py): per-stage
+            # timing + last-batch records of every live in-process batch
+            # scheduler — what `ktl sched stats` renders. The debug family
+            # sits beside /configz: read-only, introspection-only.
+            from ..scheduler.flightrec import schedstats_snapshot
+
+            body = json.dumps(schedstats_snapshot(), default=str).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

@@ -31,6 +31,19 @@ def test_bench_quick_runs_and_emits_json():
     assert "error" not in ns, ns
     assert ns["placed"] == ns["pods"] > 0
     assert ns["pods_per_sec"] > 0
+    # the flight-recorder stage breakdown (ISSUE 3): generated, present, and
+    # consistent — the serial (non-overlapped) stages must approximately
+    # explain the reported wall time (generous band: the harness co-schedules
+    # other work on a 2-core rig), and the recorder's measured self-time must
+    # stay under the 2% instrumentation budget
+    stages = ns["stages"]
+    assert stages and all(v >= 0 for v in stages.values()), stages
+    assert "solve" in stages and "ingest" in stages
+    wall = ns["wall_s"]
+    serial_sum = ns["stages_serial_sum_s"]
+    assert 0.3 * wall <= serial_sum <= 1.2 * wall, (serial_sum, wall, stages)
+    assert ns["instrumentation_s"] <= 0.02 * wall, (
+        ns["instrumentation_s"], wall)
     basic = workloads.get("SchedulingBasic", {})
     assert "error" not in basic, basic
     # the gang rung (ISSUE 2): every member of every gang binds, all-or-
