@@ -556,6 +556,53 @@ def rung_north_star_endtoend(results):
         print(f"NorthStar_100k_10k_endtoend: ERROR {e}", file=sys.stderr)
 
 
+def rung_bind_commit(results):
+    """BindCommit_20k: store.bind_many throughput in ISOLATION (the PR 4
+    clone-free commit path) — 20k pending pods bound in bind-worker-sized
+    chunks with only a coalescing watcher subscribed (the scheduler steady
+    state: lazy shared events, no per-object clones, sharded lock), no
+    scheduler and no flight recorder involved. Fixed-size like the gang
+    rung: 20k binds run in a fraction of a second, so the rung doubles as
+    the quick-tier smoke for the store commit hot path."""
+    from kubernetes_tpu.store import APIStore
+    from kubernetes_tpu.testing import MakePod
+
+    try:
+        n, chunk = 20_000, 4096
+
+        def run_once():
+            store = APIStore()
+            w = store.watch(kind=("pods",), coalesce=True)
+            store.create_many(
+                "pods", (MakePod(f"bc-{i}").req({"cpu": "100m"}).obj()
+                         for i in range(n)), consume=True)
+            w.drain()
+            triples = [("default", f"bc-{i}", f"node-{i % 512}")
+                       for i in range(n)]
+            t0 = time.perf_counter()
+            bound = 0
+            for lo in range(0, n, chunk):
+                b, errs = store.bind_many(triples[lo:lo + chunk],
+                                          origin="bench")
+                bound += b
+                assert not errs, errs[:3]
+            return bound, time.perf_counter() - t0
+
+        run_once()  # warm-up
+        bound, dt = run_once()
+        pps = n / dt
+        results["BindCommit_20k"] = {
+            "pods_per_sec": round(pps, 1), "wall_s": round(dt, 4),
+            "placed": bound, "pods": n, "us_per_pod": round(dt / n * 1e6, 2),
+            "solver": "bind_many-only"}
+        print(f"{'BindCommit_20k':>28}: {pps:>9.0f} pods/s  "
+              f"({bound}/{n} bound, {dt / n * 1e6:.1f}us/pod)",
+              file=sys.stderr)
+    except Exception as e:
+        results["BindCommit_20k"] = {"error": str(e)[:200]}
+        print(f"BindCommit_20k: ERROR {e}", file=sys.stderr)
+
+
 def rung_gang(results):
     """GangScheduling_2k_250: 8 PodGroups x 250 members bound end-to-end —
     store ingest, queue gang staging, the all-or-nothing veto, slice-packing
@@ -853,6 +900,7 @@ RUNGS = [
     ("NorthStar", rung_north_star),
     ("NorthStarWarm", rung_north_star_warm),
     ("NorthStarEndToEnd", rung_north_star_endtoend),
+    ("BindCommit", rung_bind_commit),
     ("GangScheduling", rung_gang),
     ("Transport", rung_transport),
     ("ApiserverWatchFanout", rung_watch_fanout),
@@ -863,7 +911,7 @@ RUNGS = [
 # stdout. Catches perf-path regressions (a broken coalesced ingest or bind
 # path fails loudly here) without the full ladder's budget.
 QUICK_RUNGS = ("SchedulingBasic", "MixedChurn", "NorthStarEndToEnd",
-               "GangScheduling")
+               "BindCommit", "GangScheduling")
 QUICK_BUDGET_S = 55.0
 
 

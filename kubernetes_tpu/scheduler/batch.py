@@ -20,7 +20,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..snapshot.tensorizer import TensorCache, build_cluster_tensors, build_pod_batch
-from ..store import APIStore, pod_bind_clone, pod_structural_clone
+from ..store import (MODIFIED, APIStore, NotFoundError, pod_bind_clone,
+                     pod_structural_clone)
 from .flightrec import FlightRecorder, StageClock, register_scheduler
 from .framework import Status
 from .queue import QueuedPodInfo
@@ -70,11 +71,20 @@ class BatchScheduler(Scheduler):
         # sees the capacity, while the store.bind writes flush on a worker
         # thread overlapped with solve(N+1).
         self.pipeline_binds = pipeline_binds
+        # commit sub-batch size: each bind_many+confirm cycle covers this
+        # many pods, so commit(N) overlaps the scheduling thread's work on
+        # solve(N+1) at chunk granularity instead of whole-batch granularity
+        # (and each store critical section stays short)
+        self.bind_chunk = 4096
         self._bind_q: _queue.Queue = _queue.Queue()
         self._bind_worker: Optional[threading.Thread] = None
         self._bind_errors: List = []
         self._bind_successes = 0  # folded into scheduled_count on the
         self._bind_err_lock = threading.Lock()  # scheduling thread (no race)
+        # assumed pods whose worker-side confirm missed (assume expired /
+        # foreign interference): re-ingested on the scheduling thread at the
+        # next drain, like any foreign MODIFIED
+        self._bind_confirm_leftovers: List = []
         # async bind failures, surfaced to schedule_batch callers (the worker
         # requeues them internally, but "my bind_many failed" was invisible):
         # [(pod key, message)], drained via take_bind_failures()
@@ -111,10 +121,10 @@ class BatchScheduler(Scheduler):
 
         fr = self.flightrec
         clock = StageClock()
-        # queue_add/confirm accrue into the recorder's outside buckets at
-        # their own call sites (inside this pump); difference them out so the
-        # "ingest" residual stays disjoint from its sub-stages
-        sub0 = fr.outside_seconds("queue_add", "confirm")
+        # queue_add accrues into the recorder's outside bucket at its own
+        # call site (inside this pump); difference it out so the "ingest"
+        # residual stays disjoint from its sub-stage
+        sub0 = fr.outside_seconds("queue_add")
         # pump until the watch drains — bounded: a 100k-pod backlog must
         # reach the queue as ONE batch (not batch_size/10k sub-solves), but
         # sustained event arrival must not starve scheduling forever
@@ -122,7 +132,7 @@ class BatchScheduler(Scheduler):
             if self.pump_events(max_events=self.batch_size) < self.batch_size:
                 break
         clock.mark("ingest")
-        clock.sub("ingest", fr.outside_seconds("queue_add", "confirm") - sub0)
+        clock.sub("ingest", fr.outside_seconds("queue_add") - sub0)
         qps = self.queue.pop_batch(self.batch_size, timeout=timeout)
         clock.mark("pop")
         if not qps:
@@ -401,9 +411,8 @@ class BatchScheduler(Scheduler):
                 trace.step("Assumed placements", bound=len(to_bind))
                 out["dispatched"] = len(to_bind)
                 sync_bind_s = 0.0
-                CHUNK = 10_000
-                for lo in range(0, len(to_bind), CHUNK):
-                    chunk = to_bind[lo:lo + CHUNK]
+                for lo in range(0, len(to_bind), self.bind_chunk):
+                    chunk = to_bind[lo:lo + self.bind_chunk]
                     if self.pipeline_binds:
                         self._ensure_bind_worker()
                         self._bind_q.put(chunk)
@@ -861,18 +870,21 @@ class BatchScheduler(Scheduler):
             self._bind_worker.start()
 
     def _bind_loop(self) -> None:
-        """Drains the bind queue in opportunistic batches: everything queued
-        at wake-up goes through ONE store.bind_many transaction (the pipeline
-        analog of BindingREST write batching — binds are the north star's
-        end-to-end bottleneck at 100k-pod scale)."""
+        """Drains the bind queue in PIPELINED sub-batches: items queued at
+        wake-up are merged only up to bind_chunk pods per store.bind_many +
+        confirm cycle, so commit(N) runs while the scheduling thread works
+        on solve(N+1) — chunk-granular overlap instead of one monolithic
+        commit that the scheduling thread can only wait behind (the
+        bind_wait stall the PR 3 stage table surfaced)."""
         while True:
             item = self._bind_q.get()
             if item is None:
                 self._bind_q.task_done()
                 return
             batches = [item]  # each queue item is a LIST of bind triples
+            merged = len(item)
             done = False
-            while True:
+            while merged < self.bind_chunk:
                 try:
                     nxt = self._bind_q.get_nowait()
                 except _queue.Empty:
@@ -881,6 +893,7 @@ class BatchScheduler(Scheduler):
                     done = True
                     break
                 batches.append(nxt)
+                merged += len(nxt)
             try:
                 self._bind_batch([t for b in batches for t in b])
             finally:
@@ -906,13 +919,13 @@ class BatchScheduler(Scheduler):
     def _bind_batch_inner(self, items) -> None:
         triples = [(qp.pod.metadata.namespace, qp.pod.metadata.name, node)
                    for qp, node, _assumed in items]
-        # chunked: each bind_many holds the store lock once; a single
+        # chunked: each bind_many holds the store locks once; a single
         # 100k-bind hold would starve every other store consumer. A chunk
         # that throws fails ONLY its own pods — earlier chunks already
         # committed and must not be forgotten/requeued.
         errors = []
-        for lo in range(0, len(triples), 10_000):
-            chunk = triples[lo:lo + 10_000]
+        for lo in range(0, len(triples), self.bind_chunk):
+            chunk = triples[lo:lo + self.bind_chunk]
             try:
                 _bound, errs = self.store.bind_many(
                     chunk, origin=self._bind_origin)
@@ -921,24 +934,49 @@ class BatchScheduler(Scheduler):
                 errors.extend((f"{ns}/{name}", str(e))
                               for ns, name, _node in chunk)
         if not errors:
-            # common case: whole batch committed — one cache lock for the
-            # finish_binding sweep instead of one acquire per pod
-            self.cache.finish_binding_bulk([a for _qp, _node, a in items])
-            with self._bind_err_lock:
-                self._bind_successes += len(items)
+            # common case: whole sub-batch committed. On the coalesced
+            # pipeline the assume-CONFIRM piggybacks right here (one cache
+            # lock) instead of a later event re-ingest — the scheduler skips
+            # its own origin-tagged MODIFIED batches entirely, removing the
+            # old finish_binding ttl window AND the confirm stage from the
+            # scheduling thread. Leftovers (assume expired, foreign rebind)
+            # re-ingest on the scheduling thread at the next drain. The
+            # per-pod pipeline (watch_coalesce=False, the parity oracle)
+            # keeps the finish_binding + event-confirm flow byte-for-byte.
+            if self.watch_coalesce:
+                pairs = [(qp.pod.key, node) for qp, node, _a in items]
+                leftover = self.cache.confirm_assumed_bulk(pairs)
+                with self._bind_err_lock:
+                    self._bind_successes += len(items)
+                    if leftover:
+                        self._bind_confirm_leftovers.extend(
+                            items[i][2] for i in leftover)
+            else:
+                self.cache.finish_binding_bulk([a for _qp, _node, a in items])
+                with self._bind_err_lock:
+                    self._bind_successes += len(items)
             return
         errmap = dict(errors)
+        confirm = []
         with self._bind_err_lock:
-            for qp, _node, assumed in items:
+            for qp, node, assumed in items:
                 msg = errmap.get(qp.pod.key)
                 if msg is None:
-                    self.cache.finish_binding(assumed)
+                    if self.watch_coalesce:
+                        confirm.append((qp.pod.key, node, assumed))
+                    else:
+                        self.cache.finish_binding(assumed)
                     self._bind_successes += 1
                 else:
                     self.cache.forget_pod(assumed)
                     if self.gangs is not None:
                         self.gangs.note_forgotten(assumed)
                     self._bind_errors.append((qp, Status.error(msg)))
+            if confirm:
+                leftover = self.cache.confirm_assumed_bulk(
+                    [(k, n) for k, n, _a in confirm])
+                self._bind_confirm_leftovers.extend(
+                    confirm[i][2] for i in leftover)
 
     def _drain_bind_results(self) -> None:
         """Fold completed async binds into counters and re-handle failures on
@@ -949,7 +987,22 @@ class BatchScheduler(Scheduler):
         with self._bind_err_lock:
             done, self._bind_successes = self._bind_successes, 0
             errs, self._bind_errors = self._bind_errors, []
+            leftovers, self._bind_confirm_leftovers = (
+                self._bind_confirm_leftovers, [])
         self.scheduled_count += done
+        for pod in leftovers:
+            # worker-side confirm missed (assume expired / foreign write got
+            # in first): re-read the COMMITTED object — the assume-time clone
+            # is stale (pre-bind rv, possibly older labels), and the pod may
+            # have been deleted since (re-ingesting the clone would resurrect
+            # it in the cache; the event-stream confirm of old couldn't,
+            # because it ran in rv order) — then take the full ingest path,
+            # exactly like a foreign MODIFIED, correcting the cache
+            try:
+                cur = self.store.get("pods", pod.key)
+            except NotFoundError:
+                continue  # deleted since the bind: nothing left to account
+            self._handle_pod(MODIFIED, cur)
         if errs:
             self.flightrec.note_bind_failures(
                 [(qp.pod.key, status.message()) for qp, status in errs])
@@ -1006,6 +1059,7 @@ class BatchScheduler(Scheduler):
                     self.pump_events()
                     self.queue.flush_backoff_completed()
                     self.queue.flush_unschedulable_left_over()
+                    self.sweep_expired_assumes()
                     self._stop.wait(0.05)
 
         self._thread = threading.Thread(target=loop, daemon=True)
@@ -1016,9 +1070,10 @@ class BatchScheduler(Scheduler):
         while n < max_cycles:
             if self.schedule_batch(timeout=0.0) == 0:
                 # quiesce: flush in-flight binds (may requeue failures), then
-                # drain events before declaring idle
+                # drain events + expired assumes before declaring idle
                 self.flush_binds()
                 self.pump_events()
+                self.sweep_expired_assumes()
                 if self.schedule_batch(timeout=0.0) == 0:
                     break
             n += 1

@@ -39,15 +39,16 @@ from typing import Callable, Dict, List, Optional
 
 # Serial-thread stages of one schedule_batch call, in pipeline order.
 # "ingest" is the watch pump residual (decode + cache ingest) with the
-# separately-attributed sub-stages (queue_add, confirm) subtracted out, so
-# the serial stages stay disjoint and sum cleanly.
+# separately-attributed sub-stage (queue_add) subtracted out, so the serial
+# stages stay disjoint and sum cleanly.
 BATCH_STAGES = ("ingest", "pop", "tensorize", "build_pod_batch", "solve",
                 "assume", "dispatch", "reject", "fallback")
 # Stages accumulated outside the per-batch window: bulk queue admission
-# (inside the pump), self-bind confirm re-ingest (a later pump), the bind
-# worker's store.bind_many wall (overlapped with the next solve), and the
-# scheduling thread's wait for in-flight binds (flush_binds).
-OUTSIDE_STAGES = ("queue_add", "confirm", "bind", "bind_wait")
+# (inside the pump), the bind worker's store.bind_many wall (overlapped with
+# the next solve), and the scheduling thread's wait for in-flight binds
+# (flush_binds). The old "confirm" stage is gone: the bind worker confirms
+# its own assumes on the commit chunk, so self-bind events carry no work.
+OUTSIDE_STAGES = ("queue_add", "bind", "bind_wait")
 # Overlapped with the serial thread — excluded from "does the serial stage
 # sum explain the wall clock" checks.
 OVERLAPPED_STAGES = ("bind",)

@@ -148,13 +148,33 @@ class GangDirectory:
                 if not got:
                     self._placed.pop(group, None)
 
+    def note_expired_keys(self, keys) -> int:
+        """Count expired assumes back OUT of the quorum (the leak
+        scheduler_gang_quorum_expired_assumes measured, now consumed): the
+        pod keys Cache.cleanup_expired_assumed_pods just dropped stop
+        counting as placed, so a gang with expired assumed members
+        re-evaluates its quorum against reality (and its members re-stage
+        via the scheduler's expiry sweep) instead of silently
+        under-counting. Returns how many placed entries were removed."""
+        removed = 0
+        with self._lock:
+            for group in list(self._placed):
+                got = self._placed[group]
+                before = len(got)
+                got.difference_update(keys)
+                removed += before - len(got)
+                if not got:
+                    self._placed.pop(group, None)
+        return removed
+
     def quorum_expired_count(self, contains) -> int:
         """How many placed members still counted toward some quorum are no
         longer known to the cache at all (their assume expired without a bind
-        confirmation). The ROADMAP open item 'counting expired assumes back
-        out of the quorum' is unfixed — this makes the leak observable
-        (scheduler_gang_quorum_expired_assumes). `contains` is
-        Cache.contains; called OUTSIDE our lock, stats-path only."""
+        confirmation). The scheduler's sweep_expired_assumes consumes the
+        leak via note_expired_keys; this gauge
+        (scheduler_gang_quorum_expired_assumes) measures what remains
+        between sweeps. `contains` is Cache.contains; called OUTSIDE our
+        lock, stats-path only."""
         with self._lock:
             keys = [k for placed in self._placed.values() for k in placed]
         return sum(1 for k in keys if not contains(k))

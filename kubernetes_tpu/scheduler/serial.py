@@ -20,8 +20,9 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..api import Pod
+from ..snapshot.class_compiler import pod_class_signature
 from ..store import (ADDED, DELETED, MODIFIED, APIStore, CoalescedEvent,
-                     pod_structural_clone)
+                     NotFoundError, pod_structural_clone)
 from ..utils import Clock
 from .cache import Cache
 from .framework import CycleState, NodeInfo, Snapshot, Status
@@ -255,10 +256,11 @@ class Scheduler:
         chunk). Two bulk fast paths, both falling back to the per-event
         handler for anything that doesn't match:
 
-          - our own bind MODIFIED batch (origin == _bind_origin): bulk
-            assume-confirm — one cache lock instead of 100k per-event
-            ingests; events the cache can't confirm (foreign rebind, expired
-            assume) take the full path and correct the cache;
+          - our own bind MODIFIED batch (origin == _bind_origin): NOTHING to
+            ingest — the bind worker confirmed the assumes chunk-by-chunk,
+            piggybacked on the same bind_many commits (and re-ingests the
+            rare leftovers via _drain_bind_results), so the old confirm
+            re-ingest stage is gone from the scheduling thread entirely;
           - pending-pod ADDED batch: PreEnqueue-gate per pod, then ONE
             SchedulingQueue.add_batch admission (single lock + heapify).
 
@@ -270,18 +272,6 @@ class Scheduler:
             return len(events)
         if (cev.type == MODIFIED and cev.origin is not None
                 and cev.origin == self._bind_origin):
-            fr = self.flightrec
-            t0 = time.perf_counter() if fr is not None and fr.enabled else 0.0
-            pairs = [(ev.obj.key, ev.obj.spec.node_name) for ev in events]
-            for i in self.cache.confirm_assumed_bulk(pairs):
-                self._handle_pod(MODIFIED, events[i].obj)
-            if t0:
-                t1 = time.perf_counter()
-                fr.add_outside("confirm", t1 - t0)
-                from ..server import metrics as m
-
-                m.batch_stage_duration.observe(t1 - t0, "confirm")
-                fr.note_self_time(time.perf_counter() - t1)
             return len(events)
         if cev.type == ADDED:
             admit: List[Pod] = []
@@ -306,6 +296,12 @@ class Scheduler:
         would."""
         st = (self._fw(pod) or self.framework).run_pre_enqueue(pod)
         if st.is_success():
+            # prime the pod-carried class-signature memo at ADMISSION: the
+            # fused per-pod loop in build_pod_batch then does a dict hit
+            # instead of the ~6µs signature recompute (ROADMAP open lever),
+            # and ingest overlaps the previous batch's bind commits while
+            # build_pod_batch sits on the serial critical path
+            pod_class_signature(pod)
             return True
         self.queue.add_unschedulable(QueuedPodInfo(
             pod=pod, timestamp=self.clock.now(),
@@ -770,6 +766,35 @@ class Scheduler:
         except Exception:
             pass
 
+    def sweep_expired_assumes(self) -> List[str]:
+        """Expire assumed pods whose bind never confirmed (cache.go's
+        durationToExpireAssumedPod cleanup, scheduler.go:57-59) and CONSUME
+        the consequences instead of leaking them:
+
+          - gang quorums count the expired members back OUT (the
+            scheduler_gang_quorum_expired_assumes leak the PR 3 gauge made
+            measurable) — a gang waiting on quorum re-evaluates against
+            reality instead of silently under-counting;
+          - the pods themselves re-enter the queue if they still exist
+            pending in the store (an expired assume means our bind never
+            landed; without this they strand in limbo until a relist), which
+            re-STAGES gang members under their group.
+
+        Returns the expired pod keys."""
+        expired = self.cache.cleanup_expired_assumed_pods()
+        if not expired:
+            return expired
+        if self.gangs is not None and self.gangs.active:
+            self.gangs.note_expired_keys(expired)
+        for key in expired:
+            try:
+                pod = self.store.get("pods", key)
+            except NotFoundError:
+                continue
+            if not pod.spec.node_name and not pod.is_terminal():
+                self._handle_pod(ADDED, pod)
+        return expired
+
     def run_until_idle(self, max_cycles: int = 100_000) -> int:
         """Drive the loop until the active queue drains (test/bench harness)."""
         n = 0
@@ -792,6 +817,7 @@ class Scheduler:
                 if not self.schedule_one(timeout=0.05):
                     self.queue.flush_backoff_completed()
                     self.queue.flush_unschedulable_left_over()
+                    self.sweep_expired_assumes()
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()

@@ -17,6 +17,37 @@ storage/cacher/cacher.go:261, endpoints/handlers/watch.go:187). Semantics preser
 The store is thread-safe. Watch delivery is via per-subscriber unbounded queues;
 a slow watcher never blocks writers (the reference's Cacher drops/terminates slow
 watchers; we buffer instead — acceptable in-process).
+
+Concurrency (sharded locking): the store carries TWO locks so the scheduler's
+bind worker can commit whole batches without stalling every other client:
+
+  _lock      — the GLOBAL (RV) lock: resourceVersion allocation, the kind map,
+               every non-pod kind's rows, watcher registration, event history,
+               and event emission.
+  _pods_lock — the `pods` KIND SHARD: guards the pod rows only. bind_many
+               validates + clones under the shard ALONE (the expensive part),
+               so ingest/list/create traffic on other kinds proceeds
+               concurrently; the commit (contiguous RV range, row insertion,
+               event emission) then runs in ONE short critical section under
+               both locks, which keeps the List+Watch contract exact — a LIST
+               observes either none or all of the writes at the RV it returns.
+
+  LOCK-ORDERING RULE: _lock (RV/global) -> _pods_lock (kind shard), NEVER the
+  reverse. A thread holding the shard must not acquire the global lock
+  (bind_many RELEASES the shard between its validate and commit phases and
+  re-verifies stored-object identity instead of holding through). Reversing
+  the order deadlocks against every pod write.
+
+Event allocation (clone-free commits): pod events on the bind / status /
+delete hot paths are LAZY — the Event initially SHARES the stored object
+(safe: the store never mutates stored objects in place, later writes REPLACE
+them), and a private per-object clone is materialized at most once, on first
+delivery or replay to a non-coalescing watcher (_materialize_event). In the
+scheduler steady state (only coalescing watchers subscribed) a 100k-bind
+batch allocates ONE clone per pod instead of two. The external read-only
+event contract is unchanged: per-object watchers only ever receive (and
+replay) materialized private events, and the mutation detector fingerprints
+both forms, so a consumer mutating either is still caught.
 """
 
 from __future__ import annotations
@@ -24,7 +55,7 @@ from __future__ import annotations
 import copy
 import queue
 import threading
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..api.types import Pod
@@ -45,6 +76,12 @@ class Event:
     # watchers decide scope transitions the way the reference's watchCache
     # does (predicate on prevObj vs obj); read-only like obj.
     prev: Any = None
+    # lazy-materialization slot for hot-path pod events: a mutable
+    # [materialized Event or None, cloner] pair, None on eager events. The
+    # obj of a lazy event IS the stored object; APIStore._materialize_event
+    # builds (once) the private clone handed to non-coalescing watchers.
+    # compare=False keeps Event equality identical to the eager form.
+    lazy: Any = field(default=None, compare=False, repr=False)
 
 
 @dataclass(frozen=True)
@@ -157,10 +194,27 @@ def pod_structural_clone(pod):
 
 def _shallow(obj):
     """Shallow copy without copy.copy's __reduce_ex__ machinery (~4x
-    faster; this runs 3x per bind at 100k-bind rates)."""
+    faster; this runs 3x per bind at 100k-bind rates). Replacing the fresh
+    instance's __dict__ with a C-level dict copy beats update() into the
+    lazily-created empty dict by another ~30%."""
     new = object.__new__(obj.__class__)
-    new.__dict__.update(obj.__dict__)
+    new.__dict__ = obj.__dict__.copy()
     return new
+
+
+def _make_event(etype, kind, obj, rv, prev=None, lazy=None):
+    """Hot-path Event constructor: the frozen-dataclass __init__ goes through
+    object.__setattr__ per field (~1.8µs — real money at 100k events per
+    bind batch); building the instance dict directly is ~4x cheaper and
+    produces an identical instance (frozen dataclasses keep their fields in
+    __dict__)."""
+    ev = object.__new__(Event)
+    # frozen dataclasses also veto __dict__ assignment through their
+    # __setattr__ — go around it the same way their own __init__ does
+    object.__setattr__(ev, "__dict__",
+                       {"type": etype, "kind": kind, "obj": obj,
+                        "resource_version": rv, "prev": prev, "lazy": lazy})
+    return ev
 
 
 def pod_bind_clone(pod):
@@ -171,10 +225,19 @@ def pod_bind_clone(pod):
     source — the same read-only contract pod_structural_clone already applies
     to containers/tolerations/affinity, extended to the remaining members.
     Any later write that does touch those goes through pod_structural_clone
-    (update_pod_status, caller-facing returns), which re-privatizes them."""
-    new = _shallow(pod)
-    new.metadata = _shallow(pod.metadata)
-    new.spec = _shallow(pod.spec)
+    (update_pod_status, caller-facing returns), which re-privatizes them.
+
+    _shallow is inlined: this runs twice per bind (assume clone + store
+    commit clone) at 100k-bind rates, and the call overhead alone is
+    measurable there."""
+    new = object.__new__(pod.__class__)
+    new.__dict__ = pod.__dict__.copy()
+    meta = object.__new__(pod.metadata.__class__)
+    meta.__dict__ = pod.metadata.__dict__.copy()
+    spec = object.__new__(pod.spec.__class__)
+    spec.__dict__ = pod.spec.__dict__.copy()
+    new.metadata = meta
+    new.spec = spec
     return new
 
 
@@ -288,21 +351,54 @@ class Watch:
             pass  # consumer is behind anyway; it checks _stopped/terminated
 
 
+class _LockPair:
+    """Context manager acquiring the global RV lock then a kind shard, in the
+    module docstring's mandatory order (both RLocks, so nesting under either
+    is fine)."""
+
+    __slots__ = ("a", "b")
+
+    def __init__(self, a, b):
+        self.a, self.b = a, b
+
+    def __enter__(self):
+        self.a.acquire()
+        self.b.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.b.release()
+        self.a.release()
+
+
 class APIStore:
     """The hub every component is a client of (SURVEY.md §1)."""
 
     def __init__(self, deep_copy_on_write: bool = True,
-                 mutation_detector: Optional[bool] = None):
+                 mutation_detector: Optional[bool] = None,
+                 lazy_pod_events: Optional[bool] = None):
         import os
 
         self._lock = threading.RLock()
+        # the `pods` kind shard — see the module docstring's lock-ordering
+        # rule (_lock -> _pods_lock, never reversed)
+        self._pods_lock = threading.RLock()
+        self._pods_pair = _LockPair(self._lock, self._pods_lock)
         self._rv = 0  # monotonic resourceVersion, read via .rv
         if mutation_detector is None:
             mutation_detector = os.environ.get(
                 "CACHE_MUTATION_DETECTOR", "").lower() in ("1", "true")
         self._mutation_detector = MutationDetector() if mutation_detector else None
-        # kind -> {"namespace/name" or "name": obj}
-        self._objects: Dict[str, Dict[str, Any]] = {}
+        # lazy pod events (module docstring): default on; STORE_LAZY_POD_EVENTS=0
+        # or the constructor arg force the eager per-event clones (the parity
+        # oracle the columnar-pipeline tests compare against)
+        if lazy_pod_events is None:
+            lazy_pod_events = os.environ.get(
+                "STORE_LAZY_POD_EVENTS", "").lower() not in ("0", "false")
+        self._lazy_pod_events = lazy_pod_events
+        # kind -> {"namespace/name" or "name": obj}. The pods row dict exists
+        # from birth so shard-only paths never mutate the kind map.
+        self._objects: Dict[str, Dict[str, Any]] = {"pods": {}}
         # bounded event history for watch replay (RV-ordered)
         self._history: List[Event] = []
         self._history_limit = 200_000
@@ -318,6 +414,12 @@ class APIStore:
         """Current (highest committed) resourceVersion."""
         with self._lock:
             return self._rv
+
+    def _kind_lock(self, kind: str):
+        """The lock(s) an op touching `kind` rows plus RV/history must hold:
+        the global lock alone for most kinds, global + shard (in that order)
+        for pods."""
+        return self._pods_pair if kind == "pods" else self._lock
 
     @staticmethod
     def object_key(obj) -> str:
@@ -369,7 +471,48 @@ class APIStore:
         write paths pre-clone instead of paying a second deepcopy here).
         prev is the replaced stored object — orphaned from the store by this
         very write, so sharing it with watchers is safe (read-only)."""
-        ev = Event(etype, kind, obj, self._rv, prev)
+        self._emit_event(Event(etype, kind, obj, self._rv, prev))
+
+    def _pod_event(self, etype: str, obj, cloner, prev=None) -> Event:
+        """Event for a just-committed pod write (the clone-free commit hot
+        path). Lazy fast path: the event SHARES `obj` (the stored object, or
+        delete's orphaned post-delete clone — never mutated in place; later
+        writes replace the row) and materializes a private per-object clone
+        only for non-coalescing consumers (_materialize_event). Falls back
+        to the eager clone when lazy events are disabled (the parity oracle
+        knob) or the store doesn't isolate at all (deep_copy_on_write=False
+        shares everywhere already)."""
+        if not self._deep_copy:
+            return _make_event(etype, "pods", obj, self._rv, prev)
+        if self._lazy_pod_events:
+            return _make_event(etype, "pods", obj, self._rv, prev,
+                               lazy=[None, cloner])
+        return _make_event(etype, "pods", cloner(obj), self._rv, prev)
+
+    def _materialize_event(self, ev: Event) -> Event:
+        """The per-object form of a lazy event: a private clone of the shared
+        stored object, built at most ONCE (first delivery or replay to a
+        non-coalescing watcher) and reused for every later per-object
+        consumer — all of them see the same object identity, exactly like
+        the eager path. Callers hold _lock. The detector fingerprints the
+        materialized object too, so a watcher mutating it is caught even
+        though the emission-time record covered only the shared form."""
+        lazy = ev.lazy
+        if lazy is None:
+            return ev
+        mat = lazy[0]
+        if mat is None:
+            mat = _make_event(ev.type, ev.kind, lazy[1](ev.obj),
+                              ev.resource_version, ev.prev)
+            if self._mutation_detector is not None:
+                self._mutation_detector.record(mat)
+            lazy[0] = mat
+        return mat
+
+    def _emit_event(self, ev: Event) -> None:
+        """History + delivery for one event. Lazy events reach coalescing
+        watchers (and history) in their shared form; per-object watchers get
+        the materialized private clone."""
         if self._mutation_detector is not None:
             self._mutation_detector.record(ev)
         self._history.append(ev)
@@ -379,7 +522,10 @@ class APIStore:
             del self._history[:drop]
         # snapshot: _deliver may evict (unsubscribe) a slow watcher mid-loop
         for w in list(self._watchers):
-            w._deliver(ev)
+            if ev.lazy is not None and not w.coalesce:
+                w._deliver(self._materialize_event(ev))
+            else:
+                w._deliver(ev)
 
     def _emit_batch(self, etype: str, kind: str, events: List[Event],
                     origin: Optional[str]) -> None:
@@ -387,7 +533,8 @@ class APIStore:
         per-object watcher (external semantics unchanged — ordering and rv
         monotonicity are the list order), while coalesce=True watchers get a
         single CoalescedEvent for the whole batch (the internal fast path;
-        one buffered item, one wake-up)."""
+        one buffered item, one wake-up). Lazy events materialize their
+        per-object clones once for the whole watcher set."""
         if not events:
             return
         if self._mutation_detector is not None:
@@ -399,6 +546,7 @@ class APIStore:
             self._history_floor_rv = self._history[drop - 1].resource_version
             del self._history[:drop]
         cev = None
+        mat = None
         for w in list(self._watchers):
             if w.coalesce:
                 if cev is None:
@@ -406,13 +554,15 @@ class APIStore:
                                          events[-1].resource_version, origin)
                 w._deliver_coalesced(cev)
             else:
-                for ev in events:
+                if mat is None:
+                    mat = [self._materialize_event(ev) for ev in events]
+                for ev in mat:
                     w._deliver(ev)
 
     # -- CRUD ------------------------------------------------------------------
 
     def create(self, kind: str, obj) -> Any:
-        with self._lock:
+        with self._kind_lock(kind):
             objs = self._objects.setdefault(kind, {})
             key = self.object_key(obj)
             if key in objs:
@@ -439,7 +589,7 @@ class APIStore:
         errors: List[Tuple[str, str]] = []
         created = 0
         events: List[Event] = []
-        with self._lock:
+        with self._kind_lock(kind):
             objs = self._objects.setdefault(kind, {})
             for obj in objects:
                 key = self.object_key(obj)
@@ -451,22 +601,27 @@ class APIStore:
                 self._rv += 1
                 obj.metadata.resource_version = self._rv
                 objs[key] = obj
-                events.append(Event(ADDED, kind, self._event_copy(obj), self._rv))
+                events.append(_make_event(ADDED, kind, self._event_copy(obj),
+                                          self._rv))
                 created += 1
             self._emit_batch(ADDED, kind, events, origin)
         return created, errors
 
     def get(self, kind: str, key: str) -> Any:
         """Returns a copy (when deep_copy_on_write) — like a REST GET, each read is a
-        fresh decode, so caller mutation can never corrupt stored state."""
-        with self._lock:
+        fresh decode, so caller mutation can never corrupt stored state.
+        Pod reads take the kind shard alone (no RV is returned, and every
+        pod-row commit holds the shard), so a bind batch in its clone phase
+        never stalls them on the global lock."""
+        lock = self._pods_lock if kind == "pods" else self._lock
+        with lock:
             try:
                 return self._copy(self._objects.get(kind, {})[key])
             except KeyError:
                 raise NotFoundError(f"{kind} {key} not found") from None
 
     def update(self, kind: str, obj, check_rv: bool = True) -> Any:
-        with self._lock:
+        with self._kind_lock(kind):
             objs = self._objects.setdefault(kind, {})
             key = self.object_key(obj)
             if key not in objs:
@@ -496,22 +651,26 @@ class APIStore:
         raise ConflictError(f"{kind} {key}: too many conflicts")
 
     def delete(self, kind: str, key: str) -> Any:
-        with self._lock:
+        with self._kind_lock(kind):
             objs = self._objects.get(kind, {})
             if key not in objs:
                 raise NotFoundError(f"{kind} {key} not found")
             old = objs.pop(key)
             # The DELETED event carries the object at its post-delete RV (client-go
             # convention: watchers track progress from obj.metadata.resourceVersion).
-            # Pods take structural clones (hot under preemption victim storms:
-            # the async preparation worker deletes victims at batch rate);
-            # other kinds keep the deepcopy + event-copy pair.
+            # Pods take ONE structural clone (hot under preemption victim
+            # storms: the async preparation worker deletes victims at batch
+            # rate): the stamped clone is shared lazily with the event AND
+            # returned — the return value is the history/event object, so it
+            # carries the event read-only contract (the mutation detector
+            # polices it; in-repo delete consumers serialize or discard it).
+            # Other kinds keep the deepcopy + event-copy pair.
             if self._deep_copy and type(old) is Pod:
                 obj = pod_structural_clone(old)
                 self._rv += 1
                 obj.metadata.resource_version = self._rv
-                self._emit_prepared(DELETED, kind,
-                                    pod_structural_clone(obj), prev=old)
+                self._emit_event(self._pod_event(
+                    DELETED, obj, pod_structural_clone, prev=old))
                 return obj
             obj = self._copy(old)
             self._rv += 1
@@ -522,7 +681,7 @@ class APIStore:
     def list(self, kind: str, predicate: Optional[Callable[[Any], bool]] = None) -> Tuple[List[Any], int]:
         """Consistent snapshot + the RV it is current to. Items are copies (when
         deep_copy_on_write), like a REST LIST response."""
-        with self._lock:
+        with self._kind_lock(kind):
             items = list(self._objects.get(kind, {}).values())
             if predicate is not None:
                 items = [o for o in items if predicate(o)]
@@ -532,7 +691,9 @@ class APIStore:
         """Consistent multi-kind snapshot under one RV — the safe way to seed an
         informer over several kinds (a per-kind list+watch would race: an object
         created between two lists is in neither the lists nor the replay)."""
-        with self._lock:
+        kinds = list(kinds)
+        lock = self._pods_pair if "pods" in kinds else self._lock
+        with lock:
             out = {k: [self._copy(o) for o in self._objects.get(k, {}).values()] for k in kinds}
             return out, self._rv
 
@@ -545,11 +706,17 @@ class APIStore:
         with self._lock:
             return [k for k, objs in self._objects.items() if objs]
 
-    def transaction(self):
-        """Hold the store lock across several operations (reentrant), making a
-        read-check-write sequence atomic against other threads — the stand-in
-        for the reference's etcd txn around quota check+create."""
-        return self._lock
+    def transaction(self, kind: Optional[str] = None):
+        """Hold the store locks across several operations (reentrant), making
+        a read-check-write sequence atomic against other threads — the
+        stand-in for the reference's etcd txn around quota check+create.
+        Default (kind=None) takes global + pods shard in the mandatory order
+        — safe for any sequence. Callers that provably never touch pod rows
+        can pass their kind to take the global lock alone, so they don't
+        stall holding it behind a bind batch's shard-only clone phase."""
+        if kind is not None and kind != "pods":
+            return self._lock
+        return self._pods_pair
 
     # -- watch -----------------------------------------------------------------
 
@@ -579,7 +746,9 @@ class APIStore:
                         f"the watch buffer ({maxsize}); relist required")
             w = Watch(self, kind, maxsize=maxsize, coalesce=coalesce)
             for ev in replay:
-                w._deliver(ev)
+                # a non-coalescing subscriber arriving mid/after a lazy batch
+                # must see fully private event objects, same as live delivery
+                w._deliver(ev if coalesce else self._materialize_event(ev))
             self._watchers.append(w)
             return w
 
@@ -604,10 +773,10 @@ class APIStore:
         if the pod is already bound to a different node).
 
         Hot path: binds happen at batch-solver rate (the north star is 100k),
-        so the stored object and the event object are STRUCTURAL clones
-        (fresh Pod/metadata/spec/status, shared immutable innards like
-        containers) instead of three deepcopies — see pod_structural_clone."""
-        with self._lock:
+        so the stored object is ONE bind-specialized clone and the event
+        shares it lazily (_pod_event) — per-object watchers get their private
+        clone on first delivery."""
+        with self._pods_pair:
             key = f"{namespace}/{name}"
             pod = self._pod_internal(key)
             if pod.spec.node_name:
@@ -617,8 +786,8 @@ class APIStore:
             self._rv += 1
             new.metadata.resource_version = self._rv
             self._objects["pods"][key] = new
-            self._emit_prepared(MODIFIED, "pods", pod_bind_clone(new),
-                                prev=pod)
+            self._emit_event(self._pod_event(MODIFIED, new, pod_bind_clone,
+                                             prev=pod))
             # the caller's copy is distinct from both the stored object and
             # the event object (mutating it must corrupt neither); the full
             # structural clone re-privatizes the metadata containers too
@@ -633,13 +802,21 @@ class APIStore:
         BindingREST calls back-to-back).
 
         origin tags the batch's CoalescedEvent so the writer can recognize
-        (and bulk-confirm) its own bind MODIFIED events on re-ingest; foreign
-        consumers and per-object watchers are unaffected."""
+        its own bind MODIFIED events on re-ingest (the scheduler's bind
+        worker confirms its assumes directly and skips them); foreign
+        consumers and per-object watchers are unaffected.
+
+        Two phases (module docstring lock-ordering rule): validate + ONE
+        pod_bind_clone per pod under the kind shard ALONE — the expensive
+        part, concurrent with every non-pod store client — then a short
+        commit under global+shard that stamps a contiguous RV range, inserts
+        the rows, and emits lazy events sharing the stored objects. Rows
+        that changed between the phases (a concurrent store.bind from the
+        serial fallback path) are re-validated by stored-object identity."""
         errors: List[Tuple[str, str]] = []
-        bound = 0
-        events: List[Event] = []
-        with self._lock:
-            pods = self._objects.setdefault("pods", {})
+        prepared: List = []  # (key, old stored pod, new clone, node_name)
+        pods = self._objects["pods"]
+        with self._pods_lock:
             for namespace, name, node_name in bindings:
                 key = f"{namespace}/{name}"
                 pod = pods.get(key)
@@ -652,19 +829,58 @@ class APIStore:
                     continue
                 new = pod_bind_clone(pod)
                 new.spec.node_name = node_name
-                self._rv += 1
-                new.metadata.resource_version = self._rv
-                pods[key] = new
-                events.append(Event(MODIFIED, "pods", pod_bind_clone(new),
-                                    self._rv, pod))
-                bound += 1
-            self._emit_batch(MODIFIED, "pods", events, origin)
+                prepared.append((key, pod, new, node_name))
+        bound = 0
+        if not prepared:
+            return bound, errors
+        events: List[Event] = []
+        # mode decided once per batch; rv and the event constructor live in
+        # locals — the loop below runs 100k times per north-star solve
+        lazy_on = self._deep_copy and self._lazy_pod_events
+        eager = self._deep_copy and not self._lazy_pod_events
+        append = events.append
+        get = pods.get
+        with self._lock:
+            with self._pods_lock:
+                rv = self._rv
+                for key, old, new, node_name in prepared:
+                    if get(key) is not old:
+                        # raced between the phases: re-validate on the
+                        # current row (also catches duplicate keys within
+                        # one batch — the second commit sees the first)
+                        cur = get(key)
+                        if cur is None:
+                            errors.append((key, f"pods {key} not found"))
+                            continue
+                        if cur.spec.node_name:
+                            errors.append(
+                                (key, f"pod {key} is already bound to "
+                                      f"{cur.spec.node_name}"))
+                            continue
+                        old = cur
+                        new = pod_bind_clone(cur)
+                        new.spec.node_name = node_name
+                    rv += 1
+                    new.metadata.resource_version = rv
+                    pods[key] = new
+                    if lazy_on:
+                        append(_make_event(MODIFIED, "pods", new, rv, old,
+                                           [None, pod_bind_clone]))
+                    elif eager:
+                        append(_make_event(MODIFIED, "pods",
+                                           pod_bind_clone(new), rv, old))
+                    else:
+                        append(_make_event(MODIFIED, "pods", new, rv, old))
+                    bound += 1
+                self._rv = rv
+                self._emit_batch(MODIFIED, "pods", events, origin)
         return bound, errors
 
     def update_pod_status(self, namespace: str, name: str, mutate_status: Callable[[Any], None]) -> Any:
-        """Status-subresource write (hot under failure storms: one structural
-        clone for the store, one for the event, no deepcopies)."""
-        with self._lock:
+        """Status-subresource write (hot under failure storms: ONE structural
+        clone for the store; the event shares it lazily, the caller's return
+        stays a private clone)."""
+        with self._pods_pair:
             key = f"{namespace}/{name}"
             old = self._pod_internal(key)
             pod = pod_structural_clone(old)
@@ -672,6 +888,6 @@ class APIStore:
             self._rv += 1
             pod.metadata.resource_version = self._rv
             self._objects["pods"][key] = pod
-            self._emit_prepared(MODIFIED, "pods", pod_structural_clone(pod),
-                                prev=old)
+            self._emit_event(self._pod_event(MODIFIED, pod,
+                                             pod_structural_clone, prev=old))
             return pod_structural_clone(pod)

@@ -46,6 +46,13 @@ def test_bench_quick_runs_and_emits_json():
         ns["instrumentation_s"], wall)
     basic = workloads.get("SchedulingBasic", {})
     assert "error" not in basic, basic
+    # the bind-commit micro-rung (ISSUE 4): pods/s through store.bind_many
+    # alone — a regression in the clone-free lazy-event commit path (or the
+    # sharded lock) fails loudly here without the full ladder
+    bc = workloads["BindCommit_20k"]
+    assert "error" not in bc, bc
+    assert bc["placed"] == bc["pods"] > 0
+    assert bc["pods_per_sec"] > 0
     # the gang rung (ISSUE 2): every member of every gang binds, all-or-
     # nothing never fires on the happy path
     gang = workloads["GangScheduling_2k_250"]

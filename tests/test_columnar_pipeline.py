@@ -12,16 +12,41 @@ Covers the ISSUE 1 acceptance surface:
   - async bind failures are surfaced to schedule_batch callers.
 """
 
+import json
+
 import numpy as np
 import pytest
 
+from kubernetes_tpu.api.serialize import to_dict
 from kubernetes_tpu.scheduler import Framework
 from kubernetes_tpu.scheduler.batch import BatchScheduler
 from kubernetes_tpu.scheduler.plugins import default_plugins
 from kubernetes_tpu.scheduler.queue import SchedulingQueue
-from kubernetes_tpu.store import ADDED, MODIFIED, APIStore, CoalescedEvent
+from kubernetes_tpu.store import (ADDED, DELETED, MODIFIED, APIStore,
+                                  CoalescedEvent)
 from kubernetes_tpu.testing import MakeNode, MakePod
 from kubernetes_tpu.utils import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    """ISSUE 4 CI satellite: every store this module builds runs with the
+    mutation detector FORCE-ENABLED, and every store is checked at teardown —
+    a clone-sharing regression on the lazy-event fast path (a consumer
+    mutation reaching a stored object, or vice versa) fails tier-1 here
+    instead of corrupting watchers silently."""
+    monkeypatch.setenv("CACHE_MUTATION_DETECTOR", "1")
+    stores = []
+    orig = APIStore.__init__
+
+    def wrapped(self, *a, **kw):
+        orig(self, *a, **kw)
+        stores.append(self)
+
+    monkeypatch.setattr(APIStore, "__init__", wrapped)
+    yield
+    for s in stores:
+        s.check_mutations()
 
 
 def _nodes(n, cpu="8", mem="32Gi"):
@@ -111,6 +136,127 @@ def test_mutation_detector_covers_coalesced_events():
 
     with pytest.raises(MutationDetectedError):
         store.check_mutations()
+    # repair: the module-level fixture re-checks every store at teardown
+    del cev.events[1].obj.metadata.labels["oops"]
+
+
+# -- lazy (clone-free) pod events ----------------------------------------------
+
+
+def _norm(obj):
+    """Comparable byte form of an event object, with the auto-generated uid
+    (a process-global counter, different between two store runs) dropped."""
+    d = to_dict(obj)
+    d.get("metadata", {}).pop("uid", None)
+    return json.dumps(d, sort_keys=True)
+
+
+def _event_stream(store, writes):
+    """Run `writes` against `store` with a per-object pod watcher subscribed
+    from the start; returns the drained stream as comparable tuples."""
+    w = store.watch(kind=("pods",))
+    writes(store)
+    out = []
+    for ev in w.drain():
+        out.append((ev.type, ev.resource_version, _norm(ev.obj),
+                    _norm(ev.prev) if ev.prev is not None else None))
+    return out
+
+
+def _hot_path_writes(store):
+    """Exercise every clone-free commit path: bind_many, single bind,
+    update_pod_status, and the (preemption-shaped) pod delete loop."""
+    store.create_many("pods", _pods(12))
+    assert store.bind_many(
+        [("default", f"p-{i}", f"node-{i % 3}") for i in range(8)],
+        origin="me") == (8, [])
+    store.bind("default", "p-8", "node-0")
+
+    def set_phase(st):
+        st.phase = "Running"
+
+    for i in range(6):
+        store.update_pod_status("default", f"p-{i}", set_phase)
+    for i in range(4):
+        store.delete("pods", f"default/p-{i}")
+
+
+def test_per_object_stream_identical_with_lazy_events_on_and_off():
+    """ISSUE 4 acceptance: per-object watchers observe byte-identical event
+    streams (order, rv, object and prev content) with the clone-free lazy
+    path on vs off — under the mutation detector (module fixture)."""
+    fast = _event_stream(APIStore(lazy_pod_events=True), _hot_path_writes)
+    slow = _event_stream(APIStore(lazy_pod_events=False), _hot_path_writes)
+    assert fast == slow
+    # the stream covers all three event types at identical rvs
+    assert {t for t, *_ in fast} == {ADDED, MODIFIED, DELETED}
+
+
+def test_lazy_materialized_event_objects_are_private():
+    """A per-object watcher subscribed DURING a lazy batch must never hold
+    the stored object itself: mutating its event objects must not corrupt
+    store state (and is caught by the detector)."""
+    store = APIStore()
+    w = store.watch(kind=("pods",))
+    store.create_many("pods", _pods(5))
+    store.bind_many([("default", f"p-{i}", "node-1") for i in range(5)],
+                    origin="me")
+    evs = [e for e in w.drain() if e.type == MODIFIED]
+    assert len(evs) == 5
+    for ev in evs:
+        stored = store._objects["pods"][ev.obj.key]
+        assert ev.obj is not stored
+        assert ev.obj.spec is not stored.spec
+        assert ev.obj.spec.node_name == stored.spec.node_name == "node-1"
+
+
+def test_non_coalescing_watcher_subscribing_mid_batch_sees_private_objects():
+    """ISSUE 4 satellite: with ONLY coalescing watchers at write time the
+    lazy fast path shares the stored object; a non-coalescing watcher
+    subscribing afterwards (replay) must still get fully private event
+    objects with identical content."""
+    store = APIStore()
+    fast = store.watch(kind=("pods",), coalesce=True)
+    rv0 = store.rv
+    store.create_many("pods", _pods(6))
+    store.bind_many([("default", f"p-{i}", "node-2") for i in range(6)],
+                    origin="me")
+    # the in-flight coalesced events really do share the stored objects
+    # (the steady-state hot path this PR buys)
+    cevs = [c for c in fast.drain() if c.type == MODIFIED]
+    assert any(ev.obj is store._objects["pods"][ev.obj.key]
+               for c in cevs for ev in c.events)
+    late = store.watch(kind=("pods",), since_rv=rv0)
+    evs = [e for e in late.drain() if e.type == MODIFIED]
+    assert len(evs) == 6
+    for ev in evs:
+        stored = store._objects["pods"][ev.obj.key]
+        assert ev.obj is not stored
+        assert json.dumps(to_dict(ev.obj), sort_keys=True) == \
+            json.dumps(to_dict(stored), sort_keys=True)
+
+
+def test_mutating_lazily_materialized_event_is_caught():
+    """ISSUE 4 satellite: the detector fingerprints the materialized clone
+    too — a watcher mutating a lazily-materialized event object is caught
+    even though emission recorded only the shared form."""
+    from kubernetes_tpu.store import MutationDetectedError
+
+    store = APIStore(mutation_detector=True)
+    store.watch(kind=("pods",), coalesce=True)  # keeps the lazy path hot
+    store.create_many("pods", _pods(3))
+    store.bind_many([("default", f"p-{i}", "node-0") for i in range(3)],
+                    origin="me")
+    # materialization happens at subscribe/replay time for this watcher
+    late = store.watch(kind=("pods",), since_rv=0)
+    ev = [e for e in late.drain() if e.type == MODIFIED][1]
+    store.check_mutations()
+    ev.obj.spec.node_name = "node-hacked"
+    with pytest.raises(MutationDetectedError):
+        store.check_mutations()
+    ev.obj.spec.node_name = "node-0"  # repair for the teardown check
+    # the stored object was never the mutated one: store state is intact
+    assert store._objects["pods"][ev.obj.key].spec.node_name == "node-0"
 
 
 # -- scheduler ingest: self-bind short-circuit + foreign binds -----------------

@@ -491,3 +491,58 @@ def test_bound_members_count_toward_quorum():
     sched.run_until_idle()
     sched.pump_events()
     assert len(_bound(store, "g-")) == 4
+
+
+def test_expired_assumes_count_back_out_of_quorum():
+    """ISSUE 4 satellite: the quorum leak the PR 3 gauge measured is now
+    CONSUMED — an assumed member whose bind never confirms expires out of
+    the cache AND out of the gang's placed set, and the member re-enters
+    the queue (re-staging under its gang) instead of stranding in limbo."""
+    from kubernetes_tpu.scheduler.cache import Cache
+
+    clock = FakeClock()
+    store = APIStore()
+    for n in _nodes(4, cpu="8", mem="16Gi"):
+        store.create("nodes", n)
+    store.create("podgroups", make_pod_group("train", 2))
+    sched = _sched(store, clock=clock)
+    assert isinstance(sched.cache, Cache)
+    # hand-assume a member the way the batch path does, finish its binding
+    # so the ttl clock starts — but never let the bind confirm
+    member = MakePod("exp-0").gang("train").req({"cpu": "1"}).obj()
+    store.create("pods", member)
+    sched.pump_events()
+    assumed = store.get("pods", "default/exp-0")
+    sched.queue.delete_key("default/exp-0")  # popped by a fictional batch
+    sched.cache.assume_pod(assumed, "node-0")
+    sched.cache.finish_binding(assumed)
+    sched.gangs.note_assumed(assumed)
+    assert sched.gangs.placed_count("default/train") == 1
+    assert sched.gangs.quorum_expired_count(sched.cache.contains) == 0
+    clock.step(sched.cache._ttl + 1)
+    # the leak the sweep is about to consume is visible first
+    expired_preview = [k for k, dl in sched.cache._assumed.items()
+                       if dl and dl < clock.now()]
+    assert expired_preview == ["default/exp-0"]
+    expired = sched.sweep_expired_assumes()
+    assert expired == ["default/exp-0"]
+    # counted back OUT of the quorum...
+    assert sched.gangs.placed_count("default/train") == 0
+    assert sched.gangs.quorum_expired_count(sched.cache.contains) == 0
+    # ...and the member is back in the queue, re-staged under its gang
+    # (quorum 2 with only 1 staged member: it waits rather than admits)
+    assert "default/exp-0" in sched.queue.tracked_keys()
+    assert sched.queue.gang_staged_count() == 1
+
+
+def test_note_expired_keys_removes_only_named_members():
+    gd = GangDirectory()
+    gd.observe_podgroup("ADDED", make_pod_group("a", 3))
+    for i in range(3):
+        gd.note_assumed(MakePod(f"a-{i}").gang("a").obj())
+    assert gd.placed_count("default/a") == 3
+    assert gd.note_expired_keys(["default/a-1", "default/zzz"]) == 1
+    assert gd.placed_count("default/a") == 2
+    # removing the rest empties and drops the group entry
+    assert gd.note_expired_keys(["default/a-0", "default/a-2"]) == 2
+    assert gd.placed_count("default/a") == 0
