@@ -2,7 +2,12 @@
 
 Control plane runs behind leader election (ControlPlane — the
 cmd/kube-scheduler server.go:281 / controller-manager wiring): the full
-controller set including DisruptionController, so PDB status stays live."""
+controller set including DisruptionController, so PDB status stays live.
+
+Debug knobs (read by APIStore at construction, so they apply here too):
+STORE_LOCK_ORDER_CHECK=1 arms the runtime lock-order assertion (schedlint
+LK001's dynamic companion), CACHE_MUTATION_DETECTOR=1 the event mutation
+detector."""
 import sys, time
 from kubernetes_tpu.agent import HollowCluster
 from kubernetes_tpu.server import APIServer

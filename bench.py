@@ -520,11 +520,18 @@ def rung_north_star_endtoend(results):
         gc.freeze()
         gc.disable()
         sched.flightrec.clear()  # stage table covers EXACTLY the timed window
+        # jit-cache watermark (ISSUE 5 retrace guard): the warm-up compiled
+        # every shape the timed run uses, so a nonzero delta below IS a
+        # mid-run retrace — the regression class JT001 guards statically
+        compiles0 = _solver_jit_cache()
         t0 = time.perf_counter()
         sched.run_until_idle()
         dt = time.perf_counter() - t0
         gc.enable()
         gc.unfreeze()
+        jit_cache = _solver_jit_cache()
+        compiles_during = {k: v - compiles0.get(k, 0)
+                          for k, v in jit_cache.items() if v >= 0}
         bound = sched.scheduled_count
         pps = bound / dt
         # machine-generated stage breakdown (scheduler/flightrec.py): the
@@ -544,7 +551,9 @@ def rung_north_star_endtoend(results):
             "placed": bound, "pods": n_pods, "solver": "fast+store-binds",
             "stages": stages,
             "stages_serial_sum_s": serial_sum,
-            "instrumentation_s": round(sched.flightrec.self_seconds, 6)}
+            "instrumentation_s": round(sched.flightrec.self_seconds, 6),
+            "jit_cache": jit_cache,
+            "solver_compiles_during_run": sum(compiles_during.values())}
         print(f"{'NorthStar_100k_10k_endtoend':>28}: {pps:>9.0f} pods/s  "
               f"({bound}/{n_pods} BOUND through the store in {dt:.3f}s)",
               file=sys.stderr)
@@ -554,6 +563,50 @@ def rung_north_star_endtoend(results):
     except Exception as e:
         results["NorthStar_100k_10k_endtoend"] = {"error": str(e)[:200]}
         print(f"NorthStar_100k_10k_endtoend: ERROR {e}", file=sys.stderr)
+
+
+def _solver_jit_cache():
+    """Per-solver compiled-variant counts (jax's per-function jit cache).
+    Stable counts across same-bucket batches = the cache is hot; a growing
+    count is retrace churn (tens of seconds per compile at TPU scale).
+    -1 when the introspection API is unavailable."""
+    from kubernetes_tpu.models.transport import _auction_phase, _sinkhorn_iters
+    from kubernetes_tpu.models.waterfill import waterfill_group
+    from kubernetes_tpu.ops.solver import greedy_scan_solve
+
+    out = {}
+    for name, fn in (("waterfill_group", waterfill_group),
+                     ("greedy_scan_solve", greedy_scan_solve),
+                     ("auction_phase", _auction_phase),
+                     ("sinkhorn_iters", _sinkhorn_iters)):
+        try:
+            out[name] = int(fn._cache_size())
+        except Exception:
+            out[name] = -1
+    return out
+
+
+def rung_schedlint(results):
+    """SchedLint_tree: the static-analysis gate's whole-tree self-time. The
+    analyzer runs inside tier-1 (tests/test_schedlint.py), so its wall time
+    is a budget like the flight recorder's: tests/test_bench_quick.py
+    asserts it stays cheap AND clean (0 findings) so the gate can't quietly
+    become the slowest — or a red — part of tier-1."""
+    from kubernetes_tpu.analysis.schedlint import package_root, run_paths
+
+    try:
+        t0 = time.perf_counter()
+        findings, stats = run_paths([package_root()])
+        dt = time.perf_counter() - t0
+        results["SchedLint_tree"] = {
+            "wall_s": round(dt, 3), "findings": len(findings),
+            "suppressed": stats["suppressed"], "files": stats["files"]}
+        print(f"{'SchedLint_tree':>28}: {stats['files']} files, "
+              f"{len(findings)} findings, {stats['suppressed']} suppressed "
+              f"in {dt:.2f}s", file=sys.stderr)
+    except Exception as e:
+        results["SchedLint_tree"] = {"error": str(e)[:200]}
+        print(f"SchedLint_tree: ERROR {e}", file=sys.stderr)
 
 
 def rung_bind_commit(results):
@@ -902,6 +955,7 @@ RUNGS = [
     ("NorthStarEndToEnd", rung_north_star_endtoend),
     ("BindCommit", rung_bind_commit),
     ("GangScheduling", rung_gang),
+    ("SchedLint", rung_schedlint),
     ("Transport", rung_transport),
     ("ApiserverWatchFanout", rung_watch_fanout),
 ]
@@ -911,7 +965,7 @@ RUNGS = [
 # stdout. Catches perf-path regressions (a broken coalesced ingest or bind
 # path fails loudly here) without the full ladder's budget.
 QUICK_RUNGS = ("SchedulingBasic", "MixedChurn", "NorthStarEndToEnd",
-               "BindCommit", "GangScheduling")
+               "BindCommit", "GangScheduling", "SchedLint")
 QUICK_BUDGET_S = 55.0
 
 

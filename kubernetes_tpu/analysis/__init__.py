@@ -1,0 +1,11 @@
+"""schedlint — the project-native static analyzer for the scheduler's
+concurrency and clone-discipline invariants (see analysis/schedlint.py).
+
+The rules are the hack/verify-* analog of the reference Kubernetes: each one
+encodes an invariant that is documented in prose somewhere in this tree
+(store/store.py lock ordering, the event read-only contract, the jit static
+gates) and that tier-1's behavioral tests cannot see until it has already
+cost a deadlock, a corrupted watcher, or a mid-run XLA recompile.
+"""
+
+from .schedlint import Finding, run, run_paths  # noqa: F401

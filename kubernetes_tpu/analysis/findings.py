@@ -1,0 +1,25 @@
+"""The schedlint finding record (its own module so rule modules can import
+it without touching the driver)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class Finding:
+    rule: str
+    file: str
+    line: int
+    message: str
+    hint: str = ""
+
+    def render(self) -> str:
+        out = f"{self.file}:{self.line}: {self.rule} {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "file": self.file, "line": self.line,
+                "message": self.message, "hint": self.hint}

@@ -1,0 +1,294 @@
+"""schedlint's project model: parsed files, function/class tables, import
+maps, inline suppressions, and name-based call resolution.
+
+The resolution strategy is deliberately project-native rather than sound:
+`self.m()` resolves within the enclosing class (then its in-tree bases),
+bare names resolve through module-level defs and `from x import y` maps, and
+`obj.m()` resolves only when exactly ONE class in the analyzed tree defines
+`m` — ambiguous names stay unresolved and the rules treat them as opaque.
+That trades missed paths for near-zero false positives, which is what lets
+the whole-tree run gate tier-1 at zero findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+SUPPRESS_RE = re.compile(
+    r"#\s*schedlint:\s*allow\(\s*([A-Za-z0-9_\s,]*?)\s*\)\s*(.*)$")
+
+
+@dataclass
+class Suppression:
+    line: int
+    rules: Set[str]            # empty set = allow everything on the line
+    reason: str
+    comment_only: bool         # suppression on its own line applies to line+1
+
+    def covers(self, rule: str) -> bool:
+        return not self.rules or rule in self.rules
+
+
+@dataclass
+class FuncInfo:
+    name: str
+    qualname: str              # module.Class.name or module.name
+    class_name: Optional[str]
+    module: str
+    file: "FileIndex"
+    node: ast.AST              # FunctionDef / AsyncFunctionDef
+
+    def __hash__(self):
+        return id(self.node)
+
+    def __eq__(self, other):
+        return isinstance(other, FuncInfo) and other.node is self.node
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    bases: List[str]
+    methods: Dict[str, FuncInfo] = field(default_factory=dict)
+
+
+@dataclass
+class FileIndex:
+    path: str                  # absolute (or fixture) path
+    rel: str                   # display path
+    module: str                # dotted module name
+    tree: ast.Module
+    lines: List[str]
+    suppressions: Dict[int, Suppression] = field(default_factory=dict)
+    functions: List[FuncInfo] = field(default_factory=list)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    # local name -> dotted import target (module or module.attr); collected
+    # from every Import/ImportFrom in the file, nested ones included (the
+    # tree imports heavy deps at function scope on purpose)
+    imports: Dict[str, str] = field(default_factory=dict)
+
+
+def _collect_suppressions(fi: FileIndex) -> None:
+    for lineno, raw in enumerate(fi.lines, start=1):
+        m = SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = m.group(2).strip()
+        comment_only = raw[: m.start()].strip() == ""
+        fi.suppressions[lineno] = Suppression(lineno, rules, reason,
+                                              comment_only)
+
+
+def _collect_imports(fi: FileIndex) -> None:
+    for node in ast.walk(fi.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                fi.imports[alias.asname or alias.name.split(".")[0]] = \
+                    alias.name
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                # relative import: resolve against this file's module
+                parts = fi.module.split(".")
+                parts = parts[: len(parts) - node.level]
+                base = ".".join(parts + ([node.module] if node.module else []))
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                fi.imports[alias.asname or alias.name] = \
+                    f"{base}.{alias.name}" if base else alias.name
+
+
+def _collect_defs(fi: FileIndex) -> None:
+    for node in fi.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fi.functions.append(FuncInfo(
+                node.name, f"{fi.module}.{node.name}", None, fi.module,
+                fi, node))
+        elif isinstance(node, ast.ClassDef):
+            ci = ClassInfo(node.name,
+                           [b.id for b in node.bases
+                            if isinstance(b, ast.Name)])
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    info = FuncInfo(
+                        sub.name, f"{fi.module}.{node.name}.{sub.name}",
+                        node.name, fi.module, fi, sub)
+                    ci.methods[sub.name] = info
+                    fi.functions.append(info)
+            fi.classes[node.name] = ci
+
+
+class ProjectIndex:
+    """The analyzed tree: every parsed file plus cross-file lookup tables."""
+
+    def __init__(self):
+        self.files: List[FileIndex] = []
+        self.errors: List[Tuple[str, str]] = []  # (path, parse error)
+        # lookup tables (built by _finish)
+        self.module_files: Dict[str, FileIndex] = {}
+        self.methods_by_name: Dict[str, List[FuncInfo]] = {}
+        self.module_funcs: Dict[Tuple[str, str], FuncInfo] = {}
+        self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+
+    # -- construction ----------------------------------------------------------
+
+    @classmethod
+    def from_paths(cls, paths: List[str]) -> "ProjectIndex":
+        idx = cls()
+        for path in paths:
+            if os.path.isdir(path):
+                before = len(idx.files) + len(idx.errors)
+                for dirpath, dirnames, filenames in os.walk(path):
+                    dirnames[:] = [d for d in dirnames
+                                   if d != "__pycache__"
+                                   and not d.startswith(".")]
+                    for fn in sorted(filenames):
+                        if fn.endswith(".py"):
+                            idx.add_file(os.path.join(dirpath, fn))
+                if len(idx.files) + len(idx.errors) == before:
+                    idx.errors.append((path, "directory contains no .py "
+                                             "files — nothing analyzed"))
+            elif os.path.isfile(path) and path.endswith(".py"):
+                idx.add_file(path)
+            else:
+                # a typo'd target must NOT report a clean tree with exit 0
+                idx.errors.append(
+                    (path, "no such file/directory (or not a .py file)"))
+        idx._finish()
+        return idx
+
+    @classmethod
+    def from_source(cls, source: str, filename: str = "fixture.py",
+                    module: str = "fixture") -> "ProjectIndex":
+        idx = cls()
+        idx.add_source(source, filename, module)
+        idx._finish()
+        return idx
+
+    def add_file(self, path: str) -> None:
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            self.errors.append((path, str(e)))
+            return
+        self.add_source(source, path, _module_name(path))
+
+    def add_source(self, source: str, path: str, module: str) -> None:
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.errors.append((path, f"syntax error: {e}"))
+            return
+        rel = os.path.relpath(path) if os.path.isabs(path) else path
+        fi = FileIndex(path=path, rel=rel, module=module, tree=tree,
+                       lines=source.splitlines())
+        _collect_suppressions(fi)
+        _collect_imports(fi)
+        _collect_defs(fi)
+        self.files.append(fi)
+
+    def _finish(self) -> None:
+        for fi in self.files:
+            self.module_files[fi.module] = fi
+            for info in fi.functions:
+                if info.class_name is None:
+                    self.module_funcs[(fi.module, info.name)] = info
+                else:
+                    self.methods_by_name.setdefault(info.name, []).append(info)
+            for ci in fi.classes.values():
+                self.classes_by_name.setdefault(ci.name, []).append(ci)
+
+    # -- resolution ------------------------------------------------------------
+
+    def resolve_name(self, fi: FileIndex, name: str) -> Optional[FuncInfo]:
+        """A bare-name call: module-level def in this file, else an imported
+        project function (from x import y)."""
+        got = self.module_funcs.get((fi.module, name))
+        if got is not None:
+            return got
+        target = fi.imports.get(name)
+        if target and "." in target:
+            mod, _, attr = target.rpartition(".")
+            return self.module_funcs.get((mod, attr))
+        return None
+
+    def _method_in_class(self, class_name: str, method: str,
+                         seen: Optional[Set[str]] = None
+                         ) -> Optional[FuncInfo]:
+        seen = seen or set()
+        if class_name in seen:
+            return None
+        seen.add(class_name)
+        for ci in self.classes_by_name.get(class_name, ()):
+            if method in ci.methods:
+                return ci.methods[method]
+            for base in ci.bases:
+                got = self._method_in_class(base, method, seen)
+                if got is not None:
+                    return got
+        return None
+
+    def resolve_call(self, fi: FileIndex, caller: Optional[FuncInfo],
+                     call: ast.Call) -> Optional[FuncInfo]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            return self.resolve_name(fi, func.id)
+        if isinstance(func, ast.Attribute):
+            # self.m(): enclosing class, then in-tree bases
+            if (isinstance(func.value, ast.Name) and func.value.id == "self"
+                    and caller is not None and caller.class_name):
+                got = self._method_in_class(caller.class_name, func.attr)
+                if got is not None:
+                    return got
+            # obj.m(): unique method name across the analyzed tree
+            candidates = self.methods_by_name.get(func.attr, ())
+            if len(candidates) == 1:
+                return candidates[0]
+        return None
+
+    # -- suppression check -----------------------------------------------------
+
+    def suppressed(self, fi: FileIndex, line: int, rule: str
+                   ) -> Optional[Suppression]:
+        """A suppression covers a finding on its own line, or — when it
+        opens a comment-only block — every line the block immediately
+        precedes (multi-line reasons are encouraged)."""
+        sup = fi.suppressions.get(line)
+        if sup is not None and sup.covers(rule):
+            return sup
+        lno = line - 1
+        while 1 <= lno <= len(fi.lines):
+            raw = fi.lines[lno - 1].strip()
+            if not raw.startswith("#"):
+                break
+            sup = fi.suppressions.get(lno)
+            if sup is not None:
+                return sup if sup.comment_only and sup.covers(rule) else None
+            lno -= 1
+        return None
+
+    def file_by_path(self, path: str) -> Optional[FileIndex]:
+        for fi in self.files:
+            if fi.path == path:
+                return fi
+        return None
+
+
+def _module_name(path: str) -> str:
+    """Dotted module name from a path: everything from the last
+    `kubernetes_tpu` component down (fallback: bare stem)."""
+    parts = os.path.normpath(path).split(os.sep)
+    stem = parts[-1][:-3] if parts[-1].endswith(".py") else parts[-1]
+    try:
+        i = len(parts) - 1 - parts[::-1].index("kubernetes_tpu")
+        comps = parts[i:-1] + ([] if stem == "__init__" else [stem])
+        return ".".join(comps)
+    except ValueError:
+        return stem

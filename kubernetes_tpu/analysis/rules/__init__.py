@@ -1,0 +1,20 @@
+"""schedlint rule modules. Each exposes `check(index) -> List[Finding]`."""
+
+from . import hotpath, jit, locks, mutation
+
+ALL_RULE_MODULES = (locks, mutation, jit, hotpath)
+
+RULE_DOCS = {
+    "LK001": "lock-order inversion: the pods shard must never be held when "
+             "the global RV lock is acquired (store/store.py docstring rule)",
+    "LK002": "blocking call (sleep, queue put/get, join, jax dispatch, watch "
+             "callback) on a path that holds a store/scheduler lock",
+    "MU001": "mutation of a store-returned or event object (the read-only "
+             "contract the runtime mutation detector polices)",
+    "JT001": "per-batch-varying expression flows into a static_argnames "
+             "parameter of a jitted solver (retrace churn)",
+    "JT002": "host-sync / numpy call inside a jit-traced body",
+    "HP001": "per-pod instrumentation inside a batch loop of "
+             "scheduler/batch.py (per BATCH, never per pod)",
+    "SL001": "schedlint suppression without a written reason",
+}

@@ -1,0 +1,131 @@
+"""HP001 — per-pod instrumentation inside batch loops of scheduler/batch.py.
+
+The flight recorder's contract (scheduler/flightrec.py, ROADMAP
+instrumentation budget <2%) is "per BATCH, never per pod": stage marks,
+histogram observations, recorder narration, and logging happen a handful of
+times per schedule_batch call. A perf_counter read or metrics observe inside
+a loop over the pod batch multiplies that by 100k and the budget is gone —
+exactly the regression class tier-1's behavioral tests cannot see.
+
+Batch loops are identified by the iterable's root name (the module's
+pod-scale locals: qps, to_bind, items, rejected, ...), looking through
+enumerate/zip/sorted/reversed wrappers, `.tolist()` and 1/2-arg `range(len(
+...))`. Three-arg `range(0, len(x), chunk)` loops are CHUNK loops (pods /
+bind_chunk iterations) and are exempt — per-chunk timing is the recorder's
+own design.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from ..findings import Finding
+from ..index import ProjectIndex
+
+HOT_FILE_SUFFIXES = ("scheduler/batch.py",)
+
+POD_SCALE = re.compile(
+    r"^(qps|pods|pending|items|to_bind|bind_rows|bind_nodes|bind_gang|"
+    r"triples|bindings|prepared|rejected|members|pairs|leftovers|errs|"
+    r"errors|victims|device_idx|fallback_idx|assign_list|assignment|"
+    r"events|batch|chunk)$")
+
+INSTRUMENTATION_CALLS = {"observe", "inc", "set", "mark", "record", "step",
+                         "add_outside", "note_self_time", "event", "log",
+                         "info", "warning", "debug", "error", "exception"}
+_METRICY = re.compile(r"^(m|metrics|fr|flightrec|clock|trace|recorder|"
+                      r"logger|logging|log)$")
+
+
+def _root_name(expr: ast.AST) -> Optional[str]:
+    node = expr
+    while True:
+        if isinstance(node, ast.Attribute):
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Call):
+            f = node.func
+            # look through .tolist()/.items()/.values() etc
+            if isinstance(f, ast.Attribute):
+                node = f.value
+            elif isinstance(f, ast.Name) and f.id in (
+                    "enumerate", "zip", "sorted", "reversed", "list",
+                    "tuple"):
+                if not node.args:
+                    return None
+                node = node.args[0]
+            elif isinstance(f, ast.Name) and f.id == "range":
+                if len(node.args) >= 3:
+                    return None  # chunk loop: range(lo, len(x), step)
+                node = node.args[-1] if node.args else None
+                if node is None:
+                    return None
+            else:
+                return None
+        elif isinstance(node, ast.Name):
+            return node.id
+        else:
+            return None
+
+
+def _is_pod_scale_loop(loop: ast.For) -> bool:
+    root = _root_name(loop.iter)
+    return root is not None and bool(POD_SCALE.match(root))
+
+
+def _instrumentation_desc(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr == "perf_counter":
+            return "time.perf_counter()"
+        if f.attr in INSTRUMENTATION_CALLS:
+            # receiver chain must look metric/recorder/logger-ish; plain
+            # container .add()/.update() etc. are data structure ops
+            node = f.value
+            segs = []
+            while isinstance(node, ast.Attribute):
+                segs.append(node.attr)
+                node = node.value
+            if isinstance(node, ast.Name):
+                segs.append(node.id)
+            if any(_METRICY.match(s) for s in segs):
+                return f"instrumentation call .{f.attr}() on " \
+                       f"'{segs[-1]}...'"
+    elif isinstance(f, ast.Name):
+        if f.id == "perf_counter":
+            return "perf_counter()"
+        if f.id == "Trace":
+            return "Trace() construction"
+        if f.id == "print":
+            return "print()"
+    return None
+
+
+def check(index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in index.files:
+        norm = fi.path.replace("\\", "/")
+        if not any(norm.endswith(sfx) for sfx in HOT_FILE_SUFFIXES):
+            continue
+        for info in fi.functions:
+            for loop in ast.walk(info.node):
+                if not isinstance(loop, ast.For) or \
+                        not _is_pod_scale_loop(loop):
+                    continue
+                for node in ast.walk(loop):
+                    if node is loop.iter or not isinstance(node, ast.Call):
+                        continue
+                    desc = _instrumentation_desc(node)
+                    if desc is None:
+                        continue
+                    findings.append(Finding(
+                        "HP001", fi.rel, node.lineno,
+                        f"{info.qualname}: {desc} inside a pod-scale batch "
+                        "loop",
+                        hint="instrument per BATCH (StageClock marks / one "
+                             "flight record), never per pod — see "
+                             "scheduler/flightrec.py"))
+    return findings

@@ -1,0 +1,319 @@
+"""JT001 / JT002 — jit hygiene for the batched solvers.
+
+The solver's throughput lives and dies on the XLA jit cache staying hot
+(ROADMAP: a mid-run recompile costs tens of seconds on TPU), and on traced
+bodies never forcing a host round-trip.
+
+JT001: a call site of a jitted function passes a per-batch-varying
+expression to a `static_argnames` parameter. Bad atoms are `len(...)`,
+`.item()`, `.size` loads, and `int()/float()` over non-constants — each a
+value that changes with batch/cluster content and therefore keys a fresh
+compile. Neutralizers are the project's blessed bucketing idioms:
+`bool(...)` (binary key), `1 << (...).bit_length()` (pow2 bucket, see
+models/waterfill.py). Badness follows simple local variable chains and the
+finding is anchored at the WITNESS (the assignment/expression that
+introduces the raw value), so one reasoned suppression covers every static
+arg the value flows into.
+
+JT002: host-sync or numpy calls lexically inside a jit-traced body — the
+jitted functions themselves plus every helper reachable from them through
+resolved in-tree calls (`.item()`, `int/float/bool` of non-constants,
+`np.*`, `.block_until_ready`, `jax.device_get`).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..findings import Finding
+from ..index import FileIndex, FuncInfo, ProjectIndex
+
+_NESTED_SCOPES = (ast.Lambda,)  # jit bodies DO include nested defs
+
+
+@dataclass
+class JitFn:
+    info: FuncInfo
+    static_names: Tuple[str, ...]
+    # param name -> positional index (for static args passed positionally)
+    param_index: Dict[str, int] = field(default_factory=dict)
+    # alias-form registrations (`fn = jax.jit(target, ...)`) only match call
+    # sites in the file that created the alias
+    file_scope: Optional[str] = None
+
+
+def _tuple_of_strings(node: ast.AST) -> Tuple[str, ...]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(e.value for e in node.elts
+                     if isinstance(e, ast.Constant) and isinstance(e.value,
+                                                                   str))
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    return ()
+
+
+def _is_jax_jit(expr: ast.AST) -> bool:
+    """`jax.jit` or a bare `jit` imported from jax."""
+    if isinstance(expr, ast.Attribute) and expr.attr == "jit" \
+            and isinstance(expr.value, ast.Name) and expr.value.id == "jax":
+        return True
+    return isinstance(expr, ast.Name) and expr.id == "jit"
+
+
+def _jit_decoration(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    """static_argnames if `node` is a jax.jit decoration/wrapping call."""
+    if not isinstance(node, ast.Call):
+        return None
+    # functools.partial(jax.jit, static_argnames=(...)) / partial(jit, ...)
+    f = node.func
+    is_partial = (isinstance(f, ast.Attribute) and f.attr == "partial") or \
+        (isinstance(f, ast.Name) and f.id == "partial")
+    if is_partial and node.args and _is_jax_jit(node.args[0]):
+        for kw in node.keywords:
+            if kw.arg in ("static_argnames", "static_argnums"):
+                return _tuple_of_strings(kw.value)
+        return ()
+    # jax.jit(fn, static_argnames=(...))
+    if _is_jax_jit(f):
+        for kw in node.keywords:
+            if kw.arg in ("static_argnames", "static_argnums"):
+                return _tuple_of_strings(kw.value)
+        return ()
+    return None
+
+
+def _param_indices(fn_node) -> Dict[str, int]:
+    args = getattr(fn_node, "args", None)
+    if args is None:
+        return {}
+    names = [a.arg for a in (args.posonlyargs + args.args)]
+    return {n: i for i, n in enumerate(names)}
+
+
+def collect_jit_functions(index: ProjectIndex) -> Dict[str, List[JitFn]]:
+    """name -> JitFns; includes `alias = jax.jit(target, ...)` rebindings."""
+    out: Dict[str, List[JitFn]] = {}
+    for fi in index.files:
+        for info in fi.functions:
+            for dec in getattr(info.node, "decorator_list", ()):
+                statics = _jit_decoration(dec)
+                if statics is None and _is_jax_jit(dec):
+                    statics = ()  # bare @jax.jit
+                if statics is not None:
+                    jf = JitFn(info, statics, _param_indices(info.node))
+                    out.setdefault(info.name, []).append(jf)
+        # alias-form: fn = jax.jit(target, static_argnames=...)
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Assign) or \
+                    not isinstance(node.value, ast.Call):
+                continue
+            statics = _jit_decoration(node.value)
+            if statics is None or not node.value.args:
+                continue
+            tgt = node.value.args[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            wrapped = index.resolve_name(fi, tgt.id)
+            if wrapped is None:
+                continue
+            jf = JitFn(wrapped, statics, _param_indices(wrapped.node),
+                       file_scope=fi.path)
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).append(jf)
+    return out
+
+
+def jitted_local_names(index: ProjectIndex) -> Dict[str, Set[str]]:
+    """file path -> names that are jitted callables there (for LK002)."""
+    jits = collect_jit_functions(index)
+    by_file: Dict[str, Set[str]] = {}
+    for name, fns in jits.items():
+        for jf in fns:
+            by_file.setdefault(jf.info.file.path, set()).add(name)
+    for fi in index.files:
+        for local, target in fi.imports.items():
+            leaf = target.rpartition(".")[2]
+            if leaf in jits:
+                by_file.setdefault(fi.path, set()).add(local)
+    return by_file
+
+
+# -- JT001 -----------------------------------------------------------------
+
+
+class _Badness:
+    """Does an expression (following local variable chains) carry a
+    per-batch-varying atom that no bucketing idiom neutralizes?"""
+
+    def __init__(self, func: FuncInfo):
+        self.func = func
+        self._visiting: Set[str] = set()
+
+    def witness(self, expr: ast.AST) -> Optional[ast.AST]:
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Name):
+                if f.id == "bool":
+                    return None  # binary jit key — always safe
+                if f.id == "len":
+                    return expr
+                if f.id in ("int", "float") and expr.args and not isinstance(
+                        expr.args[0], ast.Constant):
+                    return expr
+            if isinstance(f, ast.Attribute):
+                if f.attr == "bit_length":
+                    return None  # pow2 bucketing idiom
+                if f.attr == "item":
+                    return expr
+            for sub in list(expr.args) + [kw.value for kw in expr.keywords]:
+                got = self.witness(sub)
+                if got is not None:
+                    return got
+            return None
+        if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.LShift):
+            return None  # 1 << (...).bit_length() bucket
+        if isinstance(expr, ast.Attribute):
+            if expr.attr == "size":
+                return expr
+            return self.witness(expr.value)
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            if name in self._visiting:
+                return None
+            self._visiting.add(name)
+            try:
+                for node in ast.walk(self.func.node):
+                    if isinstance(node, ast.Assign):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name) and tgt.id == name:
+                                got = self.witness(node.value)
+                                if got is not None:
+                                    # anchor at the DEEPEST witness: the
+                                    # expression that introduces the raw
+                                    # value, so one reasoned suppression
+                                    # there covers every static arg the
+                                    # value flows into
+                                    return got
+            finally:
+                self._visiting.discard(name)
+            return None
+        for sub in ast.iter_child_nodes(expr):
+            if isinstance(sub, ast.expr):
+                got = self.witness(sub)
+                if got is not None:
+                    return got
+        return None
+
+
+def _check_jt001(index: ProjectIndex,
+                 jits: Dict[str, List[JitFn]]) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int]] = set()
+    for fi in index.files:
+        for info in fi.functions:
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call) or \
+                        not isinstance(node.func, ast.Name):
+                    continue
+                name = node.func.id
+                for jf in jits.get(name, ()):
+                    # only sites that actually resolve to this jitted fn
+                    if jf.file_scope is not None and jf.file_scope != fi.path:
+                        continue
+                    resolved = index.resolve_name(fi, name)
+                    if resolved is not None and resolved != jf.info and \
+                            jf.info.file.path != fi.path:
+                        continue
+                    static_exprs = []
+                    for kw in node.keywords:
+                        if kw.arg in jf.static_names:
+                            static_exprs.append((kw.arg, kw.value))
+                    for pname in jf.static_names:
+                        pi = jf.param_index.get(pname)
+                        if pi is not None and pi < len(node.args):
+                            static_exprs.append((pname, node.args[pi]))
+                    for pname, expr in static_exprs:
+                        wit = _Badness(info).witness(expr)
+                        if wit is None:
+                            continue
+                        key = (fi.rel, wit.lineno)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        findings.append(Finding(
+                            "JT001", fi.rel, wit.lineno,
+                            f"{info.qualname}: per-batch-varying value flows "
+                            f"into static arg '{pname}' of "
+                            f"{jf.info.qualname} (jit retrace per distinct "
+                            "value)",
+                            hint="bucket it (1 << (n-1).bit_length(), see "
+                                 "models/waterfill.py) or make the argument "
+                                 "dynamic"))
+    return findings
+
+
+# -- JT002 -----------------------------------------------------------------
+
+
+def _jit_reachable(index: ProjectIndex,
+                   jits: Dict[str, List[JitFn]]) -> Dict[FuncInfo, str]:
+    reachable: Dict[FuncInfo, str] = {}
+    frontier: List[FuncInfo] = []
+    for fns in jits.values():
+        for jf in fns:
+            if jf.info not in reachable:
+                reachable[jf.info] = "jitted"
+                frontier.append(jf.info)
+    while frontier:
+        cur = frontier.pop()
+        for node in ast.walk(cur.node):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = index.resolve_call(cur.file, cur, node)
+            if callee is not None and callee not in reachable:
+                reachable[callee] = f"traced via {cur.qualname}"
+                frontier.append(callee)
+    return reachable
+
+
+def _check_jt002(index: ProjectIndex,
+                 jits: Dict[str, List[JitFn]]) -> List[Finding]:
+    findings: List[Finding] = []
+    for info, how in _jit_reachable(index, jits).items():
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            desc = None
+            if isinstance(f, ast.Attribute):
+                root = f
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name) and root.id in ("np", "numpy") \
+                        and f is not root:
+                    desc = f"numpy call ({ast.unparse(f)})"
+                elif f.attr == "item":
+                    desc = "host sync .item()"
+                elif f.attr == "block_until_ready":
+                    desc = "host sync .block_until_ready()"
+                elif f.attr == "device_get":
+                    desc = "host sync jax.device_get()"
+            elif isinstance(f, ast.Name) and f.id in ("int", "float", "bool") \
+                    and node.args and not isinstance(node.args[0],
+                                                     ast.Constant):
+                desc = f"host sync {f.id}() on a traced value"
+            if desc is not None:
+                findings.append(Finding(
+                    "JT002", info.file.rel, node.lineno,
+                    f"{info.qualname}: {desc} inside a jit body ({how})",
+                    hint="keep traced code device-pure (jnp ops); do host "
+                         "conversion before the jit boundary"))
+    return findings
+
+
+def check(index: ProjectIndex) -> List[Finding]:
+    jits = collect_jit_functions(index)
+    return _check_jt001(index, jits) + _check_jt002(index, jits)

@@ -1,0 +1,316 @@
+"""LK001 / LK002 — the store's lock discipline.
+
+LK001 (lock-order inversion): the module docstring of store/store.py mandates
+`_lock` (global RV) -> `_pods_lock` (pods shard), never the reverse. We build
+a per-function acquisition model over `with` statements (including the
+`_pods_pair` / `_kind_lock()` / `transaction()` composite acquirers, which
+take global-then-shard and are therefore order-safe to ENTER but count as a
+fresh global acquisition), close "may acquire" summaries over the resolved
+call graph, and flag any point where the shard is definitely held, the
+global lock is not, and a global acquisition (direct or via a call path)
+follows.
+
+LK002 (blocking while locked): within any recognized lock region — and in
+every function reachable from one through resolved calls — flag calls that
+can block or dispatch long work: time.sleep, zero-arg .join(), blocking
+queue .get()/.put() (queue-ish receivers, `_nowait` excluded), jax/jnp
+dispatch (including calls to known-jitted functions), and watch-callback
+delivery (`on_event`). Lock identity is qualified by the enclosing class, so
+Cache._lock and APIStore._lock never alias.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..findings import Finding
+from ..index import FileIndex, FuncInfo, ProjectIndex
+
+GLOBAL = ("APIStore", "_lock")
+SHARD = ("APIStore", "_pods_lock")
+PAIR = ("APIStore", "<pair>")  # global-then-shard composite (order-safe)
+
+_QUEUEISH = re.compile(r"(^|_)q$|queue", re.IGNORECASE)
+
+_NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _last_segment(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _local_assignments(func_node: ast.AST, name: str) -> List[ast.AST]:
+    out = []
+    for node in ast.walk(func_node):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    out.append(node.value)
+    return out
+
+
+class _FuncModel:
+    """Everything the two rules need to know about one function."""
+
+    def __init__(self, info: FuncInfo):
+        self.info = info
+        self.direct_acquires: Set[Tuple[str, str]] = set()
+        self.calls: List[Tuple[ast.Call, Optional[FuncInfo]]] = []
+        # calls made while >= 1 lock frame is held (entry points of the
+        # reachable-under-lock BFS)
+        self.locked_calls: List[Tuple[ast.Call, Optional[FuncInfo], str]] = []
+        self.blocking_sites: List[Tuple[ast.AST, str]] = []
+        # LK001 candidates: (call node, callee, lock-state description)
+        self.inversion_call_sites: List[Tuple[ast.Call, FuncInfo]] = []
+        self.inversion_direct: List[Tuple[ast.AST, str]] = []
+
+
+def _classify_lock(expr: ast.AST, func: FuncInfo,
+                   depth: int = 0) -> Optional[Set[Tuple[str, str]]]:
+    """Lock tokens a with-item may acquire; None = not a lock region."""
+    cls = func.class_name or "<module>"
+    if isinstance(expr, ast.Attribute):
+        attr = expr.attr
+        if attr == "_pods_pair":
+            return {PAIR}
+        if "lock" in attr or attr.endswith("_pair"):
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                return {(cls, attr)}
+            return {("<other>", attr)}
+        return None
+    if isinstance(expr, ast.Call):
+        seg = _last_segment(expr.func)
+        if seg in ("_kind_lock", "transaction"):
+            return {PAIR}
+        return None
+    if isinstance(expr, ast.Name) and depth < 4:
+        toks: Set[Tuple[str, str]] = set()
+        for rhs in _local_assignments(func.node, expr.id):
+            sub_exprs = ([rhs.body, rhs.orelse]
+                         if isinstance(rhs, ast.IfExp) else [rhs])
+            for sub in sub_exprs:
+                got = _classify_lock(sub, func, depth + 1)
+                if got:
+                    toks |= got
+        return toks or None
+    return None
+
+
+def _is_jax_root(expr: ast.AST) -> bool:
+    node = expr
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id in ("jax", "jnp")
+
+
+def _blocking_desc(call: ast.Call, func: FuncInfo, index: ProjectIndex,
+                   jitted_names: Set[str], fi: FileIndex) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        recv_seg = _last_segment(f.value)
+        if f.attr == "sleep" and isinstance(f.value, ast.Name) \
+                and f.value.id == "time":
+            return "time.sleep()"
+        if f.attr == "join" and not call.args and not call.keywords:
+            return "blocking .join()"
+        if f.attr in ("get", "put") and recv_seg \
+                and _QUEUEISH.search(recv_seg):
+            return f"blocking queue .{f.attr}() (use the _nowait form or " \
+                   "move it outside the lock)"
+        if f.attr == "block_until_ready":
+            return "device sync .block_until_ready()"
+        if f.attr == "on_event":
+            return "watch callback delivery (on_event)"
+        if _is_jax_root(f):
+            return f"jax dispatch ({ast.unparse(f)})" \
+                if hasattr(ast, "unparse") else "jax dispatch"
+    elif isinstance(f, ast.Name):
+        if f.id == "sleep" and fi.imports.get("sleep", "").startswith("time"):
+            return "time.sleep()"
+        if f.id in jitted_names:
+            return f"jitted-solver call ({f.id})"
+        # a local callable loaded from an `on_event` attribute (the store's
+        # `cb = self.on_event; cb()` delivery ping)
+        for rhs in _local_assignments(func.node, f.id):
+            if isinstance(rhs, ast.Attribute) and rhs.attr == "on_event":
+                return "watch callback delivery (on_event)"
+    return None
+
+
+class _Walker:
+    """Statement walk with a with-lock frame stack (nested defs skipped)."""
+
+    def __init__(self, model: _FuncModel, index: ProjectIndex,
+                 jitted_names: Set[str]):
+        self.m = model
+        self.index = index
+        self.jitted_names = jitted_names
+        self.frames: List[Set[Tuple[str, str]]] = []
+
+    # lock-state queries -------------------------------------------------------
+
+    def _shard_definite(self) -> bool:
+        return any(fr == {SHARD} for fr in self.frames)
+
+    def _global_possible(self) -> bool:
+        return any(GLOBAL in fr or PAIR in fr for fr in self.frames)
+
+    def _any_lock_held(self) -> bool:
+        return bool(self.frames)
+
+    # traversal ----------------------------------------------------------------
+
+    def walk_body(self, body: List[ast.stmt]) -> None:
+        for stmt in body:
+            self.walk_stmt(stmt)
+
+    def walk_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, _NESTED):
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            pushed = 0
+            for item in stmt.items:
+                self._scan_expr(item.context_expr)
+                toks = _classify_lock(item.context_expr, self.m.info)
+                if toks:
+                    self._note_acquisition(item.context_expr, toks)
+                    self.frames.append(set(toks))
+                    pushed += 1
+            self.walk_body(stmt.body)
+            for _ in range(pushed):
+                self.frames.pop()
+            return
+        for _field, value in ast.iter_fields(stmt):
+            if isinstance(value, ast.expr):
+                self._scan_expr(value)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.stmt):
+                        self.walk_stmt(v)
+                    elif isinstance(v, ast.expr):
+                        self._scan_expr(v)
+                    elif isinstance(v, ast.ExceptHandler):
+                        self.walk_body(v.body)
+
+    def _note_acquisition(self, node: ast.AST,
+                          toks: Set[Tuple[str, str]]) -> None:
+        self.m.direct_acquires |= ({GLOBAL, SHARD} if PAIR in toks
+                                   else toks)
+        if self._shard_definite() and not self._global_possible():
+            if GLOBAL in toks or PAIR in toks:
+                self.m.inversion_direct.append(
+                    (node, "acquires the global RV lock while holding the "
+                           "pods shard"))
+
+    def _scan_expr(self, expr: ast.expr) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, _NESTED):
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            callee = self.index.resolve_call(self.m.info.file, self.m.info,
+                                             node)
+            self.m.calls.append((node, callee))
+            if self._any_lock_held():
+                lock_desc = "/".join(sorted(
+                    f"{c}.{a}" for fr in self.frames for c, a in fr))
+                self.m.locked_calls.append((node, callee, lock_desc))
+            desc = _blocking_desc(node, self.m.info, self.index,
+                                  self.jitted_names, self.m.info.file)
+            if desc is not None:
+                self.m.blocking_sites.append((node, desc))
+            if callee is not None and self._shard_definite() \
+                    and not self._global_possible():
+                self.m.inversion_call_sites.append((node, callee))
+
+
+def check(index: ProjectIndex) -> List[Finding]:
+    from .jit import jitted_local_names
+
+    findings: List[Finding] = []
+    models: Dict[FuncInfo, _FuncModel] = {}
+    jit_names_by_file = jitted_local_names(index)
+
+    for fi in index.files:
+        names = jit_names_by_file.get(fi.path, set())
+        for info in fi.functions:
+            m = _FuncModel(info)
+            w = _Walker(m, index, names)
+            w.walk_body(info.node.body)
+            models[info] = m
+
+    # may-acquire closure over the resolved call graph (fixpoint)
+    acquires: Dict[FuncInfo, Set[Tuple[str, str]]] = {
+        info: set(m.direct_acquires) for info, m in models.items()}
+    changed = True
+    while changed:
+        changed = False
+        for info, m in models.items():
+            for _call, callee in m.calls:
+                if callee is not None and callee in acquires:
+                    extra = acquires[callee] - acquires[info]
+                    if extra:
+                        acquires[info] |= extra
+                        changed = True
+
+    # LK001
+    for info, m in models.items():
+        for node, why in m.inversion_direct:
+            findings.append(Finding(
+                "LK001", info.file.rel, node.lineno,
+                f"{info.qualname}: {why}",
+                hint="store/store.py rule: _lock (global) -> _pods_lock "
+                     "(shard), never reversed; release the shard first "
+                     "(bind_many's two-phase pattern)"))
+        for call, callee in m.inversion_call_sites:
+            if GLOBAL in acquires.get(callee, ()):
+                findings.append(Finding(
+                    "LK001", info.file.rel, call.lineno,
+                    f"{info.qualname}: call to {callee.qualname} can acquire "
+                    "the global RV lock while the pods shard is held",
+                    hint="hoist the call out of the shard-only section or "
+                         "take the locks in docstring order (_lock -> "
+                         "_pods_lock)"))
+
+    # LK002: functions reachable from any lock region, with one example path
+    reachable: Dict[FuncInfo, str] = {}
+    frontier: List[FuncInfo] = []
+    for info, m in models.items():
+        for _call, callee, lock_desc in m.locked_calls:
+            if callee is not None and callee not in reachable:
+                reachable[callee] = (f"called under {lock_desc} "
+                                     f"in {info.qualname}")
+                frontier.append(callee)
+    while frontier:
+        cur = frontier.pop()
+        for _call, callee in models.get(cur, _FuncModel(cur)).calls:
+            if callee is not None and callee not in reachable:
+                reachable[callee] = (f"reachable from lock-holding path via "
+                                     f"{cur.qualname}")
+                frontier.append(callee)
+
+    seen: Set[Tuple[str, int]] = set()
+    for info, m in models.items():
+        lock_lines = {c.lineno for c, _cal, _d in m.locked_calls}
+        for node, desc in m.blocking_sites:
+            direct = node.lineno in lock_lines
+            via = reachable.get(info)
+            if not direct and via is None:
+                continue
+            key = (info.file.rel, node.lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            origin = "while holding a lock" if direct else via
+            findings.append(Finding(
+                "LK002", info.file.rel, node.lineno,
+                f"{info.qualname}: {desc} {origin}",
+                hint="move blocking work outside the critical section (or "
+                     "suppress with a written non-blocking argument)"))
+    return findings

@@ -1,0 +1,238 @@
+"""MU001 — mutation of store-returned / event objects.
+
+The static complement of the PR 4 runtime mutation detector
+(store/store.py MutationDetector): event objects (`ev.obj` / `ev.prev`) and
+store reads (`<...>store.get/list(...)`) carry the client-go read-only
+contract — consumers must clone before writing. The rule runs a per-function
+taint walk:
+
+  sources      `X.obj` / `X.prev` attribute loads (event payloads; `self.obj`
+               excluded) and `<recv>.get/list/list_many(...)` where the
+               receiver's last segment contains "store". `.list()` results
+               are CONTAINER-tainted: the returned list itself is freshly
+               allocated (sorting/slicing it is fine) but its elements are
+               object-tainted the moment they are indexed or iterated.
+  propagation  plain data flow only: name assignment, attribute/subscript
+               LOADS, tuple unpack, for-loop iteration. Calls launder taint —
+               which makes every clone helper (deepcopy,
+               pod_structural_clone, to_dict, dict(), .clone(), ...) a
+               sanitizer for free.
+  sinks        attribute/subscript STORES and aug-assigns whose base chain
+               roots in a tainted value, mutating container methods
+               (append/update/pop/...), and object.__setattr__/setattr on a
+               tainted first argument.
+
+Local-only by design: parameters are never tainted (callers that pass event
+objects onward are covered at the site where the `.obj` load happens), so
+the whole-tree run stays at zero false positives.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from ..findings import Finding
+from ..index import FuncInfo, ProjectIndex
+
+EVENT_ATTRS = ("obj", "prev")
+MUTATORS = {"append", "extend", "insert", "add", "update", "pop", "popitem",
+            "remove", "discard", "clear", "sort", "reverse", "setdefault",
+            "__setattr__", "__delitem__"}
+_NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _recv_is_store(expr: ast.AST) -> bool:
+    seg = None
+    if isinstance(expr, ast.Attribute):
+        seg = expr.attr
+    elif isinstance(expr, ast.Name):
+        seg = expr.id
+    return seg is not None and "store" in seg.lower()
+
+
+OBJ = "obj"            # the value itself is contract-covered
+CONTAINER = "container"  # fresh container of contract-covered elements
+
+
+def _store_read_level(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if (isinstance(f, ast.Attribute)
+            and f.attr in ("get", "list", "list_many")
+            and _recv_is_store(f.value)):
+        return OBJ if f.attr == "get" else CONTAINER
+    return None
+
+
+class _Taint:
+    """Per-function forward taint walk (single pass, statement order)."""
+
+    def __init__(self, info: FuncInfo, findings: List[Finding]):
+        self.info = info
+        self.findings = findings
+        self.tainted: Dict[str, str] = {}  # name -> OBJ | CONTAINER
+
+    # -- expression taint ------------------------------------------------------
+
+    def expr_tainted(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name):
+            return self.tainted.get(expr.id)
+        if isinstance(expr, ast.Attribute):
+            if expr.attr in EVENT_ATTRS and not (
+                    isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"):
+                return OBJ
+            return OBJ if self.expr_tainted(expr.value) else None
+        if isinstance(expr, ast.Subscript):
+            # indexing a fresh .list() container yields contract elements
+            return OBJ if self.expr_tainted(expr.value) else None
+        if isinstance(expr, ast.Starred):
+            return self.expr_tainted(expr.value)
+        if isinstance(expr, ast.IfExp):
+            return self.expr_tainted(expr.body) or \
+                self.expr_tainted(expr.orelse)
+        if isinstance(expr, ast.Call):
+            return _store_read_level(expr)  # every other call launders
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            levels = [self.expr_tainted(e) for e in expr.elts]
+            if OBJ in levels:
+                return OBJ
+            return CONTAINER if CONTAINER in levels else None
+        return None
+
+    def _root_tainted(self, target: ast.AST) -> Optional[str]:
+        """Walk an attr/subscript STORE chain to its base; an object-tainted
+        base (or an event-payload link in the chain) marks the write. A
+        container-tainted base only counts once the chain steps INTO the
+        container (bare `items.sort()` is fine — the list is fresh). A call
+        anywhere in the chain breaks taint (call results are private)."""
+        node = target
+        via = None
+        had_step = False
+        while True:
+            if isinstance(node, ast.Attribute):
+                if node.attr in EVENT_ATTRS and not (
+                        isinstance(node.value, ast.Name)
+                        and node.value.id == "self"):
+                    via = f".{node.attr}"
+                node = node.value
+                had_step = True
+            elif isinstance(node, ast.Subscript):
+                node = node.value
+                had_step = True
+            elif isinstance(node, ast.Name):
+                level = self.tainted.get(node.id)
+                if level == OBJ or (level == CONTAINER and had_step):
+                    return node.id
+                return via and f"event payload ({via})"
+            else:
+                return via and f"event payload ({via})" \
+                    if not isinstance(node, ast.Call) else None
+
+    # -- statements ------------------------------------------------------------
+
+    def walk(self, body) -> None:
+        for stmt in body:
+            self.stmt(stmt)
+
+    def _assign_target(self, target: ast.AST, level: Optional[str]) -> None:
+        if isinstance(target, ast.Name):
+            if level:
+                self.tainted[target.id] = level
+            else:
+                self.tainted.pop(target.id, None)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._assign_target(e, level)
+        elif isinstance(target, ast.Starred):
+            self._assign_target(target.value, level)
+        elif isinstance(target, (ast.Attribute, ast.Subscript)):
+            root = self._root_tainted(target)
+            if root:
+                self._report(target, f"write to {root}")
+
+    def _report(self, node: ast.AST, what: str) -> None:
+        self.findings.append(Finding(
+            "MU001", self.info.file.rel, node.lineno,
+            f"{self.info.qualname}: {what} mutates a store-returned/event "
+            "object",
+            hint="clone first (pod_structural_clone / copy.deepcopy) or go "
+                 "through a store write API; event objects are read-only "
+                 "(store/store.py MutationDetector contract)"))
+
+    def _scan_calls(self, expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, _NESTED) or not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "__setattr__"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id == "object" and node.args
+                    and self.expr_tainted(node.args[0])):
+                self._report(node, "object.__setattr__() on a tainted value")
+            elif isinstance(f, ast.Attribute) and f.attr in MUTATORS:
+                root = self._root_tainted(f.value) if isinstance(
+                    f.value, (ast.Name, ast.Attribute, ast.Subscript)) \
+                    else None
+                # .update()/.pop()/.get() on untainted receivers is ordinary
+                if root:
+                    self._report(node, f".{f.attr}() on {root}")
+            elif isinstance(f, ast.Name) and f.id == "setattr" and node.args:
+                if self.expr_tainted(node.args[0]):
+                    self._report(node, "setattr() on a tainted value")
+
+    def stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, _NESTED):
+            return
+        if isinstance(stmt, ast.Assign):
+            self._scan_calls(stmt.value)
+            t = self.expr_tainted(stmt.value)
+            for target in stmt.targets:
+                self._assign_target(target, t)
+            return
+        if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            self._scan_calls(stmt.value)
+            self._assign_target(stmt.target, self.expr_tainted(stmt.value))
+            return
+        if isinstance(stmt, ast.AugAssign):
+            self._scan_calls(stmt.value)
+            if isinstance(stmt.target, (ast.Attribute, ast.Subscript)):
+                root = self._root_tainted(stmt.target)
+                if root:
+                    self._report(stmt.target, f"augmented write to {root}")
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_calls(stmt.iter)
+            # iterating a fresh .list() container yields contract elements
+            self._assign_target(
+                stmt.target, OBJ if self.expr_tainted(stmt.iter) else None)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, (ast.Attribute, ast.Subscript)):
+                    root = self._root_tainted(t)
+                    if root:
+                        self._report(t, f"del on {root}")
+            return
+        # generic recursion: scan expressions, walk nested statement lists
+        for _field, value in ast.iter_fields(stmt):
+            if isinstance(value, ast.expr):
+                self._scan_calls(value)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.stmt):
+                        self.stmt(v)
+                    elif isinstance(v, ast.expr):
+                        self._scan_calls(v)
+                    elif isinstance(v, ast.ExceptHandler):
+                        self.walk(v.body)
+
+
+def check(index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in index.files:
+        for info in fi.functions:
+            _Taint(info, findings).walk(info.node.body)
+    return findings

@@ -1,0 +1,159 @@
+"""schedlint — CLI driver for the project-native static analyzer.
+
+Usage:
+    python -m kubernetes_tpu.analysis.schedlint [--json] [paths...]
+    ktl vet [-o json] [paths...]          (same engine, CLI-integrated)
+
+Walks the given paths (default: the kubernetes_tpu package), parses every
+.py file once, and runs the rule suite:
+
+    LK001  lock-order inversion (store global RV lock vs pods shard)
+    LK002  blocking call while a lock is held
+    MU001  mutation of store-returned / event objects
+    JT001  per-batch-varying value into a jit static_argnames parameter
+    JT002  host-sync / numpy call inside a jit body
+    HP001  per-pod instrumentation inside batch loops (scheduler/batch.py)
+    SL001  suppression without a written reason
+
+Inline suppressions: `# schedlint: allow(RULE) <reason>` on the finding line
+(or alone on the line above it). The reason is mandatory — a bare
+suppression is itself a finding (SL001), so every exception to an invariant
+is documented where it lives. Exit status: 0 clean, 1 findings, 2 usage or
+parse failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .findings import Finding  # noqa: F401  (re-exported API)
+from .index import ProjectIndex
+
+DEFAULT_EXCLUDE_PARTS = ("__pycache__",)
+
+
+def package_root() -> str:
+    """The kubernetes_tpu package directory (the default analysis target)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(index: ProjectIndex) -> Tuple[List[Finding], Dict]:
+    """Run every rule over a built index; returns (unsuppressed findings,
+    stats). Suppressed findings are dropped; reasonless suppressions become
+    SL001 findings (never themselves suppressible)."""
+    from .rules import ALL_RULE_MODULES
+
+    raw: List[Finding] = []
+    for mod in ALL_RULE_MODULES:
+        raw.extend(mod.check(index))
+
+    kept: List[Finding] = []
+    suppressed = 0
+    for f in raw:
+        fi = index.file_by_path(_abs_for(index, f.file))
+        sup = index.suppressed(fi, f.line, f.rule) if fi else None
+        if sup is not None:
+            suppressed += 1
+            continue
+        kept.append(f)
+
+    for fi in index.files:
+        for sup in fi.suppressions.values():
+            if not sup.reason:
+                kept.append(Finding(
+                    "SL001", fi.rel, sup.line,
+                    "suppression without a reason — write down WHY the "
+                    "invariant does not apply here",
+                    hint="# schedlint: allow(RULE) <one-line reason>"))
+
+    # unreadable/unparseable/typo'd inputs are findings too (never
+    # suppressible): an analyzer that can't see the code must not pass
+    for path, err in index.errors:
+        kept.append(Finding("PARSE", path, 1, err,
+                            hint="fix the path/syntax; exit code 2"))
+    kept.sort(key=lambda f: (f.file, f.line, f.rule))
+    stats = {
+        "files": len(index.files),
+        "parse_errors": len(index.errors),
+        "findings": len(kept),
+        "suppressed": suppressed,
+    }
+    return kept, stats
+
+
+def exit_code(findings: List[Finding]) -> int:
+    """0 clean, 1 invariant findings, 2 the analyzer could not do its job
+    (parse/path failure). Shared by the module CLI and `ktl vet`."""
+    if any(f.rule == "PARSE" for f in findings):
+        return 2
+    return 1 if findings else 0
+
+
+def _abs_for(index: ProjectIndex, rel: str) -> str:
+    for fi in index.files:
+        if fi.rel == rel:
+            return fi.path
+    return rel
+
+
+def run_paths(paths: Optional[List[str]] = None
+              ) -> Tuple[List[Finding], Dict]:
+    """Build the index for `paths` (default: the package) and run the suite."""
+    t0 = time.perf_counter()
+    index = ProjectIndex.from_paths(list(paths) if paths else [package_root()])
+    findings, stats = run(index)
+    stats["wall_s"] = round(time.perf_counter() - t0, 3)
+    return findings, stats
+
+
+def analyze_source(source: str, filename: str = "fixture.py",
+                   module: str = "fixture") -> List[Finding]:
+    """Single-source entry point for rule fixture tests."""
+    return run(ProjectIndex.from_source(source, filename, module))[0]
+
+
+def render_text(findings: List[Finding], stats: Dict) -> str:
+    lines = [f.render() for f in findings]
+    lines.append(
+        f"schedlint: {stats['findings']} finding(s), "
+        f"{stats['suppressed']} suppressed, {stats['files']} files "
+        f"in {stats.get('wall_s', 0.0):.2f}s")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="schedlint",
+        description="project-native static analyzer for the scheduler's "
+                    "concurrency and clone-discipline invariants")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to analyze "
+                             "(default: the kubernetes_tpu package)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings on stdout")
+    parser.add_argument("--list-rules", action="store_true")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        from .rules import RULE_DOCS
+
+        for rule, doc in sorted(RULE_DOCS.items()):
+            print(f"{rule}  {doc}")
+        return 0
+
+    findings, stats = run_paths(args.paths or None)
+    if args.json:
+        print(json.dumps({"findings": [f.as_dict() for f in findings],
+                          "stats": stats}, indent=2))
+    else:
+        print(render_text(findings, stats))
+    return exit_code(findings)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

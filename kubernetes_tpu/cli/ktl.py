@@ -5,7 +5,8 @@ Talks HTTP to the API server (KTL_SERVER env or --server).
 
 Commands: get, describe, create -f, apply -f (server-side merge patch),
 delete, scale, cordon, uncordon, taint, drain, label, annotate, patch,
-rollout status|restart, set image, top nodes|pods, sched stats, wait,
+rollout status|restart, set image, top nodes|pods, sched stats, vet
+(schedlint — the local static-analysis gate, no apiserver needed), wait,
 autoscale, api-resources, version.
 """
 
@@ -1360,6 +1361,19 @@ def cmd_sched(client: RESTClient, args) -> int:
         _time.sleep(args.interval)
 
 
+def cmd_vet(client: RESTClient, args) -> int:
+    """ktl vet [-o json] [paths...] — run schedlint (the project-native
+    static analyzer, analysis/schedlint.py) over the tree. The `go vet` of
+    this control plane: nonzero exit on any unsuppressed finding, so CI and
+    pre-commit hooks can gate on it. Entirely local (no apiserver)."""
+    from ..analysis import schedlint
+
+    # delegate to the module CLI so the two entry points share one
+    # output/exit-code contract (only the flag spelling differs)
+    return schedlint.main(
+        (["--json"] if args.output == "json" else []) + list(args.paths))
+
+
 def cmd_wait(client: RESTClient, args) -> int:
     """kubectl wait --for=condition=X|delete (kubectl/pkg/cmd/wait)."""
     import time
@@ -1607,6 +1621,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("-w", "--watch", action="store_true")
     p.add_argument("--interval", type=float, default=2.0)
     p.set_defaults(fn=cmd_sched)
+
+    p = sub.add_parser("vet")
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to analyze (default: the package)")
+    p.add_argument("-o", "--output", default="table",
+                   choices=["table", "json"])
+    p.set_defaults(fn=cmd_vet)
 
     p = sub.add_parser("wait")
     p.add_argument("target")  # [resource/]name

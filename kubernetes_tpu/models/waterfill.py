@@ -141,6 +141,10 @@ def waterfill_solve(inp: SolverInputs, groups: List[Tuple[np.ndarray, int]]):
     cap = max(1, int(np.asarray(inp.max_pods).max(initial=1)))
     j_max = 1 << (cap - 1).bit_length()
     if n * j_max > max_slots:
+        # schedlint: allow(JT001) documented last resort (comment above):
+        # when the static pow2 bucket blows the int32 sort-key range, the
+        # raw dynamic headroom keys the jit — recompiles are accepted there
+        # because the alternative is no fast path at all
         headroom = max(1, int(np.asarray(inp.max_pods - inp.pod_count).max(initial=1)))
         j_max = 1 << (headroom - 1).bit_length()
         if n * j_max > max_slots:

@@ -1336,7 +1336,15 @@ class _Handler(BaseHTTPRequestHandler):
         updated = None
         with self.store.transaction():
             try:
+                # store reads are read-only by convention (schedlint MU001):
+                # splice the status into a PRIVATE object. Under the default
+                # deep_copy_on_write store, get() already returns one; only
+                # a no-isolation store needs the explicit copy here.
                 existing = self.store.get(resource, key)
+                if not getattr(self.store, "_deep_copy", True):
+                    import copy as _copy
+
+                    existing = _copy.deepcopy(existing)
                 if body_rv and body_rv != existing.metadata.resource_version:
                     raise ConflictError(
                         f"{resource} {key}: stale resourceVersion {body_rv}")

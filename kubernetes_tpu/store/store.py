@@ -36,7 +36,10 @@ bind worker can commit whole batches without stalling every other client:
   reverse. A thread holding the shard must not acquire the global lock
   (bind_many RELEASES the shard between its validate and commit phases and
   re-verifies stored-object identity instead of holding through). Reversing
-  the order deadlocks against every pod write.
+  the order deadlocks against every pod write. ENFORCED twice: statically by
+  schedlint rule LK001 (analysis/schedlint.py, tier-1-gated) and at runtime
+  by the _OrderedRLock wrappers (STORE_LOCK_ORDER_CHECK=1 / the pytest
+  autouse fixture), which raise LockOrderViolation on inversion.
 
 Event allocation (clone-free commits): pod events on the bind / status /
 delete hot paths are LAZY — the Event initially SHARES the stored object
@@ -281,6 +284,9 @@ class Watch:
                 self._q.put_nowait(ev)
                 cb = self.on_event
                 if cb is not None:
+                    # schedlint: allow(LK002) on_event is the watchmux wake
+                    # ping — non-blocking by contract (a selector set/notify;
+                    # server/watchmux.py); the delivery itself is put_nowait
                     cb()
             except queue.Full:
                 self._overflow()
@@ -295,6 +301,8 @@ class Watch:
                 self._q.put_nowait(cev)
                 cb = self.on_event
                 if cb is not None:
+                    # schedlint: allow(LK002) same non-blocking wake-ping
+                    # contract as _deliver above
                     cb()
             except queue.Full:
                 self._overflow()
@@ -351,6 +359,72 @@ class Watch:
             pass  # consumer is behind anyway; it checks _stopped/terminated
 
 
+class LockOrderViolation(RuntimeError):
+    """The runtime companion of schedlint LK001 tripped: a thread acquired
+    the global RV lock while already holding the pods shard (the docstring's
+    mandatory order reversed — a latent deadlock against every pod write)."""
+
+
+class _LockOrderState(threading.local):
+    """Per-store, per-thread held-lock stack for the order assertion."""
+
+    def __init__(self):
+        self.stack = []
+
+
+class _OrderedRLock:
+    """RLock wrapper asserting the store's lock-ordering rule at runtime —
+    the dynamic half of schedlint LK001, catching acquisition orders the
+    static pass cannot prove (callbacks, reflection, test doubles). Enabled
+    per store via APIStore(lock_order_check=True) or env
+    STORE_LOCK_ORDER_CHECK=1 (pytest turns it on for every test store via an
+    autouse fixture in tests/conftest.py; set the env var on the daemon to
+    run it in production).
+
+    Rule: acquiring a lock of LOWER rank than one already held (global=0 <
+    shard=1) raises LockOrderViolation — unless the thread already holds the
+    lock (reentrant acquires never deadlock). The stack is per-store, so two
+    independent stores never alias ranks."""
+
+    __slots__ = ("_lock", "_rank", "_name", "_state")
+
+    def __init__(self, name: str, rank: int, state: _LockOrderState):
+        self._lock = threading.RLock()
+        self._rank = rank
+        self._name = name
+        self._state = state
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        stack = self._state.stack
+        if all(held is not self for held in stack):  # fresh, not reentrant
+            for held in stack:
+                if held._rank > self._rank:
+                    raise LockOrderViolation(
+                        f"acquiring {self._name} while holding "
+                        f"{held._name}: store/store.py mandates _lock "
+                        "(global RV) -> _pods_lock (pods shard), never "
+                        "reversed (schedlint LK001)")
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            stack.append(self)
+        return ok
+
+    def release(self) -> None:
+        stack = self._state.stack
+        for i in range(len(stack) - 1, -1, -1):
+            if stack[i] is self:
+                del stack[i]
+                break
+        self._lock.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+
 class _LockPair:
     """Context manager acquiring the global RV lock then a kind shard, in the
     module docstring's mandatory order (both RLocks, so nesting under either
@@ -376,13 +450,24 @@ class APIStore:
 
     def __init__(self, deep_copy_on_write: bool = True,
                  mutation_detector: Optional[bool] = None,
-                 lazy_pod_events: Optional[bool] = None):
+                 lazy_pod_events: Optional[bool] = None,
+                 lock_order_check: Optional[bool] = None):
         import os
 
-        self._lock = threading.RLock()
-        # the `pods` kind shard — see the module docstring's lock-ordering
-        # rule (_lock -> _pods_lock, never reversed)
-        self._pods_lock = threading.RLock()
+        if lock_order_check is None:
+            lock_order_check = os.environ.get(
+                "STORE_LOCK_ORDER_CHECK", "").lower() in ("1", "true")
+        if lock_order_check:
+            # runtime LK001: rank-asserting lock wrappers (see _OrderedRLock)
+            state = _LockOrderState()
+            self._lock = _OrderedRLock("_lock (global RV)", 0, state)
+            self._pods_lock = _OrderedRLock("_pods_lock (pods shard)", 1,
+                                            state)
+        else:
+            self._lock = threading.RLock()
+            # the `pods` kind shard — see the module docstring's
+            # lock-ordering rule (_lock -> _pods_lock, never reversed)
+            self._pods_lock = threading.RLock()
         self._pods_pair = _LockPair(self._lock, self._pods_lock)
         self._rv = 0  # monotonic resourceVersion, read via .rv
         if mutation_detector is None:

@@ -249,3 +249,31 @@ def make_pod_group(name: str, min_member: int, namespace: str = "default"):
         metadata=ObjectMeta(name=name, namespace=namespace, uid=new_uid()),
         spec=PodGroupSpec(min_member=min_member),
     )
+
+
+def mutation_detector_guard(monkeypatch):
+    """Shared body for the force-enabled mutation-detector autouse fixture
+    (the runtime counterpart of schedlint MU001). Use from a test module as
+
+        @pytest.fixture(autouse=True)
+        def _force_mutation_detector(monkeypatch):
+            yield from mutation_detector_guard(monkeypatch)
+
+    Every APIStore the module builds runs with the detector ON, and every
+    store is checked at teardown — a clone-sharing regression (a consumer
+    mutation reaching a stored object, or vice versa) fails tier-1 in the
+    module that caused it instead of corrupting watchers silently."""
+    from .store import APIStore
+
+    monkeypatch.setenv("CACHE_MUTATION_DETECTOR", "1")
+    stores = []
+    orig = APIStore.__init__
+
+    def wrapped(self, *a, **kw):
+        orig(self, *a, **kw)
+        stores.append(self)
+
+    monkeypatch.setattr(APIStore, "__init__", wrapped)
+    yield
+    for s in stores:
+        s.check_mutations()

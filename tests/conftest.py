@@ -17,3 +17,14 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _store_lock_order_check(monkeypatch):
+    """ISSUE 5 satellite: every APIStore built under pytest runs with the
+    runtime lock-order assertion on (the dynamic companion of schedlint
+    LK001, store/store.py _OrderedRLock) — acquisition orders the static
+    pass cannot prove are caught by the tests that exercise them."""
+    monkeypatch.setenv("STORE_LOCK_ORDER_CHECK", "1")

@@ -14,6 +14,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 def test_bench_quick_runs_and_emits_json():
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    # the conftest autouse fixture arms STORE_LOCK_ORDER_CHECK for every
+    # in-process test store; the bench subprocess must measure the
+    # PRODUCTION lock configuration, not the debug wrapper
+    env.pop("STORE_LOCK_ORDER_CHECK", None)
+    env.pop("CACHE_MUTATION_DETECTOR", None)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--quick"],
         capture_output=True, text=True, timeout=120, env=env, cwd=REPO)
@@ -60,3 +65,18 @@ def test_bench_quick_runs_and_emits_json():
     assert gang["placed"] == gang["pods"] > 0
     assert gang["gangs"] == 8
     assert gang["pods_per_sec"] > 0
+    # the jit-retrace guard (ISSUE 5): the end-to-end rung's timed window
+    # must compile NOTHING — the warm-up covered every bucket, so a nonzero
+    # count here is retrace churn (the JT001 bug class, tens of seconds per
+    # compile at TPU scale)
+    assert ns["solver_compiles_during_run"] == 0, ns["jit_cache"]
+    assert ns["jit_cache"].get("waterfill_group", 0) >= 1, ns["jit_cache"]
+    # the schedlint rung (ISSUE 5): the static-analysis gate stays CLEAN
+    # (zero unsuppressed findings over the shipped tree) and CHEAP — the
+    # self-time budget keeps the tier-1 gate from quietly becoming the
+    # slowest test in the tier
+    sl = workloads["SchedLint_tree"]
+    assert "error" not in sl, sl
+    assert sl["findings"] == 0, sl
+    assert sl["files"] > 100
+    assert sl["wall_s"] <= 15.0, sl

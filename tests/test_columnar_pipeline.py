@@ -24,29 +24,18 @@ from kubernetes_tpu.scheduler.plugins import default_plugins
 from kubernetes_tpu.scheduler.queue import SchedulingQueue
 from kubernetes_tpu.store import (ADDED, DELETED, MODIFIED, APIStore,
                                   CoalescedEvent)
-from kubernetes_tpu.testing import MakeNode, MakePod
+from kubernetes_tpu.testing import (MakeNode, MakePod,
+                                    mutation_detector_guard)
 from kubernetes_tpu.utils import FakeClock
 
 
 @pytest.fixture(autouse=True)
 def _force_mutation_detector(monkeypatch):
     """ISSUE 4 CI satellite: every store this module builds runs with the
-    mutation detector FORCE-ENABLED, and every store is checked at teardown —
-    a clone-sharing regression on the lazy-event fast path (a consumer
-    mutation reaching a stored object, or vice versa) fails tier-1 here
-    instead of corrupting watchers silently."""
-    monkeypatch.setenv("CACHE_MUTATION_DETECTOR", "1")
-    stores = []
-    orig = APIStore.__init__
-
-    def wrapped(self, *a, **kw):
-        orig(self, *a, **kw)
-        stores.append(self)
-
-    monkeypatch.setattr(APIStore, "__init__", wrapped)
-    yield
-    for s in stores:
-        s.check_mutations()
+    mutation detector FORCE-ENABLED and checked at teardown (shared impl:
+    kubernetes_tpu.testing.mutation_detector_guard; ISSUE 5 extends the same
+    guard to the gang and store test modules)."""
+    yield from mutation_detector_guard(monkeypatch)
 
 
 def _nodes(n, cpu="8", mem="32Gi"):

@@ -8,6 +8,7 @@ accounting. Placed gangs land slice-packed when a TPU slice has room.
 """
 
 import numpy as np
+import pytest
 
 from kubernetes_tpu.api.podgroup import (
     POD_GROUP_LABEL,
@@ -21,8 +22,18 @@ from kubernetes_tpu.scheduler.plugins import default_plugins
 from kubernetes_tpu.scheduler.queue import SchedulingQueue
 from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors
 from kubernetes_tpu.store import APIStore
-from kubernetes_tpu.testing import MakeNode, MakePod, make_pod_group
+from kubernetes_tpu.testing import (MakeNode, MakePod, make_pod_group,
+                                    mutation_detector_guard)
 from kubernetes_tpu.utils import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    """ISSUE 5 satellite: the gang pipeline (staging, veto, rollback,
+    requeue narration) runs under the force-enabled mutation detector —
+    MU001's runtime counterpart covers the same surface the static rule
+    does."""
+    yield from mutation_detector_guard(monkeypatch)
 
 
 def _nodes(n, cpu="8", mem="32Gi", slices=0):

@@ -1,0 +1,72 @@
+"""Jit-retrace guard (ISSUE 5 satellite): repeated same-bucket batches must
+NOT grow the solver jit cache.
+
+The waterfill fast path buckets its static args (j_max from STATIC node
+capacity, k_slots floored at 256 and pow2-bucketed — models/waterfill.py) so
+that steady-state scheduling reuses ONE compiled program. A regression there
+(e.g. someone passing a raw batch length as a static arg — schedlint JT001's
+bug class) compiles per batch: invisible to placement tests, tens of seconds
+per batch at TPU scale. This drives schedule_batch over repeated same-shape
+batches and pins the cache size; bench.py --quick surfaces the same signal
+as `jit_cache` / `solver_compiles_during_run` in the end-to-end rung JSON.
+"""
+
+from kubernetes_tpu.models.waterfill import waterfill_group
+from kubernetes_tpu.scheduler import Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod
+
+
+def _cache_size():
+    return int(waterfill_group._cache_size())
+
+
+def _synced_sched(n_nodes=16):
+    store = APIStore()
+    for i in range(n_nodes):
+        store.create("nodes", MakeNode(f"node-{i}").capacity(
+            {"cpu": "64", "memory": "256Gi", "pods": "110"}).obj())
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                          batch_size=1024, solver="fast",
+                          pipeline_binds=False)
+    sched.sync()
+    return store, sched
+
+
+def _batch(store, sched, round_no, n_pods):
+    store.create_many(
+        "pods",
+        [MakePod(f"r{round_no}-p{i}").req(
+            {"cpu": "100m", "memory": "64Mi"}).obj() for i in range(n_pods)],
+        consume=True)
+    before = sched.scheduled_count
+    sched.run_until_idle()
+    assert sched.scheduled_count - before == n_pods
+
+
+def test_same_bucket_batches_do_not_retrace():
+    store, sched = _synced_sched()
+    # round 1 pays the compile for this (j_max, k_slots, has_gang) bucket
+    _batch(store, sched, 1, 48)
+    warm = _cache_size()
+    assert warm >= 1
+    # same bucket again and again: k_slots floor (256) absorbs every batch
+    # size below it, j_max derives from static capacity — zero new compiles
+    for round_no in (2, 3, 4):
+        _batch(store, sched, round_no, 48)
+        assert _cache_size() == warm, (
+            f"solver retraced on round {round_no}: jit cache grew "
+            f"{warm} -> {_cache_size()} on an identical batch bucket")
+
+
+def test_batch_size_jitter_within_bucket_does_not_retrace():
+    """The k_slots floor exists exactly so requeue trickles / churny small
+    batches (1..256 pods) share one compiled shape."""
+    store, sched = _synced_sched()
+    _batch(store, sched, 10, 64)
+    warm = _cache_size()
+    for round_no, n in ((11, 17), (12, 130), (13, 3)):
+        _batch(store, sched, round_no, n)
+    assert _cache_size() == warm

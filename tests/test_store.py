@@ -14,9 +14,19 @@ from kubernetes_tpu.store import (
     AlreadyBoundError,
     APIStore,
     ConflictError,
+    LockOrderViolation,
     NotFoundError,
 )
-from kubernetes_tpu.testing import MakeNode, MakePod
+from kubernetes_tpu.testing import MakeNode, MakePod, mutation_detector_guard
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    """ISSUE 5 satellite: every store op this module exercises (CRUD, watch
+    replay, bind_many, status writes) runs under the force-enabled mutation
+    detector and is re-checked at teardown — the runtime counterpart of
+    schedlint MU001 on the store's own surface."""
+    yield from mutation_detector_guard(monkeypatch)
 
 
 def test_create_assigns_monotonic_rv():
@@ -177,6 +187,9 @@ def test_watch_event_objects_are_copies():
     ev.obj.spec.node_name = "sneaky"
     assert s.get("pods", "default/a").spec.node_name == ""
     s.bind("default", "a", "n1")  # must succeed
+    # repair: the module fixture re-checks every store at teardown, and this
+    # test's POINT was that the deliberate mutation stayed private
+    ev.obj.spec.node_name = ""
     w.stop()
 
 
@@ -197,3 +210,58 @@ def test_bounded_drain_leaves_remainder_buffered():
     assert len(rest) == 20_000
     assert rest[0].obj.metadata.name == "p10000"
     assert not w.terminated
+
+
+# -- runtime lock-order assertion (ISSUE 5: dynamic companion of LK001) --------
+
+
+def test_lock_order_inversion_raises_under_check():
+    """Holding the pods shard and then taking the global RV lock is the
+    docstring-forbidden order; the _OrderedRLock companion (enabled by the
+    autouse STORE_LOCK_ORDER_CHECK fixture) must refuse it loudly instead
+    of leaving a latent deadlock."""
+    s = APIStore()
+    with s._pods_lock:
+        with pytest.raises(LockOrderViolation):
+            s._lock.acquire()
+
+
+def test_lock_order_mandated_and_reentrant_orders_pass():
+    s = APIStore()
+    # global -> shard (the mandated order), nested reentrantly
+    with s._lock:
+        with s._pods_lock:
+            with s._lock:  # reentrant global under both: fine
+                pass
+    # the composite pair acquirer
+    with s._pods_pair:
+        pass
+    # shard alone, released, THEN global+shard — bind_many's two-phase shape
+    with s._pods_lock:
+        pass
+    with s._lock:
+        with s._pods_lock:
+            pass
+
+
+def test_lock_order_check_covers_real_store_traffic():
+    """The wrapped locks must be transparent to the store's actual write
+    paths (create/bind_many/status/delete all run global->shard or
+    shard-alone phases)."""
+    s = APIStore()
+    assert type(s._lock).__name__ == "_OrderedRLock"  # fixture is live
+    for i in range(4):
+        s.create("pods", MakePod(f"lk-{i}").obj())
+    s.create("nodes", MakeNode("n1").obj())
+    bound, errs = s.bind_many(
+        [("default", f"lk-{i}", "n1") for i in range(3)])
+    assert (bound, errs) == (3, [])
+    s.update_pod_status("default", "lk-3",
+                        lambda st: setattr(st, "phase", "Running"))
+    s.delete("pods", "default/lk-3")
+
+
+def test_lock_order_check_off_by_default(monkeypatch):
+    monkeypatch.delenv("STORE_LOCK_ORDER_CHECK", raising=False)
+    s = APIStore()
+    assert type(s._lock).__name__ == "RLock"
