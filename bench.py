@@ -714,6 +714,153 @@ def rung_gang(results):
         print(f"GangScheduling_2k_250: ERROR {e}", file=sys.stderr)
 
 
+def rung_chaos_churn(results):
+    """ChaosChurn_20k: the failure-domain rung (ISSUE 6) — bind 20k pods
+    end-to-end WHILE the fault injector fails the first solves (tripping the
+    solver circuit breaker to the exact scan oracle), fails store.bind_many
+    transiently at a seeded rate (exercising the bind retry/backoff), and
+    hard-kills the bind worker once mid-run (exercising the dead-worker
+    liveness recovery); a crash resync_from_store runs at the halfway mark.
+    Asserts the pod-conservation invariant — every submitted pod bound, 0
+    lost, 0 double-bound — and that the breaker tripped AND recovered to the
+    fast solver within the run. Also publishes the measured cost of the
+    DISABLED injector guard so tests can bound its NorthStar overhead <1%
+    from a measurement instead of differencing two noisy runs."""
+    from kubernetes_tpu.chaos import faultinject as fi
+    from kubernetes_tpu.scheduler import Framework
+    from kubernetes_tpu.scheduler.batch import BatchScheduler
+    from kubernetes_tpu.scheduler.plugins import default_plugins
+    from kubernetes_tpu.store import APIStore
+    from kubernetes_tpu.testing import MakePod, pod_conservation_report
+
+    try:
+        n_pods = sz(20_000, floor=2000)
+        n_nodes = sz(1000, floor=128)
+        batch = 2048
+        waves = 4
+
+        def build():
+            store = APIStore()
+            for n in _nodes(n_nodes, cpu="16", mem="64Gi"):
+                store.create("nodes", n)
+            sched = BatchScheduler(
+                store, Framework(default_plugins()), batch_size=batch,
+                solver="fast", breaker_threshold=3, breaker_cooldown_s=0.5,
+                bind_retry_base_s=0.01,
+                pod_initial_backoff=0.05, pod_max_backoff=0.2)
+            # small commit chunks: the chaos plans need MANY bind_many calls
+            # and worker cycles to bite (one merged 20k-pod cycle would see
+            # the rate fault twice)
+            sched.bind_chunk = 256
+            sched.sync()
+            return store, sched
+
+        def mk(prefix, n):
+            return [MakePod(f"{prefix}-{i}").req(
+                {"cpu": "500m", "memory": "1Gi"}).obj() for i in range(n)]
+
+        # warm-up: compile BOTH solvers at the run's shapes — the breaker
+        # drives the scan oracle mid-run, and a cold compile inside the
+        # chaos window would be measured as recovery latency
+        wstore, wsched = build()
+        wstore.create_many("pods", mk("w", min(n_pods, 2 * batch)),
+                           consume=True)
+        wsched.run_until_idle()
+        wsched.solver = "exact"
+        wstore.create_many("pods", mk("wx", batch), consume=True)
+        wsched.run_until_idle()
+        wsched.flush_binds()
+        del wstore, wsched
+
+        store, sched = build()
+        keys = [f"default/cc-{i}" for i in range(n_pods)]
+        pending = mk("cc", n_pods)
+        fi.arm([
+            fi.FaultPlan("solver.solve", "fail", count=3),
+            fi.FaultPlan("store.bind_many", "rate", rate=0.3, seed=1234),
+            fi.FaultPlan("bind.worker", "kill", after=1),
+        ])
+        t0 = time.perf_counter()
+        deadline = t0 + (40.0 if SMOKE else 240.0)
+        resynced = False
+        bound = 0
+        per_wave = (n_pods + waves - 1) // waves
+        next_wave = 0
+        injected = {}
+        try:
+            while time.perf_counter() < deadline:
+                if next_wave < n_pods:
+                    store.create_many(
+                        "pods", pending[next_wave:next_wave + per_wave],
+                        consume=True)
+                    next_wave += per_wave
+                sched.run_until_idle()
+                sched.queue.flush_backoff_completed()
+                sched.queue.move_all_to_active_or_backoff()
+                bound = sum(1 for p in store.list("pods")[0]
+                            if p.metadata.name.startswith("cc-")
+                            and p.spec.node_name)
+                if not resynced and bound >= n_pods // 2:
+                    sched.resync_from_store()  # simulated crash restart
+                    resynced = True
+                if bound >= n_pods and next_wave >= n_pods:
+                    if sched.breaker.state == "closed":
+                        break
+                    # all work drained while the breaker was still open: the
+                    # half-open probe needs a REAL batch — submit a few
+                    # probe pods (tracked by the conservation check too)
+                    extra = mk(f"probe{len(keys)}", 8)
+                    keys.extend(p.key for p in extra)
+                    store.create_many("pods", extra, consume=True)
+                    time.sleep(sched.breaker.cooldown_s / 2)
+                time.sleep(0.02)
+            injected = (fi.ACTIVE.stats() if fi.ACTIVE is not None else {})
+        finally:
+            fi.disarm()
+        # settle: with the injector gone, drain every tier to quiescence so
+        # the conservation check reads a stable partition
+        for _ in range(40):
+            sched.flush_binds()
+            sched.queue.flush_backoff_completed()
+            sched.queue.move_all_to_active_or_backoff()
+            sched.run_until_idle()
+            if all(p.spec.node_name for p in store.list("pods")[0]
+                   if not p.metadata.name.startswith(("w-", "wx-"))):
+                break
+            time.sleep(0.05)
+        dt = time.perf_counter() - t0
+        rep = pod_conservation_report(store, sched, keys)
+        c = rep["counts"]
+        brk = sched.breaker
+        ok = (c["lost"] == 0 and c["double_bound"] == 0
+              and c["bound"] == len(keys) and brk.trips >= 1
+              and brk.recoveries >= 1 and brk.state == "closed"
+              and injected.get("bind.worker", {}).get("injected", 0) >= 1
+              and sched.bind_worker_restarts >= 1)
+        results["ChaosChurn_20k"] = {
+            "pods_per_sec": round(n_pods / dt, 1), "wall_s": round(dt, 3),
+            "placed": c["bound"], "pods": len(keys),
+            "conservation": c, "conservation_ok": ok,
+            "breaker_trips": brk.trips, "breaker_recoveries": brk.recoveries,
+            "breaker_state": brk.state,
+            "bind_worker_restarts": sched.bind_worker_restarts,
+            "resynced": resynced, "injected": injected,
+            "disabled_check_ns": round(fi.disabled_check_cost_ns(), 2),
+            "solver": "fast+breaker+chaos"}
+        print(f"{'ChaosChurn_20k':>28}: {n_pods / dt:>9.0f} pods/s  "
+              f"({c['bound']}/{n_pods} bound under chaos, "
+              f"{c['lost']} lost, {c['double_bound']} double-bound, "
+              f"breaker trips={brk.trips} recoveries={brk.recoveries}, "
+              f"worker restarts={sched.bind_worker_restarts}, {dt:.1f}s)",
+              file=sys.stderr)
+    except Exception as e:
+        from kubernetes_tpu.chaos import faultinject as fi
+
+        fi.disarm()  # never leak an armed injector into later rungs
+        results["ChaosChurn_20k"] = {"error": str(e)[:200]}
+        print(f"ChaosChurn_20k: ERROR {e}", file=sys.stderr)
+
+
 def rung_transport(results):
     """Auction + Sinkhorn global solvers at 50k pods / 5k nodes (BASELINE.json
     ladder steps 3-4): throughput, placements, and mean assignment score vs
@@ -955,6 +1102,7 @@ RUNGS = [
     ("NorthStarEndToEnd", rung_north_star_endtoend),
     ("BindCommit", rung_bind_commit),
     ("GangScheduling", rung_gang),
+    ("ChaosChurn", rung_chaos_churn),
     ("SchedLint", rung_schedlint),
     ("Transport", rung_transport),
     ("ApiserverWatchFanout", rung_watch_fanout),
@@ -965,8 +1113,8 @@ RUNGS = [
 # stdout. Catches perf-path regressions (a broken coalesced ingest or bind
 # path fails loudly here) without the full ladder's budget.
 QUICK_RUNGS = ("SchedulingBasic", "MixedChurn", "NorthStarEndToEnd",
-               "BindCommit", "GangScheduling", "SchedLint")
-QUICK_BUDGET_S = 55.0
+               "BindCommit", "GangScheduling", "ChaosChurn", "SchedLint")
+QUICK_BUDGET_S = 75.0
 
 
 def cpu_fallback(reason: str) -> int:

@@ -15,6 +15,7 @@ from typing import Dict, Optional
 
 from ..api import Node
 from ..api.workloads import Lease
+from ..chaos import faultinject as _chaos
 from ..api.types import ObjectMeta, RUNNING, new_uid
 from ..store import APIStore, AlreadyExistsError, ConflictError, NotFoundError
 from ..utils import Clock
@@ -56,6 +57,9 @@ class HollowKubelet:
             self._run_pod(p)
 
     def heartbeat(self) -> None:
+        if _chaos.ACTIVE is not None and _chaos.ACTIVE.should_drop(
+                "kubelet.heartbeat", self.node_name):
+            return  # injected missed renewal: node_lifecycle must notice
         key = f"{LEASE_NAMESPACE}/{self.node_name}"
         now = self.clock.now()
         try:

@@ -1315,6 +1315,17 @@ def _render_sched_stats(doc: Dict) -> str:
                 f"vetoes={gang.get('vetoes', 0)} "
                 f"quorum_expired_assumes="
                 f"{gang.get('quorum_expired_assumes', 0)}")
+        brk = st.get("breaker")
+        bw = st.get("bind_worker")
+        if brk and (brk.get("state") != "closed" or brk.get("trips")
+                    or (bw or {}).get("restarts")
+                    or (bw or {}).get("failures_dropped")):
+            # failure domains: shown only when something actually happened
+            out.append(
+                f"breaker: {brk.get('state')} trips={brk.get('trips', 0)} "
+                f"recoveries={brk.get('recoveries', 0)}   "
+                f"bind worker: restarts={(bw or {}).get('restarts', 0)} "
+                f"failures_dropped={(bw or {}).get('failures_dropped', 0)}")
         stages = st.get("stages") or {}
         if stages:
             last = (st.get("last_batch") or {}).get("stages") or {}

@@ -16,7 +16,7 @@ from __future__ import annotations
 from typing import Optional
 
 from ..api import Node, Taint
-from ..api.types import NodeCondition, TAINT_NO_EXECUTE
+from ..api.types import NodeCondition, TAINT_NO_EXECUTE, TAINT_NO_SCHEDULE
 from ..store import NotFoundError
 from .base import Controller
 
@@ -53,6 +53,8 @@ class NodeLifecycleController(Controller):
         # suppress this controller's NoExecute escalation for unhealthy nodes
         has_noexec = any(t.key == NOT_READY_TAINT and t.effect == TAINT_NO_EXECUTE
                          for t in node.spec.taints)
+        has_nosched = any(t.key == NOT_READY_TAINT and t.effect == TAINT_NO_SCHEDULE
+                          for t in node.spec.taints)
         has_any = any(t.key == NOT_READY_TAINT for t in node.spec.taints)
         if ready and has_any:
             def clear(obj: Node) -> Node:
@@ -61,9 +63,20 @@ class NodeLifecycleController(Controller):
                 return obj
 
             self.store.guaranteed_update("nodes", name, clear)
-        elif not ready and not has_noexec:
+        elif not ready and not (has_noexec and has_nosched):
             def taint(obj: Node) -> Node:
-                obj.spec.taints.append(Taint(key=NOT_READY_TAINT, effect=TAINT_NO_EXECUTE))
+                # BOTH effects, like the reference controller: NoExecute
+                # drives the eviction chain, while NoSchedule keeps the
+                # scheduler off the dead node — without it, replacements
+                # that tolerate not-ready:NoExecute (the admission-defaulted
+                # 300s toleration) would land right back on the corpse and
+                # churn through eviction again (ISSUE 6 node-death chain)
+                effects = {t.effect for t in obj.spec.taints
+                           if t.key == NOT_READY_TAINT}
+                for eff in (TAINT_NO_EXECUTE, TAINT_NO_SCHEDULE):
+                    if eff not in effects:
+                        obj.spec.taints.append(
+                            Taint(key=NOT_READY_TAINT, effect=eff))
                 self._set_ready_condition(obj, False)
                 return obj
 

@@ -13,20 +13,32 @@ behind the same extension surface, serial path always available).
 from __future__ import annotations
 
 import queue as _queue
+import random as _random
 import threading
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from ..chaos import faultinject as _chaos
+from ..chaos.faultinject import FaultKill
 from ..snapshot.tensorizer import TensorCache, build_cluster_tensors, build_pod_batch
 from ..store import (MODIFIED, APIStore, NotFoundError, pod_bind_clone,
                      pod_structural_clone)
+from .breaker import SolverCircuitBreaker
 from .flightrec import FlightRecorder, StageClock, register_scheduler
 from .framework import Status
 from .queue import QueuedPodInfo
 from .runtime import Framework
 from .serial import Scheduler
+
+
+class _RequeuedChunk(list):
+    """A bind chunk getting its ONE supervised retry after an escaped
+    bind-worker exception (or a dead-worker recovery). A second escape fails
+    its pods through the normal bind-error path instead of re-queueing again
+    — no livelock on a deterministic fault."""
 
 
 class BatchScheduler(Scheduler):
@@ -36,10 +48,14 @@ class BatchScheduler(Scheduler):
     for constraint-free batches; native/hostsched.cpp), or 'auto' (fast when
     the batch has no topology-spread constraints, exact otherwise)."""
 
+    BIND_FAILURE_LOG_CAP = 10_000  # take_bind_failures log bound
+
     def __init__(self, store: APIStore, framework: Framework, batch_size: int = 4096,
                  solver: str = "exact", pipeline_binds: bool = True,
                  columnar: bool = True, flight_recorder: bool = True,
-                 flight_capacity: int = FlightRecorder.DEFAULT_CAPACITY, **kw):
+                 flight_capacity: int = FlightRecorder.DEFAULT_CAPACITY,
+                 breaker_threshold: int = 3, breaker_cooldown_s: float = 30.0,
+                 bind_retries: int = 3, bind_retry_base_s: float = 0.05, **kw):
         super().__init__(store, framework, **kw)
         self.batch_size = batch_size
         self.solver = solver
@@ -87,8 +103,31 @@ class BatchScheduler(Scheduler):
         self._bind_confirm_leftovers: List = []
         # async bind failures, surfaced to schedule_batch callers (the worker
         # requeues them internally, but "my bind_many failed" was invisible):
-        # [(pod key, message)], drained via take_bind_failures()
-        self.bind_failures: List = []
+        # (pod key, message) pairs drained via take_bind_failures(). BOUNDED:
+        # under sustained bind faults with no drainer the deque evicts oldest
+        # entries and counts them instead of leaking (ISSUE 6 satellite)
+        self.bind_failures: deque = deque(maxlen=self.BIND_FAILURE_LOG_CAP)
+        self.bind_failures_dropped = 0
+        # failure domains (ISSUE 6): solver circuit breaker (trips the fast
+        # solver to the exact scan oracle after `breaker_threshold`
+        # consecutive solver exceptions, half-open recovery after cooldown),
+        # transient-bind retry policy, and bind-worker supervision state
+        self.breaker = SolverCircuitBreaker(clock=self.clock,
+                                            threshold=breaker_threshold,
+                                            cooldown_s=breaker_cooldown_s)
+        self.bind_retries = bind_retries
+        self.bind_retry_base_s = bind_retry_base_s
+        # the solver path the last _solve_device call executed (or was
+        # executing when it raised) — what the breaker is fed, since the
+        # MODE label alone would credit a constrained batch's scan run to
+        # the fast path (scheduler/breaker.py path_matches_mode)
+        self._solve_path = "exact"
+        # in-flight bind chunks (each owing one task_done): recorded by the
+        # worker before commit, cleared after bookkeeping — non-empty with a
+        # DEAD worker means a hard kill stranded them, and the liveness check
+        # in _drain_bind_results re-queues them and settles the join() debt
+        self._bind_inflight: List = []
+        self.bind_worker_restarts = 0  # supervised escapes + dead-worker recoveries
         # gang scheduling (scheduler/gang.py): PodGroup quorums + placed
         # members, fed by the watch plumbing in serial.py; the queue holds
         # gang members in staging until quorum, and schedule_batch enforces
@@ -148,12 +187,18 @@ class BatchScheduler(Scheduler):
         self._batch_reasons = reasons = {}
         outcome = "error"  # overwritten unless the body raises
         out: Dict = {}
+        # circuit breaker (scheduler/breaker.py): pick THIS batch's solver —
+        # the configured one while CLOSED, the exact scan while OPEN, a
+        # single probe of the configured one when HALF_OPEN
+        out["solver"] = self.breaker.effective_solver(self.solver)
+        m.solver_breaker_state.set(self.breaker.code)
         try:
             self._schedule_batch_inner(qps, clock, trace, m,
                                        greedy_scan_solve, make_inputs, out)
             outcome = ("scheduled"
                        if out.get("dispatched", 0)
                        + out.get("serial_scheduled", 0) > 0
+                       else "error" if "batch_error" in out
                        else "unschedulable")
             return len(qps)
         finally:
@@ -168,7 +213,8 @@ class BatchScheduler(Scheduler):
                 m.gang_staged.set(self.queue.gang_staged_count())
             fr.record(
                 pods=len(qps), nodes=out.get("nodes", 0), outcome=outcome,
-                solver=self.solver, stages=clock.stages, total_s=total,
+                solver=out.get("solver", self.solver), stages=clock.stages,
+                total_s=total,
                 scheduled=out.get("dispatched", 0)
                 + out.get("serial_scheduled", 0),
                 unschedulable=self.failed_count - failed0,
@@ -176,7 +222,10 @@ class BatchScheduler(Scheduler):
                 preempted=self.preempt_victims_total - victims0,
                 reasons=reasons, gang=out.get("gang"),
                 solver_iterations=getattr(self.transport_state,
-                                          "iterations", None))
+                                          "iterations", None),
+                breaker=(self.breaker.state
+                         if self.breaker.state != "closed" else None),
+                error=out.get("batch_error"))
             trace.log_if_long(self.trace_threshold)
             fr.note_self_time(time.perf_counter() - t_fin)
 
@@ -211,6 +260,7 @@ class BatchScheduler(Scheduler):
         trace.step("Built pod batch", device=int(device_idx.size),
                    fallback=int(fallback_idx.size))
 
+        assignment = None
         if device_idx.size:
             sub = _subset_batch(batch, device_idx)
             # gang members present in the device batch? (solver bias + the
@@ -219,50 +269,27 @@ class BatchScheduler(Scheduler):
             # take the fast/exact paths (which do).
             has_gang = (sub.gang_of_pod is not None
                         and bool((sub.gang_of_pod >= 0).any()))
-            # 'fast' means fast-when-legal: the water-fill kernel has no
-            # topology-spread or inter-pod-affinity handling, so constrained
-            # batches always take the exact scan path regardless of mode.
-            constraint_free = (batch.ct_class.size == 0 and batch.st_class.size == 0
-                               and not batch.ipa.has_any)
-            use_fast = self.solver in ("fast", "auto") and constraint_free
-            use_transport = (self.solver in ("auction", "sinkhorn")
-                             and constraint_free and not has_gang)
-            assignment = None
-            if self.solver == "native" and constraint_free and not has_gang:
-                from ..native import native_available, native_greedy_solve
-
-                if native_available():
-                    assignment, _ = native_greedy_solve(cluster, sub)
-            # device upload happens only for paths that consume it; cluster
-            # tensors ride the persistent HBM mirrors (diff streaming)
-            inputs = d_max = None
-            if assignment is None:
-                inputs, d_max = make_inputs(
-                    cluster, sub,
-                    device=self._tensor_cache.device_views(cluster))
-            if use_transport:
-                from ..models.transport import transport_solve
-                from ..models.waterfill import make_groups
-
-                solved = transport_solve(
-                    inputs, make_groups(sub), method=self.solver,
-                    state=self.transport_state, node_names=cluster.node_names,
-                )
-                if solved is not None:
-                    assignment, self.transport_state = solved
-            if use_fast:
-                from ..models.waterfill import make_groups, waterfill_solve
-
-                assignment = waterfill_solve(inputs, make_groups(sub))
-            if assignment is None:
-                # static gates: constraint-free batches compile the scan
-                # variant without IPA gathers / PTS segment sums
-                assignment, _, _ = greedy_scan_solve(
-                    inputs, d_max, has_ipa=bool(batch.ipa.has_any),
-                    has_ct=bool(batch.ct_class.size),
-                    has_st=bool(batch.st_class.size),
-                    has_gang=bool(has_gang and sub.gang_bonus is not None))
-            assignment = np.asarray(assignment)
+            solver = out.get("solver", self.solver)
+            # Solver failure domain (ISSUE 6): a solver exception no longer
+            # loses the batch — no assume has happened yet at solve time, so
+            # the device pods requeue into the backoff tier as a unit and the
+            # circuit breaker decides whether the NEXT batch degrades to the
+            # exact scan oracle (scheduler/breaker.py).
+            try:
+                assignment = self._solve_device(solver, cluster, batch, sub,
+                                                has_gang, greedy_scan_solve,
+                                                make_inputs)
+            except FaultKill:
+                raise  # an injected hard death is not a handled fault
+            except Exception as e:
+                self._handle_solver_error(e, qps, device_idx, solver, out, m)
+                clock.mark("solve")
+                trace.step("Solver failed; batch requeued",
+                           error=type(e).__name__)
+                assignment = None
+            else:
+                self.breaker.record_success(self._solve_path, self.solver)
+        if device_idx.size and assignment is not None:
             # All-or-nothing gang veto (scheduler/gang.py), BEFORE any assume
             # or bind: a gang whose in-batch placements (plus members already
             # placed) miss min_member is stripped wholesale — its placed rows
@@ -298,7 +325,7 @@ class BatchScheduler(Scheduler):
                     m.gang_vetoed_total.inc(n_vetoed, reason="solver")
                     assignment = np.where(veto, -1, assignment)
             clock.mark("solve")
-            trace.step("Device solve done", solver=self.solver)
+            trace.step("Device solve done", solver=solver)
             # Two phases: bind every device assignment FIRST, then handle the
             # rejected pods. Handling mid-loop would see capacity still
             # promised to not-yet-bound assignments and double-book nodes.
@@ -346,17 +373,37 @@ class BatchScheduler(Scheduler):
                 # batches under queue contention, while one 100k batch
                 # would hold the store lock against every consumer
                 pairs = [(assumed, node) for _qp, node, assumed in to_bind]
+                batch_has_ports = True
                 if use_columnar:
                     batch_has_ports = bool(
                         batch.class_has_host_ports is None
                         or batch.class_has_host_ports[
                             batch.class_of_pod[bind_rows]].any())
-                    # structural phase only; resource totals follow as one
-                    # scatter-add in _columnar_account
-                    bad = self.cache.assume_pods_structural(
-                        pairs, check_ports=batch_has_ports)
-                else:
-                    bad = self.cache.assume_pods(pairs)
+                # Assume/dispatch failure domain (ISSUE 6): an exception in
+                # this window used to strand the whole batch's assumes. The
+                # guard rolls back every entry whose chunk has NOT reached
+                # the bind path and requeues it with backoff; dispatched
+                # chunks are in flight, owned by the bind worker's own
+                # retry/error machinery.
+                accounted = False
+                dispatched_hi = 0
+                sync_bind_s = 0.0
+                try:
+                    if use_columnar:
+                        # structural phase only; resource totals follow as
+                        # one scatter-add in _columnar_account
+                        bad = self.cache.assume_pods_structural(
+                            pairs, check_ports=batch_has_ports)
+                    else:
+                        bad = self.cache.assume_pods(pairs)
+                except FaultKill:
+                    raise
+                except Exception as e:
+                    self._rollback_undispatched(
+                        e, to_bind, bind_gang, 0, use_columnar, False,
+                        batch_has_ports, m, out)
+                    to_bind = []
+                    bad = []
                 bad_gangs = set()
                 for i, msg in sorted(bad, reverse=True):
                     qp, node, _assumed = to_bind.pop(i)
@@ -403,25 +450,34 @@ class BatchScheduler(Scheduler):
                     for i, (_qp, _node, assumed) in enumerate(to_bind):
                         if bind_gang[i] >= 0:
                             self.gangs.note_assumed(assumed)
-                if use_columnar and to_bind:
-                    self._columnar_account(batch, cluster, snapshot,
-                                           bind_rows, bind_nodes,
-                                           batch_has_ports)
-                clock.mark("assume")
-                trace.step("Assumed placements", bound=len(to_bind))
-                out["dispatched"] = len(to_bind)
-                sync_bind_s = 0.0
-                for lo in range(0, len(to_bind), self.bind_chunk):
-                    chunk = to_bind[lo:lo + self.bind_chunk]
-                    if self.pipeline_binds:
-                        self._ensure_bind_worker()
-                        self._bind_q.put(chunk)
-                    else:
-                        t0 = time.perf_counter()
-                        self._bind_batch(chunk)
-                        sync_bind_s += time.perf_counter() - t0
-                if not self.pipeline_binds:
-                    self._drain_bind_results()
+                try:
+                    if use_columnar and to_bind:
+                        self._columnar_account(batch, cluster, snapshot,
+                                               bind_rows, bind_nodes,
+                                               batch_has_ports)
+                        accounted = True
+                    clock.mark("assume")
+                    trace.step("Assumed placements", bound=len(to_bind))
+                    out["dispatched"] = len(to_bind)
+                    for lo in range(0, len(to_bind), self.bind_chunk):
+                        chunk = to_bind[lo:lo + self.bind_chunk]
+                        if self.pipeline_binds:
+                            self._ensure_bind_worker()
+                            self._bind_q.put(chunk)
+                        else:
+                            t0 = time.perf_counter()
+                            self._bind_batch(chunk)
+                            sync_bind_s += time.perf_counter() - t0
+                        dispatched_hi = lo + len(chunk)
+                    if not self.pipeline_binds:
+                        self._drain_bind_results()
+                except FaultKill:
+                    raise
+                except Exception as e:
+                    self._rollback_undispatched(
+                        e, to_bind, bind_gang, dispatched_hi, use_columnar,
+                        accounted, batch_has_ports, m, out)
+                    out["dispatched"] = dispatched_hi
                 clock.mark("dispatch")
                 # synchronous binds ran inside the dispatch span AND are
                 # observed as the "bind" stage by _bind_batch — keep the
@@ -455,6 +511,142 @@ class BatchScheduler(Scheduler):
             out["serial_scheduled"] = self.scheduled_count - fb0
             clock.mark("fallback")
             trace.step("Serial fallback done", pods=len(fallback_idx))
+
+    def _solve_device(self, solver, cluster, batch, sub, has_gang,
+                      greedy_scan_solve, make_inputs) -> np.ndarray:
+        """One device-batch solver dispatch, parameterized by the (possibly
+        breaker-degraded) solver choice. 'fast' means fast-when-legal: the
+        water-fill kernel has no topology-spread or inter-pod-affinity
+        handling, so constrained batches always take the exact scan path
+        regardless of mode. Any exception propagates to the failure-domain
+        handler in _schedule_batch_inner (the batch requeues; it is never
+        lost)."""
+        from .breaker import REPRESENTATIVE
+
+        # _solve_path tracks the path actually executing at every point so
+        # both the success return and an exception anywhere in here
+        # attribute to the right solver (the breaker must never credit a
+        # scan outcome to the fast path, or vice versa). Until routing is
+        # decided — the injected fire and the shared make_inputs prep —
+        # failures count against the mode under protection.
+        self._solve_path = REPRESENTATIVE.get(solver, solver)
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.fire("solver.solve")
+        constraint_free = (batch.ct_class.size == 0
+                           and batch.st_class.size == 0
+                           and not batch.ipa.has_any)
+        use_fast = solver in ("fast", "auto") and constraint_free
+        use_transport = (solver in ("auction", "sinkhorn")
+                         and constraint_free and not has_gang)
+        if not constraint_free:
+            self._solve_path = "exact"  # the scan owns constrained batches
+        assignment = None
+        if solver == "native" and constraint_free and not has_gang:
+            from ..native import native_available, native_greedy_solve
+
+            if native_available():
+                self._solve_path = "native"
+                assignment, _ = native_greedy_solve(cluster, sub)
+                if assignment is None:
+                    self._solve_path = "exact"
+        # device upload happens only for paths that consume it; cluster
+        # tensors ride the persistent HBM mirrors (diff streaming)
+        inputs = d_max = None
+        if assignment is None:
+            inputs, d_max = make_inputs(
+                cluster, sub,
+                device=self._tensor_cache.device_views(cluster))
+        if use_transport:
+            from ..models.transport import transport_solve
+            from ..models.waterfill import make_groups
+
+            self._solve_path = solver
+            solved = transport_solve(
+                inputs, make_groups(sub), method=solver,
+                state=self.transport_state, node_names=cluster.node_names,
+            )
+            if solved is not None:
+                assignment, self.transport_state = solved
+            else:
+                self._solve_path = "exact"  # declined: the scan takes it
+        if use_fast:
+            from ..models.waterfill import make_groups, waterfill_solve
+
+            self._solve_path = "fast"
+            assignment = waterfill_solve(inputs, make_groups(sub))
+        if assignment is None:
+            # static gates: constraint-free batches compile the scan
+            # variant without IPA gathers / PTS segment sums
+            self._solve_path = "exact"
+            assignment, _, _ = greedy_scan_solve(
+                inputs, d_max, has_ipa=bool(batch.ipa.has_any),
+                has_ct=bool(batch.ct_class.size),
+                has_st=bool(batch.st_class.size),
+                has_gang=bool(has_gang and sub.gang_bonus is not None))
+        return np.asarray(assignment)
+
+    def _handle_solver_error(self, e, qps, device_idx, solver, out, m) -> None:
+        """Solver failure domain: requeue the device pods with backoff (the
+        pods are fine — the INFRASTRUCTURE hiccuped, so no cluster event is
+        needed before retrying), feed the circuit breaker, and narrate ONCE
+        per batch (a 100k-pod batch must not write 100k events)."""
+        qps_dev = [qps[pi] for pi in device_idx.tolist()]
+        tripped = self.breaker.record_failure(self._solve_path, self.solver)
+        m.solver_breaker_state.set(self.breaker.code)
+        m.batch_retries_total.inc(len(qps_dev), stage="solve",
+                                  reason=type(e).__name__)
+        self.queue.add_backoff(qps_dev)
+        sink = self._batch_reasons
+        if sink is not None:
+            sink["SolverError"] = sink.get("SolverError", 0) + len(qps_dev)
+        out["batch_error"] = f"{type(e).__name__}: {e}"[:200]
+        msg = (f"solver {solver} failed ({type(e).__name__}: {str(e)[:120]});"
+               f" {len(qps_dev)} pod(s) requeued with backoff")
+        if tripped:
+            msg += (f"; circuit breaker OPEN — degrading to "
+                    f"{self.breaker.effective_solver(self.solver)} for "
+                    f"{self.breaker.cooldown_s:g}s")
+        self.recorder.event(qps_dev[0].pod, "Warning", "SchedulerError", msg)
+
+    def _rollback_undispatched(self, e, to_bind, bind_gang, dispatched,
+                               use_columnar, accounted, batch_has_ports,
+                               m, out) -> int:
+        """Assume/dispatch failure domain: roll back every to_bind entry at
+        index >= `dispatched` (its chunk never reached the bind path) and
+        requeue it with backoff. Before _columnar_account ran, the rollback
+        is the STRUCTURAL inverse (phase-2 resource totals were never added
+        — forget_pod would drive them negative); after it, forget_pod is the
+        exact inverse. A failure INSIDE _columnar_account leaves the few
+        already-poked nodes conservatively over-counted (capacity looks
+        smaller than it is — the safe direction) until the diff path
+        requantizes or resync_from_store rebuilds."""
+        stranded = to_bind[dispatched:]
+        if not stranded:
+            return 0
+        released = [assumed for _qp, _node, assumed in stranded]
+        if use_columnar and not accounted:
+            self.cache.forget_pods_structural(released,
+                                              check_ports=batch_has_ports)
+        else:
+            for assumed in released:
+                self.cache.forget_pod(assumed)
+        if self.gangs is not None and bind_gang:
+            for i in range(dispatched, len(to_bind)):
+                if bind_gang[i] >= 0:
+                    self.gangs.note_forgotten(to_bind[i][2])
+        self.queue.add_backoff([qp for qp, _node, _assumed in stranded])
+        m.batch_retries_total.inc(len(stranded), stage="dispatch",
+                                  reason=type(e).__name__)
+        sink = self._batch_reasons
+        if sink is not None:
+            sink["DispatchError"] = (sink.get("DispatchError", 0)
+                                     + len(stranded))
+        out["batch_error"] = f"{type(e).__name__}: {e}"[:200]
+        self.recorder.event(
+            stranded[0][0].pod, "Warning", "SchedulerError",
+            f"assume/dispatch failed ({type(e).__name__}: {str(e)[:120]}); "
+            f"{len(stranded)} assumed pod(s) rolled back and requeued")
+        return len(stranded)
 
     def _requeue_gangs(self, groups: Dict[int, List[QueuedPodInfo]],
                        keys: List[str],
@@ -828,6 +1020,12 @@ class BatchScheduler(Scheduler):
             "queue": {"active": active, "backoff": backoff,
                       "unschedulable": unsched},
             "gang": gang,
+            "breaker": self.breaker.describe(),
+            "bind_worker": {
+                "restarts": self.bind_worker_restarts,
+                "failures_logged": len(self.bind_failures),
+                "failures_dropped": self.bind_failures_dropped,
+            },
             "recorder": {"enabled": fr.enabled, "capacity": fr.capacity,
                          "records": len(fr),
                          "self_seconds": round(fr.self_seconds, 6)},
@@ -866,43 +1064,131 @@ class BatchScheduler(Scheduler):
 
     def _ensure_bind_worker(self) -> None:
         if self._bind_worker is None or not self._bind_worker.is_alive():
-            self._bind_worker = threading.Thread(target=self._bind_loop, daemon=True)
+            # the queue is BOUND at thread start: a crash resync swaps
+            # self._bind_q for a fresh queue, and the old worker must keep
+            # draining (and exiting on) the queue it was born with
+            self._bind_worker = threading.Thread(
+                target=self._bind_loop, args=(self._bind_q,), daemon=True)
             self._bind_worker.start()
 
-    def _bind_loop(self) -> None:
-        """Drains the bind queue in PIPELINED sub-batches: items queued at
-        wake-up are merged only up to bind_chunk pods per store.bind_many +
-        confirm cycle, so commit(N) runs while the scheduling thread works
-        on solve(N+1) — chunk-granular overlap instead of one monolithic
-        commit that the scheduling thread can only wait behind (the
-        bind_wait stall the PR 3 stage table surfaced)."""
+    def _bind_loop(self, q: _queue.Queue) -> None:
+        """SUPERVISED bind worker (ISSUE 6): _bind_cycle drains one pipelined
+        sub-batch; an exception that escapes it (past _bind_batch's own
+        error handling) no longer kills the worker silently — the supervisor
+        counts the escape and continues, after _bind_cycle re-queued the
+        in-flight chunk for ONE retry (a second escape fails its pods). An
+        injected FaultKill is the deliberate exception: it is a hard thread
+        death, recovered by the liveness check in _drain_bind_results."""
         while True:
-            item = self._bind_q.get()
-            if item is None:
-                self._bind_q.task_done()
-                return
-            batches = [item]  # each queue item is a LIST of bind triples
-            merged = len(item)
-            done = False
-            while merged < self.bind_chunk:
-                try:
-                    nxt = self._bind_q.get_nowait()
-                except _queue.Empty:
-                    break
-                if nxt is None:
-                    done = True
-                    break
-                batches.append(nxt)
-                merged += len(nxt)
             try:
-                self._bind_batch([t for b in batches for t in b])
-            finally:
-                for _ in batches:
-                    self._bind_q.task_done()
-                if done:
-                    self._bind_q.task_done()  # the sentinel
-            if done:
+                if self._bind_cycle(q):
+                    return
+            except FaultKill:
+                # hard death by design: exit WITHOUT the cycle bookkeeping
+                # (the in-flight chunk stays recorded, its task_done debt
+                # unsettled) — exactly what a real thread-killing failure
+                # leaves behind; the liveness check recovers both
                 return
+            except Exception:
+                with self._bind_err_lock:
+                    self.bind_worker_restarts += 1
+
+    def _bind_cycle(self, q: _queue.Queue) -> bool:
+        """One drain cycle: items queued at wake-up are merged only up to
+        bind_chunk pods per store.bind_many + confirm cycle, so commit(N)
+        runs while the scheduling thread works on solve(N+1) — chunk-granular
+        overlap instead of one monolithic commit (the bind_wait stall the
+        PR 3 stage table surfaced). Returns True on the shutdown sentinel.
+
+        Bookkeeping contract: the merged batches are recorded in
+        _bind_inflight BEFORE commit and cleared — with their task_done debt
+        settled — on every handled path. Only a hard kill leaves them
+        recorded, which is exactly what the dead-worker liveness check needs
+        to re-queue them and unwedge flush_binds."""
+        item = q.get()
+        if item is None:
+            q.task_done()
+            return True
+        batches = [item]  # each queue item is a LIST of bind triples
+        merged = len(item)
+        while merged < self.bind_chunk:
+            try:
+                nxt = q.get_nowait()
+            except _queue.Empty:
+                break
+            if nxt is None:
+                # shutdown requested mid-merge: put the sentinel back for
+                # the NEXT cycle (settling our get) so this cycle's chunk
+                # commits under the normal bookkeeping
+                q.put(None)
+                q.task_done()
+                break
+            batches.append(nxt)
+            merged += len(nxt)
+        with self._bind_err_lock:
+            self._bind_inflight = batches
+        handled = False
+        try:
+            if _chaos.ACTIVE is not None:
+                _chaos.ACTIVE.fire("bind.worker")
+            self._bind_batch([t for b in batches for t in b])
+            handled = True
+        except Exception:
+            self._requeue_inflight(batches, q)
+            handled = True
+            raise  # the supervisor counts the escape
+        finally:
+            if handled:
+                with self._bind_err_lock:
+                    self._bind_inflight = []
+                for _ in batches:
+                    q.task_done()
+            # BaseException (FaultKill): leave _bind_inflight recorded with
+            # its task_done debt — _drain_bind_results settles both
+        return False
+
+    def _requeue_inflight(self, batches, q: _queue.Queue) -> None:
+        """Give each escaped in-flight chunk ONE more trip through the bind
+        queue; a chunk that already retried fails its pods through the
+        normal bind-error path instead (requeue via _drain_bind_results) —
+        a deterministic escape must not livelock the worker."""
+        for b in batches:
+            if isinstance(b, _RequeuedChunk):
+                with self._bind_err_lock:
+                    for qp, _node, assumed in b:
+                        self.cache.forget_pod(assumed)
+                        if self.gangs is not None:
+                            self.gangs.note_forgotten(assumed)
+                        self._bind_errors.append((qp, Status.error(
+                            "bind worker failed twice on this chunk")))
+            else:
+                q.put(_RequeuedChunk(b))
+        from ..server import metrics as m
+
+        # pods, not chunks — the metric's unit across every requeue stage
+        m.batch_retries_total.inc(sum(len(b) for b in batches),
+                                  stage="worker", reason="escaped")
+
+    def _check_bind_worker_alive(self) -> None:
+        """Dead-worker liveness check (ISSUE 6 satellite), run every drain:
+        _ensure_bind_worker is only consulted on enqueue, so a worker that
+        died hard (FaultKill, MemoryError) with an empty bind queue used to
+        stay dead — and its in-flight chunk's unmatched task_done debt hung
+        flush_binds forever. Here: re-queue the stranded chunks, settle the
+        debt, and restart the worker if work remains."""
+        w = self._bind_worker
+        if w is None or w.is_alive():
+            return
+        with self._bind_err_lock:
+            inflight, self._bind_inflight = self._bind_inflight, []
+            self.bind_worker_restarts += 1
+        self._bind_worker = None
+        if inflight:
+            self._requeue_inflight(inflight, self._bind_q)
+            for _ in inflight:
+                self._bind_q.task_done()  # the dead worker's unmatched gets
+        if self._bind_q.unfinished_tasks:
+            self._ensure_bind_worker()
 
     def _bind_batch(self, items) -> None:
         t0 = time.perf_counter()
@@ -921,17 +1207,14 @@ class BatchScheduler(Scheduler):
                    for qp, node, _assumed in items]
         # chunked: each bind_many holds the store locks once; a single
         # 100k-bind hold would starve every other store consumer. A chunk
-        # that throws fails ONLY its own pods — earlier chunks already
-        # committed and must not be forgotten/requeued.
+        # whose retries are exhausted fails ONLY its own pods — earlier
+        # chunks already committed and must not be forgotten/requeued.
         errors = []
         for lo in range(0, len(triples), self.bind_chunk):
             chunk = triples[lo:lo + self.bind_chunk]
-            try:
-                _bound, errs = self.store.bind_many(
-                    chunk, origin=self._bind_origin)
-                errors.extend(errs)
-            except Exception as e:
-                errors.extend((f"{ns}/{name}", str(e))
+            exc = self._bind_chunk_with_retry(chunk, errors)
+            if exc is not None:
+                errors.extend((f"{ns}/{name}", str(exc))
                               for ns, name, _node in chunk)
         if not errors:
             # common case: whole sub-batch committed. On the coalesced
@@ -978,12 +1261,44 @@ class BatchScheduler(Scheduler):
                 self._bind_confirm_leftovers.extend(
                     confirm[i][2] for i in leftover)
 
+    def _bind_chunk_with_retry(self, chunk, errors) -> Optional[Exception]:
+        """One chunk's bind_many with transient-failure retry (ISSUE 6):
+        an EXCEPTION from bind_many is infrastructure (the per-pod conflict
+        errors come back in the error list and are never retried — a
+        conflict is a fact, not a fault), so the chunk retries up to
+        bind_retries times under exponential backoff with jitter before its
+        pods are declared failed. Returns the final exception, or None on
+        success. Runs on the bind worker with NO lock held — the sleeps
+        stall only the overlapped commit, never the scheduling thread."""
+        last: Optional[Exception] = None
+        for attempt in range(self.bind_retries + 1):
+            if attempt:
+                from ..server import metrics as m
+
+                m.batch_retries_total.inc(stage="bind", reason="transient")
+                delay = (self.bind_retry_base_s * (2 ** (attempt - 1))
+                         * (1.0 + _random.random()))
+                time.sleep(delay)
+            try:
+                _bound, errs = self.store.bind_many(
+                    chunk, origin=self._bind_origin)
+                errors.extend(errs)
+                return None
+            except Exception as e:
+                last = e
+        return last
+
     def _drain_bind_results(self) -> None:
         """Fold completed async binds into counters and re-handle failures on
         the scheduling thread (handleBindingCycleError -> requeue). Does NOT
         wait for in-flight binds — callable every cycle under sustained load.
         Failures are requeued AND recorded in bind_failures so callers of
-        schedule_batch can observe them (take_bind_failures)."""
+        schedule_batch can observe them (take_bind_failures). Also runs the
+        dead-worker liveness check: called every schedule_batch cycle, so a
+        hard-killed worker is detected within one cycle even when the bind
+        queue is empty (ISSUE 6 satellite)."""
+        if self.pipeline_binds:
+            self._check_bind_worker_alive()
         with self._bind_err_lock:
             done, self._bind_successes = self._bind_successes, 0
             errs, self._bind_errors = self._bind_errors, []
@@ -1006,31 +1321,83 @@ class BatchScheduler(Scheduler):
         if errs:
             self.flightrec.note_bind_failures(
                 [(qp.pod.key, status.message()) for qp, status in errs])
+        log = self.bind_failures
         for qp, status in errs:
-            self.bind_failures.append((qp.pod.key, status.message()))
+            if len(log) == log.maxlen:
+                # bounded (ISSUE 6 satellite): a caller that never drains
+                # must not leak under sustained bind faults — evict oldest,
+                # count the drop so the loss is observable
+                self.bind_failures_dropped += 1
+            log.append((qp.pod.key, status.message()))
             self._handle_failure(qp, status)
-        if len(self.bind_failures) > 100_000:
-            del self.bind_failures[:50_000]  # bounded if never drained
 
     def take_bind_failures(self) -> List:
         """Drain the (pod key, error message) log of asynchronous bind
         failures observed since the last call. The pods themselves were
         already requeued via the normal failure path; this surfaces WHAT
         failed to callers of schedule_batch/flush_binds, which otherwise
-        only ever see success counts."""
-        out, self.bind_failures = self.bind_failures, []
+        only ever see success counts. Bounded: under sustained faults with
+        no drainer the log holds the most recent BIND_FAILURE_LOG_CAP
+        entries (bind_failures_dropped counts the evictions)."""
+        out = list(self.bind_failures)
+        self.bind_failures.clear()
         return out
 
     def flush_binds(self) -> None:
         """Wait for queued store.bind writes, then drain results. The wait is
         recorded as the "bind_wait" stage — the scheduling thread's stall on
         in-flight binds, the residual the stage table needs to explain wall
-        time when binds don't fully overlap the next solve."""
+        time when binds don't fully overlap the next solve.
+
+        The wait is LIVENESS-AWARE (ISSUE 6): a plain Queue.join() hung
+        forever when the worker died hard mid-chunk (the chunk's task_done
+        debt was never settled). Here the wait wakes on task_done as before
+        but re-checks the worker between naps, so a dead worker is replaced
+        and its stranded chunk re-queued instead of wedging the flush."""
         t0 = time.perf_counter()
         if self._bind_worker is not None:
-            self._bind_q.join()
+            q = self._bind_q
+            while True:
+                with q.all_tasks_done:
+                    if not q.unfinished_tasks:
+                        break
+                    q.all_tasks_done.wait(timeout=0.05)
+                self._check_bind_worker_alive()
         self.flightrec.add_outside("bind_wait", time.perf_counter() - t0)
         self._drain_bind_results()
+
+    def resync_from_store(self) -> Dict[str, int]:
+        """Crash resync (ISSUE 6): rebuild ALL scheduler state from the
+        store, as a restarted scheduler process would — proving the store is
+        the single source of truth. Bound pods re-enter the cache from the
+        LIST, pending pods re-enter the queue fresh (no attempt/backoff
+        memory), stale assumes are simply gone (the fresh cache never knew
+        them), and the bind pipeline restarts empty.
+
+        In-flight binds are flushed first: a real crash would lose them
+        in-process, but their pods are either committed (the LIST sees them
+        bound) or still pending (the LIST re-queues them) — the store
+        decides, which is the whole point. Flushing just makes the
+        simulation deterministic. Returns {nodes, bound, pending,
+        dropped_assumes}."""
+        self.flush_binds()
+        dropped = self.cache.assumed_count()
+        # abandon the bind pipeline: sentinel the old worker to death on the
+        # queue it was born with (it drains nothing — flush emptied it) and
+        # start over with a fresh queue
+        if self._bind_worker is not None:
+            self._bind_q.put(None)
+        self._bind_q = _queue.Queue()
+        self._bind_worker = None
+        with self._bind_err_lock:
+            self._bind_inflight = []
+            self._bind_errors = []
+            self._bind_successes = 0
+            self._bind_confirm_leftovers = []
+        self._tensor_cache = TensorCache()
+        counts = self._rebuild_from_store(preserve_queue=False)
+        counts["dropped_assumes"] = dropped
+        return counts
 
     def _serial_one(self, qp: QueuedPodInfo) -> None:
         result = self.schedule_pod(qp.pod)

@@ -360,6 +360,12 @@ class Cache:
         with self._lock:
             return key in self._assumed
 
+    def assumed_count(self) -> int:
+        """How many pods are currently assumed-but-unconfirmed (the state a
+        crash resync drops — resync_from_store reports it)."""
+        with self._lock:
+            return len(self._assumed)
+
     def contains(self, key: str) -> bool:
         """Whether the cache accounts for this pod at all (bound or assumed).
         A gang member whose assume EXPIRED out of the cache reads False while

@@ -147,7 +147,9 @@ class FlightRecorder:
                unschedulable: int = 0, fallback: int = 0, preempted: int = 0,
                reasons: Optional[Dict[str, int]] = None,
                gang: Optional[Dict[str, int]] = None,
-               solver_iterations: Optional[int] = None) -> Optional[Dict]:
+               solver_iterations: Optional[int] = None,
+               breaker: Optional[str] = None,
+               error: Optional[str] = None) -> Optional[Dict]:
         """Append one batch record (stage values in SECONDS; stored as ms).
         Returns the record, or None when disabled."""
         if not self.enabled:
@@ -170,6 +172,10 @@ class FlightRecorder:
                 "reasons": dict(reasons or {}),
                 "gang": gang,
                 "solver_iterations": solver_iterations,
+                # failure domains (ISSUE 6): non-closed breaker state and
+                # the batch's handled pipeline error, when present
+                "breaker": breaker,
+                "error": error,
                 "bind_failures": list(self._pending_bind_failures),
             }
             self._pending_bind_failures.clear()

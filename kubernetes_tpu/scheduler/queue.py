@@ -274,6 +274,24 @@ class SchedulingQueue:
                 m.timestamp = now
                 heapq.heappush(self._backoff, (ready, next(self._seq), m))
 
+    def add_backoff(self, qps: List[QueuedPodInfo]) -> None:
+        """Transient-error requeue (ISSUE 6 failure domains): straight into
+        the backoff tier with a per-pod expiry from its attempt count —
+        unlike add_unschedulable, no cluster event is needed before the
+        retry, which is right for infrastructure faults (a solver crash, a
+        store hiccup) where the POD is fine and the retry just needs
+        breathing room."""
+        if not qps:
+            return
+        with self._lock:
+            now = self._clock.now()
+            for qp in qps:
+                qp.timestamp = now
+                heapq.heappush(
+                    self._backoff,
+                    (now + self._backoff_duration(qp.attempts),
+                     next(self._seq), qp))
+
     def add_unschedulable(self, qp: QueuedPodInfo) -> None:
         """AddUnschedulableIfNotPresent (:741): failed pods wait for an event
         (unschedulable map) — backoff applies when they are moved back."""
@@ -493,6 +511,17 @@ class SchedulingQueue:
                 heapq.heapify(self._active)
             self._backoff = [(t, s, qp) for t, s, qp in self._backoff if qp.key != key]
             heapq.heapify(self._backoff)
+
+    def clear(self) -> None:
+        """Drop every queued pod across ALL tiers (crash-resync support:
+        resync_from_store repopulates from a fresh LIST — a restarted
+        scheduler has no memory of attempts or backoff)."""
+        with self._lock:
+            self._active.clear()
+            self._backoff.clear()
+            self._unschedulable.clear()
+            self._in_active.clear()
+            self._gang_staging.clear()
 
     def tracked_keys(self) -> List[str]:
         """Keys of every pod the queue knows, across all three tiers."""

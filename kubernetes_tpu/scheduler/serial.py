@@ -317,6 +317,13 @@ class Scheduler:
             # batch path: in-flight async binds must commit before the list,
             # or their pods would be listed as pending and scheduled twice
             self.flush_binds()
+        self._rebuild_from_store(preserve_queue=True)
+
+    def _rebuild_from_store(self, preserve_queue: bool = True) -> Dict[str, int]:
+        """Shared body of _relist (watch eviction: queue state preserved) and
+        resync_from_store (crash restart: queue state DISCARDED — a restarted
+        scheduler has no memory of attempts/backoff, so every pending pod
+        re-enters fresh from the LIST). Returns {nodes, bound, pending}."""
         if self._watch is not None:
             self._watch.stop()
         self.cache = Cache(clock=self.clock)
@@ -324,9 +331,12 @@ class Scheduler:
             if hasattr(lister, "clear"):
                 lister.clear()
         self._ns_labels.clear()
+        if not preserve_queue:
+            self.queue.clear()
         lists, rv = self.store.list_many(
             ("nodes", "pods", "namespaces", "podgroups") + STORAGE_KINDS)
         known_pending = set()
+        bound = pending = 0
         for n in lists["nodes"]:
             self.cache.add_node(n)
         if self.gangs is not None:
@@ -339,16 +349,22 @@ class Scheduler:
             if p.spec.node_name:
                 if not p.is_terminal():
                     self.cache.add_pod(p)
+                    bound += 1
             elif not p.is_terminal():
                 known_pending.add(p.key)
-                if not self.queue.update(p):  # unknown to the queue: enqueue
+                pending += 1
+                if preserve_queue:
+                    if not self.queue.update(p):  # unknown: enqueue
+                        self._handle_pod(ADDED, p)
+                else:
                     self._handle_pod(ADDED, p)
-        # drop queued pods (ALL tiers) that no longer exist as pending pods —
-        # deleted or bound-by-another-leader during the outage; no DELETED
-        # event will ever arrive for them on the new watch
-        for key in self.queue.tracked_keys():
-            if key not in known_pending:
-                self.queue.delete_key(key)
+        if preserve_queue:
+            # drop queued pods (ALL tiers) that no longer exist as pending
+            # pods — deleted or bound-by-another-leader during the outage; no
+            # DELETED event will ever arrive for them on the new watch
+            for key in self.queue.tracked_keys():
+                if key not in known_pending:
+                    self.queue.delete_key(key)
         for ns in lists["namespaces"]:
             self._ns_labels[ns.metadata.name] = dict(ns.metadata.labels)
         for kind in STORAGE_KINDS:
@@ -360,6 +376,8 @@ class Scheduler:
             kind=self._watched_kinds(), since_rv=rv, maxsize=200_000,
             coalesce=self.watch_coalesce)
         self.queue.move_all_to_active_or_backoff()
+        return {"nodes": len(lists["nodes"]), "bound": bound,
+                "pending": pending}
 
     _EVENT_ACTION = {ADDED: "add", MODIFIED: "update", DELETED: "delete"}
 

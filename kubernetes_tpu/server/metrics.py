@@ -205,6 +205,16 @@ batch_stage_duration = global_registry.labeled_histogram(
     "scheduler_batch_stage_duration_seconds",
     "Batched pipeline stage latency", label="stage", buckets=STAGE_BUCKETS)
 
+# failure-domain observability (ISSUE 6): the solver circuit breaker's live
+# state and the pipeline's transient-retry volume by stage
+solver_breaker_state = global_registry.gauge(
+    "scheduler_solver_breaker_state",
+    "Solver circuit breaker state (0 closed, 1 half-open, 2 open)")
+batch_retries_total = global_registry.counter(
+    "scheduler_batch_retries_total",
+    "Pods requeued (stage=solve/assume/dispatch/worker) or chunks retried "
+    "(stage=bind) on transient pipeline failures, by stage and reason")
+
 # gang scheduling observability (ROADMAP gang-pipeline open items)
 gang_staged = global_registry.gauge(
     "scheduler_gang_staged", "Gang members parked in queue staging")

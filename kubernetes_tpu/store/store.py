@@ -62,6 +62,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..api.types import Pod
+from ..chaos import faultinject as _chaos
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -279,6 +280,9 @@ class Watch:
     def _deliver(self, ev: Event) -> None:
         if self.terminated or self._stopped:
             return
+        if _chaos.ACTIVE is not None and _chaos.ACTIVE.should_drop(
+                "watch.deliver", ev.kind):
+            return  # injected delivery drop (drop-only site: lock held)
         if self._kinds is None or ev.kind in self._kinds:
             try:
                 self._q.put_nowait(ev)
@@ -296,6 +300,9 @@ class Watch:
         channel; only called for coalesce=True watchers)."""
         if self.terminated or self._stopped:
             return
+        if _chaos.ACTIVE is not None and _chaos.ACTIVE.should_drop(
+                "watch.deliver", cev.kind):
+            return  # injected delivery drop (drop-only site: lock held)
         if self._kinds is None or cev.kind in self._kinds:
             try:
                 self._q.put_nowait(cev)
@@ -898,6 +905,10 @@ class APIStore:
         the rows, and emits lazy events sharing the stored objects. Rows
         that changed between the phases (a concurrent store.bind from the
         serial fallback path) are re-validated by stored-object identity."""
+        if _chaos.ACTIVE is not None:
+            # injected transient store failure (raises/delays BEFORE any
+            # lock): the caller's retry/backoff is what the chaos tests prove
+            _chaos.ACTIVE.fire("store.bind_many")
         errors: List[Tuple[str, str]] = []
         prepared: List = []  # (key, old stored pod, new clone, node_name)
         pods = self._objects["pods"]

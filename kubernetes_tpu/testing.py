@@ -3,7 +3,7 @@ st.MakePod()/MakeNode() — the load-bearing unit-test helper pattern, SURVEY.md
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 from .api import (
     Affinity,
@@ -249,6 +249,93 @@ def make_pod_group(name: str, min_member: int, namespace: str = "default"):
         metadata=ObjectMeta(name=name, namespace=namespace, uid=new_uid()),
         spec=PodGroupSpec(min_member=min_member),
     )
+
+
+def pod_conservation_report(store, scheduler, keys):
+    """Classify every submitted pod key after a (quiesced) chaos run — the
+    pod-conservation invariant of ISSUE 6: each pod is exactly one of
+    bound / pending / terminally-failed, never lost, never double-bound.
+
+    Call at quiescence (run_until_idle + flush_binds done): a pod mid-flight
+    in the bind queue would read as lost. Returns
+    {"bound", "pending", "failed", "lost", "double_bound", "counts"} where
+    the first five are key lists.
+
+      bound         spec.node_name set in the STORE (the source of truth)
+      pending       unbound, non-terminal, and accounted for — tracked by
+                    the queue (any tier, incl. gang staging) or still
+                    assumed in the cache
+      failed        terminal phase (Failed/Succeeded) with its status reason
+      lost          none of the above — the invariant violation chaos must
+                    never produce
+      double_bound  bound MORE than once in the store's event history (two
+                    unbind->bind transitions for one key), or accounted on
+                    two nodes in the scheduler cache
+    """
+    pods = {}
+    for p in store.list("pods")[0]:
+        pods[p.key] = p
+    queue_keys = set(scheduler.queue.tracked_keys())
+    bound, pending, failed, lost = [], [], [], []
+    for key in keys:
+        pod = pods.get(key)
+        if pod is None:
+            lost.append(key)  # deleted: a chaos run we drive never deletes
+        elif pod.spec.node_name:
+            bound.append(key)
+        elif pod.is_terminal():
+            failed.append(key)
+        elif key in queue_keys or scheduler.cache.is_assumed(key):
+            pending.append(key)
+        else:
+            lost.append(key)
+
+    # double-bind check #1: the store's own history — count unbound->bound
+    # transitions per key (bind_many/bind MODIFIED events carry prev)
+    double: List[str] = []
+    keyset = set(keys)
+    bind_counts: Dict[str, int] = {}
+    for ev in getattr(store, "_history", ()):
+        if ev.kind != "pods" or ev.type != "MODIFIED":
+            continue
+        obj, prev = ev.obj, ev.prev
+        if (obj is not None and getattr(obj.spec, "node_name", None)
+                and (prev is None or not prev.spec.node_name)):
+            k = obj.key
+            if k in keyset:
+                bind_counts[k] = bind_counts.get(k, 0) + 1
+    double.extend(k for k, n in bind_counts.items() if n > 1)
+    # double-bind check #2: the scheduler cache never accounts one pod on
+    # two nodes (an assume/forget bookkeeping bug would)
+    seen: Dict[str, int] = {}
+    snap = scheduler.cache.update_snapshot()
+    for ni in snap.node_info_list:
+        for pi in ni.pods:
+            k = pi.pod.key
+            if k in keyset:
+                seen[k] = seen.get(k, 0) + 1
+    double.extend(k for k, n in seen.items() if n > 1 and k not in double)
+
+    return {
+        "bound": bound, "pending": pending, "failed": failed, "lost": lost,
+        "double_bound": double,
+        "counts": {"submitted": len(keys), "bound": len(bound),
+                   "pending": len(pending), "failed": len(failed),
+                   "lost": len(lost), "double_bound": len(double)},
+    }
+
+
+def assert_pod_conservation(store, scheduler, keys):
+    """Raise AssertionError (with the offending keys) unless every submitted
+    pod is conserved: 0 lost, 0 double-bound. Returns the report."""
+    rep = pod_conservation_report(store, scheduler, keys)
+    assert not rep["lost"], (
+        f"{len(rep['lost'])} pod(s) LOST (not bound, not queued, not "
+        f"terminal): {rep['lost'][:10]}")
+    assert not rep["double_bound"], (
+        f"{len(rep['double_bound'])} pod(s) DOUBLE-BOUND: "
+        f"{rep['double_bound'][:10]}")
+    return rep
 
 
 def mutation_detector_guard(monkeypatch):

@@ -1,0 +1,658 @@
+"""Failure-domain tests (ISSUE 6): the deterministic fault-injection
+harness, the solver circuit breaker, bind retry/backoff + bind-worker
+supervision/liveness, crash resync from the store, the pod-conservation
+checker, and the node-death reference failure chain (node_lifecycle ->
+tainteviction -> workload controller -> batched scheduler)."""
+
+import time
+from collections import deque
+
+import pytest
+
+from kubernetes_tpu.chaos import faultinject as fi
+from kubernetes_tpu.chaos.faultinject import FaultInjected, FaultPlan
+from kubernetes_tpu.scheduler import Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.breaker import SolverCircuitBreaker
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.scheduler.queue import QueuedPodInfo
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import (MakeNode, MakePod, assert_pod_conservation,
+                                    mutation_detector_guard,
+                                    pod_conservation_report)
+from kubernetes_tpu.utils import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    """No test may leak an armed injector into its neighbors."""
+    fi.disarm()
+    yield
+    fi.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    """Chaos paths clone/rollback aggressively — run the whole module under
+    the mutation detector (the MU001 runtime companion)."""
+    yield from mutation_detector_guard(monkeypatch)
+
+
+def _nodes(n, cpu="8"):
+    return [MakeNode(f"node-{i}").capacity(
+        {"cpu": cpu, "memory": "32Gi", "pods": "110"}).obj()
+        for i in range(n)]
+
+
+def _pods(n, prefix="p", cpu="100m"):
+    return [MakePod(f"{prefix}-{i}").req({"cpu": cpu}).obj()
+            for i in range(n)]
+
+
+def _sched(n_nodes=4, **kw):
+    store = APIStore()
+    for n in _nodes(n_nodes):
+        store.create("nodes", n)
+    kw.setdefault("batch_size", 64)
+    kw.setdefault("solver", "exact")
+    kw.setdefault("pod_initial_backoff", 0.01)
+    kw.setdefault("pod_max_backoff", 0.05)
+    sched = BatchScheduler(store, Framework(default_plugins()), **kw)
+    sched.sync()
+    return store, sched
+
+
+def _drive(store, sched, want, deadline_s=10.0, keys_prefix=None):
+    """Drive the scheduler (backoff flushes included) until `want` pods are
+    bound in the store or the deadline passes. Returns the bound count."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline_s:
+        sched.run_until_idle()
+        sched.queue.flush_backoff_completed()
+        sched.queue.move_all_to_active_or_backoff()
+        bound = sum(
+            1 for p in store.list("pods")[0]
+            if p.spec.node_name and (keys_prefix is None
+                                     or p.metadata.name.startswith(keys_prefix)))
+        if bound >= want:
+            return bound
+        time.sleep(0.01)
+    return sum(1 for p in store.list("pods")[0] if p.spec.node_name)
+
+
+# -- fault-injection harness ----------------------------------------------
+
+
+class TestFaultInject:
+    def test_fail_next_n_then_passes(self):
+        inj = fi.arm([FaultPlan("solver.solve", "fail", count=2)])
+        for _ in range(2):
+            with pytest.raises(FaultInjected):
+                inj.fire("solver.solve")
+        inj.fire("solver.solve")  # exhausted: passes
+        assert inj.stats()["solver.solve"] == {"fired": 3, "injected": 2}
+
+    def test_rate_plan_is_seeded_deterministic(self):
+        def decisions(seed):
+            inj = fi.Injector([FaultPlan("store.bind_many", "rate",
+                                         rate=0.5, seed=seed)])
+            out = []
+            for _ in range(50):
+                try:
+                    inj.fire("store.bind_many")
+                    out.append(False)
+                except FaultInjected:
+                    out.append(True)
+            return out
+
+        a, b = decisions(7), decisions(7)
+        assert a == b
+        assert any(a) and not all(a)
+        assert decisions(8) != a
+
+    def test_after_offset_skips_early_fires(self):
+        inj = fi.arm([FaultPlan("solver.solve", "fail", count=1, after=2)])
+        inj.fire("solver.solve")
+        inj.fire("solver.solve")
+        with pytest.raises(FaultInjected):
+            inj.fire("solver.solve")
+
+    def test_delay_plan_sleeps(self):
+        inj = fi.arm([FaultPlan("solver.solve", "delay", count=1,
+                                delay_s=0.05)])
+        t0 = time.perf_counter()
+        inj.fire("solver.solve")
+        assert time.perf_counter() - t0 >= 0.04
+        t0 = time.perf_counter()
+        inj.fire("solver.solve")  # count exhausted: no sleep
+        assert time.perf_counter() - t0 < 0.04
+
+    def test_match_scopes_to_key(self):
+        inj = fi.arm([FaultPlan("kubelet.heartbeat", "fail", count=10,
+                                match="hollow-1")])
+        assert not inj.should_drop("kubelet.heartbeat", "hollow-0")
+        assert inj.should_drop("kubelet.heartbeat", "hollow-1")
+        assert not inj.should_drop("kubelet.heartbeat", "hollow-2")
+
+    def test_unknown_site_and_bad_modes_rejected(self):
+        with pytest.raises(ValueError):
+            fi.Injector([FaultPlan("no.such.site", "fail")])
+        with pytest.raises(ValueError):
+            fi.Injector([FaultPlan("watch.deliver", "delay", delay_s=1.0)])
+        with pytest.raises(ValueError):
+            fi.Injector([FaultPlan("kubelet.heartbeat", "kill")])
+
+    def test_env_spec_parsing(self):
+        plans = fi.parse_env(
+            "solver.solve=fail:count=3;"
+            "store.bind_many=rate:rate=0.1,seed=7;"
+            "bind.worker=kill:after=2")
+        by_site = {p.site: p for p in plans}
+        assert by_site["solver.solve"].count == 3
+        assert by_site["store.bind_many"].rate == 0.1
+        assert by_site["store.bind_many"].seed == 7
+        assert by_site["bind.worker"].mode == "kill"
+        assert by_site["bind.worker"].after == 2
+
+    def test_disarmed_is_inert(self):
+        assert fi.ACTIVE is None
+        assert not fi.enabled()
+        assert fi.disabled_check_cost_ns(10_000) > 0
+
+
+# -- solver circuit breaker ------------------------------------------------
+
+
+class TestSolverBreaker:
+    def test_state_machine_unit(self):
+        clock = FakeClock()
+        b = SolverCircuitBreaker(clock=clock, threshold=2, cooldown_s=10.0)
+        assert b.effective_solver("fast") == "fast"
+        b.record_failure("fast", "fast")
+        assert b.state == "closed"
+        b.record_failure("fast", "fast")
+        assert b.state == "open" and b.trips == 1
+        assert b.effective_solver("fast") == "exact"
+        # degraded-solver failure: counted, no state change
+        b.record_failure("exact", "fast")
+        assert b.state == "open" and b.degraded_failures == 1
+        clock.step(11)
+        assert b.effective_solver("fast") == "fast"  # half-open probe
+        assert b.state == "half_open"
+        b.record_failure("fast", "fast")  # probe failed: trips open again
+        assert b.state == "open" and b.trips == 2
+        clock.step(11)
+        assert b.effective_solver("fast") == "fast"
+        b.record_success("fast", "fast")
+        assert b.state == "closed" and b.recoveries == 1
+        assert b.consecutive_failures == 0
+
+    def test_path_attribution_not_mode_label(self):
+        """A constrained batch runs the scan regardless of mode: its outcome
+        must neither close a HALF_OPEN breaker nor trip a CLOSED one — the
+        breaker reasons about the EXECUTED path, not the mode label."""
+        clock = FakeClock()
+        b = SolverCircuitBreaker(clock=clock, threshold=2, cooldown_s=10.0)
+        # scan failures on constrained batches never count against 'fast'
+        b.record_failure("exact", "fast")
+        b.record_failure("exact", "fast")
+        assert b.state == "closed" and b.trips == 0
+        assert b.degraded_failures == 2
+        # trip for real, reach the probe window
+        b.record_failure("fast", "fast")
+        b.record_failure("fast", "fast")
+        assert b.state == "open"
+        clock.step(11)
+        assert b.effective_solver("fast") == "fast"
+        assert b.state == "half_open"
+        # a constrained probe batch (scan ran) proves nothing: stay probing
+        b.record_success("exact", "fast")
+        assert b.state == "half_open" and b.recoveries == 0
+        # a genuine fast-path success closes
+        b.record_success("fast", "fast")
+        assert b.state == "closed" and b.recoveries == 1
+        # 'auto' mode is represented by the waterfill path
+        b2 = SolverCircuitBreaker(clock=clock, threshold=1)
+        b2.record_failure("fast", "auto")
+        assert b2.state == "open"
+
+    def test_solver_exception_requeues_batch_not_lost(self):
+        store, sched = _sched(solver="exact", breaker_threshold=100)
+        store.create_many("pods", _pods(10))
+        sched.pump_events()
+        fi.arm([FaultPlan("solver.solve", "fail", count=1)])
+        assert sched.schedule_batch(timeout=0.0) == 10
+        # nothing scheduled, nothing assumed, nothing narrated per pod —
+        # the batch sits in the backoff tier as a unit
+        assert sched.scheduled_count == 0
+        assert sched.cache.assumed_count() == 0
+        assert sched.queue.lengths()[1] == 10  # backoff tier
+        rec = sched.flightrec.last()
+        assert rec["outcome"] == "error"
+        assert "FaultInjected" in rec["error"]
+        # the retry succeeds once the backoff expires
+        bound = _drive(store, sched, 10)
+        assert bound == 10
+        assert_pod_conservation(store, sched,
+                                [f"default/p-{i}" for i in range(10)])
+
+    def test_breaker_trips_to_scan_and_recovers(self):
+        store, sched = _sched(solver="fast", breaker_threshold=2,
+                              breaker_cooldown_s=0.2)
+        fi.arm([FaultPlan("solver.solve", "fail", count=2)])
+        store.create_many("pods", _pods(8, prefix="a"))
+        sched.pump_events()
+        sched.schedule_batch(timeout=0.0)  # failure 1
+        sched.queue.flush_backoff_completed()
+        time.sleep(0.02)
+        sched.queue.flush_backoff_completed()
+        sched.schedule_batch(timeout=0.0)  # failure 2 -> OPEN
+        assert sched.breaker.state == "open"
+        assert sched.breaker.trips == 1
+        # while OPEN the batch runs the DEGRADED solver (the exact scan)
+        bound = _drive(store, sched, 8, keys_prefix="a-")
+        assert bound == 8
+        solvers = [r["solver"] for r in sched.flightrec.records()
+                   if r["pods"] > 0]
+        assert "exact" in solvers  # the degraded batches are visible
+        # cooldown passes; the next real batch is the half-open probe
+        time.sleep(0.25)
+        store.create_many("pods", _pods(4, prefix="b"))
+        bound = _drive(store, sched, 4, keys_prefix="b-")
+        assert bound == 4
+        assert sched.breaker.state == "closed"
+        assert sched.breaker.recoveries == 1
+        assert sched.flightrec.records()[-1]["solver"] == "fast"
+        assert_pod_conservation(
+            store, sched,
+            [f"default/a-{i}" for i in range(8)]
+            + [f"default/b-{i}" for i in range(4)])
+
+    def test_retry_metric_counts_solver_requeues(self):
+        from kubernetes_tpu.server import metrics as m
+
+        before = m.batch_retries_total.value(stage="solve",
+                                             reason="FaultInjected")
+        store, sched = _sched(solver="exact", breaker_threshold=100)
+        store.create_many("pods", _pods(5, prefix="m"))
+        sched.pump_events()
+        fi.arm([FaultPlan("solver.solve", "fail", count=1)])
+        sched.schedule_batch(timeout=0.0)
+        after = m.batch_retries_total.value(stage="solve",
+                                            reason="FaultInjected")
+        assert after - before == 5
+
+
+# -- bind retry / backoff --------------------------------------------------
+
+
+class TestBindRetry:
+    def test_transient_bind_error_retries_to_success(self):
+        store, sched = _sched(bind_retries=3, bind_retry_base_s=0.001)
+        store.create_many("pods", _pods(6, prefix="tr"))
+        sched.pump_events()
+        # the first two bind_many calls fail, the third lands
+        fi.arm([FaultPlan("store.bind_many", "fail", count=2)])
+        assert sched.schedule_batch(timeout=0.0) == 6
+        sched.flush_binds()
+        assert sched.take_bind_failures() == []
+        assert sched.scheduled_count == 6
+        assert sched.cache.assumed_count() == 0
+        assert_pod_conservation(store, sched,
+                                [f"default/tr-{i}" for i in range(6)])
+
+    def test_bind_retries_exhausted_requeue_and_recover(self):
+        store, sched = _sched(bind_retries=1, bind_retry_base_s=0.001)
+        store.create_many("pods", _pods(4, prefix="ex"))
+        sched.pump_events()
+        fi.arm([FaultPlan("store.bind_many", "fail", count=50)])
+        assert sched.schedule_batch(timeout=0.0) == 4
+        sched.flush_binds()
+        failures = sched.take_bind_failures()
+        assert len(failures) == 4
+        assert all("injected fault" in msg for _k, msg in failures)
+        assert sched.scheduled_count == 0
+        assert sched.cache.assumed_count() == 0
+        # conservation holds mid-fault: requeued, not lost
+        assert_pod_conservation(store, sched,
+                                [f"default/ex-{i}" for i in range(4)])
+        fi.disarm()
+        assert _drive(store, sched, 4) == 4
+
+    def test_bind_failure_log_bounded_with_drop_counter(self):
+        store, sched = _sched()
+        pods = _pods(8, prefix="bl")
+        store.create_many("pods", pods)
+        sched.pump_events()
+        sched.bind_failures = deque(maxlen=5)
+        from kubernetes_tpu.scheduler.framework import Status
+
+        with sched._bind_err_lock:
+            for p in pods:
+                qp = QueuedPodInfo(pod=p)
+                sched._bind_errors.append((qp, Status.error("boom")))
+        sched._drain_bind_results()
+        assert len(sched.bind_failures) == 5  # newest 5 kept
+        assert sched.bind_failures_dropped == 3
+        kept = [k for k, _m in sched.take_bind_failures()]
+        assert kept == [f"default/bl-{i}" for i in range(3, 8)]
+
+
+# -- bind-worker supervision ----------------------------------------------
+
+
+class TestBindWorkerSupervision:
+    def test_escaped_exception_requeues_chunk_once(self):
+        store, sched = _sched()
+        store.create_many("pods", _pods(6, prefix="sw"))
+        sched.pump_events()
+        fi.arm([FaultPlan("bind.worker", "fail", count=1)])
+        assert sched.schedule_batch(timeout=0.0) == 6
+        sched.flush_binds()
+        # the supervisor caught the escape, re-queued the chunk, and the
+        # retry committed: nothing failed, nothing lost
+        assert sched.take_bind_failures() == []
+        assert sched.scheduled_count == 6
+        assert sched.bind_worker_restarts >= 1
+        assert_pod_conservation(store, sched,
+                                [f"default/sw-{i}" for i in range(6)])
+
+    def test_second_escape_fails_pods_no_livelock(self):
+        store, sched = _sched()
+        store.create_many("pods", _pods(5, prefix="s2"))
+        sched.pump_events()
+        fi.arm([FaultPlan("bind.worker", "fail", count=2)])
+        assert sched.schedule_batch(timeout=0.0) == 5
+        sched.flush_binds()
+        failures = sched.take_bind_failures()
+        assert len(failures) == 5
+        assert all("failed twice" in msg for _k, msg in failures)
+        assert sched.cache.assumed_count() == 0
+        assert_pod_conservation(store, sched,
+                                [f"default/s2-{i}" for i in range(5)])
+        fi.disarm()
+        assert _drive(store, sched, 5) == 5
+
+    def test_hard_kill_detected_and_recovered(self):
+        """An injected FaultKill escapes the supervisor (BaseException by
+        design), the worker thread DIES with its chunk in flight — and the
+        liveness check in the next drain re-queues the chunk, settles the
+        join() debt, and restarts the worker; flush_binds never hangs."""
+        store, sched = _sched()
+        store.create_many("pods", _pods(6, prefix="kl"))
+        sched.pump_events()
+        fi.arm([FaultPlan("bind.worker", "kill")])
+        assert sched.schedule_batch(timeout=0.0) == 6
+        t0 = time.monotonic()
+        sched.flush_binds()  # would hang forever on a plain Queue.join()
+        assert time.monotonic() - t0 < 5.0
+        sched._drain_bind_results()
+        assert sched.bind_worker_restarts >= 1
+        assert _drive(store, sched, 6) == 6
+        assert_pod_conservation(store, sched,
+                                [f"default/kl-{i}" for i in range(6)])
+
+    def test_dead_worker_detected_on_empty_queue_drain(self):
+        """ISSUE 6 satellite: _ensure_bind_worker only ran at enqueue; the
+        drain-side liveness check must notice a dead worker within one
+        schedule_batch cycle even with an EMPTY bind queue."""
+        store, sched = _sched()
+        store.create_many("pods", _pods(3, prefix="dw"))
+        sched.pump_events()
+        fi.arm([FaultPlan("bind.worker", "kill")])
+        assert sched.schedule_batch(timeout=0.0) == 3
+        # wait for the worker thread to die without enqueueing anything new
+        for _ in range(200):
+            w = sched._bind_worker
+            if w is not None and not w.is_alive():
+                break
+            time.sleep(0.005)
+        assert sched._bind_worker is not None
+        assert not sched._bind_worker.is_alive()
+        fi.disarm()
+        # one drain (as every schedule_batch cycle runs) detects + recovers
+        sched._drain_bind_results()
+        assert sched.bind_worker_restarts >= 1
+        assert _drive(store, sched, 3) == 3
+
+
+# -- crash resync ----------------------------------------------------------
+
+
+class TestCrashResync:
+    def test_resync_rebuilds_from_store(self):
+        store, sched = _sched(n_nodes=4)
+        store.create_many("pods", _pods(10, prefix="rb"))
+        sched.pump_events()
+        assert sched.schedule_batch(timeout=0.0) == 10
+        sched.flush_binds()
+        assert sched.scheduled_count == 10
+        # new pending pods arrive; a stale assume is fabricated (a bind that
+        # will never land — exactly what a crashed worker leaves behind)
+        store.create_many("pods", _pods(5, prefix="pend"))
+        stale = MakePod("stale").req({"cpu": "100m"}).obj()
+        store.create("pods", stale)
+        sched.pump_events()
+        from kubernetes_tpu.store import pod_structural_clone
+
+        qp = None
+        popped = sched.queue.pop_batch(64, timeout=0.0)
+        for q in popped:
+            if q.pod.metadata.name == "stale":
+                qp = q
+            else:
+                sched.queue.add(q.pod)  # put the others back
+        assert qp is not None
+        sched.cache.assume_pod(pod_structural_clone(qp.pod), "node-0")
+        assert sched.cache.assumed_count() == 1
+
+        counts = sched.resync_from_store()
+        assert counts["bound"] == 10
+        assert counts["pending"] == 6  # 5 pend-* + the stale pod
+        assert counts["dropped_assumes"] == 1
+        # the rebuilt cache holds exactly the bound pods; the stale assume
+        # is gone; every pending pod re-entered the queue fresh
+        assert sched.cache.pod_count() == 10
+        assert sched.cache.assumed_count() == 0
+        assert len(sched.queue.tracked_keys()) == 6
+        # and the world converges: everything pending binds
+        keys = ([f"default/rb-{i}" for i in range(10)]
+                + [f"default/pend-{i}" for i in range(5)]
+                + ["default/stale"])
+        assert _drive(store, sched, 16) == 16
+        rep = assert_pod_conservation(store, sched, keys)
+        assert rep["counts"]["bound"] == 16
+
+    def test_resync_after_watch_loss_recovers_dropped_events(self):
+        """Dropped watch deliveries (the watch.deliver chaos site) starve
+        the scheduler of ADDED events; resync_from_store recovers the pods
+        from the LIST — the store is the single source of truth."""
+        store, sched = _sched()
+        fi.arm([FaultPlan("watch.deliver", "fail", count=1000)])
+        store.create_many("pods", _pods(5, prefix="drop"))
+        sched.pump_events()
+        assert sched.schedule_batch(timeout=0.0) == 0  # events never arrived
+        fi.disarm()
+        keys = [f"default/drop-{i}" for i in range(5)]
+        rep = pod_conservation_report(store, sched, keys)
+        assert len(rep["lost"]) == 5  # genuinely stranded without resync
+        counts = sched.resync_from_store()
+        assert counts["pending"] == 5
+        assert _drive(store, sched, 5) == 5
+        assert_pod_conservation(store, sched, keys)
+
+
+# -- the conservation checker itself ---------------------------------------
+
+
+class TestConservationChecker:
+    def test_partitions_bound_pending_failed(self):
+        store, sched = _sched()
+        store.create_many("pods", _pods(3, prefix="ok"))
+        failed = MakePod("dead").req({"cpu": "100m"}).obj()
+        failed.status.phase = "Failed"
+        store.create("pods", failed)
+        sched.pump_events()
+        sched.run_until_idle()
+        rep = pod_conservation_report(
+            store, sched,
+            [f"default/ok-{i}" for i in range(3)] + ["default/dead"])
+        assert rep["counts"] == {"submitted": 4, "bound": 3, "pending": 0,
+                                 "failed": 1, "lost": 0, "double_bound": 0}
+
+    def test_flags_lost_pod(self):
+        store, sched = _sched()
+        store.create("pods", MakePod("ghost").req({"cpu": "100m"}).obj())
+        # never pumped: the scheduler has no idea this pod exists
+        with pytest.raises(AssertionError, match="LOST"):
+            assert_pod_conservation(store, sched, ["default/ghost"])
+
+    def test_flags_double_bind_in_history(self):
+        store, sched = _sched()
+        p = MakePod("twice").req({"cpu": "100m"}).obj()
+        store.create("pods", p)
+        store.bind("default", "twice", "node-0")
+        store.delete("pods", "default/twice")
+        p2 = MakePod("twice").req({"cpu": "100m"}).obj()
+        store.create("pods", p2)
+        store.bind("default", "twice", "node-1")
+        with pytest.raises(AssertionError, match="DOUBLE-BOUND"):
+            assert_pod_conservation(store, sched, ["default/twice"])
+
+
+# -- node death: the reference failure chain through the batch path --------
+
+
+class TestNodeDeathEndToEnd:
+    def test_heartbeat_loss_taints_evicts_and_batch_replaces(self):
+        """The reference failure chain (ISSUE 6 satellite), batched: one
+        hollow kubelet's heartbeat is dropped by the chaos harness ->
+        node_lifecycle taints the node NotReady:NoExecute -> tainteviction
+        fires the tolerationSeconds deadline and evicts -> the ReplicaSet
+        controller replaces -> the BATCH scheduler re-places every pod on
+        the surviving nodes."""
+        from kubernetes_tpu.agent.hollow import HollowCluster
+        from kubernetes_tpu.api.workloads import ReplicaSet
+        from kubernetes_tpu.controllers import (NodeLifecycleController,
+                                                ReplicaSetController)
+        from kubernetes_tpu.controllers.tainteviction import (
+            TaintEvictionController)
+
+        clock = FakeClock(start=100.0)
+        store = APIStore()
+        cluster = HollowCluster(store, n_nodes=3, clock=clock)
+        cluster.register_all()
+        sched = BatchScheduler(store, Framework(default_plugins()),
+                               batch_size=64, solver="exact", clock=clock)
+        sched.sync()
+        rsc = ReplicaSetController(store, clock=clock)
+        rsc.sync_all()
+        nlc = NodeLifecycleController(store, clock=clock, grace_period=40.0)
+        nlc.sync_all()
+        tec = TaintEvictionController(store, clock=clock)
+        tec.sync_all()
+
+        store.create("replicasets", ReplicaSet.from_dict({
+            "metadata": {"name": "web"},
+            "spec": {
+                "replicas": 6,
+                "selector": {"matchLabels": {"app": "web"}},
+                "template": {
+                    "metadata": {"labels": {"app": "web"}},
+                    "spec": {
+                        "containers": [{"name": "c", "resources": {
+                            "requests": {"cpu": "500m"}}}],
+                        # tolerate not-ready for 5s: node_lifecycle's own
+                        # immediate eviction defers to tainteviction's
+                        # tolerationSeconds deadline — both controllers in
+                        # the chain do real work
+                        "tolerations": [{
+                            "key": "node.kubernetes.io/not-ready",
+                            "operator": "Exists", "effect": "NoExecute",
+                            "tolerationSeconds": 5}],
+                    }},
+            },
+        }))
+        for _ in range(6):
+            rsc.reconcile_once()
+            sched.run_until_idle()
+            cluster.pump_all()
+        pods, _ = store.list("pods")
+        assert len(pods) == 6 and all(p.spec.node_name for p in pods)
+        victim = pods[0].spec.node_name
+        n_victim = sum(1 for p in pods if p.spec.node_name == victim)
+        assert n_victim > 0
+
+        # the victim node's heartbeat is DROPPED by the harness; siblings
+        # keep renewing through the same heartbeat_all() calls
+        fi.arm([FaultPlan("kubelet.heartbeat", "fail", count=10_000,
+                          match=victim)])
+        clock.step(41)
+        cluster.heartbeat_all()
+        nlc.monitor()
+        node = store.get("nodes", victim)
+        assert any(t.key == "node.kubernetes.io/not-ready"
+                   and t.effect == "NoExecute" for t in node.spec.taints)
+        # tolerationSeconds still running: nothing evicted yet
+        tec.pump(), tec.tick()
+        assert len(store.list("pods")[0]) == 6
+        clock.step(6)  # past the 5s tolerationSeconds deadline
+        tec.tick()
+        survivors = store.list("pods")[0]
+        assert all(p.spec.node_name != victim for p in survivors
+                   if p.spec.node_name)
+        assert len(survivors) == 6 - n_victim
+
+        # ReplicaSet replaces; the BATCH scheduler re-places on live nodes
+        # (the tainted node is filtered by TaintToleration — NoExecute)
+        for _ in range(6):
+            rsc.pump(), rsc.reconcile_once()
+            sched.pump_events()
+            sched.run_until_idle()
+            sched.queue.flush_backoff_completed()
+            cluster.pump_all()
+        pods, _ = store.list("pods")
+        assert len(pods) == 6
+        assert all(p.spec.node_name and p.spec.node_name != victim
+                   for p in pods)
+        assert sched.scheduled_count >= 6 + n_victim
+
+        # heartbeat resumes -> taint clears -> the node is placeable again
+        fi.disarm()
+        cluster.heartbeat_all()
+        nlc.monitor()
+        node = store.get("nodes", victim)
+        assert not any(t.key == "node.kubernetes.io/not-ready"
+                       for t in node.spec.taints)
+
+
+# -- end-to-end chaos churn (the rung's shape, test-sized) ------------------
+
+
+def test_chaos_churn_conservation_small():
+    """The ChaosChurn rung's invariant at test scale: solver faults, bind
+    faults, a worker kill, and a mid-run resync — every pod exactly once."""
+    store, sched = _sched(n_nodes=8, solver="fast", batch_size=64,
+                          breaker_threshold=2, breaker_cooldown_s=0.1,
+                          bind_retries=2, bind_retry_base_s=0.001)
+    sched.bind_chunk = 16
+    n = 60
+    keys = [f"default/cc-{i}" for i in range(n)]
+    fi.arm([
+        FaultPlan("solver.solve", "fail", count=2),
+        FaultPlan("store.bind_many", "rate", rate=0.3, seed=42),
+        FaultPlan("bind.worker", "kill", after=1),
+    ])
+    for lo in range(0, n, 20):
+        store.create_many("pods",
+                          [MakePod(f"cc-{i}").req({"cpu": "100m"}).obj()
+                           for i in range(lo, lo + 20)])
+        _drive(store, sched, min(lo + 10, n), deadline_s=5.0,
+               keys_prefix="cc-")
+        if lo == 20:
+            sched.resync_from_store()
+    fi.disarm()
+    assert _drive(store, sched, n, deadline_s=10.0, keys_prefix="cc-") >= n
+    rep = assert_pod_conservation(store, sched, keys)
+    assert rep["counts"]["bound"] == n
+    assert sched.breaker.trips >= 1

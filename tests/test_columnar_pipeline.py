@@ -445,12 +445,19 @@ def test_add_batch_respects_pre_enqueue_gate():
 # -- bind-worker error propagation --------------------------------------------
 
 
-def test_async_bind_failures_surface_to_callers():
+# Both watch_coalesce modes (ISSUE 6 satellite): the error-handling branch
+# in _bind_batch_inner splits on watch_coalesce (confirm_assumed_bulk vs
+# finish_binding per pod), so bind-failure REQUEUE parity must be pinned on
+# the per-pod oracle path too, not only the coalesced one.
+@pytest.mark.parametrize("columnar", [True, False],
+                         ids=["coalesced", "per-pod"])
+def test_async_bind_failures_surface_to_callers(columnar):
     store = APIStore()
     for n in _nodes(4):
         store.create("nodes", n)
     sched = BatchScheduler(store, Framework(default_plugins()),
-                           batch_size=64, solver="exact")
+                           batch_size=64, solver="exact", columnar=columnar,
+                           bind_retries=1, bind_retry_base_s=0.001)
     sched.sync()
     store.create_many("pods", _pods(5, prefix="bf"))
     sched.pump_events()
@@ -477,14 +484,30 @@ def test_async_bind_failures_surface_to_callers():
     assert sched.queue.lengths()[2] == 5
     # and nothing is left assumed in the cache
     assert not sched.cache._assumed
+    # PARITY: after the fault clears, both modes converge identically
+    sched.queue.move_all_to_active_or_backoff()
+    sched.queue.flush_backoff_completed()
+    import time as _time
+
+    for _ in range(50):
+        sched.run_until_idle()
+        sched.queue.flush_backoff_completed()
+        if sched.scheduled_count == 5:
+            break
+        _time.sleep(0.02)
+    assert sched.scheduled_count == 5
+    assert not sched.cache._assumed
+    assert sched.cache.pod_count() == 5
 
 
-def test_partial_bind_errors_fail_only_their_pods():
+@pytest.mark.parametrize("columnar", [True, False],
+                         ids=["coalesced", "per-pod"])
+def test_partial_bind_errors_fail_only_their_pods(columnar):
     store = APIStore()
     for n in _nodes(4):
         store.create("nodes", n)
     sched = BatchScheduler(store, Framework(default_plugins()),
-                           batch_size=64, solver="exact")
+                           batch_size=64, solver="exact", columnar=columnar)
     sched.sync()
     store.create_many("pods", _pods(6, prefix="pb"))
     # inject a per-pod failure for pb-2 only: the rest of the chunk commits
@@ -508,3 +531,5 @@ def test_partial_bind_errors_fail_only_their_pods():
     # the failed pod was forgotten from the cache (its assume rolled back)
     assert not sched.cache.is_assumed("default/pb-2")
     assert sched.cache.pod_count() == 5
+    # requeue parity: pb-2 waits in the unschedulable tier in BOTH modes
+    assert sched.queue.lengths()[2] == 1

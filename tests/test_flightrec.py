@@ -118,7 +118,7 @@ class TestStageClock:
 RECORD_KEYS = {"seq", "ts", "pods", "nodes", "outcome", "solver", "total_ms",
                "stages", "scheduled", "unschedulable", "fallback",
                "preempted", "reasons", "gang", "solver_iterations",
-               "bind_failures"}
+               "breaker", "error", "bind_failures"}
 
 
 class TestRecordSchema:
