@@ -490,10 +490,12 @@ def rung_north_star_endtoend(results):
             warm_store.create("nodes", n)
         # warm-up runs with the flight recorder DISABLED — exercising the
         # recorder-off hot path every bench run (parity with recorder-on is
-        # pinned by tests/test_flightrec.py)
+        # pinned by tests/test_flightrec.py). The pod TRACER stays on so its
+        # first-call costs (numpy ufunc warmup, lazy imports, histogram
+        # construction) land here, not inside the timed window
         warm = BatchScheduler(warm_store, Framework(default_plugins()),
                               batch_size=n_pods, solver="fast",
-                              flight_recorder=False)
+                              flight_recorder=False, pod_trace=True)
         warm.sync()
         warm_store.create_many(
             "pods", (MakePod(f"w-{i}").req(
@@ -520,6 +522,7 @@ def rung_north_star_endtoend(results):
         gc.freeze()
         gc.disable()
         sched.flightrec.clear()  # stage table covers EXACTLY the timed window
+        sched.podtrace.clear()  # latency histogram + spans likewise
         # jit-cache watermark (ISSUE 5 retrace guard): the warm-up compiled
         # every shape the timed run uses, so a nonzero delta below IS a
         # mid-run retrace — the regression class JT001 guards statically
@@ -545,21 +548,48 @@ def rung_north_star_endtoend(results):
         stages = {k: round(v["total_ms"] / 1000, 4) for k, v in table.items()}
         serial_sum = round(sum(v["total_ms"] for v in table.values()
                                if not v["overlapped"]) / 1000, 4)
+        # pod-latency observability (ISSUE 7): per-stage p50/p99 columns,
+        # the all-pods submit->bound distribution, sampled-span health, and
+        # the declarative SLO gate (scheduler/slo.py) — the BENCH_r* series
+        # tracks tails from this run on, not just pods/s
+        from kubernetes_tpu.scheduler.slo import NORTH_STAR_SLO, evaluate_slo
+
+        latency = sched.podtrace.latency_stats()
+        tsnap = sched.podtrace.snapshot()
+        compiles = sum(compiles_during.values())
+        instr_frac = sched.flightrec.self_seconds / max(dt, 1e-9)
+        slo = evaluate_slo(
+            {"stages": table, "latency": latency}, NORTH_STAR_SLO,
+            extra={"solver_compiles": compiles,
+                   "instrumentation_frac": round(instr_frac, 5)})
         results["NorthStar_100k_10k_endtoend"] = {
             "pods_per_sec": round(pps, 1), "wall_s": round(dt, 3),
             "vs_target": round(pps / NORTH_STAR, 2),
             "placed": bound, "pods": n_pods, "solver": "fast+store-binds",
             "stages": stages,
+            "stages_p50_ms": {k: v.get("p50_ms") for k, v in table.items()},
+            "stages_p99_ms": {k: v.get("p99_ms") for k, v in table.items()},
             "stages_serial_sum_s": serial_sum,
+            "latency": latency,
+            "trace": {"spans": len(tsnap["spans"]),
+                      "complete": sum(1 for s in tsnap["spans"]
+                                      if s["complete"]),
+                      "evicted_incomplete": tsnap["evicted_incomplete"],
+                      "flush_s": tsnap["flush_seconds"]},
+            "slo": slo,
             "instrumentation_s": round(sched.flightrec.self_seconds, 6),
             "jit_cache": jit_cache,
-            "solver_compiles_during_run": sum(compiles_during.values())}
+            "solver_compiles_during_run": compiles}
         print(f"{'NorthStar_100k_10k_endtoend':>28}: {pps:>9.0f} pods/s  "
               f"({bound}/{n_pods} BOUND through the store in {dt:.3f}s)",
               file=sys.stderr)
         print("    stages: " + "  ".join(
             f"{k}={v:.3f}s" for k, v in sorted(
                 stages.items(), key=lambda kv: -kv[1])), file=sys.stderr)
+        print(f"    submit->bound: p50={latency['p50_s']}s "
+              f"p99={latency['p99_s']}s over {latency['count']} pods; "
+              f"SLO {'PASS' if slo['pass'] else 'FAIL ' + str(slo['failed'])}",
+              file=sys.stderr)
     except Exception as e:
         results["NorthStar_100k_10k_endtoend"] = {"error": str(e)[:200]}
         print(f"NorthStar_100k_10k_endtoend: ERROR {e}", file=sys.stderr)
@@ -837,6 +867,22 @@ def rung_chaos_churn(results):
               and brk.recoveries >= 1 and brk.state == "closed"
               and injected.get("bind.worker", {}).get("injected", 0) >= 1
               and sched.bind_worker_restarts >= 1)
+        # ISSUE 7: the breaker trip must SHOW UP as a latency excursion in
+        # the trace without breaking the tracer — at quiescence every pod is
+        # bound, so every surviving sampled span must be complete, the
+        # submit->bound p99 must sit above the median (the faulted/backoff
+        # pods ARE the tail) yet inside the chaos SLO ceiling
+        from kubernetes_tpu.scheduler.slo import CHAOS_SLO, evaluate_slo
+
+        latency = sched.podtrace.latency_stats()
+        tsnap = sched.podtrace.snapshot()
+        n_spans = len(tsnap["spans"])
+        n_complete = sum(1 for s in tsnap["spans"] if s["complete"])
+        slo = evaluate_slo({"latency": latency}, CHAOS_SLO)
+        trace_ok = (n_spans > 0 and n_complete == n_spans
+                    and latency["count"] > 0
+                    and latency["p99_s"] >= latency["p50_s"]
+                    and slo["pass"])
         results["ChaosChurn_20k"] = {
             "pods_per_sec": round(n_pods / dt, 1), "wall_s": round(dt, 3),
             "placed": c["bound"], "pods": len(keys),
@@ -845,13 +891,19 @@ def rung_chaos_churn(results):
             "breaker_state": brk.state,
             "bind_worker_restarts": sched.bind_worker_restarts,
             "resynced": resynced, "injected": injected,
+            "latency": latency,
+            "trace": {"spans": n_spans, "complete": n_complete,
+                      "evicted_incomplete": tsnap["evicted_incomplete"]},
+            "trace_ok": trace_ok, "slo": slo,
             "disabled_check_ns": round(fi.disabled_check_cost_ns(), 2),
             "solver": "fast+breaker+chaos"}
         print(f"{'ChaosChurn_20k':>28}: {n_pods / dt:>9.0f} pods/s  "
               f"({c['bound']}/{n_pods} bound under chaos, "
               f"{c['lost']} lost, {c['double_bound']} double-bound, "
               f"breaker trips={brk.trips} recoveries={brk.recoveries}, "
-              f"worker restarts={sched.bind_worker_restarts}, {dt:.1f}s)",
+              f"worker restarts={sched.bind_worker_restarts}, {dt:.1f}s; "
+              f"p50={latency['p50_s']}s p99={latency['p99_s']}s, "
+              f"{n_complete}/{n_spans} spans complete)",
               file=sys.stderr)
     except Exception as e:
         from kubernetes_tpu.chaos import faultinject as fi

@@ -1,4 +1,5 @@
-"""HP001 — per-pod instrumentation inside batch loops of scheduler/batch.py.
+"""HP001 — per-pod instrumentation inside batch loops of the hot scheduler
+files (scheduler/batch.py and scheduler/podtrace.py).
 
 The flight recorder's contract (scheduler/flightrec.py, ROADMAP
 instrumentation budget <2%) is "per BATCH, never per pod": stage marks,
@@ -13,6 +14,14 @@ enumerate/zip/sorted/reversed wrappers, `.tolist()` and 1/2-arg `range(len(
 ...))`. Three-arg `range(0, len(x), chunk)` loops are CHUNK loops (pods /
 bind_chunk iterations) and are exempt — per-chunk timing is the recorder's
 own design.
+
+Sampled-tracing exception (ISSUE 7): the pod tracer's lifecycle stamps ARE
+per-pod work — legal ONLY behind a membership check against the sampled set
+(`if key in self._sampled: span.stamp(...)`), which bounds the paying
+population to K reservoir slots while unsampled pods pay one set lookup.
+Instrumentation calls lexically inside an `if` whose test contains an
+`x in <something named *sampled*>` comparison are therefore allowed; the
+same call unguarded is a finding.
 """
 
 from __future__ import annotations
@@ -24,19 +33,25 @@ from typing import List, Optional
 from ..findings import Finding
 from ..index import ProjectIndex
 
-HOT_FILE_SUFFIXES = ("scheduler/batch.py",)
+HOT_FILE_SUFFIXES = ("scheduler/batch.py", "scheduler/podtrace.py")
 
 POD_SCALE = re.compile(
     r"^(qps|pods|pending|items|to_bind|bind_rows|bind_nodes|bind_gang|"
     r"triples|bindings|prepared|rejected|members|pairs|leftovers|errs|"
     r"errors|victims|device_idx|fallback_idx|assign_list|assignment|"
-    r"events|batch|chunk)$")
+    r"events|batch|chunk|keys)$")
 
-INSTRUMENTATION_CALLS = {"observe", "inc", "set", "mark", "record", "step",
-                         "add_outside", "note_self_time", "event", "log",
-                         "info", "warning", "debug", "error", "exception"}
+INSTRUMENTATION_CALLS = {"observe", "observe_many", "inc", "set", "mark",
+                         "record", "step", "stamp", "add_outside",
+                         "note_self_time", "event", "log", "info", "warning",
+                         "debug", "error", "exception"}
 _METRICY = re.compile(r"^(m|metrics|fr|flightrec|clock|trace|recorder|"
-                      r"logger|logging|log)$")
+                      r"logger|logging|log|sp|span|spans|tracer|podtrace|"
+                      r"pt|latency)$")
+
+# the membership guard that legalizes per-pod stamping: any name segment of
+# the `in` comparator matching this (self._sampled, sampled, sampled_set)
+_SAMPLED = re.compile(r"sampled")
 
 
 def _root_name(expr: ast.AST) -> Optional[str]:
@@ -71,9 +86,32 @@ def _root_name(expr: ast.AST) -> Optional[str]:
             return None
 
 
+def _name_segments(node: ast.AST) -> List[str]:
+    segs: List[str] = []
+    while isinstance(node, ast.Attribute):
+        segs.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        segs.append(node.id)
+    return segs
+
+
 def _is_pod_scale_loop(loop: ast.For) -> bool:
     root = _root_name(loop.iter)
     return root is not None and bool(POD_SCALE.match(root))
+
+
+def _has_sampled_guard(test: ast.AST) -> bool:
+    """True when the if-test contains `x in <...sampled...>` — the
+    membership check that bounds per-pod stamping to the K-slot sample."""
+    for node in ast.walk(test):
+        if not isinstance(node, ast.Compare):
+            continue
+        for op, comp in zip(node.ops, node.comparators):
+            if isinstance(op, ast.In) and any(
+                    _SAMPLED.search(s) for s in _name_segments(comp)):
+                return True
+    return False
 
 
 def _instrumentation_desc(call: ast.Call) -> Optional[str]:
@@ -82,15 +120,9 @@ def _instrumentation_desc(call: ast.Call) -> Optional[str]:
         if f.attr == "perf_counter":
             return "time.perf_counter()"
         if f.attr in INSTRUMENTATION_CALLS:
-            # receiver chain must look metric/recorder/logger-ish; plain
-            # container .add()/.update() etc. are data structure ops
-            node = f.value
-            segs = []
-            while isinstance(node, ast.Attribute):
-                segs.append(node.attr)
-                node = node.value
-            if isinstance(node, ast.Name):
-                segs.append(node.id)
+            # receiver chain must look metric/recorder/logger/tracer-ish;
+            # plain container .add()/.update() etc. are data structure ops
+            segs = _name_segments(f.value)
             if any(_METRICY.match(s) for s in segs):
                 return f"instrumentation call .{f.attr}() on " \
                        f"'{segs[-1]}...'"
@@ -104,6 +136,24 @@ def _instrumentation_desc(call: ast.Call) -> Optional[str]:
     return None
 
 
+def _scan_loop_body(node: ast.AST, guarded: bool, hits: List) -> None:
+    """Collect unguarded instrumentation calls, tracking sampled-set guards:
+    descending into an `if <... in ...sampled...>` body flips guarded on;
+    the orelse branch keeps the surrounding state."""
+    if isinstance(node, ast.If) and _has_sampled_guard(node.test):
+        for child in node.body:
+            _scan_loop_body(child, True, hits)
+        for child in node.orelse:
+            _scan_loop_body(child, guarded, hits)
+        return
+    if isinstance(node, ast.Call) and not guarded:
+        desc = _instrumentation_desc(node)
+        if desc is not None:
+            hits.append((node, desc))
+    for child in ast.iter_child_nodes(node):
+        _scan_loop_body(child, guarded, hits)
+
+
 def check(index: ProjectIndex) -> List[Finding]:
     findings: List[Finding] = []
     for fi in index.files:
@@ -115,17 +165,20 @@ def check(index: ProjectIndex) -> List[Finding]:
                 if not isinstance(loop, ast.For) or \
                         not _is_pod_scale_loop(loop):
                     continue
-                for node in ast.walk(loop):
-                    if node is loop.iter or not isinstance(node, ast.Call):
-                        continue
-                    desc = _instrumentation_desc(node)
-                    if desc is None:
-                        continue
+                hits: List = []
+                # the iterable expression runs per pod too (a clock.mark()
+                # in a sort key multiplies just like one in the body)
+                _scan_loop_body(loop.iter, False, hits)
+                for stmt in loop.body + loop.orelse:
+                    _scan_loop_body(stmt, False, hits)
+                for node, desc in hits:
                     findings.append(Finding(
                         "HP001", fi.rel, node.lineno,
                         f"{info.qualname}: {desc} inside a pod-scale batch "
                         "loop",
                         hint="instrument per BATCH (StageClock marks / one "
-                             "flight record), never per pod — see "
-                             "scheduler/flightrec.py"))
+                             "flight record), never per pod — or guard the "
+                             "stamp behind the sampled-set membership check "
+                             "(`if key in ...sampled...:`); see "
+                             "scheduler/flightrec.py + scheduler/podtrace.py"))
     return findings

@@ -1305,9 +1305,18 @@ def _render_sched_stats(doc: Dict) -> str:
         out.append(
             f"queue: active={q.get('active', 0)} "
             f"backoff={q.get('backoff', 0)} "
-            f"unschedulable={q.get('unschedulable', 0)}   "
+            f"unschedulable={q.get('unschedulable', 0)} "
+            f"gang_staged={q.get('gang_staged', 0)} "
+            f"oldest_age={q.get('oldest_pending_age_s', 0.0):.1f}s   "
             f"recorder: {'on' if rec.get('enabled') else 'off'} "
             f"{rec.get('records', 0)}/{rec.get('capacity', 0)} batches")
+        lat = st.get("latency") or {}
+        if lat.get("count"):
+            out.append(
+                f"submit->bound: count={lat['count']} "
+                f"mean={lat.get('mean_s', 0) or 0:.3f}s "
+                f"p50={lat.get('p50_s', 0) or 0:.3f}s "
+                f"p99={lat.get('p99_s', 0) or 0:.3f}s")
         gang = st.get("gang")
         if gang:
             out.append(
@@ -1332,15 +1341,20 @@ def _render_sched_stats(doc: Dict) -> str:
             rows = []
             for stage, row in stages.items():
                 mean = row.get("mean_ms")
+                p50 = row.get("p50_ms")
+                p99 = row.get("p99_ms")
                 rows.append([
                     stage + (" *" if row.get("overlapped") else ""),
                     f"{row.get('total_ms', 0):.1f}",
                     f"{mean:.2f}" if mean is not None else "-",
+                    f"{p50:.2f}" if p50 is not None else "-",
+                    f"{p99:.2f}" if p99 is not None else "-",
                     f"{last[stage]:.2f}" if stage in last else "-",
                     str(row.get("batches", 0)),
                 ])
             out.append(fmt_table(
-                ["STAGE", "TOTAL(ms)", "MEAN(ms)", "LAST(ms)", "BATCHES"],
+                ["STAGE", "TOTAL(ms)", "MEAN(ms)", "P50(ms)", "P99(ms)",
+                 "LAST(ms)", "BATCHES"],
                 rows))
             out.append("(* overlapped with the scheduling thread)")
         else:
@@ -1349,25 +1363,134 @@ def _render_sched_stats(doc: Dict) -> str:
     return "\n".join(out).rstrip()
 
 
+def _render_sched_trace(doc: Dict) -> str:
+    """Sampled pod lifecycle spans (scheduler/podtrace.py): per scheduler, a
+    window/latency header plus one row per span with the per-edge offsets —
+    where each sampled pod's milliseconds went, submit to bound."""
+    if not doc:
+        return ("no batch scheduler registered in the server process "
+                "(is the control plane running in-process?)")
+    out = []
+    for name, tr in sorted(doc.items()):
+        if "error" in tr and len(tr) == 1:
+            out.append(f"{name}: error: {tr['error']}")
+            continue
+        lat = tr.get("latency") or {}
+        out.append(
+            f"{name}  tracer={'on' if tr.get('enabled') else 'off'} "
+            f"sample_k={tr.get('sample_k')} "
+            f"windows={tr.get('windows_rotated', 0)} "
+            f"completed={tr.get('completed', 0)} "
+            f"live={tr.get('live_incomplete', 0)} "
+            f"evicted={tr.get('evicted_incomplete', 0)}")
+        if lat.get("count"):
+            out.append(
+                f"submit->bound (ALL pods): count={lat['count']} "
+                f"p50={lat.get('p50_s', 0) or 0:.3f}s "
+                f"p99={lat.get('p99_s', 0) or 0:.3f}s")
+        spans = tr.get("spans") or []
+        if spans:
+            rows = []
+            for sp in spans[-40:]:  # newest spans; -o json has everything
+                st = sp.get("stamps_ms") or {}
+                rows.append([
+                    sp.get("pod", "?"),
+                    "yes" if sp.get("complete") else "no",
+                    str(sp.get("pops", 0)),
+                ] + [f"{st[k]:.1f}" if k in st else "-"
+                     for k in ("pop", "solve", "assume", "dispatch",
+                               "bind_commit", "bind_confirmed")])
+            out.append(fmt_table(
+                ["POD", "DONE", "POPS", "POP", "SOLVE", "ASSUME", "DISPATCH",
+                 "COMMIT", "CONFIRMED"], rows))
+            out.append("(per-edge offsets in ms since queue admission; "
+                       "last 40 spans — use -o json for all)")
+        else:
+            out.append("no sampled spans yet")
+        out.append("")
+    return "\n".join(out).rstrip()
+
+
+def _render_sched_slo(results: Dict) -> str:
+    """Per-scheduler SLO verdicts: one PASS/FAIL/SKIP row per check."""
+    out = []
+    for name, res in sorted(results.items()):
+        verdict = "PASS" if res["pass"] else "FAIL"
+        out.append(f"{name}: {verdict} "
+                   f"({len(res['failed'])} failed, "
+                   f"{len(res['skipped'])} skipped)")
+        rows = []
+        for c in res["checks"]:
+            state = ("SKIP" if c["ok"] is None
+                     else "PASS" if c["ok"] else "FAIL")
+            rows.append([c["name"], str(c["limit"]),
+                         "-" if c["actual"] is None else str(c["actual"]),
+                         state])
+        out.append(fmt_table(["CHECK", "CEILING", "ACTUAL", "STATE"], rows))
+        out.append("")
+    return "\n".join(out).rstrip()
+
+
 def cmd_sched(client: RESTClient, args) -> int:
-    """ktl sched stats [--watch] — the batched solver's flight-recorder view
-    served from /debug/schedstats (the kubectl-less sibling of `kubectl get
-    --raw /debug/...`)."""
+    """ktl sched stats|trace|slo — the batched solver's observability family
+    (flight recorder stage table, sampled lifecycle spans, SLO verdicts)
+    served from /debug/schedstats and /debug/schedtrace (the kubectl-less
+    sibling of `kubectl get --raw /debug/...`)."""
     import time as _time
 
-    if args.action != "stats":
+    if args.action not in ("stats", "trace", "slo"):
         raise CLIError(f"unknown sched action {args.action!r}")
+    spec = None
+    if args.action == "slo":
+        from ..scheduler.slo import DEFAULT_SLO, load_slo_spec
+
+        spec = load_slo_spec(args.spec) if args.spec else DEFAULT_SLO
+    # -w/--watch applies to every action (the parser registers it for all
+    # three); non-watch mode returns after one fetch with the action's exit
+    # code (slo: 1 on any FAIL)
     while True:
-        doc = client.request("GET", "/debug/schedstats")
-        if args.output == "json":
-            print(json.dumps(doc, indent=2))
+        if args.action == "trace":
+            doc = client.request("GET", "/debug/schedtrace")
+            rendered = (json.dumps(doc, indent=2) if args.output == "json"
+                        else _render_sched_trace(doc))
+            rc = 0
+        elif args.action == "slo":
+            from ..scheduler.slo import evaluate_slo
+
+            doc = client.request("GET", "/debug/schedstats")
+            if not doc:
+                print("no batch scheduler registered in the server process",
+                      file=sys.stderr)
+                return 1
+            results = {}
+            for name, st in doc.items():
+                if "error" in st and len(st) == 1:
+                    # a scheduler whose sched_stats() raised is a FAILING
+                    # verdict, not an absent one — the spec's "unavailable
+                    # datum never silently passes" rule applies to the whole
+                    # snapshot too
+                    results[name] = {
+                        "pass": False, "failed": ["schedstats_error"],
+                        "skipped": [], "checks": [{
+                            "name": "schedstats_error", "limit": None,
+                            "actual": st["error"], "ok": False}]}
+                else:
+                    results[name] = evaluate_slo(st, spec)
+            rendered = (json.dumps(results, indent=2)
+                        if args.output == "json"
+                        else _render_sched_slo(results))
+            rc = 0 if all(r["pass"] for r in results.values()) else 1
         else:
-            if args.watch:
-                # ANSI clear+home, like `watch`: live-updating stage table
-                sys.stdout.write("\x1b[2J\x1b[H")
-            print(_render_sched_stats(doc))
+            doc = client.request("GET", "/debug/schedstats")
+            rendered = (json.dumps(doc, indent=2) if args.output == "json"
+                        else _render_sched_stats(doc))
+            rc = 0
+        if args.watch and args.output != "json":
+            # ANSI clear+home, like `watch`: live-updating table
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(rendered)
         if not args.watch:
-            return 0
+            return rc
         sys.stdout.flush()
         _time.sleep(args.interval)
 
@@ -1626,11 +1749,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("sched")
-    p.add_argument("action", choices=["stats"])
+    p.add_argument("action", choices=["stats", "trace", "slo"])
     p.add_argument("-o", "--output", default="table",
                    choices=["table", "json"])
     p.add_argument("-w", "--watch", action="store_true")
     p.add_argument("--interval", type=float, default=2.0)
+    p.add_argument("--spec", default=None,
+                   help="SLO spec JSON file (sched slo; default: the "
+                        "built-in north-star spec)")
     p.set_defaults(fn=cmd_sched)
 
     p = sub.add_parser("vet")

@@ -119,6 +119,15 @@ def run(n_watchers: int = 5000, n_events: int = 100,
         out["deliveries_per_s"] = round(delivered / fan_s, 1)
         out["events_per_s_per_watcher"] = round(
             delivered / fan_s / max(1, n_watchers), 2)
+        # watch-bus telemetry (ISSUE 7 satellite): subscriber buffer state +
+        # dropped-delivery counters at the end of the fan-out — a watcher
+        # silently losing events (chaos drop, overflow eviction) is now a
+        # number in the rung output, not an invisible gap
+        tel = store.watch_telemetry()
+        out["watch_subscribers"] = len(tel["subscribers"])
+        out["watch_queue_max"] = max(
+            (s["queue_length"] for s in tel["subscribers"]), default=0)
+        out["watch_dropped"] = tel["dropped"]
         if done < n_watchers:
             incomplete = sum(1 for c in counts.values() if c < want)
             out["error"] = (f"{incomplete} watchers missed events "

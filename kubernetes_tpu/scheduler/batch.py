@@ -29,6 +29,7 @@ from ..store import (MODIFIED, APIStore, NotFoundError, pod_bind_clone,
 from .breaker import SolverCircuitBreaker
 from .flightrec import FlightRecorder, StageClock, register_scheduler
 from .framework import Status
+from .podtrace import PodTracer
 from .queue import QueuedPodInfo
 from .runtime import Framework
 from .serial import Scheduler
@@ -55,7 +56,9 @@ class BatchScheduler(Scheduler):
                  columnar: bool = True, flight_recorder: bool = True,
                  flight_capacity: int = FlightRecorder.DEFAULT_CAPACITY,
                  breaker_threshold: int = 3, breaker_cooldown_s: float = 30.0,
-                 bind_retries: int = 3, bind_retry_base_s: float = 0.05, **kw):
+                 bind_retries: int = 3, bind_retry_base_s: float = 0.05,
+                 pod_trace: Optional[bool] = None,
+                 trace_sample_k: int = PodTracer.DEFAULT_SAMPLE_K, **kw):
         super().__init__(store, framework, **kw)
         self.batch_size = batch_size
         self.solver = solver
@@ -68,6 +71,22 @@ class BatchScheduler(Scheduler):
         self.flightrec = FlightRecorder(capacity=flight_capacity,
                                         enabled=flight_recorder)
         self.queue.stat_sink = self.flightrec
+        # sampled pod lifecycle tracer (scheduler/podtrace.py, ISSUE 7):
+        # reservoir-samples K pods per window at queue admission, stamps
+        # lifecycle edges with SHARED per-batch/per-chunk timestamps, and
+        # feeds the all-pods submit->bound latency histogram. Follows the
+        # recorder's enable switch unless pod_trace says otherwise; its
+        # self-time accrues to the same <2% budget.
+        self.podtrace = PodTracer(
+            clock=self.clock, sample_k=trace_sample_k,
+            enabled=flight_recorder if pod_trace is None else pod_trace,
+            stat_sink=self.flightrec)
+        self.queue.trace_sink = self.podtrace
+        # queue-depth/oldest-age gauge refresh throttle (satellite): the
+        # telemetry scan is O(queue), so gauges update at most 1/s per pump
+        self._q_telemetry_next = 0.0
+        self._q_telemetry_last: Optional[Dict] = None
+        self._q_telemetry_lock = threading.Lock()
         register_scheduler(self._bind_origin, self)
         # per-batch unschedulable-reason attribution (set during
         # schedule_batch; _handle_failure taps Status.plugin into it)
@@ -181,6 +200,10 @@ class BatchScheduler(Scheduler):
                 fr.add_outside(name, sec)
             return 0
         m.batch_size_gauge.set(len(qps))
+        # ONE full-batch pass finds the sampled pods (set-membership per pod,
+        # nothing when the sample is empty); later stage stamps touch only
+        # the <=K hits (scheduler/podtrace.py)
+        self.podtrace.batch_popped(qps)
         trace = Trace("ScheduleBatch", pods=len(qps))
         failed0 = self.failed_count
         victims0 = self.preempt_victims_total
@@ -227,6 +250,7 @@ class BatchScheduler(Scheduler):
                          if self.breaker.state != "closed" else None),
                 error=out.get("batch_error"))
             trace.log_if_long(self.trace_threshold)
+            self._update_queue_telemetry()
             fr.note_self_time(time.perf_counter() - t_fin)
 
     def _schedule_batch_inner(self, qps, clock, trace, m,
@@ -326,6 +350,7 @@ class BatchScheduler(Scheduler):
                     assignment = np.where(veto, -1, assignment)
             clock.mark("solve")
             trace.step("Device solve done", solver=solver)
+            self.podtrace.batch_stage("solve")  # shared per-batch stamp
             # Two phases: bind every device assignment FIRST, then handle the
             # rejected pods. Handling mid-loop would see capacity still
             # promised to not-yet-bound assignments and double-book nodes.
@@ -458,7 +483,12 @@ class BatchScheduler(Scheduler):
                         accounted = True
                     clock.mark("assume")
                     trace.step("Assumed placements", bound=len(to_bind))
+                    self.podtrace.batch_stage("assume")
                     out["dispatched"] = len(to_bind)
+                    # dispatch edge = handed to the bind path; stamped BEFORE
+                    # the chunk loop so the synchronous-bind mode (which
+                    # completes spans inside the loop) still records it
+                    self.podtrace.batch_stage("dispatch")
                     for lo in range(0, len(to_bind), self.bind_chunk):
                         chunk = to_bind[lo:lo + self.bind_chunk]
                         if self.pipeline_binds:
@@ -994,11 +1024,44 @@ class BatchScheduler(Scheduler):
             sink[key] = sink.get(key, 0) + 1
         super()._handle_failure(qp, status, failed_nodes)
 
+    def _update_queue_telemetry(self, want_dict: bool = False) -> Optional[Dict]:
+        """Refresh the scheduler_queue_depth{tier} gauges and the
+        oldest-pending-age gauge (ISSUE 7 satellite). Called once per pump
+        (schedule_batch's finally), throttled to 1/s because the underlying
+        scan is O(queue) under the queue lock — gauges are a dashboard read,
+        not a control input. The throttle holds for EVERY caller: a read
+        surface (want_dict=True) inside the window gets the cached <=1s-old
+        dict instead of forcing a rescan, so an aggressive external poller
+        (`ktl sched stats -w --interval 0.1` against a 100k backlog) can't
+        turn /debug/schedstats into a queue-lock DoS."""
+        # claim the refresh slot under a private lock (check-then-act:
+        # sched_stats runs on HTTP handler threads concurrently with the
+        # pump) so N simultaneous pollers produce ONE scan, not N; the scan
+        # itself runs outside the claim lock
+        with self._q_telemetry_lock:
+            now = self.clock.now()
+            if now < self._q_telemetry_next and \
+                    self._q_telemetry_last is not None:
+                return self._q_telemetry_last if want_dict else None
+            self._q_telemetry_next = now + 1.0
+        t0 = time.perf_counter()
+        tel = self.queue.telemetry()
+        from ..server import metrics as m
+
+        for tier in ("active", "backoff", "unschedulable", "gang_staged"):
+            m.queue_depth.set(tel[tier], tier=tier)
+        m.queue_oldest_age.set(tel["oldest_pending_age_s"])
+        self.flightrec.note_self_time(time.perf_counter() - t0)
+        self._q_telemetry_last = tel
+        return tel
+
     def sched_stats(self) -> Dict:
         """The /debug/schedstats payload: live counters + the flight
-        recorder's aggregate stage table and last-batch record (the
-        machine-generated successor of ROADMAP's hand-maintained table)."""
-        active, backoff, unsched = self.queue.lengths()
+        recorder's aggregate stage table (now with p50/p99 columns), the
+        submit->bound latency distribution, tracer health, and the last-batch
+        record (the machine-generated successor of ROADMAP's hand-maintained
+        table)."""
+        tel = self._update_queue_telemetry(want_dict=True)
         gang = None
         if self.gangs is not None and self.gangs.active:
             from ..server import metrics as m
@@ -1017,8 +1080,17 @@ class BatchScheduler(Scheduler):
             "failed": self.failed_count,
             "preemptions": self.preemption_count,
             "preempt_victims": self.preempt_victims_total,
-            "queue": {"active": active, "backoff": backoff,
-                      "unschedulable": unsched},
+            "queue": {"active": tel["active"], "backoff": tel["backoff"],
+                      "unschedulable": tel["unschedulable"],
+                      "gang_staged": tel["gang_staged"],
+                      "oldest_pending_age_s": round(
+                          tel["oldest_pending_age_s"], 3)},
+            "latency": self.podtrace.latency_stats(),
+            "trace": {"enabled": self.podtrace.enabled,
+                      "sample_k": self.podtrace.sample_k,
+                      "completed": self.podtrace.completed_total,
+                      "live_incomplete": self.podtrace.live_incomplete,
+                      "windows_rotated": self.podtrace.windows_rotated},
             "gang": gang,
             "breaker": self.breaker.describe(),
             "bind_worker": {
@@ -1063,7 +1135,15 @@ class BatchScheduler(Scheduler):
                 self._handle_failure(qp, Status.error(str(e)))
 
     def _ensure_bind_worker(self) -> None:
-        if self._bind_worker is None or not self._bind_worker.is_alive():
+        if self._bind_worker is not None and not self._bind_worker.is_alive():
+            # a hard-dead worker's in-flight chunks and task_done debt MUST
+            # be recovered before a replacement starts: the new worker's
+            # first cycle overwrites the shared _bind_inflight record,
+            # destroying the evidence — the debt then leaks and flush_binds
+            # wedges forever (found by the full-size ChaosChurn_20k rung:
+            # the enqueue path won the race against the liveness drain)
+            self._recover_dead_worker()
+        if self._bind_worker is None:
             # the queue is BOUND at thread start: a crash resync swaps
             # self._bind_q for a fresh queue, and the old worker must keep
             # draining (and exiting on) the queue it was born with
@@ -1171,14 +1251,25 @@ class BatchScheduler(Scheduler):
 
     def _check_bind_worker_alive(self) -> None:
         """Dead-worker liveness check (ISSUE 6 satellite), run every drain:
-        _ensure_bind_worker is only consulted on enqueue, so a worker that
-        died hard (FaultKill, MemoryError) with an empty bind queue used to
-        stay dead — and its in-flight chunk's unmatched task_done debt hung
-        flush_binds forever. Here: re-queue the stranded chunks, settle the
-        debt, and restart the worker if work remains."""
+        a worker that died hard (FaultKill, MemoryError) with an empty bind
+        queue used to stay dead — and its in-flight chunk's unmatched
+        task_done debt hung flush_binds forever. Here: recover the stranded
+        chunks + debt, and restart the worker if work remains."""
         w = self._bind_worker
         if w is None or w.is_alive():
             return
+        self._recover_dead_worker()
+        if self._bind_q.unfinished_tasks:
+            self._ensure_bind_worker()
+
+    def _recover_dead_worker(self) -> None:
+        """Settle a hard-dead worker's estate — shared by the liveness drain
+        and the enqueue path (whichever observes the death first): re-queue
+        its in-flight chunks for the supervised retry, settle their
+        unmatched task_done debt, count the restart, and clear the worker
+        ref so _ensure_bind_worker starts a replacement. Runs only on the
+        scheduling thread (both callers), so the estate is handed off
+        exactly once."""
         with self._bind_err_lock:
             inflight, self._bind_inflight = self._bind_inflight, []
             self.bind_worker_restarts += 1
@@ -1187,8 +1278,6 @@ class BatchScheduler(Scheduler):
             self._requeue_inflight(inflight, self._bind_q)
             for _ in inflight:
                 self._bind_q.task_done()  # the dead worker's unmatched gets
-        if self._bind_q.unfinished_tasks:
-            self._ensure_bind_worker()
 
     def _bind_batch(self, items) -> None:
         t0 = time.perf_counter()
@@ -1216,6 +1305,11 @@ class BatchScheduler(Scheduler):
             if exc is not None:
                 errors.extend((f"{ns}/{name}", str(exc))
                               for ns, name, _node in chunk)
+        # pod tracer (scheduler/podtrace.py): ONE commit stamp for the whole
+        # chunk (batch-boundary timestamps, no per-pod clocks); the confirm
+        # stamp is read after the assume-confirm settles below
+        pt = self.podtrace
+        t_commit = self.clock.now() if pt is not None and pt.enabled else 0.0
         if not errors:
             # common case: whole sub-batch committed. On the coalesced
             # pipeline the assume-CONFIRM piggybacks right here (one cache
@@ -1238,6 +1332,8 @@ class BatchScheduler(Scheduler):
                 self.cache.finish_binding_bulk([a for _qp, _node, a in items])
                 with self._bind_err_lock:
                     self._bind_successes += len(items)
+            if pt is not None and pt.enabled:
+                pt.chunk_bound(items, t_commit, self.clock.now())
             return
         errmap = dict(errors)
         confirm = []
@@ -1260,6 +1356,12 @@ class BatchScheduler(Scheduler):
                     [(k, n) for k, n, _a in confirm])
                 self._bind_confirm_leftovers.extend(
                     confirm[i][2] for i in leftover)
+        if pt is not None and pt.enabled:
+            # partial-failure chunk: failed pods are excluded from both the
+            # latency distribution and the sampled stamps (they re-enter the
+            # queue and bind later — the tracer sees that attempt instead)
+            pt.chunk_bound(items, t_commit, self.clock.now(),
+                           errkeys=frozenset(errmap))
 
     def _bind_chunk_with_retry(self, chunk, errors) -> Optional[Exception]:
         """One chunk's bind_many with transient-failure retry (ISSUE 6):

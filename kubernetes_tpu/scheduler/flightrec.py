@@ -53,6 +53,21 @@ OUTSIDE_STAGES = ("queue_add", "bind", "bind_wait")
 # sum explain the wall clock" checks.
 OVERLAPPED_STAGES = ("bind",)
 
+# Windowed per-stage latency buckets (ISSUE 7): log-spaced 0.2ms..~42s so
+# the p50/p99 estimates survive ring eviction at bounded memory. The ~1.55x
+# bucket ratio bounds the interpolation error well inside the headroom any
+# sane SLO ceiling carries; batches still in the ring get EXACT nearest-rank
+# percentiles instead (stage_table picks whichever source is lossless).
+STAGE_P_BUCKETS = tuple(round(0.0002 * (1.55 ** i), 6) for i in range(28))
+
+
+def _nearest_rank(sorted_vals: List[float], q: float) -> float:
+    """Exact nearest-rank percentile over a complete sample."""
+    import math
+
+    return sorted_vals[min(len(sorted_vals) - 1,
+                           max(0, math.ceil(q * len(sorted_vals)) - 1))]
+
 
 class StageClock:
     """Per-batch stage boundary marks. mark(name) attributes the time since
@@ -105,6 +120,12 @@ class FlightRecorder:
         self._stage_batches: Dict[str, int] = {}
         # per-stage seconds accrued outside any batch (see OUTSIDE_STAGES)
         self._outside: Dict[str, float] = {}
+        # per-stage latency histograms (ISSUE 7): one observation per batch
+        # (or per outside-bucket call — a bind chunk, a flush wait), never
+        # evicted with the ring, so stage_table's p50/p99 cover the whole
+        # window. Built lazily per stage; metrics.Histogram carries its own
+        # lock but every write here happens under self._lock anyway.
+        self._stage_hist: Dict[str, object] = {}
         # async bind failures observed since the last record (attached to it)
         self._pending_bind_failures: List = []
         # instrumentation self-time: seconds spent building records,
@@ -117,11 +138,22 @@ class FlightRecorder:
 
     # -- ingest ----------------------------------------------------------------
 
+    def _hist_observe(self, stage: str, seconds: float) -> None:
+        """One per-stage latency observation (caller holds self._lock)."""
+        h = self._stage_hist.get(stage)
+        if h is None:
+            from ..server.metrics import Histogram
+
+            h = self._stage_hist[stage] = Histogram(
+                stage, buckets=STAGE_P_BUCKETS)
+        h.observe(seconds)
+
     def add_outside(self, stage: str, seconds: float) -> None:
         if not self.enabled or seconds <= 0:
             return
         with self._lock:
             self._outside[stage] = self._outside.get(stage, 0.0) + seconds
+            self._hist_observe(stage, seconds)
 
     def outside_seconds(self, *stages: str) -> float:
         """Sum of the named outside buckets (the scheduler differences this
@@ -183,6 +215,7 @@ class FlightRecorder:
             for k, v in stages.items():
                 self._stage_totals[k] = self._stage_totals.get(k, 0.0) + v
                 self._stage_batches[k] = self._stage_batches.get(k, 0) + 1
+                self._hist_observe(k, v)
             return rec
 
     # -- read side -------------------------------------------------------------
@@ -206,22 +239,51 @@ class FlightRecorder:
 
     def stage_table(self) -> Dict[str, Dict]:
         """Aggregate per-stage view across every batch since clear() plus the
-        outside buckets: {stage: {total_ms, mean_ms, batches, overlapped}}.
-        The non-overlapped rows sum to ~the window's serial wall time — the
-        machine-generated successor of ROADMAP's hand-maintained table."""
+        outside buckets: {stage: {total_ms, mean_ms, p50_ms, p99_ms, batches,
+        overlapped}}. The non-overlapped rows sum to ~the window's serial
+        wall time — the machine-generated successor of ROADMAP's
+        hand-maintained table.
+
+        Percentile source (ISSUE 7): nearest-rank over the per-batch ring
+        while every observation is still in it (exact); once eviction or
+        per-call outside observations outgrow the ring, the windowed stage
+        histogram takes over (bucket-interpolated, error bounded by the
+        STAGE_P_BUCKETS ratio)."""
         with self._lock:
             totals = dict(self._stage_totals)
             batches = dict(self._stage_batches)
             outside = dict(self._outside)
+            hists = dict(self._stage_hist)
+            ring_vals: Dict[str, List[float]] = {}
+            for rec in self._records:
+                for k, ms in rec["stages"].items():
+                    ring_vals.setdefault(k, []).append(ms)
+
+        def pcts(name):
+            h = hists.get(name)
+            n_obs = h._total if h is not None else 0
+            vals = ring_vals.get(name)
+            if vals and len(vals) == n_obs:
+                vals = sorted(vals)
+                return (round(_nearest_rank(vals, 0.50), 3),
+                        round(_nearest_rank(vals, 0.99), 3))
+            if h is None or n_obs == 0:
+                return None, None
+            return (round(h.quantile(0.50) * 1000, 3),
+                    round(h.quantile(0.99) * 1000, 3))
+
         out: Dict[str, Dict] = {}
         for name in list(BATCH_STAGES) + list(OUTSIDE_STAGES):
             sec = totals.get(name, 0.0) + outside.get(name, 0.0)
             n = batches.get(name, 0)
             if sec == 0.0 and n == 0:
                 continue
+            p50, p99 = pcts(name)
             out[name] = {
                 "total_ms": round(sec * 1000, 3),
                 "mean_ms": round(sec * 1000 / n, 3) if n else None,
+                "p50_ms": p50,
+                "p99_ms": p99,
                 "batches": n,
                 "overlapped": name in OVERLAPPED_STAGES,
             }
@@ -230,8 +292,11 @@ class FlightRecorder:
         for name in set(totals) | set(outside):
             if name not in out:
                 sec = totals.get(name, 0.0) + outside.get(name, 0.0)
+                p50, p99 = pcts(name)
                 out[name] = {"total_ms": round(sec * 1000, 3),
                              "mean_ms": None,
+                             "p50_ms": p50,
+                             "p99_ms": p99,
                              "batches": batches.get(name, 0),
                              "overlapped": False}
         return out
@@ -242,6 +307,7 @@ class FlightRecorder:
             self._stage_totals.clear()
             self._stage_batches.clear()
             self._outside.clear()
+            self._stage_hist.clear()
             self._pending_bind_failures.clear()
             self._self_s = 0.0
 
@@ -273,5 +339,23 @@ def schedstats_snapshot() -> Dict[str, Dict]:
         try:
             out[name] = stats()
         except Exception as e:  # a wedged scheduler must not 500 the endpoint
+            out[name] = {"error": str(e)}
+    return out
+
+
+def schedtrace_snapshot() -> Dict[str, Dict]:
+    """{scheduler name: podtrace snapshot} over every live registered
+    scheduler — the sampled pod lifecycle spans GET /debug/schedtrace and
+    `ktl sched trace` serve (scheduler/podtrace.py)."""
+    with _registry_lock:
+        live = dict(_schedulers)
+    out = {}
+    for name, sched in live.items():
+        tracer = getattr(sched, "podtrace", None)
+        if tracer is None:
+            continue
+        try:
+            out[name] = tracer.snapshot()
+        except Exception as e:  # same wedge-tolerance as schedstats
             out[name] = {"error": str(e)}
     return out

@@ -1,0 +1,536 @@
+"""Sampled pod lifecycle tracing: what a USER of the cluster experiences.
+
+The flight recorder (scheduler/flightrec.py) explains where a batch's
+milliseconds go; nothing in tree measured the submit->bound latency of an
+individual pod, so a tail regression (a stalled bind chunk, a breaker
+cooldown, a backoff-tier pile-up) was invisible while throughput held. Two
+instruments, both built under the HP001 design constraint (instrumentation
+is per BATCH/chunk, never per pod in a pod-scale loop):
+
+  PodTracer.admitted   — reservoir-samples K pod keys per window at queue
+                         admission using Algorithm L (Li 1994): the geometric
+                         jump makes the per-batch cost O(samples taken), so a
+                         100k-pod admission touches a handful of keys, not
+                         100k random draws. The enqueue stamp is the batch's
+                         shared admission timestamp (QueuedPodInfo.timestamp),
+                         not a per-pod clock read.
+  lifecycle stamps     — sampled pods are stamped at the pipeline edges
+                         (enqueue, pop, solve, assume, dispatch, bind_commit,
+                         bind_confirmed) with ONE shared timestamp per batch/
+                         chunk. Unsampled pods pay one attribute read in the
+                         settlement pass; per-pod stamping is legal ONLY
+                         behind the sampled-set membership check (schedlint
+                         HP001 enforces it in this file).
+  latency histogram    — the aggregate submit->bound distribution covers ALL
+                         pods, not just the sample: each committed bind chunk
+                         bulk-observes (chunk commit stamp) - (admission batch
+                         stamp) per pod — batch-boundary timestamps only, and
+                         one histogram lock per chunk.
+
+Every stamp tap is O(1) on the hot path (the PR 4 lazy-event idiom): it
+records an op — the batch/chunk ref plus its shared timestamps — and the
+per-pod settlement passes run at the next read surface with the recorded
+stamps, identical whenever they happen, so the contended scheduling window
+never pays a batch scan. Past a bounded pending cap the flush runs inline on
+the recording thread and bills the recorder's <2% self-time budget
+(stat_sink, asserted by bench.py); read-side settlement is rendering cost,
+tracked separately as flush_seconds (published in snapshot()).
+
+Everything is bounded: the reservoir holds K keys, completed spans live in a
+ring, incomplete spans from rotated windows are capped and evicted oldest-
+first (counted, never silent).
+
+Consumers: `ktl sched trace` / GET /debug/schedtrace (span dump),
+sched_stats()["latency"] / ["trace"], and the SLO gates in bench.py
+(scheduler/slo.py) — the per-decision latency attribution placement-quality
+work needs downstream (Tesserae, arxiv 2508.04953; CvxCluster, arxiv
+2605.01614).
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+# lifecycle edges, in pipeline order (bind_commit = store.bind_many returned,
+# bind_confirmed = the cache assume-confirm settled on the same chunk)
+SPAN_STAGES = ("enqueue", "pop", "solve", "assume", "dispatch",
+               "bind_commit", "bind_confirmed")
+
+
+class PodSpan:
+    """One sampled pod's lifecycle record. stamps maps stage -> absolute
+    clock time (scheduler clock); re-pops overwrite, so the span always
+    describes the attempt that finally bound (pops counts the retries)."""
+
+    __slots__ = ("key", "window", "stamps", "pops", "complete")
+
+    def __init__(self, key: str, window: int):
+        self.key = key
+        self.window = window
+        self.stamps: Dict[str, float] = {}
+        self.pops = 0
+        self.complete = False
+
+    def stamp(self, stage: str, ts: float) -> None:
+        self.stamps[stage] = ts
+
+    def render(self) -> Dict:
+        t0 = self.stamps.get("enqueue")
+        offsets = {}
+        if t0 is not None:
+            for stage in SPAN_STAGES:
+                ts = self.stamps.get(stage)
+                if ts is not None:
+                    offsets[stage] = round((ts - t0) * 1000, 3)
+        total = offsets.get("bind_confirmed")
+        return {"pod": self.key, "window": self.window, "pops": self.pops,
+                "complete": self.complete, "stamps_ms": offsets,
+                "submit_to_bound_ms": total}
+
+
+class PodTracer:
+    """Reservoir-sampled lifecycle tracer + all-pods latency histogram."""
+
+    DEFAULT_SAMPLE_K = 64
+    DEFAULT_WINDOW_S = 30.0
+    SPAN_RING = 512
+    LIVE_CAP_FACTOR = 4  # incomplete spans kept across windows: K * this
+    # recorded-but-unsettled trace ops held for deferred processing; past
+    # this the flush runs inline on the recording thread (bounded memory:
+    # the deque holds refs to batch/chunk lists that are alive during the
+    # batch anyway)
+    PENDING_OPS_CAP = 64
+
+    def __init__(self, clock=None, sample_k: int = DEFAULT_SAMPLE_K,
+                 window_s: float = DEFAULT_WINDOW_S, enabled: bool = True,
+                 rng_seed: Optional[int] = None, stat_sink=None):
+        from ..server.metrics import E2E_LATENCY_BUCKETS, Histogram
+        from ..utils import Clock
+
+        self._clock = clock or Clock()
+        self.sample_k = max(1, sample_k)
+        self.window_s = window_s
+        self.enabled = enabled
+        self._rng = random.Random(rng_seed)
+        self._lock = threading.Lock()
+        # keys with a live (incomplete) span — THE stamp guard every per-pod
+        # loop below checks before touching a span (schedlint HP001)
+        self._sampled: set = set()
+        self._live: Dict[str, PodSpan] = {}  # insertion-ordered: evict oldest
+        self._done: deque = deque(maxlen=self.SPAN_RING)
+        # Algorithm L reservoir state for the current window
+        self._reservoir: List[str] = []
+        self._w: Optional[float] = None
+        self._skip = 0
+        self._window_seq = 0
+        self._window_start = self._clock.now()
+        self.windows_rotated = 0
+        self.evicted_incomplete = 0
+        self._completed = 0
+        # trace ops awaiting settlement (see the lifecycle-stamp taps);
+        # appends and poplefts are atomic deque ops, so the recording
+        # threads never contend on a lock for the O(1) taps. _flush_lock
+        # serializes settlement (ops are order-dependent).
+        self._ops: deque = deque()
+        self._flush_lock = threading.Lock()
+        self.flush_seconds = 0.0  # read-side settlement cost (rendering)
+        # aggregate submit->bound latency over ALL pods (private histogram so
+        # concurrent schedulers in one process don't blend; the process-wide
+        # Prometheus series is fed alongside in chunk_bound)
+        self.latency = Histogram("submit_to_bound_seconds",
+                                 buckets=E2E_LATENCY_BUCKETS)
+        # sampled keys present in the batch being scheduled right now
+        # (scheduling thread only)
+        self._batch_hits: Tuple = ()
+        self.stat_sink = stat_sink  # FlightRecorder: self-time budget
+
+    # -- sampling (queue admission) --------------------------------------------
+
+    def _rand(self) -> float:
+        return max(self._rng.random(), 1e-12)  # log() needs (0, 1]
+
+    def _geom_skip(self) -> int:
+        # items to pass over before the next reservoir replacement
+        return int(math.log(self._rand()) / math.log(1.0 - self._w))
+
+    def admitted(self, qps) -> None:
+        """One call per admission batch (SchedulingQueue.add_batch / add).
+        Samples this batch's slice of the admission stream into the
+        candidate reservoir. Cost: O(samples taken) — Algorithm L's
+        geometric jumps skip the rest of the batch untouched, and a sampled
+        candidate costs only a slot write + set update (its PodSpan
+        materializes lazily at first pop, so reservoir churn never allocates
+        spans that immediately get replaced)."""
+        if not self.enabled or not qps:
+            return
+        t0 = time.perf_counter()
+        # settle pending ops FIRST: window rotation and candidate
+        # displacement below read span.pops to decide which live spans
+        # survive, so deferred pop stamps must land before sampling state
+        # advances. Ops are empty in the bulk-ingest common case (admission
+        # precedes the batch's pop), so this is a falsy check there.
+        self._flush_ops(inline=True)
+        with self._lock:
+            now = qps[0].timestamp or self._clock.now()
+            self._maybe_rotate(now)
+            k = self.sample_k
+            n = len(qps)
+            res = self._reservoir  # slots hold QueuedPodInfo refs
+            rng = self._rng.random
+            log = math.log
+            idx = 0
+            filled = len(res)
+            while idx < n and len(res) < k:
+                res.append(qps[idx])
+                idx += 1
+            mutated = len(res) != filled
+            if len(res) == k and self._w is None:
+                self._w = math.exp(log(self._rand()) / k)
+                self._skip = self._geom_skip()
+            # jump phase, locals only: a replacement is one slot write plus
+            # ~3 rng/log ops — the span bookkeeping for this call's
+            # SURVIVORS happens once below, so within-call reservoir churn
+            # allocates nothing
+            w, skip, inv_k = self._w, self._skip, 1.0 / k
+            while w is not None and idx + skip < n:
+                idx += skip
+                res[int(rng() * k)] = qps[idx]
+                mutated = True
+                idx += 1
+                w *= (rng() or 1e-12) ** inv_k
+                skip = int(log(rng() or 1e-12) / log(1.0 - w))
+            if w is not None:
+                skip -= n - idx
+            self._w, self._skip = w, skip
+            # the geometric jump skipped this whole slice (the per-pod
+            # add() common case once the reservoir is warm): occupants are
+            # unchanged, so reconciliation has nothing to do — skip the
+            # O(K + live) scan
+            if mutated:
+                self._sync_candidates()
+        sink = self.stat_sink
+        if sink is not None:
+            sink.note_self_time(time.perf_counter() - t0)
+
+    def _sync_candidates(self) -> None:
+        """Reconcile live spans with the reservoir's final occupants (caller
+        holds self._lock): new candidates get a span (enqueue = their shared
+        admission stamp) linked onto their QueuedPodInfo — the link every
+        later stage reads instead of building keys and probing sets per pod;
+        requeues reuse the same object so it survives retries. Displaced
+        candidates that were never popped leave the sample; mid-flight spans
+        keep their stamps coming and complete normally."""
+        live = self._live
+        current = set()
+        for qp in self._reservoir:
+            # a slot whose pod already bound (its span completed and left
+            # the live set) is a SPENT sample: it keeps the slot — it is a
+            # legitimately sampled stream item — but must not be re-issued
+            # a fresh span that can never complete (admission waves after
+            # binds would otherwise mint zombie incomplete spans)
+            done = qp.trace_span
+            if done is not None and done.complete:
+                continue
+            key = qp.pod.key
+            current.add(key)
+            span = live.get(key)
+            if span is None:
+                span = PodSpan(key, self._window_seq)
+                span.stamp("enqueue", qp.submit_ts or qp.timestamp
+                           or self._clock.now())
+                live[key] = span
+                self._sampled.add(key)
+            qp.trace_span = span
+        for key in list(live):
+            if key not in current and live[key].pops == 0:
+                del live[key]
+                self._sampled.discard(key)
+        # a pod that never binds must not leak spans forever: cap the live
+        # set AFTER this window's additions, evicting oldest-first (counted,
+        # never silent) — insertion order puts prior windows' stragglers up
+        # front, so fresh candidates are the last to go
+        cap = self.LIVE_CAP_FACTOR * self.sample_k
+        while len(live) > cap:
+            old = next(iter(live))
+            live.pop(old)
+            self._sampled.discard(old)
+            self.evicted_incomplete += 1
+
+    def _maybe_rotate(self, now: float) -> None:
+        if now - self._window_start < self.window_s:
+            return
+        self._window_start = now
+        self._window_seq += 1
+        self.windows_rotated += 1
+        # un-materialized candidates from the old window lose their slot;
+        # live spans keep tracing until they complete, bounded by the cap
+        # in _sync_candidates
+        self._reservoir = []
+        self._sampled = set(self._live)
+        self._w = None
+        self._skip = 0
+
+    # -- lifecycle stamps (O(1) taps, deferred settlement) ---------------------
+    #
+    # Every stamp tap records an op — (kind, payload, shared timestamp) — in
+    # a FIFO and returns; the per-pod passes run in _flush_ops at the next
+    # read surface with the RECORDED timestamps, so the rendered result is
+    # byte-identical whenever settlement happens but the contended
+    # scheduling window never pays a batch scan. Past PENDING_OPS_CAP the
+    # flush runs inline on the recording thread and bills the recorder
+    # budget; read-side settlement is rendering cost (tracked in
+    # flush_seconds, published in snapshot()).
+
+    def batch_popped(self, qps) -> None:
+        """Once per popped batch: record the pop edge (shared timestamp)."""
+        if not self.enabled or not qps:
+            return
+        self._ops.append(("pop", qps, self._clock.now()))
+        if len(self._ops) > self.PENDING_OPS_CAP:
+            self._flush_ops(inline=True)
+
+    def batch_stage(self, stage: str) -> None:
+        """Record one pipeline-stage edge for the current batch's sampled
+        pods (resolved by the preceding pop op at settlement)."""
+        if not self.enabled:
+            return
+        self._ops.append(("stage", stage, self._clock.now()))
+        if len(self._ops) > self.PENDING_OPS_CAP:
+            self._flush_ops(inline=True)
+
+    def chunk_bound(self, items, t_commit: float, t_confirm: float,
+                    errkeys=frozenset()) -> None:
+        """Once per committed bind chunk (the bind worker thread, or the
+        synchronous bind path): record the chunk with its ONE commit stamp.
+        items are the bind triples (qp, node_name, assumed)."""
+        if not self.enabled or not items:
+            return
+        self._ops.append(("chunk", (items, t_commit, t_confirm, errkeys),
+                          0.0))
+        if len(self._ops) > self.PENDING_OPS_CAP:
+            self._flush_ops(inline=True)
+
+    def _flush_ops(self, inline: bool = False) -> None:
+        """Settle every deferred op in recording order (FIFO — a pop op
+        establishes the batch hits its stage ops stamp). _flush_lock
+        serializes flushers so order holds under concurrency; inline=True
+        (cap overflow on a recording thread) bills the recorder budget,
+        read-side settlement only accrues flush_seconds."""
+        if not self._ops:
+            return
+        with self._flush_lock:
+            # timer starts AFTER the lock: a flusher that blocked while a
+            # peer drained the FIFO did no work, and must not re-bill the
+            # peer's wall time to flush_seconds / the recorder budget
+            t0 = time.perf_counter()
+            while True:
+                try:
+                    kind, payload, ts = self._ops.popleft()
+                except IndexError:
+                    break
+                if kind == "pop":
+                    self._apply_pop(payload, ts)
+                elif kind == "stage":
+                    self._apply_stage(payload, ts)
+                else:
+                    self._apply_chunk(*payload)
+            # accrued under _flush_lock: concurrent flushers (read surfaces
+            # + cap overflows on recording threads) must not lose updates
+            dt = time.perf_counter() - t0
+            self.flush_seconds += dt
+        if inline:
+            sink = self.stat_sink
+            if sink is not None:
+                sink.note_self_time(dt)
+
+    def _apply_pop(self, qps, now: float) -> None:
+        """Find the sampled pods in a popped batch and stamp 'pop' with the
+        batch's shared timestamp. The full-batch pass costs unsampled pods
+        one attribute read each (the span was linked onto the QueuedPodInfo
+        at sampling time); the membership check against the sampled set then
+        guards only the <=K linked spans against staleness."""
+        if not self._sampled:  # common case: one falsy check per batch
+            self._batch_hits = ()
+            return
+        # C-speed pass: one attribute read per pod; only the <=K linked
+        # spans reach the stamping loop below
+        hits = [qp.trace_span for qp in qps if qp.trace_span is not None]
+        kept = []
+        if hits:
+            with self._lock:
+                sampled = self._sampled
+                for sp in hits:
+                    if sp.key in sampled:  # HP001 staleness guard
+                        sp.stamp("pop", now)
+                        sp.pops += 1
+                        kept.append(sp.key)
+        self._batch_hits = tuple(kept)
+
+    def _apply_stage(self, stage: str, now: float) -> None:
+        """Stamp one pipeline stage for the current batch's sampled pods —
+        shared timestamp, O(hits) with hits <= K."""
+        if not self._batch_hits:
+            return
+        with self._lock:
+            for k in self._batch_hits:
+                if k in self._sampled:  # HP001 guard (evicted mid-batch)
+                    sp = self._live.get(k)
+                    if sp is not None:
+                        sp.stamp(stage, now)
+
+    def _apply_chunk(self, items, t_commit: float, t_confirm: float,
+                     errkeys) -> None:
+        """Settle one committed bind chunk: bulk-observe submit->bound
+        latency for EVERY successfully bound pod (shared commit stamp minus
+        the shared admission stamp — submit_ts is always set,
+        QueuedPodInfo.__post_init__), then stamp bind_commit/bind_confirmed
+        for the sampled ones. Unsampled pods pay two attribute reads in
+        C-speed listcomps."""
+        if errkeys:
+            vals = [t_commit - qp.submit_ts for qp, _node, _a in items
+                    if qp.pod.key not in errkeys]
+            spans = [qp.trace_span for qp, _node, _a in items
+                     if qp.trace_span is not None
+                     and qp.pod.key not in errkeys]
+        else:
+            vals = [t_commit - qp.submit_ts for qp, _node, _a in items]
+            spans = [qp.trace_span for qp, _node, _a in items
+                     if qp.trace_span is not None]
+        if vals:
+            # ONE bucket pass feeds both the private histogram and the
+            # process-wide Prometheus series (identical E2E buckets)
+            res = self.latency.bucket_counts(vals)
+            self.latency.observe_counts(*res)
+            from ..server import metrics as m
+
+            m.pod_e2e_latency.observe_counts(*res)
+        if spans:
+            with self._lock:
+                for sp in spans:
+                    if sp.key in self._sampled:  # HP001 staleness guard
+                        sp.stamp("bind_commit", t_commit)
+                        sp.stamp("bind_confirmed", t_confirm)
+                        self._complete(sp.key)
+
+    def pod_bound(self, qp, now: float) -> None:
+        """Serial-path bind (the per-pod fallback loop — inherently per pod,
+        so a per-pod tap is the loop's own granularity): one latency
+        observation plus the sampled stamps."""
+        if not self.enabled:
+            return
+        # settle deferred pop/stage ops BEFORE completing: _complete()
+        # removes the key from the sampled set, so a pending pop op settling
+        # later would be staleness-guarded away and the finished span would
+        # render with pops=0 and missing mid-pipeline stamps. Falsy check
+        # after the first pod of the batch.
+        self._flush_ops(inline=True)
+        dt = now - (qp.submit_ts or qp.timestamp)
+        self.latency.observe(dt)
+        from ..server import metrics as m
+
+        m.pod_e2e_latency.observe(dt)
+        sp = qp.trace_span
+        if sp is not None and sp.key in self._sampled:  # HP001 guard
+            with self._lock:
+                sp.stamp("bind_commit", now)
+                sp.stamp("bind_confirmed", now)
+                self._complete(sp.key)
+
+    def _complete(self, key: str) -> None:
+        """Caller holds self._lock."""
+        sp = self._live.pop(key, None)
+        if sp is None:
+            return
+        self._sampled.discard(key)
+        sp.complete = True
+        self._done.append(sp)
+        self._completed += 1
+
+    def drop_live(self) -> None:
+        """Abandon every in-flight span (counted, never silent). Called on
+        crash resync / relist: the rebuilt queue holds fresh QueuedPodInfos
+        with no span links, so the old spans could never complete — exactly
+        like the rest of the in-memory scheduler state a crash loses.
+        Chunks that COMMITTED before the crash settle first: their binds
+        are store facts the resync will re-observe."""
+        self._flush_ops()
+        with self._lock:
+            self.evicted_incomplete += len(self._live)
+            self._live.clear()
+            self._sampled = set()
+            self._reservoir = []
+            self._w = None
+            self._skip = 0
+            self._batch_hits = ()
+
+    # -- read side (every surface settles deferred chunks first) ---------------
+
+    @property
+    def live_incomplete(self) -> int:
+        self._flush_ops()
+        return len(self._live)
+
+    @property
+    def completed_total(self) -> int:
+        self._flush_ops()
+        return self._completed
+
+    def latency_stats(self) -> Dict:
+        """The aggregate submit->bound distribution: count/mean/p50/p99."""
+        self._flush_ops()
+        total_s, count = self.latency.snapshot()
+        p50 = self.latency.quantile(0.50)
+        p99 = self.latency.quantile(0.99)
+        return {
+            "count": count,
+            "sum_s": round(total_s, 4),
+            "mean_s": round(total_s / count, 6) if count else None,
+            "p50_s": round(p50, 6) if p50 is not None else None,
+            "p99_s": round(p99, 6) if p99 is not None else None,
+        }
+
+    def snapshot(self) -> Dict:
+        """The /debug/schedtrace payload: config, window counters, the
+        latency distribution, and every span (completed ring + live)."""
+        self._flush_ops()
+        with self._lock:
+            spans = [sp.render() for sp in self._done]
+            spans.extend(sp.render() for sp in self._live.values())
+            live = len(self._live)
+        return {
+            "enabled": self.enabled,
+            "sample_k": self.sample_k,
+            "window_s": self.window_s,
+            "windows_rotated": self.windows_rotated,
+            "completed": self._completed,
+            "live_incomplete": live,
+            "evicted_incomplete": self.evicted_incomplete,
+            "flush_seconds": round(self.flush_seconds, 6),
+            "latency": self.latency_stats(),
+            "spans": spans,
+        }
+
+    def clear(self) -> None:
+        from ..server.metrics import E2E_LATENCY_BUCKETS, Histogram
+
+        with self._lock:
+            self._sampled.clear()
+            self._live.clear()
+            self._done.clear()
+            self._reservoir = []
+            self._w = None
+            self._skip = 0
+            self._window_start = self._clock.now()
+            self.windows_rotated = 0
+            self.evicted_incomplete = 0
+            self._completed = 0
+            self._ops.clear()
+            self.flush_seconds = 0.0
+            self._batch_hits = ()
+            self.latency = Histogram("submit_to_bound_seconds",
+                                     buckets=E2E_LATENCY_BUCKETS)

@@ -58,6 +58,19 @@ class QueuedPodInfo:
     timestamp: float = 0.0
     attempts: int = 0
     unschedulable_plugins: Tuple[str, ...] = ()
+    # first-admission time, NEVER reset by requeues (timestamp is): the
+    # submit->bound latency the pod tracer observes spans every retry. Set
+    # from the admission batch's shared clock read — no per-pod clock calls.
+    submit_ts: float = 0.0
+    # the pod's live PodSpan when it is in the tracer's sample, linked at
+    # batch-pop time (scheduler/podtrace.py): the bind worker's per-chunk
+    # pass then pays ONE attribute read per pod instead of a key build +
+    # set lookup. None for the unsampled ~100%.
+    trace_span: object = field(default=None, repr=False, compare=False)
+
+    def __post_init__(self):
+        if not self.submit_ts:
+            self.submit_ts = self.timestamp
 
     @property
     def key(self) -> str:
@@ -96,6 +109,10 @@ class SchedulingQueue:
         # bulk-admission wall time accrues to its "queue_add" bucket so the
         # batch pipeline's stage table can attribute ingest sub-stages
         self.stat_sink = None
+        # lifecycle-trace sink (a PodTracer, installed by BatchScheduler):
+        # notified once per admission batch — AFTER the queue lock releases —
+        # with the freshly-admitted QueuedPodInfos for reservoir sampling
+        self.trace_sink = None
 
     def set_gang_hooks(self, gang_of, gang_ready, gang_active) -> None:
         """Install gang gating: gang_of(pod) names the pod's group (None for
@@ -123,6 +140,9 @@ class SchedulingQueue:
             qp = QueuedPodInfo(pod=pod, timestamp=self._clock.now())
             self._push_active(qp)
             self._lock.notify()
+        ts = self.trace_sink
+        if ts is not None:
+            ts.admitted((qp,))
 
     def add_batch(self, pods: List[Pod], pre_gated: bool = False) -> None:
         """Bulk admission for a coalesced watch chunk: ONE lock acquisition
@@ -140,9 +160,10 @@ class SchedulingQueue:
         if sink is not None and sink.enabled:
             import time as _time
 
+            admitted = []
             t0 = _time.perf_counter()
             try:
-                self._add_batch_locked(pods, pre_gated)
+                admitted = self._add_batch_locked(pods, pre_gated)
             finally:
                 t1 = _time.perf_counter()
                 sink.add_outside("queue_add", t1 - t0)
@@ -150,10 +171,17 @@ class SchedulingQueue:
 
                 m.batch_stage_duration.observe(t1 - t0, "queue_add")
                 sink.note_self_time(_time.perf_counter() - t1)
-            return
-        self._add_batch_locked(pods, pre_gated)
+        else:
+            admitted = self._add_batch_locked(pods, pre_gated)
+        ts = self.trace_sink
+        if ts is not None and admitted:
+            # reservoir sampling at admission (scheduler/podtrace.py), with
+            # the queue lock already released; the tracer accounts its own
+            # self-time against the recorder budget
+            ts.admitted(admitted)
 
-    def _add_batch_locked(self, pods: List[Pod], pre_gated: bool) -> None:
+    def _add_batch_locked(self, pods: List[Pod],
+                          pre_gated: bool) -> List[QueuedPodInfo]:
         with self._lock:
             now = self._clock.now()
             gang_of = (self._gang_of if self._gang_active is not None
@@ -180,7 +208,7 @@ class SchedulingQueue:
                 self._in_active[key] = qp
                 entries.append((self._sort_key(qp), next(self._seq), qp))
             if not entries:
-                return
+                return []
             if len(entries) >= len(self._active):
                 self._active.extend(entries)
                 heapq.heapify(self._active)
@@ -188,6 +216,7 @@ class SchedulingQueue:
                 for e in entries:
                     heapq.heappush(self._active, e)
             self._lock.notify_all()
+            return [e[2] for e in entries]
 
     def _push_active(self, qp: QueuedPodInfo) -> None:
         self._unschedulable.pop(qp.key, None)
@@ -551,3 +580,28 @@ class SchedulingQueue:
     def gang_staged_count(self) -> int:
         with self._lock:
             return sum(len(s) for s in self._gang_staging.values())
+
+    def telemetry(self) -> Dict[str, float]:
+        """Queue depth by tier plus the age of the oldest pod still waiting
+        anywhere (first-admission time, so a pod cycling through backoff
+        keeps aging). One O(queue) scan per call — callers update gauges per
+        PUMP, throttled (scheduler/batch.py), never per pod."""
+        with self._lock:
+            now = self._clock.now()
+            staged = sum(len(m) for m in self._gang_staging.values())
+            waiting = itertools.chain(
+                (qp for _, _, qp in self._active),
+                (qp for _, _, qp in self._backoff),
+                self._unschedulable.values(),
+                (qp for m in self._gang_staging.values()
+                 for qp in m.values()))
+            oldest = min((qp.submit_ts or qp.timestamp for qp in waiting),
+                         default=None)
+            return {
+                "active": len(self._active),
+                "backoff": len(self._backoff),
+                "unschedulable": len(self._unschedulable),
+                "gang_staged": staged,
+                "oldest_pending_age_s": (max(0.0, now - oldest)
+                                         if oldest is not None else 0.0),
+            }

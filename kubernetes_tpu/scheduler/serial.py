@@ -123,6 +123,9 @@ class Scheduler:
         # pipeline flight recorder (scheduler/flightrec.py) — installed by
         # BatchScheduler; None on the serial loop, every hook gated on it
         self.flightrec = None
+        # sampled pod lifecycle tracer (scheduler/podtrace.py) — installed by
+        # BatchScheduler; None on the serial loop, hooks gated on it
+        self.podtrace = None
         # coalesced watch ingest: batched store writes arrive as ONE
         # CoalescedEvent; _bind_origin tags our own bind_many batches so
         # their MODIFIED events short-circuit to a bulk assume-confirm
@@ -327,6 +330,14 @@ class Scheduler:
         if self._watch is not None:
             self._watch.stop()
         self.cache = Cache(clock=self.clock)
+        if self.podtrace is not None and not preserve_queue:
+            # crash resync discards the queue, so in-flight spans reference
+            # QueuedPodInfos that are about to be replaced and can never
+            # complete — drop them (counted as evicted) like the rest of the
+            # pre-crash in-memory state. A routine _relist KEEPS the queue's
+            # objects (and their trace_span links), so those spans still
+            # finish normally and must not be evicted.
+            self.podtrace.drop_live()
         for lister in self._volume_listers:
             if hasattr(lister, "clear"):
                 lister.clear()
@@ -718,6 +729,12 @@ class Scheduler:
                 self.gangs.note_assumed(assumed)
             framework.run_post_bind(state, assumed, result.suggested_host)
             self.scheduled_count += 1
+            pt = self.podtrace
+            if pt is not None and pt.enabled:
+                # serial fallback pods join the submit->bound distribution
+                # and (if sampled) complete their lifecycle span here — the
+                # serial loop is per-pod by design, so this is its granularity
+                pt.pod_bound(qp, self.clock.now())
             self.recorder.event(
                 pod, "Normal", "Scheduled",
                 f"Successfully assigned {pod.key} to {result.suggested_host}")

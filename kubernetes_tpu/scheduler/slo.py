@@ -1,0 +1,132 @@
+"""Declarative latency SLOs over the observability stack — regression GATES.
+
+ROADMAP direction 5: the PR 3/PR 5 observability was a dashboard; this turns
+it into assertions. A spec is a plain dict (JSON-serializable, loadable from
+a file for `ktl sched slo --spec`):
+
+    {
+      "stage_p99_ms":         {"solve": 5000, "bind": 8000, ...},
+      "submit_to_bound_p99_s": 30.0,
+      "solver_compiles":       0,
+      "instrumentation_frac":  0.02
+    }
+
+  stage_p99_ms           per-stage p99 ceilings in ms, checked against the
+                         flight recorder's stage table (flightrec.py). A
+                         stage absent from the stats is a SKIP, not a pass —
+                         except that a FAILED check it would have produced is
+                         exactly what the consumer must decide about, so
+                         skips are reported separately.
+  submit_to_bound_p99_s  ceiling on the all-pods submit->bound p99
+                         (scheduler/podtrace.py latency histogram).
+  solver_compiles        max jit compiles inside the measured window (the
+                         retrace guard as an SLO; needs the caller to supply
+                         the count via `extra` — bench.py does, a live `ktl
+                         sched slo` cannot and the check reports SKIP).
+  instrumentation_frac   recorder+tracer self-time / wall ceiling (the <2%
+                         budget as a first-class SLO; also `extra`-supplied).
+
+evaluate_slo() consumes a sched_stats()-shaped payload (the /debug/schedstats
+document, or the dict bench.py assembles) and returns
+{"pass", "checks": [{name, limit, actual, ok}], "failed", "skipped"} where
+ok is True/False/None(=skipped). The bench rungs gate on "pass" and
+tests/test_bench_quick.py asserts it, so the BENCH_r* series tracks tails,
+not just pods/s.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+# The NorthStar_100k_10k_endtoend gate. Ceilings are sized for the FULL
+# 100k-pod run on the noisy 2-core CI rig (one batch, so per-stage p99 ==
+# that batch's wall share) with ~4x headroom over BENCH_r07 — the gate
+# catches order-of-magnitude tail regressions (a stalled chunk, a retrace,
+# a serialization bug), not scheduling jitter.
+NORTH_STAR_SLO: Dict = {
+    "stage_p99_ms": {
+        "ingest": 6000.0,
+        "queue_add": 4000.0,
+        "pop": 2000.0,
+        "tensorize": 3000.0,
+        "build_pod_batch": 5000.0,
+        "solve": 8000.0,
+        "assume": 6000.0,
+        "dispatch": 2000.0,
+        "bind": 8000.0,
+        "bind_wait": 8000.0,
+    },
+    "submit_to_bound_p99_s": 30.0,
+    "solver_compiles": 0,
+    "instrumentation_frac": 0.02,
+}
+
+# The ChaosChurn_20k gate: under injected solver faults, bind faults, a
+# worker kill, and a mid-run resync, the p99 is SUPPOSED to show an excursion
+# (breaker cooldown + backoff tiers) — the SLO asserts the excursion stays
+# BOUNDED and the tracer keeps working, not that chaos is latency-free.
+CHAOS_SLO: Dict = {
+    "submit_to_bound_p99_s": 120.0,
+}
+
+# what `ktl sched slo` checks when no --spec file is given
+DEFAULT_SLO = NORTH_STAR_SLO
+
+KNOWN_SPEC_KEYS = frozenset((
+    "stage_p99_ms", "submit_to_bound_p99_s", "solver_compiles",
+    "instrumentation_frac"))
+
+
+def load_slo_spec(path: str) -> Dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def _check(name: str, limit, actual) -> Dict:
+    ok: Optional[bool]
+    if actual is None:
+        ok = None  # data unavailable: SKIP (reported, never silently passed)
+    else:
+        ok = actual <= limit  # every spec value is a ceiling
+    return {"name": name, "limit": limit,
+            "actual": round(actual, 6) if isinstance(actual, float) else actual,
+            "ok": ok}
+
+
+def evaluate_slo(stats: Dict, spec: Dict,
+                 extra: Optional[Dict] = None) -> Dict:
+    """Evaluate one scheduler's stats payload against a spec.
+
+    stats: sched_stats()-shaped — needs "stages" (stage table rows with
+    p99_ms) for stage ceilings and "latency" (podtrace latency_stats) for the
+    submit->bound ceiling. extra: out-of-band numbers only the harness knows
+    (solver_compiles, instrumentation_frac)."""
+    extra = extra or {}
+    checks: List[Dict] = []
+    # a typoed spec key ("stage_p99ms") must not yield a vacuous PASS that
+    # checks nothing: unknown keys are FAILING checks, visible in the table
+    for key in sorted(set(spec) - KNOWN_SPEC_KEYS):
+        checks.append({"name": f"unknown_spec_key:{key}", "limit": None,
+                       "actual": spec[key], "ok": False})
+    stages = stats.get("stages") or {}
+    for stage, limit in sorted((spec.get("stage_p99_ms") or {}).items()):
+        row = stages.get(stage) or {}
+        checks.append(_check(f"stage_p99_ms:{stage}", limit,
+                             row.get("p99_ms")))
+    if "submit_to_bound_p99_s" in spec:
+        lat = stats.get("latency") or {}
+        checks.append(_check("submit_to_bound_p99_s",
+                             spec["submit_to_bound_p99_s"],
+                             lat.get("p99_s")))
+    if "solver_compiles" in spec:
+        checks.append(_check("solver_compiles", spec["solver_compiles"],
+                             extra.get("solver_compiles")))
+    if "instrumentation_frac" in spec:
+        checks.append(_check("instrumentation_frac",
+                             spec["instrumentation_frac"],
+                             extra.get("instrumentation_frac")))
+    failed = [c["name"] for c in checks if c["ok"] is False]
+    skipped = [c["name"] for c in checks if c["ok"] is None]
+    return {"pass": not failed, "failed": failed, "skipped": skipped,
+            "checks": checks}

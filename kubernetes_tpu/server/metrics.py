@@ -69,6 +69,7 @@ class Histogram:
         self.name = name
         self.help = help_
         self.buckets = tuple(buckets)
+        self._bucket_arr = None  # lazy numpy mirror for bucket_counts
         self._counts = [0] * (len(self.buckets) + 1)
         self._sum = 0.0
         self._total = 0
@@ -83,6 +84,71 @@ class Histogram:
                     self._counts[i] += 1
                     return
             self._counts[-1] += 1
+
+    def bucket_counts(self, values):
+        """One numpy bucket pass over a chunk of samples WITHOUT mutating
+        this histogram: (counts, sum, n) for observe_counts(), so a single
+        pass can feed several histograms with identical bucket layouts (the
+        tracer's private latency histogram + the process-wide Prometheus
+        series — the 100k-pod window must not pay the bucket pass twice).
+        Bucket semantics identical to observe(): value <= bound counts into
+        that bucket, overflow into +Inf. None for an empty chunk."""
+        import numpy as np
+
+        arr = np.asarray(values, dtype=np.float64)
+        if arr.size == 0:
+            return None
+        ba = self._bucket_arr
+        if ba is None:
+            ba = self._bucket_arr = np.asarray(self.buckets,
+                                               dtype=np.float64)
+        idx = np.searchsorted(ba, arr, side="left")
+        counts = np.bincount(idx, minlength=len(self.buckets) + 1).tolist()
+        return counts, float(arr.sum()), int(arr.size)
+
+    def observe_counts(self, counts, total_sum: float, n: int) -> None:
+        """Merge a bucket_counts() result — ONE lock acquisition per chunk.
+        The caller guarantees the bucket layout matches."""
+        with self._lock:
+            for i, c in enumerate(counts):
+                if c:
+                    self._counts[i] += c
+            self._sum += total_sum
+            self._total += n
+
+    def observe_many(self, values) -> None:
+        """Bulk observation: one numpy bucket pass + ONE lock acquisition
+        for a whole chunk of samples."""
+        res = self.bucket_counts(values)
+        if res is not None:
+            self.observe_counts(*res)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile estimate (the histogram_quantile()
+        formula: find the bucket holding rank q*count, interpolate linearly
+        inside it). Error is bounded by the bucket width — pick log-spaced
+        buckets sized to the tolerance the consumer needs. Values landing in
+        the +Inf bucket clamp to the highest finite bound (the PromQL
+        convention). None when empty."""
+        with self._lock:
+            counts = list(self._counts)
+            total = self._total
+        if total == 0:
+            return None
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if not c:
+                continue
+            cum += c
+            if cum >= rank:
+                if i >= len(self.buckets):
+                    return float(self.buckets[-1]) if self.buckets else 0.0
+                lo = float(self.buckets[i - 1]) if i else 0.0
+                hi = float(self.buckets[i])
+                frac = (rank - (cum - c)) / c
+                return lo + (hi - lo) * max(0.0, min(1.0, frac))
+        return float(self.buckets[-1]) if self.buckets else 0.0
 
     def render(self, label: str = "") -> List[str]:
         """Sample lines; `label` is a pre-rendered 'k="v"' prefix merged into
@@ -149,6 +215,37 @@ class LabeledHistogram:
         return out
 
 
+class GaugeFunc:
+    """A gauge whose samples come from a callback at read/render time (the
+    reference's GaugeFunc / custom collector shape) — for state that lives in
+    another component and would be stale or hot-path-expensive to push (the
+    per-subscriber watch queue lengths). The callback returns
+    [(labels dict, value), ...]; a raising callback renders nothing rather
+    than corrupting the whole /metrics page."""
+
+    def __init__(self, name: str, help_: str = "", fn=None):
+        self.name = name
+        self.help = help_
+        self._fn = fn
+
+    def samples(self) -> List[Tuple[Dict[str, str], float]]:
+        if self._fn is None:
+            return []
+        try:
+            return list(self._fn())
+        except Exception:
+            return []
+
+    def render(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        for labels, v in self.samples():
+            lbl = _render_labels(tuple(sorted(labels.items())))
+            out.append(f"{self.name}{{{lbl}}} {v}" if lbl
+                       else f"{self.name} {v}")
+        return out
+
+
 class Registry:
     def __init__(self):
         self._metrics: List = []
@@ -159,6 +256,9 @@ class Registry:
 
     def gauge(self, name: str, help_: str = "") -> Gauge:
         return self._add(Gauge(name, help_))
+
+    def gauge_func(self, name: str, help_: str = "", fn=None) -> GaugeFunc:
+        return self._add(GaugeFunc(name, help_, fn))
 
     def histogram(self, name: str, help_: str = "", buckets=Histogram.DEFAULT_BUCKETS) -> Histogram:
         return self._add(Histogram(name, help_, buckets))
@@ -214,6 +314,78 @@ batch_retries_total = global_registry.counter(
     "scheduler_batch_retries_total",
     "Pods requeued (stage=solve/assume/dispatch/worker) or chunks retried "
     "(stage=bind) on transient pipeline failures, by stage and reason")
+
+# pod-latency observability (ISSUE 7): queue depth per tier + oldest-pending
+# age (updated per pump, never per pod — scheduler/batch.py throttles the
+# depth scan), and the aggregate submit->bound latency of EVERY pod, observed
+# in bulk per bind chunk from batch-boundary timestamps (scheduler/podtrace.py)
+queue_depth = global_registry.gauge(
+    "scheduler_queue_depth",
+    "Queued pods by tier (active / backoff / unschedulable / gang_staged)")
+queue_oldest_age = global_registry.gauge(
+    "scheduler_queue_oldest_pending_age_seconds",
+    "Age of the oldest pod still waiting in any queue tier")
+# log-spaced out to 5 minutes: submit->bound spans queue wait + solve + bind,
+# and a chaos/backoff excursion must land in a finite bucket, not +Inf
+E2E_LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                       0.5, 1, 2.5, 5, 10, 30, 60, 120, 300)
+pod_e2e_latency = global_registry.histogram(
+    "scheduler_pod_submit_to_bound_seconds",
+    "Pod latency from queue admission to committed bind",
+    buckets=E2E_LATENCY_BUCKETS)
+
+# store commit latency (ISSUE 7 satellite): one observation per bind_many
+# call (a bind-worker chunk) around the two-phase commit — the before/after
+# metric for the native-port work on the commit loop
+store_bind_many_duration = global_registry.histogram(
+    "store_bind_many_duration_seconds",
+    "store.bind_many two-phase commit latency per chunk",
+    buckets=STAGE_BUCKETS)
+
+# watch-bus telemetry (ISSUE 7 satellite): dropped deliveries were silent —
+# a chaos watch.deliver drop or a slow-watcher overflow eviction is now
+# countable from /metrics; queue lengths come from live stores at render time
+store_watch_dropped = global_registry.counter(
+    "store_watch_dropped_deliveries_total",
+    "Watch deliveries dropped, by reason (chaos injection / overflow "
+    "eviction) and kind")
+
+_watch_sources: List = []  # weakrefs to APIStores with live watchers
+_watch_sources_lock = threading.Lock()
+
+
+def register_watch_source(ref) -> None:
+    """Register a weakref to an APIStore so the subscriber-queue-length
+    GaugeFunc can read its watcher list at render time (store/store.py calls
+    this on the first watch() subscription)."""
+    with _watch_sources_lock:
+        if len(_watch_sources) > 64:  # prune dead stores opportunistically
+            _watch_sources[:] = [r for r in _watch_sources if r() is not None]
+        _watch_sources.append(ref)
+
+
+def _watch_queue_samples():
+    out = []
+    with _watch_sources_lock:
+        refs = list(_watch_sources)
+    for ref in refs:
+        store = ref()
+        if store is None:
+            continue
+        try:
+            tel = store.watch_telemetry()
+        except Exception:
+            continue
+        for sub in tel["subscribers"]:
+            out.append(({"subscriber": sub["id"]},
+                        float(sub["queue_length"])))
+    return out
+
+
+store_watch_queue_length = global_registry.gauge_func(
+    "store_watch_subscriber_queue_length",
+    "Buffered events per live watch subscriber (read at scrape time)",
+    fn=_watch_queue_samples)
 
 # gang scheduling observability (ROADMAP gang-pipeline open items)
 gang_staged = global_registry.gauge(

@@ -392,7 +392,7 @@ class _Handler(BaseHTTPRequestHandler):
     _FC_VERBS = {"GET": "get", "POST": "create", "PUT": "update",
                  "PATCH": "patch", "DELETE": "delete"}
     _FC_EXEMPT_PATHS = ("/healthz", "/readyz", "/metrics", "/version",
-                        "/configz", "/debug/schedstats")
+                        "/configz", "/debug/schedstats", "/debug/schedtrace")
 
     def _flow_dispatch(self, orig: "Callable[[], None]") -> None:
         """Seat-accounted dispatch. Health/metrics always pass (the probe
@@ -650,6 +650,19 @@ class _Handler(BaseHTTPRequestHandler):
             from ..scheduler.flightrec import schedstats_snapshot
 
             body = json.dumps(schedstats_snapshot(), default=str).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if url.path == "/debug/schedtrace":
+            # sampled pod lifecycle spans (scheduler/podtrace.py): the
+            # per-pod latency view `ktl sched trace` renders — same
+            # read-only debug family as /debug/schedstats
+            from ..scheduler.flightrec import schedtrace_snapshot
+
+            body = json.dumps(schedtrace_snapshot(), default=str).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

@@ -56,8 +56,11 @@ both forms, so a consumer mutating either is still caught.
 from __future__ import annotations
 
 import copy
+import itertools
 import queue
 import threading
+import time
+import weakref
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -68,6 +71,23 @@ ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
 BOOKMARK = "BOOKMARK"
+
+_watch_seq = itertools.count()
+
+_metrics_mod = None
+
+
+def _metrics():
+    """kubernetes_tpu.server.metrics, imported on first use — the store
+    cannot import the server package at module load (rest.py imports the
+    store), and the watch/bind telemetry paths (ISSUE 7) only need it once
+    something is actually observed."""
+    global _metrics_mod
+    if _metrics_mod is None:
+        from ..server import metrics as m
+
+        _metrics_mod = m
+    return _metrics_mod
 
 
 @dataclass(frozen=True)
@@ -260,6 +280,9 @@ class Watch:
                  maxsize: int = DEFAULT_MAXSIZE, coalesce: bool = False):
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize=maxsize or 0)
         self._store = store
+        # stable subscriber id for the per-subscriber queue-length gauge
+        # (store_watch_subscriber_queue_length) and watch_telemetry()
+        self.id = f"w{next(_watch_seq)}"
         # kind: None = all kinds; a str = one kind; a set/tuple = several
         # (components subscribe to exactly what they handle, so high-volume
         # kinds they ignore — e.g. events — never fill their buffers)
@@ -282,7 +305,12 @@ class Watch:
             return
         if _chaos.ACTIVE is not None and _chaos.ACTIVE.should_drop(
                 "watch.deliver", ev.kind):
-            return  # injected delivery drop (drop-only site: lock held)
+            # injected delivery drop (drop-only site: lock held). Counted
+            # (ISSUE 7 satellite): a dropped delivery was invisible from
+            # /metrics, so chaos runs couldn't prove the resync actually
+            # recovered anything
+            self._store._note_watch_drop("chaos", ev.kind)
+            return
         if self._kinds is None or ev.kind in self._kinds:
             try:
                 self._q.put_nowait(ev)
@@ -302,7 +330,10 @@ class Watch:
             return
         if _chaos.ACTIVE is not None and _chaos.ACTIVE.should_drop(
                 "watch.deliver", cev.kind):
-            return  # injected delivery drop (drop-only site: lock held)
+            # injected drop of a whole coalesced batch — counted once (the
+            # unit dropped is the delivery, matching the injection site)
+            self._store._note_watch_drop("chaos", cev.kind)
+            return
         if self._kinds is None or cev.kind in self._kinds:
             try:
                 self._q.put_nowait(cev)
@@ -319,6 +350,7 @@ class Watch:
         # event to make room for the end-of-stream sentinel (the
         # stream is void anyway — the consumer must relist)
         self.terminated = True
+        self._store._note_watch_drop("overflow", "")
         self._store._unsubscribe(self)
         try:
             self._q.get_nowait()
@@ -498,6 +530,12 @@ class APIStore:
         self._history_floor_rv = 0
         self._watchers: List[Watch] = []
         self._deep_copy = deep_copy_on_write
+        # watch-bus telemetry (ISSUE 7 satellite): per-reason dropped
+        # delivery counts (chaos injection, overflow eviction) kept as plain
+        # ints here (the drop sites run under the store lock) and mirrored
+        # into store_watch_dropped_deliveries_total
+        self._watch_drops: Dict[str, int] = {}
+        self._watch_metrics_registered = False
 
     # -- helpers ---------------------------------------------------------------
 
@@ -842,7 +880,17 @@ class APIStore:
                 # must see fully private event objects, same as live delivery
                 w._deliver(ev if coalesce else self._materialize_event(ev))
             self._watchers.append(w)
-            return w
+            # first successful subscription: expose this store's subscribers
+            # to the render-time queue-length gauge (weakref — a collected
+            # store silently drops out). Flag flipped under the lock so two
+            # concurrent first watch() calls can't both register (duplicate
+            # series would break /metrics scrapers); the registry call
+            # itself stays outside the critical section (LK002).
+            register = not self._watch_metrics_registered
+            self._watch_metrics_registered = True
+        if register:
+            _metrics().register_watch_source(weakref.ref(self))
+        return w
 
     def _unsubscribe(self, w: Watch) -> None:
         with self._lock:
@@ -850,6 +898,30 @@ class APIStore:
                 self._watchers.remove(w)
             except ValueError:
                 pass
+
+    def _note_watch_drop(self, reason: str, kind: str) -> None:
+        """Count one dropped watch delivery (chaos injection or overflow
+        eviction) — rare by construction, so the metrics import/inc on this
+        path costs nothing in the steady state."""
+        self._watch_drops[reason] = self._watch_drops.get(reason, 0) + 1
+        _metrics().store_watch_dropped.inc(reason=reason, kind=kind)
+
+    def watch_telemetry(self) -> Dict:
+        """Per-subscriber watch-bus state (ISSUE 7 satellite): live
+        subscriber ids with their buffered-event counts, plus the dropped-
+        delivery counters — what the subscriber-queue-length GaugeFunc and
+        the watch-fanout bench rung read."""
+        with self._lock:
+            watchers = list(self._watchers)
+            drops = dict(self._watch_drops)
+        return {
+            "subscribers": [{"id": w.id,
+                             "queue_length": w._q.qsize(),
+                             "coalesce": w.coalesce,
+                             "terminated": w.terminated}
+                            for w in watchers],
+            "dropped": drops,
+        }
 
     # -- scheduling-specific transactional surfaces ----------------------------
 
@@ -905,6 +977,11 @@ class APIStore:
         the rows, and emits lazy events sharing the stored objects. Rows
         that changed between the phases (a concurrent store.bind from the
         serial fallback path) are re-validated by stored-object identity."""
+        # commit-latency histogram (ISSUE 7 satellite): ONE observation per
+        # bind_many call — a bind-worker chunk — covering both phases. The
+        # before/after metric for the direction-1 native commit-loop port.
+        # Observed on success returns only (an injected raise never committed)
+        t0 = time.perf_counter()
         if _chaos.ACTIVE is not None:
             # injected transient store failure (raises/delays BEFORE any
             # lock): the caller's retry/backoff is what the chaos tests prove
@@ -928,6 +1005,8 @@ class APIStore:
                 prepared.append((key, pod, new, node_name))
         bound = 0
         if not prepared:
+            _metrics().store_bind_many_duration.observe(
+                time.perf_counter() - t0)
             return bound, errors
         events: List[Event] = []
         # mode decided once per batch; rv and the event constructor live in
@@ -970,6 +1049,7 @@ class APIStore:
                     bound += 1
                 self._rv = rv
                 self._emit_batch(MODIFIED, "pods", events, origin)
+        _metrics().store_bind_many_duration.observe(time.perf_counter() - t0)
         return bound, errors
 
     def update_pod_status(self, namespace: str, name: str, mutate_status: Callable[[Any], None]) -> Any:

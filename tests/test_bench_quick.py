@@ -49,6 +49,27 @@ def test_bench_quick_runs_and_emits_json():
     assert 0.3 * wall <= serial_sum <= 1.2 * wall, (serial_sum, wall, stages)
     assert ns["instrumentation_s"] <= 0.02 * wall, (
         ns["instrumentation_s"], wall)
+    # pod-latency observability (ISSUE 7): the rung emits per-stage p50/p99
+    # and an all-pods submit->bound distribution, and the declarative SLO
+    # gate (scheduler/slo.py NORTH_STAR_SLO) passes — tails are now gated,
+    # not just throughput
+    assert ns["stages_p99_ms"].get("solve", 0) > 0, ns["stages_p99_ms"]
+    p50 = ns["stages_p50_ms"].get("solve")
+    p99 = ns["stages_p99_ms"].get("solve")
+    assert p50 is not None and p99 >= p50, (p50, p99)
+    lat = ns["latency"]
+    # every bound pod is observed exactly once (batch-boundary timestamps)
+    assert lat["count"] == ns["pods"], lat
+    assert lat["p50_s"] > 0 and lat["p99_s"] >= lat["p50_s"], lat
+    slo = ns["slo"]
+    assert slo["pass"] is True, slo
+    # the out-of-band checks really ran (not silently skipped)
+    assert "solver_compiles" not in slo["skipped"], slo
+    assert "instrumentation_frac" not in slo["skipped"], slo
+    # sampled lifecycle spans: the tracer sampled pods and completed every
+    # span it kept (all pods bound in this rung)
+    tr = ns["trace"]
+    assert tr["spans"] > 0 and tr["complete"] == tr["spans"], tr
     basic = workloads.get("SchedulingBasic", {})
     assert "error" not in basic, basic
     # the bind-commit micro-rung (ISSUE 4): pods/s through store.bind_many
@@ -86,6 +107,16 @@ def test_bench_quick_runs_and_emits_json():
     assert cc["breaker_state"] == "closed", cc
     assert cc["bind_worker_restarts"] >= 1, cc
     assert cc["resynced"] is True, cc
+    # ISSUE 7: the breaker trip shows as a BOUNDED p99 excursion in the
+    # trace (the faulted/backoff pods are the tail, under the chaos SLO
+    # ceiling) while every sampled span still completed — chaos must be
+    # visible in the latency distribution, never break the tracer
+    assert cc["trace_ok"] is True, cc
+    assert cc["trace"]["spans"] > 0, cc
+    assert cc["trace"]["complete"] == cc["trace"]["spans"], cc
+    assert cc["latency"]["count"] > 0, cc
+    assert cc["latency"]["p99_s"] >= cc["latency"]["p50_s"] > 0, cc
+    assert cc["slo"]["pass"] is True, cc
     # injector-DISABLED overhead budget (<1% on the NorthStar rung): the
     # rung measures the per-check cost of the disabled guard directly; the
     # NorthStar path runs a handful of checks per BATCH/chunk/delivery,

@@ -4,6 +4,7 @@ supervision/liveness, crash resync from the store, the pod-conservation
 checker, and the node-death reference failure chain (node_lifecycle ->
 tainteviction -> workload controller -> batched scheduler)."""
 
+import threading
 import time
 from collections import deque
 
@@ -414,6 +415,40 @@ class TestBindWorkerSupervision:
         sched._drain_bind_results()
         assert sched.bind_worker_restarts >= 1
         assert _drive(store, sched, 3) == 3
+
+    def test_enqueue_after_kill_recovers_estate_before_replacement(self):
+        """ISSUE 7 regression (found by the first FULL-size ChaosChurn_20k
+        run): when the ENQUEUE path observed the dead worker first,
+        _ensure_bind_worker started a replacement without recovering the
+        estate — the new worker's first cycle overwrote the shared
+        _bind_inflight record, the dead worker's task_done debt leaked, and
+        flush_binds wedged forever (restarts stayed 0, erasing the
+        evidence). The replacement must settle the estate FIRST."""
+        store, sched = _sched()
+        store.create_many("pods", _pods(5, prefix="eq"))
+        sched.pump_events()
+        fi.arm([FaultPlan("bind.worker", "kill")])
+        assert sched.schedule_batch(timeout=0.0) == 5
+        for _ in range(200):
+            w = sched._bind_worker
+            if w is not None and not w.is_alive():
+                break
+            time.sleep(0.005)
+        assert not sched._bind_worker.is_alive()
+        fi.disarm()
+        # the enqueue path wins the race against the liveness drain: a new
+        # chunk is dispatched before any _drain_bind_results runs
+        sched._bind_q.put([])
+        sched._ensure_bind_worker()
+        assert sched.bind_worker_restarts >= 1  # estate settled, counted
+        done = threading.Event()
+        threading.Thread(target=lambda: (sched.flush_binds(), done.set()),
+                         daemon=True).start()
+        assert done.wait(10.0), \
+            "flush_binds wedged on leaked task_done debt"
+        assert _drive(store, sched, 5) == 5
+        assert_pod_conservation(store, sched,
+                                [f"default/eq-{i}" for i in range(5)])
 
 
 # -- crash resync ----------------------------------------------------------

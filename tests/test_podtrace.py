@@ -1,0 +1,802 @@
+"""Sampled pod lifecycle tracing + latency SLOs (ISSUE 7): reservoir
+sampling correctness, span completeness under churn/bind retries, tracer
+on/off placement parity (both watch_coalesce modes, mutation detector
+force-enabled — the PR 4 pattern), percentile math on known distributions,
+the self-time accounting contract, the queue/watch/store telemetry
+satellites, and the /debug/schedtrace + `ktl sched trace|slo` surfaces."""
+
+import io
+import json
+import urllib.request
+from contextlib import redirect_stdout
+from types import SimpleNamespace
+
+import pytest
+
+from kubernetes_tpu.chaos import faultinject as fi
+from kubernetes_tpu.chaos.faultinject import FaultPlan
+from kubernetes_tpu.scheduler import Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.flightrec import (FlightRecorder,
+                                                schedtrace_snapshot)
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.scheduler.podtrace import SPAN_STAGES, PodTracer
+from kubernetes_tpu.scheduler.queue import QueuedPodInfo, SchedulingQueue
+from kubernetes_tpu.scheduler.slo import (CHAOS_SLO, NORTH_STAR_SLO,
+                                          evaluate_slo, load_slo_spec)
+from kubernetes_tpu.server import metrics as m
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import MakeNode, MakePod, mutation_detector_guard
+from kubernetes_tpu.utils import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    """The PR 4 CI pattern: every store this module builds runs with the
+    mutation detector FORCE-ENABLED and checked at teardown — the tracer
+    reads QueuedPodInfos and store events, and must never mutate either."""
+    yield from mutation_detector_guard(monkeypatch)
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    fi.disarm()
+    yield
+    fi.disarm()
+
+
+def _nodes(n, cpu="8", mem="32Gi"):
+    return [MakeNode(f"node-{i}").capacity(
+        {"cpu": cpu, "memory": mem, "pods": "110"}).obj() for i in range(n)]
+
+
+def _pods(n, prefix="p", cpu="100m", mem="128Mi"):
+    return [MakePod(f"{prefix}-{i}").req({"cpu": cpu, "memory": mem}).obj()
+            for i in range(n)]
+
+
+def _sched(store, **kw):
+    kw.setdefault("batch_size", 1024)
+    kw.setdefault("solver", "exact")
+    kw.setdefault("pipeline_binds", False)
+    sched = BatchScheduler(store, Framework(default_plugins()), **kw)
+    sched.sync()
+    return sched
+
+
+def _placements(store):
+    return {p.metadata.name: p.spec.node_name
+            for p in store.list("pods")[0] if p.spec.node_name}
+
+
+def _fake_qps(n, ts=100.0, prefix="s"):
+    """Lightweight QueuedPodInfo stand-ins: the tracer touches only
+    .timestamp/.submit_ts/.trace_span and .pod.key."""
+    return [SimpleNamespace(timestamp=ts, submit_ts=ts, trace_span=None,
+                            pod=SimpleNamespace(key=f"default/{prefix}-{i}"))
+            for i in range(n)]
+
+
+# -- reservoir sampling (Algorithm L) -------------------------------------------
+
+
+class TestReservoirSampling:
+    def test_sample_bounded_at_k_and_drawn_from_stream(self):
+        tr = PodTracer(sample_k=8, rng_seed=7)
+        qps = _fake_qps(5000)
+        tr.admitted(qps)
+        keys = {qp.pod.key for qp in qps}
+        assert tr.live_incomplete == 8
+        assert len(tr._sampled) == 8
+        assert tr._sampled <= keys
+        # every sampled pod got a span with the SHARED admission stamp
+        for key, span in tr._live.items():
+            assert span.stamps["enqueue"] == 100.0
+
+    def test_sampling_streams_across_admission_batches(self):
+        tr = PodTracer(sample_k=4, rng_seed=3)
+        for i in range(20):
+            tr.admitted(_fake_qps(50, prefix=f"b{i}"))
+        assert tr.live_incomplete == 4
+        # late batches are represented: Algorithm L keeps sampling the
+        # whole stream, not just the first K arrivals (with this seed at
+        # least one slot comes from a batch after the first)
+        assert any(not k.startswith("default/b0-") for k in tr._sampled)
+
+    def test_late_stream_items_can_displace_early_ones(self):
+        # over many seeded runs the reservoir must not be frozen at the
+        # first K items (that would be a broken jump computation)
+        displaced = 0
+        for seed in range(10):
+            tr = PodTracer(sample_k=4, rng_seed=seed)
+            qps = _fake_qps(400)
+            tr.admitted(qps)
+            first_k = {qp.pod.key for qp in qps[:4]}
+            if tr._sampled - first_k:
+                displaced += 1
+        assert displaced >= 8, displaced
+
+    def test_displaced_unpopped_candidate_leaves_sample(self):
+        tr = PodTracer(sample_k=2, rng_seed=1)
+        tr.admitted(_fake_qps(2, prefix="a"))
+        assert tr.live_incomplete == 2
+        # a big follow-up batch displaces at least one never-popped
+        # candidate; its span disappears rather than leaking
+        tr.admitted(_fake_qps(500, prefix="b"))
+        assert tr.live_incomplete == 2
+
+    def test_window_rotation_evicts_unpopped_and_caps_live(self):
+        clock = FakeClock(100.0)
+        tr = PodTracer(clock=clock, sample_k=4, window_s=30.0, rng_seed=5)
+        # each window: admit 4, POP them (live spans survive rotation)
+        for w in range(10):
+            qps = _fake_qps(4, ts=clock.now(), prefix=f"w{w}")
+            tr.admitted(qps)
+            tr.batch_popped(qps)
+            clock.step(31.0)
+        assert tr.windows_rotated >= 9
+        cap = tr.LIVE_CAP_FACTOR * tr.sample_k
+        assert tr.live_incomplete <= cap
+        assert tr.evicted_incomplete > 0  # counted, never silent
+
+    def test_disabled_tracer_is_inert(self):
+        tr = PodTracer(enabled=False)
+        qps = _fake_qps(100)
+        tr.admitted(qps)
+        tr.batch_popped(qps)
+        tr.chunk_bound([(qp, "n", None) for qp in qps], 1.0, 1.0)
+        assert tr.live_incomplete == 0
+        assert tr.completed_total == 0
+        assert tr.latency_stats()["count"] == 0
+
+
+# -- lifecycle spans end-to-end -------------------------------------------------
+
+
+class TestLifecycleSpans:
+    def test_unit_pipeline_produces_ordered_complete_span(self):
+        clock = FakeClock(10.0)
+        tr = PodTracer(clock=clock, sample_k=64, rng_seed=0)
+        qps = _fake_qps(10, ts=10.0)
+        tr.admitted(qps)
+        clock.step(0.5)
+        tr.batch_popped(qps)
+        for stage in ("solve", "assume", "dispatch"):
+            clock.step(0.5)
+            tr.batch_stage(stage)
+        clock.step(0.5)
+        t_commit = clock.now()
+        clock.step(0.5)
+        tr.chunk_bound([(qp, "node-0", None) for qp in qps],
+                       t_commit, clock.now())
+        assert tr.completed_total == 10
+        assert tr.live_incomplete == 0
+        snap = tr.snapshot()
+        for sp in snap["spans"]:
+            assert sp["complete"] is True
+            offs = sp["stamps_ms"]
+            assert list(offs) == list(SPAN_STAGES)  # ordered, all present
+            vals = [offs[s] for s in SPAN_STAGES]
+            assert vals == sorted(vals) and vals[0] == 0.0
+            assert sp["submit_to_bound_ms"] == offs["bind_confirmed"]
+        # ALL pods hit the latency histogram, sampled or not
+        assert snap["latency"]["count"] == 10
+
+    def test_failed_chunk_pods_excluded_until_their_retry(self):
+        clock = FakeClock(0.0)
+        tr = PodTracer(clock=clock, sample_k=64, rng_seed=0)
+        qps = _fake_qps(4)
+        for qp in qps:
+            qp.timestamp = qp.submit_ts = 0.0
+        tr.admitted(qps)
+        tr.batch_popped(qps)
+        bad = qps[0].pod.key
+        clock.step(1.0)
+        tr.chunk_bound([(qp, "n", None) for qp in qps], clock.now(),
+                       clock.now(), errkeys=frozenset([bad]))
+        assert tr.latency_stats()["count"] == 3
+        assert tr.completed_total == 3
+        # the failed pod's span is still live and completes on the retry
+        assert tr.live_incomplete == 1
+        tr.batch_popped([qps[0]])  # requeued attempt pops again
+        clock.step(4.0)
+        tr.chunk_bound([(qps[0], "n", None)], clock.now(), clock.now())
+        assert tr.completed_total == 4 and tr.live_incomplete == 0
+        done = [sp for sp in tr.snapshot()["spans"] if sp["pod"] == bad]
+        assert done[-1]["pops"] == 2
+        assert done[-1]["complete"] is True
+
+    def test_serial_bind_settles_pending_pop_stamps_first(self):
+        # pod_bound (the serial fallback) completes the span, which removes
+        # it from the sampled set — a deferred pop op settling later would
+        # be staleness-guarded away, leaving a completed span with pops=0
+        clock = FakeClock(10.0)
+        tr = PodTracer(clock=clock, sample_k=4, rng_seed=0)
+        qps = _fake_qps(4, ts=10.0)
+        tr.admitted(qps)
+        tr.batch_popped(qps)  # deferred: still in the op FIFO
+        clock.step(1.0)
+        for qp in qps:
+            tr.pod_bound(qp, clock.now())
+        assert tr.completed_total == 4
+        for sp in tr.snapshot()["spans"]:
+            assert sp["pops"] == 1
+            assert "pop" in sp["stamps_ms"]
+
+    def test_bound_pods_in_reservoir_do_not_resurrect_as_zombies(self):
+        # a completed pod's QueuedPodInfo keeps its reservoir slot (it IS a
+        # sampled stream item) — but a later admission wave must not mint it
+        # a fresh incomplete span that can never complete
+        clock = FakeClock(10.0)
+        tr = PodTracer(clock=clock, sample_k=4, rng_seed=1)
+        wave1 = _fake_qps(4, ts=10.0, prefix="a")
+        tr.admitted(wave1)
+        tr.batch_popped(wave1)
+        clock.step(1.0)
+        tr.chunk_bound([(qp, "n", None) for qp in wave1],
+                       clock.now(), clock.now())
+        assert tr.completed_total == 4 and tr.live_incomplete == 0
+        tr.admitted(_fake_qps(500, ts=clock.now(), prefix="b"))
+        bound = {qp.pod.key for qp in wave1}
+        assert not (set(tr._live) & bound), "zombie spans for bound pods"
+        assert tr.completed_total == 4
+        snap = tr.snapshot()
+        assert all(sp["complete"] for sp in snap["spans"]
+                   if sp["pod"] in bound)
+
+    def test_live_scheduler_every_sampled_span_completes(self):
+        store = APIStore()
+        for n in _nodes(6):
+            store.create("nodes", n)
+        sched = _sched(store, trace_sample_k=16)
+        store.create_many("pods", _pods(60), consume=True)
+        sched.run_until_idle()
+        assert sched.scheduled_count == 60
+        snap = sched.podtrace.snapshot()
+        assert 0 < len(snap["spans"]) <= 16
+        assert all(sp["complete"] for sp in snap["spans"])
+        assert snap["live_incomplete"] == 0
+        # the aggregate distribution covers EVERY pod, not just the sample
+        assert snap["latency"]["count"] == 60
+        stats = sched.sched_stats()
+        assert stats["latency"]["count"] == 60
+        assert stats["trace"]["completed"] == len(snap["spans"])
+
+    def test_spans_complete_under_churn_and_bind_retries(self):
+        """Sampling correctness under faults: injected transient bind_many
+        failures (absorbed by the per-chunk retry) and a solver fault
+        (breaker requeue through the backoff tier) must still leave every
+        surviving sampled span complete once the cluster quiesces."""
+        import time as _time
+
+        store = APIStore()
+        for n in _nodes(6):
+            store.create("nodes", n)
+        sched = _sched(store, trace_sample_k=32, bind_retries=3,
+                       bind_retry_base_s=0.001, breaker_threshold=3)
+        fi.arm([FaultPlan("store.bind_many", "fail", count=2),
+                FaultPlan("solver.solve", "fail", count=1)])
+        store.create_many("pods", _pods(40, prefix="ch"), consume=True)
+        for _ in range(100):
+            sched.run_until_idle()
+            sched.queue.flush_backoff_completed()
+            if sched.scheduled_count == 40:
+                break
+            _time.sleep(0.01)
+        assert sched.scheduled_count == 40
+        snap = sched.podtrace.snapshot()
+        assert len(snap["spans"]) > 0
+        assert all(sp["complete"] for sp in snap["spans"])
+        assert snap["live_incomplete"] == 0
+        assert snap["latency"]["count"] == 40
+        # the solver-faulted batch re-popped: visible as pops > 1 somewhere
+        assert max(sp["pops"] for sp in snap["spans"]) >= 2
+
+    def test_resync_drops_live_spans_counted(self):
+        store = APIStore()
+        for n in _nodes(2):
+            store.create("nodes", n)
+        sched = _sched(store, trace_sample_k=8)
+        qps = _fake_qps(8)
+        sched.podtrace.admitted(qps)
+        sched.podtrace.batch_popped(qps)
+        assert sched.podtrace.live_incomplete == 8
+        sched.resync_from_store()
+        assert sched.podtrace.live_incomplete == 0
+        assert sched.podtrace.evicted_incomplete == 8
+
+    def test_relist_preserves_live_spans(self):
+        # a routine watch-eviction relist KEEPS the queue's QueuedPodInfos
+        # (preserve_queue=True), so in-flight spans must survive the rebuild
+        # — not be counted evicted — and still complete when the pods bind
+        store = APIStore()
+        for n in _nodes(3):
+            store.create("nodes", n)
+        sched = _sched(store, trace_sample_k=8)
+        store.create_many("pods", _pods(12, prefix="rl"), consume=True)
+        sched.pump_events()
+        assert sched.podtrace.live_incomplete > 0
+        before = sched.podtrace.live_incomplete
+        sched._relist()
+        assert sched.podtrace.evicted_incomplete == 0
+        assert sched.podtrace.live_incomplete == before
+        sched.run_until_idle()
+        snap = sched.podtrace.snapshot()
+        assert snap["spans"] and all(sp["complete"] for sp in snap["spans"])
+        assert sched.podtrace.live_incomplete == 0
+
+
+# -- parity: the tracer must never steer placement ------------------------------
+
+
+class TestTracerParity:
+    @pytest.mark.parametrize("columnar", [True, False],
+                             ids=["coalesced", "per-pod"])
+    def test_tracer_on_off_identical_placements(self, columnar):
+        def run(pod_trace):
+            store = APIStore()
+            for n in _nodes(6):
+                store.create("nodes", n)
+            sched = _sched(store, columnar=columnar, pod_trace=pod_trace,
+                           solver="exact")
+            store.create_many("pods", [
+                MakePod(f"p-{i}").req({"cpu": "500m", "memory": "1Gi"}).obj()
+                for i in range(40)], consume=True)
+            sched.run_until_idle()
+            return _placements(store), sched
+
+        on_placed, on_sched = run(True)
+        off_placed, off_sched = run(False)
+        assert len(on_placed) == 40
+        # byte-identical assignment maps
+        assert json.dumps(sorted(on_placed.items())) == \
+            json.dumps(sorted(off_placed.items()))
+        assert on_sched.podtrace.completed_total > 0
+        assert off_sched.podtrace.completed_total == 0
+        assert off_sched.sched_stats()["trace"]["enabled"] is False
+
+
+# -- percentile math on known distributions -------------------------------------
+
+
+class TestQuantileMath:
+    def test_histogram_quantile_bucket_interpolation(self):
+        h = m.Histogram("t", buckets=(0.25, 0.5, 1.0))
+        h.observe_many([i / 1000 for i in range(1000)])  # uniform [0, 1)
+        q50 = h.quantile(0.50)
+        q99 = h.quantile(0.99)
+        # error bounded by the bucket width around the true quantile
+        assert 0.25 <= q50 <= 0.55, q50
+        assert 0.90 <= q99 <= 1.0, q99
+        assert q99 >= q50
+
+    def test_quantile_edge_cases(self):
+        h = m.Histogram("t", buckets=(1.0, 2.0))
+        assert h.quantile(0.5) is None  # empty
+        h.observe(50.0)  # lands in +Inf: clamps to the last finite bound
+        assert h.quantile(0.99) == 2.0
+        h2 = m.Histogram("t2", buckets=(1.0,))
+        h2.observe(0.5)
+        assert 0.0 <= h2.quantile(0.5) <= 1.0
+
+    def test_observe_many_matches_sequential_observe(self):
+        vals = [0.001, 0.3, 0.7, 1.5, 2.0, 99.0, 0.25]
+        h_seq = m.Histogram("a", buckets=(0.25, 0.5, 1.0, 2.0))
+        h_blk = m.Histogram("b", buckets=(0.25, 0.5, 1.0, 2.0))
+        for v in vals:
+            h_seq.observe(v)
+        h_blk.observe_many(vals)
+        assert h_seq._counts == h_blk._counts
+        assert h_seq.snapshot() == h_blk.snapshot()
+
+    def test_stage_table_exact_nearest_rank_in_ring(self):
+        fr = FlightRecorder(capacity=16)
+        for ms in (10, 20, 30, 40, 50):
+            fr.record(pods=1, nodes=1, outcome="scheduled", solver="fast",
+                      stages={"solve": ms / 1000}, total_s=ms / 1000)
+        row = fr.stage_table()["solve"]
+        # all 5 observations are still in the ring: EXACT nearest-rank
+        assert row["p50_ms"] == 30.0
+        assert row["p99_ms"] == 50.0
+
+    def test_stage_table_percentiles_survive_ring_eviction(self):
+        fr = FlightRecorder(capacity=2)
+        for ms in (10, 20, 30, 40, 50):
+            fr.record(pods=1, nodes=1, outcome="scheduled", solver="fast",
+                      stages={"solve": ms / 1000}, total_s=ms / 1000)
+        row = fr.stage_table()["solve"]
+        # ring holds 2 of 5: the windowed histogram takes over — estimates
+        # bounded by the ~1.55x bucket ratio, covering ALL 5 batches
+        assert row["batches"] == 5
+        assert row["p50_ms"] is not None and row["p99_ms"] is not None
+        assert 15 <= row["p50_ms"] <= 47, row
+        assert 30 <= row["p99_ms"] <= 80, row
+        assert row["p99_ms"] >= row["p50_ms"]
+
+    def test_tracer_latency_stats_on_known_distribution(self):
+        clock = FakeClock(0.0)
+        tr = PodTracer(clock=clock, sample_k=1, rng_seed=0)
+        qps = _fake_qps(100)
+        for qp in qps:
+            qp.timestamp = qp.submit_ts = 0.0
+        tr.admitted(qps)
+        tr.batch_popped(qps)
+        # bind 90 pods at t=0.1s and 10 stragglers at t=9s: the p99 must
+        # see the stragglers' magnitude, the p50 the bulk's
+        tr.chunk_bound([(qp, "n", None) for qp in qps[:90]], 0.1, 0.1)
+        tr.chunk_bound([(qp, "n", None) for qp in qps[90:]], 9.0, 9.0)
+        stats = tr.latency_stats()
+        assert stats["count"] == 100
+        assert stats["p50_s"] <= 0.25
+        assert stats["p99_s"] >= 5.0
+        assert stats["mean_s"] == pytest.approx((90 * 0.1 + 10 * 9.0) / 100,
+                                                rel=1e-3)
+
+
+# -- self-time accounting --------------------------------------------------------
+
+
+class TestSelfTime:
+    def test_hot_path_taps_are_o1_and_settlement_is_read_side(self):
+        calls = []
+        sink = SimpleNamespace(note_self_time=lambda s: calls.append(s))
+        tr = PodTracer(sample_k=8, rng_seed=0, stat_sink=sink)
+        qps = _fake_qps(200)
+        tr.admitted(qps)  # one tap accounting, never per pod
+        n_admit = len(calls)
+        assert n_admit >= 1
+        # pop/stage/chunk taps are O(1) records: no per-pod pass, no
+        # accounting until settlement
+        tr.batch_popped(qps)
+        tr.batch_stage("solve")
+        tr.chunk_bound([(qp, "n", None) for qp in qps], 1.0, 1.0)
+        assert len(calls) == n_admit
+        assert len(tr._ops) == 3
+        # a read settles everything; the cost is rendering (flush_seconds),
+        # not hot-window budget
+        assert tr.latency_stats()["count"] == 200
+        assert len(tr._ops) == 0
+        assert len(calls) == n_admit
+        assert tr.flush_seconds > 0
+        assert all(s >= 0 for s in calls)
+
+    def test_pending_cap_forces_inline_flush_and_bills_budget(self):
+        calls = []
+        sink = SimpleNamespace(note_self_time=lambda s: calls.append(s))
+        tr = PodTracer(sample_k=4, rng_seed=0, stat_sink=sink)
+        qps = _fake_qps(2000)
+        tr.admitted(qps)
+        tr.batch_popped(qps)
+        n_before = len(calls)
+        for lo in range(0, 2000, 25):  # 80 chunk ops > PENDING_OPS_CAP
+            tr.chunk_bound([(qp, "n", None) for qp in qps[lo:lo + 25]],
+                           1.0, 1.0)
+        assert len(tr._ops) <= tr.PENDING_OPS_CAP + 1
+        assert len(calls) > n_before  # the inline flush billed the sink
+        assert tr.latency_stats()["count"] == 2000  # nothing lost
+
+    def test_admission_cost_is_o_samples_not_o_batch(self):
+        import time as _time
+
+        tr = PodTracer(sample_k=64, rng_seed=0)
+        big = _fake_qps(100_000)
+        tr.admitted(big[:1000])  # fill the reservoir + warm the path
+        t0 = _time.perf_counter()
+        tr.admitted(big[1000:])
+        dt = _time.perf_counter() - t0
+        # 99k admissions must cost O(samples taken), not O(batch): even on
+        # a noisy CI rig the geometric-jump path is well under 60ms (a
+        # per-pod implementation would be ~10x that)
+        assert dt < 0.06, dt
+
+    def test_scheduler_run_stays_inside_recorder_budget_shape(self):
+        # the REAL <2% budget is asserted by tests/test_bench_quick.py on
+        # the bench rung; here: the tracer's accrual lands in the recorder's
+        # self_seconds (shared budget) and is tiny in absolute terms
+        store = APIStore()
+        for n in _nodes(4):
+            store.create("nodes", n)
+        sched = _sched(store)
+        before = sched.flightrec.self_seconds
+        store.create_many("pods", _pods(50), consume=True)
+        sched.run_until_idle()
+        accrued = sched.flightrec.self_seconds - before
+        assert accrued >= 0
+        assert accrued < 0.25, accrued
+
+
+# -- satellite: queue telemetry --------------------------------------------------
+
+
+class TestQueueTelemetry:
+    def test_tiers_and_oldest_age(self):
+        clock = FakeClock(100.0)
+        q = SchedulingQueue(clock=clock)
+        q.add_batch(_pods(3, prefix="qa"))
+        clock.step(5.0)
+        q.add_batch(_pods(2, prefix="qb"))
+        tel = q.telemetry()
+        assert tel["active"] == 5
+        assert tel["backoff"] == 0 and tel["unschedulable"] == 0
+        assert tel["gang_staged"] == 0
+        # oldest age tracks FIRST admission, and keeps growing
+        assert tel["oldest_pending_age_s"] == pytest.approx(5.0)
+        clock.step(10.0)
+        assert q.telemetry()["oldest_pending_age_s"] == pytest.approx(15.0)
+
+    def test_oldest_age_survives_requeue_tiers(self):
+        clock = FakeClock(100.0)
+        q = SchedulingQueue(clock=clock)
+        q.add_batch(_pods(1, prefix="rq"))
+        qp = q.pop(timeout=0.0)
+        clock.step(3.0)
+        q.add_unschedulable(qp)
+        tel = q.telemetry()
+        assert tel["unschedulable"] == 1 and tel["active"] == 0
+        # submit_ts (not the requeue timestamp) drives the age
+        assert tel["oldest_pending_age_s"] == pytest.approx(3.0)
+
+    def test_empty_queue_age_is_zero(self):
+        q = SchedulingQueue(clock=FakeClock(50.0))
+        assert q.telemetry()["oldest_pending_age_s"] == 0.0
+
+    def test_sched_stats_and_gauges_updated_per_pump(self):
+        store = APIStore()
+        for n in _nodes(4):
+            store.create("nodes", n)
+        sched = _sched(store)
+        store.create_many("pods", _pods(10), consume=True)
+        sched.run_until_idle()
+        stats = sched.sched_stats()
+        q = stats["queue"]
+        assert set(q) == {"active", "backoff", "unschedulable",
+                          "gang_staged", "oldest_pending_age_s"}
+        assert q["active"] == 0 and q["oldest_pending_age_s"] == 0.0
+        # the gauges were fed (per pump, not per pod)
+        assert m.queue_depth.value(tier="active") == 0.0
+        assert m.queue_oldest_age.value() == 0.0
+
+
+# -- satellite: watch-bus telemetry ----------------------------------------------
+
+
+class TestWatchTelemetry:
+    def test_chaos_drop_is_counted(self):
+        store = APIStore()
+        w = store.watch(kind=("pods",))
+        before = m.store_watch_dropped.value(reason="chaos", kind="pods")
+        fi.arm([FaultPlan("watch.deliver", "fail", count=1)])
+        store.create("pods", MakePod("dropped").obj())
+        fi.disarm()
+        store.create("pods", MakePod("delivered").obj())
+        tel = store.watch_telemetry()
+        assert tel["dropped"].get("chaos") == 1
+        assert m.store_watch_dropped.value(
+            reason="chaos", kind="pods") == before + 1
+        evs = w.drain()
+        assert [e.obj.metadata.name for e in evs] == ["delivered"]
+
+    def test_overflow_eviction_is_counted(self):
+        store = APIStore()
+        w = store.watch(kind=("pods",), maxsize=2)
+        before = m.store_watch_dropped.value(reason="overflow", kind="")
+        for p in _pods(6, prefix="ov"):
+            store.create("pods", p)
+        assert w.terminated is True
+        assert store.watch_telemetry()["dropped"].get("overflow", 0) >= 1
+        assert m.store_watch_dropped.value(
+            reason="overflow", kind="") >= before + 1
+
+    def test_subscriber_queue_length_gauge(self):
+        store = APIStore()
+        w = store.watch(kind=("pods",))
+        for p in _pods(3, prefix="ql"):
+            store.create("pods", p)
+        tel = store.watch_telemetry()
+        me = [s for s in tel["subscribers"] if s["id"] == w.id]
+        assert me and me[0]["queue_length"] == 3
+        assert me[0]["terminated"] is False
+        # the render-time GaugeFunc sees the same subscriber
+        samples = dict((labels["subscriber"], v)
+                       for labels, v in m.store_watch_queue_length.samples())
+        assert samples.get(w.id) == 3.0
+        rendered = "\n".join(m.store_watch_queue_length.render())
+        assert f'subscriber="{w.id}"' in rendered
+
+    def test_gauge_func_swallows_raising_callback(self):
+        g = m.GaugeFunc("t", fn=lambda: (_ for _ in ()).throw(
+            RuntimeError("boom")))
+        assert g.samples() == []
+        assert g.render()[0].startswith("# HELP")
+
+
+# -- satellite: store commit latency ---------------------------------------------
+
+
+class TestStoreCommitLatency:
+    def test_bind_many_observed_once_per_chunk(self):
+        store = APIStore()
+        for p in _pods(8, prefix="bm"):
+            store.create("pods", p)
+        before = m.store_bind_many_duration.snapshot()[1]
+        bound, errs = store.bind_many(
+            [("default", f"bm-{i}", f"n-{i % 2}") for i in range(8)])
+        assert bound == 8 and not errs
+        after = m.store_bind_many_duration.snapshot()[1]
+        assert after == before + 1  # ONE observation for the whole chunk
+
+    def test_empty_prepare_still_observed(self):
+        store = APIStore()
+        before = m.store_bind_many_duration.snapshot()[1]
+        bound, errs = store.bind_many([("default", "ghost", "n-0")])
+        assert bound == 0 and len(errs) == 1
+        assert m.store_bind_many_duration.snapshot()[1] == before + 1
+
+
+# -- SLO spec + gates ------------------------------------------------------------
+
+
+class TestSLO:
+    STATS = {
+        "stages": {"solve": {"p99_ms": 100.0}, "bind": {"p99_ms": 50.0}},
+        "latency": {"count": 10, "p99_s": 1.5},
+    }
+
+    def test_pass_fail_and_skip(self):
+        spec = {"stage_p99_ms": {"solve": 200.0, "bind": 10.0,
+                                 "missing_stage": 5.0},
+                "submit_to_bound_p99_s": 2.0,
+                "solver_compiles": 0}
+        res = evaluate_slo(self.STATS, spec)
+        by = {c["name"]: c for c in res["checks"]}
+        assert by["stage_p99_ms:solve"]["ok"] is True
+        assert by["stage_p99_ms:bind"]["ok"] is False
+        assert by["stage_p99_ms:missing_stage"]["ok"] is None
+        assert by["submit_to_bound_p99_s"]["ok"] is True
+        assert by["solver_compiles"]["ok"] is None  # no extra supplied
+        assert res["pass"] is False
+        assert res["failed"] == ["stage_p99_ms:bind"]
+        assert set(res["skipped"]) == {"stage_p99_ms:missing_stage",
+                                       "solver_compiles"}
+
+    def test_extra_supplies_out_of_band_checks(self):
+        spec = {"solver_compiles": 0, "instrumentation_frac": 0.02}
+        res = evaluate_slo({}, spec, extra={"solver_compiles": 0,
+                                            "instrumentation_frac": 0.004})
+        assert res["pass"] is True and not res["skipped"]
+        res = evaluate_slo({}, spec, extra={"solver_compiles": 3,
+                                            "instrumentation_frac": 0.004})
+        assert res["failed"] == ["solver_compiles"]
+
+    def test_ceiling_is_inclusive(self):
+        res = evaluate_slo({"latency": {"p99_s": 2.0}},
+                           {"submit_to_bound_p99_s": 2.0})
+        assert res["pass"] is True
+
+    def test_typoed_spec_key_is_a_fail_never_a_vacuous_pass(self):
+        # a misspelled key must not evaluate to zero checks and exit 0
+        res = evaluate_slo({"latency": {"p99_s": 1.0}},
+                           {"submit_to_bound_p99s": 30.0})
+        assert res["pass"] is False
+        assert res["failed"] == ["unknown_spec_key:submit_to_bound_p99s"]
+
+    def test_load_spec_roundtrip(self, tmp_path):
+        p = tmp_path / "slo.json"
+        p.write_text(json.dumps(NORTH_STAR_SLO))
+        assert load_slo_spec(str(p)) == NORTH_STAR_SLO
+        assert CHAOS_SLO["submit_to_bound_p99_s"] > \
+            NORTH_STAR_SLO["stage_p99_ms"]["solve"] / 1000 / 100
+
+
+# -- the HTTP + ktl surfaces -----------------------------------------------------
+
+
+class TestTraceSurfaces:
+    def _server_with_traffic(self):
+        from kubernetes_tpu.server import APIServer
+
+        store = APIStore()
+        srv = APIServer(store).start()
+        for n in _nodes(3):
+            store.create("nodes", n)
+        sched = _sched(store)
+        store.create_many("pods", _pods(20, prefix="sv"), consume=True)
+        sched.run_until_idle()
+        return store, srv, sched
+
+    def test_debug_schedtrace_endpoint(self):
+        store, srv, sched = self._server_with_traffic()
+        try:
+            name = sched._bind_origin
+            snap = schedtrace_snapshot()
+            assert name in snap and snap[name]["completed"] > 0
+            with urllib.request.urlopen(
+                    f"{srv.url}/debug/schedtrace") as resp:
+                payload = json.loads(resp.read())
+            assert name in payload
+            doc = payload[name]
+            assert doc["enabled"] is True
+            assert doc["latency"]["count"] == 20
+            assert doc["spans"] and all(
+                sp["complete"] for sp in doc["spans"])
+        finally:
+            srv.stop()
+
+    def test_ktl_sched_trace_renders(self):
+        from kubernetes_tpu.cli.ktl import main as ktl_main
+
+        store, srv, sched = self._server_with_traffic()
+        try:
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert ktl_main(["--server", srv.url, "sched",
+                                 "trace"]) == 0
+            out = buf.getvalue()
+            assert "POD" in out and "COMMIT" in out
+            assert "submit->bound (ALL pods)" in out
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert ktl_main(["--server", srv.url, "sched", "trace",
+                                 "-o", "json"]) == 0
+            doc = json.loads(buf.getvalue())
+            assert sched._bind_origin in doc
+        finally:
+            srv.stop()
+
+    def test_ktl_sched_slo_gates_exit_code(self, tmp_path):
+        from kubernetes_tpu.cli.ktl import main as ktl_main
+
+        store, srv, sched = self._server_with_traffic()
+        try:
+            # default (north-star) spec: a tiny healthy run passes
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert ktl_main(["--server", srv.url, "sched",
+                                 "slo"]) == 0
+            assert "PASS" in buf.getvalue()
+            # an impossible spec file FAILS with exit 1
+            strict = tmp_path / "strict.json"
+            strict.write_text(json.dumps(
+                {"submit_to_bound_p99_s": 1e-9}))
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert ktl_main(["--server", srv.url, "sched", "slo",
+                                 "--spec", str(strict)]) == 1
+            out = buf.getvalue()
+            assert "FAIL" in out
+        finally:
+            srv.stop()
+
+    def test_ktl_sched_slo_errored_scheduler_is_a_fail(self):
+        # a scheduler whose sched_stats() raised arrives as {"error": ...};
+        # that's a FAILING verdict (exit 1), never a vacuous empty PASS
+        from kubernetes_tpu.cli.ktl import cmd_sched
+
+        class _StubClient:
+            def request(self, method, path):
+                return {"default-scheduler": {"error": "boom"}}
+
+        # the parser registers watch/interval for every sched action
+        args = SimpleNamespace(action="slo", spec=None, output="table",
+                               watch=False, interval=2.0)
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert cmd_sched(_StubClient(), args) == 1
+        out = buf.getvalue()
+        assert "FAIL" in out and "schedstats_error" in out
+
+    def test_ktl_sched_stats_shows_latency_and_percentiles(self):
+        from kubernetes_tpu.cli.ktl import main as ktl_main
+
+        store, srv, sched = self._server_with_traffic()
+        try:
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert ktl_main(["--server", srv.url, "sched",
+                                 "stats"]) == 0
+            out = buf.getvalue()
+            assert "P50(ms)" in out and "P99(ms)" in out
+            assert "submit->bound:" in out
+            assert "oldest_age=" in out
+        finally:
+            srv.stop()

@@ -356,6 +356,63 @@ def test_hp001_scoped_to_hot_files():
         analyze_source(HP001_BAD, filename="kubernetes_tpu/cli/ktl.py"))
 
 
+# ISSUE 7: the pod tracer's per-pod lifecycle stamping is legal ONLY behind
+# a membership check against the sampled set — the guard bounds the paying
+# population to the K reservoir slots. Unguarded stamping in a pod-scale
+# loop of podtrace.py is the same 100k-multiplier bug HP001 exists for.
+
+HP001_TRACE_BAD = '''
+def batch_popped(self, qps, now):
+    for qp in qps:
+        sp = self._live.get(qp.key)
+        sp.stamp("pop", now)
+'''
+
+HP001_TRACE_GOOD = '''
+def batch_popped(self, qps, now):
+    for qp in qps:
+        if qp.key in self._sampled:
+            sp = self._live.get(qp.key)
+            sp.stamp("pop", now)
+
+def chunk_bound(self, items, t_commit, errkeys):
+    for qp, _node, _a in items:
+        if qp.key in self._sampled and qp.key not in errkeys:
+            sp = self._live.get(qp.key)
+            sp.stamp("bind_commit", t_commit)
+'''
+
+_TRACE = "kubernetes_tpu/scheduler/podtrace.py"
+
+
+def test_hp001_fires_on_unguarded_tracer_stamp():
+    findings = [f for f in analyze_source(HP001_TRACE_BAD, filename=_TRACE)
+                if f.rule == "HP001"]
+    assert len(findings) == 1, findings
+    assert ".stamp()" in findings[0].message
+
+
+def test_hp001_quiet_behind_sampled_membership_guard():
+    assert "HP001" not in rules_of(
+        analyze_source(HP001_TRACE_GOOD, filename=_TRACE))
+
+
+def test_hp001_guard_does_not_launder_batch_py_metrics():
+    # the sampled-set exception is for tracer STAMPS; a metrics observe per
+    # pod is still a finding even when some unrelated guard wraps it —
+    # unless that guard IS a sampled-set membership check
+    src = '''
+def schedule_batch(self, qps, m):
+    for qp in qps:
+        if qp.key in self._ready_set:
+            m.batch_stage_duration.observe(0.1, "pod")
+'''
+    findings = [f for f in analyze_source(
+        src, filename="kubernetes_tpu/scheduler/batch.py")
+        if f.rule == "HP001"]
+    assert len(findings) == 1, findings
+
+
 # ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
