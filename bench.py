@@ -140,34 +140,48 @@ def make_snapshot(nodes, bound_pods=()):
     return cache.update_snapshot()
 
 
-def device_solve(snap, pods, solver):
+def device_solve(snap, pods, solver, ns_labels=None):
     """One full device pass: tensorize + upload + solve + readback. Returns
-    (assignment ndarray, seconds)."""
+    (assignment ndarray, seconds, info dict — repair-stage columns when the
+    propose-and-repair solver ran, else empty)."""
     import numpy as np
 
+    from kubernetes_tpu.models.repair import repair_solve
     from kubernetes_tpu.models.waterfill import make_groups, waterfill_solve
     from kubernetes_tpu.ops.solver import greedy_scan_solve, make_inputs
     from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors, build_pod_batch
 
+    info = {}
     t0 = time.perf_counter()
     cluster = build_cluster_tensors(snap)
-    batch = build_pod_batch(pods, snap, cluster)
+    batch = build_pod_batch(pods, snap, cluster, ns_labels=ns_labels)
     inputs, d_max = make_inputs(cluster, batch)
     if solver == "waterfill":
         a = np.asarray(waterfill_solve(inputs, make_groups(batch)))
+    elif solver == "repair":
+        solved = repair_solve(inputs, batch, d_max)
+        assert solved is not None, "repair solver declined the problem shape"
+        a, stats = solved
+        a = np.asarray(a)
+        s = stats.as_dict()
+        info["repair"] = {k: s[k] for k in
+                          ("rounds", "residual", "full_scan", "propose_calls")}
     else:
         assignment, _, _ = greedy_scan_solve(
             inputs, d_max, has_ipa=bool(batch.ipa.has_any),
             has_ct=bool(batch.ct_class.size), has_st=bool(batch.st_class.size))
         a = np.asarray(assignment)
-    return a, time.perf_counter() - t0
+    return a, time.perf_counter() - t0, info
 
 
-def run_rung(name, snap, pods, solver, baseline, min_placed=None, results=None):
-    """Warm-up (compile) + timed pass; records pods/s and vs_baseline."""
+def run_rung(name, snap, pods, solver, baseline, min_placed=None,
+             results=None, ns_labels=None):
+    """Warm-up (compile) + timed pass; records pods/s and vs_baseline. Every
+    constraint rung publishes the SAME columns (solver / vs_baseline /
+    repair-stage info) through this one path."""
     try:
-        device_solve(snap, pods, solver)
-        a, dt = device_solve(snap, pods, solver)
+        device_solve(snap, pods, solver, ns_labels=ns_labels)
+        a, dt, info = device_solve(snap, pods, solver, ns_labels=ns_labels)
         placed = int((a >= 0).sum())
         want = len(pods) if min_placed is None else min_placed
         assert placed >= want, f"{name}: only {placed}/{want} placed"
@@ -178,6 +192,7 @@ def run_rung(name, snap, pods, solver, baseline, min_placed=None, results=None):
             "placed": placed,
             "pods": len(pods),
             "solver": solver,
+            **info,
         }
         print(f"{name:>28}: {pods_per_sec:>9.0f} pods/s  "
               f"({placed}/{len(pods)} placed, {results[name]['vs_baseline']}x baseline "
@@ -207,7 +222,7 @@ def rung_topology_spread(results):
             .req({"cpu": "200m", "memory": "256Mi"})
             .topology_spread(1, ZONE, "DoNotSchedule", {"app": "spread"})
             .obj() for i in range(sz(5000))]
-    run_rung("TopologySpreading", snap, pods, "scan", BASE_PTS, results=results)
+    run_rung("TopologySpreading", snap, pods, "repair", BASE_PTS, results=results)
 
 
 def rung_pod_anti_affinity(results):
@@ -222,7 +237,7 @@ def rung_pod_anti_affinity(results):
             pods.append(MakePod(f"anti-{g}-{i}").labels({"grp": f"g{g}"})
                         .pod_anti_affinity(HOST, {"grp": f"g{g}"})
                         .req({"cpu": "200m"}).obj())
-    run_rung("PodAntiAffinity", snap, pods, "scan", BASE_ANTI, results=results)
+    run_rung("PodAntiAffinity", snap, pods, "repair", BASE_ANTI, results=results)
 
 
 def rung_pod_affinity(results):
@@ -237,13 +252,16 @@ def rung_pod_affinity(results):
     pods = [MakePod(f"aff-{i}").labels({"peer": "1"})
             .pod_affinity(ZONE, {"svc": f"s{i % sz(50)}"})
             .req({"cpu": "200m"}).obj() for i in range(sz(5000))]
-    run_rung("PodAffinity", snap, pods, "scan", BASE_AFF, results=results)
+    run_rung("PodAffinity", snap, pods, "repair", BASE_AFF, results=results)
 
 
 def rung_anti_affinity_ns_selector(results):
     # RequiredPodAntiAffinityWithNSSelector: pods across namespaces,
     # anti-affinity scoped by namespaceSelector
-    # (affinity/performance-config.yaml:480 — the reference's worst case, 24)
+    # (affinity/performance-config.yaml:480 — the reference's worst case, 24).
+    # Folded into run_rung (ISSUE 8): ns_labels flow through build_pod_batch
+    # via device_solve, so this rung publishes the SAME columns as every
+    # other constraint rung instead of a hand-rolled result dict.
     from kubernetes_tpu.api.types import Affinity, PodAffinityTerm
     from kubernetes_tpu.api.labels import Selector
     from kubernetes_tpu.testing import MakePod
@@ -262,36 +280,8 @@ def rung_anti_affinity_ns_selector(results):
                 {"grp": f"g{g}"}).req({"cpu": "200m"}).obj()
             p.spec.affinity = Affinity(pod_anti_affinity_required=[term])
             pods.append(p)
-
-    # ns_labels flow through build_pod_batch
-    import numpy as np
-
-    from kubernetes_tpu.ops.solver import greedy_scan_solve, make_inputs
-    from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors, build_pod_batch
-
-    def solve():
-        t0 = time.perf_counter()
-        cluster = build_cluster_tensors(snap)
-        batch = build_pod_batch(pods, snap, cluster, ns_labels=ns_labels)
-        inputs, d_max = make_inputs(cluster, batch)
-        assignment, _, _ = greedy_scan_solve(inputs, d_max)
-        return np.asarray(assignment), time.perf_counter() - t0
-
-    try:
-        solve()
-        a, dt = solve()
-        placed = int((a >= 0).sum())
-        assert placed == len(pods), f"only {placed}/{len(pods)}"
-        pps = len(pods) / dt
-        results["AntiAffinityNSSelector"] = {
-            "pods_per_sec": round(pps, 1), "vs_baseline": round(pps / BASE_NSANTI, 2),
-            "placed": placed, "pods": len(pods), "solver": "scan"}
-        print(f"{'AntiAffinityNSSelector':>28}: {pps:>9.0f} pods/s  "
-              f"({placed}/{len(pods)} placed, {pps / BASE_NSANTI:.0f}x baseline 24, scan)",
-              file=sys.stderr)
-    except Exception as e:
-        results["AntiAffinityNSSelector"] = {"error": str(e)[:200]}
-        print(f"AntiAffinityNSSelector: ERROR {e}", file=sys.stderr)
+    run_rung("AntiAffinityNSSelector", snap, pods, "repair", BASE_NSANTI,
+             results=results, ns_labels=ns_labels)
 
 
 def rung_mixed_churn(results):
@@ -385,7 +375,7 @@ def rung_north_star(results):
             for i in range(sz(100_000))]
     try:
         device_solve(snap, pods, "waterfill")
-        a, dt = device_solve(snap, pods, "waterfill")
+        a, dt, _ = device_solve(snap, pods, "waterfill")
         placed = int((a >= 0).sum())
         pps = len(pods) / dt
         results["NorthStar_100k_10k"] = {
@@ -600,6 +590,7 @@ def _solver_jit_cache():
     Stable counts across same-bucket batches = the cache is hot; a growing
     count is retrace churn (tens of seconds per compile at TPU scale).
     -1 when the introspection API is unavailable."""
+    from kubernetes_tpu.models.repair import repair_check
     from kubernetes_tpu.models.transport import _auction_phase, _sinkhorn_iters
     from kubernetes_tpu.models.waterfill import waterfill_group
     from kubernetes_tpu.ops.solver import greedy_scan_solve
@@ -607,6 +598,7 @@ def _solver_jit_cache():
     out = {}
     for name, fn in (("waterfill_group", waterfill_group),
                      ("greedy_scan_solve", greedy_scan_solve),
+                     ("repair_check", repair_check),
                      ("auction_phase", _auction_phase),
                      ("sinkhorn_iters", _sinkhorn_iters)):
         try:
@@ -1032,7 +1024,7 @@ def rung_preferred_topology_spread(results):
             .req({"cpu": "200m", "memory": "256Mi"})
             .topology_spread(1, ZONE, "ScheduleAnyway", {"app": "soft"})
             .obj() for i in range(sz(5000))]
-    run_rung("PreferredTopologySpreading", snap, pods, "scan", 125,
+    run_rung("PreferredTopologySpreading", snap, pods, "repair", 125,
              results=results)
 
 

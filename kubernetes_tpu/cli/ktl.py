@@ -1324,6 +1324,18 @@ def _render_sched_stats(doc: Dict) -> str:
                 f"vetoes={gang.get('vetoes', 0)} "
                 f"quorum_expired_assumes="
                 f"{gang.get('quorum_expired_assumes', 0)}")
+        rep = st.get("repair")
+        if rep:
+            last = rep.get("last") or {}
+            out.append(
+                f"constraint repair: batches={rep.get('batches', 0)} "
+                f"rounds={rep.get('rounds', 0)} "
+                f"residual={rep.get('residual', 0)} "
+                f"full_scan={rep.get('full_scan', 0)} "
+                f"violations={rep.get('violations', 0)}"
+                + (f"   last: proposed={last.get('proposed', 0)} "
+                   f"rounds={last.get('rounds', 0)} "
+                   f"residual={last.get('residual', 0)}" if last else ""))
         brk = st.get("breaker")
         bw = st.get("bind_worker")
         if brk and (brk.get("state") != "closed" or brk.get("trips")

@@ -25,7 +25,7 @@ to the exact scan solver by the driver (solver='auto').
 from __future__ import annotations
 
 import functools
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +37,42 @@ from ..ops.solver import (
     INT_MIN,
 )
 from ..scheduler.framework import MAX_NODE_SCORE
+
+
+def bucket_j_max(max_pods, pod_count, n: int, max_slots: int,
+                 cap_hint: Optional[int] = None) -> Optional[int]:
+    """Pow2-bucketed per-node slot depth for the waterfill sort key.
+
+    j_max must cover every node's remaining pod headroom, or schedulable pods
+    would be silently clipped; the int32 sort key bounds total slots at
+    `max_slots` (max_total_score * slots < 2^31 — each caller budgets its own
+    score ceiling). Derived from STATIC capacity (max_pods) when it fits:
+    headroom shrinks as the cluster fills and a headroom-derived bucket would
+    recompile at every power-of-two boundary — each mid-run XLA compile costs
+    tens of seconds on TPU. Only when the static bound blows the int32 key
+    range does the tighter dynamic headroom (then a raw, unbucketed one) come
+    in. cap_hint (the repair path's largest group size, itself pow2-bucketed
+    by the shift below) tightens the depth when no group can ever fill a
+    node. Returns None when the problem shape exceeds the key range entirely
+    (callers fall back to the scan solver)."""
+    cap = max(1, int(np.asarray(max_pods).max(initial=1)))
+    if cap_hint is not None:
+        cap = min(cap, max(1, int(cap_hint)))
+    j_max = 1 << (cap - 1).bit_length()
+    if n * j_max > max_slots:
+        # documented last resort (docstring above): when the static pow2
+        # bucket blows the int32 sort-key range, the raw dynamic headroom
+        # keys the jit — recompiles are accepted there because the
+        # alternative is no fast path at all
+        headroom = max(1, int(np.asarray(max_pods - pod_count).max(initial=1)))
+        if cap_hint is not None:
+            headroom = min(headroom, max(1, int(cap_hint)))
+        j_max = 1 << (headroom - 1).bit_length()
+        if n * j_max > max_slots:
+            if n * headroom > max_slots:
+                return None
+            j_max = headroom
+    return j_max
 
 
 @functools.partial(jax.jit, static_argnames=("j_max", "k_slots", "has_gang"))
@@ -128,29 +164,13 @@ def waterfill_solve(inp: SolverInputs, groups: List[Tuple[np.ndarray, int]]):
     p = inp.req.shape[0]
     n = inp.alloc.shape[0]
     has_gang = inp.gang_bonus is not None
-    # j_max must cover every node's remaining pod headroom, or schedulable pods
-    # would be silently clipped; the int32 sort key bounds slots at ~2.6M
-    # (max_total_score 800 * slots < 2^31; gang batches add GANG_SLICE_BONUS
-    # to the score range, so their slot cap tightens to ~2.3M). Derived from
-    # STATIC capacity (max_pods) when it fits: headroom shrinks as the
-    # cluster fills and a headroom-derived bucket would recompile at every
-    # power-of-two boundary — each mid-run XLA compile costs tens of seconds
-    # on TPU. Only when the static bound blows the int32 key range does the
-    # tighter dynamic headroom (then a raw, unbucketed one) come in.
+    # slot budget (bucket_j_max): max_total_score 800 * slots < 2^31 bounds
+    # slots at ~2.6M; gang batches add GANG_SLICE_BONUS to the score range,
+    # so their slot cap tightens to ~2.3M
     max_slots = 2_300_000 if has_gang else 2_600_000
-    cap = max(1, int(np.asarray(inp.max_pods).max(initial=1)))
-    j_max = 1 << (cap - 1).bit_length()
-    if n * j_max > max_slots:
-        # schedlint: allow(JT001) documented last resort (comment above):
-        # when the static pow2 bucket blows the int32 sort-key range, the
-        # raw dynamic headroom keys the jit — recompiles are accepted there
-        # because the alternative is no fast path at all
-        headroom = max(1, int(np.asarray(inp.max_pods - inp.pod_count).max(initial=1)))
-        j_max = 1 << (headroom - 1).bit_length()
-        if n * j_max > max_slots:
-            if n * headroom > max_slots:
-                return None
-            j_max = headroom
+    j_max = bucket_j_max(inp.max_pods, inp.pod_count, n, max_slots)
+    if j_max is None:
+        return None
     assignment = np.full(p, -1, dtype=np.int32)
     used = inp.used
     used_nz = inp.used_nz

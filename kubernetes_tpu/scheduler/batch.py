@@ -141,6 +141,14 @@ class BatchScheduler(Scheduler):
         # MODE label alone would credit a constrained batch's scan run to
         # the fast path (scheduler/breaker.py path_matches_mode)
         self._solve_path = "exact"
+        # constraint propose-and-repair observability (ISSUE 8): the last
+        # batch's RepairStats (feeds the flight record) + running totals for
+        # sched_stats/ktl — a pathological repair loop (rounds pinned at the
+        # bound, heavy residual, full_scan re-solves) must be visible
+        self._last_repair = None
+        self.repair_totals = {
+            "batches": 0, "rounds": 0, "proposed": 0, "repaired": 0,
+            "residual": 0, "full_scan": 0, "violations": 0}
         # in-flight bind chunks (each owing one task_done): recorded by the
         # worker before commit, cleared after bookkeeping — non-empty with a
         # DEAD worker means a hard kill stranded them, and the liveness check
@@ -214,6 +222,7 @@ class BatchScheduler(Scheduler):
         # the configured one while CLOSED, the exact scan while OPEN, a
         # single probe of the configured one when HALF_OPEN
         out["solver"] = self.breaker.effective_solver(self.solver)
+        self._last_repair = None  # set by _note_repair on the repair path
         m.solver_breaker_state.set(self.breaker.code)
         try:
             self._schedule_batch_inner(qps, clock, trace, m,
@@ -244,6 +253,8 @@ class BatchScheduler(Scheduler):
                 fallback=out.get("fallback", 0),
                 preempted=self.preempt_victims_total - victims0,
                 reasons=reasons, gang=out.get("gang"),
+                repair=(self._last_repair.as_dict()
+                        if self._last_repair is not None else None),
                 solver_iterations=getattr(self.transport_state,
                                           "iterations", None),
                 breaker=(self.breaker.state
@@ -277,8 +288,41 @@ class BatchScheduler(Scheduler):
             gangs=self.gangs)
 
         fallback_mask = batch.fallback_class[batch.class_of_pod]
-        device_idx = np.nonzero(~fallback_mask)[0]
-        fallback_idx = np.nonzero(fallback_mask)[0]
+        # Gang semantic hole CLOSED (ISSUE 8 satellite; ROADMAP direction 4
+        # carryover): a gang member whose class needs the serial path
+        # (volumes, DRA, non-default PTS policies) used to schedule
+        # INDIVIDUALLY there — silently breaking all-or-nothing. The whole
+        # gang is vetoed instead, with a narrated reason: every in-batch
+        # member (device and fallback rows alike) fails unschedulable and
+        # ONE Warning event names the gangs; a pod or PodGroup update
+        # re-queues them through the normal unschedulable machinery.
+        gang_strip = None
+        if batch.gang_of_pod is not None:
+            gof = np.asarray(batch.gang_of_pod)
+            bad_gof = np.unique(gof[(gof >= 0) & fallback_mask])
+            if bad_gof.size:
+                gang_strip = np.isin(gof, bad_gof)
+                names = ", ".join(batch.gang_keys[g] for g in bad_gof.tolist())
+                self.gang_vetoes += int(bad_gof.size)
+                m.gang_vetoed_total.inc(int(bad_gof.size),
+                                        reason="serial_fallback")
+                strip_rows = np.nonzero(gang_strip)[0].tolist()
+                self.recorder.event(
+                    qps[strip_rows[0]].pod, "Warning", "GangVetoed",
+                    f"gang(s) {names} vetoed: a member class requires "
+                    "serial-fallback scheduling (volumes/DRA), where "
+                    "all-or-nothing placement cannot be enforced")
+                for pi in strip_rows:
+                    self._handle_failure(qps[pi], Status.unschedulable(
+                        "gang member class requires serial-fallback "
+                        "scheduling; all-or-nothing placement is only "
+                        "enforced on the batched path (gang vetoed)"))
+        if gang_strip is not None:
+            device_idx = np.nonzero(~fallback_mask & ~gang_strip)[0]
+            fallback_idx = np.nonzero(fallback_mask & ~gang_strip)[0]
+        else:
+            device_idx = np.nonzero(~fallback_mask)[0]
+            fallback_idx = np.nonzero(fallback_mask)[0]
         out["fallback"] = int(fallback_idx.size)
         clock.mark("build_pod_batch")
         trace.step("Built pod batch", device=int(device_idx.size),
@@ -531,9 +575,9 @@ class BatchScheduler(Scheduler):
                 clock.skip()
 
         # Serial fallback, in original priority order among themselves.
-        # NOTE: gang members whose class needs the serial path (volumes, DRA)
-        # schedule individually — all-or-nothing is enforced for device-path
-        # classes, the shape training gangs actually take.
+        # Gang members never reach here: a gang touching a serial-fallback
+        # class was vetoed above (all-or-nothing cannot be enforced on the
+        # per-pod path).
         if len(fallback_idx):
             fb0 = self.scheduled_count
             for pi in fallback_idx:
@@ -556,20 +600,25 @@ class BatchScheduler(Scheduler):
         # _solve_path tracks the path actually executing at every point so
         # both the success return and an exception anywhere in here
         # attribute to the right solver (the breaker must never credit a
-        # scan outcome to the fast path, or vice versa). Until routing is
-        # decided — the injected fire and the shared make_inputs prep —
-        # failures count against the mode under protection.
+        # scan outcome to the fast path, or vice versa). Routing is decided
+        # BEFORE the injected fire so a chaos fault on a constrained
+        # fast-mode batch attributes to the repair kernel it would have run
+        # — tripping the breaker to the scan exactly like a waterfill fault.
         self._solve_path = REPRESENTATIVE.get(solver, solver)
-        if _chaos.ACTIVE is not None:
-            _chaos.ACTIVE.fire("solver.solve")
-        constraint_free = (batch.ct_class.size == 0
-                           and batch.st_class.size == 0
-                           and not batch.ipa.has_any)
+        constraint_free = not batch.has_constraints
         use_fast = solver in ("fast", "auto") and constraint_free
+        # constrained batches under the fast/auto modes ride the
+        # propose-and-repair pipeline (models/repair.py, ISSUE 8); every
+        # other mode's constrained batches stay on the scan oracle
+        use_repair = solver in ("fast", "auto") and not constraint_free
         use_transport = (solver in ("auction", "sinkhorn")
                          and constraint_free and not has_gang)
-        if not constraint_free:
+        if use_repair:
+            self._solve_path = "repair"
+        elif not constraint_free:
             self._solve_path = "exact"  # the scan owns constrained batches
+        if _chaos.ACTIVE is not None:
+            _chaos.ACTIVE.fire("solver.solve")
         assignment = None
         if solver == "native" and constraint_free and not has_gang:
             from ..native import native_available, native_greedy_solve
@@ -604,6 +653,19 @@ class BatchScheduler(Scheduler):
 
             self._solve_path = "fast"
             assignment = waterfill_solve(inputs, make_groups(sub))
+        if use_repair:
+            from ..models.repair import repair_solve
+
+            solved = repair_solve(
+                inputs, sub, d_max,
+                has_gang=bool(has_gang and sub.gang_bonus is not None))
+            if solved is not None:
+                assignment, rstats = solved
+                self._note_repair(rstats)
+            else:
+                # problem shape exceeds the fast path's sort-key range:
+                # decline to the oracle, exactly like waterfill_solve
+                self._solve_path = "exact"
         if assignment is None:
             # static gates: constraint-free batches compile the scan
             # variant without IPA gathers / PTS segment sums
@@ -614,6 +676,25 @@ class BatchScheduler(Scheduler):
                 has_st=bool(batch.st_class.size),
                 has_gang=bool(has_gang and sub.gang_bonus is not None))
         return np.asarray(assignment)
+
+    def _note_repair(self, rstats) -> None:
+        """Fold one constrained batch's RepairStats into the metrics and the
+        running totals (ONE call per batch, never per pod)."""
+        from ..server import metrics as m
+
+        self._last_repair = rstats
+        t = self.repair_totals
+        t["batches"] += 1
+        t["rounds"] += rstats.rounds
+        t["proposed"] += rstats.proposed
+        t["repaired"] += rstats.repaired
+        t["residual"] += rstats.residual
+        t["full_scan"] += int(rstats.full_scan)
+        m.constraint_repair_rounds.observe(rstats.rounds)
+        for kind, v in rstats.violations.items():
+            if v:
+                t["violations"] += v
+                m.constraint_violations_total.inc(v, kind=kind)
 
     def _handle_solver_error(self, e, qps, device_idx, solver, out, m) -> None:
         """Solver failure domain: requeue the device pods with backoff (the
@@ -1092,6 +1173,11 @@ class BatchScheduler(Scheduler):
                       "live_incomplete": self.podtrace.live_incomplete,
                       "windows_rotated": self.podtrace.windows_rotated},
             "gang": gang,
+            "repair": (dict(self.repair_totals,
+                            last=self._last_repair.as_dict())
+                       if self._last_repair is not None
+                       else dict(self.repair_totals)
+                       if self.repair_totals["batches"] else None),
             "breaker": self.breaker.describe(),
             "bind_worker": {
                 "restarts": self.bind_worker_restarts,

@@ -54,10 +54,10 @@ DEGRADED = {
 }
 
 # which EXECUTED path (BatchScheduler._solve_path) represents the preferred
-# mode's fast path: a constrained batch under solver='fast' runs the scan
-# regardless of the breaker, and its outcome says NOTHING about the failing
-# fast kernel — crediting it to the mode would falsely close (or trip) the
-# breaker
+# mode's fast path: a constrained batch under an exact/native/transport mode
+# runs the scan regardless of the breaker, and its outcome says NOTHING
+# about the failing fast kernel — crediting it to the mode would falsely
+# close (or trip) the breaker
 REPRESENTATIVE = {
     "fast": "fast",
     "auto": "fast",
@@ -67,11 +67,22 @@ REPRESENTATIVE = {
     "exact": "exact",
 }
 
+# the fast MODE now has two jitted kernels (ISSUE 8): the constraint-free
+# waterfill ("fast") and the constrained propose-and-repair pipeline
+# ("repair" — models/repair.py). A failure of EITHER is a failure of the
+# mode under protection, so both degrade to the exact scan oracle through
+# the same trip/cooldown/half-open ladder — and a successful repair batch
+# is a genuine probe of the protected mode.
+FAST_PATHS = ("fast", "repair")
+
 
 def path_matches_mode(used: str, preferred: str) -> bool:
     """True when the executed solver path `used` exercised the preferred
     MODE's fast path (the thing the breaker is protecting)."""
-    return used == REPRESENTATIVE.get(preferred, preferred)
+    rep = REPRESENTATIVE.get(preferred, preferred)
+    if rep == "fast":
+        return used in FAST_PATHS
+    return used == rep
 
 
 class SolverCircuitBreaker:

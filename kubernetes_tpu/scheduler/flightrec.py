@@ -179,6 +179,7 @@ class FlightRecorder:
                unschedulable: int = 0, fallback: int = 0, preempted: int = 0,
                reasons: Optional[Dict[str, int]] = None,
                gang: Optional[Dict[str, int]] = None,
+               repair: Optional[Dict] = None,
                solver_iterations: Optional[int] = None,
                breaker: Optional[str] = None,
                error: Optional[str] = None) -> Optional[Dict]:
@@ -203,6 +204,9 @@ class FlightRecorder:
                 "preempted": preempted,
                 "reasons": dict(reasons or {}),
                 "gang": gang,
+                # constraint propose-and-repair (ISSUE 8): the batch's
+                # RepairStats dict when the repair path ran, else None
+                "repair": repair,
                 "solver_iterations": solver_iterations,
                 # failure domains (ISSUE 6): non-closed breaker state and
                 # the batch's handled pipeline error, when present

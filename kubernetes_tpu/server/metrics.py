@@ -387,6 +387,21 @@ store_watch_queue_length = global_registry.gauge_func(
     "Buffered events per live watch subscriber (read at scrape time)",
     fn=_watch_queue_samples)
 
+# constraint propose-and-repair observability (ISSUE 8): repair-round count
+# per constrained batch (a distribution pinned at the REPAIR_MAX_ROUNDS
+# bound means the repair loop is thrashing and the residual scan is doing
+# the real work) and final-state violations found by the repair check, by
+# kind — both observed ONCE per batch from RepairStats, never per pod
+constraint_repair_rounds = global_registry.histogram(
+    "scheduler_constraint_repair_rounds",
+    "Rip-and-repropose rounds per constrained batch (models/repair.py)",
+    buckets=(0, 1, 2, 3, 4, 8, 16))
+constraint_violations_total = global_registry.counter(
+    "scheduler_constraint_violations_total",
+    "Constraint violations found by the repair path's final-state check, "
+    "by kind (anti_affinity / existing_anti_affinity / affinity / "
+    "topology_spread)")
+
 # gang scheduling observability (ROADMAP gang-pipeline open items)
 gang_staged = global_registry.gauge(
     "scheduler_gang_staged", "Gang members parked in queue staging")

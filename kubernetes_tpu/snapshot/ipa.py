@@ -104,6 +104,12 @@ class IPATensors:
 
     class_self_ok: np.ndarray  # [C] bool — pod matches all own required terms
     class_has_ra: np.ndarray  # [C] bool
+    # constraint-compilation metadata for the propose-and-repair solver
+    # (models/repair.py): a class whose OWN required anti-affinity term
+    # matches its own rep pod can place at most ONE member per topology
+    # domain — the propose step caps it at one per node (the host-port cap
+    # mechanism) and the repair loop resolves coarser-domain collisions
+    class_rn_self: np.ndarray = None  # [C] bool
 
     @property
     def has_any(self) -> bool:
@@ -136,6 +142,7 @@ def compile_ipa(
     pp_rows: List[List[Tuple[int, int, int]]] = [[] for _ in range(c)]
     class_self_ok = np.zeros(c, dtype=bool)
     class_has_ra = np.zeros(c, dtype=bool)
+    class_rn_self = np.zeros(c, dtype=bool)
 
     def _sel_row_for(term, source_pod) -> int:
         eff = effective_selector(term, source_pod)
@@ -156,6 +163,8 @@ def compile_ipa(
             ra_rows[ci].append((topo_row(term.topology_key), _sel_row_for(term, pod)))
         for term in aff.pod_anti_affinity_required:
             rn_rows[ci].append((topo_row(term.topology_key), _sel_row_for(term, pod)))
+            if term_matches_pod(term, pod, pod, ns_labels):
+                class_rn_self[ci] = True
         for wt in aff.pod_affinity_preferred:
             pp_rows[ci].append((topo_row(wt.term.topology_key),
                                 _sel_row_for(wt.term, pod), wt.weight))
@@ -281,4 +290,5 @@ def compile_ipa(
         sym_grp=sym_grp_c, sym_weight=sym_w_c,
         class_self_ok=class_self_ok,
         class_has_ra=class_has_ra,
+        class_rn_self=class_rn_self,
     )

@@ -269,6 +269,82 @@ class TestSolverBreaker:
             [f"default/a-{i}" for i in range(8)]
             + [f"default/b-{i}" for i in range(4)])
 
+    def test_repair_path_counts_as_the_fast_mode(self):
+        """ISSUE 8: the fast MODE has two kernels — waterfill ('fast') and
+        the constrained propose-and-repair pipeline ('repair'). Failures of
+        either trip the breaker; a successful repair batch is a genuine
+        half-open probe."""
+        clock = FakeClock()
+        b = SolverCircuitBreaker(clock=clock, threshold=2, cooldown_s=10.0)
+        b.record_failure("repair", "fast")
+        b.record_failure("repair", "fast")
+        assert b.state == "open" and b.trips == 1
+        clock.step(11)
+        assert b.effective_solver("fast") == "fast"
+        b.record_success("repair", "fast")
+        assert b.state == "closed" and b.recoveries == 1
+        # 'auto' mode likewise
+        b2 = SolverCircuitBreaker(clock=clock, threshold=1)
+        b2.record_failure("repair", "auto")
+        assert b2.state == "open"
+
+    def test_repair_fault_trips_breaker_to_scan_and_recovers(self):
+        """ISSUE 8 chaos coverage: a solver.solve fault on a CONSTRAINED
+        fast-mode batch attributes to the repair kernel, trips the breaker,
+        the degraded batches place the constrained pods on the scan oracle
+        (semantics intact), and a constrained half-open probe closes it."""
+        store = APIStore()
+        for i in range(8):
+            store.create("nodes", MakeNode(f"node-{i}").labels(
+                {"kubernetes.io/hostname": f"node-{i}"}).capacity(
+                {"cpu": "8", "memory": "32Gi", "pods": "110"}).obj())
+        sched = BatchScheduler(store, Framework(default_plugins()),
+                               batch_size=64, solver="fast",
+                               breaker_threshold=2, breaker_cooldown_s=0.2,
+                               pod_initial_backoff=0.01, pod_max_backoff=0.05)
+        sched.sync()
+
+        def anti(prefix, n):
+            return [MakePod(f"{prefix}-{i}").labels({"grp": prefix})
+                    .pod_anti_affinity("kubernetes.io/hostname",
+                                       {"grp": prefix})
+                    .req({"cpu": "100m"}).obj() for i in range(n)]
+
+        fi.arm([FaultPlan("solver.solve", "fail", count=2)])
+        store.create_many("pods", anti("a", 4))
+        sched.pump_events()
+        sched.schedule_batch(timeout=0.0)  # failure 1, on the repair path
+        assert sched._solve_path == "repair"
+        sched.queue.flush_backoff_completed()
+        time.sleep(0.02)
+        sched.queue.flush_backoff_completed()
+        sched.schedule_batch(timeout=0.0)  # failure 2 -> OPEN
+        assert sched.breaker.state == "open"
+        assert sched.breaker.trips == 1
+        # while OPEN, constrained batches run the DEGRADED exact scan —
+        # and still honor the anti-affinity
+        bound = _drive(store, sched, 4, keys_prefix="a-")
+        assert bound == 4
+        solvers = [r["solver"] for r in sched.flightrec.records()
+                   if r["pods"] > 0]
+        assert "exact" in solvers
+        nodes = [p.spec.node_name for p in store.list("pods")[0]
+                 if p.spec.node_name]
+        assert len(set(nodes)) == 4
+        # cooldown passes; the CONSTRAINED probe batch exercises the repair
+        # kernel and closes the breaker
+        time.sleep(0.25)
+        store.create_many("pods", anti("b", 4))
+        bound = _drive(store, sched, 4, keys_prefix="b-")
+        assert bound == 4
+        assert sched.breaker.state == "closed"
+        assert sched.breaker.recoveries == 1
+        assert sched._solve_path == "repair"
+        assert_pod_conservation(
+            store, sched,
+            [f"default/a-{i}" for i in range(4)]
+            + [f"default/b-{i}" for i in range(4)])
+
     def test_retry_metric_counts_solver_requeues(self):
         from kubernetes_tpu.server import metrics as m
 

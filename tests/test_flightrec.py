@@ -117,7 +117,7 @@ class TestStageClock:
 
 RECORD_KEYS = {"seq", "ts", "pods", "nodes", "outcome", "solver", "total_ms",
                "stages", "scheduled", "unschedulable", "fallback",
-               "preempted", "reasons", "gang", "solver_iterations",
+               "preempted", "reasons", "gang", "repair", "solver_iterations",
                "breaker", "error", "bind_failures"}
 
 

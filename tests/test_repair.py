@@ -1,0 +1,588 @@
+"""Propose-and-repair constraint solver (ISSUE 8): scan-oracle parity.
+
+Two contracts, pinned here:
+
+  feasibility — the repair path NEVER commits a hard-constraint violation:
+      every required anti-affinity / affinity / DoNotSchedule-spread term
+      holds in the FINAL state of its output, validated by an independent
+      host-side checker (numpy recount from the assignment — shares no code
+      with either solver kernel).
+  no invented unschedulability — whenever the repair path leaves any pod
+      unplaced, its whole output IS the scan oracle's (the full_scan
+      re-solve), so unschedulable sets are identical bit for bit; and
+      whenever the oracle can place everything, so does repair (implied:
+      a non-empty repair-unplaced set forces the oracle output).
+
+Plus the end-to-end surface: constrained batches ride solver='fast' through
+the BatchScheduler (`_solve_path == "repair"`) in BOTH watch_coalesce modes
+with the mutation detector forced, the gang serial-fallback veto, and the
+repair observability (metrics / flight records / sched_stats / ktl).
+"""
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.labels import Selector
+from kubernetes_tpu.api.types import Affinity, PodAffinityTerm
+from kubernetes_tpu.models.repair import REPAIR_MAX_ROUNDS, repair_solve
+from kubernetes_tpu.ops.solver import greedy_scan_solve, make_inputs
+from kubernetes_tpu.scheduler import Cache, Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.snapshot.tensorizer import (build_cluster_tensors,
+                                                build_pod_batch)
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import (MakeNode, MakePod, make_pod_group,
+                                    mutation_detector_guard)
+from kubernetes_tpu.utils import FakeClock
+
+HOST = "kubernetes.io/hostname"
+ZONE = "topology.kubernetes.io/zone"
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    """ISSUE 8 satellite: every store this module builds runs with the
+    mutation detector FORCE-ENABLED and checked at teardown."""
+    yield from mutation_detector_guard(monkeypatch)
+
+
+def _nodes(n, cpu="8", mem="32Gi", zones=0):
+    out = []
+    for i in range(n):
+        labels = {HOST: f"node-{i}"}
+        if zones:
+            labels[ZONE] = f"zone-{i % zones}"
+        out.append(MakeNode(f"node-{i}").labels(labels)
+                   .capacity({"cpu": cpu, "memory": mem, "pods": "110"})
+                   .obj())
+    return out
+
+
+def _snap(nodes, bound=()):
+    cache = Cache(clock=FakeClock())
+    for n in nodes:
+        cache.add_node(n)
+    for p in bound:
+        cache.add_pod(p)
+    return cache.update_snapshot()
+
+
+def _solve_both(snap, pods, ns_labels=None, max_rounds=REPAIR_MAX_ROUNDS):
+    cluster = build_cluster_tensors(snap)
+    batch = build_pod_batch(pods, snap, cluster, ns_labels=ns_labels)
+    inputs, d_max = make_inputs(cluster, batch)
+    solved = repair_solve(inputs, batch, d_max, max_rounds=max_rounds)
+    assert solved is not None, "repair declined a bench-scale problem shape"
+    rep, stats = solved
+    scan, _, _ = greedy_scan_solve(
+        inputs, d_max, has_ipa=bool(batch.ipa.has_any),
+        has_ct=bool(batch.ct_class.size), has_st=bool(batch.st_class.size))
+    return np.asarray(rep), stats, np.asarray(scan), batch, inputs, d_max
+
+
+# ---------------------------------------------------------------------------
+# the independent final-state validator
+# ---------------------------------------------------------------------------
+
+
+def assert_hard_feasible(batch, inputs, assignment, label=""):
+    """Recount every hard term from scratch against the FINAL state of
+    `assignment` — plain numpy over the compiled tables, no solver code."""
+    topo = np.asarray(inputs.topo_id)
+    selcls = np.asarray(inputs.selcls_count).astype(np.int64).copy()
+    grp = np.asarray(inputs.grp_count).astype(np.int64).copy()
+    cm = np.asarray(inputs.class_matches_selcls)
+    chg = np.asarray(inputs.class_holds_grp)
+    grp_key = np.asarray(inputs.grp_key)
+    aff_ok = np.asarray(inputs.aff_ok)
+    cls = np.asarray(batch.class_of_pod)
+    ipa = batch.ipa
+    placed = [(i, int(nd)) for i, nd in enumerate(assignment.tolist())
+              if nd >= 0]
+    for i, nd in placed:
+        selcls[:, nd] += cm[cls[i]]
+        grp[:, nd] += chg[cls[i]]
+
+    # node resources: final used (seed + every placed pod's OWN request)
+    # must fit allocatable — catches any path that commits one pod's
+    # request vector for another (the mixed-request-class bug class)
+    alloc = np.asarray(inputs.alloc).astype(np.int64)
+    used = np.asarray(inputs.used).astype(np.int64).copy()
+    count = np.asarray(inputs.pod_count).astype(np.int64).copy()
+    req = np.asarray(batch.req).astype(np.int64)
+    for i, nd in placed:
+        used[nd] += req[i]
+        count[nd] += 1
+    over = (used > alloc) & (alloc > 0)
+    assert not over.any(), (
+        f"{label}: resource overcommit on nodes "
+        f"{np.nonzero(over.any(axis=1))[0].tolist()}")
+    assert (count <= np.asarray(inputs.max_pods)).all(), (
+        f"{label}: max-pods overcommit")
+
+    def dom_sum(row, trow, dom):
+        return int(row[trow == dom].sum())
+
+    for i, nd in placed:
+        c = int(cls[i])
+        for j in range(ipa.rn_key.shape[1]):
+            k = int(ipa.rn_key[c, j])
+            if k < 0:
+                continue
+            s = int(ipa.rn_sel[c, j])
+            trow = topo[k]
+            assert trow[nd] >= 0, f"{label} pod {i}: anti term on keyless node"
+            others = dom_sum(selcls[s], trow, trow[nd]) - int(cm[c, s])
+            assert others <= 0, (
+                f"{label} pod {i}@node {nd}: required anti-affinity violated "
+                f"({others} other matching pods in domain)")
+        for j in range(ipa.ea_grp.shape[1]):
+            g = int(ipa.ea_grp[c, j])
+            if g < 0:
+                continue
+            trow = topo[grp_key[g]]
+            assert trow[nd] >= 0
+            others = dom_sum(grp[g], trow, trow[nd]) - int(chg[c, g])
+            assert others <= 0, (
+                f"{label} pod {i}@node {nd}: existing-pod anti-affinity "
+                f"violated ({others} holders share the domain)")
+        for j in range(ipa.ra_key.shape[1]):
+            k = int(ipa.ra_key[c, j])
+            if k < 0:
+                continue
+            s = int(ipa.ra_sel[c, j])
+            trow = topo[k]
+            assert trow[nd] >= 0, f"{label} pod {i}: affinity on keyless node"
+            # final state: the pod itself counts (first-pod exception seeds
+            # legally satisfy their own term)
+            assert dom_sum(selcls[s], trow, trow[nd]) >= 1, (
+                f"{label} pod {i}@node {nd}: required affinity unsatisfied")
+    ct_class = np.asarray(batch.ct_class)
+    for t in range(ct_class.size):
+        c = int(ct_class[t])
+        trow = topo[int(batch.ct_key[t])]
+        srow = selcls[int(batch.ct_sel[t])]
+        elig = aff_ok[c] & (trow >= 0)
+        doms = np.unique(trow[elig])
+        if doms.size == 0:
+            continue
+        counts = {int(d): int(srow[elig & (trow == d)].sum()) for d in doms}
+        mmn = min(counts.values())
+        skew = int(batch.ct_max_skew[t])
+        for i, nd in placed:
+            if int(cls[i]) != c:
+                continue
+            assert trow[nd] >= 0, f"{label} pod {i}: spread on keyless node"
+            assert counts[int(trow[nd])] - mmn <= skew, (
+                f"{label} pod {i}@node {nd}: final spread skew "
+                f"{counts[int(trow[nd])] - mmn} > {skew}")
+
+
+def _assert_parity(rep, scan, batch, inputs, label=""):
+    assert_hard_feasible(batch, inputs, rep, label=f"{label}/repair")
+    assert_hard_feasible(batch, inputs, scan, label=f"{label}/scan")
+    if (rep < 0).any():
+        # a non-empty unplaced set is ALWAYS the oracle's own verdict
+        assert np.array_equal(rep < 0, scan < 0), (
+            f"{label}: unschedulable sets diverge: repair "
+            f"{np.nonzero(rep < 0)[0].tolist()} vs scan "
+            f"{np.nonzero(scan < 0)[0].tolist()}")
+
+
+# ---------------------------------------------------------------------------
+# per-constraint-kind semantics
+# ---------------------------------------------------------------------------
+
+
+def test_hostname_anti_affinity_places_each_group_on_distinct_nodes():
+    snap = _snap(_nodes(32))
+    pods = []
+    for g in range(3):
+        for i in range(8):
+            pods.append(MakePod(f"a-{g}-{i}").labels({"grp": f"g{g}"})
+                        .pod_anti_affinity(HOST, {"grp": f"g{g}"})
+                        .req({"cpu": "200m"}).obj())
+    rep, stats, scan, batch, inputs, _ = _solve_both(snap, pods)
+    assert (rep >= 0).all()
+    _assert_parity(rep, scan, batch, inputs, "host-anti")
+    for g in range(3):
+        nodes = rep[[i for i, p in enumerate(pods)
+                     if p.metadata.labels["grp"] == f"g{g}"]]
+        assert len(set(nodes.tolist())) == 8
+    # self-anti classes ride the cap-one propose: no repair rounds needed
+    assert stats.rounds == 0
+    assert stats.residual == 0
+
+
+def test_zone_anti_affinity_repairs_coarse_domain_collisions():
+    # 4 zones x 2 consecutive nodes: the masked propose can land two group
+    # members in one zone within a single call (cap-one is per NODE), so
+    # the final-state check + rip/reprieve rounds must resolve it
+    nodes = _nodes(8, zones=0)
+    for i, n in enumerate(nodes):
+        n.metadata.labels[ZONE] = f"zone-{i // 2}"
+    snap = _snap(nodes)
+    pods = [MakePod(f"z-{i}").labels({"grp": "z"})
+            .pod_anti_affinity(ZONE, {"grp": "z"})
+            .req({"cpu": "100m"}).obj() for i in range(4)]
+    rep, stats, scan, batch, inputs, _ = _solve_both(snap, pods)
+    assert (rep >= 0).all()
+    _assert_parity(rep, scan, batch, inputs, "zone-anti")
+    zones = {i // 2 for i in rep.tolist()}
+    assert len(zones) == 4  # one member per zone
+
+
+def test_zone_anti_affinity_infeasible_excess_matches_oracle():
+    # 6 members, 4 zones: exactly 2 are unschedulable — and they must be
+    # the SAME verdict the scan oracle returns (never silently dropped)
+    nodes = _nodes(8, zones=0)
+    for i, n in enumerate(nodes):
+        n.metadata.labels[ZONE] = f"zone-{i // 2}"
+    snap = _snap(nodes)
+    pods = [MakePod(f"x-{i}").labels({"grp": "x"})
+            .pod_anti_affinity(ZONE, {"grp": "x"})
+            .req({"cpu": "100m"}).obj() for i in range(6)]
+    rep, stats, scan, batch, inputs, _ = _solve_both(snap, pods)
+    assert int((rep < 0).sum()) == 2
+    _assert_parity(rep, scan, batch, inputs, "zone-anti-infeasible")
+    assert stats.full_scan or stats.residual > 0
+
+
+def test_repair_round_mixed_request_class_does_not_overcommit():
+    """One equivalence class spanning TWO request vectors
+    (pod_class_signature excludes resources): a repair round's re-propose
+    must regroup by the full (class, req) key — sizing capacity with
+    members[0]'s request for ALL ripped members would overcommit nodes
+    (caught by the validator's resource recount)."""
+    nodes = _nodes(12, cpu="4", mem="16Gi")
+    for i, n in enumerate(nodes):
+        n.metadata.labels[ZONE] = f"zone-{i // 2}"
+    snap = _snap(nodes)
+    pods = ([MakePod(f"ms-{i}").labels({"grp": "z"})
+             .pod_anti_affinity(ZONE, {"grp": "z"})
+             .req({"cpu": "2"}).obj() for i in range(4)]
+            + [MakePod(f"ml-{i}").labels({"grp": "z"})
+               .pod_anti_affinity(ZONE, {"grp": "z"})
+               .req({"cpu": "3"}).obj() for i in range(2)])
+    rep, stats, scan, batch, inputs, _ = _solve_both(snap, pods)
+    assert np.unique(np.asarray(batch.class_of_pod)).size == 1
+    assert stats.groups == 2  # same class, two request vectors
+    _assert_parity(rep, scan, batch, inputs, "mixed-req")
+    assert (rep >= 0).all()
+    assert len({int(nd) // 2 for nd in rep.tolist()}) == 6  # one per zone
+
+
+def test_required_affinity_colocates_with_seeds():
+    nodes = _nodes(32, zones=8)
+    seeds = [MakePod(f"seed-{z}").labels({"svc": f"s{z}"})
+             .node(f"node-{z}").req({"cpu": "100m"}).obj() for z in range(4)]
+    snap = _snap(nodes, bound=seeds)
+    pods = [MakePod(f"aff-{i}").labels({"peer": "1"})
+            .pod_affinity(ZONE, {"svc": f"s{i % 4}"})
+            .req({"cpu": "200m"}).obj() for i in range(16)]
+    rep, stats, scan, batch, inputs, _ = _solve_both(snap, pods)
+    assert (rep >= 0).all()
+    _assert_parity(rep, scan, batch, inputs, "affinity")
+    for i in range(16):
+        assert rep[i] % 8 == i % 4  # zone of seed s{i%4}
+
+
+def test_topology_spread_do_not_schedule_respects_skew():
+    snap = _snap(_nodes(20, zones=5))
+    pods = [MakePod(f"sp-{i}").labels({"app": "spread"})
+            .req({"cpu": "100m"})
+            .topology_spread(1, ZONE, "DoNotSchedule", {"app": "spread"})
+            .obj() for i in range(20)]
+    rep, stats, scan, batch, inputs, _ = _solve_both(snap, pods)
+    assert (rep >= 0).all()
+    _assert_parity(rep, scan, batch, inputs, "spread")
+    counts = np.bincount(rep % 5, minlength=5)
+    assert counts.max() - counts.min() <= 1
+
+
+def test_ns_selector_anti_affinity_merges_classes():
+    # the AntiAffinityNSSelector shape: one anti-affine group split over N
+    # namespaces compiles to N classes that differ only in namespace — the
+    # fingerprint merge must collapse them into ONE propose dispatch
+    snap = _snap(_nodes(32))
+    ns_labels = {f"team-{t}": {"team": "x"} for t in range(4)}
+    term = PodAffinityTerm(
+        topology_key=HOST,
+        selector=Selector.from_match_labels({"grp": "g0"}),
+        namespace_selector=Selector.from_match_labels({"team": "x"}))
+    pods = []
+    for i in range(12):
+        p = MakePod(f"nsa-{i}", namespace=f"team-{i % 4}").labels(
+            {"grp": "g0"}).req({"cpu": "200m"}).obj()
+        p.spec.affinity = Affinity(pod_anti_affinity_required=[term])
+        pods.append(p)
+    rep, stats, scan, batch, inputs, _ = _solve_both(
+        snap, pods, ns_labels=ns_labels)
+    assert (rep >= 0).all()
+    _assert_parity(rep, scan, batch, inputs, "ns-anti")
+    assert len(set(rep.tolist())) == 12  # hostname-anti across namespaces
+    assert stats.groups == 4  # one class per namespace
+    assert stats.propose_calls == 1  # byte-identical classes merged
+
+
+def test_mixed_constrained_and_unconstrained_classes_one_batch():
+    snap = _snap(_nodes(32))
+    pods = [MakePod(f"plain-{i}").req({"cpu": "100m"}).obj()
+            for i in range(10)]
+    pods += [MakePod(f"anti-{i}").labels({"grp": "m"})
+             .pod_anti_affinity(HOST, {"grp": "m"})
+             .req({"cpu": "100m"}).obj() for i in range(6)]
+    rep, stats, scan, batch, inputs, _ = _solve_both(snap, pods)
+    assert (rep >= 0).all()
+    _assert_parity(rep, scan, batch, inputs, "mixed")
+    anti_nodes = rep[10:]
+    assert len(set(anti_nodes.tolist())) == 6
+
+
+# ---------------------------------------------------------------------------
+# randomized scan-parity sweep (seeded, no hypothesis in the env)
+# ---------------------------------------------------------------------------
+
+
+def _random_scenario(rng):
+    n_zones = int(rng.integers(3, 6))
+    n_nodes = n_zones * int(rng.integers(2, 5))
+    nodes = _nodes(n_nodes, zones=n_zones, cpu="4", mem="16Gi")
+    pods = []
+    kind_bits = 1 + int(rng.integers(0, 7))
+    if kind_bits & 1:  # host-anti groups (sometimes infeasibly large)
+        for g in range(int(rng.integers(1, 3))):
+            size = int(rng.integers(2, n_nodes + 3))
+            for i in range(size):
+                pods.append(MakePod(f"ha-{g}-{i}").labels({"ha": f"g{g}"})
+                            .pod_anti_affinity(HOST, {"ha": f"g{g}"})
+                            .req({"cpu": "100m"}).obj())
+    if kind_bits & 2:  # zone-anti group (coarse domains force repair);
+        # MIXED request vectors within one class (pod_class_signature
+        # excludes resources) so repair-round re-proposes must regroup by
+        # the full (class, req) key — the validator's resource recount
+        # catches any member committed with another member's request
+        size = int(rng.integers(2, n_zones + 2))
+        for i in range(size):
+            cpu = "2" if rng.integers(0, 2) else "500m"
+            pods.append(MakePod(f"za-{i}").labels({"za": "1"})
+                        .pod_anti_affinity(ZONE, {"za": "1"})
+                        .req({"cpu": cpu}).obj())
+    if kind_bits & 4:  # DoNotSchedule spread
+        skew = int(rng.integers(1, 3))
+        for i in range(int(rng.integers(4, 16))):
+            pods.append(MakePod(f"sp-{i}").labels({"sp": "1"})
+                        .req({"cpu": "100m"})
+                        .topology_spread(skew, ZONE, "DoNotSchedule",
+                                         {"sp": "1"}).obj())
+    for i in range(int(rng.integers(0, 6))):  # unconstrained filler
+        pods.append(MakePod(f"f-{i}").req({"cpu": "100m"}).obj())
+    order = rng.permutation(len(pods))
+    return _snap(nodes), [pods[i] for i in order]
+
+
+def test_randomized_feasibility_parity_with_scan_oracle():
+    rng = np.random.default_rng(8)
+    for case in range(6):
+        snap, pods = _random_scenario(rng)
+        rep, stats, scan, batch, inputs, _ = _solve_both(snap, pods)
+        _assert_parity(rep, scan, batch, inputs, f"case{case}")
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the BatchScheduler routes constrained batches to repair
+# ---------------------------------------------------------------------------
+
+
+def _e2e(columnar, solver="fast"):
+    store = APIStore()
+    for n in _nodes(32):
+        store.create("nodes", n)
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=256, solver=solver, columnar=columnar,
+                           pipeline_binds=False)
+    sched.sync()
+    pods = []
+    for g in range(3):
+        for i in range(6):
+            pods.append(MakePod(f"e-{g}-{i}").labels({"grp": f"g{g}"})
+                        .pod_anti_affinity(HOST, {"grp": f"g{g}"})
+                        .req({"cpu": "200m"}).obj())
+    store.create_many("pods", pods, consume=True)
+    sched.run_until_idle()
+    bound = {p.metadata.name: p.spec.node_name
+             for p in store.list("pods")[0] if p.spec.node_name}
+    return sched, bound
+
+
+@pytest.mark.parametrize("columnar", [True, False])
+def test_e2e_constrained_batch_rides_repair_both_modes(columnar):
+    sched, bound = _e2e(columnar)
+    assert sched._solve_path == "repair"
+    assert sched.scheduled_count == 18
+    assert len(bound) == 18
+    for g in range(3):
+        nodes = [bound[f"e-{g}-{i}"] for i in range(6)]
+        assert len(set(nodes)) == 6, nodes
+    assert sched.repair_totals["batches"] >= 1
+
+
+def test_e2e_exact_mode_still_owns_constrained_batches():
+    sched, bound = _e2e(True, solver="exact")
+    assert sched._solve_path == "exact"
+    assert len(bound) == 18
+    assert sched.repair_totals["batches"] == 0
+
+
+# ---------------------------------------------------------------------------
+# gang serial-fallback veto (ISSUE 8 satellite; ROADMAP direction 4)
+# ---------------------------------------------------------------------------
+
+
+def test_gang_with_serial_fallback_member_is_vetoed_not_split():
+    from kubernetes_tpu.server import metrics as m
+
+    before = m.gang_vetoed_total.value(reason="serial_fallback")
+    store = APIStore()
+    for n in _nodes(16):
+        store.create("nodes", n)
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=64, solver="fast",
+                           pipeline_binds=False)
+    sched.sync()
+    store.create("podgroups", make_pod_group("train-v", 3))
+    pods = [MakePod(f"gv-{i}").gang("train-v").req({"cpu": "200m"}).obj()
+            for i in range(2)]
+    # the third member's PVC volume routes its class to the serial fallback
+    pods.append(MakePod("gv-2").gang("train-v").req({"cpu": "200m"})
+                .pvc("claim-a").obj())
+    store.create_many("pods", pods, consume=True)
+    sched.run_until_idle()
+    # all-or-nothing: NO member schedules individually — the gang is vetoed
+    # with a narrated reason instead of silently splitting
+    assert sched.scheduled_count == 0
+    assert all(not p.spec.node_name for p in store.list("pods")[0])
+    assert sched.gang_vetoes >= 1
+    assert m.gang_vetoed_total.value(reason="serial_fallback") - before == 1
+    events = [e for e in store.list("events")[0]
+              if e.reason == "GangVetoed"]
+    assert events and "serial-fallback" in events[0].message
+
+
+def test_gang_free_fallback_pods_still_schedule_serially():
+    from kubernetes_tpu.api.storage import (CLAIM_BOUND, VOLUME_BOUND,
+                                            PersistentVolume,
+                                            PersistentVolumeClaim)
+    from kubernetes_tpu.api.types import ObjectMeta
+
+    store = APIStore()
+    for n in _nodes(8):
+        store.create("nodes", n)
+    pvc = PersistentVolumeClaim(
+        metadata=ObjectMeta(name="claim-b", namespace="default"))
+    pvc.spec.access_modes = ["ReadWriteOnce"]
+    pvc.spec.storage_class_name = "std"
+    pvc.spec.volume_name = "pv-b"
+    pvc.phase = CLAIM_BOUND
+    store.create("persistentvolumeclaims", pvc)
+    pv = PersistentVolume(metadata=ObjectMeta(name="pv-b"))
+    pv.spec.capacity = 100
+    pv.spec.access_modes = ["ReadWriteOnce"]
+    pv.spec.storage_class_name = "std"
+    pv.spec.claim_ref = "default/claim-b"
+    pv.phase = VOLUME_BOUND
+    store.create("persistentvolumes", pv)
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=64, solver="fast",
+                           pipeline_binds=False)
+    sched.sync()
+    store.create("pods", MakePod("vol-1").req({"cpu": "200m"})
+                 .pvc("claim-b").obj())
+    sched.run_until_idle()
+    assert sched.scheduled_count == 1  # non-gang fallback pods unaffected
+
+
+# ---------------------------------------------------------------------------
+# observability: metrics, flight record, sched_stats
+# ---------------------------------------------------------------------------
+
+
+def test_repair_observability_rounds_and_violations():
+    from kubernetes_tpu.server import metrics as m
+
+    rounds_before = m.constraint_repair_rounds.snapshot()[1]
+    viol_before = m.constraint_violations_total.value(kind="anti_affinity")
+    store = APIStore()
+    nodes = _nodes(8)
+    for i, n in enumerate(nodes):
+        n.metadata.labels[ZONE] = f"zone-{i // 2}"
+        store.create("nodes", n)
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=64, solver="fast",
+                           pipeline_binds=False)
+    sched.sync()
+    store.create_many(
+        "pods", [MakePod(f"zo-{i}").labels({"grp": "z"})
+                 .pod_anti_affinity(ZONE, {"grp": "z"})
+                 .req({"cpu": "100m"}).obj() for i in range(4)],
+        consume=True)
+    sched.run_until_idle()
+    assert sched.scheduled_count == 4
+    assert m.constraint_repair_rounds.snapshot()[1] > rounds_before
+    # the coarse-domain collision surfaced as at least one counted violation
+    assert (m.constraint_violations_total.value(kind="anti_affinity")
+            > viol_before)
+    st = sched.sched_stats()
+    assert st["repair"]["batches"] >= 1
+    assert st["repair"]["violations"] >= 1
+    rec = [r for r in sched.flightrec.records() if r.get("repair")]
+    assert rec, "constrained batch left no repair field in flight records"
+    assert rec[-1]["repair"]["proposed"] >= 1
+
+
+def test_ktl_sched_stats_renders_repair_line():
+    from kubernetes_tpu.cli.ktl import _render_sched_stats
+
+    doc = {"sched": {
+        "solver": "fast", "batches_solved": 3, "scheduled": 10, "failed": 0,
+        "queue": {"active": 0, "backoff": 0, "unschedulable": 0,
+                  "gang_staged": 0, "oldest_pending_age_s": 0.0},
+        "recorder": {"enabled": True, "records": 3, "capacity": 256},
+        "repair": {"batches": 2, "rounds": 1, "proposed": 20, "repaired": 2,
+                   "residual": 0, "full_scan": 0, "violations": 3,
+                   "last": {"proposed": 12, "rounds": 1, "residual": 0}},
+        "breaker": {"state": "closed", "trips": 0, "recoveries": 0},
+        "bind_worker": {"restarts": 0, "failures_dropped": 0},
+        "stages": {}, "last_batch": None}}
+    out = _render_sched_stats(doc)
+    assert "constraint repair:" in out
+    assert "violations=3" in out
+    assert "last: proposed=12" in out
+
+
+def test_repair_decline_falls_back_to_scan_path():
+    # a monkeypatched decline (shape too large) must degrade to the exact
+    # scan exactly like waterfill_solve declining — pods still place
+    import kubernetes_tpu.models.repair as repair_mod
+
+    store = APIStore()
+    for n in _nodes(8):
+        store.create("nodes", n)
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=64, solver="fast",
+                           pipeline_binds=False)
+    sched.sync()
+    orig = repair_mod.repair_solve
+    try:
+        repair_mod.repair_solve = lambda *a, **kw: None
+        store.create_many(
+            "pods", [MakePod(f"dc-{i}").labels({"grp": "d"})
+                     .pod_anti_affinity(HOST, {"grp": "d"})
+                     .req({"cpu": "100m"}).obj() for i in range(4)],
+            consume=True)
+        sched.run_until_idle()
+    finally:
+        repair_mod.repair_solve = orig
+    assert sched.scheduled_count == 4
+    assert sched._solve_path == "exact"

@@ -11,6 +11,7 @@ batches and pins the cache size; bench.py --quick surfaces the same signal
 as `jit_cache` / `solver_compiles_during_run` in the end-to-end rung JSON.
 """
 
+from kubernetes_tpu.models.repair import repair_check
 from kubernetes_tpu.models.waterfill import waterfill_group
 from kubernetes_tpu.scheduler import Framework
 from kubernetes_tpu.scheduler.batch import BatchScheduler
@@ -21,6 +22,10 @@ from kubernetes_tpu.testing import MakeNode, MakePod
 
 def _cache_size():
     return int(waterfill_group._cache_size())
+
+
+def _repair_cache_size():
+    return int(repair_check._cache_size())
 
 
 def _synced_sched(n_nodes=16):
@@ -70,3 +75,67 @@ def test_batch_size_jitter_within_bucket_does_not_retrace():
     for round_no, n in ((11, 17), (12, 130), (13, 3)):
         _batch(store, sched, round_no, n)
     assert _cache_size() == warm
+
+
+# -- ISSUE 8: the repair kernel's static gates ------------------------------
+
+
+def _synced_hostname_sched(n_nodes=64):
+    store = APIStore()
+    for i in range(n_nodes):
+        store.create("nodes", MakeNode(f"node-{i}").labels(
+            {"kubernetes.io/hostname": f"node-{i}"}).capacity(
+            {"cpu": "64", "memory": "256Gi", "pods": "110"}).obj())
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=1024, solver="fast",
+                           pipeline_binds=False)
+    sched.sync()
+    return store, sched
+
+
+def _anti_batch(store, sched, round_no, n_pods):
+    """One constrained batch: n_pods hostname-anti-affine pods sharing ONE
+    selector (a stable selector keeps the selcls/holder-group tensor widths
+    — and therefore the repair_check shapes — fixed across rounds)."""
+    store.create_many(
+        "pods",
+        [MakePod(f"a{round_no}-p{i}").labels({"anti": "one"})
+         .pod_anti_affinity("kubernetes.io/hostname", {"anti": "one"})
+         .req({"cpu": "100m", "memory": "64Mi"}).obj()
+         for i in range(n_pods)],
+        consume=True)
+    before = sched.scheduled_count
+    sched.run_until_idle()
+    assert sched.scheduled_count - before == n_pods
+    assert sched._solve_path == "repair"
+
+
+def test_mixed_constrained_batches_do_not_retrace():
+    """Alternating constrained/unconstrained batches share compiled shapes:
+    the repair kernel buckets its pod axis to pow2 (floored at 256), gates
+    constraint families with static bools (has_affinity / has_ct), and the
+    cap-one propose pins run_j=1 — so a mixed steady state compiles each
+    variant ONCE (the acceptance gate behind `solver_compiles_during_run`)."""
+    store, sched = _synced_hostname_sched()
+    # warm every shape: two constrained rounds (the second sees the first's
+    # bound pods as existing holders — the holder-group tables go from
+    # empty-padded to populated exactly once) and one unconstrained round
+    _batch(store, sched, 20, 48)
+    _anti_batch(store, sched, 21, 8)
+    _anti_batch(store, sched, 22, 6)
+    warm_wf = _cache_size()
+    warm_rc = _repair_cache_size()
+    assert warm_rc >= 1
+    plan = (("plain", 23, 17), ("anti", 24, 12), ("plain", 25, 130),
+            ("anti", 26, 3), ("plain", 27, 48), ("anti", 28, 9))
+    for kind, round_no, n in plan:
+        if kind == "plain":
+            _batch(store, sched, round_no, n)
+        else:
+            _anti_batch(store, sched, round_no, n)
+        assert _cache_size() == warm_wf, (
+            f"waterfill retraced on {kind} round {round_no}: "
+            f"{warm_wf} -> {_cache_size()}")
+        assert _repair_cache_size() == warm_rc, (
+            f"repair_check retraced on {kind} round {round_no}: "
+            f"{warm_rc} -> {_repair_cache_size()}")

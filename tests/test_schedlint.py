@@ -29,8 +29,13 @@ def test_tree_is_clean_and_suppressions_carry_reasons():
     # SL001 findings are reasonless suppressions; anything else is a real
     # invariant violation — both fail the gate
     assert findings == [], "\n".join(f.render() for f in findings)
-    # the shipped tree documents its intentional exceptions inline
-    assert stats["suppressed"] >= 3
+    # the shipped tree documents its intentional exceptions inline (the
+    # Watch._deliver* wake pings; waterfill's raw-headroom jit fallback
+    # moved into the bucket_j_max helper in ISSUE 8, where the accepted
+    # recompile is documented in the docstring instead of an allow — JT001
+    # anchors witnesses inside one function and the raw value now crosses a
+    # helper return)
+    assert stats["suppressed"] >= 2
 
 
 def test_wall_time_stays_cheap():
@@ -307,6 +312,101 @@ def test_jt002_fires_on_host_sync_inside_jit_bodies():
 
 def test_jt002_quiet_outside_the_jit_boundary():
     assert "JT002" not in rules_of(analyze_source(JT002_GOOD))
+
+
+# ISSUE 8: the repair kernel's static-gate discipline. The propose-and-
+# repair solver (models/repair.py) keys its jitted violation check on bool
+# constraint gates and a pow2-bucketed pod axis; the bug class JT001 guards
+# is someone keying it on the raw batch length or a raw round count instead
+# (one compile per batch size / per repair round — tens of seconds each at
+# TPU scale).
+
+JT001_REPAIR_BAD = '''
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("pb", "has_affinity"))
+def repair_check(node_of, pb, has_affinity=True):
+    return node_of[:pb]
+
+def check(assignment, violators):
+    # raw lengths key the jit: a compile per batch size AND per violator
+    # count — the exact retrace class the pow2 bucket exists to prevent
+    return repair_check(assignment, pb=len(assignment),
+                        has_affinity=len(violators) > 0)
+'''
+
+JT001_REPAIR_GOOD = '''
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("pb", "has_affinity", "has_ct"))
+def repair_check(node_of, pb, has_affinity=True, has_ct=True):
+    return node_of[:pb]
+
+def check(assignment, batch, p):
+    # the shipped discipline: pow2 pod-axis bucket (floored so small
+    # batches share one shape) + bool constraint-family gates
+    pb = max(256, 1 << (p - 1).bit_length())
+    return repair_check(assignment, pb=pb,
+                        has_affinity=bool(batch.ipa.has_any),
+                        has_ct=bool(batch.ct_class.size))
+'''
+
+
+def test_jt001_fires_on_repair_kernel_raw_static_keys():
+    findings = [f for f in analyze_source(JT001_REPAIR_BAD)
+                if f.rule == "JT001"]
+    assert len(findings) >= 1, findings
+    assert any("pb" in f.message for f in findings)
+
+
+def test_jt001_quiet_on_repair_kernel_shipped_gates():
+    assert "JT001" not in rules_of(analyze_source(JT001_REPAIR_GOOD))
+
+
+JT002_REPAIR_BAD = '''
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@functools.partial(jax.jit, static_argnames=("d_max",))
+def repair_check(node_of, counts, d_max):
+    placed = node_of >= 0
+    host = np.nonzero(np.asarray(placed))[0]   # numpy readback INSIDE jit
+    return host
+
+def violators(node_of, counts, d_max):
+    return repair_check(node_of, counts, d_max)
+'''
+
+JT002_REPAIR_GOOD = '''
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@functools.partial(jax.jit, static_argnames=("d_max",))
+def repair_check(node_of, counts, d_max):
+    return node_of >= 0
+
+def violators(node_of, counts, d_max, p):
+    # the shipped discipline: the host readback (_check's np.asarray +
+    # nonzero) happens OUTSIDE the traced body, once per round
+    v = repair_check(node_of, counts, d_max)
+    return np.nonzero(np.asarray(v)[:p])[0]
+'''
+
+
+def test_jt002_fires_on_host_readback_inside_repair_kernel():
+    findings = [f for f in analyze_source(JT002_REPAIR_BAD)
+                if f.rule == "JT002"]
+    assert len(findings) >= 1, findings
+
+
+def test_jt002_quiet_on_host_readback_outside_repair_kernel():
+    assert "JT002" not in rules_of(analyze_source(JT002_REPAIR_GOOD))
 
 
 HP001_BAD = '''
