@@ -546,7 +546,26 @@ def rung_north_star_endtoend(results):
 
         latency = sched.podtrace.latency_stats()
         tsnap = sched.podtrace.snapshot()
+        # control-plane observability columns (ISSUE 9): the scheduler's own
+        # coalesced subscriber gives the commit->dequeue propagation of the
+        # whole ingest path; controller columns are empty here (no
+        # controllers in this rung) but published so the schema is uniform
+        from kubernetes_tpu.obs.reconcile import reconcile_rollup
+
+        wtel = store.watch_telemetry()
+        prop = wtel["propagation"]
+        watch_col = {
+            "propagation_count": prop["count"],
+            "propagation_p50_s": prop["p50_s"],
+            "propagation_p99_s": prop["p99_s"],
+            "settle_s": prop["settle_seconds"],
+            "subscribers": len(wtel["subscribers"]),
+            "max_rv_lag": max((s["rv_lag"] for s in wtel["subscribers"]),
+                              default=0),
+        }
         compiles = sum(compiles_during.values())
+        # the <2% budget now covers the new recorders too: inline watch-tap
+        # settlement already bills flightrec via the Watch stat_sink
         instr_frac = sched.flightrec.self_seconds / max(dt, 1e-9)
         slo = evaluate_slo(
             {"stages": table, "latency": latency}, NORTH_STAR_SLO,
@@ -566,6 +585,8 @@ def rung_north_star_endtoend(results):
                                       if s["complete"]),
                       "evicted_incomplete": tsnap["evicted_incomplete"],
                       "flush_s": tsnap["flush_seconds"]},
+            "watch": watch_col,
+            "reconcile": reconcile_rollup(),
             "slo": slo,
             "instrumentation_s": round(sched.flightrec.self_seconds, 6),
             "jit_cache": jit_cache,
@@ -870,6 +891,17 @@ def rung_chaos_churn(results):
         tsnap = sched.podtrace.snapshot()
         n_spans = len(tsnap["spans"])
         n_complete = sum(1 for s in tsnap["spans"] if s["complete"])
+        # watch-propagation column (ISSUE 9): chaos drops and the breaker
+        # excursion show up as commit->dequeue tail + counted drops
+        wtel = store.watch_telemetry()
+        prop = wtel["propagation"]
+        watch_col = {
+            "propagation_count": prop["count"],
+            "propagation_p50_s": prop["p50_s"],
+            "propagation_p99_s": prop["p99_s"],
+            "subscribers": len(wtel["subscribers"]),
+            "dropped": wtel["dropped"],
+        }
         slo = evaluate_slo({"latency": latency}, CHAOS_SLO)
         trace_ok = (n_spans > 0 and n_complete == n_spans
                     and latency["count"] > 0
@@ -886,6 +918,7 @@ def rung_chaos_churn(results):
             "latency": latency,
             "trace": {"spans": n_spans, "complete": n_complete,
                       "evicted_incomplete": tsnap["evicted_incomplete"]},
+            "watch": watch_col,
             "trace_ok": trace_ok, "slo": slo,
             "disabled_check_ns": round(fi.disabled_check_cost_ns(), 2),
             "solver": "fast+breaker+chaos"}
@@ -903,6 +936,185 @@ def rung_chaos_churn(results):
         fi.disarm()  # never leak an armed injector into later rungs
         results["ChaosChurn_20k"] = {"error": str(e)[:200]}
         print(f"ChaosChurn_20k: ERROR {e}", file=sys.stderr)
+
+
+def rung_control_plane(results):
+    """ControlPlane_churn (ISSUE 9): the WHOLE "watch, reconcile, write
+    status" loop — deployment rollout + node drain + eviction/replace driven
+    through the controllers, hollow kubelets, and the batch scheduler, with
+    the control-plane flight recorder measuring it all: per-controller
+    reconcile-loop p99s (obs/reconcile.py), store watch-propagation
+    commit->dequeue latency + delivered-RV lag, and submit->running spans
+    with evict->replace causal links. Gated by CONTROL_PLANE_SLO
+    (watch_propagation_p99_s / reconcile_p99_ms), asserted PASS by
+    tests/test_bench_quick.py. Fixed-size like the gang rung: the rung IS
+    the quick-tier control-plane smoke and runs in seconds."""
+    from kubernetes_tpu.agent import HollowKubelet
+    from kubernetes_tpu.api.types import Taint, TAINT_NO_EXECUTE
+    from kubernetes_tpu.api.workloads import Deployment
+    from kubernetes_tpu.controllers import (DeploymentController,
+                                            ReplicaSetController,
+                                            TaintEvictionController)
+    from kubernetes_tpu.obs.reconcile import (controlstats_snapshot,
+                                              reconcile_rollup)
+    from kubernetes_tpu.scheduler import Framework
+    from kubernetes_tpu.scheduler.batch import BatchScheduler
+    from kubernetes_tpu.scheduler.plugins import default_plugins
+    from kubernetes_tpu.scheduler.slo import CONTROL_PLANE_SLO, evaluate_slo
+    from kubernetes_tpu.store import APIStore
+
+    try:
+        n_nodes, replicas = 24, 192
+        store = APIStore()
+        kubelets = [HollowKubelet(store, f"hollow-{i}",
+                                  capacity={"cpu": "16", "memory": "64Gi",
+                                            "pods": "110"})
+                    for i in range(n_nodes)]
+        for k in kubelets:
+            k.register()
+        sched = BatchScheduler(store, Framework(default_plugins()),
+                               batch_size=1024, solver="exact",
+                               trace_sample_k=256)  # sample every pod: the
+        # evict->replace chain assertions need both ends of every link
+        sched.sync()
+        dc = DeploymentController(store)
+        rsc = ReplicaSetController(store)
+        te = TaintEvictionController(store)
+        for c in (dc, rsc, te):
+            c.sync_all()
+        controllers = (dc, rsc, te)
+
+        def drive(rounds, done):
+            for _ in range(rounds):
+                for c in controllers:
+                    c.reconcile_once()
+                te.tick()  # fire due timed evictions
+                sched.run_until_idle()
+                for k in kubelets:
+                    k.pump()
+                if done():
+                    return True
+            return done()
+
+        def pods_running():
+            pods, _ = store.list("pods")
+            return bool(pods) and all(
+                p.spec.node_name and p.status.phase == "Running"
+                for p in pods)
+
+        store.create("deployments", Deployment.from_dict({
+            "metadata": {"name": "cp-web"},
+            "spec": {
+                "replicas": replicas,
+                # wide surge budget: the rung measures the control plane
+                # under bulk churn, not the default one-pod-per-round crawl
+                "strategy": {"type": "RollingUpdate",
+                             "rollingUpdate": {"maxSurge": 64,
+                                               "maxUnavailable": 64}},
+                "selector": {"matchLabels": {"app": "cp-web"}},
+                "template": {
+                    "metadata": {"labels": {"app": "cp-web"}},
+                    "spec": {"containers": [{"name": "c", "image": "v1",
+                                             "resources": {"requests": {
+                                                 "cpu": "100m"}}}]}},
+            },
+        }))
+        # warm phase: initial rollout to Running (includes the solver's one
+        # jit compile) — NOT measured; the churn window below is
+        assert drive(30, pods_running), "initial rollout"
+        # measured window starts here (the flightrec.clear() idiom)
+        store.clear_watch_propagation()
+        for c in controllers:
+            c.recorder.clear()
+        t0 = time.perf_counter()
+
+        # (1) rolling update: new template -> new RS -> replace all pods
+        def set_image(d):
+            d.spec.template.spec.containers[0].image = "v2"
+            return d
+
+        store.guaranteed_update("deployments", "default/cp-web", set_image)
+
+        def rolled():
+            pods, _ = store.list("pods")
+            new = [p for p in pods if any(
+                c.image == "v2" for c in p.spec.containers)]
+            return (len(new) >= replicas and all(
+                p.spec.node_name and p.status.phase == "Running"
+                for p in new))
+
+        assert drive(60, rolled), "rolling update did not converge"
+
+        # (2) node drain: NoExecute taint -> tainteviction evicts ->
+        # ReplicaSet replaces -> scheduler re-places off the drained node
+        drained = "hollow-0"
+        node = store.get("nodes", drained)
+        victims = sum(1 for p in store.list("pods")[0]
+                      if p.spec.node_name == drained)
+        node.spec.taints = list(node.spec.taints) + [
+            Taint(key="bench/drain", effect=TAINT_NO_EXECUTE)]
+        store.update("nodes", node, check_rv=False)
+
+        def drained_done():
+            pods, _ = store.list("pods")
+            on_node = [p for p in pods if p.spec.node_name == drained]
+            return (not on_node and len(pods) >= replicas
+                    and pods_running())
+
+        assert drive(60, drained_done), "drain/replace did not converge"
+        dt = time.perf_counter() - t0
+
+        # collect: controller reconcile rollup + watch propagation + spans
+        snap = controlstats_snapshot()
+        snap = {k: v for k, v in snap.items()
+                if k in ("DeploymentController", "ReplicaSetController",
+                         "TaintEvictionController")}
+        roll = reconcile_rollup(snap)
+        tel = store.watch_telemetry()
+        prop = tel["propagation"]
+        max_lag = max((s["rv_lag"] for s in tel["subscribers"]), default=0)
+        tsnap = sched.podtrace.snapshot()
+        chains = sum(1 for s in tsnap["spans"] if s.get("replaces"))
+        chain_complete = sum(1 for s in tsnap["spans"]
+                             if s.get("replaces") and s["complete"])
+        running_spans = sum(1 for s in tsnap["spans"]
+                            if s.get("submit_to_running_ms") is not None)
+        slo = evaluate_slo({"watch": {"propagation": prop},
+                            "reconcile": roll}, CONTROL_PLANE_SLO)
+        ok = (slo["pass"] and not slo["skipped"] and victims > 0
+              and chains >= 1 and chain_complete == chains
+              and running_spans > 0)
+        results["ControlPlane_churn"] = {
+            "pods_per_sec": round(replicas / dt, 1), "wall_s": round(dt, 3),
+            "pods": replicas, "nodes": n_nodes, "evicted_from_drain": victims,
+            "watch": {"propagation_count": prop["count"],
+                      "propagation_p50_s": prop["p50_s"],
+                      "propagation_p99_s": prop["p99_s"],
+                      "subscribers": len(tel["subscribers"]),
+                      "max_rv_lag": max_lag,
+                      "settle_s": prop["settle_seconds"]},
+            "reconcile": roll,
+            "controllers": {name: {"loops": st.get("loops"),
+                                   "keys": st.get("keys"),
+                                   "errors": st.get("errors"),
+                                   "p99_ms": st.get("reconcile_p99_ms")}
+                            for name, st in snap.items()},
+            "trace": {"spans": len(tsnap["spans"]),
+                      "evict_replace_chains": chains,
+                      "chains_complete": chain_complete,
+                      "running_spans": running_spans},
+            "slo": slo, "controlplane_ok": ok,
+            "solver": "exact+controllers+kubelets"}
+        print(f"{'ControlPlane_churn':>28}: rollout+drain of {replicas} pods "
+              f"in {dt:.2f}s  (propagation p99={prop['p99_s']}s over "
+              f"{prop['count']} deliveries, worst reconcile p99="
+              f"{roll['p99_ms']}ms [{roll['worst_controller']}], "
+              f"{chains} evict->replace chains, SLO "
+              f"{'PASS' if slo['pass'] else 'FAIL ' + str(slo['failed'])})",
+              file=sys.stderr)
+    except Exception as e:
+        results["ControlPlane_churn"] = {"error": str(e)[:200]}
+        print(f"ControlPlane_churn: ERROR {e}", file=sys.stderr)
 
 
 def rung_transport(results):
@@ -1147,6 +1359,7 @@ RUNGS = [
     ("BindCommit", rung_bind_commit),
     ("GangScheduling", rung_gang),
     ("ChaosChurn", rung_chaos_churn),
+    ("ControlPlane", rung_control_plane),
     ("SchedLint", rung_schedlint),
     ("Transport", rung_transport),
     ("ApiserverWatchFanout", rung_watch_fanout),
@@ -1157,8 +1370,9 @@ RUNGS = [
 # stdout. Catches perf-path regressions (a broken coalesced ingest or bind
 # path fails loudly here) without the full ladder's budget.
 QUICK_RUNGS = ("SchedulingBasic", "MixedChurn", "NorthStarEndToEnd",
-               "BindCommit", "GangScheduling", "ChaosChurn", "SchedLint")
-QUICK_BUDGET_S = 75.0
+               "BindCommit", "GangScheduling", "ChaosChurn", "ControlPlane",
+               "SchedLint")
+QUICK_BUDGET_S = 95.0
 
 
 def cpu_fallback(reason: str) -> int:

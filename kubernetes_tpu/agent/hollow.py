@@ -22,6 +22,20 @@ from ..utils import Clock
 
 LEASE_NAMESPACE = "kube-node-lease"
 
+_podtrace = None
+
+
+def _trace():
+    """scheduler.podtrace, imported on first use: the submit->running span
+    taps (ISSUE 9) must not make the node agent import the scheduler stack
+    at module load. note_pod_event is an O(1) no-op for unsampled pods."""
+    global _podtrace
+    if _podtrace is None:
+        from ..scheduler import podtrace as _pt
+
+        _podtrace = _pt
+    return _podtrace
+
 
 class HollowKubelet:
     def __init__(self, store: APIStore, node_name: str, capacity: Optional[Dict] = None,
@@ -102,6 +116,7 @@ class HollowKubelet:
                     self.running_pods.pop(key, None)
             return 0
         n = 0
+        pt = _trace()
         for ev in self._watch.drain():
             pod = ev.obj
             if pod.spec.node_name != self.node_name:
@@ -109,17 +124,24 @@ class HollowKubelet:
             if ev.type == "DELETED":
                 self.running_pods.pop(pod.key, None)
             elif not pod.is_terminal() and pod.key not in self.running_pods:
+                # submit->running span edge (ISSUE 9): the pod's bind event
+                # was dequeued by ITS kubelet — the watch-delivery leg of
+                # the true end-to-end latency. O(1) no-op when unsampled.
+                pt.note_pod_event(pod.key, "watch_delivered")
                 self._run_pod(pod)
             n += 1
         return n
 
     def _run_pod(self, pod) -> None:
+        pt = _trace()
+        pt.note_pod_event(pod.key, "kubelet_observed")
         self.running_pods[pod.key] = RUNNING
         try:
             self.store.update_pod_status(
                 pod.metadata.namespace, pod.metadata.name,
                 lambda st: setattr(st, "phase", RUNNING),
             )
+            pt.note_pod_event(pod.key, "running")
         except (NotFoundError, ConflictError):
             self.running_pods.pop(pod.key, None)
 

@@ -235,6 +235,18 @@ class ProjectIndex:
                     return got
         return None
 
+    # Method names owned by ubiquitous library types (ndarray reductions,
+    # dict/list/set/queue protocol): `arr.all()` in a jitted kernel must NOT
+    # resolve to the one project class that happens to define `all` — that
+    # exact chain (greedy_scan_solve -> DynamicRegistry.all -> Watch.drain)
+    # dragged the whole watch bus into JT002's traced set. Uniqueness-based
+    # resolution skips these; self.m() and bare-name calls still resolve.
+    _LIBRARY_METHODS = frozenset((
+        "all", "any", "sum", "mean", "min", "max", "item", "items", "keys",
+        "values", "get", "put", "pop", "append", "extend", "add", "update",
+        "clear", "copy", "sort", "join", "split", "read", "write", "close",
+        "tolist", "astype", "reshape"))
+
     def resolve_call(self, fi: FileIndex, caller: Optional[FuncInfo],
                      call: ast.Call) -> Optional[FuncInfo]:
         func = call.func
@@ -248,6 +260,8 @@ class ProjectIndex:
                 if got is not None:
                     return got
             # obj.m(): unique method name across the analyzed tree
+            if func.attr in self._LIBRARY_METHODS:
+                return None
             candidates = self.methods_by_name.get(func.attr, ())
             if len(candidates) == 1:
                 return candidates[0]

@@ -1,5 +1,6 @@
 """HP001 — per-pod instrumentation inside batch loops of the hot scheduler
-files (scheduler/batch.py and scheduler/podtrace.py).
+files (scheduler/batch.py and scheduler/podtrace.py) and the controller
+reconcile loops (controllers/base.py, ISSUE 9).
 
 The flight recorder's contract (scheduler/flightrec.py, ROADMAP
 instrumentation budget <2%) is "per BATCH, never per pod": stage marks,
@@ -22,6 +23,15 @@ population to K reservoir slots while unsampled pods pay one set lookup.
 Instrumentation calls lexically inside an `if` whose test contains an
 `x in <something named *sampled*>` comparison are therefore allowed; the
 same call unguarded is a finding.
+
+Reconcile loops (ISSUE 9): controllers/base.py drains its workqueue
+(`for key in keys:`) and its watch buffer (`for ev in <watch>.drain(...):`)
+at event scale — a 10k-object relist marks 10k keys per drain. The
+ReconcileRecorder taps are per LOOP (two perf_counter reads around the
+whole drain, one recorder.loop()/pump() call); per-key instrumentation
+inside those loops is the same multiplier bug as per-pod stamping in
+batch.py. `.drain(...)` iterables are recognized as event-scale regardless
+of the receiver expression.
 """
 
 from __future__ import annotations
@@ -33,7 +43,8 @@ from typing import List, Optional
 from ..findings import Finding
 from ..index import ProjectIndex
 
-HOT_FILE_SUFFIXES = ("scheduler/batch.py", "scheduler/podtrace.py")
+HOT_FILE_SUFFIXES = ("scheduler/batch.py", "scheduler/podtrace.py",
+                     "controllers/base.py")
 
 POD_SCALE = re.compile(
     r"^(qps|pods|pending|items|to_bind|bind_rows|bind_nodes|bind_gang|"
@@ -65,6 +76,10 @@ def _root_name(expr: ast.AST) -> Optional[str]:
             f = node.func
             # look through .tolist()/.items()/.values() etc
             if isinstance(f, ast.Attribute):
+                if f.attr == "drain":
+                    # a watch-buffer drain is event-scale whatever the
+                    # receiver is called (self._watch.drain(n), w.drain())
+                    return "events"
                 node = f.value
             elif isinstance(f, ast.Name) and f.id in (
                     "enumerate", "zip", "sorted", "reversed", "list",

@@ -5,7 +5,8 @@ Talks HTTP to the API server (KTL_SERVER env or --server).
 
 Commands: get, describe, create -f, apply -f (server-side merge patch),
 delete, scale, cordon, uncordon, taint, drain, label, annotate, patch,
-rollout status|restart, set image, top nodes|pods, sched stats, vet
+rollout status|restart, set image, top nodes|pods, sched stats|trace|slo,
+controller stats (reconcile-loop telemetry from /debug/controlstats), vet
 (schedlint — the local static-analysis gate, no apiserver needed), wait,
 autoscale, api-resources, version.
 """
@@ -1336,6 +1337,19 @@ def _render_sched_stats(doc: Dict) -> str:
                 + (f"   last: proposed={last.get('proposed', 0)} "
                    f"rounds={last.get('rounds', 0)} "
                    f"residual={last.get('residual', 0)}" if last else ""))
+        watch = st.get("watch") or {}
+        prop = watch.get("propagation") or {}
+        if prop.get("count"):
+            # watch-propagation line (ISSUE 9): commit->dequeue latency of
+            # the store's watch bus plus the worst subscriber RV lag
+            out.append(
+                f"watch bus: subscribers={watch.get('subscribers', 0)} "
+                f"max_rv_lag={watch.get('max_rv_lag', 0)} "
+                f"propagation p50={prop.get('p50_s', 0) or 0:.4f}s "
+                f"p99={prop.get('p99_s', 0) or 0:.4f}s "
+                f"over {prop['count']} deliveries"
+                + (f" dropped={watch.get('dropped')}"
+                   if watch.get("dropped") else ""))
         brk = st.get("breaker")
         bw = st.get("bind_worker")
         if brk and (brk.get("state") != "closed" or brk.get("trips")
@@ -1503,6 +1517,84 @@ def cmd_sched(client: RESTClient, args) -> int:
         print(rendered)
         if not args.watch:
             return rc
+        sys.stdout.flush()
+        _time.sleep(args.interval)
+
+
+def _render_controller_stats(doc: Dict) -> str:
+    """The control-plane flight recorder (ISSUE 9): one row per live
+    controller (loops/keys/errors/depth + sync p50/p99), the cross-
+    controller reconcile rollup, and the server store's watch-bus
+    propagation/lag summary."""
+    ctrls = doc.get("controllers") or {}
+    out = []
+    roll = doc.get("reconcile") or {}
+    if roll:
+        p99 = roll.get("p99_ms")
+        out.append(
+            f"reconcile: controllers={roll.get('controllers', 0)} "
+            f"loops={roll.get('loops', 0)} keys={roll.get('keys', 0)} "
+            f"errors={roll.get('errors', 0)} "
+            f"worst_p99={p99 if p99 is not None else '-'}ms"
+            + (f" ({roll.get('worst_controller')})"
+               if roll.get("worst_controller") else ""))
+    watch = doc.get("watch") or {}
+    prop = watch.get("propagation") or {}
+    if prop.get("count"):
+        subs = watch.get("subscribers") or []
+        max_lag = max((s.get("rv_lag", 0) for s in subs), default=0)
+        out.append(
+            f"watch bus: subscribers={len(subs)} max_rv_lag={max_lag} "
+            f"propagation p50={prop.get('p50_s', 0) or 0:.4f}s "
+            f"p99={prop.get('p99_s', 0) or 0:.4f}s "
+            f"over {prop['count']} deliveries")
+    if not ctrls:
+        out.append("no controllers registered in the server process "
+                   "(is the control plane running in-process?)")
+        return "\n".join(out)
+    rows = []
+    for name, st in sorted(ctrls.items()):
+        if "error" in st and len(st) == 1:
+            rows.append([name, "error: " + str(st["error"]), "", "", "", "",
+                         "", "", "", ""])
+            continue
+        p50 = st.get("reconcile_p50_ms")
+        p99 = st.get("reconcile_p99_ms")
+        rows.append([
+            name,
+            str(st.get("loops", 0)),
+            str(st.get("keys", 0)),
+            str(st.get("events", 0)),
+            str(st.get("errors", 0)),
+            str(st.get("requeues", 0)),
+            str(st.get("depth", 0)),
+            f"{st.get('oldest_dirty_age_s', 0):.1f}",
+            f"{p50:.2f}" if p50 is not None else "-",
+            f"{p99:.2f}" if p99 is not None else "-",
+        ])
+    out.append(fmt_table(
+        ["CONTROLLER", "LOOPS", "KEYS", "EVENTS", "ERRORS", "REQUEUES",
+         "DEPTH", "OLDEST(s)", "P50(ms)", "P99(ms)"], rows))
+    return "\n".join(out).rstrip()
+
+
+def cmd_controller(client: RESTClient, args) -> int:
+    """ktl controller stats [-o json] [-w] — the reconcile-loop telemetry of
+    every live controller, served from /debug/controlstats (the controller
+    sibling of `ktl sched stats`)."""
+    import time as _time
+
+    if args.action != "stats":
+        raise CLIError(f"unknown controller action {args.action!r}")
+    while True:
+        doc = client.request("GET", "/debug/controlstats")
+        rendered = (json.dumps(doc, indent=2) if args.output == "json"
+                    else _render_controller_stats(doc))
+        if args.watch and args.output != "json":
+            sys.stdout.write("\x1b[2J\x1b[H")
+        print(rendered)
+        if not args.watch:
+            return 0
         sys.stdout.flush()
         _time.sleep(args.interval)
 
@@ -1770,6 +1862,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="SLO spec JSON file (sched slo; default: the "
                         "built-in north-star spec)")
     p.set_defaults(fn=cmd_sched)
+
+    p = sub.add_parser("controller")
+    p.add_argument("action", choices=["stats"])
+    p.add_argument("-o", "--output", default="table",
+                   choices=["table", "json"])
+    p.add_argument("-w", "--watch", action="store_true")
+    p.add_argument("--interval", type=float, default=2.0)
+    p.set_defaults(fn=cmd_controller)
 
     p = sub.add_parser("vet")
     p.add_argument("paths", nargs="*",

@@ -1,0 +1,23 @@
+"""Shared observability machinery (ISSUE 9).
+
+The flight recorder (PR 3/PR 7) proved a set of idioms on the scheduler —
+O(1) per-batch taps on perf_counter, bounded record rings, windowed
+log-bucket stage histograms with exact-while-complete percentiles, measured
+self-time against a <2% budget. This package factors the reusable half out
+of scheduler/flightrec.py so the rest of the control plane (the ~20
+reconcile controllers, the store's watch bus) can inherit the same
+machinery instead of reinventing weaker copies:
+
+  obs.recorder   — StageClock + RingRecorder (the generic bounded ring with
+                   per-stage totals/histograms and the p50/p99 stage table).
+  obs.reconcile  — ReconcileRecorder: per-loop reconcile spans for
+                   controllers/base.py, plus the live-controller registry
+                   behind GET /debug/controlstats and `ktl controller stats`.
+"""
+
+from .recorder import (  # noqa: F401
+    STAGE_P_BUCKETS,
+    RingRecorder,
+    StageClock,
+    nearest_rank,
+)

@@ -1172,6 +1172,7 @@ class BatchScheduler(Scheduler):
                       "completed": self.podtrace.completed_total,
                       "live_incomplete": self.podtrace.live_incomplete,
                       "windows_rotated": self.podtrace.windows_rotated},
+            "watch": self._watch_summary(),
             "gang": gang,
             "repair": (dict(self.repair_totals,
                             last=self._last_repair.as_dict())
@@ -1189,6 +1190,24 @@ class BatchScheduler(Scheduler):
                          "self_seconds": round(fr.self_seconds, 6)},
             "stages": fr.stage_table(),
             "last_batch": fr.last(),
+        }
+
+    def _watch_summary(self) -> Dict:
+        """The store watch bus seen from this scheduler (ISSUE 9): settled
+        commit->dequeue propagation plus subscriber counts and the worst
+        delivered-RV lag — the "watch" section of sched_stats that `ktl
+        sched stats` renders and watch_propagation_p99_s gates. One
+        watch_telemetry() call (settles pending taps; O(subscribers))."""
+        try:
+            tel = self.store.watch_telemetry()
+        except Exception as e:  # a wedged store must not 500 the endpoint
+            return {"error": str(e)}
+        subs = tel.get("subscribers") or []
+        return {
+            "subscribers": len(subs),
+            "max_rv_lag": max((s.get("rv_lag", 0) for s in subs), default=0),
+            "dropped": tel.get("dropped") or {},
+            "propagation": tel.get("propagation") or {},
         }
 
     def _hard_pod_affinity_weight(self) -> int:

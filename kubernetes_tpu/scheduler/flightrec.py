@@ -23,6 +23,13 @@ PAPERS.md) additionally needs per-decision attribution. Three pieces:
                    table of an in-process scheduler without new plumbing
                    (the configz register/snapshot pattern, utils/tracing.py).
 
+The generic ring/stage machinery (bounded ring, per-stage totals +
+windowed histograms, exact-while-complete p50/p99, self-time accounting)
+lives in kubernetes_tpu/obs/recorder.py (ISSUE 9) — the reconcile-loop
+recorder every controller inherits is built on the SAME base, so the whole
+control plane shares one proven implementation. This module keeps the
+scheduler-specific record schema and the outside-bucket stage table.
+
 Everything is O(1) per batch and allocation-light; `enabled=False` skips the
 ring-buffer append (placement parity with the recorder on is pinned by
 tests/test_flightrec.py). bench.py consumes the recorder to emit the
@@ -32,10 +39,15 @@ machine-generated `stages` breakdown that replaced ROADMAP's hand-estimates.
 from __future__ import annotations
 
 import threading
-import time
 import weakref
-from collections import deque
 from typing import Callable, Dict, List, Optional
+
+from ..obs.recorder import (  # noqa: F401  (re-exported: public surface)
+    STAGE_P_BUCKETS,
+    RingRecorder,
+    StageClock,
+    nearest_rank as _nearest_rank,
+)
 
 # Serial-thread stages of one schedule_batch call, in pipeline order.
 # "ingest" is the watch pump residual (decode + cache ingest) with the
@@ -53,113 +65,17 @@ OUTSIDE_STAGES = ("queue_add", "bind", "bind_wait")
 # sum explain the wall clock" checks.
 OVERLAPPED_STAGES = ("bind",)
 
-# Windowed per-stage latency buckets (ISSUE 7): log-spaced 0.2ms..~42s so
-# the p50/p99 estimates survive ring eviction at bounded memory. The ~1.55x
-# bucket ratio bounds the interpolation error well inside the headroom any
-# sane SLO ceiling carries; batches still in the ring get EXACT nearest-rank
-# percentiles instead (stage_table picks whichever source is lossless).
-STAGE_P_BUCKETS = tuple(round(0.0002 * (1.55 ** i), 6) for i in range(28))
 
-
-def _nearest_rank(sorted_vals: List[float], q: float) -> float:
-    """Exact nearest-rank percentile over a complete sample."""
-    import math
-
-    return sorted_vals[min(len(sorted_vals) - 1,
-                           max(0, math.ceil(q * len(sorted_vals)) - 1))]
-
-
-class StageClock:
-    """Per-batch stage boundary marks. mark(name) attributes the time since
-    the previous boundary; skip() moves the boundary without attributing
-    (work another accumulator already claimed)."""
-
-    __slots__ = ("t0", "_last", "stages")
-
-    def __init__(self):
-        self.t0 = self._last = time.perf_counter()
-        self.stages: Dict[str, float] = {}
-
-    def mark(self, name: str) -> float:
-        now = time.perf_counter()
-        dt = now - self._last
-        self.stages[name] = self.stages.get(name, 0.0) + dt
-        self._last = now
-        return dt
-
-    def skip(self) -> None:
-        self._last = time.perf_counter()
-
-    def add(self, name: str, seconds: float) -> None:
-        if seconds > 0:
-            self.stages[name] = self.stages.get(name, 0.0) + seconds
-
-    def sub(self, name: str, seconds: float) -> None:
-        """Remove sub-stage time another bucket owns (floored at 0)."""
-        if seconds > 0 and name in self.stages:
-            self.stages[name] = max(0.0, self.stages[name] - seconds)
-
-    def total(self) -> float:
-        return time.perf_counter() - self.t0
-
-
-class FlightRecorder:
+class FlightRecorder(RingRecorder):
     """Bounded ring of per-batch trace records (last N batches)."""
 
-    DEFAULT_CAPACITY = 64
-
-    def __init__(self, capacity: int = DEFAULT_CAPACITY, enabled: bool = True):
-        self.capacity = capacity
-        self.enabled = enabled
-        self._lock = threading.Lock()
-        self._records: deque = deque(maxlen=capacity)
-        self._seq = 0
-        # aggregate per-stage seconds since clear(), across ALL batches —
-        # survives ring eviction so the stage table covers the full window
-        self._stage_totals: Dict[str, float] = {}
-        self._stage_batches: Dict[str, int] = {}
-        # per-stage seconds accrued outside any batch (see OUTSIDE_STAGES)
-        self._outside: Dict[str, float] = {}
-        # per-stage latency histograms (ISSUE 7): one observation per batch
-        # (or per outside-bucket call — a bind chunk, a flush wait), never
-        # evicted with the ring, so stage_table's p50/p99 cover the whole
-        # window. Built lazily per stage; metrics.Histogram carries its own
-        # lock but every write here happens under self._lock anyway.
-        self._stage_hist: Dict[str, object] = {}
+    def __init__(self, capacity: int = RingRecorder.DEFAULT_CAPACITY,
+                 enabled: bool = True):
+        super().__init__(capacity=capacity, enabled=enabled)
         # async bind failures observed since the last record (attached to it)
         self._pending_bind_failures: List = []
-        # instrumentation self-time: seconds spent building records,
-        # observing histograms, and in the timing taps (queue_add / confirm
-        # / bind wrappers note their own cost here). Everything measured
-        # except the ~10 StageClock perf_counter reads per batch — bench
-        # divides this by wall to bound the <2% overhead budget instead of
-        # differencing two noisy runs.
-        self._self_s = 0.0
 
     # -- ingest ----------------------------------------------------------------
-
-    def _hist_observe(self, stage: str, seconds: float) -> None:
-        """One per-stage latency observation (caller holds self._lock)."""
-        h = self._stage_hist.get(stage)
-        if h is None:
-            from ..server.metrics import Histogram
-
-            h = self._stage_hist[stage] = Histogram(
-                stage, buckets=STAGE_P_BUCKETS)
-        h.observe(seconds)
-
-    def add_outside(self, stage: str, seconds: float) -> None:
-        if not self.enabled or seconds <= 0:
-            return
-        with self._lock:
-            self._outside[stage] = self._outside.get(stage, 0.0) + seconds
-            self._hist_observe(stage, seconds)
-
-    def outside_seconds(self, *stages: str) -> float:
-        """Sum of the named outside buckets (the scheduler differences this
-        around a pump to keep 'ingest' disjoint from its sub-stages)."""
-        with self._lock:
-            return sum(self._outside.get(s, 0.0) for s in stages)
 
     def note_bind_failures(self, failures: List) -> None:
         """Bind-worker failures surfaced at drain time; attached to the next
@@ -169,10 +85,6 @@ class FlightRecorder:
         with self._lock:
             self._pending_bind_failures.extend(failures)
             del self._pending_bind_failures[:-200]  # bounded if batches stop
-
-    def note_self_time(self, seconds: float) -> None:
-        with self._lock:
-            self._self_s += seconds
 
     def record(self, *, pods: int, nodes: int, outcome: str, solver: str,
                stages: Dict[str, float], total_s: float, scheduled: int = 0,
@@ -188,16 +100,12 @@ class FlightRecorder:
         if not self.enabled:
             return None
         with self._lock:
-            self._seq += 1
             rec = {
-                "seq": self._seq,
-                "ts": time.time(),
                 "pods": pods,
                 "nodes": nodes,
                 "outcome": outcome,
                 "solver": solver,
                 "total_ms": round(total_s * 1000, 3),
-                "stages": {k: round(v * 1000, 3) for k, v in stages.items()},
                 "scheduled": scheduled,
                 "unschedulable": unschedulable,
                 "fallback": fallback,
@@ -215,105 +123,21 @@ class FlightRecorder:
                 "bind_failures": list(self._pending_bind_failures),
             }
             self._pending_bind_failures.clear()
-            self._records.append(rec)
-            for k, v in stages.items():
-                self._stage_totals[k] = self._stage_totals.get(k, 0.0) + v
-                self._stage_batches[k] = self._stage_batches.get(k, 0) + 1
-                self._hist_observe(k, v)
-            return rec
+            return self._append_record(rec, stages)
 
     # -- read side -------------------------------------------------------------
 
-    def __len__(self) -> int:
-        with self._lock:
-            return len(self._records)
-
-    def records(self) -> List[Dict]:
-        with self._lock:
-            return list(self._records)
-
-    def last(self) -> Optional[Dict]:
-        with self._lock:
-            return self._records[-1] if self._records else None
-
-    @property
-    def self_seconds(self) -> float:
-        with self._lock:
-            return self._self_s
-
     def stage_table(self) -> Dict[str, Dict]:
         """Aggregate per-stage view across every batch since clear() plus the
-        outside buckets: {stage: {total_ms, mean_ms, p50_ms, p99_ms, batches,
-        overlapped}}. The non-overlapped rows sum to ~the window's serial
-        wall time — the machine-generated successor of ROADMAP's
-        hand-maintained table.
+        outside buckets (see RingRecorder.stage_table). The non-overlapped
+        rows sum to ~the window's serial wall time — the machine-generated
+        successor of ROADMAP's hand-maintained table."""
+        return super().stage_table(
+            order=list(BATCH_STAGES) + list(OUTSIDE_STAGES),
+            overlapped=frozenset(OVERLAPPED_STAGES))
 
-        Percentile source (ISSUE 7): nearest-rank over the per-batch ring
-        while every observation is still in it (exact); once eviction or
-        per-call outside observations outgrow the ring, the windowed stage
-        histogram takes over (bucket-interpolated, error bounded by the
-        STAGE_P_BUCKETS ratio)."""
-        with self._lock:
-            totals = dict(self._stage_totals)
-            batches = dict(self._stage_batches)
-            outside = dict(self._outside)
-            hists = dict(self._stage_hist)
-            ring_vals: Dict[str, List[float]] = {}
-            for rec in self._records:
-                for k, ms in rec["stages"].items():
-                    ring_vals.setdefault(k, []).append(ms)
-
-        def pcts(name):
-            h = hists.get(name)
-            n_obs = h._total if h is not None else 0
-            vals = ring_vals.get(name)
-            if vals and len(vals) == n_obs:
-                vals = sorted(vals)
-                return (round(_nearest_rank(vals, 0.50), 3),
-                        round(_nearest_rank(vals, 0.99), 3))
-            if h is None or n_obs == 0:
-                return None, None
-            return (round(h.quantile(0.50) * 1000, 3),
-                    round(h.quantile(0.99) * 1000, 3))
-
-        out: Dict[str, Dict] = {}
-        for name in list(BATCH_STAGES) + list(OUTSIDE_STAGES):
-            sec = totals.get(name, 0.0) + outside.get(name, 0.0)
-            n = batches.get(name, 0)
-            if sec == 0.0 and n == 0:
-                continue
-            p50, p99 = pcts(name)
-            out[name] = {
-                "total_ms": round(sec * 1000, 3),
-                "mean_ms": round(sec * 1000 / n, 3) if n else None,
-                "p50_ms": p50,
-                "p99_ms": p99,
-                "batches": n,
-                "overlapped": name in OVERLAPPED_STAGES,
-            }
-        # anything recorded under a name this module doesn't know keeps
-        # rendering (forward compatibility for new stages)
-        for name in set(totals) | set(outside):
-            if name not in out:
-                sec = totals.get(name, 0.0) + outside.get(name, 0.0)
-                p50, p99 = pcts(name)
-                out[name] = {"total_ms": round(sec * 1000, 3),
-                             "mean_ms": None,
-                             "p50_ms": p50,
-                             "p99_ms": p99,
-                             "batches": batches.get(name, 0),
-                             "overlapped": False}
-        return out
-
-    def clear(self) -> None:
-        with self._lock:
-            self._records.clear()
-            self._stage_totals.clear()
-            self._stage_batches.clear()
-            self._outside.clear()
-            self._stage_hist.clear()
-            self._pending_bind_failures.clear()
-            self._self_s = 0.0
+    def _clear_extra(self) -> None:
+        self._pending_bind_failures.clear()
 
 
 # -- live-scheduler registry (the configz pattern) ------------------------------

@@ -53,21 +53,84 @@ import math
 import random
 import threading
 import time
+import weakref
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 # lifecycle edges, in pipeline order (bind_commit = store.bind_many returned,
-# bind_confirmed = the cache assume-confirm settled on the same chunk)
+# bind_confirmed = the cache assume-confirm settled on the same chunk).
+# ISSUE 9 extends the span past the scheduler's horizon: watch_delivered =
+# the bind MODIFIED event dequeued by the pod's kubelet watcher,
+# kubelet_observed = the kubelet's syncLoop picked the pod up, running =
+# the Running status write committed — the TRUE end-to-end latency a user
+# of the cluster experiences, stamped via note_pod_event (O(1) miss for
+# unsampled pods: one module-dict probe).
 SPAN_STAGES = ("enqueue", "pop", "solve", "assume", "dispatch",
-               "bind_commit", "bind_confirmed")
+               "bind_commit", "bind_confirmed",
+               "watch_delivered", "kubelet_observed", "running")
+
+# -- post-edge key registry (module-level, like flightrec's configz pattern) ----
+#
+# Components OUTSIDE the scheduler (the hollow kubelet's syncLoop, a future
+# real kubelet shim) stamp sampled spans through note_pod_event without
+# holding a tracer reference. The registry maps sampled pod keys -> weak
+# tracer refs; unsampled pods pay ONE falsy module check (empty dict) or one
+# dict probe. Bounded: tracers register exactly their live + post-completion
+# sampled keys and unregister on completion of the running edge, eviction,
+# deletion, drop_live and clear; dead-tracer refs are pruned opportunistically.
+
+_post_lock = threading.Lock()
+_post_keys: Dict[str, "weakref.ref"] = {}
+
+
+def _post_register(key: str, tracer: "PodTracer") -> None:
+    with _post_lock:
+        if len(_post_keys) > 4096:  # prune dead-tracer leftovers
+            for k in [k for k, r in _post_keys.items() if r() is None]:
+                _post_keys.pop(k, None)
+        _post_keys[key] = weakref.ref(tracer)
+
+
+def _post_unregister(key: str) -> None:
+    with _post_lock:
+        _post_keys.pop(key, None)
+
+
+def _owner_link_key(ref: Dict) -> Optional[str]:
+    """The identity an evict->replace link is keyed by: the owner's uid when
+    set, else kind/name (this repo's workload builders often leave uid
+    empty; the controller identity is what makes old and new pod siblings)."""
+    uid = ref.get("uid")
+    if uid:
+        return uid
+    kind, name = ref.get("kind"), ref.get("name")
+    return f"{kind}/{name}" if kind and name else None
+
+
+def note_pod_event(key: str, stage: str, ts: Optional[float] = None) -> None:
+    """Stamp a post-scheduler lifecycle edge on a sampled pod's span (no-op
+    for unsampled pods — the common case is one falsy check). Callers pass
+    no timestamp; the owning tracer stamps with ITS clock so every edge of
+    a span shares one clock."""
+    if not _post_keys:
+        return
+    with _post_lock:
+        ref = _post_keys.get(key)
+    tracer = ref() if ref is not None else None
+    if tracer is not None:
+        tracer.stamp_post(key, stage, ts)
 
 
 class PodSpan:
     """One sampled pod's lifecycle record. stamps maps stage -> absolute
     clock time (scheduler clock); re-pops overwrite, so the span always
-    describes the attempt that finally bound (pops counts the retries)."""
+    describes the attempt that finally bound (pops counts the retries).
+    replaces/replaced_by link an evicted pod's span to its ReplicaSet
+    replacement (causal chains under churn, ISSUE 9); deleted marks a span
+    whose pod was evicted before it could complete."""
 
-    __slots__ = ("key", "window", "stamps", "pops", "complete")
+    __slots__ = ("key", "window", "stamps", "pops", "complete",
+                 "replaces", "replaced_by", "deleted")
 
     def __init__(self, key: str, window: int):
         self.key = key
@@ -75,6 +138,9 @@ class PodSpan:
         self.stamps: Dict[str, float] = {}
         self.pops = 0
         self.complete = False
+        self.replaces: Optional[str] = None
+        self.replaced_by: Optional[str] = None
+        self.deleted = False
 
     def stamp(self, stage: str, ts: float) -> None:
         self.stamps[stage] = ts
@@ -88,9 +154,17 @@ class PodSpan:
                 if ts is not None:
                     offsets[stage] = round((ts - t0) * 1000, 3)
         total = offsets.get("bind_confirmed")
-        return {"pod": self.key, "window": self.window, "pops": self.pops,
-                "complete": self.complete, "stamps_ms": offsets,
-                "submit_to_bound_ms": total}
+        out = {"pod": self.key, "window": self.window, "pops": self.pops,
+               "complete": self.complete, "stamps_ms": offsets,
+               "submit_to_bound_ms": total,
+               "submit_to_running_ms": offsets.get("running")}
+        if self.replaces is not None:
+            out["replaces"] = self.replaces
+        if self.replaced_by is not None:
+            out["replaced_by"] = self.replaced_by
+        if self.deleted:
+            out["deleted"] = True
+        return out
 
 
 class PodTracer:
@@ -100,6 +174,7 @@ class PodTracer:
     DEFAULT_WINDOW_S = 30.0
     SPAN_RING = 512
     LIVE_CAP_FACTOR = 4  # incomplete spans kept across windows: K * this
+    EVICTED_LINK_CAP = 64  # pending evict->replace links kept (oldest drop)
     # recorded-but-unsettled trace ops held for deferred processing; past
     # this the flush runs inline on the recording thread (bounded memory:
     # the deque holds refs to batch/chunk lists that are alive during the
@@ -123,6 +198,16 @@ class PodTracer:
         self._sampled: set = set()
         self._live: Dict[str, PodSpan] = {}  # insertion-ordered: evict oldest
         self._done: deque = deque(maxlen=self.SPAN_RING)
+        # bound spans still awaiting post-scheduler edges (watch_delivered /
+        # kubelet_observed / running, ISSUE 9) — bounded by the done ring:
+        # a span evicted from the ring leaves here too
+        self._post_sampled: Dict[str, PodSpan] = {}
+        # evict->replace causal links: owner identity -> FIFO of (evicted
+        # key, its span) — one ReplicaSet drain evicts MANY siblings, each
+        # owed a link to a replacement. Consumed oldest-first at admission;
+        # bounded at EVICTED_LINK_CAP total links (oldest dropped).
+        self._evicted_sampled: Dict[str, List[Tuple[str, PodSpan]]] = {}
+        self._evicted_links = 0
         # Algorithm L reservoir state for the current window
         self._reservoir: List[str] = []
         self._w: Optional[float] = None
@@ -213,6 +298,11 @@ class PodTracer:
             # O(K + live) scan
             if mutated:
                 self._sync_candidates()
+            # evict->replace causal chains (ISSUE 9): one falsy check in the
+            # steady state; only while links are pending does admission pay
+            # an owner-uid probe per pod
+            if self._evicted_sampled:
+                self._link_replacements(qps)
         sink = self.stat_sink
         if sink is not None:
             sink.note_self_time(time.perf_counter() - t0)
@@ -245,11 +335,13 @@ class PodTracer:
                            or self._clock.now())
                 live[key] = span
                 self._sampled.add(key)
+                _post_register(key, self)
             qp.trace_span = span
         for key in list(live):
             if key not in current and live[key].pops == 0:
                 del live[key]
                 self._sampled.discard(key)
+                _post_unregister(key)
         # a pod that never binds must not leak spans forever: cap the live
         # set AFTER this window's additions, evicting oldest-first (counted,
         # never silent) — insertion order puts prior windows' stragglers up
@@ -259,6 +351,7 @@ class PodTracer:
             old = next(iter(live))
             live.pop(old)
             self._sampled.discard(old)
+            _post_unregister(old)
             self.evicted_incomplete += 1
 
     def _maybe_rotate(self, now: float) -> None:
@@ -448,8 +541,132 @@ class PodTracer:
             return
         self._sampled.discard(key)
         sp.complete = True
+        if len(self._done) == self._done.maxlen:
+            # ring eviction: the evicted span's post-edge tracking ends too
+            old = self._done[0]
+            self._post_sampled.pop(old.key, None)
+            _post_unregister(old.key)
         self._done.append(sp)
         self._completed += 1
+        if "running" in sp.stamps:
+            # the running edge already arrived (serial path + fast kubelet):
+            # the kubelet taps are done with this key
+            _post_unregister(key)
+        # keep the span addressable until ring eviction: for the
+        # watch_delivered / kubelet_observed / running stamps while they are
+        # pending, and for the evict->replace link if this pod is later
+        # evicted (ISSUE 9; bounded by the done ring)
+        self._post_sampled[key] = sp
+
+    def stamp_post(self, key: str, stage: str,
+                   ts: Optional[float] = None) -> None:
+        """Stamp a post-scheduler edge (watch_delivered / kubelet_observed /
+        running) on a sampled span — live (bind still settling) or bound.
+        Reached via note_pod_event; unsampled pods never get here."""
+        if not self.enabled:
+            return
+        done = False
+        with self._lock:
+            sp = self._post_sampled.get(key) or self._live.get(key)
+            if sp is None:
+                return
+            sp.stamp(stage, ts if ts is not None else self._clock.now())
+            if stage == "running":
+                # the kubelet is done with this span — but it STAYS in
+                # _post_sampled until ring eviction, so a later eviction of
+                # this pod can still find it for the evict->replace link
+                done = True
+        if done:
+            _post_unregister(key)
+
+    def note_deleted(self, pod) -> None:
+        """A sampled pod was DELETED (evicted). A live span can never
+        complete — close it out (kept in the ring, marked deleted); either
+        way remember the owner uid so the ReplicaSet replacement's span
+        links back to this one (causal chains under churn, ISSUE 9). O(1)
+        for unsampled pods: two membership probes."""
+        if not self.enabled:
+            return
+        key = pod.key
+        if key not in self._sampled and key not in self._post_sampled:
+            return
+        # settle pending pop/stage ops first: the span's last stamps must
+        # land before it leaves the live set (the pod_bound discipline)
+        self._flush_ops(inline=True)
+        meta = getattr(pod, "metadata", None)
+        owner_uid = None
+        for ref in (meta.owner_references if meta is not None else ()):
+            owner_uid = _owner_link_key(ref)
+            if owner_uid:
+                break
+        with self._lock:
+            live_sp = self._live.pop(key, None)
+            self._sampled.discard(key)
+            span = self._post_sampled.pop(key, None) or live_sp
+            if span is None:
+                _post_unregister(key)
+                return
+            span.deleted = True
+            if live_sp is not None:
+                # an unbound evicted span joins the ring incomplete — the
+                # chain must render even though the pod never bound
+                if len(self._done) == self._done.maxlen:
+                    old = self._done[0]
+                    self._post_sampled.pop(old.key, None)
+                    _post_unregister(old.key)
+                self._done.append(live_sp)
+            if owner_uid is not None:
+                self._evicted_sampled.setdefault(owner_uid, []).append(
+                    (key, span))
+                self._evicted_links += 1
+                while self._evicted_links > self.EVICTED_LINK_CAP:
+                    oldest = next(iter(self._evicted_sampled))
+                    lst = self._evicted_sampled[oldest]
+                    lst.pop(0)
+                    if not lst:
+                        del self._evicted_sampled[oldest]
+                    self._evicted_links -= 1
+        _post_unregister(key)
+
+    def _link_replacements(self, qps) -> None:
+        """Adopt replacements of evicted sampled pods into the sample
+        (caller holds self._lock; runs only while links are pending). A
+        replacement is FORCE-sampled — causal chains are only useful when
+        both ends exist, so it bypasses the reservoir lottery."""
+        for qp in qps:
+            meta = getattr(qp.pod, "metadata", None)
+            if meta is None:
+                continue
+            for ref in meta.owner_references:
+                link = _owner_link_key(ref)
+                if link in self._evicted_sampled:
+                    self._adopt_replacement(qp, link)
+                    # one replacement consumes ONE link: a second owner ref
+                    # with its own pending entry must not overwrite this
+                    # span's `replaces` and starve the next real sibling
+                    break
+            if not self._evicted_sampled:
+                return
+
+    def _adopt_replacement(self, qp, uid: str) -> None:
+        """Link one replacement (caller holds self._lock)."""
+        lst = self._evicted_sampled[uid]
+        old_key, old_span = lst.pop(0)  # oldest eviction claims the link
+        if not lst:
+            del self._evicted_sampled[uid]
+        self._evicted_links -= 1
+        key = qp.pod.key
+        span = self._live.get(key)
+        if span is None:
+            span = PodSpan(key, self._window_seq)
+            span.stamp("enqueue", qp.submit_ts or qp.timestamp
+                       or self._clock.now())
+            self._live[key] = span
+            self._sampled.add(key)
+            _post_register(key, self)
+        qp.trace_span = span
+        span.replaces = old_key
+        old_span.replaced_by = key
 
     def drop_live(self) -> None:
         """Abandon every in-flight span (counted, never silent). Called on
@@ -461,12 +678,19 @@ class PodTracer:
         self._flush_ops()
         with self._lock:
             self.evicted_incomplete += len(self._live)
+            for key in self._live:
+                _post_unregister(key)
             self._live.clear()
             self._sampled = set()
             self._reservoir = []
             self._w = None
             self._skip = 0
             self._batch_hits = ()
+            # bound spans keep their post-edge tracking: their binds are
+            # store facts the resync re-observes, so the kubelet's stamps
+            # still land; pending evict->replace links die with the queue
+            self._evicted_sampled.clear()
+            self._evicted_links = 0
 
     # -- read side (every surface settles deferred chunks first) ---------------
 
@@ -502,6 +726,7 @@ class PodTracer:
             spans = [sp.render() for sp in self._done]
             spans.extend(sp.render() for sp in self._live.values())
             live = len(self._live)
+            post = len(self._post_sampled)
         return {
             "enabled": self.enabled,
             "sample_k": self.sample_k,
@@ -509,6 +734,9 @@ class PodTracer:
             "windows_rotated": self.windows_rotated,
             "completed": self._completed,
             "live_incomplete": live,
+            # bound spans still addressable for post edges / evict links
+            # (bounded by the done ring)
+            "post_sampled": post,
             "evicted_incomplete": self.evicted_incomplete,
             "flush_seconds": round(self.flush_seconds, 6),
             "latency": self.latency_stats(),
@@ -519,9 +747,16 @@ class PodTracer:
         from ..server.metrics import E2E_LATENCY_BUCKETS, Histogram
 
         with self._lock:
+            for key in self._sampled:
+                _post_unregister(key)
+            for key in self._post_sampled:
+                _post_unregister(key)
             self._sampled.clear()
             self._live.clear()
             self._done.clear()
+            self._post_sampled.clear()
+            self._evicted_sampled.clear()
+            self._evicted_links = 0
             self._reservoir = []
             self._w = None
             self._skip = 0

@@ -219,6 +219,10 @@ class Scheduler:
         self._watch = self.store.watch(
             kind=self._watched_kinds(), since_rv=rv, maxsize=200_000,
             coalesce=self.watch_coalesce)
+        # watch-propagation tap (ISSUE 9): inline settlement on OUR drain
+        # thread bills the flight recorder's <2% budget (batch path only —
+        # the serial oracle has no recorder and pays read-side settle only)
+        self._watch.stat_sink = getattr(self, "flightrec", None)
 
     def _push_ns_labels(self):
         for fw in self.profiles.values():
@@ -386,6 +390,7 @@ class Scheduler:
         self._watch = self.store.watch(
             kind=self._watched_kinds(), since_rv=rv, maxsize=200_000,
             coalesce=self.watch_coalesce)
+        self._watch.stat_sink = getattr(self, "flightrec", None)
         self.queue.move_all_to_active_or_backoff()
         return {"nodes": len(lists["nodes"]), "bound": bound,
                 "pending": pending}
@@ -518,6 +523,12 @@ class Scheduler:
                 self.queue.delete(pod)
             return
         if etype == DELETED:
+            # sampled-span eviction tap (ISSUE 9): close out a sampled span
+            # and remember the owner for the evict->replace causal link.
+            # O(1) for unsampled pods (two membership probes inside).
+            pt = self.podtrace
+            if pt is not None and pt.enabled:
+                pt.note_deleted(pod)
             if pod.spec.node_name:
                 self.cache.remove_pod(pod)
                 self._move_for_event("pods", DELETED, pod)

@@ -19,6 +19,16 @@ a file for `ktl sched slo --spec`):
                          skips are reported separately.
   submit_to_bound_p99_s  ceiling on the all-pods submit->bound p99
                          (scheduler/podtrace.py latency histogram).
+  watch_propagation_p99_s  ceiling on the store watch bus's commit->dequeue
+                         p99 across all kinds (ISSUE 9; the "watch" section
+                         of a stats payload — sched_stats() or the bench's
+                         assembled dict — carries the settled summary).
+  reconcile_p99_ms       ceiling on the WORST controller's per-loop sync
+                         p99 (obs/reconcile.py rollup: one dark-slow
+                         controller must fail the ceiling, not be averaged
+                         away). SKIPs on a payload with no "reconcile"
+                         section — a live `ktl sched slo` has one scheduler,
+                         not the controller registry.
   solver_compiles        max jit compiles inside the measured window (the
                          retrace guard as an SLO; needs the caller to supply
                          the count via `extra` — bench.py does, a live `ktl
@@ -70,12 +80,22 @@ CHAOS_SLO: Dict = {
     "submit_to_bound_p99_s": 120.0,
 }
 
+# The ControlPlane_churn gate (ISSUE 9): deployment rollout + node drain +
+# eviction/replace driven through the controllers on the noisy 2-core rig.
+# Ceilings catch order-of-magnitude regressions (a backlogged watcher, a
+# controller gone quadratic), not scheduling jitter: propagation is
+# microseconds in-process, reconcile loops are single-digit ms.
+CONTROL_PLANE_SLO: Dict = {
+    "watch_propagation_p99_s": 10.0,
+    "reconcile_p99_ms": 2000.0,
+}
+
 # what `ktl sched slo` checks when no --spec file is given
 DEFAULT_SLO = NORTH_STAR_SLO
 
 KNOWN_SPEC_KEYS = frozenset((
     "stage_p99_ms", "submit_to_bound_p99_s", "solver_compiles",
-    "instrumentation_frac"))
+    "instrumentation_frac", "watch_propagation_p99_s", "reconcile_p99_ms"))
 
 
 def load_slo_spec(path: str) -> Dict:
@@ -119,6 +139,15 @@ def evaluate_slo(stats: Dict, spec: Dict,
         checks.append(_check("submit_to_bound_p99_s",
                              spec["submit_to_bound_p99_s"],
                              lat.get("p99_s")))
+    if "watch_propagation_p99_s" in spec:
+        prop = (stats.get("watch") or {}).get("propagation") or {}
+        checks.append(_check("watch_propagation_p99_s",
+                             spec["watch_propagation_p99_s"],
+                             prop.get("p99_s")))
+    if "reconcile_p99_ms" in spec:
+        rec = stats.get("reconcile") or {}
+        checks.append(_check("reconcile_p99_ms", spec["reconcile_p99_ms"],
+                             rec.get("p99_ms")))
     if "solver_compiles" in spec:
         checks.append(_check("solver_compiles", spec["solver_compiles"],
                              extra.get("solver_compiles")))

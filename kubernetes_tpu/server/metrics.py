@@ -85,6 +85,29 @@ class Histogram:
                     return
             self._counts[-1] += 1
 
+    def observe_n(self, value: float, n: int) -> None:
+        """n observations of ONE value under a single lock acquisition — the
+        coalesced-event shape (ISSUE 9): a CoalescedEvent delivery carries
+        len(events) objects that all share the batch's commit stamp, so the
+        propagation histogram takes one bucket probe for the whole batch."""
+        if n <= 0:
+            return
+        with self._lock:
+            self._sum += value * n
+            self._total += n
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    self._counts[i] += n
+                    return
+            self._counts[-1] += n
+
+    def counts_snapshot(self) -> Tuple[List[int], float, int]:
+        """(bucket counts incl. +Inf, sum, total) under the lock — lets a
+        reader merge several same-layout histograms (the per-kind propagation
+        children) into one distribution via observe_counts."""
+        with self._lock:
+            return list(self._counts), self._sum, self._total
+
     def bucket_counts(self, values):
         """One numpy bucket pass over a chunk of samples WITHOUT mutating
         this histogram: (counts, sum, n) for observe_counts(), so a single
@@ -350,6 +373,18 @@ store_watch_dropped = global_registry.counter(
     "Watch deliveries dropped, by reason (chaos injection / overflow "
     "eviction) and kind")
 
+# watch-propagation tracing (ISSUE 9): commit->delivery latency per kind —
+# every event carries its store-commit stamp (shared per batched write) and
+# the subscriber's dequeue tap settles the distribution at render time.
+# Buckets reach from 100us (in-process same-tick delivery) out to 5 minutes
+# (a backlogged subscriber's worst honest lag must land in a finite bucket)
+PROPAGATION_BUCKETS = (0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1, 0.25,
+                       0.5, 1, 2.5, 5, 10, 30, 60, 120, 300)
+store_watch_propagation = global_registry.labeled_histogram(
+    "store_watch_propagation_seconds",
+    "Watch event latency from store commit to subscriber dequeue, by kind",
+    label="kind", buckets=PROPAGATION_BUCKETS)
+
 _watch_sources: List = []  # weakrefs to APIStores with live watchers
 _watch_sources_lock = threading.Lock()
 
@@ -364,8 +399,12 @@ def register_watch_source(ref) -> None:
         _watch_sources.append(ref)
 
 
-def _watch_queue_samples():
-    out = []
+def _watch_subscriber_rows():
+    """Subscriber rows from every live store — the shared feed of the two
+    watch GaugeFuncs below. Uses the subscribers-only telemetry read: one
+    scrape must not pay the merged propagation-summary construction twice
+    per store just to list subscribers."""
+    rows = []
     with _watch_sources_lock:
         refs = list(_watch_sources)
     for ref in refs:
@@ -373,19 +412,60 @@ def _watch_queue_samples():
         if store is None:
             continue
         try:
-            tel = store.watch_telemetry()
+            rows.extend(store.watch_subscriber_telemetry())
         except Exception:
             continue
-        for sub in tel["subscribers"]:
-            out.append(({"subscriber": sub["id"]},
-                        float(sub["queue_length"])))
-    return out
+    return rows
+
+
+def _watch_queue_samples():
+    return [({"subscriber": sub["id"]}, float(sub["queue_length"]))
+            for sub in _watch_subscriber_rows()]
 
 
 store_watch_queue_length = global_registry.gauge_func(
     "store_watch_subscriber_queue_length",
     "Buffered events per live watch subscriber (read at scrape time)",
     fn=_watch_queue_samples)
+
+
+def _watch_rv_lag_samples():
+    """Delivered-RV lag per live subscriber (ISSUE 9): how many store
+    commits behind each watcher's last DEQUEUED event is — the leading
+    indicator of a backlogged informer, read from live stores at render
+    time like the queue-length gauge."""
+    return [({"subscriber": sub["id"]}, float(sub.get("rv_lag", 0)))
+            for sub in _watch_subscriber_rows()]
+
+
+store_watch_rv_lag = global_registry.gauge_func(
+    "store_watch_delivered_rv_lag",
+    "Store commits not yet dequeued per live watch subscriber",
+    fn=_watch_rv_lag_samples)
+
+# reconcile-loop telemetry (ISSUE 9): every controller built on
+# controllers/base.py observes ONE duration per process() loop (never per
+# key) into this family; workqueue depth comes from the live controller
+# registry at render time (obs/reconcile.py)
+controller_reconcile_duration = global_registry.labeled_histogram(
+    "controller_reconcile_duration_seconds",
+    "Reconcile loop latency per controller (one observation per loop)",
+    label="controller", buckets=STAGE_BUCKETS)
+controller_sync_errors = global_registry.counter(
+    "controller_sync_errors_total",
+    "sync(key) exceptions per controller (each one also requeues its key)")
+
+
+def _controller_depth_samples():
+    from ..obs.reconcile import workqueue_depth_samples
+
+    return workqueue_depth_samples()
+
+
+controller_workqueue_depth = global_registry.gauge_func(
+    "controller_workqueue_depth",
+    "Dirty keys awaiting reconcile per live controller (read at render time)",
+    fn=_controller_depth_samples)
 
 # constraint propose-and-repair observability (ISSUE 8): repair-round count
 # per constrained batch (a distribution pinned at the REPAIR_MAX_ROUNDS

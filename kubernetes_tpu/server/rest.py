@@ -392,7 +392,8 @@ class _Handler(BaseHTTPRequestHandler):
     _FC_VERBS = {"GET": "get", "POST": "create", "PUT": "update",
                  "PATCH": "patch", "DELETE": "delete"}
     _FC_EXEMPT_PATHS = ("/healthz", "/readyz", "/metrics", "/version",
-                        "/configz", "/debug/schedstats", "/debug/schedtrace")
+                        "/configz", "/debug/schedstats", "/debug/schedtrace",
+                        "/debug/controlstats")
 
     def _flow_dispatch(self, orig: "Callable[[], None]") -> None:
         """Seat-accounted dispatch. Health/metrics always pass (the probe
@@ -663,6 +664,27 @@ class _Handler(BaseHTTPRequestHandler):
             from ..scheduler.flightrec import schedtrace_snapshot
 
             body = json.dumps(schedtrace_snapshot(), default=str).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if url.path == "/debug/controlstats":
+            # control-plane flight recorder (ISSUE 9): per-controller
+            # reconcile-loop telemetry (obs/reconcile.py) plus THIS server's
+            # watch-bus propagation/lag view — what `ktl controller stats`
+            # renders. Same read-only debug family as /debug/schedstats.
+            from ..obs.reconcile import controlstats_snapshot, reconcile_rollup
+
+            snap = controlstats_snapshot()
+            doc = {"controllers": snap,
+                   "reconcile": reconcile_rollup(snap)}
+            try:
+                doc["watch"] = self.server.store.watch_telemetry()
+            except Exception as e:  # telemetry must not 500 the endpoint
+                doc["watch"] = {"error": str(e)}
+            body = json.dumps(doc, default=str).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

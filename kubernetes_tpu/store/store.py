@@ -61,6 +61,7 @@ import queue
 import threading
 import time
 import weakref
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
@@ -106,6 +107,11 @@ class Event:
     # builds (once) the private clone handed to non-coalescing watchers.
     # compare=False keeps Event equality identical to the eager form.
     lazy: Any = field(default=None, compare=False, repr=False)
+    # watch-propagation stamp (ISSUE 9): perf_counter at store commit,
+    # SHARED across a batched write's events (one clock read per batch).
+    # 0.0 = unstamped (propagation tracing disabled). compare=False keeps
+    # Event equality identical to the pre-stamp form.
+    commit_ts: float = field(default=0.0, compare=False, repr=False)
 
 
 @dataclass(frozen=True)
@@ -124,6 +130,10 @@ class CoalescedEvent:
     events: Tuple[Event, ...]
     resource_version: int
     origin: Optional[str] = None
+    # the batch's shared commit stamp (ISSUE 9 satellite: the coalesced
+    # fast path must carry it too, or propagation histograms would silently
+    # exclude the NorthStar ingest path). 0.0 = tracing disabled.
+    commit_ts: float = 0.0
 
 
 class ConflictError(Exception):
@@ -226,7 +236,7 @@ def _shallow(obj):
     return new
 
 
-def _make_event(etype, kind, obj, rv, prev=None, lazy=None):
+def _make_event(etype, kind, obj, rv, prev=None, lazy=None, commit_ts=0.0):
     """Hot-path Event constructor: the frozen-dataclass __init__ goes through
     object.__setattr__ per field (~1.8µs — real money at 100k events per
     bind batch); building the instance dict directly is ~4x cheaper and
@@ -237,7 +247,8 @@ def _make_event(etype, kind, obj, rv, prev=None, lazy=None):
     # __setattr__ — go around it the same way their own __init__ does
     object.__setattr__(ev, "__dict__",
                        {"type": etype, "kind": kind, "obj": obj,
-                        "resource_version": rv, "prev": prev, "lazy": lazy})
+                        "resource_version": rv, "prev": prev, "lazy": lazy,
+                        "commit_ts": commit_ts})
     return ev
 
 
@@ -299,6 +310,31 @@ class Watch:
         # watch mux (server/watchmux.py) wakes on it instead of spending a
         # blocked thread per stream
         self.on_event = None
+        # watch-propagation tracing (ISSUE 9): dequeue taps are O(1) — they
+        # append (events, t_dequeue) ops here; per-event settlement into the
+        # store's commit->delivery histograms runs at the next read surface
+        # (watch_telemetry) or inline past _PROP_OPS_CAP, billed to
+        # stat_sink (the scheduler wires its flight recorder in so the <2%
+        # budget covers this tap too). last_delivered_rv feeds the rv-lag
+        # gauge; _prop_min_rv excludes replayed history from the latency
+        # distribution (a late subscriber's replay is catch-up, not bus lag).
+        self._prop_ops: deque = deque()
+        self.last_delivered_rv = 0
+        self._prop_min_rv = 0
+        self.stat_sink = None
+
+    _PROP_OPS_CAP = 64
+
+    def _note_delivered(self, evs) -> None:
+        """O(1) dequeue tap: ONE perf_counter read for the drained batch,
+        one deque append (refs only — the consumer holds the events alive
+        through its own processing anyway), one rv watermark store."""
+        self.last_delivered_rv = evs[-1].resource_version
+        if not self._store._watch_propagation:
+            return
+        self._prop_ops.append((evs, time.perf_counter()))
+        if len(self._prop_ops) > self._PROP_OPS_CAP:
+            self._store._settle_propagation(self, inline=True)
 
     def _deliver(self, ev: Event) -> None:
         if self.terminated or self._stopped:
@@ -363,9 +399,12 @@ class Watch:
 
     def get(self, timeout: Optional[float] = None) -> Optional[Event]:
         try:
-            return self._q.get(timeout=timeout)
+            ev = self._q.get(timeout=timeout)
         except queue.Empty:
             return None
+        if ev is not None:
+            self._note_delivered((ev,))
+        return ev
 
     def drain(self, max_n: Optional[int] = None) -> List[Event]:
         """Drain buffered events; max_n bounds the take so a capped consumer
@@ -377,9 +416,11 @@ class Watch:
             try:
                 ev = self._q.get_nowait()
             except queue.Empty:
-                return out
+                break
             if ev is not None:
                 out.append(ev)
+        if out:
+            self._note_delivered(out)
         return out
 
     def __iter__(self):
@@ -387,6 +428,7 @@ class Watch:
             ev = self._q.get()
             if ev is None:
                 return
+            self._note_delivered((ev,))
             yield ev
 
     def stop(self) -> None:
@@ -490,7 +532,8 @@ class APIStore:
     def __init__(self, deep_copy_on_write: bool = True,
                  mutation_detector: Optional[bool] = None,
                  lazy_pod_events: Optional[bool] = None,
-                 lock_order_check: Optional[bool] = None):
+                 lock_order_check: Optional[bool] = None,
+                 watch_propagation: bool = True):
         import os
 
         if lock_order_check is None:
@@ -536,6 +579,16 @@ class APIStore:
         # into store_watch_dropped_deliveries_total
         self._watch_drops: Dict[str, int] = {}
         self._watch_metrics_registered = False
+        # watch-propagation tracing (ISSUE 9): commit->dequeue latency per
+        # kind. Events carry a perf_counter commit stamp (one read per
+        # batched write); subscriber dequeue taps record O(1) ops settled
+        # HERE at render time (watch_telemetry) under a private lock — never
+        # the store lock (LK002). False disables stamps AND taps (the
+        # parity-oracle knob for the on/off byte-identical test).
+        self._watch_propagation = watch_propagation
+        self._prop_lock = threading.Lock()
+        self._prop_hist: Dict[str, Any] = {}  # kind -> metrics.Histogram
+        self._prop_settle_s = 0.0
 
     # -- helpers ---------------------------------------------------------------
 
@@ -601,7 +654,14 @@ class APIStore:
         write paths pre-clone instead of paying a second deepcopy here).
         prev is the replaced stored object — orphaned from the store by this
         very write, so sharing it with watchers is safe (read-only)."""
-        self._emit_event(Event(etype, kind, obj, self._rv, prev))
+        self._emit_event(Event(etype, kind, obj, self._rv, prev,
+                               commit_ts=self._commit_stamp()))
+
+    def _commit_stamp(self) -> float:
+        """The propagation commit stamp for an event being emitted right now
+        (0.0 when tracing is off). Batched writes read perf_counter ONCE and
+        share the stamp across the batch instead of calling this per event."""
+        return time.perf_counter() if self._watch_propagation else 0.0
 
     def _pod_event(self, etype: str, obj, cloner, prev=None) -> Event:
         """Event for a just-committed pod write (the clone-free commit hot
@@ -612,12 +672,15 @@ class APIStore:
         to the eager clone when lazy events are disabled (the parity oracle
         knob) or the store doesn't isolate at all (deep_copy_on_write=False
         shares everywhere already)."""
+        ts = self._commit_stamp()
         if not self._deep_copy:
-            return _make_event(etype, "pods", obj, self._rv, prev)
+            return _make_event(etype, "pods", obj, self._rv, prev,
+                               commit_ts=ts)
         if self._lazy_pod_events:
             return _make_event(etype, "pods", obj, self._rv, prev,
-                               lazy=[None, cloner])
-        return _make_event(etype, "pods", cloner(obj), self._rv, prev)
+                               lazy=[None, cloner], commit_ts=ts)
+        return _make_event(etype, "pods", cloner(obj), self._rv, prev,
+                           commit_ts=ts)
 
     def _materialize_event(self, ev: Event) -> Event:
         """The per-object form of a lazy event: a private clone of the shared
@@ -632,8 +695,11 @@ class APIStore:
             return ev
         mat = lazy[0]
         if mat is None:
+            # the materialized form keeps the ORIGINAL commit stamp:
+            # propagation measures commit->dequeue, not clone time
             mat = _make_event(ev.type, ev.kind, lazy[1](ev.obj),
-                              ev.resource_version, ev.prev)
+                              ev.resource_version, ev.prev,
+                              commit_ts=ev.commit_ts)
             if self._mutation_detector is not None:
                 self._mutation_detector.record(mat)
             lazy[0] = mat
@@ -680,8 +746,12 @@ class APIStore:
         for w in list(self._watchers):
             if w.coalesce:
                 if cev is None:
+                    # the batch's shared stamp rides the coalesced form too
+                    # (ISSUE 9 satellite: without it the NorthStar ingest
+                    # path would be invisible to propagation histograms)
                     cev = CoalescedEvent(etype, kind, tuple(events),
-                                         events[-1].resource_version, origin)
+                                         events[-1].resource_version, origin,
+                                         events[-1].commit_ts)
                 w._deliver_coalesced(cev)
             else:
                 if mat is None:
@@ -721,6 +791,9 @@ class APIStore:
         events: List[Event] = []
         with self._kind_lock(kind):
             objs = self._objects.setdefault(kind, {})
+            # ONE shared commit stamp for the whole batch (ISSUE 9): the
+            # coalesced ingest path must carry propagation stamps too
+            t_commit = self._commit_stamp()
             for obj in objects:
                 key = self.object_key(obj)
                 if key in objs:
@@ -732,7 +805,7 @@ class APIStore:
                 obj.metadata.resource_version = self._rv
                 objs[key] = obj
                 events.append(_make_event(ADDED, kind, self._event_copy(obj),
-                                          self._rv))
+                                          self._rv, commit_ts=t_commit))
                 created += 1
             self._emit_batch(ADDED, kind, events, origin)
         return created, errors
@@ -875,6 +948,13 @@ class APIStore:
                         f"replay of {len(replay)} events from rv {since_rv} exceeds "
                         f"the watch buffer ({maxsize}); relist required")
             w = Watch(self, kind, maxsize=maxsize, coalesce=coalesce)
+            # propagation baseline (ISSUE 9): replayed history is catch-up,
+            # not bus lag — only events committed AFTER this subscription
+            # enter the latency distribution. The delivered-RV watermark
+            # starts at the resume point (or now) so the lag gauge reads 0
+            # until real commits outrun the consumer.
+            w._prop_min_rv = self._rv
+            w.last_delivered_rv = since_rv if since_rv >= 0 else self._rv
             for ev in replay:
                 # a non-coalescing subscriber arriving mid/after a lazy batch
                 # must see fully private event objects, same as live delivery
@@ -906,21 +986,145 @@ class APIStore:
         self._watch_drops[reason] = self._watch_drops.get(reason, 0) + 1
         _metrics().store_watch_dropped.inc(reason=reason, kind=kind)
 
-    def watch_telemetry(self) -> Dict:
-        """Per-subscriber watch-bus state (ISSUE 7 satellite): live
-        subscriber ids with their buffered-event counts, plus the dropped-
-        delivery counters — what the subscriber-queue-length GaugeFunc and
-        the watch-fanout bench rung read."""
+    # -- watch propagation (ISSUE 9) -------------------------------------------
+
+    def _prop_child(self, kind: str):
+        """The per-kind commit->dequeue histogram (created on first use,
+        under the private propagation lock — never the store lock)."""
+        with self._prop_lock:
+            h = self._prop_hist.get(kind)
+            if h is None:
+                m = _metrics()
+                h = self._prop_hist[kind] = m.Histogram(
+                    "watch_propagation", buckets=m.PROPAGATION_BUCKETS)
+            return h
+
+    def _settle_propagation(self, w: Watch, inline: bool = False) -> None:
+        """Settle one subscriber's pending dequeue ops into the per-kind
+        propagation histograms (private + the process-wide Prometheus
+        series). Runs at read surfaces (watch_telemetry) or inline on the
+        consuming thread past the ops cap — inline cost bills the watch's
+        stat_sink (the scheduler's flight recorder), read-side cost accrues
+        to the settle_seconds counter only. Concurrent settlers are safe:
+        deque.popleft hands each op to exactly one of them."""
+        ops = w._prop_ops
+        if not ops:
+            return
+        t0 = time.perf_counter()
+        m = _metrics()
+        min_rv = w._prop_min_rv
+        by_kind: Dict[str, List[float]] = {}
+        bulk: List[Tuple[str, float, int]] = []
+        while True:
+            try:
+                evs, t = ops.popleft()
+            except IndexError:
+                break
+            for ev in evs:
+                ts = ev.commit_ts
+                if ts <= 0.0 or ev.resource_version <= min_rv:
+                    continue  # unstamped, or replayed catch-up history
+                if type(ev) is CoalescedEvent:
+                    # the whole batch shares ONE stamp: n observations of
+                    # one value, one bucket probe (Histogram.observe_n)
+                    bulk.append((ev.kind, t - ts, len(ev.events)))
+                else:
+                    by_kind.setdefault(ev.kind, []).append(t - ts)
+        for kind, vals in by_kind.items():
+            h = self._prop_child(kind)
+            res = h.bucket_counts(vals)
+            if res is not None:
+                # one numpy bucket pass feeds the private histogram AND the
+                # process-wide series (identical bucket layouts)
+                h.observe_counts(*res)
+                m.store_watch_propagation.child(kind).observe_counts(*res)
+        for kind, val, n in bulk:
+            self._prop_child(kind).observe_n(val, n)
+            m.store_watch_propagation.child(kind).observe_n(val, n)
+        dt = time.perf_counter() - t0
+        with self._prop_lock:
+            self._prop_settle_s += dt
+        if inline:
+            sink = w.stat_sink
+            if sink is not None:
+                sink.note_self_time(dt)
+
+    def clear_watch_propagation(self) -> None:
+        """Reset the settled propagation distributions (the bench clears at
+        the measured window's start, like flightrec.clear())."""
+        with self._prop_lock:
+            self._prop_hist.clear()
+            self._prop_settle_s = 0.0
+
+    def watch_propagation_summary(self) -> Dict:
+        """Per-kind + merged commit->dequeue distribution: what `ktl sched
+        stats` renders, the bench rungs publish, and the
+        watch_propagation_p99_s SLO key (scheduler/slo.py) gates. Callers
+        that need fresh numbers go through watch_telemetry(), which settles
+        every subscriber's pending ops first."""
+        m = _metrics()
+        with self._prop_lock:
+            hists = dict(self._prop_hist)
+            settle = self._prop_settle_s
+        merged = m.Histogram("merged", buckets=m.PROPAGATION_BUCKETS)
+        kinds: Dict[str, Dict] = {}
+        for kind, h in sorted(hists.items()):
+            counts, total_sum, n = h.counts_snapshot()
+            if n == 0:
+                continue
+            merged.observe_counts(counts, total_sum, n)
+            kinds[kind] = {
+                "count": n,
+                "mean_s": round(total_sum / n, 6),
+                "p50_s": round(h.quantile(0.50), 6),
+                "p99_s": round(h.quantile(0.99), 6),
+            }
+        total_sum, n = merged.snapshot()
+        return {
+            "kinds": kinds,
+            "count": n,
+            "p50_s": round(merged.quantile(0.50), 6) if n else None,
+            "p99_s": round(merged.quantile(0.99), 6) if n else None,
+            "settle_seconds": round(settle, 6),
+        }
+
+    def watch_subscriber_telemetry(self) -> List[Dict]:
+        """Subscriber rows only — the cheap read the /metrics GaugeFuncs
+        use per scrape. Settles pending propagation ops first (keeps the
+        Prometheus propagation series fresh and the per-watch op deques
+        empty — a falsy no-op when nothing is pending) but SKIPS the
+        merged-summary construction watch_telemetry() does, which the
+        gauges never read. The rv watermark is against the GLOBAL
+        resourceVersion stream (etcd-revision semantics), so a
+        kind-filtered subscriber's lag includes unrelated commits — like
+        the reference's watch-cache lag, it measures staleness, not
+        undelivered matching events."""
         with self._lock:
             watchers = list(self._watchers)
+            rv = self._rv
+        for w in watchers:
+            # outside the store lock (LK002)
+            self._settle_propagation(w)
+        return [{"id": w.id,
+                 "queue_length": w._q.qsize(),
+                 "coalesce": w.coalesce,
+                 "terminated": w.terminated,
+                 "last_delivered_rv": w.last_delivered_rv,
+                 "rv_lag": max(0, rv - w.last_delivered_rv)}
+                for w in watchers]
+
+    def watch_telemetry(self) -> Dict:
+        """Per-subscriber watch-bus state (ISSUE 7 satellite; propagation +
+        rv-lag columns ISSUE 9): live subscriber ids with buffered-event
+        counts and delivered-RV watermarks, the dropped-delivery counters,
+        and the settled commit->dequeue propagation distribution — what
+        `ktl sched stats`, /debug/controlstats, and the bench rungs read."""
+        with self._lock:
             drops = dict(self._watch_drops)
         return {
-            "subscribers": [{"id": w.id,
-                             "queue_length": w._q.qsize(),
-                             "coalesce": w.coalesce,
-                             "terminated": w.terminated}
-                            for w in watchers],
+            "subscribers": self.watch_subscriber_telemetry(),
             "dropped": drops,
+            "propagation": self.watch_propagation_summary(),
         }
 
     # -- scheduling-specific transactional surfaces ----------------------------
@@ -1018,6 +1222,8 @@ class APIStore:
         with self._lock:
             with self._pods_lock:
                 rv = self._rv
+                # shared propagation stamp for the whole commit (one read)
+                t_commit = self._commit_stamp()
                 for key, old, new, node_name in prepared:
                     if get(key) is not old:
                         # raced between the phases: re-validate on the
@@ -1040,12 +1246,14 @@ class APIStore:
                     pods[key] = new
                     if lazy_on:
                         append(_make_event(MODIFIED, "pods", new, rv, old,
-                                           [None, pod_bind_clone]))
+                                           [None, pod_bind_clone], t_commit))
                     elif eager:
                         append(_make_event(MODIFIED, "pods",
-                                           pod_bind_clone(new), rv, old))
+                                           pod_bind_clone(new), rv, old,
+                                           commit_ts=t_commit))
                     else:
-                        append(_make_event(MODIFIED, "pods", new, rv, old))
+                        append(_make_event(MODIFIED, "pods", new, rv, old,
+                                           commit_ts=t_commit))
                     bound += 1
                 self._rv = rv
                 self._emit_batch(MODIFIED, "pods", events, origin)

@@ -21,7 +21,7 @@ def test_bench_quick_runs_and_emits_json():
     env.pop("CACHE_MUTATION_DETECTOR", None)
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--quick"],
-        capture_output=True, text=True, timeout=180, env=env, cwd=REPO)
+        capture_output=True, text=True, timeout=240, env=env, cwd=REPO)
     assert proc.returncode == 0, proc.stderr[-2000:]
     # stdout is exactly one JSON object (the last non-empty line)
     lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
@@ -66,6 +66,18 @@ def test_bench_quick_runs_and_emits_json():
     # the out-of-band checks really ran (not silently skipped)
     assert "solver_compiles" not in slo["skipped"], slo
     assert "instrumentation_frac" not in slo["skipped"], slo
+    # watch-propagation columns (ISSUE 9): the rung publishes the scheduler
+    # subscriber's commit->dequeue distribution — the coalesced fast path
+    # must be counted (the NorthStar ingest IS that path), and the
+    # instrumentation budget asserted above now includes the watch-tap
+    # settlement billed through the Watch stat_sink
+    wcol = ns["watch"]
+    assert wcol["propagation_count"] >= ns["pods"], wcol
+    assert wcol["propagation_p99_s"] is not None, wcol
+    assert wcol["propagation_p99_s"] >= (wcol["propagation_p50_s"] or 0), wcol
+    assert wcol["subscribers"] >= 1, wcol
+    # controller-reconcile column: uniform schema (no controllers run here)
+    assert "reconcile" in ns, ns.keys()
     # sampled lifecycle spans: the tracer sampled pods and completed every
     # span it kept (all pods bound in this rung)
     tr = ns["trace"]
@@ -117,6 +129,31 @@ def test_bench_quick_runs_and_emits_json():
     assert cc["latency"]["count"] > 0, cc
     assert cc["latency"]["p99_s"] >= cc["latency"]["p50_s"] > 0, cc
     assert cc["slo"]["pass"] is True, cc
+    # the chaos rung publishes watch-propagation columns too (ISSUE 9):
+    # injected watch.deliver drops are counted, delivered events measured
+    assert cc["watch"]["propagation_count"] > 0, cc["watch"]
+    # the control-plane flight recorder rung (ISSUE 9): deployment rollout
+    # + node drain + eviction/replace driven through the controllers and
+    # hollow kubelets, gated by the new SLO keys — BOTH must be real PASS
+    # verdicts (present, not skipped), the drain must actually evict, the
+    # evict->replace span chains must link and complete, and submit->running
+    # spans must cover the kubelet tail
+    cp = workloads["ControlPlane_churn"]
+    assert "error" not in cp, cp
+    assert cp["controlplane_ok"] is True, cp
+    assert cp["slo"]["pass"] is True, cp
+    assert cp["slo"]["skipped"] == [], cp["slo"]
+    checked = {c["name"] for c in cp["slo"]["checks"] if c["ok"] is True}
+    assert {"watch_propagation_p99_s", "reconcile_p99_ms"} <= checked, cp["slo"]
+    assert cp["evicted_from_drain"] > 0, cp
+    assert cp["trace"]["evict_replace_chains"] >= 1, cp["trace"]
+    assert cp["trace"]["chains_complete"] == \
+        cp["trace"]["evict_replace_chains"], cp["trace"]
+    assert cp["trace"]["running_spans"] > 0, cp["trace"]
+    assert cp["watch"]["propagation_count"] > 0, cp["watch"]
+    assert cp["reconcile"]["p99_ms"] is not None, cp["reconcile"]
+    assert cp["reconcile"]["errors"] == 0, cp["reconcile"]
+    assert len(cp["controllers"]) == 3, cp["controllers"]
     # injector-DISABLED overhead budget (<1% on the NorthStar rung): the
     # rung measures the per-check cost of the disabled guard directly; the
     # NorthStar path runs a handful of checks per BATCH/chunk/delivery,

@@ -1,0 +1,493 @@
+"""Control-plane flight recorder (ISSUE 9): watch-propagation tracing
+(commit stamps on both the per-object and coalesced fast paths, replay
+exclusion, rv-lag, on/off placement parity), the reconcile-loop recorder
+every controller inherits (per-loop spans, bounded rings, error/requeue
+accounting, workqueue depth/age), submit->running spans with evict->replace
+causal chains, the new SLO keys, and the /debug/controlstats + `ktl
+controller stats` surfaces. Mutation detector force-enabled throughout (the
+PR 4 CI pattern)."""
+
+import io
+import json
+import urllib.request
+from contextlib import redirect_stdout
+
+import pytest
+
+from kubernetes_tpu.agent import HollowKubelet
+from kubernetes_tpu.api.workloads import ReplicaSet
+from kubernetes_tpu.controllers import Controller, ReplicaSetController
+from kubernetes_tpu.obs.recorder import RingRecorder, StageClock
+from kubernetes_tpu.obs.reconcile import (ReconcileRecorder,
+                                          controlstats_snapshot,
+                                          reconcile_rollup)
+from kubernetes_tpu.scheduler import Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.scheduler.podtrace import SPAN_STAGES, note_pod_event
+from kubernetes_tpu.scheduler.slo import (CONTROL_PLANE_SLO,
+                                          KNOWN_SPEC_KEYS, evaluate_slo)
+from kubernetes_tpu.server import metrics as m
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import (MakeNode, MakePod,
+                                    mutation_detector_guard)
+from kubernetes_tpu.utils import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    yield from mutation_detector_guard(monkeypatch)
+
+
+def _nodes(n, cpu="16", mem="64Gi"):
+    return [MakeNode(f"node-{i}").capacity(
+        {"cpu": cpu, "memory": mem, "pods": "110"}).obj() for i in range(n)]
+
+
+def _pods(n, prefix="p", cpu="100m"):
+    return [MakePod(f"{prefix}-{i}").req({"cpu": cpu}).obj()
+            for i in range(n)]
+
+
+def _sched(store, **kw):
+    kw.setdefault("batch_size", 1024)
+    kw.setdefault("solver", "exact")
+    kw.setdefault("pipeline_binds", False)
+    sched = BatchScheduler(store, Framework(default_plugins()), **kw)
+    sched.sync()
+    return sched
+
+
+def _placements(store):
+    return {p.metadata.name: p.spec.node_name
+            for p in store.list("pods")[0] if p.spec.node_name}
+
+
+def make_rs(name="web", replicas=3, cpu="100m"):
+    return ReplicaSet.from_dict({
+        "metadata": {"name": name},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {
+                "metadata": {"labels": {"app": name}},
+                "spec": {"containers": [{"name": "c", "resources": {
+                    "requests": {"cpu": cpu}}}]},
+            },
+        },
+    })
+
+
+# -- watch-propagation tracing ---------------------------------------------------
+
+
+class TestWatchPropagation:
+    def _churn(self, store, n=50):
+        store.create_many("pods", _pods(n), consume=True)
+        store.bind_many([("default", f"p-{i}", f"node-{i % 4}")
+                         for i in range(n)], origin="t")
+
+    def test_commit_stamps_ride_both_delivery_forms(self):
+        store = APIStore()
+        wc = store.watch(kind=("pods",), coalesce=True)
+        wp = store.watch(kind=("pods",))
+        self._churn(store, 10)
+        cevs = wc.drain()
+        evs = wp.drain()
+        # the coalesced fast path carries the batch's ONE shared stamp
+        # (ISSUE 9 satellite: without it the NorthStar ingest path would be
+        # invisible to propagation histograms)
+        assert cevs and all(c.commit_ts > 0 for c in cevs)
+        assert all(ev.commit_ts == cevs[0].commit_ts
+                   for ev in cevs[0].events)
+        # per-object (incl. lazily materialized) events carry it too
+        assert evs and all(ev.commit_ts > 0 for ev in evs)
+
+    def test_propagation_parity_across_coalesce_modes(self):
+        """The SAME churn counts the SAME number of propagation
+        observations whether the subscriber rides the coalesced fast path
+        or the per-object path (satellite: the fast path must not be
+        silently excluded)."""
+        counts = {}
+        for coalesce in (True, False):
+            store = APIStore()
+            w = store.watch(kind=("pods",), coalesce=coalesce)
+            self._churn(store, 50)
+            w.drain()
+            counts[coalesce] = store.watch_telemetry()[
+                "propagation"]["count"]
+        assert counts[True] == counts[False] == 100  # 50 ADDED + 50 bind
+
+    def test_replayed_history_is_catchup_not_lag(self):
+        store = APIStore()
+        self._churn(store, 20)
+        w = store.watch(kind=("pods",), since_rv=0)  # full replay
+        evs = w.drain()
+        assert len(evs) == 40
+        assert store.watch_telemetry()["propagation"]["count"] == 0
+        # events committed AFTER the subscription DO count
+        store.create("pods", MakePod("late").obj())
+        w.drain()
+        assert store.watch_telemetry()["propagation"]["count"] == 1
+
+    def test_propagation_off_is_inert_and_placements_identical(self):
+        place = {}
+        for enabled in (True, False):
+            store = APIStore(watch_propagation=enabled)
+            for n in _nodes(4):
+                store.create("nodes", n)
+            sched = _sched(store)
+            store.create_many("pods", _pods(32, prefix="par"), consume=True)
+            sched.run_until_idle()
+            place[enabled] = _placements(store)
+            prop = store.watch_telemetry()["propagation"]
+            if enabled:
+                assert prop["count"] > 0
+            else:
+                assert prop["count"] == 0 and prop["p99_s"] is None
+        assert place[True] == place[False]  # byte-identical placements
+
+    def test_rv_lag_tracks_undrained_subscriber(self):
+        store = APIStore()
+        w = store.watch(kind=("pods",))
+        self._churn(store, 10)
+        tel = store.watch_telemetry()
+        sub = next(s for s in tel["subscribers"] if s["id"] == w.id)
+        assert sub["rv_lag"] == 20  # 10 creates + 10 binds, none dequeued
+        w.drain()
+        tel = store.watch_telemetry()
+        sub = next(s for s in tel["subscribers"] if s["id"] == w.id)
+        assert sub["rv_lag"] == 0
+        assert sub["last_delivered_rv"] == store.rv
+
+    def test_observe_n_matches_sequential_observes(self):
+        h1 = m.Histogram("a", buckets=m.PROPAGATION_BUCKETS)
+        h2 = m.Histogram("b", buckets=m.PROPAGATION_BUCKETS)
+        for _ in range(7):
+            h1.observe(0.42)
+        h2.observe_n(0.42, 7)
+        assert h1.counts_snapshot() == h2.counts_snapshot()
+        assert h1.quantile(0.5) == h2.quantile(0.5)
+
+    def test_settlement_survives_ops_cap_inline(self):
+        # more drains than the per-watch ops cap: inline settlement keeps
+        # the deque bounded and loses nothing
+        store = APIStore()
+        w = store.watch(kind=("pods",))
+        for i in range(100):
+            store.create("pods", MakePod(f"cap-{i}").obj())
+            w.drain()
+        assert len(w._prop_ops) <= w._PROP_OPS_CAP + 1
+        assert store.watch_telemetry()["propagation"]["count"] == 100
+
+
+# -- reconcile-loop recorder -----------------------------------------------------
+
+
+class _FailOnce(Controller):
+    watch_kinds = ("pods",)
+
+    def __init__(self, store, **kw):
+        super().__init__(store, **kw)
+        self.failed = False
+
+    def key_of_object(self, kind, obj):
+        return obj.key
+
+    def sync(self, key):
+        if not self.failed:
+            self.failed = True
+            raise RuntimeError("transient")
+
+
+class TestReconcileRecorder:
+    def test_loops_keys_and_stage_table(self):
+        store = APIStore()
+        rsc = ReplicaSetController(store)
+        rsc.sync_all()
+        store.create("replicasets", make_rs(replicas=4))
+        rsc.run_until_stable(max_rounds=10)
+        st = rsc.reconcile_stats()
+        assert st["loops"] > 0 and st["keys"] >= st["loops"]
+        assert st["events"] > 0  # pump ingested the RS/pod events
+        assert st["errors"] == 0
+        sync = st["stages"]["sync"]
+        assert sync["p99_ms"] >= sync["p50_ms"] > 0
+        assert st["reconcile_p99_ms"] == sync["p99_ms"]
+        assert st["last"]["keys"] >= 1
+
+    def test_sync_error_counted_and_key_requeued(self):
+        store = APIStore()
+        c = _FailOnce(store)
+        c.sync_all()
+        store.create("pods", MakePod("x").obj())
+        c.pump()
+        c.process()
+        assert c.sync_errors == 1
+        st = c.reconcile_stats()
+        assert st["errors"] == 1 and st["requeues"] == 1
+        assert st["depth"] == 1  # the failed key is re-marked
+        c.process()  # retry succeeds
+        assert c.reconcile_stats()["depth"] == 0
+
+    def test_ring_bounded_under_sustained_churn(self):
+        store = APIStore()
+        rsc = ReplicaSetController(store)
+        rsc.sync_all()
+        store.create("replicasets", make_rs(replicas=1))
+        for i in range(3 * rsc.recorder.capacity):
+            store.guaranteed_update(
+                "replicasets", "default/web",
+                lambda rs: (setattr(rs.spec, "replicas", 1 + i % 2), rs)[1])
+            rsc.reconcile_once()
+        st = rsc.reconcile_stats()
+        assert st["records"] <= rsc.recorder.capacity
+        assert st["loops"] >= 3 * rsc.recorder.capacity  # totals survive
+        # the stage table keeps covering evicted records (windowed hists)
+        assert st["stages"]["sync"]["batches"] == st["loops"]
+
+    def test_telemetry_off_is_inert_and_state_identical(self):
+        end_state = {}
+        for telemetry in (True, False):
+            store = APIStore()
+            rsc = ReplicaSetController(store, telemetry=telemetry)
+            rsc.sync_all()
+            store.create("replicasets", make_rs(replicas=5))
+            rsc.run_until_stable(max_rounds=10)
+            end_state[telemetry] = sorted(
+                p.metadata.name for p in store.list("pods")[0])
+            if not telemetry:
+                assert rsc.recorder.loops == 0
+                assert len(rsc.recorder.records()) == 0
+        assert end_state[True] == end_state[False]
+
+    def test_workqueue_depth_and_oldest_age(self):
+        clock = FakeClock(100.0)
+        store = APIStore()
+        c = _FailOnce(store, clock=clock)
+        c._mark("default/a")
+        clock.step(3.0)
+        c._mark("default/b")
+        assert c.workqueue_depth() == 2
+        clock.step(2.0)
+        # oldest = default/a, marked 5s ago; re-marking must NOT reset it
+        c._mark("default/a")
+        assert c.oldest_dirty_age_s() == pytest.approx(5.0)
+
+    def test_rollup_picks_worst_controller(self):
+        snap = {
+            "A": {"loops": 2, "keys": 4, "errors": 1,
+                  "reconcile_p99_ms": 10.0},
+            "B": {"loops": 1, "keys": 1, "errors": 0,
+                  "reconcile_p99_ms": 250.0},
+            "C": {"error": "wedged"},
+        }
+        roll = reconcile_rollup(snap)
+        assert roll["p99_ms"] == 250.0
+        assert roll["worst_controller"] == "B"
+        assert roll["loops"] == 3 and roll["errors"] == 1
+
+    def test_registry_snapshot_contains_live_controller(self):
+        store = APIStore()
+        rsc = ReplicaSetController(store)
+        rsc.sync_all()
+        store.create("replicasets", make_rs(replicas=2))
+        rsc.run_until_stable(max_rounds=5)
+        snap = controlstats_snapshot()
+        assert "ReplicaSetController" in snap
+        assert snap["ReplicaSetController"]["loops"] > 0
+
+    def test_recorder_clear_resets_counters(self):
+        r = ReconcileRecorder("X", capacity=8)
+        r.loop(keys=3, errors=1, requeues=1, seconds=0.01, depth=0)
+        r.pump(5, 0.001)
+        r.clear()
+        assert r.loops == 0 and r.keys_total == 0 and r.events_total == 0
+        assert len(r.records()) == 0
+        assert r.stage_table() == {}
+
+
+# -- shared ring machinery (obs/recorder.py) -------------------------------------
+
+
+class TestRingRecorder:
+    def test_flightrec_still_built_on_the_shared_base(self):
+        from kubernetes_tpu.scheduler.flightrec import FlightRecorder
+
+        assert issubclass(FlightRecorder, RingRecorder)
+        assert issubclass(ReconcileRecorder, RingRecorder)
+
+    def test_stage_clock_reexport_identity(self):
+        from kubernetes_tpu.scheduler.flightrec import StageClock as SC2
+
+        assert SC2 is StageClock
+
+
+# -- submit->running spans + evict->replace chains -------------------------------
+
+
+class TestEndToEndSpans:
+    def _cluster(self, n_nodes=2, sample_k=64):
+        store = APIStore()
+        kubelets = [HollowKubelet(store, f"hollow-{i}",
+                                  capacity={"cpu": "16", "memory": "64Gi",
+                                            "pods": "110"})
+                    for i in range(n_nodes)]
+        for k in kubelets:
+            k.register()
+        sched = _sched(store, trace_sample_k=sample_k)
+        return store, sched, kubelets
+
+    def test_submit_to_running_span_all_edges_ordered(self):
+        store, sched, kubelets = self._cluster()
+        store.create_many("pods", _pods(10, prefix="e2e"), consume=True)
+        sched.run_until_idle()
+        for k in kubelets:
+            k.pump()
+        snap = sched.podtrace.snapshot()
+        assert snap["spans"]
+        for sp in snap["spans"]:
+            offs = sp["stamps_ms"]
+            assert list(offs) == list(SPAN_STAGES)  # all 10 edges, ordered
+            vals = [offs[s] for s in SPAN_STAGES]
+            assert vals == sorted(vals)
+            assert sp["submit_to_running_ms"] >= sp["submit_to_bound_ms"]
+        assert snap["completed"] == 10
+
+    def test_evict_replace_chain_links_and_completes(self):
+        store, sched, kubelets = self._cluster()
+        rsc = ReplicaSetController(store)
+        rsc.sync_all()
+        store.create("replicasets", make_rs(replicas=4))
+        for _ in range(5):
+            rsc.reconcile_once()
+            sched.run_until_idle()
+            for k in kubelets:
+                k.pump()
+        victims = [p for p in store.list("pods")[0] if p.spec.node_name][:2]
+        for v in victims:
+            store.delete("pods", v.key)
+        sched.pump_events()  # DELETED taps record the owner links
+        for _ in range(5):
+            rsc.reconcile_once()
+            sched.run_until_idle()
+            for k in kubelets:
+                k.pump()
+        spans = sched.podtrace.snapshot()["spans"]
+        old = [s for s in spans if s.get("deleted")]
+        new = [s for s in spans if s.get("replaces")]
+        # every victim's span linked forward, every replacement linked back
+        # and completed (satellite: span completeness across evict->replace)
+        assert len(old) == 2 and all(s.get("replaced_by") for s in old)
+        assert len(new) == 2 and all(s["complete"] for s in new)
+        assert {s["replaces"] for s in new} == {v.key for v in victims}
+
+    def test_unsampled_note_pod_event_is_noop(self):
+        note_pod_event("default/ghost", "running")  # must not raise
+        store, sched, _ = self._cluster(sample_k=1)
+        store.create_many("pods", _pods(5, prefix="u"), consume=True)
+        sched.run_until_idle()
+        note_pod_event("default/not-a-pod", "running")
+        assert sched.podtrace.snapshot()["completed"] >= 1
+
+
+# -- SLO keys --------------------------------------------------------------------
+
+
+class TestControlPlaneSLO:
+    def test_new_keys_are_known(self):
+        assert set(CONTROL_PLANE_SLO) <= KNOWN_SPEC_KEYS
+
+    def test_pass_fail_and_skip(self):
+        stats = {"watch": {"propagation": {"p99_s": 0.5}},
+                 "reconcile": {"p99_ms": 100.0}}
+        res = evaluate_slo(stats, CONTROL_PLANE_SLO)
+        assert res["pass"] and not res["skipped"]
+        res = evaluate_slo(stats, {"watch_propagation_p99_s": 0.1})
+        assert res["failed"] == ["watch_propagation_p99_s"]
+        res = evaluate_slo(stats, {"reconcile_p99_ms": 1.0})
+        assert res["failed"] == ["reconcile_p99_ms"]
+        # a payload without the sections SKIPs (reported, never silent pass)
+        res = evaluate_slo({}, CONTROL_PLANE_SLO)
+        assert res["pass"] and set(res["skipped"]) == set(CONTROL_PLANE_SLO)
+
+    def test_typoed_new_keys_fail_loudly(self):
+        res = evaluate_slo({}, {"watch_propagation_p99s": 1.0,
+                                "reconcile_p99ms": 1.0})
+        assert not res["pass"]
+        assert sorted(res["failed"]) == [
+            "unknown_spec_key:reconcile_p99ms",
+            "unknown_spec_key:watch_propagation_p99s"]
+
+
+# -- HTTP + ktl surfaces ---------------------------------------------------------
+
+
+class TestControlStatsSurfaces:
+    def _server_with_controller(self):
+        from kubernetes_tpu.server import APIServer
+
+        store = APIStore()
+        srv = APIServer(store).start()
+        rsc = ReplicaSetController(store)
+        rsc.sync_all()
+        store.create("replicasets", make_rs(replicas=3))
+        rsc.run_until_stable(max_rounds=10)
+        return store, srv, rsc
+
+    def test_debug_controlstats_endpoint(self):
+        store, srv, rsc = self._server_with_controller()
+        try:
+            with urllib.request.urlopen(
+                    f"{srv.url}/debug/controlstats") as resp:
+                doc = json.loads(resp.read())
+            assert "ReplicaSetController" in doc["controllers"]
+            st = doc["controllers"]["ReplicaSetController"]
+            assert st["loops"] > 0
+            assert doc["reconcile"]["p99_ms"] is not None
+            assert "propagation" in doc["watch"]
+        finally:
+            srv.stop()
+
+    def test_ktl_controller_stats_renders(self):
+        from kubernetes_tpu.cli.ktl import main as ktl_main
+
+        store, srv, rsc = self._server_with_controller()
+        try:
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert ktl_main(["--server", srv.url, "controller",
+                                 "stats"]) == 0
+            out = buf.getvalue()
+            assert "CONTROLLER" in out and "P99(ms)" in out
+            assert "ReplicaSetController" in out
+            assert "reconcile:" in out
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert ktl_main(["--server", srv.url, "controller", "stats",
+                                 "-o", "json"]) == 0
+            doc = json.loads(buf.getvalue())
+            assert "ReplicaSetController" in doc["controllers"]
+        finally:
+            srv.stop()
+
+    def test_ktl_sched_stats_shows_watch_propagation(self):
+        from kubernetes_tpu.cli.ktl import main as ktl_main
+        from kubernetes_tpu.server import APIServer
+
+        store = APIStore()
+        srv = APIServer(store).start()
+        try:
+            for n in _nodes(2):
+                store.create("nodes", n)
+            sched = _sched(store)
+            store.create_many("pods", _pods(10, prefix="wt"), consume=True)
+            sched.run_until_idle()
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                assert ktl_main(["--server", srv.url, "sched",
+                                 "stats"]) == 0
+            out = buf.getvalue()
+            assert "watch bus:" in out and "propagation" in out
+        finally:
+            srv.stop()

@@ -172,13 +172,18 @@ class TestLifecycleSpans:
         assert tr.completed_total == 10
         assert tr.live_incomplete == 0
         snap = tr.snapshot()
+        # the scheduler pipeline stamps every edge up to bind_confirmed; the
+        # post-scheduler edges (watch_delivered/kubelet_observed/running,
+        # ISSUE 9) come from the kubelet taps and are absent here
+        sched_stages = SPAN_STAGES[:SPAN_STAGES.index("watch_delivered")]
         for sp in snap["spans"]:
             assert sp["complete"] is True
             offs = sp["stamps_ms"]
-            assert list(offs) == list(SPAN_STAGES)  # ordered, all present
-            vals = [offs[s] for s in SPAN_STAGES]
+            assert list(offs) == list(sched_stages)  # ordered, all present
+            vals = [offs[s] for s in sched_stages]
             assert vals == sorted(vals) and vals[0] == 0.0
             assert sp["submit_to_bound_ms"] == offs["bind_confirmed"]
+            assert sp["submit_to_running_ms"] is None
         # ALL pods hit the latency histogram, sampled or not
         assert snap["latency"]["count"] == 10
 

@@ -497,6 +497,72 @@ def test_hp001_quiet_behind_sampled_membership_guard():
         analyze_source(HP001_TRACE_GOOD, filename=_TRACE))
 
 
+# ISSUE 9: controllers/base.py reconcile loops are HP001 hot paths too — a
+# per-key metrics observe (or per-event perf_counter) inside the workqueue
+# drain or the watch-buffer drain is the same multiplier bug; the
+# ReconcileRecorder taps are per LOOP (recorder.loop()/pump() around the
+# whole drain).
+
+HP001_CONTROLLER_BAD = '''
+import time
+
+def process(self, keys, m):
+    for key in keys:
+        t0 = time.perf_counter()
+        self.sync(key)
+        m.controller_reconcile_duration.observe(
+            time.perf_counter() - t0, "key")
+
+def pump(self, clock):
+    for ev in self._watch.drain(10_000):
+        clock.mark("event")
+        self._mark(ev.obj.key)
+'''
+
+HP001_CONTROLLER_GOOD = '''
+import time
+
+def process(self, keys, recorder):
+    t0 = time.perf_counter()
+    for key in keys:
+        self.sync(key)
+    recorder.loop(keys=len(keys), errors=0, requeues=0,
+                  seconds=time.perf_counter() - t0, depth=0)
+
+def pump(self, recorder):
+    t0 = time.perf_counter()
+    n = 0
+    for ev in self._watch.drain(10_000):
+        self._mark(ev.obj.key)
+        n += 1
+    recorder.pump(n, time.perf_counter() - t0)
+'''
+
+_CTRL = "kubernetes_tpu/controllers/base.py"
+
+
+def test_hp001_fires_on_per_key_reconcile_instrumentation():
+    findings = [f for f in analyze_source(HP001_CONTROLLER_BAD,
+                                          filename=_CTRL)
+                if f.rule == "HP001"]
+    # per-key perf_counter + observe in process(), per-event clock.mark in
+    # the drain loop of pump() — all three are the multiplier bug
+    assert len(findings) >= 3, findings
+
+
+def test_hp001_quiet_on_per_loop_reconcile_taps():
+    assert "HP001" not in rules_of(
+        analyze_source(HP001_CONTROLLER_GOOD, filename=_CTRL))
+
+
+def test_hp001_controller_scope_is_base_py_only():
+    # a concrete controller's sync() body is per-OBJECT by design (one key
+    # at a time); only the base reconcile loops are the hot path
+    assert "HP001" not in rules_of(analyze_source(
+        HP001_CONTROLLER_BAD,
+        filename="kubernetes_tpu/controllers/replicaset.py"))
+
+
 def test_hp001_guard_does_not_launder_batch_py_metrics():
     # the sampled-set exception is for tracer STAMPS; a metrics observe per
     # pod is still a finding even when some unrelated guard wraps it —
