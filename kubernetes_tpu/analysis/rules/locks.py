@@ -14,8 +14,14 @@ LK002 (blocking while locked): within any recognized lock region — and in
 every function reachable from one through resolved calls — flag calls that
 can block or dispatch long work: time.sleep, zero-arg .join(), blocking
 queue .get()/.put() (queue-ish receivers, `_nowait` excluded), jax/jnp
-dispatch (including calls to known-jitted functions), and watch-callback
-delivery (`on_event`). Lock identity is qualified by the enclosing class, so
+dispatch (including calls to known-jitted functions), watch-callback
+delivery (`on_event`), and the GIL-RELEASING native kernels (ISSUE 11: the
+ctypes-CDLL entry points in native/hostsched.py drop the GIL for the call's
+duration — releasing it inside a store/scheduler lock region invites the
+classic GIL/lock interleavings; see the NATIVE LOCK RULE in store/store.py.
+The PyDLL commit-engine entries in native/hostcommit.py HOLD the GIL and
+are deliberately NOT in this set — being called under the store locks is
+their whole point). Lock identity is qualified by the enclosing class, so
 Cache._lock and APIStore._lock never alias.
 """
 
@@ -33,6 +39,16 @@ SHARD = ("APIStore", "_pods_lock")
 PAIR = ("APIStore", "<pair>")  # global-then-shard composite (order-safe)
 
 _QUEUEISH = re.compile(r"(^|_)q$|queue", re.IGNORECASE)
+
+# GIL-releasing native entry points (ctypes CDLL wrappers in
+# native/hostsched.py): blocking under LK002 — the call drops the GIL until
+# the C kernel returns. The PyDLL commit engine (native/hostcommit.py
+# bind_prepare/bind_commit/delete_commit/assume_structural/batch_rows) holds
+# the GIL and is NOT listed.
+_NATIVE_GIL_RELEASING = frozenset({
+    "native_greedy_solve",
+    "native_commit_deltas",
+})
 
 _NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
 
@@ -127,10 +143,16 @@ def _blocking_desc(call: ast.Call, func: FuncInfo, index: ProjectIndex,
             return "device sync .block_until_ready()"
         if f.attr == "on_event":
             return "watch callback delivery (on_event)"
+        if f.attr in _NATIVE_GIL_RELEASING:
+            return (f"GIL-releasing native kernel ({f.attr}: ctypes CDLL "
+                    "drops the GIL — store/store.py NATIVE LOCK RULE)")
         if _is_jax_root(f):
             return f"jax dispatch ({ast.unparse(f)})" \
                 if hasattr(ast, "unparse") else "jax dispatch"
     elif isinstance(f, ast.Name):
+        if f.id in _NATIVE_GIL_RELEASING:
+            return (f"GIL-releasing native kernel ({f.id}: ctypes CDLL "
+                    "drops the GIL — store/store.py NATIVE LOCK RULE)")
         if f.id == "sleep" and fi.imports.get("sleep", "").startswith("time"):
             return "time.sleep()"
         if f.id in jitted_names:

@@ -43,6 +43,7 @@ Sites (the registry below documents where each is wired):
   watch.deliver      Watch._deliver/_deliver_coalesced — dropped delivery
   bind.worker        BatchScheduler._bind_cycle — worker fault / hard kill
   kubelet.heartbeat  HollowKubelet.heartbeat — missed lease renewal
+  native.commit      bind_many/delete_pods native commit boundary (ISSUE 11)
 
 Arming: programmatic `arm([FaultPlan(...), ...])` (tests/bench), or the
 FAULT_INJECT env var at import time, e.g.
@@ -72,6 +73,16 @@ SITES: Dict[str, str] = {
     "watch.deliver": "store/store.py Watch._deliver* (drop-only: store lock)",
     "bind.worker": "scheduler/batch.py BatchScheduler._bind_cycle",
     "kubelet.heartbeat": "agent/hollow.py HollowKubelet.heartbeat (drop-only)",
+    # the native commit boundary (ISSUE 11): fires only when the C-API
+    # commit engine is taking the write — for bind_many in the gap between
+    # the validate/clone phase and the commit phase (clones made, nothing
+    # committed, no lock held), for delete_pods before the critical section.
+    # A mid-chunk native failure therefore leaves the store untouched and
+    # must be fully absorbed by the caller's retry/requeue machinery
+    # (supervised bind worker), conserving every pod — ChaosChurn_20k's
+    # native leg proves it.
+    "native.commit": "store/store.py bind_many/delete_pods native phase gap "
+                     "(no lock held)",
 }
 
 # sites that fire under a lock (or inside a loop that must not stall): only

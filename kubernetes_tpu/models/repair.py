@@ -520,8 +520,21 @@ def repair_solve(inp: SolverInputs, batch, d_max: int, *,
         else:
             req_row = ctx.req_np[pi0].astype(np.int64)
             nz = req_row > 0
-            stack = (int((ctx.free0[:, nz] // req_row[nz]).min(axis=1)
-                         .max(initial=0)) if nz.any() else j_max)
+            # the stack bound only needs to cover nodes the kernel can
+            # actually CHOOSE — frow is filter_ok & mask, so restricting the
+            # max to eligible nodes is strictly tighter and still a safe
+            # over-estimate (free0 never grows within the batch). This is
+            # the PodAffinity propose lever (ISSUE 11 satellite): an
+            # affinity group's eligible zone nodes hold the seed pods and
+            # have far less headroom than the emptiest cluster node, and
+            # kernel cost is linear in run_j.
+            elig = mask & ctx.filter_np[cls]
+            if nz.any():
+                free_elig = ctx.free0[elig][:, nz]
+                stack = (int((free_elig // req_row[nz]).min(axis=1)
+                             .max(initial=0)) if free_elig.size else 0)
+            else:
+                stack = j_max
             run_j = 1 << (max(1, min(j_max, len(members), stack))
                           - 1).bit_length()
         k_slots = min(1 << (len(members) - 1).bit_length(), n * run_j)

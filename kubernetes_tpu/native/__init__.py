@@ -1,14 +1,26 @@
-"""Native host runtime: C++ scheduler engine behind a ctypes boundary.
+"""Native host runtime: C++ engines behind ctypes boundaries.
 
-The compute path of this build is JAX/XLA on TPU; the host runtime around it —
-here, the CPU-fallback batch engine mirroring ops/solver.py's scan solver —
-is native C++ (hostsched.cpp), compiled on first use with the toolchain's g++
-and loaded via ctypes. `native_available()` gates callers; everything degrades
-to the JAX/numpy paths when no compiler is present.
+The compute path of this build is JAX/XLA on TPU; the host runtime around it
+is native C++, compiled on first use with the toolchain's g++:
+
+  hostsched.cpp  — pure array kernels loaded via ctypes CDLL, which RELEASES
+                   the GIL for every call: the CPU-fallback batch engine
+                   (greedy_assign) and the columnar-assume scatter-add
+                   (commit_deltas). Never call these under a store/scheduler
+                   lock (schedlint LK002; store/store.py NATIVE LOCK RULE).
+  hostcommit.cpp — the C-API commit engine loaded via ctypes.PyDLL (GIL
+                   HELD): bind/delete commit loops, the assume structural
+                   loop, and build_pod_batch's fused row loop, byte-identical
+                   to their Python oracles (tests/test_native_commit.py).
+
+`native_available()` / `hostcommit.available()` gate callers; everything
+degrades to the JAX/numpy/Python paths when no compiler is present.
 """
 
+from . import hostcommit  # noqa: F401
 from .hostsched import (  # noqa: F401
     native_available,
+    native_commit_deltas,
     native_greedy_solve,
     native_solvable,
 )

@@ -1,0 +1,145 @@
+"""ctypes loader + wrappers for the C-API host commit engine (hostcommit.cpp).
+
+Compiled on first use with the toolchain's g++ against the CPython headers
+and loaded via ctypes.PyDLL — every entry point manipulates Python objects
+and runs WITH the GIL held (the engine's speedup is fewer interpreter cycles
+per pod inside the store/cache critical sections, not GIL release; the
+GIL-releasing array kernels live in hostsched.py). Selection mirrors the
+native solver: `available()` gates callers, everything degrades to the
+Python oracles when the compile fails, and the HOSTSCHED_NATIVE_COMMIT env
+var (0/false) forces the fallback — the knob the parity tests and the
+BindCommit_20k bench's python-vs-native columns use.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .hostsched import build_so
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "hostcommit.cpp")
+_SO = os.path.join(_HERE, "_hostcommit.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.PyDLL] = None
+_build_error: Optional[str] = None
+
+_i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+
+
+def _env_disabled() -> bool:
+    return os.environ.get("HOSTSCHED_NATIVE_COMMIT", "").lower() in (
+        "0", "false")
+
+
+def _load() -> Optional[ctypes.PyDLL]:
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        err = build_so(_SRC, _SO, python_include=True)
+        if err is not None:
+            _build_error = err
+            return None
+        try:
+            lib = ctypes.PyDLL(_SO)
+            obj = ctypes.py_object
+            lib.hc_init.restype = obj
+            lib.hc_init.argtypes = [obj, obj, obj]
+            lib.hc_bind_prepare.restype = obj
+            lib.hc_bind_prepare.argtypes = [obj, obj, obj, obj]
+            lib.hc_bind_commit.restype = obj
+            lib.hc_bind_commit.argtypes = [
+                obj, obj, obj, obj, ctypes.c_long, ctypes.c_int, obj, obj,
+                obj]
+            lib.hc_delete_commit.restype = obj
+            lib.hc_delete_commit.argtypes = [
+                obj, obj, obj, obj, ctypes.c_long, ctypes.c_int, obj, obj,
+                obj]
+            lib.hc_assume_structural.restype = obj
+            lib.hc_assume_structural.argtypes = [obj, obj, obj, obj, obj]
+            lib.hc_batch_rows.restype = obj
+            lib.hc_batch_rows.argtypes = [obj, obj, obj, obj, obj, obj,
+                                          _i32p, _i32p]
+            # one-time type/string setup (the engine holds strong refs)
+            from ..scheduler.framework import NodeInfo, PodInfo
+            from ..store.store import Event
+
+            lib.hc_init(Event, PodInfo, NodeInfo)
+        except (OSError, AttributeError) as e:
+            _build_error = f"load failed: {e}"
+            return None
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    """True when the commit engine is loaded and not env-disabled. The env
+    check is live (not cached) so tests can flip the fallback per-case."""
+    if _env_disabled():
+        return False
+    return _load() is not None
+
+
+def build_error() -> Optional[str]:
+    _load()
+    return _build_error
+
+
+# -- store.bind_many ---------------------------------------------------------
+
+def bind_prepare(pods: dict, bindings, prepared: list, errors: list) -> None:
+    """Phase 1 (validate + ONE bind clone per pod; caller holds the pods
+    shard). Appends (key, old, new, node_name) to prepared."""
+    _lib.hc_bind_prepare(pods, bindings, prepared, errors)
+
+
+def bind_commit(pods: dict, prepared: list, events: list, errors: list,
+                rv: int, mode: int, commit_ts, cloner,
+                etype: str) -> Tuple[int, int]:
+    """Phase 2 (RV stamp + row swap + event append; caller holds global +
+    shard). mode: 0 share / 1 lazy / 2 eager. Returns (final_rv, bound)."""
+    return _lib.hc_bind_commit(pods, prepared, events, errors, rv, mode,
+                               commit_ts, cloner, etype)
+
+
+def delete_commit(pods: dict, keys, events: list, errors: list, rv: int,
+                  mode: int, commit_ts, cloner,
+                  etype: str) -> Tuple[int, int]:
+    """Batched pod-delete commit (caller holds global + shard): pops rows,
+    one structural clone per pod, DELETED events. Returns (final_rv, n)."""
+    return _lib.hc_delete_commit(pods, keys, events, errors, rv, mode,
+                                 commit_ts, cloner, etype)
+
+
+# -- cache assume ------------------------------------------------------------
+
+def assume_structural(pairs, pod_nodes: dict, assumed: dict, nodes: dict,
+                      failed: list) -> None:
+    """Cache.assume_pods_structural's loop (caller holds the cache lock;
+    check_ports=False form only — host-port batches use the Python loop)."""
+    _lib.hc_assume_structural(pairs, pod_nodes, assumed, nodes, failed)
+
+
+# -- build_pod_batch ---------------------------------------------------------
+
+def batch_rows(pods, sig_to_class: dict, rep_pods: list, req_cache: dict,
+               sig_cb, entry_cb) -> Tuple[np.ndarray, np.ndarray]:
+    """The fused per-pod loop of build_pod_batch: returns (class_of_pod
+    int32[P], entry_rows int32[P]); mutates sig_to_class/rep_pods/req_cache
+    exactly like the Python loop (misses call back into sig_cb/entry_cb)."""
+    n = len(pods)
+    if n == 0:
+        z = np.zeros(0, dtype=np.int32)
+        return z, z.copy()
+    class_rows = np.empty(n, dtype=np.int32)
+    entry_rows = np.empty(n, dtype=np.int32)
+    _lib.hc_batch_rows(pods, sig_to_class, rep_pods, req_cache, sig_cb,
+                       entry_cb, class_rows, entry_rows)
+    return class_rows, entry_rows

@@ -152,4 +152,46 @@ int64_t greedy_assign(const int32_t* alloc, int32_t* used, int32_t* used_nz,
   return placed;
 }
 
+// Fused columnar-assume scatter-add (the _columnar_account hot block):
+// d_used[nodes[i]] += raw_req[rows[i]], d_used_nz likewise, d_count bump,
+// touched-node flags — ONE pass over the batch instead of two np.add.at
+// dispatches + bincount + unique. Pure array math: called via ctypes CDLL,
+// which RELEASES the GIL for the duration (the scheduling thread's commit
+// accounting no longer steals interpreter time from the bind worker). Must
+// therefore never run under a store/scheduler lock (schedlint LK002 lists
+// the wrapper as a blocking call). Layouts: raw_req/raw_req_nz [p_all, R]
+// int64 row-major; d_used/d_used_nz [N, R] int64 zeroed by the caller;
+// d_count [N] int64 zeroed; touched [N] uint8 zeroed.
+//
+// Indices are VALIDATED (pass 1) before anything is written (pass 2): the
+// numpy oracle surfaces a bad node/row as a catchable IndexError that the
+// assume/dispatch failure-domain guard rolls back — a silent out-of-bounds
+// heap write here would defeat that machinery. Returns 0, or (bad_index+1)
+// negated for the first out-of-range entry; the wrapper raises IndexError.
+int64_t commit_deltas(const int64_t* rows, const int64_t* nodes, int64_t p,
+                      const int64_t* raw_req, const int64_t* raw_req_nz,
+                      int64_t r, int64_t p_all, int64_t n, int64_t* d_used,
+                      int64_t* d_used_nz, int64_t* d_count,
+                      uint8_t* touched) {
+  for (int64_t i = 0; i < p; ++i) {
+    if (nodes[i] < 0 || nodes[i] >= n || rows[i] < 0 || rows[i] >= p_all)
+      return -(i + 1);
+  }
+  for (int64_t i = 0; i < p; ++i) {
+    const int64_t node = nodes[i];
+    const int64_t row = rows[i];
+    int64_t* du = d_used + node * r;
+    int64_t* dz = d_used_nz + node * r;
+    const int64_t* rq = raw_req + row * r;
+    const int64_t* rz = raw_req_nz + row * r;
+    for (int64_t k = 0; k < r; ++k) {
+      du[k] += rq[k];
+      dz[k] += rz[k];
+    }
+    d_count[node] += 1;
+    touched[node] = 1;
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -25,23 +25,36 @@ _lib: Optional[ctypes.CDLL] = None
 _build_error: Optional[str] = None
 
 
-def _build() -> Optional[str]:
-    """Compile the .so if missing/stale. Returns an error string or None."""
+def build_so(src: str, so: str, python_include: bool = False) -> Optional[str]:
+    """Compile src -> so if missing/stale (shared by this loader and the
+    commit-engine loader in hostcommit.py). python_include adds the CPython
+    headers for C-API translation units. Returns an error string or None."""
     try:
-        if (os.path.exists(_SO)
-                and os.path.getmtime(_SO) >= os.path.getmtime(_SRC)):
+        if (os.path.exists(so)
+                and os.path.getmtime(so) >= os.path.getmtime(src)):
             return None
         # per-process temp name: concurrent builds (pytest workers, daemon +
         # bench on a fresh checkout) must not interleave writes into one file
-        tmp = f"{_SO}.tmp{os.getpid()}"
-        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, _SRC]
+        tmp = f"{so}.tmp{os.getpid()}"
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", tmp, src]
+        if python_include:
+            import sysconfig
+
+            inc = sysconfig.get_paths().get("include")
+            if not inc or not os.path.exists(os.path.join(inc, "Python.h")):
+                return "Python.h not found (no CPython dev headers)"
+            cmd.insert(1, f"-I{inc}")
         proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
         if proc.returncode != 0:
             return f"g++ failed: {proc.stderr[-500:]}"
-        os.replace(tmp, _SO)  # atomic: a concurrent loader sees old or new
+        os.replace(tmp, so)  # atomic: a concurrent loader sees old or new
         return None
     except (OSError, subprocess.SubprocessError) as e:
         return str(e)
+
+
+def _build() -> Optional[str]:
+    return build_so(_SRC, _SO)
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -56,6 +69,7 @@ def _load() -> Optional[ctypes.CDLL]:
         try:
             lib = ctypes.CDLL(_SO)
             i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+            i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
             u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
             lib.greedy_assign.restype = ctypes.c_int64
             lib.greedy_assign.argtypes = [
@@ -65,6 +79,13 @@ def _load() -> Optional[ctypes.CDLL]:
                 i32p, i32p, i32p, u8p,  # class_of_pod, req, req_nz, bal_active
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
                 u8p, i32p,  # feas_buf, assignment
+            ]
+            lib.commit_deltas.restype = ctypes.c_int64
+            lib.commit_deltas.argtypes = [
+                i64p, i64p, ctypes.c_int64,  # rows, nodes, p
+                i64p, i64p, ctypes.c_int64,  # raw_req, raw_req_nz, r
+                ctypes.c_int64, ctypes.c_int64,  # p_all, n (bounds)
+                i64p, i64p, i64p, u8p,  # d_used, d_used_nz, d_count, touched
             ]
         except (OSError, AttributeError) as e:
             # corrupt/incompatible .so: degrade, never raise from available()
@@ -76,6 +97,40 @@ def _load() -> Optional[ctypes.CDLL]:
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def native_commit_deltas(rows, nodes, raw_req, raw_req_nz, n: int):
+    """Fused columnar-assume scatter-add: one C pass over the solved batch
+    computing (d_used [N,R] i64, d_used_nz [N,R] i64, d_count [N] i64,
+    touched node indices, sorted). The ctypes CDLL call RELEASES the GIL for
+    its duration — NEVER call this while holding a store or scheduler lock
+    (schedlint LK002 enforces that; see store/store.py's lock-discipline
+    note). Raises RuntimeError when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native engine unavailable: {_build_error}")
+    rows = np.ascontiguousarray(rows, dtype=np.int64)
+    nodes = np.ascontiguousarray(nodes, dtype=np.int64)
+    raw_req = np.ascontiguousarray(raw_req, dtype=np.int64)
+    raw_req_nz = np.ascontiguousarray(raw_req_nz, dtype=np.int64)
+    r = raw_req.shape[1] if raw_req.ndim == 2 else 0
+    d_used = np.zeros((n, r), dtype=np.int64)
+    d_used_nz = np.zeros((n, r), dtype=np.int64)
+    d_count = np.zeros(n, dtype=np.int64)
+    touched = np.zeros(n, dtype=np.uint8)
+    rc = lib.commit_deltas(rows, nodes, len(rows), raw_req, raw_req_nz, r,
+                           len(raw_req), n, d_used, d_used_nz, d_count,
+                           touched)
+    if rc:
+        # same failure surface as the np.add.at oracle: a catchable
+        # IndexError the assume/dispatch failure-domain guard rolls back
+        # (the kernel validates BEFORE writing, so the deltas are untouched)
+        i = int(-rc - 1)
+        raise IndexError(
+            f"commit_deltas: entry {i} out of bounds "
+            f"(node {int(nodes[i])} of {n}, row {int(rows[i])} of "
+            f"{len(raw_req)})")
+    return d_used, d_used_nz, d_count, np.nonzero(touched)[0]
 
 
 def build_error() -> Optional[str]:

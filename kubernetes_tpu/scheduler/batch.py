@@ -806,12 +806,25 @@ class BatchScheduler(Scheduler):
         rows = np.asarray(bind_rows, dtype=np.int64)
         nodes = np.asarray(bind_nodes, dtype=np.int64)
         n, r = cluster.n, len(cluster.resource_dims)
-        d_used = np.zeros((n, r), dtype=np.int64)
-        d_used_nz = np.zeros((n, r), dtype=np.int64)
-        np.add.at(d_used, nodes, batch.raw_req[rows])
-        np.add.at(d_used_nz, nodes, batch.raw_req_nz[rows])
-        d_count = np.bincount(nodes, minlength=n)
-        touched = np.unique(nodes)
+        from ..native import hostcommit, native_available, native_commit_deltas
+
+        if native_available() and hostcommit.available():
+            # ONE GIL-free C pass (ctypes CDLL releases the GIL for the
+            # call) replacing two np.add.at dispatches + bincount + unique.
+            # NO lock is held here — the CDLL kernels are blocking calls
+            # under schedlint LK002 (store/store.py NATIVE LOCK RULE).
+            # Gated on hostcommit.available() too so the documented kill
+            # switch (HOSTSCHED_NATIVE_COMMIT=0) forces the pure-numpy
+            # fallback on EVERY native-commit path, this one included.
+            d_used, d_used_nz, d_count, touched = native_commit_deltas(
+                rows, nodes, batch.raw_req, batch.raw_req_nz, n)
+        else:
+            d_used = np.zeros((n, r), dtype=np.int64)
+            d_used_nz = np.zeros((n, r), dtype=np.int64)
+            np.add.at(d_used, nodes, batch.raw_req[rows])
+            np.add.at(d_used_nz, nodes, batch.raw_req_nz[rows])
+            d_count = np.bincount(nodes, minlength=n)
+            touched = np.unique(nodes)
         final_gen = self.cache.apply_node_resource_deltas(
             cluster.resource_dims,
             [(cluster.node_names[i], d_used[i], d_used_nz[i])

@@ -500,11 +500,21 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
             for k, v in sorted(res.items()))
 
     def _req_entry(pod) -> tuple:
-        sig = (
-            tuple(_res_sig(c.resources) for c in pod.spec.containers),
-            tuple(_res_sig(c.resources) for c in pod.spec.init_containers),
-            repr(pod.spec.overhead) if pod.spec.overhead else "",
-        )
+        # request-signature memo, keyed by spec identity like _class_sig
+        # (resources live under spec; any change parses a NEW Pod/spec):
+        # the tuple build runs once per pod LIFETIME, and the native fused
+        # loop (hostcommit.batch_rows) reads the same memo — parity by
+        # construction
+        rs = pod.__dict__.get("_req_sig")
+        if rs is not None and rs[0] is pod.spec:
+            sig = rs[1]
+        else:
+            sig = (
+                tuple(_res_sig(c.resources) for c in pod.spec.containers),
+                tuple(_res_sig(c.resources) for c in pod.spec.init_containers),
+                repr(pod.spec.overhead) if pod.spec.overhead else "",
+            )
+            pod.__dict__["_req_sig"] = (pod.spec, sig)
         got = req_cache.get(sig)
         if got is None:
             pr = compute_pod_resource_request(pod)
@@ -544,22 +554,32 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
     else:
         # ONE fused pass per pod: class signature + request-memo row (two
         # separate 100k-pod loops were measurable); per-pod array writes are
-        # replaced by a vectorized gather over the unique memo entries below
+        # replaced by a vectorized gather over the unique memo entries below.
+        # The loop body is memo dict hits in the steady state, so it ports to
+        # the native commit engine (ISSUE 11) verbatim — same dicts, same
+        # append order, misses call back into the Python helpers.
         sig_to_class: Dict[tuple, int] = {}
         rep_pods = []
-        class_rows: List[int] = []
-        for pod in pods:
-            sig = pod_class_signature(pod)
-            ci = sig_to_class.get(sig)
-            if ci is None:
-                ci = len(rep_pods)
-                sig_to_class[sig] = ci
-                rep_pods.append(pod)
-            class_rows.append(ci)
-            entry_rows.append(_req_entry(pod)[0])
-        class_of_pod = np.asarray(class_rows, dtype=np.int32)
+        from ..native import hostcommit as _hostcommit
 
-    if entry_rows:
+        if pods and _hostcommit.available():
+            class_of_pod, entry_rows = _hostcommit.batch_rows(
+                pods, sig_to_class, rep_pods, req_cache,
+                pod_class_signature, lambda pod: _req_entry(pod)[0])
+        else:
+            class_rows: List[int] = []
+            for pod in pods:
+                sig = pod_class_signature(pod)
+                ci = sig_to_class.get(sig)
+                if ci is None:
+                    ci = len(rep_pods)
+                    sig_to_class[sig] = ci
+                    rep_pods.append(pod)
+                class_rows.append(ci)
+                entry_rows.append(_req_entry(pod)[0])
+            class_of_pod = np.asarray(class_rows, dtype=np.int32)
+
+    if len(entry_rows):
         eidx = np.asarray(entry_rows)
         ne = len(req_entries)
         req = np.array([e[0] for e in req_entries],

@@ -51,6 +51,26 @@ batch allocates ONE clone per pod instead of two. The external read-only
 event contract is unchanged: per-object watchers only ever receive (and
 replay) materialized private events, and the mutation detector fingerprints
 both forms, so a consumer mutating either is still caught.
+
+Native host commit (ISSUE 11): the per-pod loops of bind_many and
+delete_pods — clone, row swap, RV stamp, event append — run inside the
+in-tree C-API engine (native/hostcommit.cpp, ctypes.PyDLL, compiled on
+first use) when it is available, entered ONCE per chunk. The engine replays
+exactly the Python loops' object operations (the Python code below stays as
+the oracle and the no-g++ fallback; tests/test_native_commit.py pins
+byte-identical rows, RV sequence, and event streams), so the store's
+critical sections shrink ~5x without any semantic change. Selection:
+APIStore(native_commit=) or env STORE_NATIVE_COMMIT / the engine-level
+HOSTSCHED_NATIVE_COMMIT kill switch.
+
+  NATIVE LOCK RULE: the PyDLL commit entries HOLD the GIL and are legal
+  under the store locks (they are plain interpreter work, just cheaper).
+  The GIL-RELEASING kernels (ctypes CDLL in native/hostsched.py —
+  native_greedy_solve, native_commit_deltas) are BLOCKING calls under LK002
+  and must NEVER run inside a store/scheduler lock region: dropping the GIL
+  while holding a store lock invites every classic lock/GIL interleaving
+  (a GIL-waiting thread that needs this lock, a lock-waiting thread that
+  holds the GIL). schedlint flags them like any other blocking call.
 """
 
 from __future__ import annotations
@@ -533,7 +553,8 @@ class APIStore:
                  mutation_detector: Optional[bool] = None,
                  lazy_pod_events: Optional[bool] = None,
                  lock_order_check: Optional[bool] = None,
-                 watch_propagation: bool = True):
+                 watch_propagation: bool = True,
+                 native_commit: Optional[bool] = None):
         import os
 
         if lock_order_check is None:
@@ -563,6 +584,15 @@ class APIStore:
             lazy_pod_events = os.environ.get(
                 "STORE_LAZY_POD_EVENTS", "").lower() not in ("0", "false")
         self._lazy_pod_events = lazy_pod_events
+        # native host commit engine (module docstring): default on whenever
+        # the C-API engine compiles; STORE_NATIVE_COMMIT=0 or the constructor
+        # arg force the Python oracle (the parity tests' knob). Resolution of
+        # engine availability is lazy — first bind decides, so a fresh
+        # checkout's one-time g++ compile never blocks construction.
+        if native_commit is None:
+            native_commit = os.environ.get(
+                "STORE_NATIVE_COMMIT", "").lower() not in ("0", "false")
+        self._native_commit = native_commit
         # kind -> {"namespace/name" or "name": obj}. The pods row dict exists
         # from birth so shard-only paths never mutate the kind map.
         self._objects: Dict[str, Dict[str, Any]] = {"pods": {}}
@@ -597,6 +627,16 @@ class APIStore:
         """Current (highest committed) resourceVersion."""
         with self._lock:
             return self._rv
+
+    def _native_commit_engine(self):
+        """The loaded C-API commit engine, or None (disabled / no g++ /
+        env-killed). The first call on a fresh checkout pays the one-time
+        g++ compile; every later call is an attribute check + env probe."""
+        if not self._native_commit:
+            return None
+        from ..native import hostcommit
+
+        return hostcommit if hostcommit.available() else None
 
     def _kind_lock(self, kind: str):
         """The lock(s) an op touching `kind` rows plus RV/history must hold:
@@ -1193,25 +1233,39 @@ class APIStore:
         errors: List[Tuple[str, str]] = []
         prepared: List = []  # (key, old stored pod, new clone, node_name)
         pods = self._objects["pods"]
+        native = self._native_commit_engine()
         with self._pods_lock:
-            for namespace, name, node_name in bindings:
-                key = f"{namespace}/{name}"
-                pod = pods.get(key)
-                if pod is None:
-                    errors.append((key, f"pods {key} not found"))
-                    continue
-                if pod.spec.node_name:
-                    errors.append(
-                        (key, f"pod {key} is already bound to {pod.spec.node_name}"))
-                    continue
-                new = pod_bind_clone(pod)
-                new.spec.node_name = node_name
-                prepared.append((key, pod, new, node_name))
+            if native is not None:
+                # native validate+clone loop — identical entries/errors,
+                # ~5x fewer interpreter cycles under the shard (PyDLL: GIL
+                # held, non-blocking — legal here per the module docstring)
+                native.bind_prepare(pods, bindings, prepared, errors)
+            else:
+                for namespace, name, node_name in bindings:
+                    key = f"{namespace}/{name}"
+                    pod = pods.get(key)
+                    if pod is None:
+                        errors.append((key, f"pods {key} not found"))
+                        continue
+                    if pod.spec.node_name:
+                        errors.append(
+                            (key, f"pod {key} is already bound to {pod.spec.node_name}"))
+                        continue
+                    new = pod_bind_clone(pod)
+                    new.spec.node_name = node_name
+                    prepared.append((key, pod, new, node_name))
         bound = 0
         if not prepared:
             _metrics().store_bind_many_duration.observe(
                 time.perf_counter() - t0)
             return bound, errors
+        if native is not None and _chaos.ACTIVE is not None:
+            # injected native-commit failure (ISSUE 11 satellite): fires in
+            # the phase gap — clones made, NOTHING committed, no lock held —
+            # so a mid-chunk native fault leaves the store untouched and the
+            # caller's retry/requeue machinery (bind worker supervision)
+            # must conserve every pod (ChaosChurn_20k exercises this)
+            _chaos.ACTIVE.fire("native.commit")
         events: List[Event] = []
         # mode decided once per batch; rv and the event constructor live in
         # locals — the loop below runs 100k times per north-star solve
@@ -1224,41 +1278,120 @@ class APIStore:
                 rv = self._rv
                 # shared propagation stamp for the whole commit (one read)
                 t_commit = self._commit_stamp()
-                for key, old, new, node_name in prepared:
-                    if get(key) is not old:
-                        # raced between the phases: re-validate on the
-                        # current row (also catches duplicate keys within
-                        # one batch — the second commit sees the first)
-                        cur = get(key)
-                        if cur is None:
-                            errors.append((key, f"pods {key} not found"))
-                            continue
-                        if cur.spec.node_name:
-                            errors.append(
-                                (key, f"pod {key} is already bound to "
-                                      f"{cur.spec.node_name}"))
-                            continue
-                        old = cur
-                        new = pod_bind_clone(cur)
-                        new.spec.node_name = node_name
-                    rv += 1
-                    new.metadata.resource_version = rv
-                    pods[key] = new
-                    if lazy_on:
-                        append(_make_event(MODIFIED, "pods", new, rv, old,
-                                           [None, pod_bind_clone], t_commit))
-                    elif eager:
-                        append(_make_event(MODIFIED, "pods",
-                                           pod_bind_clone(new), rv, old,
-                                           commit_ts=t_commit))
-                    else:
-                        append(_make_event(MODIFIED, "pods", new, rv, old,
-                                           commit_ts=t_commit))
-                    bound += 1
+                if native is not None:
+                    mode = 1 if lazy_on else (2 if eager else 0)
+                    rv, bound = native.bind_commit(
+                        pods, prepared, events, errors, rv, mode, t_commit,
+                        pod_bind_clone, MODIFIED)
+                else:
+                    for key, old, new, node_name in prepared:
+                        if get(key) is not old:
+                            # raced between the phases: re-validate on the
+                            # current row (also catches duplicate keys within
+                            # one batch — the second commit sees the first)
+                            cur = get(key)
+                            if cur is None:
+                                errors.append((key, f"pods {key} not found"))
+                                continue
+                            if cur.spec.node_name:
+                                errors.append(
+                                    (key, f"pod {key} is already bound to "
+                                          f"{cur.spec.node_name}"))
+                                continue
+                            old = cur
+                            new = pod_bind_clone(cur)
+                            new.spec.node_name = node_name
+                        rv += 1
+                        new.metadata.resource_version = rv
+                        pods[key] = new
+                        if lazy_on:
+                            append(_make_event(MODIFIED, "pods", new, rv, old,
+                                               [None, pod_bind_clone],
+                                               t_commit))
+                        elif eager:
+                            append(_make_event(MODIFIED, "pods",
+                                               pod_bind_clone(new), rv, old,
+                                               commit_ts=t_commit))
+                        else:
+                            append(_make_event(MODIFIED, "pods", new, rv, old,
+                                               commit_ts=t_commit))
+                        bound += 1
                 self._rv = rv
                 self._emit_batch(MODIFIED, "pods", events, origin)
         _metrics().store_bind_many_duration.observe(time.perf_counter() - t0)
         return bound, errors
+
+    def delete_pods(self, keys: Iterable[str],
+                    origin: Optional[str] = None) -> Tuple[int, List[Tuple[str, str]]]:
+        """Batched pod delete: one lock acquisition + one coalesced DELETED
+        batch for a whole victim set — the bulk companion of delete() on the
+        SAME native commit entry as bind_many (ISSUE 11 satellite: the
+        PreemptionAsync preparation worker's per-victim delete() calls were
+        the residual GIL-bound store path). Per-pod semantics preserved
+        exactly: each deleted pod's event carries ONE structural clone at its
+        post-delete RV with prev=old (lazy, like delete()); per-key misses
+        don't abort the batch. Returns (deleted_count, [(key, error), ...]).
+
+        Victim sets are small (bounded by one preemption batch), so a single
+        critical section is fine — this path never sees 100k-pod chunks."""
+        keys = list(keys)
+        errors: List[Tuple[str, str]] = []
+        events: List[Event] = []
+        deleted = 0
+        native = self._native_commit_engine()
+        if native is not None and _chaos.ACTIVE is not None:
+            # same injected boundary as bind_many's (no lock held yet)
+            _chaos.ACTIVE.fire("native.commit")
+        with self._pods_pair:
+            pods = self._objects["pods"]
+            t_commit = self._commit_stamp()
+            if native is not None:
+                # same three event modes as bind_many (share-mode stores
+                # ride native mode 0 there too — no asymmetry between the
+                # two commit entries)
+                mode = (0 if not self._deep_copy
+                        else 1 if self._lazy_pod_events else 2)
+                self._rv, deleted = native.delete_commit(
+                    pods, keys, events, errors, self._rv, mode, t_commit,
+                    pod_structural_clone, DELETED)
+            else:
+                # build-then-pop, exactly like the native engine: every
+                # clone/event is constructed BEFORE any row is removed, so a
+                # mid-batch failure leaves the store untouched (no
+                # popped-but-never-narrated pods); a duplicate key errors
+                # like the pop it replaces
+                rv = self._rv
+                found: List[str] = []
+                seen = set()
+                for key in keys:
+                    old = None if key in seen else pods.get(key)
+                    if old is None:
+                        errors.append((key, f"pods {key} not found"))
+                        continue
+                    seen.add(key)
+                    found.append(key)
+                    rv += 1
+                    if not self._deep_copy:
+                        old.metadata.resource_version = rv
+                        events.append(_make_event(DELETED, "pods", old, rv,
+                                                  old, commit_ts=t_commit))
+                    else:
+                        obj = pod_structural_clone(old)
+                        obj.metadata.resource_version = rv
+                        if self._lazy_pod_events:
+                            events.append(_make_event(
+                                DELETED, "pods", obj, rv, old,
+                                [None, pod_structural_clone], t_commit))
+                        else:
+                            events.append(_make_event(
+                                DELETED, "pods", pod_structural_clone(obj),
+                                rv, old, commit_ts=t_commit))
+                    deleted += 1
+                for key in found:
+                    del pods[key]
+                self._rv = rv
+            self._emit_batch(DELETED, "pods", events, origin)
+        return deleted, errors
 
     def update_pod_status(self, namespace: str, name: str, mutate_status: Callable[[Any], None]) -> Any:
         """Status-subresource write (hot under failure storms: ONE structural

@@ -47,7 +47,12 @@ def test_bench_quick_runs_and_emits_json():
     wall = ns["wall_s"]
     serial_sum = ns["stages_serial_sum_s"]
     assert 0.3 * wall <= serial_sum <= 1.2 * wall, (serial_sum, wall, stages)
-    assert ns["instrumentation_s"] <= 0.02 * wall, (
+    # 2% of wall with a 2ms ABSOLUTE floor: the quick rung's wall shrank
+    # with the native commit engine (ISSUE 11) to the point where the
+    # recorder's fixed sub-1ms per-run cost was ~1.6% of wall — one
+    # co-scheduling hiccup away from failing on cost that doesn't scale
+    # with the run (production-size walls never hit the floor)
+    assert ns["instrumentation_s"] <= max(0.02 * wall, 0.002), (
         ns["instrumentation_s"], wall)
     # pod-latency observability (ISSUE 7): the rung emits per-stage p50/p99
     # and an all-pods submit->bound distribution, and the declarative SLO
@@ -91,6 +96,16 @@ def test_bench_quick_runs_and_emits_json():
     assert "error" not in bc, bc
     assert bc["placed"] == bc["pods"] > 0
     assert bc["pods_per_sec"] > 0
+    # the native commit engine column (ISSUE 11): python-vs-native us/pod
+    # published side by side. On a rig with g++ the native engine must have
+    # actually loaded and run (us_per_pod_native real); without one the
+    # python column still publishes and `available` says why
+    nat = bc["native"]
+    assert nat["us_per_pod_python"] > 0, bc
+    if nat["available"]:
+        assert nat["us_per_pod_native"] > 0, bc
+    else:
+        assert nat["us_per_pod_native"] is None, bc
     # the gang rung (ISSUE 2): every member of every gang binds, all-or-
     # nothing never fires on the happy path
     gang = workloads["GangScheduling_2k_250"]
@@ -119,6 +134,11 @@ def test_bench_quick_runs_and_emits_json():
     assert cc["breaker_state"] == "closed", cc
     assert cc["bind_worker_restarts"] >= 1, cc
     assert cc["resynced"] is True, cc
+    # ISSUE 11: on a native-capable rig the chaos run must have injected
+    # mid-chunk NATIVE commit faults (the native.commit site) and still
+    # conserved every pod — the assertion above (lost == 0) covers both legs
+    if cc["native_commit"]:
+        assert cc["native_commit_faults"] >= 1, cc
     # ISSUE 7: the breaker trip shows as a BOUNDED p99 excursion in the
     # trace (the faulted/backoff pods are the tail, under the chaos SLO
     # ceiling) while every sampled span still completed — chaos must be

@@ -1,0 +1,560 @@
+"""ISSUE 11: native host commit engine — native-vs-Python parity suite.
+
+The C-API engine (native/hostcommit.cpp) must be BYTE-IDENTICAL to the
+Python oracles it replaces: same store rows, same RV sequence, same event
+stream (lazy slot layout included), same placements — across BOTH
+watch_coalesce modes, with the mutation detector forced (autouse below), on
+the bind, delete, assume, and build_pod_batch paths. Plus: a forced-fallback
+leg proving a rig without g++ (or with the HOSTSCHED_NATIVE_COMMIT kill
+switch thrown) runs the identical workload through the Python paths, and a
+chaos leg proving a mid-chunk native fault leaves the store untouched.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.api.serialize import to_dict
+from kubernetes_tpu.native import hostcommit
+from kubernetes_tpu.store import APIStore, CoalescedEvent
+from kubernetes_tpu.testing import MakeNode, MakePod, mutation_detector_guard
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    yield from mutation_detector_guard(monkeypatch)
+
+
+NATIVE = hostcommit.available()
+needs_native = pytest.mark.skipif(
+    not NATIVE, reason="native commit engine unavailable (no g++?)")
+
+
+def _dump(obj):
+    return json.dumps(to_dict(obj), sort_keys=True, default=repr)
+
+
+def _pods(n, prefix="p"):
+    """Deterministic pod set: fixed uids so two independent builds are
+    byte-identical (MakePod's uid sequence is process-global)."""
+    out = []
+    for i in range(n):
+        p = MakePod(f"{prefix}-{i}").req({"cpu": "100m",
+                                          "memory": "64Mi"}).obj()
+        p.metadata.uid = f"uid-{prefix}-{i}"
+        out.append(p)
+    return out
+
+
+def _store_with_watchers(native, lazy=None, deep_copy=True, detector=None):
+    # detector=False opts a SHARE-MODE store out of the autouse mutation
+    # detector: deep_copy_on_write=False means no isolation contract at all
+    # (delete() legitimately re-stamps the caller-shared object in place),
+    # so the detector's read-only premise doesn't apply there
+    store = APIStore(native_commit=native, lazy_pod_events=lazy,
+                     deep_copy_on_write=deep_copy,
+                     mutation_detector=detector)
+    per_obj = store.watch(kind=("pods",))
+    coal = store.watch(kind=("pods",), coalesce=True)
+    return store, per_obj, coal
+
+
+def _event_sig(ev):
+    return (type(ev).__name__, ev.type, ev.kind, ev.resource_version,
+            _dump(ev.obj), _dump(ev.prev) if ev.prev is not None else None)
+
+
+def _stream_sig(watch):
+    out = []
+    for ev in watch.drain():
+        if isinstance(ev, CoalescedEvent):
+            out.append(("coalesced", ev.type, ev.kind, ev.resource_version,
+                        ev.origin, tuple(_event_sig(e) for e in ev.events)))
+        else:
+            out.append(_event_sig(ev))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# store-level parity: bind_many / delete_pods
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+@pytest.mark.parametrize("mode", ["lazy", "eager", "share"])
+def test_bind_many_parity_rows_rv_events(mode):
+    """Same workload through the native engine and the Python oracle: rows,
+    RV sequence, error list, per-object AND coalesced event streams all
+    byte-identical — including the error paths (missing pod, already bound,
+    duplicate key within one batch, which exercises the phase-2 re-validate
+    branch: the second commit must see the first). All THREE event modes:
+    lazy (default), eager (STORE_LAZY_POD_EVENTS=0 oracle), and share
+    (deep_copy_on_write=False — the perf-harness store; native mode 0)."""
+    results = {}
+    for native in (True, False):
+        store, per_obj, coal = _store_with_watchers(
+            native, lazy=(mode == "lazy") if mode != "share" else None,
+            deep_copy=(mode != "share"))
+        store.create_many("pods", _pods(64), consume=True)
+        per_obj.drain(), coal.drain()
+        rv0 = store.rv
+        triples = [("default", f"p-{i}", f"node-{i % 7}") for i in range(64)]
+        triples.append(("default", "p-3", "node-9"))   # dup: raced re-check
+        triples.append(("default", "ghost", "node-0"))  # missing
+        bound, errors = store.bind_many(triples, origin="t")
+        # a second call re-binding is all-errors (already bound)
+        bound2, errors2 = store.bind_many(triples[:4], origin="t")
+        rows = sorted((k, _dump(p))
+                      for k, p in store._objects["pods"].items())
+        results[native] = (rv0, store.rv, bound, sorted(errors), bound2,
+                           sorted(errors2), rows, _stream_sig(per_obj),
+                           _stream_sig(coal))
+        assert bound == 64 and bound2 == 0
+        assert len(errors) == 2, errors
+        store.check_mutations()
+    assert results[True] == results[False]
+
+
+@needs_native
+@pytest.mark.parametrize("mode", ["lazy", "eager", "share"])
+def test_delete_pods_parity(mode):
+    """Batched pod delete (the PreemptionAsync victim path): same rows gone,
+    same DELETED event stream (one structural clone at the post-delete RV,
+    prev=old; share mode stamps the popped object itself, like delete()),
+    same errors, native vs Python — all three event modes, like bind."""
+    results = {}
+    for native in (True, False):
+        store, per_obj, coal = _store_with_watchers(
+            native, lazy=(mode == "lazy") if mode != "share" else None,
+            deep_copy=(mode != "share"),
+            detector=(False if mode == "share" else None))
+        store.create_many("pods", _pods(20, "v"), consume=True)
+        per_obj.drain(), coal.drain()
+        n, errors = store.delete_pods(
+            [f"default/v-{i}" for i in range(10)] + ["default/missing"],
+            origin="t")
+        assert n == 10 and errors == [
+            ("default/missing", "pods default/missing not found")]
+        rows = sorted(store._objects["pods"])
+        results[native] = (store.rv, rows, _stream_sig(per_obj),
+                           _stream_sig(coal))
+        store.check_mutations()
+    assert results[True] == results[False]
+
+
+@needs_native
+def test_bind_many_accepts_list_entries_like_oracle():
+    """The Python loops unpack ANY sequence (`for ns, name, node in ...`);
+    the native engine must accept list triples/pairs identically instead of
+    requiring exact tuples (same for the assume pairs)."""
+    from kubernetes_tpu.scheduler.cache import Cache
+    from kubernetes_tpu.store import pod_bind_clone
+
+    store, _w, _c = _store_with_watchers(True)
+    store.create_many("pods", _pods(4, "l"), consume=True)
+    bound, errors = store.bind_many(
+        [["default", f"l-{i}", "node-0"] for i in range(4)])
+    assert bound == 4 and not errors
+    cache = Cache()
+    cache.add_node(MakeNode("node-0").capacity(
+        {"cpu": "8", "memory": "8Gi", "pods": "110"}).obj())
+    pairs = [[pod_bind_clone(p), "node-0"] for p in _pods(3, "lc")]
+    assert cache.assume_pods_structural(pairs, check_ports=False) == []
+    assert cache.pod_count() == 3
+
+
+@needs_native
+def test_bind_commit_raced_row_replacement_keeps_prev_alive():
+    """The phase-gap race branch: a row replaced between prepare and commit
+    is re-validated and re-cloned from the CURRENT object, and the event's
+    prev is that replacement — which the commit's row swap just dropped the
+    dict's (sole) reference to. The engine must hold its own strong ref
+    (the UAF a borrowed `old = cur` caused); the event fields prove it."""
+    from kubernetes_tpu.store.store import MODIFIED, pod_bind_clone
+
+    pods = {}
+    first = _pods(1, "r")[0]
+    pods["default/r-0"] = first
+    prepared, errors, events = [], [], []
+    hostcommit.bind_prepare(pods, [("default", "r-0", "node-1")],
+                            prepared, errors)
+    assert len(prepared) == 1 and not errors
+    # a concurrent writer replaces the row in the phase gap; the dict holds
+    # the ONLY reference to the replacement
+    repl = _pods(1, "r")[0]
+    repl.metadata.uid = "uid-replacement"
+    pods["default/r-0"] = repl
+    del repl, first
+    rv, bound = hostcommit.bind_commit(pods, prepared, events, errors,
+                                       10, 1, 0.0, pod_bind_clone, MODIFIED)
+    assert (rv, bound) == (11, 1) and not errors
+    ev = events[0]
+    assert ev.prev.metadata.uid == "uid-replacement"  # alive + correct
+    assert ev.obj is pods["default/r-0"]
+    assert ev.obj.spec.node_name == "node-1"
+    assert ev.obj.metadata.resource_version == 11
+    # a raced row that came back BOUND errors instead
+    prepared2, errors2, events2 = [], [], []
+    hostcommit.bind_prepare(pods, [("default", "r-0", "node-2")],
+                            prepared2, errors2)
+    assert not prepared2 and "already bound to node-1" in errors2[0][1]
+
+
+@needs_native
+def test_delete_pods_duplicate_key_and_midbatch_atomicity():
+    """A duplicate key in one batch errors like the pop it replaces ("not
+    found" on the second occurrence), on both paths — and the build-then-pop
+    structure means an erroring batch never strands popped-but-unnarrated
+    rows (every removed row has its DELETED event in the same batch)."""
+    for native in (True, False):
+        store, per_obj, _ = _store_with_watchers(native)
+        store.create_many("pods", _pods(4, "q"), consume=True)
+        per_obj.drain()
+        n, errors = store.delete_pods(
+            ["default/q-0", "default/q-0", "default/q-1"])
+        assert n == 2, (native, n)
+        assert errors == [("default/q-0", "pods default/q-0 not found")], (
+            native, errors)
+        evs = [e for e in per_obj.drain() if e.type == "DELETED"]
+        assert [e.obj.metadata.name for e in evs] == ["q-0", "q-1"]
+        assert "default/q-0" not in store._objects["pods"]
+
+
+@needs_native
+def test_delete_pods_matches_per_pod_delete_semantics():
+    """delete_pods' per-pod event must match what N delete() calls emit
+    (modulo the coalesced channel): same object content at the same RVs."""
+    s_bulk, w_bulk, _ = _store_with_watchers(True)
+    s_one, w_one, _ = _store_with_watchers(True)
+    for s in (s_bulk, s_one):
+        s.create_many("pods", _pods(6, "d"), consume=True)
+    w_bulk.drain(), w_one.drain()
+    keys = [f"default/d-{i}" for i in range(6)]
+    s_bulk.delete_pods(keys)
+    for k in keys:
+        s_one.delete("pods", k)
+    assert [_event_sig(e) for e in w_bulk.drain()] == \
+        [_event_sig(e) for e in w_one.drain()]
+
+
+# ---------------------------------------------------------------------------
+# cache + tensorizer parity
+# ---------------------------------------------------------------------------
+
+
+def _cache_fingerprint(cache):
+    out = {}
+    for name, ni in cache._nodes.items():
+        out[name] = (
+            sorted(pi.pod.key for pi in ni.pods),
+            sorted(pi.pod.key for pi in ni.pods_with_affinity),
+            sorted(pi.pod.key for pi in ni.pods_with_required_anti_affinity),
+            sorted(ni.used_ports),
+        )
+    return (out, dict(cache._pod_nodes), dict(cache._assumed))
+
+
+@needs_native
+def test_assume_structural_parity(monkeypatch):
+    """Native vs Python assume loop: identical failure list and identical
+    NodeInfo membership (pods, affinity sublists) — including a duplicate
+    pod, an affinity pod, and a pod with no memoized request pair (the cold
+    PodInfo constructor fallback)."""
+    from kubernetes_tpu.scheduler.cache import Cache
+    from kubernetes_tpu.store import pod_bind_clone
+
+    def build(native_env):
+        monkeypatch.setenv("HOSTSCHED_NATIVE_COMMIT",
+                           "1" if native_env else "0")
+        cache = Cache()
+        for i in range(4):
+            cache.add_node(MakeNode(f"node-{i}").capacity(
+                {"cpu": "8", "memory": "8Gi", "pods": "110"}).obj())
+        pods = _pods(12, "a")
+        pairs = [(pod_bind_clone(p), f"node-{i % 4}")
+                 for i, p in enumerate(pods)]
+        # seed the request memo on SOME pods only (both code paths in play)
+        from kubernetes_tpu.api import compute_pod_resource_request
+
+        for qp, _node in pairs[:6]:
+            qp.__dict__["_req_cache"] = (
+                compute_pod_resource_request(qp),
+                compute_pod_resource_request(qp, non_zero=True))
+        failed = cache.assume_pods_structural(list(pairs),
+                                              check_ports=False)
+        # duplicate assume must fail identically
+        failed2 = cache.assume_pods_structural([pairs[0]],
+                                               check_ports=False)
+        return failed, failed2, _cache_fingerprint(cache)
+
+    f_nat, f2_nat, fp_nat = build(True)
+    f_py, f2_py, fp_py = build(False)
+    assert f_nat == f_py == []
+    assert f2_nat == f2_py
+    assert "already in the cache" in f2_nat[0][1]
+    assert fp_nat == fp_py
+
+
+@needs_native
+def test_assume_structural_affinity_sublists(monkeypatch):
+    """Pods with inter-pod (anti-)affinity land in the affinity sublists on
+    both paths."""
+    from kubernetes_tpu.api.labels import Selector
+    from kubernetes_tpu.api.types import Affinity, PodAffinityTerm
+    from kubernetes_tpu.scheduler.cache import Cache
+    from kubernetes_tpu.store import pod_bind_clone
+
+    def mk_aff(name):
+        p = MakePod(name).req({"cpu": "100m"}).obj()
+        p.metadata.uid = f"uid-{name}"
+        term = PodAffinityTerm(
+            topology_key="kubernetes.io/hostname",
+            selector=Selector.from_match_labels({"k": "v"}))
+        p.spec.affinity = Affinity(pod_anti_affinity_required=[term])
+        return p
+
+    def build(native_env):
+        monkeypatch.setenv("HOSTSCHED_NATIVE_COMMIT",
+                           "1" if native_env else "0")
+        cache = Cache()
+        cache.add_node(MakeNode("node-0").capacity(
+            {"cpu": "8", "memory": "8Gi", "pods": "110"}).obj())
+        pairs = [(pod_bind_clone(mk_aff(f"aff-{i}")), "node-0")
+                 for i in range(3)]
+        failed = cache.assume_pods_structural(pairs, check_ports=False)
+        return failed, _cache_fingerprint(cache)
+
+    got_nat = build(True)
+    got_py = build(False)
+    assert got_nat == got_py
+    ni = got_nat[1][0]["node-0"]
+    assert len(ni[1]) == 3 and len(ni[2]) == 3  # both affinity sublists
+
+
+@needs_native
+def test_build_pod_batch_rows_parity(monkeypatch):
+    """The fused per-pod loop: identical class_of_pod / request rows /
+    balanced flags / rep_pods native vs Python, over a batch mixing
+    template-stamped classes, distinct labels, and distinct requests."""
+    from kubernetes_tpu.scheduler.cache import Cache
+    from kubernetes_tpu.snapshot.tensorizer import (build_cluster_tensors,
+                                                    build_pod_batch)
+
+    def mk_batch():
+        pods = []
+        for i in range(40):
+            p = MakePod(f"b-{i}").req(
+                {"cpu": "100m"} if i % 3 else {"cpu": "250m"}).obj()
+            p.metadata.uid = f"uid-b-{i}"
+            if i % 5 == 0:
+                p.metadata.labels = {"grp": f"g{i % 2}"}
+            pods.append(p)
+        return pods
+
+    def build(native_env):
+        monkeypatch.setenv("HOSTSCHED_NATIVE_COMMIT",
+                           "1" if native_env else "0")
+        cache = Cache()
+        for i in range(8):
+            cache.add_node(MakeNode(f"node-{i}").capacity(
+                {"cpu": "8", "memory": "8Gi", "pods": "110"}).obj())
+        snap = cache.update_snapshot()
+        cluster = build_cluster_tensors(snap)
+        batch = build_pod_batch(mk_batch(), snap, cluster)
+        return (batch.class_of_pod.tolist(), batch.req.tolist(),
+                batch.req_nz.tolist(), batch.raw_req.tolist(),
+                batch.balanced_active.tolist(),
+                [p.metadata.name for p in batch.tables.rep_pods])
+
+    assert build(True) == build(False)
+
+
+@needs_native
+def test_scatter_deltas_parity():
+    from kubernetes_tpu.native import native_available, native_commit_deltas
+
+    assert native_available()
+    rng = np.random.default_rng(7)
+    p_all, p, n, r = 500, 300, 40, 4
+    rows = rng.integers(0, p_all, p)
+    nodes = rng.integers(0, n, p)
+    raw = rng.integers(0, 1000, (p_all, r)).astype(np.int64)
+    raw_nz = rng.integers(0, 1000, (p_all, r)).astype(np.int64)
+    du, dz, dc, touched = native_commit_deltas(rows, nodes, raw, raw_nz, n)
+    du2 = np.zeros((n, r), np.int64)
+    dz2 = np.zeros((n, r), np.int64)
+    np.add.at(du2, nodes, raw[rows])
+    np.add.at(dz2, nodes, raw_nz[rows])
+    assert (du == du2).all() and (dz == dz2).all()
+    assert (dc == np.bincount(nodes, minlength=n)).all()
+    assert (touched == np.unique(nodes)).all()
+
+
+@needs_native
+def test_scatter_deltas_bad_index_raises_like_oracle():
+    """An out-of-range node/row must surface as a catchable IndexError
+    (what np.add.at raises — the assume/dispatch failure-domain guard's
+    contract), never a silent out-of-bounds write; the kernel validates
+    before writing, so the deltas stay zero."""
+    from kubernetes_tpu.native import native_commit_deltas
+
+    raw = np.ones((4, 2), dtype=np.int64)
+    with pytest.raises(IndexError):
+        native_commit_deltas(np.array([0, 1]), np.array([0, 9]), raw, raw, 3)
+    with pytest.raises(IndexError):
+        native_commit_deltas(np.array([0, 7]), np.array([0, 1]), raw, raw, 3)
+    with pytest.raises(IndexError):
+        native_commit_deltas(np.array([-1]), np.array([0]), raw, raw, 3)
+
+
+# ---------------------------------------------------------------------------
+# end-to-end placement parity, both watch_coalesce modes
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+@pytest.mark.parametrize("coalesce", [True, False])
+def test_e2e_placement_parity_native_vs_python(coalesce, monkeypatch):
+    """The whole pipeline — ingest, build_pod_batch, solve, assume, bind —
+    with the native engine on vs off must produce byte-identical placements
+    and store dumps, in BOTH watch_coalesce modes, with the mutation
+    detector forced (autouse)."""
+    from kubernetes_tpu.scheduler import Framework
+    from kubernetes_tpu.scheduler.batch import BatchScheduler
+    from kubernetes_tpu.scheduler.plugins import default_plugins
+
+    def run(native):
+        monkeypatch.setenv("HOSTSCHED_NATIVE_COMMIT",
+                           "1" if native else "0")
+        store = APIStore(native_commit=native)
+        for i in range(16):
+            store.create("nodes", MakeNode(f"node-{i}").capacity(
+                {"cpu": "16", "memory": "64Gi", "pods": "110"}).obj())
+        sched = BatchScheduler(store, Framework(default_plugins()),
+                               batch_size=1024, solver="fast",
+                               columnar=coalesce)
+        sched.watch_coalesce = coalesce
+        sched.sync()
+        store.create_many("pods", _pods(512, "e"), consume=True)
+        sched.run_until_idle()
+        pods, rv = store.list("pods")
+        placements = sorted((p.key, p.spec.node_name,
+                             p.metadata.resource_version) for p in pods)
+        dump = sorted(_dump(p) for p in pods)
+        store.check_mutations()
+        return placements, rv, dump, sched.scheduled_count
+
+    got_native = run(True)
+    got_python = run(False)
+    assert got_native == got_python
+    assert got_native[3] == 512
+
+
+# ---------------------------------------------------------------------------
+# forced fallback (a rig without g++) + kill switch + chaos
+# ---------------------------------------------------------------------------
+
+
+def test_forced_fallback_without_gxx(monkeypatch):
+    """A rig whose compile fails (no g++ / no Python headers) must keep the
+    identical store surface on the Python paths: available() False, binds
+    and deletes work, and the scheduler pipeline completes — the in-tier
+    descendant of the bench_fallback ladder run."""
+    monkeypatch.setattr(hostcommit, "_lib", None)
+    monkeypatch.setattr(hostcommit, "_build_error",
+                        "g++ failed: command not found")
+    assert hostcommit.available() is False
+    assert "g++" in hostcommit.build_error()
+    store = APIStore(native_commit=True)  # wants native, engine dead
+    assert store._native_commit_engine() is None
+    store.create_many("pods", _pods(8, "f"), consume=True)
+    bound, errors = store.bind_many(
+        [("default", f"f-{i}", "node-0") for i in range(8)])
+    assert bound == 8 and not errors
+    n, errs = store.delete_pods(["default/f-0", "default/f-1"])
+    assert n == 2 and not errs
+
+
+def test_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("HOSTSCHED_NATIVE_COMMIT", "0")
+    assert hostcommit.available() is False
+    monkeypatch.delenv("HOSTSCHED_NATIVE_COMMIT")
+
+
+@needs_native
+def test_chaos_native_commit_fault_leaves_store_untouched():
+    """The native.commit injection site fires in bind_many's phase gap —
+    clones made, NOTHING committed — so an injected mid-chunk fault must
+    leave rows, RV, and events exactly as before, and a plain retry
+    succeeds (what the supervised bind worker does)."""
+    from kubernetes_tpu.chaos import faultinject as fi
+
+    store, per_obj, coal = _store_with_watchers(True)
+    store.create_many("pods", _pods(16, "c"), consume=True)
+    per_obj.drain(), coal.drain()
+    rv0 = store.rv
+    fi.arm([fi.FaultPlan("native.commit", "fail", count=1)])
+    try:
+        with pytest.raises(fi.FaultInjected):
+            store.bind_many([("default", f"c-{i}", "node-0")
+                             for i in range(16)])
+        assert store.rv == rv0  # nothing committed
+        assert not per_obj.drain() and not coal.drain()
+        assert all(not p.spec.node_name
+                   for p in store._objects["pods"].values())
+        bound, errors = store.bind_many(
+            [("default", f"c-{i}", "node-0") for i in range(16)])
+        assert bound == 16 and not errors
+    finally:
+        fi.disarm()
+
+
+@needs_native
+def test_chaos_native_fault_e2e_conservation():
+    """Mid-chunk native faults under the real bind worker: the supervised
+    retry absorbs them and every pod still binds (pod conservation)."""
+    from kubernetes_tpu.chaos import faultinject as fi
+    from kubernetes_tpu.scheduler import Framework
+    from kubernetes_tpu.scheduler.batch import BatchScheduler
+    from kubernetes_tpu.scheduler.plugins import default_plugins
+    from kubernetes_tpu.testing import assert_pod_conservation
+
+    store = APIStore(native_commit=True)
+    for i in range(8):
+        store.create("nodes", MakeNode(f"node-{i}").capacity(
+            {"cpu": "16", "memory": "64Gi", "pods": "110"}).obj())
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=256, solver="fast",
+                           bind_retry_base_s=0.01)
+    sched.bind_chunk = 64
+    sched.sync()
+    pods = _pods(256, "cc")
+    keys = [p.key for p in pods]
+    store.create_many("pods", pods, consume=True)
+    fi.arm([fi.FaultPlan("native.commit", "fail", count=2)])
+    try:
+        sched.run_until_idle()
+    finally:
+        fi.disarm()
+    sched.run_until_idle()
+    sched.flush_binds()
+    assert_pod_conservation(store, sched, keys)
+    assert sched.scheduled_count == 256
+
+
+def test_bench_bind_commit_publishes_native_column(monkeypatch):
+    """The BindCommit_20k rung publishes the python-vs-native columns even
+    on a forced-fallback rig (native: available False, python number still
+    real) — the tier-1 descendant of the bench fallback run."""
+    import bench
+
+    monkeypatch.setenv("HOSTSCHED_NATIVE_COMMIT", "0")
+    results = {}
+    bench.rung_bind_commit(results)
+    bc = results["BindCommit_20k"]
+    assert "error" not in bc, bc
+    assert bc["native"]["available"] is False
+    assert bc["native"]["us_per_pod_native"] is None
+    assert bc["native"]["us_per_pod_python"] > 0
+    assert bc["placed"] == bc["pods"]

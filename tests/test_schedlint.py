@@ -183,6 +183,64 @@ def test_lk002_quiet_on_nowait_and_outside_lock():
     assert "LK002" not in rules_of(analyze_source(LK002_GOOD))
 
 
+# ISSUE 11: the GIL-releasing native kernels (ctypes CDLL wrappers in
+# native/hostsched.py) are blocking calls under LK002 — dropping the GIL
+# inside a store lock region invites GIL/lock interleavings (the NATIVE LOCK
+# RULE in store/store.py). The PyDLL commit-engine entries hold the GIL and
+# stay legal under the locks.
+
+LK002_NATIVE_BAD = '''
+import threading
+
+from kubernetes_tpu.native import native_commit_deltas, native_greedy_solve
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def scatter_under_lock(self, rows, nodes, raw, raw_nz, n):
+        with self._lock:
+            return native_commit_deltas(rows, nodes, raw, raw_nz, n)
+
+    def solve_under_lock(self, cluster, batch):
+        with self._lock:
+            return native_greedy_solve(cluster, batch)
+'''
+
+LK002_NATIVE_GOOD = '''
+import threading
+
+from kubernetes_tpu.native import hostcommit, native_commit_deltas
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def scatter_outside(self, rows, nodes, raw, raw_nz, n):
+        with self._lock:
+            payload = (rows, nodes)
+        return native_commit_deltas(rows, nodes, raw, raw_nz, n)
+
+    def commit_under_lock(self, pods, bindings, prepared, errors):
+        # the PyDLL commit engine HOLDS the GIL: legal under the store lock
+        with self._lock:
+            hostcommit.bind_prepare(pods, bindings, prepared, errors)
+'''
+
+
+def test_lk002_fires_on_native_kernel_under_lock():
+    findings = [f for f in analyze_source(LK002_NATIVE_BAD)
+                if f.rule == "LK002"]
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 2, msgs
+    assert "GIL-releasing native kernel" in msgs
+    assert "native_commit_deltas" in msgs and "native_greedy_solve" in msgs
+
+
+def test_lk002_quiet_on_pydll_commit_and_outside_lock():
+    assert "LK002" not in rules_of(analyze_source(LK002_NATIVE_GOOD))
+
+
 MU001_BAD = '''
 def mutate_get(self):
     pod = self.store.get("pods", "default/a")
