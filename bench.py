@@ -493,6 +493,9 @@ def rung_north_star_endtoend(results):
                 for i in range(n_pods)), consume=True)
         warm.run_until_idle()
         # the warm cluster must not sit in memory during the timed run
+        # (stop() releases the bind worker, which would otherwise pin the
+        # whole warm object graph from its parked q.get())
+        warm.stop()
         del warm, warm_store
 
         store = APIStore()
@@ -613,9 +616,238 @@ def rung_north_star_endtoend(results):
               f"p99={latency['p99_s']}s over {latency['count']} pods; "
               f"SLO {'PASS' if slo['pass'] else 'FAIL ' + str(slo['failed'])}",
               file=sys.stderr)
+        # --- partitioned A/B (ISSUE 12): the SAME workload, same box,
+        # through 2 partitioned pipelines — disjoint node shards,
+        # hash-routed pods, each partition's GIL-held host stages
+        # overlapping the other's GIL-free XLA solve. The 1p run above is
+        # the A; this is the B. The 1p heap is released first (the A/B must
+        # not measure the winner under the loser's memory pressure), and a
+        # warm run compiles the partition-shaped kernels (half-size pod
+        # bucket, shard-size node axis — fresh jit shapes).
+        share_1p = round(stages.get("bind_wait", 0.0) / max(dt, 1e-9), 4)
+        sched.stop()  # release the bind worker so the del really frees
+        del sched, store, pending
+        try:
+            _w = _partitioned_e2e(n_pods, n_nodes, 2, "e2ew")[0]
+            _w.stop()
+            del _w
+            compiles2_0 = _solver_jit_cache()
+            # interleaved best-of-2 per mode (the BindCommit discipline):
+            # harness co-scheduling drifts minute-to-minute on this rig
+            # (same-code 1p walls vary +-30%), and alternating the modes
+            # keeps the drift from landing entirely on one column. The main
+            # 1p run above stays the official 1p number; its wall joins the
+            # 1p sample set here.
+            best = None
+            walls_1p, walls_2p = [dt], []
+            for i in range(2):
+                c, st2c, d2, b2 = _partitioned_e2e(
+                    n_pods, n_nodes, 2, f"e2eb{i}")
+                walls_2p.append(d2)
+                if best is None or d2 < best[2]:
+                    if best is not None:
+                        best[0].stop()
+                    best = (c, st2c, d2, b2)  # rebind drops the old best
+                else:
+                    c.stop()
+                    del c, st2c
+                _s1, _st1, d1, _b1 = _partitioned_e2e(
+                    n_pods, n_nodes, 1, f"e2ea{i}")
+                _s1.stop()
+                del _s1, _st1
+                walls_1p.append(d1)
+            coord, store2, dt2, bound2 = best
+            compiles_2p = sum(
+                v - compiles2_0.get(k, 0)
+                for k, v in _solver_jit_cache().items() if v >= 0)
+            dt1_best = min(walls_1p)
+            pps1b = n_pods / dt1_best  # best-of 1p for the A/B columns
+            dt2 = min(walls_2p)
+            pps2 = bound2 / dt2
+            # bind_wait share of wall: mean over pipelines of that
+            # pipeline's scheduling-thread stall — the acceptance lever
+            # (partitioning exists to give the stall something to overlap
+            # with)
+            waits = [(p.flightrec.stage_table().get("bind_wait", {})
+                      .get("total_ms", 0.0) or 0.0) / 1000.0
+                     for p in coord.pipelines]
+            share_2p = round((sum(waits) / max(len(waits), 1))
+                             / max(dt2, 1e-9), 4)
+            cores = len(os.sched_getaffinity(0))
+            results["NorthStar_100k_10k_endtoend"]["partitioned"] = {
+                "partitions": 2,
+                "pods_per_sec_2p": round(pps2, 1),
+                "wall_s_2p": round(dt2, 3),
+                "placed_2p": bound2,
+                "pods_per_sec_1p_best": round(pps1b, 1),
+                "speedup_vs_1p": round(pps2 / max(pps1b, 1e-9), 3),
+                "walls_1p": [round(w, 3) for w in walls_1p],
+                "walls_2p": [round(w, 3) for w in walls_2p],
+                "cores": cores,
+                "ab_comparable": cores >= 2,
+                "concurrent_drive": coord.concurrent_drive,
+                "bind_wait_share_1p": share_1p,
+                "bind_wait_share_2p": share_2p,
+                "conflicts": coord.conflicts_total,
+                "reroutes": coord.reroutes_total,
+                "solver_compiles_during_run": compiles_2p,
+                "per_partition": [
+                    {"index": r["index"], "nodes": r["nodes"],
+                     "scheduled": r["scheduled"]}
+                    for r in coord.sched_stats()["rows"]],
+            }
+            print(f"    partitioned A/B (best-of-interleaved): "
+                  f"1p {pps1b:.0f} vs 2p {pps2:.0f} pods/s "
+                  f"(speedup {pps2 / max(pps1b, 1e-9):.2f}x; bind_wait "
+                  f"share {share_1p:.3f} -> {share_2p:.3f}; "
+                  f"compiles_2p={compiles_2p})", file=sys.stderr)
+            coord.stop()  # release bind workers before later rungs
+        except Exception as e:  # the A/B must not void the 1p result
+            results["NorthStar_100k_10k_endtoend"]["partitioned"] = {
+                "error": str(e)[:200]}
+            print(f"    partitioned A/B: ERROR {e}", file=sys.stderr)
     except Exception as e:
         results["NorthStar_100k_10k_endtoend"] = {"error": str(e)[:200]}
         print(f"NorthStar_100k_10k_endtoend: ERROR {e}", file=sys.stderr)
+
+
+def _partitioned_e2e(n_pods, n_nodes, partitions, prefix, batch_size=None):
+    """One end-to-end bind run (fresh store, GC-frozen timed window) through
+    a 1-partition BatchScheduler or an N-partition PartitionedScheduler —
+    the shared body of the Partitioned_2x rung and the NorthStar A/B column
+    (ISSUE 12). Returns (sched, store, dt, bound)."""
+    import gc
+
+    from kubernetes_tpu.scheduler import Framework
+    from kubernetes_tpu.scheduler.batch import BatchScheduler
+    from kubernetes_tpu.scheduler.partition import PartitionedScheduler
+    from kubernetes_tpu.scheduler.plugins import default_plugins
+    from kubernetes_tpu.store import APIStore
+    from kubernetes_tpu.testing import MakePod
+
+    bs = batch_size or n_pods
+    store = APIStore()
+    for n in _nodes(n_nodes, cpu="16", mem="64Gi"):
+        store.create("nodes", n)
+    if partitions == 1:
+        sched = BatchScheduler(store, Framework(default_plugins()),
+                               batch_size=bs, solver="fast")
+    else:
+        sched = PartitionedScheduler(
+            store, lambda: Framework(default_plugins()),
+            partitions=partitions, batch_size=bs, solver="fast")
+    sched.sync()
+    CH = 10_000
+    pending = [MakePod(f"{prefix}-{i}").req(
+        {"cpu": "500m", "memory": "1Gi"}).obj() for i in range(n_pods)]
+    for lo in range(0, n_pods, CH):
+        store.create_many("pods", pending[lo:lo + CH], consume=True)
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    try:
+        t0 = time.perf_counter()
+        sched.run_until_idle()
+        dt = time.perf_counter() - t0
+    finally:
+        gc.enable()
+        gc.unfreeze()
+    sched.flush_binds()
+    return sched, store, dt, sched.scheduled_count
+
+
+def rung_partitioned(results):
+    """Partitioned_2x (ISSUE 12): the SAME constraint-free bind workload
+    through ONE pipeline and through TWO partitioned pipelines on the same
+    box — disjoint node shards, hash-routed pods, each partition's
+    tensorize/assume/bind overlapping the other's GIL-free XLA solve. The
+    quick-tier sibling of the NorthStar A/B column; publishes speedup,
+    absorbed conflicts/reroutes, per-partition rows, and the conservation
+    verdict (tests/test_bench_quick.py asserts correctness columns; the
+    speedup itself is recorded, not tier-1-gated — a co-scheduled 2-core CI
+    box is not the bench rig)."""
+    from kubernetes_tpu.testing import pod_conservation_report
+
+    try:
+        n_pods = sz(20_000, floor=2000)
+        n_nodes = sz(1000, floor=64)
+        # warm-up BOTH configurations on throwaway clusters: the partitioned
+        # run solves shard-sized batches on shard-sized node sets — fresh
+        # jit shapes that must compile before the timed windows
+        for parts in (1, 2):
+            _w = _partitioned_e2e(n_pods, n_nodes, parts, f"pw{parts}")[0]
+            _w.stop()
+            del _w
+        compiles0 = _solver_jit_cache()
+        # interleaved best-of-2 per mode (the BindCommit discipline): the
+        # co-scheduled rig drifts, alternating keeps the drift off one column
+        runs_1p = []  # (wall, bound) pairs — picked together, never mixed
+        walls_2p = []
+        best2 = None
+        for i in range(2):
+            _s1, _st1, d1, b1i = _partitioned_e2e(
+                n_pods, n_nodes, 1, f"pa{i}")
+            _s1.stop()
+            del _s1, _st1
+            runs_1p.append((d1, b1i))
+            c2, stc2, d2, b2 = _partitioned_e2e(
+                n_pods, n_nodes, 2, f"pb{i}")
+            walls_2p.append(d2)
+            if best2 is None or d2 < best2[2]:
+                if best2 is not None:
+                    best2[0].stop()
+                best2 = (c2, stc2, d2, b2, f"pb{i}")
+            else:
+                c2.stop()
+                del c2, stc2
+        s2, st2, _d2, b2, pfx2 = best2
+        dt1, b1 = min(runs_1p)
+        walls_1p = [w for w, _b in runs_1p]
+        dt2 = min(walls_2p)
+        compiles = sum(v - compiles0.get(k, 0)
+                       for k, v in _solver_jit_cache().items() if v >= 0)
+        pps1, pps2 = b1 / dt1, b2 / dt2
+        rep = pod_conservation_report(
+            st2, s2, [f"default/{pfx2}-{i}" for i in range(n_pods)])
+        rows = s2.sched_stats()["rows"]
+        cores = len(os.sched_getaffinity(0))
+        results["Partitioned_2x"] = {
+            "pods_per_sec": round(pps2, 1), "wall_s": round(dt2, 3),
+            "pods": n_pods, "nodes": n_nodes, "placed": b2,
+            "pods_per_sec_1p": round(pps1, 1), "wall_s_1p": round(dt1, 3),
+            "speedup_vs_1p": round(pps2 / pps1, 3),
+            "walls_1p": [round(w, 3) for w in walls_1p],
+            "walls_2p": [round(w, 3) for w in walls_2p],
+            # the A/B is a CONCURRENCY claim: on a 1-core box the pipelines
+            # time-slice and the speedup column measures overhead+noise,
+            # not overlap — publish the cores so the number is interpretable
+            # (ROADMAP direction 3 judges scaling on a >=2-core rig)
+            "cores": cores,
+            "ab_comparable": cores >= 2,
+            "concurrent_drive": s2.concurrent_drive,
+            "conflicts": s2.conflicts_total,
+            "reroutes": s2.reroutes_total,
+            "residual_passes": s2.residual_passes,
+            "conservation": rep["counts"],
+            "conservation_ok": (rep["counts"]["lost"] == 0
+                                and rep["counts"]["double_bound"] == 0
+                                and rep["counts"]["bound"] == n_pods),
+            "solver_compiles_during_run": compiles,
+            "per_partition": [{"index": r["index"], "nodes": r["nodes"],
+                               "scheduled": r["scheduled"],
+                               "conflicts": r["conflicts"],
+                               "reroutes": r["reroutes"],
+                               "breaker": r["breaker"]} for r in rows],
+            "solver": "fast+partitioned"}
+        s2.stop()  # release bind workers before later rungs
+        print(f"{'Partitioned_2x':>28}: {pps2:>9.0f} pods/s  "
+              f"({b2}/{n_pods} bound; 1p {pps1:.0f} pods/s, "
+              f"speedup {pps2 / pps1:.2f}x, "
+              f"conflicts={s2.conflicts_total} "
+              f"reroutes={s2.reroutes_total})", file=sys.stderr)
+    except Exception as e:
+        results["Partitioned_2x"] = {"error": str(e)[:200]}
+        print(f"Partitioned_2x: ERROR {e}", file=sys.stderr)
 
 
 def _solver_jit_cache():
@@ -864,6 +1096,7 @@ def rung_chaos_churn(results):
         wstore.create_many("pods", mk("wx", batch), consume=True)
         wsched.run_until_idle()
         wsched.flush_binds()
+        wsched.stop()
         del wstore, wsched
 
         store, sched = build()
@@ -976,6 +1209,65 @@ def rung_chaos_churn(results):
                     and latency["count"] > 0
                     and latency["p99_s"] >= latency["p50_s"]
                     and slo["pass"])
+        # --- partition hard-kill leg (ISSUE 12 satellite): the same churn
+        # through a 2-partition scheduler, with partition 1 HARD-KILLED
+        # mid-run by the partition.dispatch chaos site. The survivor must
+        # absorb the dead shard — router remap + resync_from_store — and
+        # every pod must still be conserved (the dead pipeline's in-flight
+        # binds reconcile through the conflict machinery).
+        from kubernetes_tpu.scheduler.partition import PartitionedScheduler
+        pk = {}
+        try:
+            pstore = APIStore()
+            for n in _nodes(n_nodes, cpu="16", mem="64Gi"):
+                pstore.create("nodes", n)
+            coord = PartitionedScheduler(
+                pstore, lambda: Framework(default_plugins()), partitions=2,
+                batch_size=batch, solver="fast",
+                pod_initial_backoff=0.05, pod_max_backoff=0.2)
+            coord.sync()
+            pkeys = [f"default/pk-{i}" for i in range(n_pods)]
+            ppods = mk("pk", n_pods)
+            fi.arm([fi.FaultPlan("partition.dispatch", "kill",
+                                 match="partition-1", after=1)])
+            t0p = time.perf_counter()
+            deadline_p = t0p + (30.0 if SMOKE else 120.0)
+            try:
+                sent = 0
+                pbound = 0
+                while time.perf_counter() < deadline_p:
+                    if sent < n_pods:
+                        pstore.create_many(
+                            "pods", ppods[sent:sent + per_wave],
+                            consume=True)
+                        sent += per_wave
+                    coord.run_until_idle()
+                    coord.flush_queues()
+                    pbound = sum(1 for p in pstore.list("pods")[0]
+                                 if p.metadata.name.startswith("pk-")
+                                 and p.spec.node_name)
+                    if pbound >= n_pods and sent >= n_pods:
+                        break
+                    time.sleep(0.02)
+            finally:
+                fi.disarm()
+            coord.run_until_idle()
+            coord.flush_binds()
+            prep = pod_conservation_report(pstore, coord, pkeys)
+            pc = prep["counts"]
+            pk = {"pods": n_pods, "bound": pc["bound"], "lost": pc["lost"],
+                  "double_bound": pc["double_bound"],
+                  "partitions_absorbed": coord.partitions_absorbed,
+                  "conflicts": coord.conflicts_total,
+                  "reroutes": coord.reroutes_total,
+                  "wall_s": round(time.perf_counter() - t0p, 3),
+                  "ok": (pc["bound"] == len(pkeys) and pc["lost"] == 0
+                         and pc["double_bound"] == 0
+                         and coord.partitions_absorbed == 1)}
+            coord.stop()
+        except Exception as e:  # the leg must not void the main chaos run
+            fi.disarm()
+            pk = {"error": str(e)[:200]}
         results["ChaosChurn_20k"] = {
             "pods_per_sec": round(n_pods / dt, 1), "wall_s": round(dt, 3),
             "placed": c["bound"], "pods": len(keys),
@@ -993,6 +1285,7 @@ def rung_chaos_churn(results):
             "native_commit_faults": injected.get("native.commit",
                                                  {}).get("injected", 0),
             "native_commit": native_leg,
+            "partition_kill": pk,
             "solver": "fast+breaker+chaos"}
         print(f"{'ChaosChurn_20k':>28}: {n_pods / dt:>9.0f} pods/s  "
               f"({c['bound']}/{n_pods} bound under chaos, "
@@ -1002,6 +1295,16 @@ def rung_chaos_churn(results):
               f"p50={latency['p50_s']}s p99={latency['p99_s']}s, "
               f"{n_complete}/{n_spans} spans complete)",
               file=sys.stderr)
+        if "error" in pk:
+            print(f"    partition-kill leg: ERROR {pk['error']}",
+                  file=sys.stderr)
+        else:
+            print(f"    partition-kill leg: {pk['bound']}/{pk['pods']} "
+                  f"conserved after absorbing partition 1 "
+                  f"(absorbed={pk['partitions_absorbed']}, "
+                  f"conflicts={pk['conflicts']}, "
+                  f"reroutes={pk['reroutes']}, {pk['wall_s']}s)",
+                  file=sys.stderr)
     except Exception as e:
         from kubernetes_tpu.chaos import faultinject as fi
 
@@ -1430,6 +1733,7 @@ RUNGS = [
     ("NorthStarEndToEnd", rung_north_star_endtoend),
     ("BindCommit", rung_bind_commit),
     ("GangScheduling", rung_gang),
+    ("Partitioned", rung_partitioned),
     ("ChaosChurn", rung_chaos_churn),
     ("ControlPlane", rung_control_plane),
     ("SchedLint", rung_schedlint),
@@ -1442,9 +1746,9 @@ RUNGS = [
 # stdout. Catches perf-path regressions (a broken coalesced ingest or bind
 # path fails loudly here) without the full ladder's budget.
 QUICK_RUNGS = ("SchedulingBasic", "MixedChurn", "NorthStarEndToEnd",
-               "BindCommit", "GangScheduling", "ChaosChurn", "ControlPlane",
-               "SchedLint")
-QUICK_BUDGET_S = 95.0
+               "BindCommit", "GangScheduling", "Partitioned", "ChaosChurn",
+               "ControlPlane", "SchedLint")
+QUICK_BUDGET_S = 110.0
 
 
 def cpu_fallback(reason: str) -> int:

@@ -10,6 +10,13 @@ call graph, and flag any point where the shard is definitely held, the
 global lock is not, and a global acquisition (direct or via a call path)
 follows.
 
+LK001 partition extension (ISSUE 12): the partitioned dispatch layer's
+locks — `PartitionRouter._route_lock` and
+`PartitionedScheduler._dispatch_lock` (scheduler/partition.py) — are LEAF
+locks ordered strictly after the whole store chain. While one is
+definitely held, ANY store-lock acquisition (global, shard, or the
+composite pair — direct or via a resolved call path) is an inversion.
+
 LK002 (blocking while locked): within any recognized lock region — and in
 every function reachable from one through resolved calls — flag calls that
 can block or dispatch long work: time.sleep, zero-arg .join(), blocking
@@ -37,6 +44,19 @@ from ..index import FileIndex, FuncInfo, ProjectIndex
 GLOBAL = ("APIStore", "_lock")
 SHARD = ("APIStore", "_pods_lock")
 PAIR = ("APIStore", "<pair>")  # global-then-shard composite (order-safe)
+
+# Partitioned-dispatch locks (ISSUE 12, scheduler/partition.py): LEAF locks
+# ordered strictly AFTER the store chain — code holding one may touch only
+# the router/coordinator's own bookkeeping. Acquiring (directly or via any
+# resolved call path) the store's global/shard locks while a dispatch lock
+# is held is an LK001 inversion: every pipeline's commit path takes the
+# store locks, and a store call under a dispatch lock would deadlock
+# against any store client that consults the router.
+PART_LOCKS = frozenset({
+    ("PartitionRouter", "_route_lock"),
+    ("PartitionedScheduler", "_dispatch_lock"),
+})
+STORE_LOCKS = frozenset({GLOBAL, SHARD, PAIR})
 
 _QUEUEISH = re.compile(r"(^|_)q$|queue", re.IGNORECASE)
 
@@ -85,6 +105,9 @@ class _FuncModel:
         # LK001 candidates: (call node, callee, lock-state description)
         self.inversion_call_sites: List[Tuple[ast.Call, FuncInfo]] = []
         self.inversion_direct: List[Tuple[ast.AST, str]] = []
+        # calls made while a partition/dispatch LEAF lock is definitely held
+        # (ISSUE 12): any callee that may acquire a store lock is an LK001
+        self.part_call_sites: List[Tuple[ast.Call, FuncInfo]] = []
 
 
 def _classify_lock(expr: ast.AST, func: FuncInfo,
@@ -180,6 +203,9 @@ class _Walker:
     def _shard_definite(self) -> bool:
         return any(fr == {SHARD} for fr in self.frames)
 
+    def _part_definite(self) -> bool:
+        return any(fr and fr <= PART_LOCKS for fr in self.frames)
+
     def _global_possible(self) -> bool:
         return any(GLOBAL in fr or PAIR in fr for fr in self.frames)
 
@@ -229,6 +255,11 @@ class _Walker:
                 self.m.inversion_direct.append(
                     (node, "acquires the global RV lock while holding the "
                            "pods shard"))
+        if self._part_definite() and toks & STORE_LOCKS:
+            self.m.inversion_direct.append(
+                (node, "acquires a store lock while holding a partition/"
+                       "dispatch leaf lock (scheduler/partition.py lock "
+                       "discipline)"))
 
     def _scan_expr(self, expr: ast.expr) -> None:
         for node in ast.walk(expr):
@@ -250,6 +281,8 @@ class _Walker:
             if callee is not None and self._shard_definite() \
                     and not self._global_possible():
                 self.m.inversion_call_sites.append((node, callee))
+            if callee is not None and self._part_definite():
+                self.m.part_call_sites.append((node, callee))
 
 
 def check(index: ProjectIndex) -> List[Finding]:
@@ -299,6 +332,17 @@ def check(index: ProjectIndex) -> List[Finding]:
                     hint="hoist the call out of the shard-only section or "
                          "take the locks in docstring order (_lock -> "
                          "_pods_lock)"))
+        for call, callee in m.part_call_sites:
+            if acquires.get(callee, set()) & STORE_LOCKS:
+                findings.append(Finding(
+                    "LK001", info.file.rel, call.lineno,
+                    f"{info.qualname}: call to {callee.qualname} can acquire "
+                    "a store lock while a partition/dispatch leaf lock is "
+                    "held",
+                    hint="dispatch locks are LEAVES (scheduler/partition.py "
+                         "lock discipline): compute the routing decision "
+                         "under the lock, release, then call the store/"
+                         "queue/cache"))
 
     # LK002: functions reachable from any lock region, with one example path
     reachable: Dict[FuncInfo, str] = {}

@@ -83,6 +83,16 @@ SITES: Dict[str, str] = {
     # native leg proves it.
     "native.commit": "store/store.py bind_many/delete_pods native phase gap "
                      "(no lock held)",
+    # the partitioned dispatch layer (ISSUE 12): fires once per pipeline
+    # drive cycle in PartitionedScheduler._drive_pipeline (no lock held;
+    # key = "partition-<i>", so `match=` scopes a plan to one partition).
+    # fail/rate plans are absorbed dispatch hiccups (the cycle retries and
+    # the coordinator counts them); a kill plan is that partition's HARD
+    # death — the coordinator's absorb path remaps the shard and resyncs
+    # the survivors (ChaosChurn_20k's partition-kill leg proves pod
+    # conservation across it).
+    "partition.dispatch": "scheduler/partition.py "
+                          "PartitionedScheduler._drive_pipeline (no lock)",
 }
 
 # sites that fire under a lock (or inside a loop that must not stall): only

@@ -1350,6 +1350,16 @@ def _render_sched_stats(doc: Dict) -> str:
                 f"over {prop['count']} deliveries"
                 + (f" dropped={watch.get('dropped')}"
                    if watch.get("dropped") else ""))
+        part = st.get("partition")
+        if part:
+            # partitioned mode (ISSUE 12): this scheduler is one pipeline of
+            # a PartitionedScheduler — its shard + the dispatch layer's
+            # absorbed races. index -1 is the global residual pass.
+            out.append(
+                f"partition: index={part.get('index')} "
+                f"nodes={part.get('nodes', 0)} "
+                f"conflicts={part.get('conflicts', 0)} "
+                f"reroutes={part.get('reroutes', 0)}")
         brk = st.get("breaker")
         bw = st.get("bind_worker")
         if brk and (brk.get("state") != "closed" or brk.get("trips")

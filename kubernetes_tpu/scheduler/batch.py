@@ -24,8 +24,8 @@ import numpy as np
 from ..chaos import faultinject as _chaos
 from ..chaos.faultinject import FaultKill
 from ..snapshot.tensorizer import TensorCache, build_cluster_tensors, build_pod_batch
-from ..store import (MODIFIED, APIStore, NotFoundError, pod_bind_clone,
-                     pod_structural_clone)
+from ..store import (MODIFIED, APIStore, NotFoundError, is_bind_conflict,
+                     pod_bind_clone, pod_structural_clone)
 from .breaker import SolverCircuitBreaker
 from .flightrec import FlightRecorder, StageClock, register_scheduler
 from .framework import Status
@@ -160,6 +160,20 @@ class BatchScheduler(Scheduler):
         # gang members in staging until quorum, and schedule_batch enforces
         # the all-or-nothing veto. Inactive (one attr read) until a PodGroup
         # exists.
+        # partitioned scheduling (scheduler/partition.py, ISSUE 12):
+        # installed by PartitionedScheduler on its pipelines; all inert on a
+        # standalone scheduler. reroute_hook(qp, status) -> bool intercepts
+        # a plain shard-capacity unschedulable verdict and moves the pod to
+        # another partition's queue (True = ownership transferred, no local
+        # requeue/narration); conflict_sink(qp, msg) consumes a LOST
+        # cross-partition bind race (the pod IS bound — the store decided —
+        # so the losing pipeline drops it instead of requeueing a pod that
+        # no longer needs scheduling).
+        self.partition_index: Optional[int] = None
+        self.reroute_hook = None
+        self.conflict_sink = None
+        self.partition_conflicts = 0  # bind conflicts this pipeline LOST
+        self.partition_reroutes = 0  # pods handed to another partition
         from .gang import GangDirectory
 
         self.gangs = GangDirectory()
@@ -1110,7 +1124,25 @@ class BatchScheduler(Scheduler):
     def _handle_failure(self, qp: QueuedPodInfo, status: Status,
                         failed_nodes: Optional[Dict[str, Status]] = None) -> None:
         """Taps the failure's attribution (plugin, else the reason text) into
-        the current batch's flight record before the shared requeue path."""
+        the current batch's flight record before the shared requeue path.
+
+        Partitioned re-route (ISSUE 12): an UNSCHEDULABLE verdict from a
+        pipeline that only sees one node shard is not a cluster verdict —
+        the reroute hook offers the pod to the next partition (or the global
+        residual pass) instead of parking it, UNLESS preemption nominated a
+        node here (victims are terminating on OUR shard; the pod must wait
+        locally). A re-routed pod is not a failure: no event, no status
+        patch, no failed_count — the terminal verdict belongs to whichever
+        pipeline exhausts the routing."""
+        hook = self.reroute_hook
+        if hook is not None:
+            from .framework import Code
+
+            if (status.code == Code.UNSCHEDULABLE
+                    and not qp.pod.status.nominated_node_name
+                    and hook(qp, status)):
+                self.partition_reroutes += 1
+                return
         sink = self._batch_reasons
         if sink is not None:
             key = status.plugin or (status.reasons[0][:80] if status.reasons
@@ -1193,6 +1225,14 @@ class BatchScheduler(Scheduler):
                        else dict(self.repair_totals)
                        if self.repair_totals["batches"] else None),
             "breaker": self.breaker.describe(),
+            # partitioned mode (ISSUE 12): this pipeline's shard identity +
+            # the absorbed cross-partition races; None standalone
+            "partition": ({
+                "index": self.partition_index,
+                "nodes": self.cache.node_count(),
+                "conflicts": self.partition_conflicts,
+                "reroutes": self.partition_reroutes,
+            } if self.partition_index is not None else None),
             "bind_worker": {
                 "restarts": self.bind_worker_restarts,
                 "failures_logged": len(self.bind_failures),
@@ -1542,13 +1582,24 @@ class BatchScheduler(Scheduler):
             self.flightrec.note_bind_failures(
                 [(qp.pod.key, status.message()) for qp, status in errs])
         log = self.bind_failures
+        csink = self.conflict_sink
         for qp, status in errs:
+            msg = status.message()
+            if csink is not None and is_bind_conflict(msg):
+                # lost cross-partition bind race (ISSUE 12): the conflict is
+                # a FACT — the pod is bound, the store decided the winner —
+                # so this pipeline drops it (the assume was already
+                # forgotten on the error path) and the coordinator counts
+                # the absorbed race. Requeueing would schedule a bound pod.
+                self.partition_conflicts += 1
+                csink(qp, msg)
+                continue
             if len(log) == log.maxlen:
                 # bounded (ISSUE 6 satellite): a caller that never drains
                 # must not leak under sustained bind faults — evict oldest,
                 # count the drop so the loss is observable
                 self.bind_failures_dropped += 1
-            log.append((qp.pod.key, status.message()))
+            log.append((qp.pod.key, msg))
             self._handle_failure(qp, status)
 
     def take_bind_failures(self) -> List:
@@ -1618,6 +1669,19 @@ class BatchScheduler(Scheduler):
         counts = self._rebuild_from_store(preserve_queue=False)
         counts["dropped_assumes"] = dropped
         return counts
+
+    def stop(self) -> None:
+        """Stop the loop/watch like the base class, AND release the bind
+        worker: parked in `q.get()` it would otherwise pin this scheduler's
+        entire object graph (cache, store refs, 100k-pod heaps) for the
+        process lifetime — the leak the partitioned A/B bench and
+        `_absorb_dead`'s corpse.stop() both hit. Items queued before the
+        sentinel still commit (FIFO); a later start() gets a fresh queue."""
+        super().stop()
+        if self._bind_worker is not None:
+            self._bind_q.put(None)
+            self._bind_q = _queue.Queue()
+            self._bind_worker = None
 
     def _serial_one(self, qp: QueuedPodInfo) -> None:
         result = self.schedule_pod(qp.pod)

@@ -95,6 +95,11 @@ class SchedulingQueue:
         # activeQ: heap of (sort_key, seq, QueuedPodInfo)
         self._active: List[Tuple] = []
         self._backoff: List[Tuple[float, int, QueuedPodInfo]] = []
+        # key -> entry count in _backoff (duplicates possible transiently):
+        # keeps contains() O(1) — the partitioned dispatch layer (ISSUE 12)
+        # probes membership once per foreign bound-pod event, which must not
+        # cost an O(backoff) scan under chaos backlogs
+        self._backoff_keys: Dict[str, int] = {}
         self._unschedulable: Dict[str, QueuedPodInfo] = {}
         self._in_active: Dict[str, QueuedPodInfo] = {}
         self._closed = False
@@ -237,6 +242,17 @@ class SchedulingQueue:
         self._in_active[qp.key] = qp
         heapq.heappush(self._active, (self._sort_key(qp), next(self._seq), qp))
 
+    def _backoff_push(self, ready: float, qp: QueuedPodInfo) -> None:
+        heapq.heappush(self._backoff, (ready, next(self._seq), qp))
+        self._backoff_keys[qp.key] = self._backoff_keys.get(qp.key, 0) + 1
+
+    def _backoff_key_drop(self, key: str) -> None:
+        n = self._backoff_keys.get(key, 0) - 1
+        if n <= 0:
+            self._backoff_keys.pop(key, None)
+        else:
+            self._backoff_keys[key] = n
+
     # -- gang staging (scheduler/gang.py) --------------------------------------
 
     def _gang_stage(self, group: str, qp: QueuedPodInfo) -> List[QueuedPodInfo]:
@@ -301,7 +317,7 @@ class SchedulingQueue:
             ready = now + dur
             for m in members:
                 m.timestamp = now
-                heapq.heappush(self._backoff, (ready, next(self._seq), m))
+                self._backoff_push(ready, m)
 
     def add_backoff(self, qps: List[QueuedPodInfo]) -> None:
         """Transient-error requeue (ISSUE 6 failure domains): straight into
@@ -316,10 +332,8 @@ class SchedulingQueue:
             now = self._clock.now()
             for qp in qps:
                 qp.timestamp = now
-                heapq.heappush(
-                    self._backoff,
-                    (now + self._backoff_duration(qp.attempts),
-                     next(self._seq), qp))
+                self._backoff_push(
+                    now + self._backoff_duration(qp.attempts), qp)
 
     def add_unschedulable(self, qp: QueuedPodInfo) -> None:
         """AddUnschedulableIfNotPresent (:741): failed pods wait for an event
@@ -351,7 +365,7 @@ class SchedulingQueue:
                 self._unschedulable.pop(key)
                 remaining = self._backoff_remaining(qp)
                 if remaining > 0:
-                    heapq.heappush(self._backoff, (self._clock.now() + remaining, next(self._seq), qp))
+                    self._backoff_push(self._clock.now() + remaining, qp)
                 else:
                     self._push_active(qp)
                 moved = True
@@ -372,6 +386,7 @@ class SchedulingQueue:
             moved = False
             while self._backoff and self._backoff[0][0] <= now:
                 _, _, qp = heapq.heappop(self._backoff)
+                self._backoff_key_drop(qp.key)
                 self._push_active(qp)
                 moved = True
             if moved:
@@ -509,8 +524,8 @@ class SchedulingQueue:
                     self._unschedulable.pop(key)
                     remaining = self._backoff_remaining(tracked)
                     if remaining > 0:
-                        heapq.heappush(self._backoff, (self._clock.now() + remaining,
-                                                       next(self._seq), tracked))
+                        self._backoff_push(self._clock.now() + remaining,
+                                           tracked)
                     else:
                         self._push_active(tracked)
                         self._lock.notify()
@@ -538,8 +553,11 @@ class SchedulingQueue:
                 self._in_active.pop(key)
                 self._active = [(k, s, qp) for k, s, qp in self._active if qp.key != key]
                 heapq.heapify(self._active)
-            self._backoff = [(t, s, qp) for t, s, qp in self._backoff if qp.key != key]
-            heapq.heapify(self._backoff)
+            if key in self._backoff_keys:
+                self._backoff = [(t, s, qp) for t, s, qp in self._backoff
+                                 if qp.key != key]
+                heapq.heapify(self._backoff)
+                self._backoff_keys.pop(key, None)
 
     def clear(self) -> None:
         """Drop every queued pod across ALL tiers (crash-resync support:
@@ -548,9 +566,38 @@ class SchedulingQueue:
         with self._lock:
             self._active.clear()
             self._backoff.clear()
+            self._backoff_keys.clear()
             self._unschedulable.clear()
             self._in_active.clear()
             self._gang_staging.clear()
+
+    def contains(self, key: str) -> bool:
+        """O(1) membership probe across every tier (active/backoff/
+        unschedulable; gang staging is a small dict-of-dicts scan). The
+        partitioned dispatch layer (ISSUE 12) calls this once per FOREIGN
+        bound-pod event to clean up a stale local entry after losing a
+        cross-partition race — it must never cost an O(queue) scan."""
+        with self._lock:
+            if (key in self._in_active or key in self._unschedulable
+                    or key in self._backoff_keys):
+                return True
+            return any(key in staged
+                       for staged in self._gang_staging.values())
+
+    def add_requeued(self, qps: List[QueuedPodInfo]) -> None:
+        """Admit EXISTING QueuedPodInfos straight into the active tier,
+        preserving their attempt counts and (crucially) submit_ts — the
+        partitioned dispatch layer re-routes a pod that proved infeasible in
+        one node shard to the next partition's queue through here. No
+        backoff: the pod is not unschedulable, it was offered the wrong
+        shard, and the hop count (PartitionRouter) bounds the re-routing so
+        this cannot livelock."""
+        if not qps:
+            return
+        with self._lock:
+            for qp in qps:
+                self._push_active(qp)
+            self._lock.notify_all()
 
     def tracked_keys(self) -> List[str]:
         """Keys of every pod the queue knows, across all three tiers."""

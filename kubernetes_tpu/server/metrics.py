@@ -483,6 +483,17 @@ constraint_violations_total = global_registry.counter(
     "topology_spread)")
 
 # gang scheduling observability (ROADMAP gang-pipeline open items)
+partition_conflicts_total = global_registry.counter(
+    "scheduler_partition_conflicts_total",
+    "Cross-partition bind races LOST by a partition (the pod was already "
+    "bound — an absorbed fact, not an error), by partition")
+partition_reroutes_total = global_registry.counter(
+    "scheduler_partition_reroutes_total",
+    "Pods the dispatch layer re-routed out of a shard that declined them, "
+    "by source partition and target (a partition index or 'residual')")
+partition_deaths_total = global_registry.counter(
+    "scheduler_partition_deaths_total",
+    "Hard partition deaths absorbed by the surviving pipelines")
 gang_staged = global_registry.gauge(
     "scheduler_gang_staged", "Gang members parked in queue staging")
 gang_vetoed_total = global_registry.counter(

@@ -764,7 +764,8 @@ class _Handler(BaseHTTPRequestHandler):
             self._watch(resource, ns, int(q.get("resourceVersion", ["-1"])[0]),
                         field_pred, view=view, label_sel=label_sel,
                         send_initial_events=q.get(
-                            "sendInitialEvents", ["false"])[0] == "true")
+                            "sendInitialEvents", ["false"])[0] == "true",
+                        ring=q.get("ring", ["false"])[0] == "true")
             return
         try:
             if name is not None:
@@ -814,7 +815,15 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _watch(self, resource: str, ns: Optional[str], since_rv: int,
                field_pred=None, view=None, label_sel=None,
-               send_initial_events: bool = False) -> None:
+               send_initial_events: bool = False,
+               ring: bool = False) -> None:
+        """ring=true (query param, ISSUE 12 satellite) subscribes through a
+        per-subscriber bounded RING: a slow observability stream (`ktl ...
+        -w` dashboards) drops its own oldest deliveries — counted as
+        reason="ring_overflow" — instead of terminating into a relist storm
+        that stalls the store for every partition's bind worker. Cache-
+        building clients (informers) must NOT set it: they need the
+        terminate->relist signal."""
         if view is None:
             view = _IDENTITY_VIEW
         if label_sel is not None:
@@ -848,7 +857,7 @@ class _Handler(BaseHTTPRequestHandler):
                 resource,
                 _initial_pred if (ns or field_pred or label_sel) else None)
         try:
-            w = self.store.watch(resource, since_rv=since_rv)
+            w = self.store.watch(resource, since_rv=since_rv, ring=ring)
         except ResourceVersionTooOldError as e:
             self._error(410, str(e), "Expired")
             return

@@ -177,6 +177,22 @@ class AlreadyBoundError(Exception):
     pass
 
 
+# The per-pod bind_many error phrase for a lost bind race (another writer
+# set spec.node_name first). The store OWNS the message format — both the
+# Python commit loop and the native engine (native/hostcommit.cpp) build
+# exactly this phrase — so consumers recognize conflicts through the
+# predicate below instead of each growing its own string match. ISSUE 12:
+# the partitioned scheduler treats a recognized conflict as a FACT (the pod
+# is bound; drop it locally), never as an error to retry.
+_BIND_CONFLICT_PHRASE = " is already bound to "
+
+
+def is_bind_conflict(message: str) -> bool:
+    """True when a bind/bind_many per-pod error message reports the
+    already-bound conflict (vs infrastructure errors or not-found)."""
+    return _BIND_CONFLICT_PHRASE in message
+
+
 class MutationDetectedError(Exception):
     """A watch consumer mutated an event object (client-go's cache mutation
     detector failure: informer objects are shared and must be read-only)."""
@@ -308,8 +324,21 @@ class Watch:
     DEFAULT_MAXSIZE = 10_000
 
     def __init__(self, store: "APIStore", kind=None,
-                 maxsize: int = DEFAULT_MAXSIZE, coalesce: bool = False):
+                 maxsize: int = DEFAULT_MAXSIZE, coalesce: bool = False,
+                 ring: bool = False):
         self._q: "queue.Queue[Optional[Event]]" = queue.Queue(maxsize=maxsize or 0)
+        # ring=True turns the bounded buffer into a RING (ISSUE 12
+        # satellite): on overflow the OLDEST buffered delivery is dropped —
+        # counted as reason="ring_overflow" — and the subscription survives
+        # with a gap instead of terminating. For observability consumers
+        # (`ktl ... -w` dashboards) that tolerate a lossy stream, this
+        # removes the indirect backpressure of eviction: a terminated
+        # watcher relists, and a LIST of a 100k-pod store under the global
+        # lock IS the stall the bind workers would feel. Correctness
+        # consumers (informer caches, the scheduler) keep ring=False — they
+        # NEED the terminate->relist signal, a silent gap would corrupt them.
+        self.ring = ring
+        self.ring_dropped = 0  # lifetime ring_overflow drops (telemetry)
         self._store = store
         # stable subscriber id for the per-subscriber queue-length gauge
         # (store_watch_subscriber_queue_length) and watch_telemetry()
@@ -377,7 +406,7 @@ class Watch:
                     # server/watchmux.py); the delivery itself is put_nowait
                     cb()
             except queue.Full:
-                self._overflow()
+                self._overflow(ev)
 
     def _deliver_coalesced(self, cev: "CoalescedEvent") -> None:
         """Deliver a whole batched write as one buffered item (fast-path
@@ -399,9 +428,32 @@ class Watch:
                     # contract as _deliver above
                     cb()
             except queue.Full:
-                self._overflow()
+                self._overflow(cev)
 
-    def _overflow(self) -> None:
+    def _overflow(self, item=None) -> None:
+        if self.ring and item is not None:
+            # ring mode: drop the OLDEST buffered delivery to make room for
+            # the newest — the subscription survives with a counted gap.
+            # Everything here is non-blocking (get_nowait/put_nowait), so
+            # the emitting writer (a partition's bind worker inside its
+            # commit section) is never backpressured by a slow dashboard.
+            try:
+                old = self._q.get_nowait()
+            except queue.Empty:
+                old = None  # consumer drained between Full and here: the
+                # slot freed itself, nothing was actually lost
+            if old is not None:
+                self.ring_dropped += 1
+                self._store._note_watch_drop("ring_overflow", old.kind)
+            try:
+                self._q.put_nowait(item)
+                return
+            except queue.Full:
+                # raced with a concurrent writer refilling the slot: this
+                # delivery is the drop instead
+                self.ring_dropped += 1
+                self._store._note_watch_drop("ring_overflow", item.kind)
+                return
         # slow watcher: evict rather than buffer forever; drop one
         # event to make room for the end-of-stream sentinel (the
         # stream is void anyway — the consumer must relist)
@@ -965,7 +1017,7 @@ class APIStore:
 
     def watch(self, kind=None, since_rv: int = -1,
               maxsize: int = Watch.DEFAULT_MAXSIZE,
-              coalesce: bool = False) -> Watch:
+              coalesce: bool = False, ring: bool = False) -> Watch:
         """Subscribe to events. since_rv >= 0 replays history events with rv > since_rv
         first (the Reflector resume contract); since_rv == -1 means 'from now'.
         Raises ResourceVersionTooOldError if since_rv predates retained history
@@ -973,7 +1025,12 @@ class APIStore:
         relist (410 Gone analog). maxsize bounds the per-watcher buffer; a
         consumer that falls that far behind is evicted (Watch.terminated).
         coalesce=True opts into CoalescedEvent delivery for batched writes
-        (replay is still per-object)."""
+        (replay is still per-object). ring=True makes the bounded buffer a
+        lossy ring for slow OBSERVABILITY consumers: overflow drops the
+        oldest delivery (counted, reason="ring_overflow") and the
+        subscription survives instead of terminating into a relist storm —
+        see Watch.__init__; never use it for a consumer that builds a cache
+        from the stream."""
         with self._lock:
             if 0 <= since_rv < self._history_floor_rv:
                 raise ResourceVersionTooOldError(
@@ -987,7 +1044,8 @@ class APIStore:
                     raise ResourceVersionTooOldError(
                         f"replay of {len(replay)} events from rv {since_rv} exceeds "
                         f"the watch buffer ({maxsize}); relist required")
-            w = Watch(self, kind, maxsize=maxsize, coalesce=coalesce)
+            w = Watch(self, kind, maxsize=maxsize, coalesce=coalesce,
+                      ring=ring)
             # propagation baseline (ISSUE 9): replayed history is catch-up,
             # not bus lag — only events committed AFTER this subscription
             # enter the latency distribution. The delivered-RV watermark
@@ -1148,6 +1206,8 @@ class APIStore:
         return [{"id": w.id,
                  "queue_length": w._q.qsize(),
                  "coalesce": w.coalesce,
+                 "ring": w.ring,
+                 "ring_dropped": w.ring_dropped,
                  "terminated": w.terminated,
                  "last_delivered_rv": w.last_delivered_rv,
                  "rv_lag": max(0, rv - w.last_delivered_rv)}

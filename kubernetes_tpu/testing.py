@@ -275,7 +275,21 @@ def pod_conservation_report(store, scheduler, keys):
     pods = {}
     for p in store.list("pods")[0]:
         pods[p.key] = p
-    queue_keys = set(scheduler.queue.tracked_keys())
+    # partitioned scheduler (ISSUE 12): the coordinator exposes its live
+    # pipelines + the residual pass; pending-tracking is the UNION of their
+    # queues/caches, while the cross-member double-accounting check below
+    # covers the disjoint pipelines only (the residual cache deliberately
+    # MIRRORS every bound pod, so it is checked for internal dups alone)
+    members = getattr(scheduler, "conservation_members", None)
+    if members is not None:
+        disjoint, mirror = members()
+        trackers = list(disjoint) + ([mirror] if mirror is not None else [])
+    else:
+        disjoint, mirror = [scheduler], None
+        trackers = [scheduler]
+    queue_keys = set()
+    for s in trackers:
+        queue_keys.update(s.queue.tracked_keys())
     bound, pending, failed, lost = [], [], [], []
     for key in keys:
         pod = pods.get(key)
@@ -285,7 +299,8 @@ def pod_conservation_report(store, scheduler, keys):
             bound.append(key)
         elif pod.is_terminal():
             failed.append(key)
-        elif key in queue_keys or scheduler.cache.is_assumed(key):
+        elif key in queue_keys or any(s.cache.is_assumed(key)
+                                      for s in trackers):
             pending.append(key)
         else:
             lost.append(key)
@@ -306,15 +321,29 @@ def pod_conservation_report(store, scheduler, keys):
                 bind_counts[k] = bind_counts.get(k, 0) + 1
     double.extend(k for k, n in bind_counts.items() if n > 1)
     # double-bind check #2: the scheduler cache never accounts one pod on
-    # two nodes (an assume/forget bookkeeping bug would)
+    # two nodes (an assume/forget bookkeeping bug would). For a partitioned
+    # scheduler the DISJOINT pipelines' caches merge into one count — a pod
+    # accounted by two partitions is the cross-partition double; the mirror
+    # (residual) cache is checked separately for internal duplicates only.
     seen: Dict[str, int] = {}
-    snap = scheduler.cache.update_snapshot()
-    for ni in snap.node_info_list:
-        for pi in ni.pods:
-            k = pi.pod.key
-            if k in keyset:
-                seen[k] = seen.get(k, 0) + 1
+    for s in disjoint:
+        snap = s.cache.update_snapshot()
+        for ni in snap.node_info_list:
+            for pi in ni.pods:
+                k = pi.pod.key
+                if k in keyset:
+                    seen[k] = seen.get(k, 0) + 1
     double.extend(k for k, n in seen.items() if n > 1 and k not in double)
+    if mirror is not None:
+        mseen: Dict[str, int] = {}
+        snap = mirror.cache.update_snapshot()
+        for ni in snap.node_info_list:
+            for pi in ni.pods:
+                k = pi.pod.key
+                if k in keyset:
+                    mseen[k] = mseen.get(k, 0) + 1
+        double.extend(k for k, n in mseen.items()
+                      if n > 1 and k not in double)
 
     return {
         "bound": bound, "pending": pending, "failed": failed, "lost": lost,

@@ -113,6 +113,30 @@ def test_bench_quick_runs_and_emits_json():
     assert gang["placed"] == gang["pods"] > 0
     assert gang["gangs"] == 8
     assert gang["pods_per_sec"] > 0
+    # the partitioned scheduler (ISSUE 12): the quick A/B rung's CORRECTNESS
+    # columns are tier-1-gated — conservation, zero mid-run compiles, per-
+    # partition rows, dispatch-layer counters. The SPEEDUP column is
+    # published, never gated here: the A/B is a concurrency claim and a
+    # co-scheduled (possibly 1-core) CI box measures overhead, not overlap
+    # (the `cores`/`ab_comparable` columns say which one you got)
+    px = workloads["Partitioned_2x"]
+    assert "error" not in px, px
+    assert px["conservation_ok"] is True, px
+    assert px["conservation"]["lost"] == 0, px
+    assert px["conservation"]["double_bound"] == 0, px
+    assert px["placed"] == px["pods"] > 0
+    assert px["solver_compiles_during_run"] == 0, px
+    assert len(px["per_partition"]) == 2, px
+    assert sum(r["nodes"] for r in px["per_partition"]) == px["nodes"], px
+    assert px["speedup_vs_1p"] > 0 and px["pods_per_sec_1p"] > 0, px
+    assert isinstance(px["ab_comparable"], bool), px
+    # the NorthStar A/B column: same-box 1p-vs-2p, zero mid-run compiles
+    # per partition, every pod bound through the partitioned path too
+    nsp = ns["partitioned"]
+    assert "error" not in nsp, nsp
+    assert nsp["placed_2p"] == ns["pods"], nsp
+    assert nsp["solver_compiles_during_run"] == 0, nsp
+    assert len(nsp["per_partition"]) == 2, nsp
     # the jit-retrace guard (ISSUE 5): the end-to-end rung's timed window
     # must compile NOTHING — the warm-up covered every bucket, so a nonzero
     # count here is retrace churn (the JT001 bug class, tens of seconds per
@@ -139,6 +163,15 @@ def test_bench_quick_runs_and_emits_json():
     # conserved every pod — the assertion above (lost == 0) covers both legs
     if cc["native_commit"]:
         assert cc["native_commit_faults"] >= 1, cc
+    # ISSUE 12: the partition hard-kill leg — one of two partitions killed
+    # mid-run by the partition.dispatch chaos site; the survivor absorbed
+    # the dead shard (router remap + resync) and every pod is conserved
+    pk = cc["partition_kill"]
+    assert "error" not in pk, pk
+    assert pk["ok"] is True, pk
+    assert pk["bound"] == pk["pods"] > 0, pk
+    assert pk["lost"] == 0 and pk["double_bound"] == 0, pk
+    assert pk["partitions_absorbed"] == 1, pk
     # ISSUE 7: the breaker trip shows as a BOUNDED p99 excursion in the
     # trace (the faulted/backoff pods are the tail, under the chaos SLO
     # ceiling) while every sampled span still completed — chaos must be

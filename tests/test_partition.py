@@ -1,0 +1,688 @@
+"""Partitioned scheduler (ISSUE 12): N solve pipelines over disjoint node
+shards against one store, with optimistic assume + conflict requeue.
+
+The load-bearing guarantees:
+  (a) partitions=1 is BYTE-IDENTICAL to a standalone BatchScheduler —
+      placements, RV sequence, and event streams, across both
+      watch_coalesce modes, with the mutation detector forced;
+  (b) cross-partition races resolve to EXACTLY-ONCE binding through the
+      store's conflict surfacing (a lost race is absorbed, never retried,
+      and conservation holds);
+  (c) the dispatch layer re-routes shard-local unschedulability, pins
+      constraint-spanning pods, and falls through to a global residual
+      pass with full-cluster visibility;
+  (d) a hard-killed partition is absorbed by the survivors via resync with
+      every pod conserved.
+"""
+
+import pytest
+
+from kubernetes_tpu.api.labels import Selector
+from kubernetes_tpu.api.types import Affinity, PodAffinityTerm
+from kubernetes_tpu.chaos import faultinject as fi
+from kubernetes_tpu.scheduler import Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.partition import (
+    PartitionedScheduler,
+    PartitionRouter,
+    spans_partitions,
+)
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.scheduler.queue import QueuedPodInfo
+from kubernetes_tpu.store import APIStore, is_bind_conflict
+from kubernetes_tpu.testing import (
+    MakeNode,
+    MakePod,
+    assert_pod_conservation,
+    mutation_detector_guard,
+)
+
+HOST = "kubernetes.io/hostname"
+ZONE = "topology.kubernetes.io/zone"
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    # every store in this module runs with the detector ON and is checked at
+    # teardown — the partitioned pipelines share one store and one event
+    # stream, exactly the sharing the detector patrols
+    yield from mutation_detector_guard(monkeypatch)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    fi.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _collect_schedulers():
+    """Every pipeline registers in the process-global weak scheduler
+    registry (flightrec) that `ktl sched slo`/`/debug/schedstats` read.
+    Reference cycles keep this module's coordinators alive past their
+    test otherwise, and a later surface test would then evaluate THESE
+    chaos-shaped schedulers' SLOs. Collect so the weak registry drops
+    them at teardown."""
+    yield
+    import gc
+
+    gc.collect()
+
+
+def fw_factory():
+    return Framework(default_plugins())
+
+
+def make_nodes(n, cpu="16", zones=0):
+    out = []
+    for i in range(n):
+        labels = {HOST: f"node-{i}"}
+        if zones:
+            labels[ZONE] = f"zone-{i % zones}"
+        out.append(MakeNode(f"node-{i}").labels(labels).capacity(
+            {"cpu": cpu, "memory": "64Gi", "pods": "110"}).obj())
+    return out
+
+
+def make_pods(n, pfx="p", cpu="500m"):
+    return [MakePod(f"{pfx}-{i}").req(
+        {"cpu": cpu, "memory": "1Gi"}).obj() for i in range(n)]
+
+
+def drain(sched):
+    sched.run_until_idle()
+    sched.flush_binds()
+
+
+def placements(store):
+    return sorted((p.key, p.spec.node_name) for p in store.list("pods")[0])
+
+
+def bind_transitions(store):
+    """Per-key count of unbound->bound transitions in the store's history —
+    the exactly-once-binding source of truth."""
+    out = {}
+    for ev in store._history:
+        if ev.kind != "pods" or ev.type != "MODIFIED":
+            continue
+        if ev.obj.spec.node_name and (ev.prev is None
+                                      or not ev.prev.spec.node_name):
+            out[ev.obj.key] = out.get(ev.obj.key, 0) + 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# (a) partitions=1 parity
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("columnar", [True, False])
+def test_partitions_1_is_byte_identical(columnar):
+    def run(build):
+        store = APIStore()
+        for n in make_nodes(24):
+            store.create("nodes", n)
+        s = build(store)
+        s.sync()
+        store.create_many("pods", make_pods(300), consume=True)
+        drain(s)
+        events = [(ev.type, ev.kind, ev.resource_version,
+                   ev.obj.key if hasattr(ev.obj, "key") else None,
+                   getattr(ev.obj.spec, "node_name", None)
+                   if ev.kind == "pods" else None)
+                  for ev in store._history]
+        return placements(store), events
+
+    pl_a, ev_a = run(lambda st: BatchScheduler(
+        st, fw_factory(), batch_size=256, solver="fast", columnar=columnar))
+    pl_b, ev_b = run(lambda st: PartitionedScheduler(
+        st, fw_factory, partitions=1, batch_size=256, solver="fast",
+        columnar=columnar))
+    assert pl_a == pl_b
+    assert ev_a == ev_b
+    assert len(pl_a) == 300 and all(node for _k, node in pl_a)
+
+
+def test_partitions_1_has_no_hooks_or_residual():
+    store = APIStore()
+    ps = PartitionedScheduler(store, fw_factory, partitions=1)
+    pipe = ps.pipelines[0]
+    assert pipe._pod_gate is None and pipe._node_filter is None
+    assert pipe.reroute_hook is None and pipe.conflict_sink is None
+    assert ps._residual is None and not ps._residual_enabled
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+
+def test_hash_routing_splits_nodes_and_pods_disjointly():
+    store = APIStore()
+    for n in make_nodes(40):
+        store.create("nodes", n)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              batch_size=256, solver="fast")
+    ps.sync()
+    counts = [p.cache.node_count() for p in ps.pipelines]
+    assert sum(counts) == 40 and all(c > 0 for c in counts)
+    store.create_many("pods", make_pods(400, "hr"), consume=True)
+    drain(ps)
+    bound = [p for p in store.list("pods")[0] if p.spec.node_name]
+    assert len(bound) == 400
+    # every pod landed inside its node's shard, and the shards are disjoint
+    r = ps.router
+    by_part = {0: set(), 1: set()}
+    for p in bound:
+        by_part[r.partition_of_node_name(p.spec.node_name)].add(
+            p.spec.node_name)
+    assert by_part[0] and by_part[1]
+    assert not (by_part[0] & by_part[1])
+    assert_pod_conservation(store, ps, [p.key for p in bound])
+
+
+def test_zone_routing_keeps_zones_whole():
+    store = APIStore()
+    nodes = make_nodes(24, zones=4)
+    for n in nodes:
+        store.create("nodes", n)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              partition_by="zone", batch_size=64,
+                              solver="fast")
+    ps.sync()
+    r = ps.router
+    for zone in ("zone-0", "zone-1", "zone-2", "zone-3"):
+        members = [n for n in nodes
+                   if n.metadata.labels.get(ZONE) == zone]
+        owners = {r.partition_of_node_name(n.metadata.name)
+                  for n in members}
+        assert len(owners) == 1, (zone, owners)
+    assert sum(p.cache.node_count() for p in ps.pipelines) == 24
+
+
+def test_spanning_pods_pin_to_designated_partition():
+    aff = MakePod("aff").req({"cpu": "100m"}).obj()
+    aff.spec.affinity = Affinity(pod_affinity_required=[PodAffinityTerm(
+        topology_key=HOST,
+        selector=Selector.from_match_labels({"app": "db"}))])
+    assert spans_partitions(aff)
+    plain = MakePod("plain").req({"cpu": "100m"}).obj()
+    assert not spans_partitions(plain)
+    gang = MakePod("g0").labels(
+        {"pod-group.scheduling/name": "grp"}).obj()
+    assert spans_partitions(gang)
+    r = PartitionRouter(4)
+    # pinned: the slot-0 owner, identical for every spanning pod
+    assert r.partition_of_pod(aff) == r.partition_of_pod(gang) == 0
+
+
+# ---------------------------------------------------------------------------
+# (c) re-route + residual
+# ---------------------------------------------------------------------------
+
+
+def test_shard_unschedulable_pod_reroutes_and_binds():
+    store = APIStore()
+    nodes = make_nodes(8)
+    r = PartitionRouter(2)
+    shard0 = [n for n in nodes if r.observe_node(n) == 0]
+    shard1 = [n for n in nodes if r.observe_node(n) == 1]
+    assert shard0 and shard1
+    # shard 0 keeps ONE node (32 pod slots); shard 1 keeps everything
+    for n in shard0[:1] + shard1:
+        store.create("nodes", n)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              batch_size=256, solver="fast")
+    ps.sync()
+    n_pods = 30 * (1 + len(shard1))  # under capacity, over shard 0 alone
+    store.create_many("pods", make_pods(n_pods, "rr"), consume=True)
+    drain(ps)
+    bound = [p for p in store.list("pods")[0] if p.spec.node_name]
+    assert len(bound) == n_pods
+    assert ps.reroutes_total > 0
+    assert_pod_conservation(store, ps,
+                            [f"default/rr-{i}" for i in range(n_pods)])
+
+
+def test_residual_pass_places_spanning_pod_with_global_view():
+    store = APIStore()
+    nodes = make_nodes(8)
+    r_probe = PartitionRouter(2)
+    shard1 = [n for n in nodes if r_probe.observe_node(n) == 1]
+    for n in nodes:
+        store.create("nodes", n)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              batch_size=64, solver="fast")
+    ps.sync()
+    # anchor bound on a SHARD-1 node; the affinity pod is spanning, so it
+    # pins to partition 0 — whose shard cannot satisfy the affinity — and
+    # must fall through to the residual pass's full-cluster view
+    anchor = MakePod("anchor").labels({"app": "db"}).req(
+        {"cpu": "100m"}).obj()
+    anchor.spec.node_name = shard1[0].metadata.name
+    store.create("pods", anchor)
+    aff = MakePod("aff").req({"cpu": "100m"}).obj()
+    aff.spec.affinity = Affinity(pod_affinity_required=[PodAffinityTerm(
+        topology_key=HOST,
+        selector=Selector.from_match_labels({"app": "db"}))])
+    store.create("pods", aff)
+    drain(ps)
+    assert ps.residual_passes >= 1
+    got = store.get("pods", "default/aff")
+    assert got.spec.node_name == shard1[0].metadata.name
+    st = ps.sched_stats()
+    assert st["residual"]["scheduled"] >= 1
+
+
+def test_residual_disabled_parks_locally():
+    store = APIStore()
+    nodes = make_nodes(4)
+    for n in nodes:
+        store.create("nodes", n)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              batch_size=64, solver="fast", residual=False)
+    ps.sync()
+    big = MakePod("too-big").req({"cpu": "64"}).obj()  # fits nowhere
+    store.create("pods", big)
+    drain(ps)
+    assert ps.residual_passes == 0
+    # parked unschedulable in SOME pipeline — conserved, not lost
+    assert any("default/too-big" in p.queue.tracked_keys()
+               for p in ps.pipelines)
+
+
+# ---------------------------------------------------------------------------
+# (b) conflict requeue: exactly-once binding under a cross-partition race
+# ---------------------------------------------------------------------------
+
+
+def test_is_bind_conflict_recognizer():
+    assert is_bind_conflict("pod default/x is already bound to node-3")
+    assert not is_bind_conflict("pods default/x not found")
+    assert not is_bind_conflict("injected fault at store.bind_many")
+
+
+def test_cross_partition_race_binds_exactly_once():
+    """The acceptance race: both partitions hold the SAME pods in their
+    queues (a double-routing race), both solve and optimistically assume,
+    both bind — the store arbitrates, the loser absorbs the conflict, and
+    every pod is bound exactly once with conservation intact."""
+    store = APIStore()
+    for n in make_nodes(8):
+        store.create("nodes", n)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              batch_size=64, solver="fast")
+    ps.sync()
+    store.create_many("pods", make_pods(20, "race"), consume=True)
+    for pipe in ps.pipelines:
+        pipe.pump_events()
+    # force the race: inject every pod into the OTHER partition's queue too
+    for pipe in ps.pipelines:
+        other = ps.pipelines[1 - pipe.partition_index]
+        for key in list(other.queue.tracked_keys()):
+            pod = store.get("pods", key)
+            # a REAL admission timestamp: these hand-made race entries feed
+            # the pipeline's submit->bound latency histogram like any pod,
+            # and a zero timestamp would record the process uptime as a
+            # (bogus) multi-minute tail
+            pipe.queue.add_requeued(
+                [QueuedPodInfo(pod=pod, timestamp=pipe.clock.now())])
+    drain(ps)
+    bound = [p for p in store.list("pods")[0] if p.spec.node_name]
+    assert len(bound) == 20
+    trans = bind_transitions(store)
+    assert len(trans) == 20 and all(v == 1 for v in trans.values()), trans
+    assert ps.conflicts_total > 0  # the race really happened and absorbed
+    assert_pod_conservation(store, ps,
+                            [f"default/race-{i}" for i in range(20)])
+    # the losers' caches hold no residue of the pods they lost
+    for pipe in ps.pipelines:
+        assert pipe.cache.assumed_count() == 0
+
+
+def test_foreign_bound_event_cleans_stale_queue_entry():
+    """A PER-OBJECT foreign bind event (a store.bind from anywhere outside
+    the peer pipelines' batch channel) cleans a stale local queue entry at
+    the gate; a PEER's coalesced bind batch is instead skipped in O(1) —
+    disjoint shards — and the stale entry self-heals through the bind
+    conflict path (test_cross_partition_race_binds_exactly_once)."""
+    store = APIStore()
+    nodes = make_nodes(8)
+    for n in nodes:
+        store.create("nodes", n)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              batch_size=64, solver="fast")
+    ps.sync()
+    pod = make_pods(1, "stale")[0]
+    store.create("pods", pod)
+    for pipe in ps.pipelines:
+        pipe.pump_events()
+    owner = ps.router.partition_of_pod(pod)
+    loser = ps.pipelines[1 - owner]
+    # double-route: the non-owner also queues it
+    loser.queue.add_requeued(
+        [QueuedPodInfo(pod=store.get("pods", pod.key),
+                       timestamp=loser.clock.now())])
+    assert loser.queue.contains(pod.key)
+    # an out-of-band bind (not a peer batch: plain store.bind, no origin)
+    # onto a node of the OWNER's shard; the loser's next ingest of the
+    # per-object MODIFIED must clean the stale entry without scheduling
+    target = next(n.metadata.name for n in nodes
+                  if ps.router.partition_of_node_name(n.metadata.name)
+                  == owner)
+    store.bind(pod.metadata.namespace, pod.metadata.name, target)
+    loser.pump_events()
+    assert not loser.queue.contains(pod.key)
+    # the owner still accounts the bind in its cache
+    ps.pipelines[owner].pump_events()
+    assert ps.pipelines[owner].cache.contains(pod.key)
+
+
+# ---------------------------------------------------------------------------
+# (d) partition death absorption
+# ---------------------------------------------------------------------------
+
+
+def test_partition_hard_kill_absorbed_with_conservation():
+    store = APIStore()
+    for n in make_nodes(12):
+        store.create("nodes", n)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              batch_size=64, solver="fast")
+    ps.sync()
+    store.create_many("pods", make_pods(200, "kk"), consume=True)
+    fi.arm([fi.FaultPlan("partition.dispatch", "kill",
+                         match="partition-1", after=1)])
+    try:
+        ps.run_until_idle()
+    finally:
+        fi.disarm()
+    drain(ps)
+    assert ps.partitions_absorbed == 1
+    assert ps.router.live_partitions() == [0]
+    bound = [p for p in store.list("pods")[0] if p.spec.node_name]
+    assert len(bound) == 200
+    trans = bind_transitions(store)
+    assert all(v == 1 for v in trans.values())
+    assert_pod_conservation(store, ps,
+                            [f"default/kk-{i}" for i in range(200)])
+    # the survivor adopted the dead shard's nodes
+    assert ps.pipelines[0].cache.node_count() == 12
+
+
+def test_dispatch_fail_plan_is_absorbed():
+    store = APIStore()
+    for n in make_nodes(6):
+        store.create("nodes", n)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              batch_size=64, solver="fast")
+    ps.sync()
+    store.create_many("pods", make_pods(60, "df"), consume=True)
+    fi.arm([fi.FaultPlan("partition.dispatch", "fail", count=3)])
+    try:
+        drain(ps)
+    finally:
+        fi.disarm()
+    assert ps.dispatch_faults >= 1
+    bound = [p for p in store.list("pods")[0] if p.spec.node_name]
+    assert len(bound) == 60
+
+
+def test_kill_partition_entrypoint():
+    store = APIStore()
+    for n in make_nodes(8):
+        store.create("nodes", n)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              batch_size=64, solver="fast")
+    ps.sync()
+    store.create_many("pods", make_pods(100, "ke"), consume=True)
+    drain(ps)
+    before = ps.scheduled_count
+    assert before == 100
+    ps.kill_partition(1)
+    assert ps.router.live_partitions() == [0]
+    # post-absorb, new pods all flow through the survivor
+    store.create_many("pods", make_pods(50, "ke2"), consume=True)
+    drain(ps)
+    bound = [p for p in store.list("pods")[0] if p.spec.node_name]
+    assert len(bound) == 150
+    assert ps.pipelines[0].cache.node_count() == 8
+
+
+# ---------------------------------------------------------------------------
+# observability + router unit coverage
+# ---------------------------------------------------------------------------
+
+
+def test_sched_stats_merged_and_per_partition_rows():
+    store = APIStore()
+    for n in make_nodes(10):
+        store.create("nodes", n)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              batch_size=64, solver="fast")
+    ps.sync()
+    store.create_many("pods", make_pods(100, "ob"), consume=True)
+    drain(ps)
+    st = ps.sched_stats()
+    assert st["partitions"] == 2 and st["live"] == 2
+    assert st["scheduled"] == 100
+    assert len(st["rows"]) == 2
+    assert sum(r["nodes"] for r in st["rows"]) == 10
+    assert sum(r["scheduled"] for r in st["rows"]) == 100
+    assert st["stages_merged"].get("solve", {}).get("batches", 0) >= 2
+    # each pipeline's OWN sched_stats carries the partition section that
+    # /debug/schedstats and `ktl sched stats` render per registered pipeline
+    for i, pipe in enumerate(ps.pipelines):
+        sec = pipe.sched_stats()["partition"]
+        assert sec["index"] == i
+        assert sec["nodes"] == pipe.cache.node_count()
+
+
+def test_router_absorb_remaps_all_slots_to_survivors():
+    r = PartitionRouter(3)
+    survivors = r.absorb(1)
+    assert survivors == [0, 2]
+    for name in (f"node-{i}" for i in range(64)):
+        assert r.partition_of_node_name(name) in (0, 2)
+    pod = make_pods(1)[0]
+    assert r.partition_of_pod(pod) in (0, 2)
+
+
+def test_router_next_hop_is_bounded_and_clears():
+    r = PartitionRouter(3)
+    pod = make_pods(1, "hop")[0]
+    home = r.partition_of_pod(pod)
+    seen = set()
+    cur = home
+    while True:
+        nxt = r.next_hop(pod, cur)
+        if nxt is None:
+            break
+        assert nxt not in seen  # never revisits within one routing cycle
+        seen.add(nxt)
+        cur = nxt
+    assert len(seen) <= 2  # 3 live partitions -> at most 2 hops
+    assert r.override_count() == 0  # exhausted routing cleared its override
+
+
+def test_queue_contains_is_consistent_across_tiers():
+    from kubernetes_tpu.scheduler.queue import SchedulingQueue
+
+    q = SchedulingQueue()
+    pod = make_pods(1, "qc")[0]
+    q.add(pod)
+    assert q.contains(pod.key)
+    qp = q.pop(timeout=0)
+    assert not q.contains(pod.key)
+    q.add_backoff([qp])
+    assert q.contains(pod.key)
+    q.delete_key(pod.key)
+    assert not q.contains(pod.key)
+    q.add_unschedulable(qp)
+    assert q.contains(pod.key)
+    q.clear()
+    assert not q.contains(pod.key)
+
+
+def test_zone_label_migration_moves_node_between_shards():
+    """A node placed by hash fallback (zone label absent at creation) and
+    later re-slotted when its zone label appears must leave the OLD
+    owner's cache — two pipelines accounting one node's capacity would
+    overcommit it in a way the pod-level conflict machinery can't catch."""
+    store = APIStore()
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              partition_by="zone", batch_size=64,
+                              solver="fast")
+    ps.sync()
+    # zone-0/zone-1 learned first, pinning the zone->slot round-robin
+    seeded = make_nodes(2, zones=2)
+    for n in seeded:
+        store.create("nodes", n)
+    # a node with NO zone label: hash-fallback placement
+    bare = MakeNode("drift-node").labels({HOST: "drift-node"}).capacity(
+        {"cpu": "16", "memory": "64Gi", "pods": "110"}).obj()
+    store.create("nodes", bare)
+    ps.pump_events()
+    old_owner = ps.router.partition_of_node_name("drift-node")
+    assert ps.pipelines[old_owner].cache.node_count() >= 1
+    # the zone label appears; pick whichever zone re-slots it AWAY
+    for zone in ("zone-0", "zone-1"):
+        labeled = MakeNode("drift-node").labels(
+            {HOST: "drift-node", ZONE: zone}).capacity(
+            {"cpu": "16", "memory": "64Gi", "pods": "110"}).obj()
+        probe = ps.router.observe_node(labeled)
+        if probe != old_owner:
+            break
+    assert probe != old_owner, "both zones map to the old owner"
+    cur = store.get("nodes", "drift-node")
+    import copy as _copy
+
+    relabeled = _copy.deepcopy(cur)
+    relabeled.metadata.labels[ZONE] = zone
+    store.update("nodes", relabeled)
+    ps.pump_events()
+    new_owner = ps.router.partition_of_node_name("drift-node")
+    assert new_owner != old_owner
+    # exactly ONE pipeline accounts the node now
+    counts = []
+    for pipe in ps.pipelines:
+        snap = pipe.cache.update_snapshot()
+        counts.append(1 if snap.get("drift-node") is not None else 0)
+    assert counts[new_owner] == 1 and counts[old_owner] == 0, counts
+
+
+def test_required_anti_affinity_not_violated_across_shards():
+    """Review regression (2nd pass): a REQUIRED constraint whose witnesses
+    live on another shard must not be violated by a shard-limited solve. A
+    zone that hash-splits across both shards holds an app=web pod on the
+    OTHER shard's node; the anti-affinity pod (topologyKey=zone) must land
+    outside that zone — only the full-view residual pass can know that."""
+    store = APIStore()
+    r_probe = PartitionRouter(2)
+    # zone-a spans both shards: one node per shard; zone-b is the escape
+    nodes, zone_a = [], []
+    for i in range(12):
+        n = MakeNode(f"node-{i}").labels(
+            {HOST: f"node-{i}", ZONE: "zone-b"}).capacity(
+            {"cpu": "16", "memory": "64Gi", "pods": "110"}).obj()
+        nodes.append(n)
+    shard_of = {n.metadata.name: r_probe.observe_node(n) for n in nodes}
+    a0 = next(n for n in nodes if shard_of[n.metadata.name] == 0)
+    a1 = next(n for n in nodes if shard_of[n.metadata.name] == 1)
+    for n in (a0, a1):
+        n.metadata.labels[ZONE] = "zone-a"
+        zone_a.append(n.metadata.name)
+    for n in nodes:
+        store.create("nodes", n)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              batch_size=64, solver="fast")
+    ps.sync()
+    # the witness: app=web bound in zone-a on SHARD 1 (invisible to a
+    # shard-0-limited pipeline)
+    witness = MakePod("web").labels({"app": "web"}).req({"cpu": "100m"}).obj()
+    witness.spec.node_name = a1.metadata.name
+    store.create("pods", witness)
+    anti = MakePod("anti").req({"cpu": "100m"}).obj()
+    anti.spec.affinity = Affinity(pod_anti_affinity_required=[
+        PodAffinityTerm(topology_key=ZONE,
+                        selector=Selector.from_match_labels({"app": "web"}))])
+    store.create("pods", anti)
+    drain(ps)
+    got = store.get("pods", "default/anti")
+    assert got.spec.node_name, "anti pod must place (zone-b is free)"
+    assert got.spec.node_name not in zone_a, (
+        f"required anti-affinity violated: bound into zone-a on "
+        f"{got.spec.node_name}")
+    assert ps.residual_passes >= 1
+
+
+def test_gang_quorum_counts_foreign_bound_members_residual_disabled():
+    """Review regression (2nd pass): with the residual disabled (spanning
+    pods pin to the designated partition), a gang's already-bound members
+    on FOREIGN shards must still count toward quorum — the pinned
+    pipeline's GangDirectory observes every pod event, gated or not."""
+    from kubernetes_tpu.testing import make_pod_group
+
+    store = APIStore()
+    nodes = make_nodes(10)
+    r_probe = PartitionRouter(2)
+    shard1 = [n for n in nodes if r_probe.observe_node(n) == 1]
+    for n in nodes:
+        store.create("nodes", n)
+    store.create("podgroups", make_pod_group("g1", min_member=4))
+    # two members already bound on SHARD-1 nodes (foreign to partition 0)
+    for i in range(2):
+        m = MakePod(f"g1-bound-{i}").labels(
+            {"pod-group.scheduling/name": "g1"}).req({"cpu": "100m"}).obj()
+        m.spec.node_name = shard1[i % len(shard1)].metadata.name
+        store.create("pods", m)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              batch_size=64, solver="fast", residual=False)
+    ps.sync()
+    pinned = ps.router.designated()
+    assert ps.pipelines[pinned].gangs.placed_count("default/g1") == 2
+    # two pending members arrive: staged(2) + placed(2) >= min_member(4)
+    # must admit — an undercount would strand them in staging forever
+    store.create_many("pods", [
+        MakePod(f"g1-new-{i}").labels(
+            {"pod-group.scheduling/name": "g1"}).req({"cpu": "100m"}).obj()
+        for i in range(2)], consume=True)
+    drain(ps)
+    bound = [p for p in store.list("pods")[0]
+             if p.metadata.name.startswith("g1-new-") and p.spec.node_name]
+    assert len(bound) == 2, (
+        ps.pipelines[pinned].queue.lengths(),
+        ps.pipelines[pinned].queue.gang_staged_count())
+
+
+def test_stop_releases_bind_worker_thread():
+    """Review regression (2nd pass): stop() must release the bind worker —
+    parked in q.get() it pins the scheduler's whole object graph (the
+    bench's del-before-A/B relies on this actually freeing)."""
+    store = APIStore()
+    for n in make_nodes(4):
+        store.create("nodes", n)
+    s = BatchScheduler(store, fw_factory(), batch_size=64, solver="fast")
+    s.sync()
+    store.create_many("pods", make_pods(20, "bw"), consume=True)
+    s.run_until_idle()
+    s.flush_binds()
+    worker = s._bind_worker
+    assert worker is not None and worker.is_alive()
+    s.stop()
+    worker.join(timeout=5)
+    assert not worker.is_alive()
+    assert s._bind_worker is None
+
+
+def test_kill_partition_is_idempotent():
+    store = APIStore()
+    for n in make_nodes(6):
+        store.create("nodes", n)
+    ps = PartitionedScheduler(store, fw_factory, partitions=2,
+                              batch_size=64, solver="fast")
+    ps.sync()
+    ps.kill_partition(1)
+    ps.kill_partition(1)
+    assert ps.partitions_absorbed == 1
+    assert ps.router.live_partitions() == [0]

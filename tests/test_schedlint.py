@@ -116,6 +116,96 @@ def test_lk001_quiet_on_mandated_order():
     assert "LK001" not in rules_of(analyze_source(LK001_GOOD))
 
 
+# LK001 partition extension (ISSUE 12): the dispatch-layer locks
+# (PartitionRouter._route_lock / PartitionedScheduler._dispatch_lock) are
+# LEAF locks — a store-lock acquisition (direct or via any resolved call
+# path) while one is held is an inversion.
+
+LK001_PART_BAD = '''
+import threading
+
+class APIStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pods_lock = threading.RLock()
+
+    def commit_rows(self):
+        with self._lock:
+            with self._pods_lock:
+                return 1
+
+class PartitionRouter:
+    def __init__(self):
+        self._route_lock = threading.Lock()
+        self.store = APIStore()
+
+    def bad_store_call_under_route_lock(self):
+        with self._route_lock:
+            # routing decisions must not reach into the store: commit_rows
+            # takes the global+shard chain UNDER the leaf lock
+            return self.store.commit_rows()
+
+class PartitionedScheduler:
+    def __init__(self):
+        self._dispatch_lock = threading.Lock()
+        self.store = APIStore()
+
+    def bad_store_call_under_dispatch_lock(self):
+        with self._dispatch_lock:
+            return self.store.commit_rows()
+'''
+
+LK001_PART_GOOD = '''
+import threading
+
+class APIStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pods_lock = threading.RLock()
+
+    def commit_rows(self):
+        with self._lock:
+            with self._pods_lock:
+                return 1
+
+class PartitionRouter:
+    def __init__(self):
+        self._route_lock = threading.Lock()
+        self._overrides = {}
+        self.store = APIStore()
+
+    def decide_then_act(self, key):
+        # the mandated shape: bookkeeping under the leaf lock, release,
+        # THEN call the store
+        with self._route_lock:
+            target = self._overrides.get(key)
+        if target is None:
+            return self.store.commit_rows()
+        return target
+
+class PartitionedScheduler:
+    def __init__(self):
+        self._dispatch_lock = threading.Lock()
+        self._parked = []
+
+    def park(self, qp):
+        with self._dispatch_lock:
+            self._parked.append(qp)
+'''
+
+
+def test_lk001_fires_on_store_call_under_partition_lock():
+    findings = [f for f in analyze_source(LK001_PART_BAD)
+                if f.rule == "LK001"]
+    assert len(findings) == 2, findings
+    assert all("partition/dispatch leaf lock" in f.message
+               for f in findings), findings
+
+
+def test_lk001_quiet_on_decide_then_act_partition_shape():
+    assert "LK001" not in rules_of(analyze_source(LK001_PART_GOOD))
+
+
 LK002_BAD = '''
 import threading
 import time

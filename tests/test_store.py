@@ -212,6 +212,71 @@ def test_bounded_drain_leaves_remainder_buffered():
     assert not w.terminated
 
 
+def test_ring_watch_survives_overflow_with_counted_drops():
+    """Ring mode (ISSUE 12 satellite): a slow observability subscriber with
+    ring=True drops its own OLDEST deliveries on overflow — counted as
+    reason="ring_overflow" — and the subscription SURVIVES with the newest
+    events buffered, instead of terminating into a relist. Writers are
+    never blocked either way (put_nowait throughout)."""
+    from kubernetes_tpu.testing import MakePod
+
+    store = APIStore()
+    w = store.watch("pods", maxsize=64, ring=True)
+    for i in range(200):
+        store.create("pods", MakePod(f"r{i}").obj())
+    assert not w.terminated
+    assert w.ring_dropped == 200 - 64
+    evs = w.drain()
+    assert len(evs) == 64
+    # the ring kept the NEWEST window
+    assert evs[-1].obj.metadata.name == "r199"
+    assert evs[0].obj.metadata.name == "r136"
+    # drops are observable: per-watch counter + store-level reason bucket
+    tel = store.watch_telemetry()
+    assert tel["dropped"].get("ring_overflow", 0) == 136
+    row = next(s for s in tel["subscribers"] if s["id"] == w.id)
+    assert row["ring"] is True and row["ring_dropped"] == 136
+    # the stream keeps flowing after the lossy window
+    store.create("pods", MakePod("after").obj())
+    got = w.drain()
+    assert len(got) == 1 and got[0].obj.metadata.name == "after"
+    assert not w.terminated
+
+
+def test_non_ring_watch_still_terminates_on_overflow():
+    """The default contract is unchanged: a cache-building consumer that
+    falls maxsize behind is evicted and must relist (terminate→relist is
+    its correctness signal; a silent gap would corrupt its cache)."""
+    from kubernetes_tpu.testing import MakePod
+
+    store = APIStore()
+    w = store.watch("pods", maxsize=16)
+    for i in range(40):
+        store.create("pods", MakePod(f"t{i}").obj())
+    assert w.terminated
+    assert store.watch_telemetry()["dropped"].get("overflow", 0) >= 1
+
+
+def test_ring_watch_coalesced_batches_drop_as_units():
+    """Coalesced mode + ring: each CoalescedEvent is one buffered item, so
+    the ring drops whole batches (counted once per dropped delivery, the
+    same unit the chaos drop site counts)."""
+    from kubernetes_tpu.testing import MakePod
+
+    store = APIStore()
+    w = store.watch("pods", maxsize=2, coalesce=True, ring=True)
+    for wave in range(4):
+        store.create_many(
+            "pods", [MakePod(f"c{wave}-{i}").obj() for i in range(10)],
+            consume=True)
+    assert not w.terminated
+    assert w.ring_dropped == 2
+    evs = w.drain()
+    assert len(evs) == 2
+    # newest two waves retained
+    assert evs[-1].events[-1].obj.metadata.name == "c3-9"
+
+
 # -- runtime lock-order assertion (ISSUE 5: dynamic companion of LK001) --------
 
 

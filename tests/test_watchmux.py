@@ -153,3 +153,50 @@ class TestWatchMux:
             assert wait_streams(server, 0)
         finally:
             resp.close()
+
+
+class TestRingWatch:
+    def test_ring_query_param_survives_overflow(self, server):
+        """ISSUE 12 satellite: `?ring=true` subscribes through a lossy RING
+        — on overflow the server-side Watch drops its own oldest delivery
+        (counted reason="ring_overflow") and the stream SURVIVES, instead
+        of the default terminate->relist. The writer is never blocked."""
+        import queue as _queue
+
+        store = server.store
+        _, rv = store.list("pods")
+        req = urllib.request.Request(
+            f"{server.url}/api/v1/namespaces/default/pods?watch=true"
+            f"&resourceVersion={rv}&ring=true")
+        resp = urllib.request.urlopen(req, timeout=10)
+        assert wait_streams(server, 1)
+        st = server._mux._streams[0]
+        assert st.watch.ring is True
+        # same overflow shape as the eviction test: shrink the buffer and
+        # publish back-to-back with the mux locked out of draining
+        st.watch._q = _queue.Queue(maxsize=1)
+        with store._lock:
+            for i in range(4):
+                store.create("pods", MakePod(f"ring{i}").obj())
+        assert not st.watch.terminated
+        assert st.watch.ring_dropped >= 3
+        assert store.watch_telemetry()["dropped"].get(
+            "ring_overflow", 0) >= 3
+        try:
+            # the NEWEST event still reaches the client
+            deadline = time.monotonic() + 5
+            names = []
+            while time.monotonic() < deadline:
+                line = resp.readline()
+                if not line.strip():
+                    continue
+                ev = json.loads(line)
+                if ev["type"] == "BOOKMARK":
+                    continue
+                names.append(ev["object"]["metadata"]["name"])
+                if "ring3" in names:
+                    break
+            assert "ring3" in names
+            assert not st.watch.terminated
+        finally:
+            resp.close()
