@@ -32,6 +32,13 @@ whole drain, one recorder.loop()/pump() call); per-key instrumentation
 inside those loops is the same multiplier bug as per-pod stamping in
 batch.py. `.drain(...)` iterables are recognized as event-scale regardless
 of the receiver expression.
+
+Steady-state telemetry (ISSUE 13): obs/timeseries.py and obs/resource.py
+are hot files too — their contract is taps per WINDOW close / per SAMPLE
+tick, never per pod. A note_batch/note_stage call is one tap per batch by
+design; anything instrumenting inside a pod-scale loop of these files
+(someone feeding the window per pod "for accuracy") is the same 100k
+multiplier the flight recorder's budget forbids.
 """
 
 from __future__ import annotations
@@ -44,7 +51,8 @@ from ..findings import Finding
 from ..index import ProjectIndex
 
 HOT_FILE_SUFFIXES = ("scheduler/batch.py", "scheduler/podtrace.py",
-                     "controllers/base.py")
+                     "controllers/base.py", "obs/timeseries.py",
+                     "obs/resource.py")
 
 POD_SCALE = re.compile(
     r"^(qps|pods|pending|items|to_bind|bind_rows|bind_nodes|bind_gang|"

@@ -5,7 +5,8 @@ Talks HTTP to the API server (KTL_SERVER env or --server).
 
 Commands: get, describe, create -f, apply -f (server-side merge patch),
 delete, scale, cordon, uncordon, taint, drain, label, annotate, patch,
-rollout status|restart, set image, top nodes|pods, sched stats|trace|slo,
+rollout status|restart, set image, top nodes|pods, sched stats|trace|slo|top
+(top: the steady-state windowed dashboard from /debug/timeseries),
 controller stats (reconcile-loop telemetry from /debug/controlstats), vet
 (schedlint — the local static-analysis gate, no apiserver needed), wait,
 autoscale, api-resources, version.
@@ -199,13 +200,20 @@ def cmd_get(client: RESTClient, args) -> int:
 
     def stream(rv, field_selector=""):
         # kubectl get -w: the stream keeps the requested format — one JSON/
-        # YAML document or jsonpath line per event, table rows otherwise
+        # YAML document or jsonpath line per event, table rows otherwise.
+        # ring=True (ISSUE 13 satellite): a `-w` dashboard is an
+        # OBSERVABILITY consumer — a slow terminal must drop its own oldest
+        # rows, never terminate into the relist storm that stalls every
+        # bind worker behind the watch bus (the PR-11 failure mode). `ktl
+        # logs -f` keeps the eviction contract: it re-anchors on 410 and a
+        # silently ring-dropped log line would be data loss.
         try:
             for etype, obj in client.watch(
                     resource, since_rv=rv,
                     namespace=None if args.all_namespaces else ns,
                     field_selector=field_selector,
-                    label_selector=getattr(args, "selector", "") or ""):
+                    label_selector=getattr(args, "selector", "") or "",
+                    ring=True):
                 if etype == "BOOKMARK":
                     continue
                 if output == "json":
@@ -1447,6 +1455,78 @@ def _render_sched_trace(doc: Dict) -> str:
     return "\n".join(out).rstrip()
 
 
+def _render_sched_top(doc: Dict) -> str:
+    """The steady-state dashboard (ISSUE 13): per scheduler, the resource
+    sampler's header line (RSS / live objects / GC pauses / per-thread CPU
+    with the clock honesty flag) and one row per recent window — batches,
+    pods/s, key stage p99s, queue depth, breaker state, RSS."""
+    if not doc:
+        return ("no batch scheduler registered in the server process "
+                "(is the control plane running in-process?)")
+    import datetime as _dt
+
+    out = []
+    for name, st in sorted(doc.items()):
+        if "error" in st and len(st) == 1:
+            out.append(f"{name}: error: {st['error']}")
+            continue
+        out.append(f"{name}  window={st.get('window_s')}s "
+                   f"closed={st.get('windows_closed', 0)} "
+                   f"capacity={st.get('capacity', 0)}")
+        res = st.get("resource")
+        if res:
+            cpu = "  ".join(f"{k}={v:.2f}s" for k, v in sorted(
+                (res.get("thread_cpu_s") or {}).items()))
+            resolution = res.get("clock_resolution_s")
+            out.append(
+                f"resource: rss={res.get('rss_mb')}MB "
+                f"(+{res.get('rss_growth_mb')}) "
+                f"alloc_blocks={res.get('alloc_blocks')} "
+                f"(+{res.get('alloc_growth_blocks')}) "
+                f"gc_pause={res.get('gc', {}).get('pause_s', 0)}s "
+                f"overlap_cpu={res.get('overlap_cpu_s')}s"
+                + (f"  cpu: {cpu}" if cpu else "")
+                + f"  [clock={res.get('clock_source')}"
+                + (f" tick={resolution * 1e6:.1f}us"
+                   if resolution is not None else "")
+                + f" overhead={res.get('overhead_frac', 0):.2%}]")
+        windows = st.get("windows") or []
+        if not windows:
+            out.append("no closed windows yet")
+            out.append("")
+            continue
+        rows = []
+        for w in windows[-14:]:
+            stages = w.get("stages") or {}
+
+            def p99(stage):
+                v = (stages.get(stage) or {}).get("p99_ms")
+                return f"{v:.1f}" if v is not None else "-"
+
+            q = w.get("queue") or {}
+            r = w.get("resource") or {}
+            when = (_dt.datetime.fromtimestamp(w["ts"]).strftime("%H:%M:%S")
+                    if "ts" in w else "-")
+            rows.append([
+                str(w.get("seq", "-")), when,
+                str(w.get("batches", 0)),
+                f"{w.get('pods_per_sec', 0):.0f}",
+                p99("solve"), p99("assume"), p99("bind"),
+                str(q.get("active", "-")),
+                str(q.get("backoff", "-")),
+                (w.get("breaker") or {}).get("state", "-"),
+                (f"{r['rss_mb']:.1f}" if "rss_mb" in r else "-"),
+            ])
+        rows.reverse()  # newest first: the dashboard reads top-down
+        out.append(fmt_table(
+            ["WIN", "TIME", "BATCHES", "PODS/S", "SOLVE(p99ms)",
+             "ASSUME(p99ms)", "BIND(p99ms)", "ACTIVE", "BACKOFF", "BREAKER",
+             "RSS(MB)"], rows))
+        out.append("(newest window first; use -o json for every column)")
+        out.append("")
+    return "\n".join(out).rstrip()
+
+
 def _render_sched_slo(results: Dict) -> str:
     """Per-scheduler SLO verdicts: one PASS/FAIL/SKIP row per check."""
     out = []
@@ -1474,7 +1554,7 @@ def cmd_sched(client: RESTClient, args) -> int:
     sibling of `kubectl get --raw /debug/...`)."""
     import time as _time
 
-    if args.action not in ("stats", "trace", "slo"):
+    if args.action not in ("stats", "trace", "slo", "top"):
         raise CLIError(f"unknown sched action {args.action!r}")
     spec = None
     if args.action == "slo":
@@ -1489,6 +1569,16 @@ def cmd_sched(client: RESTClient, args) -> int:
             doc = client.request("GET", "/debug/schedtrace")
             rendered = (json.dumps(doc, indent=2) if args.output == "json"
                         else _render_sched_trace(doc))
+            rc = 0
+        elif args.action == "top":
+            # the steady-state dashboard (ISSUE 13): windowed time-series +
+            # resource sampler, served from /debug/timeseries. `-w` polls
+            # the debug endpoint — and any event-stream dashboards ride
+            # ring=true subscriptions (client.watch), never the
+            # terminate-relist contract
+            doc = client.request("GET", "/debug/timeseries")
+            rendered = (json.dumps(doc, indent=2) if args.output == "json"
+                        else _render_sched_top(doc))
             rc = 0
         elif args.action == "slo":
             from ..scheduler.slo import evaluate_slo
@@ -1863,7 +1953,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("sched")
-    p.add_argument("action", choices=["stats", "trace", "slo"])
+    p.add_argument("action", choices=["stats", "trace", "slo", "top"])
     p.add_argument("-o", "--output", default="table",
                    choices=["table", "json"])
     p.add_argument("-w", "--watch", action="store_true")

@@ -13,6 +13,13 @@ machinery instead of reinventing weaker copies:
   obs.reconcile  — ReconcileRecorder: per-loop reconcile spans for
                    controllers/base.py, plus the live-controller registry
                    behind GET /debug/controlstats and `ktl controller stats`.
+  obs.timeseries — TimeSeriesRecorder: fixed-interval windows over the batch
+                   pipeline (per-stage p50/p99, pods/s, probe columns) plus
+                   the fit_slope/drift_ratio trend math the leak gates in
+                   scheduler/slo.py consume (ISSUE 13).
+  obs.resource   — ResourceSampler: RSS / GC / live-object / per-thread CPU
+                   sampling with a measured-clock honesty flag — the
+                   steady-state leak and GIL-overlap signal (ISSUE 13).
 """
 
 from .recorder import (  # noqa: F401
@@ -21,3 +28,9 @@ from .recorder import (  # noqa: F401
     StageClock,
     nearest_rank,
 )
+from .timeseries import (  # noqa: F401
+    TimeSeriesRecorder,
+    drift_ratio,
+    fit_slope,
+)
+from .resource import ResourceSampler  # noqa: F401

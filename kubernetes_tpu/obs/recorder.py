@@ -103,6 +103,11 @@ class RingRecorder:
         # observing histograms, and in the timing taps. Divided by wall it
         # bounds the overhead budget from a measurement.
         self._self_s = 0.0
+        # optional TimeSeriesRecorder (obs/timeseries.py, ISSUE 13): when
+        # set, per-record stage maps and outside-bucket observations are
+        # forwarded so the windowed view covers the overlapped stages
+        # (bind, bind_wait, queue_add) the per-batch clock never sees
+        self.timeseries = None
 
     # -- ingest ----------------------------------------------------------------
 
@@ -122,6 +127,9 @@ class RingRecorder:
         with self._lock:
             self._outside[stage] = self._outside.get(stage, 0.0) + seconds
             self._hist_observe(stage, seconds)
+        ts = self.timeseries
+        if ts is not None:
+            ts.note_stage(stage, seconds)
 
     def outside_seconds(self, *stages: str) -> float:
         """Sum of the named outside buckets (the scheduler differences this

@@ -23,6 +23,7 @@ import numpy as np
 
 from ..chaos import faultinject as _chaos
 from ..chaos.faultinject import FaultKill
+from ..obs.timeseries import TimeSeriesRecorder
 from ..snapshot.tensorizer import TensorCache, build_cluster_tensors, build_pod_batch
 from ..store import (MODIFIED, APIStore, NotFoundError, is_bind_conflict,
                      pod_bind_clone, pod_structural_clone)
@@ -58,7 +59,8 @@ class BatchScheduler(Scheduler):
                  breaker_threshold: int = 3, breaker_cooldown_s: float = 30.0,
                  bind_retries: int = 3, bind_retry_base_s: float = 0.05,
                  pod_trace: Optional[bool] = None,
-                 trace_sample_k: int = PodTracer.DEFAULT_SAMPLE_K, **kw):
+                 trace_sample_k: int = PodTracer.DEFAULT_SAMPLE_K,
+                 ts_window_s: float = 5.0, **kw):
         super().__init__(store, framework, **kw)
         self.batch_size = batch_size
         self.solver = solver
@@ -82,6 +84,21 @@ class BatchScheduler(Scheduler):
             enabled=flight_recorder if pod_trace is None else pod_trace,
             stat_sink=self.flightrec)
         self.queue.trace_sink = self.podtrace
+        # windowed time-series (obs/timeseries.py, ISSUE 13): fixed-interval
+        # windows over the batch pipeline — per-stage p50/p99, pods/s, and
+        # window-close probe columns (queue depth, breaker state, watch lag,
+        # partition counters, resource sampler). ONE note_batch tap per
+        # schedule_batch; the flight recorder forwards its outside buckets
+        # (bind / bind_wait / queue_add) so overlapped stages window too.
+        # No stat_sink: its taps already run inside callers' measured
+        # self-time windows — a sink would double-bill the budget.
+        self.timeseries = TimeSeriesRecorder(
+            window_s=ts_window_s, enabled=flight_recorder)
+        self.flightrec.timeseries = self.timeseries
+        # optional obs/resource.py ResourceSampler (attach_resource_sampler):
+        # RSS / GC / live-object / per-thread CPU columns for the soak gates
+        self.resource_sampler = None
+        self._register_window_probes()
         # queue-depth/oldest-age gauge refresh throttle (satellite): the
         # telemetry scan is O(queue), so gauges update at most 1/s per pump
         self._q_telemetry_next = 0.0
@@ -274,6 +291,13 @@ class BatchScheduler(Scheduler):
                 breaker=(self.breaker.state
                          if self.breaker.state != "closed" else None),
                 error=out.get("batch_error"))
+            # windowed time-series (ISSUE 13): ONE tap per batch, inside the
+            # t_fin self-time window so its cost bills to the <2% budget
+            self.timeseries.note_batch(
+                clock.stages, pods=len(qps),
+                scheduled=out.get("dispatched", 0)
+                + out.get("serial_scheduled", 0),
+                failed=self.failed_count - failed0)
             trace.log_if_long(self.trace_threshold)
             self._update_queue_telemetry()
             fr.note_self_time(time.perf_counter() - t_fin)
@@ -1188,6 +1212,9 @@ class BatchScheduler(Scheduler):
         record (the machine-generated successor of ROADMAP's hand-maintained
         table)."""
         tel = self._update_queue_telemetry(want_dict=True)
+        # read the windows FIRST: the read settles an expired open window,
+        # and the meta counters below must describe the settled state
+        windows = self.timeseries.windows(last=12)
         gang = None
         if self.gangs is not None and self.gangs.active:
             from ..server import metrics as m
@@ -1242,8 +1269,79 @@ class BatchScheduler(Scheduler):
                          "records": len(fr),
                          "self_seconds": round(fr.self_seconds, 6)},
             "stages": fr.stage_table(),
+            # steady-state telemetry (ISSUE 13): the recent closed windows
+            # (the live feed of `ktl sched top` and the windowed SLO keys)
+            # plus the resource sampler's summary when one is attached
+            "timeseries": {
+                "enabled": self.timeseries.enabled,
+                "window_s": self.timeseries.window_s,
+                "capacity": self.timeseries.capacity,
+                "windows_closed": self.timeseries.windows_closed,
+                "self_seconds": round(self.timeseries.self_seconds, 6),
+            },
+            "windows": windows,
+            "resource": (self.resource_sampler.summary()
+                         if self.resource_sampler is not None else None),
             "last_batch": fr.last(),
         }
+
+    def _register_window_probes(self) -> None:
+        """Window-close probes (obs/timeseries.py): each runs ONCE per
+        closed window — queue depth (O(tiers), no age scan), breaker state,
+        watch-bus lag (pure read, no settlement), the partition's
+        conflict/reroute counters, and the resource sampler's latest
+        columns. Everything here is lazy: attributes constructed later in
+        __init__ (breaker) or installed later (partition_index, sampler)
+        resolve at fire time."""
+        ts = self.timeseries
+        ts.add_probe("queue", lambda: self.queue.depths())
+        ts.add_probe("breaker", lambda: {"state": self.breaker.state})
+        ts.add_probe("watch", lambda: self.store.watch_lag())
+        ts.add_probe("partition", self._partition_window_probe)
+        ts.add_probe("resource", self._resource_window_probe)
+
+    def _partition_window_probe(self) -> Optional[Dict]:
+        if self.partition_index is None:
+            return None
+        return {"index": self.partition_index,
+                "conflicts": self.partition_conflicts,
+                "reroutes": self.partition_reroutes}
+
+    def _resource_window_probe(self) -> Optional[Dict]:
+        s = self.resource_sampler
+        if s is None:
+            return None
+        last = s.latest()
+        if last is None:
+            return None
+        return {"rss_mb": last["rss_mb"],
+                "alloc_blocks": last["alloc_blocks"],
+                "gc_collections": last["gc"]["collections"],
+                "gc_pause_s": last["gc"]["pause_s"],
+                # cumulative sampler self-time at window close (difference
+                # consecutive windows for the per-window overhead)
+                "sampler_self_s": round(s.self_seconds, 6),
+                "threads": {k: v["cpu_s"]
+                            for k, v in last["threads"].items()}}
+
+    def _thread_label(self, role: str) -> str:
+        return (f"p{self.partition_index}-{role}"
+                if self.partition_index is not None else role)
+
+    def attach_resource_sampler(self, sampler) -> None:
+        """Wire an obs/resource.py ResourceSampler: the sampler's latest
+        columns join every closed window (the rss/alloc slope gates' feed),
+        and this scheduler's threads register for per-thread CPU
+        attribution — the loop thread on start(), the bind worker as it
+        spawns, both immediately when already running."""
+        self.resource_sampler = sampler
+        if sampler is not None:
+            if self._thread is not None:
+                sampler.register_thread(self._thread_label("sched"),
+                                        self._thread)
+            if self._bind_worker is not None:
+                sampler.register_thread(self._thread_label("bind"),
+                                        self._bind_worker)
 
     def _watch_summary(self) -> Dict:
         """The store watch bus seen from this scheduler (ISSUE 9): settled
@@ -1308,6 +1406,11 @@ class BatchScheduler(Scheduler):
             self._bind_worker = threading.Thread(
                 target=self._bind_loop, args=(self._bind_q,), daemon=True)
             self._bind_worker.start()
+            if self.resource_sampler is not None:
+                # re-registering the label points the CPU column at the
+                # replacement worker (a restart keeps one column)
+                self.resource_sampler.register_thread(
+                    self._thread_label("bind"), self._bind_worker)
 
     def _bind_loop(self, q: _queue.Queue) -> None:
         """SUPERVISED bind worker (ISSUE 6): _bind_cycle drains one pipelined
@@ -1715,6 +1818,9 @@ class BatchScheduler(Scheduler):
 
         self._thread = threading.Thread(target=loop, daemon=True)
         self._thread.start()
+        if self.resource_sampler is not None:
+            self.resource_sampler.register_thread(
+                self._thread_label("sched"), self._thread)
 
     def run_until_idle(self, max_cycles: int = 10_000) -> int:
         n = 0

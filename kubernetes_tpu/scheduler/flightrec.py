@@ -171,6 +171,32 @@ def schedstats_snapshot() -> Dict[str, Dict]:
     return out
 
 
+def timeseries_snapshot() -> Dict[str, Dict]:
+    """{scheduler name: windowed time-series + resource summary} over every
+    live registered scheduler — what GET /debug/timeseries and `ktl sched
+    top` serve (obs/timeseries.py, ISSUE 13)."""
+    with _registry_lock:
+        live = dict(_schedulers)
+    out = {}
+    for name, sched in live.items():
+        ts = getattr(sched, "timeseries", None)
+        if ts is None:
+            continue
+        try:
+            sampler = getattr(sched, "resource_sampler", None)
+            out[name] = {
+                "window_s": ts.window_s,
+                "capacity": ts.capacity,
+                "windows_closed": ts.windows_closed,
+                "windows": ts.windows(),
+                "resource": (sampler.summary()
+                             if sampler is not None else None),
+            }
+        except Exception as e:  # same wedge-tolerance as schedstats
+            out[name] = {"error": str(e)}
+    return out
+
+
 def schedtrace_snapshot() -> Dict[str, Dict]:
     """{scheduler name: podtrace snapshot} over every live registered
     scheduler — the sampled pod lifecycle spans GET /debug/schedtrace and

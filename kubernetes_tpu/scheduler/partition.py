@@ -481,6 +481,15 @@ class PartitionedScheduler:
             p.queue.flush_backoff_completed()
             p.queue.move_all_to_active_or_backoff()
 
+    def attach_resource_sampler(self, sampler) -> None:
+        """Forward an obs/resource.py ResourceSampler to every pipeline
+        (ISSUE 13): each partition's windows grow resource columns and its
+        sched/bind threads register under partition-labeled names
+        (p0-sched, p1-bind, ...) so the per-thread CPU attribution can
+        judge the partition A/B when the rig has real cores."""
+        for p in self._members():
+            p.attach_resource_sampler(sampler)
+
     def take_bind_failures(self) -> List:
         out: List = []
         for p in self._members():
@@ -521,6 +530,13 @@ class PartitionedScheduler:
                     for i in alive]
                 for t in threads:
                     t.start()
+                for idx, t in zip(alive, threads):
+                    # per-thread CPU attribution (ISSUE 13): this round's
+                    # drive thread IS the partition's scheduling thread;
+                    # re-registration points the column at the live thread
+                    sam = self.pipelines[idx].resource_sampler
+                    if sam is not None:
+                        sam.register_thread(f"p{idx}-sched", t)
                 for t in threads:
                     t.join()
             else:

@@ -628,6 +628,17 @@ class SchedulingQueue:
         with self._lock:
             return sum(len(s) for s in self._gang_staging.values())
 
+    def depths(self) -> Dict[str, int]:
+        """Per-tier depth dict WITHOUT the O(queue) oldest-age scan
+        telemetry() pays — the window-close probe (obs/timeseries.py) reads
+        this every few seconds unthrottled, so it must stay O(tiers)."""
+        with self._lock:
+            return {"active": len(self._active),
+                    "backoff": len(self._backoff),
+                    "unschedulable": len(self._unschedulable),
+                    "gang_staged": sum(len(s)
+                                       for s in self._gang_staging.values())}
+
     def telemetry(self) -> Dict[str, float]:
         """Queue depth by tier plus the age of the oldest pod still waiting
         anywhere (first-admission time, so a pod cycling through backoff

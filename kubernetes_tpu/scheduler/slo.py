@@ -36,6 +36,30 @@ a file for `ktl sched slo --spec`):
   instrumentation_frac   recorder+tracer self-time / wall ceiling (the <2%
                          budget as a first-class SLO; also `extra`-supplied).
 
+Steady-state trend/leak gates (ISSUE 13) — these consume the "windows"
+section (obs/timeseries.py window records, each carrying per-stage p50/p99
+and the resource-sampler probe columns), so they see the SHAPE of a run over
+time where the whole-run keys above only see its aggregate:
+
+  stage_p99_ms_per_window  per-stage ceiling checked against EVERY window's
+                         p99 (actual = the worst window) — a single stalled
+                         window fails even when the whole-run p99 absorbs it.
+  rss_slope_mb_per_min   least-squares slope over the windows' rss_mb in
+                         MB/minute — the heap-pin detector (the PR-11
+                         parked-bind-worker class). FAILS on sustained
+                         growth; a flat-but-high RSS passes (capacity is a
+                         different spec).
+  alloc_block_slope_per_s  slope over sys.getallocatedblocks() per second —
+                         the deterministic live-OBJECT leak signal (RSS is
+                         allocator-noisy; leaked objects always grow this).
+  p99_drift_ratio        worst over stages of median(last third of window
+                         p99s) / median(first third) — "is the tail creeping
+                         under steady load". Sub-millisecond stages are
+                         excluded (pure noise); monotonic growth reads >1.
+
+Trend checks SKIP (reported, never silently passed) under
+TREND_MIN_WINDOWS windows — a slope over two points is an opinion.
+
 evaluate_slo() consumes a sched_stats()-shaped payload (the /debug/schedstats
 document, or the dict bench.py assembles) and returns
 {"pass", "checks": [{name, limit, actual, ok}], "failed", "skipped"} where
@@ -90,17 +114,86 @@ CONTROL_PLANE_SLO: Dict = {
     "reconcile_p99_ms": 2000.0,
 }
 
+# The NorthStar_1M soak gate (ISSUE 13): sustained create/bind/delete churn
+# at steady state. The windowed keys assert the run's SHAPE — no stalled
+# window, no monotonic RSS/live-object growth, no creeping tail — with
+# ceilings sized for the noisy co-scheduled CI rig (order-of-magnitude
+# detectors; the leak fixture in tests/test_timeseries.py proves they bite).
+# bench.py quick mode loosens the slope ceilings: a time-compressed run
+# divides the same absolute noise by a much shorter baseline.
+SOAK_SLO: Dict = {
+    "stage_p99_ms_per_window": {
+        "solve": 8000.0,
+        "assume": 6000.0,
+        "bind": 8000.0,
+    },
+    "rss_slope_mb_per_min": 30.0,
+    "alloc_block_slope_per_s": 100_000.0,
+    "p99_drift_ratio": 10.0,
+}
+
+# a trend over fewer windows than this is a SKIP, not a verdict
+TREND_MIN_WINDOWS = 4
+# stages whose first-third median p99 sits under this are excluded from the
+# drift check — a 0.02ms dispatch stage doubling is noise, not a regression
+DRIFT_FLOOR_MS = 1.0
+
 # what `ktl sched slo` checks when no --spec file is given
 DEFAULT_SLO = NORTH_STAR_SLO
 
 KNOWN_SPEC_KEYS = frozenset((
     "stage_p99_ms", "submit_to_bound_p99_s", "solver_compiles",
-    "instrumentation_frac", "watch_propagation_p99_s", "reconcile_p99_ms"))
+    "instrumentation_frac", "watch_propagation_p99_s", "reconcile_p99_ms",
+    "stage_p99_ms_per_window", "rss_slope_mb_per_min",
+    "alloc_block_slope_per_s", "p99_drift_ratio"))
 
 
 def load_slo_spec(path: str) -> Dict:
     with open(path) as f:
         return json.load(f)
+
+
+def _trend_checks(windows: List[Dict], spec: Dict, checks: List[Dict]) -> None:
+    """The steady-state gates (ISSUE 13) over the "windows" section."""
+    from ..obs.timeseries import drift_ratio, extract_series, fit_slope
+
+    for stage, limit in sorted(
+            (spec.get("stage_p99_ms_per_window") or {}).items()):
+        pts = extract_series(windows, "stages", stage, "p99_ms")
+        worst = max((v for _t, v in pts), default=None)
+        checks.append(_check(f"stage_p99_ms_per_window:{stage}", limit,
+                             worst))
+    if "rss_slope_mb_per_min" in spec:
+        pts = extract_series(windows, "resource", "rss_mb")
+        slope = (fit_slope(pts) if len(pts) >= TREND_MIN_WINDOWS else None)
+        checks.append(_check(
+            "rss_slope_mb_per_min", spec["rss_slope_mb_per_min"],
+            slope * 60.0 if slope is not None else None))
+    if "alloc_block_slope_per_s" in spec:
+        pts = extract_series(windows, "resource", "alloc_blocks")
+        slope = (fit_slope(pts) if len(pts) >= TREND_MIN_WINDOWS else None)
+        checks.append(_check(
+            "alloc_block_slope_per_s", spec["alloc_block_slope_per_s"],
+            slope))
+    if "p99_drift_ratio" in spec:
+        stages = sorted({s for rec in windows
+                         for s in (rec.get("stages") or {})})
+        worst = None
+        if len(windows) >= TREND_MIN_WINDOWS:
+            for stage in stages:
+                vals = [v for _t, v in
+                        extract_series(windows, "stages", stage, "p99_ms")]
+                if len(vals) < TREND_MIN_WINDOWS:
+                    continue
+                third = max(1, len(vals) // 3)
+                head = sorted(vals[:third])
+                if head[len(head) // 2] < DRIFT_FLOOR_MS:
+                    continue  # sub-ms stage: drift is noise, not regression
+                d = drift_ratio(vals)
+                if d is not None and (worst is None or d > worst):
+                    worst = d
+        checks.append(_check("p99_drift_ratio", spec["p99_drift_ratio"],
+                             worst))
 
 
 def _check(name: str, limit, actual) -> Dict:
@@ -148,6 +241,10 @@ def evaluate_slo(stats: Dict, spec: Dict,
         rec = stats.get("reconcile") or {}
         checks.append(_check("reconcile_p99_ms", spec["reconcile_p99_ms"],
                              rec.get("p99_ms")))
+    if ("stage_p99_ms_per_window" in spec or "rss_slope_mb_per_min" in spec
+            or "alloc_block_slope_per_s" in spec
+            or "p99_drift_ratio" in spec):
+        _trend_checks(stats.get("windows") or [], spec, checks)
     if "solver_compiles" in spec:
         checks.append(_check("solver_compiles", spec["solver_compiles"],
                              extra.get("solver_compiles")))

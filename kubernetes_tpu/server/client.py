@@ -249,16 +249,26 @@ class RESTClient:
               namespace: Optional[str] = None,
               field_selector: str = "",
               label_selector: str = "",
-              send_initial_events: bool = False) -> Iterator[Tuple[str, Dict]]:
+              send_initial_events: bool = False,
+              ring: bool = False) -> Iterator[Tuple[str, Dict]]:
         """Yields (event_type, object_dict); blocks on the streaming
         response. send_initial_events=True is the WatchList mode
         (KEP-3157): current objects stream first as ADDED, then a BOOKMARK
-        annotated k8s.io/initial-events-end, then live events."""
+        annotated k8s.io/initial-events-end, then live events.
+
+        ring=True subscribes through a lossy ring buffer (`?ring=true`,
+        ISSUE 12/13): a slow consumer's overflow drops its own oldest
+        delivery instead of terminating the subscription into a relist
+        storm. OBSERVABILITY consumers (dashboards, `ktl ... -w`) must pass
+        it; cache-building consumers (Informer) must not — they need the
+        eviction/terminate contract to know they missed events."""
         from urllib.parse import quote
 
         path = self._path(resource, namespace) + f"?watch=true&resourceVersion={since_rv}"
         if send_initial_events:
             path += "&sendInitialEvents=true"
+        if ring:
+            path += "&ring=true"
         if field_selector:
             path += f"&fieldSelector={quote(field_selector)}"
         if label_selector:

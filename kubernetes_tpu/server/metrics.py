@@ -467,6 +467,55 @@ controller_workqueue_depth = global_registry.gauge_func(
     "Dirty keys awaiting reconcile per live controller (read at render time)",
     fn=_controller_depth_samples)
 
+# steady-state resource telemetry (ISSUE 13): read from live
+# obs/resource.py samplers at render time (the GaugeFunc pattern — the
+# sampler thread owns the cadence, /metrics just reads the latest sample)
+
+
+def _resource_samples(field):
+    from ..obs.resource import live_samplers
+
+    out = []
+    for s in live_samplers():
+        last = s.latest()
+        if last is not None and last.get(field) is not None:
+            # the sampler label keeps concurrent samplers' series distinct
+            # (duplicate identical label sets are invalid exposition)
+            out.append(({"sampler": s.id}, float(last[field])))
+    return out
+
+
+process_rss_mb = global_registry.gauge_func(
+    "process_resident_memory_megabytes",
+    "Resident set size from the resource sampler's latest sample",
+    fn=lambda: _resource_samples("rss_mb"))
+process_alloc_blocks = global_registry.gauge_func(
+    "process_allocated_blocks",
+    "sys.getallocatedblocks() from the resource sampler's latest sample "
+    "(the deterministic live-object leak signal)",
+    fn=lambda: _resource_samples("alloc_blocks"))
+
+
+def _thread_cpu_samples():
+    from ..obs.resource import live_samplers
+
+    out = []
+    for s in live_samplers():
+        last = s.latest()
+        if last is None:
+            continue
+        for name, t in last.get("threads", {}).items():
+            out.append(({"sampler": s.id, "thread": name},
+                        float(t["cpu_s"])))
+    return out
+
+
+scheduler_thread_cpu = global_registry.gauge_func(
+    "scheduler_thread_cpu_seconds",
+    "Per-registered-thread CPU seconds (sched/bind/partition threads; "
+    "clock source published by the sampler's honesty flag)",
+    fn=_thread_cpu_samples)
+
 # constraint propose-and-repair observability (ISSUE 8): repair-round count
 # per constrained batch (a distribution pinned at the REPAIR_MAX_ROUNDS
 # bound means the repair loop is thrashing and the residual scan is doing

@@ -393,7 +393,7 @@ class _Handler(BaseHTTPRequestHandler):
                  "PATCH": "patch", "DELETE": "delete"}
     _FC_EXEMPT_PATHS = ("/healthz", "/readyz", "/metrics", "/version",
                         "/configz", "/debug/schedstats", "/debug/schedtrace",
-                        "/debug/controlstats")
+                        "/debug/controlstats", "/debug/timeseries")
 
     def _flow_dispatch(self, orig: "Callable[[], None]") -> None:
         """Seat-accounted dispatch. Health/metrics always pass (the probe
@@ -664,6 +664,20 @@ class _Handler(BaseHTTPRequestHandler):
             from ..scheduler.flightrec import schedtrace_snapshot
 
             body = json.dumps(schedtrace_snapshot(), default=str).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if url.path == "/debug/timeseries":
+            # steady-state telemetry (ISSUE 13): windowed time-series +
+            # resource-sampler summary of every live batch scheduler — what
+            # `ktl sched top` renders. Same read-only debug family as
+            # /debug/schedstats.
+            from ..scheduler.flightrec import timeseries_snapshot
+
+            body = json.dumps(timeseries_snapshot(), default=str).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

@@ -606,7 +606,8 @@ class APIStore:
                  lazy_pod_events: Optional[bool] = None,
                  lock_order_check: Optional[bool] = None,
                  watch_propagation: bool = True,
-                 native_commit: Optional[bool] = None):
+                 native_commit: Optional[bool] = None,
+                 history_limit: int = 200_000):
         import os
 
         if lock_order_check is None:
@@ -648,9 +649,15 @@ class APIStore:
         # kind -> {"namespace/name" or "name": obj}. The pods row dict exists
         # from birth so shard-only paths never mutate the kind map.
         self._objects: Dict[str, Dict[str, Any]] = {"pods": {}}
-        # bounded event history for watch replay (RV-ordered)
+        # bounded event history for watch replay (RV-ordered). The bound is
+        # the store's steady-state memory knob (ISSUE 13): each retained
+        # event pins an object clone, so a churning control plane holds
+        # ~history_limit x pod-size bytes HERE at equilibrium — the
+        # NorthStar_1M soak rung sizes it to a few churn waves (a resume
+        # older than the floor relists, the contract subscribers already
+        # handle) and the rss/alloc trend gates verify the plateau.
         self._history: List[Event] = []
-        self._history_limit = 200_000
+        self._history_limit = history_limit
         # all events with rv > _history_floor_rv are retained
         self._history_floor_rv = 0
         self._watchers: List[Watch] = []
@@ -1212,6 +1219,19 @@ class APIStore:
                  "last_delivered_rv": w.last_delivered_rv,
                  "rv_lag": max(0, rv - w.last_delivered_rv)}
                 for w in watchers]
+
+    def watch_lag(self) -> Dict:
+        """Subscriber count + worst delivered-RV lag as a PURE O(subscribers)
+        read — no propagation-op settlement, no distribution construction.
+        The window-close probe (obs/timeseries.py, ISSUE 13) calls this every
+        few seconds; settlement stays owned by the surfaces that publish
+        distributions (watch_telemetry / the /metrics gauges)."""
+        with self._lock:
+            watchers = list(self._watchers)
+            rv = self._rv
+        return {"subscribers": len(watchers),
+                "max_rv_lag": max((max(0, rv - w.last_delivered_rv)
+                                   for w in watchers), default=0)}
 
     def watch_telemetry(self) -> Dict:
         """Per-subscriber watch-bus state (ISSUE 7 satellite; propagation +

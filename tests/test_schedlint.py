@@ -12,6 +12,8 @@ Three layers:
 import os
 import time
 
+import pytest
+
 from kubernetes_tpu.analysis.schedlint import (
     analyze_source,
     package_root,
@@ -701,6 +703,51 @@ def test_hp001_fires_on_per_key_reconcile_instrumentation():
 def test_hp001_quiet_on_per_loop_reconcile_taps():
     assert "HP001" not in rules_of(
         analyze_source(HP001_CONTROLLER_GOOD, filename=_CTRL))
+
+
+# ISSUE 13: the steady-state telemetry files (obs/timeseries.py,
+# obs/resource.py) are HP001 hot paths — their contract is one tap per
+# WINDOW close / per SAMPLE tick. Someone "improving accuracy" by feeding
+# the window per pod inside a pod-scale loop is the 100k multiplier bug.
+
+HP001_OBS_BAD = '''
+import time
+
+def note_batch_per_pod(self, qps, m):
+    for qp in qps:
+        t0 = time.perf_counter()
+        self._fold(qp)
+        m.batch_stage_duration.observe(time.perf_counter() - t0, "pod")
+'''
+
+HP001_OBS_GOOD = '''
+import time
+
+def note_batch(self, stages, qps):
+    t0 = time.perf_counter()
+    with self._lock:
+        w = self._advance_locked(t0)
+        for name, sec in stages.items():
+            w.stage_samples.setdefault(name, []).append(sec)
+        w.pods += len(qps)
+    self._bill(time.perf_counter() - t0)
+'''
+
+
+@pytest.mark.parametrize("hot", ["kubernetes_tpu/obs/timeseries.py",
+                                 "kubernetes_tpu/obs/resource.py"])
+def test_hp001_fires_on_per_pod_window_feed(hot):
+    findings = [f for f in analyze_source(HP001_OBS_BAD, filename=hot)
+                if f.rule == "HP001"]
+    assert len(findings) >= 2, findings
+
+
+def test_hp001_quiet_on_per_window_taps():
+    assert "HP001" not in rules_of(analyze_source(
+        HP001_OBS_GOOD, filename="kubernetes_tpu/obs/timeseries.py"))
+    # the identical bad code OUTSIDE the hot files stays out of scope
+    assert "HP001" not in rules_of(analyze_source(
+        HP001_OBS_BAD, filename="kubernetes_tpu/obs/recorder.py"))
 
 
 def test_hp001_controller_scope_is_base_py_only():
