@@ -855,6 +855,7 @@ def _solver_jit_cache():
     Stable counts across same-bucket batches = the cache is hot; a growing
     count is retrace churn (tens of seconds per compile at TPU scale).
     -1 when the introspection API is unavailable."""
+    from kubernetes_tpu.models.gangcover import cover_curve, rank_align_kernel
     from kubernetes_tpu.models.repair import repair_check
     from kubernetes_tpu.models.transport import _auction_phase, _sinkhorn_iters
     from kubernetes_tpu.models.waterfill import waterfill_group
@@ -865,7 +866,9 @@ def _solver_jit_cache():
                      ("greedy_scan_solve", greedy_scan_solve),
                      ("repair_check", repair_check),
                      ("auction_phase", _auction_phase),
-                     ("sinkhorn_iters", _sinkhorn_iters)):
+                     ("sinkhorn_iters", _sinkhorn_iters),
+                     ("cover_curve", cover_curve),
+                     ("rank_align_kernel", rank_align_kernel)):
         try:
             out[name] = int(fn._cache_size())
         except Exception:
@@ -1230,10 +1233,43 @@ def rung_bind_commit(results):
         print(f"BindCommit_20k: ERROR {e}", file=sys.stderr)
 
 
+def _gang_adjacency(store, sched):
+    """Placement-quality column (ISSUE 14): mean intra-gang neighbor ring
+    distance of the BOUND members, measured from the STORE (labels + node
+    topology), independent of the scheduler's own stats."""
+    from kubernetes_tpu.api.podgroup import pod_gang_rank, pod_group_key
+    from kubernetes_tpu.models.gangcover import mean_neighbor_distance
+    from kubernetes_tpu.scheduler.gang import node_slice_positions, \
+        ring_lengths
+    from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors
+
+    cl = build_cluster_tensors(sched.cache.update_snapshot())
+    slice_ids, pos = node_slice_positions(cl)
+    if slice_ids is None:
+        return None
+    node_idx = {n: i for i, n in enumerate(cl.node_names)}
+    gids, groups, ranks, slices, poss = {}, [], [], [], []
+    for p in store.list("pods")[0]:
+        g = pod_group_key(p)
+        if not g or not p.spec.node_name:
+            continue
+        ni = node_idx[p.spec.node_name]
+        gids.setdefault(g, len(gids))
+        groups.append(gids[g])
+        ranks.append(pod_gang_rank(p))
+        slices.append(int(slice_ids[ni]))
+        poss.append(int(pos[ni]))
+    return mean_neighbor_distance(groups, ranks, slices, poss,
+                                  ring_lengths(slice_ids, pos))
+
+
 def rung_gang(results):
-    """GangScheduling_2k_250: 8 PodGroups x 250 members bound end-to-end —
-    store ingest, queue gang staging, the all-or-nothing veto, slice-packing
-    score, and batched binds all inside the timed window. Fixed-size (no
+    """GangScheduling_2k_250: 8 PodGroups x 250 RANKED members bound
+    end-to-end — store ingest, queue gang staging, the all-or-nothing veto,
+    slice-packing score, rank alignment, and batched binds all inside the
+    timed window. Publishes the adjacency placement-quality column (ISSUE
+    14): mean intra-gang neighbor ring distance, rank-aligned vs the
+    rank-blind baseline (same workload, rank_align=False). Fixed-size (no
     SMOKE shrink): the rung IS the quick-tier gang smoke and 2k pods solves
     in a few seconds on the CPU rig."""
     from kubernetes_tpu.scheduler import Framework
@@ -1246,21 +1282,23 @@ def rung_gang(results):
         n_gangs, members, n_nodes, n_slices = 8, 250, 256, 4
 
         def gang_nodes():
-            return [MakeNode(f"node-{i}").tpu_slice(i % n_slices)
+            return [MakeNode(f"node-{i}")
+                    .tpu_slice(i % n_slices, index=i // n_slices)
                     .capacity({"cpu": "16", "memory": "64Gi",
                                "pods": "110"}).obj() for i in range(n_nodes)]
 
         def gang_pods():
-            return [MakePod(f"gp-{g}-{i}").gang(f"train-{g}")
+            return [MakePod(f"gp-{g}-{i}").gang(f"train-{g}", rank=i)
                     .req({"cpu": "500m", "memory": "1Gi"}).obj()
                     for g in range(n_gangs) for i in range(members)]
 
-        def run_once():
+        def run_once(rank_align=True):
             store = APIStore()
             for n in gang_nodes():
                 store.create("nodes", n)
             sched = BatchScheduler(store, Framework(default_plugins()),
-                                   batch_size=4096, solver="fast")
+                                   batch_size=4096, solver="fast",
+                                   rank_align=rank_align)
             sched.sync()
             for g in range(n_gangs):
                 store.create("podgroups", make_pod_group(f"train-{g}", members))
@@ -1270,8 +1308,16 @@ def rung_gang(results):
             dt = time.perf_counter() - t0
             return sched, store, dt
 
-        run_once()  # warm-up: compile at the real shapes
+        wsched, _wstore, _wdt = run_once()  # warm-up: compile at real shapes
+        wsched.stop()  # release the bind worker (PR 11 discard hygiene)
         sched, store, dt = run_once()
+        adjacency = _gang_adjacency(store, sched)
+        # rank-blind baseline: the SAME workload with the alignment pass off
+        # — what greedy water-filling alone gives consecutive ranks
+        bsched, bstore, _bdt = run_once(rank_align=False)
+        adjacency_blind = _gang_adjacency(bstore, bsched)
+        bsched.stop()
+        sched.stop()
         n_pods = n_gangs * members
         bound = sched.scheduled_count
         pps = bound / dt if dt > 0 else 0.0
@@ -1279,13 +1325,170 @@ def rung_gang(results):
             "pods_per_sec": round(pps, 1), "wall_s": round(dt, 3),
             "placed": bound, "pods": n_pods, "gangs": n_gangs,
             "gang_vetoes": sched.gang_vetoes,
-            "solver": "fast+gang+store-binds"}
+            "adjacency": {
+                "mean_neighbor_distance": (round(adjacency, 3)
+                                           if adjacency is not None
+                                           else None),
+                "mean_neighbor_distance_rank_blind": (
+                    round(adjacency_blind, 3)
+                    if adjacency_blind is not None else None),
+                "placed_rank_blind": bsched.scheduled_count,
+            },
+            "solver": "fast+gang+rank-align+store-binds"}
         print(f"{'GangScheduling_2k_250':>28}: {pps:>9.0f} pods/s  "
               f"({bound}/{n_pods} bound in {n_gangs} gangs, "
-              f"{sched.gang_vetoes} vetoes, {dt:.3f}s)", file=sys.stderr)
+              f"{sched.gang_vetoes} vetoes, adjacency "
+              f"{adjacency if adjacency is None else round(adjacency, 3)} vs "
+              f"rank-blind "
+              f"{adjacency_blind if adjacency_blind is None else round(adjacency_blind, 3)}, "
+              f"{dt:.3f}s)", file=sys.stderr)
     except Exception as e:
         results["GangScheduling_2k_250"] = {"error": str(e)[:200]}
         print(f"GangScheduling_2k_250: ERROR {e}", file=sys.stderr)
+
+
+def rung_gang_preempt(results):
+    """GangPreemption (ISSUE 14): the victim-cover rung, quick tier. A
+    2-slice cluster full of low-priority fillers takes a high-priority gang
+    that cannot fit anywhere: the preemptor must select the MIN-COST victim
+    set whose release fits the entire quorum on one slice (6 of 8 fillers,
+    not all 8), delete it through the batched store path, park the gang,
+    and place it WHOLE on release — inside a bounded wall with zero mid-run
+    solver compiles. A second, larger gang has only PARTIAL room on every
+    slice: it must be vetoed with a narrated event and ZERO further
+    evictions. Pod conservation asserted over both gangs."""
+    from kubernetes_tpu.scheduler import Framework
+    from kubernetes_tpu.scheduler.batch import BatchScheduler
+    from kubernetes_tpu.scheduler.plugins import default_plugins
+    from kubernetes_tpu.store import APIStore
+    from kubernetes_tpu.testing import (MakeNode, MakePod, make_pod_group,
+                                        pod_conservation_report)
+
+    try:
+        n_slices, per_slice = 2, 8
+        gang_n, big_n = 12, 40  # 12 fits one slice after 6 evictions; 40 never
+
+        def build():
+            store = APIStore()
+            for s in range(n_slices):
+                for i in range(per_slice):
+                    store.create("nodes", MakeNode(f"node-{s}-{i}")
+                                 .tpu_slice(s, index=i)
+                                 .capacity({"cpu": "8", "memory": "32Gi",
+                                            "pods": "110"}).obj())
+            for s in range(n_slices):
+                for i in range(per_slice):
+                    low = MakePod(f"low-{s}-{i}").priority(1).req(
+                        {"cpu": "6"}).obj()
+                    low.spec.node_name = f"node-{s}-{i}"
+                    store.create("pods", low)
+            sched = BatchScheduler(store, Framework(default_plugins()),
+                                   batch_size=1024, solver="fast",
+                                   pod_initial_backoff=0.05,
+                                   pod_max_backoff=0.2)
+            sched.sync()
+            return store, sched
+
+        def gang_pods(name, n):
+            return [MakePod(f"{name}-{i}").gang(name, rank=i).priority(100)
+                    .req({"cpu": "3"}).obj() for i in range(n)]
+
+        def drive(store, sched, prefix, want, deadline_s):
+            bound = 0
+            deadline = time.perf_counter() + deadline_s
+            while time.perf_counter() < deadline:
+                sched.run_until_idle()
+                sched.queue.flush_backoff_completed()
+                sched.pump_events()
+                bound = sum(1 for p in store.list("pods")[0]
+                            if p.metadata.name.startswith(f"{prefix}-")
+                            and p.spec.node_name)
+                if bound >= want:
+                    return bound
+                time.sleep(0.02)
+            return bound
+
+        def run_once():
+            store, sched = build()
+            store.create("podgroups", make_pod_group("gp", gang_n))
+            pods = gang_pods("gp", gang_n)
+            store.create_many("pods", pods, consume=True)
+            t0 = time.perf_counter()
+            bound = drive(store, sched, "gp", gang_n,
+                          20.0 if SMOKE else 60.0)
+            dt = time.perf_counter() - t0
+            return store, sched, pods, bound, dt
+
+        # warm-up: compile the cover/alignment kernels at the run's shapes
+        _wst, wsched, _wp, _wb, _wdt = run_once()
+        wsched.stop()
+        compiles0 = _solver_jit_cache()
+        store, sched, pods, bound, dt = run_once()
+        # watermark read HERE: the veto leg below runs new shapes (a
+        # 40-member alignment axis) by design — the zero-compile claim is
+        # about the preemption run the warm-up covered
+        compiles = sum(v - compiles0.get(k, 0)
+                       for k, v in _solver_jit_cache().items() if v >= 0)
+        stats = sched.gangpreempt.stats()
+        fillers_left = sorted(p.metadata.name for p in store.list("pods")[0]
+                              if p.metadata.name.startswith("low-"))
+        slices_used = {n.spec.node_name.split("-")[1]
+                       for n in store.list("pods")[0]
+                       if n.metadata.name.startswith("gp-")
+                       and n.spec.node_name}
+        adjacency = _gang_adjacency(store, sched)
+        rep = pod_conservation_report(store, sched, [p.key for p in pods])
+
+        # --- partial-room leg: a gang NO slice can host even after evicting
+        # every remaining filler — vetoed, narrated, zero evictions
+        pods_before = len(store.list("pods")[0])
+        store.create("podgroups", make_pod_group("big", big_n))
+        big = gang_pods("big", big_n)
+        store.create_many("pods", big, consume=True)
+        sched.run_until_idle()
+        sched.pump_events()
+        veto_stats = sched.gangpreempt.stats()
+        big_bound = sum(1 for p in store.list("pods")[0]
+                        if p.metadata.name.startswith("big-")
+                        and p.spec.node_name)
+        evictions_after_veto = (pods_before + big_n
+                                - len(store.list("pods")[0]))
+        veto_events = sum(1 for e in store.list("events")[0]
+                          if (e.reason or "") == "GangPreemptionVetoed")
+        rep_big = pod_conservation_report(
+            store, sched, [p.key for p in pods + big])
+        sched.stop()
+        c = rep["counts"]
+        ok = (bound == gang_n and len(slices_used) == 1
+              and stats["preempted"] == 1 and stats["victims"] == 6
+              and len(fillers_left) == per_slice * n_slices - 6
+              and c["lost"] == 0 and c["double_bound"] == 0
+              and big_bound == 0 and evictions_after_veto == 0
+              and veto_stats["vetoed_partial"] >= 1 and veto_events >= 1
+              and rep_big["counts"]["lost"] == 0
+              and rep_big["counts"]["double_bound"] == 0)
+        results["GangPreemption"] = {
+            "wall_s": round(dt, 3), "placed": bound, "pods": gang_n,
+            "victims": stats["victims"],
+            "cover_cost": stats["cover_cost"],
+            "slices_ripped": stats["slices_ripped"],
+            "vetoed_partial": veto_stats["vetoed_partial"],
+            "veto_evictions": evictions_after_veto,
+            "veto_narrated": veto_events,
+            "adjacency_mean_neighbor_distance": (
+                round(adjacency, 3) if adjacency is not None else None),
+            "conservation": c, "conservation_ok": ok,
+            "solver_compiles_during_run": compiles,
+            "preempt_ok": ok,
+            "solver": "fast+gang-preempt+victim-cover"}
+        print(f"{'GangPreemption':>28}: {bound}/{gang_n} placed whole via "
+              f"{stats['victims']}-victim cover in {dt:.3f}s "
+              f"(cost {stats['cover_cost']}, compiles={compiles}; "
+              f"partial-room gang vetoed: {veto_stats['vetoed_partial']} "
+              f"veto(s), {evictions_after_veto} evictions)", file=sys.stderr)
+    except Exception as e:
+        results["GangPreemption"] = {"error": str(e)[:200]}
+        print(f"GangPreemption: ERROR {e}", file=sys.stderr)
 
 
 def rung_chaos_churn(results):
@@ -1516,6 +1719,96 @@ def rung_chaos_churn(results):
         except Exception as e:  # the leg must not void the main chaos run
             fi.disarm()
             pk = {"error": str(e)[:200]}
+        # --- gang-preemption leg (ISSUE 14 satellite): a victim cover under
+        # injected bind + native-commit faults AND a mid-run bind-worker
+        # kill. The invariants: pod conservation clean over gang AND
+        # surviving fillers, the gang is never half-bound (0 or all), and a
+        # cover never half-fires without the gang eventually landing whole.
+        gp = {}
+        try:
+            from kubernetes_tpu.testing import MakeNode, make_pod_group
+
+            gstore = APIStore()
+            for s in range(2):
+                for i in range(8):
+                    gstore.create("nodes", MakeNode(f"node-{s}-{i}")
+                                  .tpu_slice(s, index=i)
+                                  .capacity({"cpu": "8", "memory": "32Gi",
+                                             "pods": "110"}).obj())
+            filler_keys = []
+            for s in range(2):
+                for i in range(8):
+                    low = MakePod(f"low-{s}-{i}").priority(1).req(
+                        {"cpu": "6"}).obj()
+                    low.spec.node_name = f"node-{s}-{i}"
+                    gstore.create("pods", low)
+                    filler_keys.append(low.key)
+            gsched = BatchScheduler(
+                gstore, Framework(default_plugins()), batch_size=1024,
+                solver="fast", breaker_threshold=3, breaker_cooldown_s=0.5,
+                bind_retry_base_s=0.01,
+                pod_initial_backoff=0.05, pod_max_backoff=0.2)
+            gsched.bind_chunk = 4
+            gsched.sync()
+            gstore.create("podgroups", make_pod_group("cg", 12))
+            gpods = [MakePod(f"cg-{i}").gang("cg", rank=i).priority(100)
+                     .req({"cpu": "3"}).obj() for i in range(12)]
+            gplans = [fi.FaultPlan("store.bind_many", "rate", rate=0.25,
+                                   seed=77),
+                      fi.FaultPlan("bind.worker", "kill", after=1)]
+            if native_leg:
+                gplans.append(fi.FaultPlan("native.commit", "fail", count=2))
+            fi.arm(gplans)
+            t0g = time.perf_counter()
+            deadline_g = t0g + (25.0 if SMOKE else 90.0)
+            gbound = 0
+            try:
+                gstore.create_many("pods", gpods, consume=True)
+                while time.perf_counter() < deadline_g:
+                    gsched.run_until_idle()
+                    gsched.queue.flush_backoff_completed()
+                    gsched.pump_events()
+                    gbound = sum(1 for p in gstore.list("pods")[0]
+                                 if p.metadata.name.startswith("cg-")
+                                 and p.spec.node_name)
+                    if gbound >= 12:
+                        break
+                    time.sleep(0.02)
+            finally:
+                fi.disarm()
+            # settle to quiescence with the injector gone
+            for _ in range(40):
+                gsched.run_until_idle()
+                gsched.queue.flush_backoff_completed()
+                gsched.pump_events()
+                gbound = sum(1 for p in gstore.list("pods")[0]
+                             if p.metadata.name.startswith("cg-")
+                             and p.spec.node_name)
+                if gbound >= 12:
+                    break
+                time.sleep(0.05)
+            gstats = gsched.gangpreempt.stats()
+            # conservation over the gang + every filler the cover did NOT
+            # delete (a deleted victim is the cover's documented outcome)
+            live_fillers = [k for k in filler_keys
+                            if any(p.key == k
+                                   for p in gstore.list("pods")[0])]
+            grep_ = pod_conservation_report(
+                gstore, gsched, [p.key for p in gpods] + live_fillers)
+            gc_ = grep_["counts"]
+            gsched.stop()
+            gp = {"pods": 12, "bound": gbound,
+                  "lost": gc_["lost"], "double_bound": gc_["double_bound"],
+                  "preempted": gstats["preempted"],
+                  "victims": gstats["victims"],
+                  "expired_covers": gstats["expired"],
+                  "wall_s": round(time.perf_counter() - t0g, 3),
+                  "ok": (gbound == 12 and gc_["lost"] == 0
+                         and gc_["double_bound"] == 0
+                         and gstats["preempted"] >= 1)}
+        except Exception as e:  # the leg must not void the main chaos run
+            fi.disarm()
+            gp = {"error": str(e)[:200]}
         results["ChaosChurn_20k"] = {
             "pods_per_sec": round(n_pods / dt, 1), "wall_s": round(dt, 3),
             "placed": c["bound"], "pods": len(keys),
@@ -1534,6 +1827,7 @@ def rung_chaos_churn(results):
                                                  {}).get("injected", 0),
             "native_commit": native_leg,
             "partition_kill": pk,
+            "gang_preemption": gp,
             "solver": "fast+breaker+chaos"}
         print(f"{'ChaosChurn_20k':>28}: {n_pods / dt:>9.0f} pods/s  "
               f"({c['bound']}/{n_pods} bound under chaos, "
@@ -1553,6 +1847,15 @@ def rung_chaos_churn(results):
                   f"conflicts={pk['conflicts']}, "
                   f"reroutes={pk['reroutes']}, {pk['wall_s']}s)",
                   file=sys.stderr)
+        if "error" in gp:
+            print(f"    gang-preemption leg: ERROR {gp['error']}",
+                  file=sys.stderr)
+        else:
+            print(f"    gang-preemption leg: {gp['bound']}/{gp['pods']} "
+                  f"placed whole under faults "
+                  f"(covers={gp['preempted']}, victims={gp['victims']}, "
+                  f"expired={gp['expired_covers']}, {gp['lost']} lost, "
+                  f"{gp['wall_s']}s)", file=sys.stderr)
     except Exception as e:
         from kubernetes_tpu.chaos import faultinject as fi
 
@@ -1982,6 +2285,7 @@ RUNGS = [
     ("NorthStarSoak", rung_north_star_soak),
     ("BindCommit", rung_bind_commit),
     ("GangScheduling", rung_gang),
+    ("GangPreemption", rung_gang_preempt),
     ("Partitioned", rung_partitioned),
     ("ChaosChurn", rung_chaos_churn),
     ("ControlPlane", rung_control_plane),
@@ -1996,7 +2300,8 @@ RUNGS = [
 # path fails loudly here) without the full ladder's budget.
 QUICK_RUNGS = ("SchedulingBasic", "MixedChurn", "NorthStarEndToEnd",
                "NorthStarSoak", "BindCommit", "GangScheduling",
-               "Partitioned", "ChaosChurn", "ControlPlane", "SchedLint")
+               "GangPreemption", "Partitioned", "ChaosChurn",
+               "ControlPlane", "SchedLint")
 QUICK_BUDGET_S = 110.0
 
 

@@ -32,10 +32,26 @@ from .types import ObjectMeta
 # pod's namespace.
 POD_GROUP_LABEL = "pod-group.scheduling/name"
 
+# A gang member's rank (its position in the job's collective order, the MPI
+# rank of the rank-aware-scheduling literature). POSITIONAL METADATA, not a
+# scheduling constraint: the batched path excludes this one label from
+# pod_class_signature so a 250-rank gang stays ONE equivalence class (one
+# solver dispatch, one filter row) — consequently label selectors keying on
+# it are not supported on the batched path. Consumed by the rank-alignment
+# pass (models/gangcover.py rank_align): ranks r and r+1 prefer ICI-adjacent
+# nodes.
+POD_GROUP_RANK_LABEL = "pod-group.scheduling/rank"
+
 # Node label carrying the TPU slice (ICI domain) the node's chips belong to.
 # Nodes of one slice share terabit ICI; crossing slices pays DCN — the gang
 # packing score prefers placing a whole gang inside one slice.
 LABEL_TPU_SLICE = "tpu.scheduling/slice"
+
+# Optional node label: the node's position on its slice's ICI ring/torus
+# (an integer). Rank-aware placement measures neighbor distance along these
+# positions; nodes without it fall back to their enumeration order within
+# the slice (deterministic, and exact when nodes are listed in ring order).
+LABEL_TPU_SLICE_INDEX = "tpu.scheduling/slice-index"
 
 
 @dataclass
@@ -93,6 +109,18 @@ class PodGroup:
             out["status"] = {"phase": self.status.phase,
                              "scheduled": self.status.scheduled}
         return out
+
+
+def pod_gang_rank(pod) -> int:
+    """The pod's gang rank (POD_GROUP_RANK_LABEL parsed as int), or -1 when
+    absent/unparseable — rank-less members align by arrival order."""
+    v = pod.metadata.labels.get(POD_GROUP_RANK_LABEL)
+    if not v:
+        return -1
+    try:
+        return int(v)
+    except ValueError:
+        return -1
 
 
 def pod_group_key(pod) -> str:

@@ -1330,9 +1330,19 @@ def _render_sched_stats(doc: Dict) -> str:
         if gang:
             out.append(
                 f"gang: staged={gang.get('staged', 0)} "
+                f"parked={gang.get('parked', 0)} "
                 f"vetoes={gang.get('vetoes', 0)} "
                 f"quorum_expired_assumes="
                 f"{gang.get('quorum_expired_assumes', 0)}")
+            gp = gang.get("preemption")
+            if gp and (gp.get("attempts") or gp.get("preempted")):
+                out.append(
+                    f"gang preemption: attempts={gp.get('attempts', 0)} "
+                    f"preempted={gp.get('preempted', 0)} "
+                    f"victims={gp.get('victims', 0)} "
+                    f"cover_cost={gp.get('cover_cost', 0)} "
+                    f"slices_ripped={gp.get('slices_ripped', 0)} "
+                    f"vetoed_partial={gp.get('vetoed_partial', 0)}")
         rep = st.get("repair")
         if rep:
             last = rep.get("last") or {}

@@ -1,0 +1,277 @@
+"""Gang victim-cover + rank-adjacency kernels (ISSUE 14, ROADMAP direction 4).
+
+Two batched-tensor problems the gang preemption subsystem
+(scheduler/gangpreempt.py) and the rank-aware placement pass
+(scheduler/batch.py) hand to this module:
+
+  victim cover — for ONE ICI slice, the capacity curve of eviction: caps[k] =
+      how many gang pods the slice can host after evicting the first k
+      victims of a caller-ordered victim list. The preemptor picks the
+      smallest k with caps[k] >= quorum (the min-cost cover) or vetoes when
+      no k reaches it on any slice — the all-or-nothing discipline of the
+      gang placement veto, applied to eviction (a partial eviction that
+      strands a half-placed gang is the failure mode this module exists to
+      make impossible). The curve is ONE fused pass over a [K+1, Ns, R]
+      prefix-freed tensor (cover_curve, jitted) instead of K sequential
+      evict-and-recount steps; cover_curve_host is the numpy oracle
+      (bit-parity pinned by tests/test_gangpreempt.py) and the fallback when
+      the padded tensor would not be worth uploading.
+
+  rank alignment — the solver places a gang's identical members as an
+      interchangeable group (waterfill water-fills, so greedy order
+      interleaves across nodes); which MEMBER lands on which node is a free
+      permutation. rank_align matches rank order to ring-position order per
+      (gang, class, request) group — the monotone matching that minimizes the
+      sum of consecutive-rank position gaps (sorted-to-sorted is optimal for
+      line distance: any permutation of distinct positions pays at least
+      max-min over consecutive hops) — so rank r and rank r+1 sit on
+      ICI-adjacent nodes (the Tesserae / rank-aware-MPI placement policy:
+      per-step collectives traverse neighbor links, not the whole slice).
+      Jitted with a pow2-bucketed pod axis (repair_check's JT001 discipline);
+      gang-free batches never call it, so they stay byte-identical.
+
+Both kernels take only batch-stable statics (pow2 buckets) and do no host
+sync inside traced bodies (JT001/JT002, schedlint-enforced). Everything is
+int32 on device (this project runs jax in 32-bit mode): quantized resource
+magnitudes (millicores / MiB) keep a 1024-victim prefix sum far below 2^31,
+and the sentinels below are chosen to stay inside the range.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# victims considered per slice (ordered best-first by victim_order, so the
+# cap drops only the WORST candidates); the preemptor publishes a
+# victims_capped stat when it fires — never a silent truncation
+COVER_MAX_VICTIMS = 1024
+# above this padded-tensor size the [K+1, Ns, R] prefix tensor is not worth
+# building on device; the numpy oracle computes the same curve
+_COVER_KERNEL_MAX_ELEMS = 4_000_000
+
+_INT32_BIG = 2**30  # "infinite" capacity / unplaced-position sentinel
+
+
+# -- victim ordering ----------------------------------------------------------
+
+
+def victim_order(prio: np.ndarray, freed_norm: np.ndarray) -> np.ndarray:
+    """Eviction order for a candidate victim list: lowest priority first
+    (cheapest disruption), then the victim freeing the MOST capacity
+    (fewest victims reach the cover), then index for determinism. Shared
+    ordering for the gang cover and any batched victim path that wants the
+    same preference."""
+    idx = np.arange(len(prio))
+    return np.lexsort((idx, -np.asarray(freed_norm, dtype=np.int64),
+                       np.asarray(prio, dtype=np.int64)))
+
+
+# -- victim cover curve -------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n_slots", "k_max"))
+def cover_curve(free, headroom, eligible, v_node, v_req, req,
+                n_slots: int, k_max: int):
+    """caps[k] for k in 0..k_max: gang pods the slice fits after evicting the
+    first k victims. All arrays padded by the caller: free [n_slots, R]
+    int32, headroom [n_slots] int32 (remaining pod-count slots), eligible
+    [n_slots] bool, v_node [k_max] slice-local node index (-1 pads), v_req
+    [k_max, R] int32, req [R] int32 (the gang's per-member request). Statics
+    are pow2 buckets only."""
+    valid = v_node >= 0
+    onehot = (v_node[:, None] == jnp.arange(n_slots)[None, :]) & valid[:, None]
+    freed1 = jnp.cumsum(onehot[:, :, None] * v_req[:, None, :], axis=0)
+    freed = jnp.concatenate(
+        [jnp.zeros((1, n_slots, v_req.shape[1]), freed1.dtype), freed1],
+        axis=0)  # [K+1, Ns, R]
+    rel1 = jnp.cumsum(onehot.astype(jnp.int32), axis=0)
+    released = jnp.concatenate(
+        [jnp.zeros((1, n_slots), rel1.dtype), rel1], axis=0)  # [K+1, Ns]
+    avail = free[None, :, :] + freed
+    nz = req > 0
+    per = jnp.where(nz[None, None, :],
+                    avail // jnp.maximum(req, 1)[None, None, :],
+                    jnp.int32(_INT32_BIG))
+    cap = jnp.min(per, axis=2)
+    cap = jnp.minimum(cap, headroom[None, :] + released)
+    cap = jnp.where(eligible[None, :], jnp.maximum(cap, 0), 0)
+    return jnp.sum(cap, axis=1)  # [K+1]
+
+
+def cover_curve_host(free: np.ndarray, headroom: np.ndarray,
+                     eligible: np.ndarray, v_node: np.ndarray,
+                     v_req: np.ndarray, req: np.ndarray) -> np.ndarray:
+    """Numpy oracle of cover_curve (unpadded): the parity target and the
+    fallback for slices whose padded prefix tensor exceeds the device
+    budget. One incremental pass — O(R) work per victim, not a recount."""
+    free = np.asarray(free, dtype=np.int64).copy()
+    headroom = np.asarray(headroom, dtype=np.int64).copy()
+    eligible = np.asarray(eligible, dtype=bool)
+    req = np.asarray(req, dtype=np.int64)
+    nz = req > 0
+
+    def node_cap(n: int) -> int:
+        if not eligible[n]:
+            return 0
+        c = int(headroom[n])
+        if nz.any():
+            c = min(c, int((free[n, nz] // req[nz]).min()))
+        return max(c, 0)
+
+    caps = np.empty(len(v_node) + 1, dtype=np.int64)
+    cap_by_node = np.array([node_cap(n) for n in range(free.shape[0])],
+                           dtype=np.int64)
+    total = int(cap_by_node.sum())
+    caps[0] = total
+    for k, n in enumerate(np.asarray(v_node, dtype=np.int64).tolist()):
+        free[n] += np.asarray(v_req[k], dtype=np.int64)
+        headroom[n] += 1
+        new = node_cap(n)
+        total += new - int(cap_by_node[n])
+        cap_by_node[n] = new
+        caps[k + 1] = total
+    return caps
+
+
+def cover_curves(free: np.ndarray, headroom: np.ndarray, eligible: np.ndarray,
+                 v_node: np.ndarray, v_req: np.ndarray,
+                 req: np.ndarray) -> np.ndarray:
+    """Dispatch wrapper: pads to pow2 buckets and runs the jitted curve, or
+    the numpy oracle when the padded tensor would blow the device budget.
+    Returns caps[len(v_node) + 1] as numpy int64."""
+    k = len(v_node)
+    ns, r = free.shape
+    # pow2 buckets key the jit (JT001 discipline, models/waterfill.py idiom)
+    n_slots = 1 << max(0, ns - 1).bit_length()
+    k_max = 1 << max(0, k - 1).bit_length()
+    if (k_max + 1) * n_slots * r > _COVER_KERNEL_MAX_ELEMS or k == 0:
+        return cover_curve_host(free, headroom, eligible, v_node, v_req, req)
+    free_p = np.zeros((n_slots, r), dtype=np.int32)
+    free_p[:ns] = free
+    head_p = np.zeros(n_slots, dtype=np.int32)
+    head_p[:ns] = headroom
+    elig_p = np.zeros(n_slots, dtype=bool)
+    elig_p[:ns] = eligible
+    vn_p = np.full(k_max, -1, dtype=np.int32)
+    vn_p[:k] = v_node
+    vr_p = np.zeros((k_max, r), dtype=np.int32)
+    vr_p[:k] = v_req
+    caps = np.asarray(cover_curve(
+        free_p, head_p, elig_p, vn_p, vr_p,
+        np.asarray(req, dtype=np.int32), n_slots=n_slots, k_max=k_max))
+    return caps[: k + 1].astype(np.int64)
+
+
+# -- rank alignment -----------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("p_max",))
+def rank_align_kernel(assignment, group_id, rank, pos_key, p_max: int):
+    """Permute node assignments WITHIN each alignment group so rank order
+    matches ring-position order: the i-th smallest rank gets the node of the
+    i-th smallest position key (unplaced members carry the _INT32_BIG
+    position sentinel, so the highest ranks stay unplaced). Non-members and
+    padding carry unique group ids, making their permutation the identity.
+    p_max is the pow2 pod-axis bucket (the caller pads); the two lexsorts
+    enumerate each group contiguously in the same group order, so row i of
+    both orders is the same group by construction."""
+    idx = jnp.arange(p_max)
+    order_rank = jnp.lexsort((idx, rank, group_id))
+    order_pos = jnp.lexsort((idx, pos_key, group_id))
+    return jnp.zeros_like(assignment).at[order_rank].set(
+        assignment[order_pos])
+
+
+def rank_align_host(assignment: np.ndarray, group_id: np.ndarray,
+                    rank: np.ndarray, pos_key: np.ndarray) -> np.ndarray:
+    """Numpy oracle of rank_align_kernel (parity pinned by tests)."""
+    idx = np.arange(len(assignment))
+    order_rank = np.lexsort((idx, rank, group_id))
+    order_pos = np.lexsort((idx, pos_key, group_id))
+    out = np.zeros_like(assignment)
+    out[order_rank] = assignment[order_pos]
+    return out
+
+
+def rank_align(assignment: np.ndarray, group_id: np.ndarray,
+               rank: np.ndarray, pos_key: np.ndarray) -> np.ndarray:
+    """Pad to the pow2 pod bucket and run the jitted alignment. Padding rows
+    get group ids beyond every real group (identity permutation). Inputs
+    must already be int32-range (alignment_groups and the caller's position
+    keys guarantee it)."""
+    p = len(assignment)
+    # pow2 pod-axis bucket (JT001 discipline, repair_check's pod axis)
+    p_max = 1 << max(0, p - 1).bit_length()
+    a = np.full(p_max, -1, dtype=np.int32)
+    a[:p] = assignment
+    # padding group ids: one singleton per pad row, above every real id
+    g = np.arange(p_max, dtype=np.int32) + np.int32(_INT32_BIG)
+    g[:p] = group_id
+    r = np.zeros(p_max, dtype=np.int32)
+    r[:p] = rank
+    k = np.zeros(p_max, dtype=np.int32)
+    k[:p] = pos_key
+    out = np.asarray(rank_align_kernel(a, g, r, k, p_max=p_max))
+    return out[:p].astype(assignment.dtype)
+
+
+def alignment_groups(gang_of_pod: np.ndarray, class_of_pod: np.ndarray,
+                     req: np.ndarray, req_nz: np.ndarray) -> np.ndarray:
+    """Group ids for rank alignment: members are interchangeable ONLY within
+    (gang, class, request vector) — the same key make_groups solves by — so
+    a permutation can never move a pod onto a node that fits a different
+    request or filter row. Non-members get unique singleton ids above the
+    real groups (identity permutation), all int32-range. Vectorized (one
+    np.unique over the stacked key columns): this runs on the solve path
+    of every ranked-gang batch."""
+    p = len(gang_of_pod)
+    member = np.asarray(gang_of_pod) >= 0
+    out = np.empty(p, dtype=np.int32)
+    out[~member] = _INT32_BIG // 2 + np.nonzero(~member)[0].astype(np.int32)
+    if member.any():
+        rows = np.nonzero(member)[0]
+        key = np.column_stack([
+            np.asarray(gang_of_pod)[rows].astype(np.int64),
+            np.asarray(class_of_pod)[rows].astype(np.int64),
+            np.asarray(req)[rows].astype(np.int64),
+            np.asarray(req_nz)[rows].astype(np.int64)])
+        _uniq, inv = np.unique(key, axis=0, return_inverse=True)
+        out[rows] = inv.astype(np.int32)
+    return out
+
+
+# -- adjacency metric ---------------------------------------------------------
+
+
+def mean_neighbor_distance(group_id: Sequence[int], rank: Sequence[int],
+                           slice_of: Sequence[int], pos: Sequence[int],
+                           ring_len: Dict[int, int]) -> Optional[float]:
+    """Mean ring distance between consecutive-rank placed members, the
+    placement-quality column of the gang rungs: for ranks r and r+1 on the
+    same slice it is the ICI ring hop count min(|dp|, L - |dp|); a
+    cross-slice pair pays the worst ring length (the DCN hop the packing
+    score exists to avoid). None when no gang has two placed members."""
+    by_group: Dict[int, List[Tuple[int, int, int]]] = {}
+    for g, r, s, p in zip(group_id, rank, slice_of, pos):
+        if g < 0 or s < 0:
+            continue
+        by_group.setdefault(int(g), []).append((int(r), int(s), int(p)))
+    worst = max(ring_len.values(), default=1)
+    dists: List[float] = []
+    for members in by_group.values():
+        members.sort()
+        for (r1, s1, p1), (r2, s2, p2) in zip(members, members[1:]):
+            if s1 == s2:
+                ln = max(ring_len.get(s1, 1), 1)
+                d = abs(p2 - p1)
+                dists.append(min(d, ln - d))
+            else:
+                dists.append(worst)
+    if not dists:
+        return None
+    return float(np.mean(dists))

@@ -60,7 +60,8 @@ class BatchScheduler(Scheduler):
                  bind_retries: int = 3, bind_retry_base_s: float = 0.05,
                  pod_trace: Optional[bool] = None,
                  trace_sample_k: int = PodTracer.DEFAULT_SAMPLE_K,
-                 ts_window_s: float = 5.0, **kw):
+                 ts_window_s: float = 5.0, rank_align: bool = True,
+                 gang_preemption: bool = True, **kw):
         super().__init__(store, framework, **kw)
         self.batch_size = batch_size
         self.solver = solver
@@ -192,12 +193,19 @@ class BatchScheduler(Scheduler):
         self.partition_conflicts = 0  # bind conflicts this pipeline LOST
         self.partition_reroutes = 0  # pods handed to another partition
         from .gang import GangDirectory
+        from .gangpreempt import GangPreemptor
 
         self.gangs = GangDirectory()
         self.queue.set_gang_hooks(self.gangs.group_of,
                                   self.gangs.quorum_ready,
                                   lambda: self.gangs.active)
         self.gang_vetoes = 0  # gangs stripped post-solve (observability)
+        # gang-aware preemption (scheduler/gangpreempt.py, ISSUE 14): a
+        # solver-vetoed gang tries a min-cost victim cover on one ICI slice
+        # before requeueing; rank_align gates the post-solve rank→ring
+        # permutation (models/gangcover.py). Both inert on gang-free runs.
+        self.rank_align = rank_align
+        self.gangpreempt = GangPreemptor(self) if gang_preemption else None
 
     def schedule_batch(self, timeout: Optional[float] = 0.0) -> int:
         """Drain up to batch_size pods, solve jointly, bind. Returns #pods handled.
@@ -404,6 +412,8 @@ class BatchScheduler(Scheduler):
             # unit. gang_requeue: gang id -> members collected for requeue.
             gang_requeue: Dict[int, List[QueuedPodInfo]] = {}
             hopeless: set = set()
+            solver_vetoed: set = set()
+            gang_need = None
             veto = None
             gang_info: Optional[Dict[str, int]] = None
             if has_gang:
@@ -414,7 +424,7 @@ class BatchScheduler(Scheduler):
                     "vetoed": 0, "assume_vetoed": 0, "released": 0,
                     "hopeless": 0}
                 gkeys = batch.gang_keys
-                need = np.array(
+                gang_need = need = np.array(
                     [max(0, (self.gangs.min_member(k) or 0)
                          - self.gangs.placed_count(k)) for k in gkeys],
                     dtype=np.int64)
@@ -425,11 +435,25 @@ class BatchScheduler(Scheduler):
                 # diagnostic instead of livelocking through backoff retries
                 hopeless.update(np.nonzero(need > self.batch_size)[0].tolist())
                 if veto.any():
-                    n_vetoed = int(np.unique(sub.gang_of_pod[veto]).size)
+                    vetoed_gids = np.unique(sub.gang_of_pod[veto])
+                    n_vetoed = int(vetoed_gids.size)
+                    # solver-vetoed gangs are the gang-preemption candidates
+                    # (an assume-time veto means the gang FIT — a capacity
+                    # race, not a room problem)
+                    solver_vetoed = set(vetoed_gids.tolist())
                     self.gang_vetoes += n_vetoed
                     gang_info["vetoed"] = n_vetoed
                     m.gang_vetoed_total.inc(n_vetoed, reason="solver")
                     assignment = np.where(veto, -1, assignment)
+                # rank-aware placement (ISSUE 14): permute which MEMBER gets
+                # which node within each (gang, class, request) group so rank
+                # order follows ICI ring position — a free permutation of an
+                # identical-pod group, run ONLY when some member carries a
+                # rank label (rank-less gang batches stay byte-identical)
+                if (self.rank_align and sub.gang_rank is not None
+                        and bool((np.asarray(sub.gang_rank) >= 0).any())):
+                    assignment = self._rank_align_assignment(
+                        cluster, sub, assignment, gang_info)
             clock.mark("solve")
             trace.step("Device solve done", solver=solver)
             self.podtrace.batch_stage("solve")  # shared per-batch stamp
@@ -604,8 +628,19 @@ class BatchScheduler(Scheduler):
                 if gang_info is not None:
                     gang_info["hopeless"] = sum(
                         1 for g in gang_requeue if g in hopeless)
+                # gang preemption (ISSUE 14): solver-vetoed gangs get ONE
+                # victim-cover attempt before requeueing; context built
+                # lazily only when an eligible gang exists
+                preempt_ctx = None
+                if (self.gangpreempt is not None and gang_need is not None
+                        and any(g in solver_vetoed and g not in hopeless
+                                for g in gang_requeue)):
+                    preempt_ctx = self.gangpreempt.build_ctx(
+                        snapshot, cluster, sub, assignment, gang_need)
                 self._requeue_gangs(gang_requeue, batch.gang_keys or [],
-                                    hopeless)
+                                    hopeless, preempt_gids=solver_vetoed,
+                                    preempt_ctx=preempt_ctx,
+                                    gang_info=gang_info)
             if rejected or gang_requeue:
                 clock.mark("reject")
                 trace.step("Handled rejects", rejected=len(rejected))
@@ -799,7 +834,10 @@ class BatchScheduler(Scheduler):
 
     def _requeue_gangs(self, groups: Dict[int, List[QueuedPodInfo]],
                        keys: List[str],
-                       hopeless: frozenset = frozenset()) -> None:
+                       hopeless: frozenset = frozenset(),
+                       preempt_gids: frozenset = frozenset(),
+                       preempt_ctx: Optional[Dict] = None,
+                       gang_info: Optional[Dict] = None) -> None:
         """Gang-aware rejection handling: a vetoed (or assume-rolled-back)
         gang re-enters the queue AS A UNIT — one shared backoff expiry via
         SchedulingQueue.add_gang_backoff, so the members re-stage and
@@ -808,7 +846,14 @@ class BatchScheduler(Scheduler):
         (not per member: a 250-rank gang must not write 250 events per
         veto). `hopeless` gangs (min_member beyond what one solve can see)
         park unschedulable with a diagnostic instead — retrying on a timer
-        would livelock."""
+        would livelock.
+
+        Gang preemption (ISSUE 14): a SOLVER-vetoed gang (in preempt_gids,
+        with a built preempt_ctx) first tries a victim cover
+        (scheduler/gangpreempt.py). A fired cover PARKS the gang — its
+        members are neither failures nor requeued here, they wait in the
+        parked tier for victim termination; a partial-room veto (or an
+        inapplicable attempt) falls through to the normal unit requeue."""
         for gid, members in groups.items():
             key = keys[gid] if 0 <= gid < len(keys) else "<unknown>"
             if gid in hopeless:
@@ -820,6 +865,28 @@ class BatchScheduler(Scheduler):
                 for m in members:
                     self._handle_failure(m, status)
                 continue
+            if preempt_ctx is not None and gid in preempt_gids:
+                got = self.gangpreempt.try_preempt(key, gid, members,
+                                                   preempt_ctx)
+                if got is not None and not got.get("vetoed"):
+                    # cover fired: the gang is PARKED awaiting victim
+                    # termination — not a scheduling failure
+                    if gang_info is not None:
+                        gang_info["preempted"] = (
+                            gang_info.get("preempted", 0) + 1)
+                        gang_info["preempt_victims"] = (
+                            gang_info.get("preempt_victims", 0)
+                            + got["victims"])
+                        gang_info["cover_cost"] = (
+                            gang_info.get("cover_cost", 0) + got["cost"])
+                    if self._batch_reasons is not None:
+                        self._batch_reasons["GangPreemption"] = (
+                            self._batch_reasons.get("GangPreemption", 0)
+                            + len(members))
+                    continue
+                if got is not None and gang_info is not None:
+                    gang_info["preempt_vetoed_partial"] = (
+                        gang_info.get("preempt_vetoed_partial", 0) + 1)
             self.failed_count += len(members)
             if self._batch_reasons is not None:
                 self._batch_reasons["GangScheduling"] = (
@@ -832,6 +899,68 @@ class BatchScheduler(Scheduler):
                 f"pod group {key}: {len(members)} member(s) cannot be placed "
                 "together (all-or-nothing); gang requeued")
             self.queue.add_gang_backoff(members)
+
+    def _rank_align_assignment(self, cluster, sub, assignment,
+                               gang_info: Optional[Dict]) -> np.ndarray:
+        """Rank-aware placement pass (ISSUE 14): within each (gang, class,
+        request) group — where members are interchangeable by construction —
+        permute WHICH member gets WHICH node so rank order follows ICI ring
+        position (models/gangcover.py rank_align; sorted-to-sorted matching
+        minimizes consecutive-rank hop distance). The node SET is untouched:
+        feasibility, capacity accounting, and the gang veto all see the same
+        multiset. Publishes the before/after mean neighbor distance into the
+        batch's gang flight-record dict."""
+        from ..models.gangcover import (alignment_groups,
+                                        mean_neighbor_distance, rank_align)
+        from .gang import node_slice_positions
+
+        slice_ids, pos = node_slice_positions(cluster)
+        if slice_ids is None:
+            return assignment  # no ICI topology: adjacency is moot
+        a = np.asarray(assignment, dtype=np.int64)
+        gop = np.asarray(sub.gang_of_pod)
+        ranks = np.asarray(sub.gang_rank, dtype=np.int64)
+        groups = alignment_groups(gop, np.asarray(sub.class_of_pod),
+                                  np.asarray(sub.req),
+                                  np.asarray(sub.req_nz))
+        # rank-less members order AFTER ranked siblings, by row
+        # (deterministic); keys stay far under the int32 sentinels
+        eff_rank = np.where(ranks >= 0, ranks,
+                            1_000_000 + np.arange(len(ranks)))
+        # per-member position key: slice-major ring position of the assigned
+        # node; unlabeled nodes sort after every labeled one, unplaced last
+        stride = cluster.n + 1
+        node_key = np.where(
+            slice_ids >= 0, slice_ids * stride + np.maximum(pos, 0),
+            2**28 + np.arange(cluster.n))
+        placed = a >= 0
+        pos_key = np.where(placed, node_key[np.maximum(a, 0)], 2**30)
+        aligned = rank_align(a, groups, eff_rank, pos_key)
+        # adjacency pre/post telemetry is observability, not placement —
+        # pure-Python per-member passes, so it rides the flight recorder's
+        # enable switch like every other non-essential measurement
+        if gang_info is not None and self.flightrec.enabled:
+            from .gang import ring_lengths
+
+            ranked = ranks >= 0
+            ring_len = ring_lengths(slice_ids, pos)
+
+            def dist(assign):
+                aa = np.asarray(assign)
+                ok = ranked & (aa >= 0)
+                sl = np.where(ok, slice_ids[np.maximum(aa, 0)], -1)
+                pp = np.where(ok, pos[np.maximum(aa, 0)], -1)
+                return mean_neighbor_distance(
+                    np.where(ranked, gop, -1).tolist(), ranks.tolist(),
+                    sl.tolist(), pp.tolist(), ring_len)
+
+            pre, post = dist(a), dist(aligned)
+            if pre is not None:
+                gang_info["adjacency_pre"] = round(pre, 3)
+            if post is not None:
+                gang_info["adjacency_post"] = round(post, 3)
+            gang_info["rank_aligned"] = int((aligned != a).sum())
+        return aligned.astype(np.int32)
 
     def _columnar_account(self, batch, cluster, snapshot, bind_rows,
                           bind_nodes, has_ports: bool = True) -> None:
@@ -982,32 +1111,19 @@ class BatchScheduler(Scheduler):
         Returns the (j, qp) pairs that could not be preempted."""
         import numpy as np
 
-        from ..api import compute_pod_resource_request
-        from ..snapshot.tensorizer import _quantize
         from .framework import CycleState, PodInfo
-        from .plugins.default_preemption import Candidate
+        from .gangpreempt import flatten_snapshot_victims
 
         n = cluster.n
         dims = cluster.resource_dims
         r = len(dims)
 
-        # flatten bound pods into victim arrays (one pass over the snapshot)
-        v_node, v_prio, v_req, v_pods = [], [], [], []
-        node_victims: List[List[int]] = [[] for _ in range(n)]
-        for i, ni in enumerate(snapshot.node_info_list):
-            for pi in ni.pods:
-                p = pi.pod
-                node_victims[i].append(len(v_pods))
-                v_node.append(i)
-                v_prio.append(p.spec.priority)
-                v_req.append(_quantize(
-                    compute_pod_resource_request(p), dims, is_request=True))
-                v_pods.append(p)
+        # flatten bound pods into victim arrays (one snapshot pass) — the
+        # helper shared with the gang victim cover (ISSUE 14 satellite)
+        v_node, v_prio, v_req, v_pods, node_victims = \
+            flatten_snapshot_victims(snapshot, dims)
         if not v_pods:
             return list(rejected)
-        v_node = np.array(v_node, np.int64)
-        v_prio = np.array(v_prio, np.int64)
-        v_req = np.array(v_req, np.int64).reshape(len(v_pods), r)
         v_alive = np.ones(len(v_pods), dtype=bool)
 
         plugin_by_fw: dict = {}
@@ -1198,7 +1314,8 @@ class BatchScheduler(Scheduler):
         tel = self.queue.telemetry()
         from ..server import metrics as m
 
-        for tier in ("active", "backoff", "unschedulable", "gang_staged"):
+        for tier in ("active", "backoff", "unschedulable", "gang_staged",
+                     "gang_parked"):
             m.queue_depth.set(tel[tier], tier=tier)
         m.queue_oldest_age.set(tel["oldest_pending_age_s"])
         self.flightrec.note_self_time(time.perf_counter() - t0)
@@ -1222,8 +1339,16 @@ class BatchScheduler(Scheduler):
             expired = self.gangs.quorum_expired_count(self.cache.contains)
             m.gang_quorum_expired_assumes.set(expired)
             gang = {"staged": self.queue.gang_staged_count(),
+                    "parked": self.queue.gang_parked_count(),
                     "vetoes": self.gang_vetoes,
-                    "quorum_expired_assumes": expired}
+                    "quorum_expired_assumes": expired,
+                    # victim-cover stats (ISSUE 14): attempts/preempted/
+                    # victims/cover_cost/slices_ripped/vetoed_partial +
+                    # release accounting, the `ktl sched stats` gang-
+                    # preemption line's source
+                    "preemption": (self.gangpreempt.stats()
+                                   if self.gangpreempt is not None
+                                   else None)}
         fr = self.flightrec
         return {
             "solver": self.solver,
@@ -1236,6 +1361,7 @@ class BatchScheduler(Scheduler):
             "queue": {"active": tel["active"], "backoff": tel["backoff"],
                       "unschedulable": tel["unschedulable"],
                       "gang_staged": tel["gang_staged"],
+                      "gang_parked": tel.get("gang_parked", 0),
                       "oldest_pending_age_s": round(
                           tel["oldest_pending_age_s"], 3)},
             "latency": self.podtrace.latency_stats(),
@@ -1740,6 +1866,16 @@ class BatchScheduler(Scheduler):
         self.flightrec.add_outside("bind_wait", time.perf_counter() - t0)
         self._drain_bind_results()
 
+    def sweep_expired_assumes(self) -> List[str]:
+        """Base sweep plus the gang preemptor's parked-gang deadline: a
+        cover whose victim deletions stalled releases its gang back to the
+        normal retry ladder (scheduler/gangpreempt.py) — both run from the
+        same idle loops."""
+        expired = super().sweep_expired_assumes()
+        if self.gangpreempt is not None:
+            self.gangpreempt.sweep(self.clock.now())
+        return expired
+
     def resync_from_store(self) -> Dict[str, int]:
         """Crash resync (ISSUE 6): rebuild ALL scheduler state from the
         store, as a restarted scheduler process would — proving the store is
@@ -1769,6 +1905,10 @@ class BatchScheduler(Scheduler):
             self._bind_successes = 0
             self._bind_confirm_leftovers = []
         self._tensor_cache = TensorCache()
+        if self.gangpreempt is not None:
+            # parked-gang state is queue state; the fresh LIST re-admits
+            # every pending pod, so in-flight cover tracking is stale
+            self.gangpreempt.reset()
         counts = self._rebuild_from_store(preserve_queue=False)
         counts["dropped_assumes"] = dropped
         return counts
@@ -1853,4 +1993,6 @@ def _subset_batch(batch, idx):
         raw_req_nz=None if batch.raw_req_nz is None else batch.raw_req_nz[idx],
         gang_of_pod=(None if batch.gang_of_pod is None
                      else batch.gang_of_pod[idx]),
+        gang_rank=(None if batch.gang_rank is None
+                   else batch.gang_rank[idx]),
     )

@@ -40,7 +40,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 import numpy as np
 
 from ..api import Pod
-from ..api.podgroup import LABEL_TPU_SLICE, pod_group_key
+from ..api.podgroup import (LABEL_TPU_SLICE, LABEL_TPU_SLICE_INDEX,
+                            pod_gang_rank, pod_group_key)
 
 # Score bonus for nodes on a gang's chosen slice. Sized like one full plugin
 # score (MAX_NODE_SCORE): it dominates the least-allocated/balanced deltas
@@ -188,13 +189,19 @@ class GangDirectory:
     # -- batch tensorization ---------------------------------------------------
 
     def batch_rows(self, pods: Sequence[Pod]
-                   ) -> Tuple[Optional[np.ndarray], List[str]]:
+                   ) -> Tuple[Optional[np.ndarray], List[str],
+                              Optional[np.ndarray]]:
         """Group-id rows for one solver batch: ([P] int32, -1 = not a gang
-        member, else an index into the returned group-key list). Pods whose
-        group has no PodGroup object (deleted between admission and solve)
-        read -1 — without a quorum they schedule as ordinary pods. Returns
-        (None, []) when the batch has no gang members at all."""
+        member, else an index into the returned group-key list), plus the
+        members' rank rows ([P] int32 from the positional rank label, -1
+        absent; None when NO member carries a rank — the rank-alignment
+        pass stays compiled out, ISSUE 14). Pods whose group has no PodGroup
+        object (deleted between admission and solve) read -1 — without a
+        quorum they schedule as ordinary pods. Returns (None, [], None)
+        when the batch has no gang members at all."""
         rows = np.full(len(pods), -1, dtype=np.int32)
+        ranks = np.full(len(pods), -1, dtype=np.int32)
+        any_rank = False
         keys: List[str] = []
         idx: Dict[str, int] = {}
         known = self._min
@@ -207,9 +214,13 @@ class GangDirectory:
                 gi = idx[group] = len(keys)
                 keys.append(group)
             rows[i] = gi
+            r = pod_gang_rank(pod)
+            if r >= 0:
+                ranks[i] = r
+                any_rank = True
         if not keys:
-            return None, []
-        return rows, keys
+            return None, [], None
+        return rows, keys, (ranks if any_rank else None)
 
 
 def gang_veto_mask(assignment: np.ndarray, gang_rows: np.ndarray,
@@ -243,6 +254,59 @@ def node_slice_ids(cluster) -> Optional[np.ndarray]:
     if (ids < 0).all():
         return None
     return ids
+
+
+def node_slice_positions(cluster) -> Tuple[Optional[np.ndarray],
+                                           Optional[np.ndarray]]:
+    """(slice_ids [N], pos [N]) — each node's ICI ring position within its
+    slice, for the rank-alignment pass (models/gangcover.py). Positions come
+    from LABEL_TPU_SLICE_INDEX when every slice-labeled node carries a
+    numeric value; otherwise (mixed or unlabeled) each node's enumeration
+    order within its slice — deterministic either way, and exact when nodes
+    are listed in ring order. (None, None) when no node has a slice label
+    (single-ICI-domain clusters: adjacency is moot)."""
+    slice_ids = node_slice_ids(cluster)
+    if slice_ids is None:
+        return None, None
+    n = cluster.n
+    vocab, idx_ids = cluster.cols.val_ids(LABEL_TPU_SLICE_INDEX)
+    labeled = slice_ids >= 0
+    pos = np.full(n, -1, dtype=np.int64)
+    parsed = None
+    if vocab:
+        by_id = {}
+        ok = True
+        for val, vid in vocab.items():
+            try:
+                by_id[vid] = int(val)
+            except ValueError:
+                ok = False
+                break
+        if ok and bool((idx_ids[labeled] >= 0).all()):
+            parsed = np.full(n, -1, dtype=np.int64)
+            has = idx_ids >= 0
+            parsed[has] = [by_id[v] for v in idx_ids[has].tolist()]
+    if parsed is not None:
+        pos = np.where(labeled, parsed, -1)
+    else:
+        # fallback: rank of the node within its slice, in node order
+        order = np.argsort(slice_ids[labeled], kind="stable")
+        rows = np.nonzero(labeled)[0][order]
+        counts: Dict[int, int] = {}
+        for i in rows.tolist():
+            s = int(slice_ids[i])
+            pos[i] = counts.get(s, 0)
+            counts[s] = pos[i] + 1
+    return slice_ids, pos
+
+
+def ring_lengths(slice_ids: np.ndarray, pos: np.ndarray) -> Dict[int, int]:
+    """Per-slice ICI ring length (max position + 1) — the adjacency
+    metric's wrap-around modulus, shared by the scheduler's rank-align
+    telemetry, the bench adjacency column, and tests (one definition: a
+    position-semantics change lands everywhere at once)."""
+    return {int(s): int(pos[slice_ids == s].max()) + 1
+            for s in np.unique(slice_ids[slice_ids >= 0]).tolist()}
 
 
 def gang_slice_bonus(cluster, class_of_pod: np.ndarray, req: np.ndarray,

@@ -110,6 +110,12 @@ class SchedulingQueue:
         self._gang_ready = None  # (group, staged_count) -> bool
         self._gang_active = None  # () -> bool
         self._gang_staging: Dict[str, Dict[str, QueuedPodInfo]] = {}
+        # parked-gang retry tier (ISSUE 14): gangs whose victim cover fired
+        # wait HERE — off the active/backoff heaps — until the preemptor
+        # releases them (victims observed deleted, or its deadline sweep).
+        # A parked member is still pending for the conservation invariant
+        # (tracked_keys / telemetry cover this tier).
+        self._gang_parked: Dict[str, Dict[str, QueuedPodInfo]] = {}
         # stage-timing sink (a FlightRecorder, installed by BatchScheduler):
         # bulk-admission wall time accrues to its "queue_add" bucket so the
         # batch pipeline's stage table can attribute ingest sub-stages
@@ -304,6 +310,41 @@ class SchedulingQueue:
             if moved:
                 self._lock.notify_all()
 
+    def park_gang(self, group: str, members: List[QueuedPodInfo]) -> None:
+        """Park a preempting gang (ISSUE 14): its victim cover was selected
+        and the deletions are in flight — the members wait OUT of every
+        retry loop until release_parked_gang moves them back (the preemptor
+        calls it when the last victim's DELETED event lands, or from its
+        deadline sweep when deletions stall). One gang, one parking slot:
+        re-parking replaces (members are the same objects)."""
+        if not members:
+            return
+        with self._lock:
+            slot = self._gang_parked.setdefault(group, {})
+            for m in members:
+                slot[m.key] = m
+
+    def release_parked_gang(self, group: str) -> int:
+        """Move a parked gang back through the normal admission path: the
+        members re-stage under their group (gang hooks installed), reach
+        quorum together, and admit contiguously — the same all-at-once
+        re-entry add_gang_backoff gives a vetoed gang, without the backoff
+        wait. Returns the number of members released."""
+        with self._lock:
+            slot = self._gang_parked.pop(group, None)
+            if not slot:
+                return 0
+            now = self._clock.now()
+            for m in slot.values():
+                m.timestamp = now
+                self._push_active(m)
+            self._lock.notify_all()
+            return len(slot)
+
+    def parked_gang_groups(self) -> List[str]:
+        with self._lock:
+            return list(self._gang_parked)
+
     def add_gang_backoff(self, members: List[QueuedPodInfo]) -> None:
         """Requeue a failed gang as a UNIT: every member enters the backoff
         queue under ONE shared expiry (the slowest member's backoff), so the
@@ -495,6 +536,14 @@ class SchedulingQueue:
                             tracked = staged[key]
                             staged_in = group
                             break
+                if tracked is None:
+                    # parked for a victim cover: keep the object fresh but
+                    # stay parked — the preemptor's release/deadline owns
+                    # when this gang re-enters the admission path
+                    for parked in self._gang_parked.values():
+                        if key in parked:
+                            tracked = parked[key]
+                            break
             if tracked is None:
                 return False
             # status-only writes don't requeue (our own PodScheduled
@@ -549,6 +598,10 @@ class SchedulingQueue:
                 staged = self._gang_staging[group]
                 if staged.pop(key, None) is not None and not staged:
                     self._gang_staging.pop(group, None)
+            for group in list(self._gang_parked):
+                parked = self._gang_parked[group]
+                if parked.pop(key, None) is not None and not parked:
+                    self._gang_parked.pop(group, None)
             if key in self._in_active:
                 self._in_active.pop(key)
                 self._active = [(k, s, qp) for k, s, qp in self._active if qp.key != key]
@@ -570,6 +623,7 @@ class SchedulingQueue:
             self._unschedulable.clear()
             self._in_active.clear()
             self._gang_staging.clear()
+            self._gang_parked.clear()
 
     def contains(self, key: str) -> bool:
         """O(1) membership probe across every tier (active/backoff/
@@ -581,8 +635,10 @@ class SchedulingQueue:
             if (key in self._in_active or key in self._unschedulable
                     or key in self._backoff_keys):
                 return True
-            return any(key in staged
-                       for staged in self._gang_staging.values())
+            return (any(key in staged
+                        for staged in self._gang_staging.values())
+                    or any(key in parked
+                           for parked in self._gang_parked.values()))
 
     def add_requeued(self, qps: List[QueuedPodInfo]) -> None:
         """Admit EXISTING QueuedPodInfos straight into the active tier,
@@ -606,7 +662,9 @@ class SchedulingQueue:
                     + [qp.key for _, _, qp in self._backoff]
                     + list(self._unschedulable)
                     + [k for staged in self._gang_staging.values()
-                       for k in staged])
+                       for k in staged]
+                    + [k for parked in self._gang_parked.values()
+                       for k in parked])
 
     def close(self) -> None:
         with self._lock:
@@ -617,16 +675,21 @@ class SchedulingQueue:
 
     def lengths(self) -> Tuple[int, int, int]:
         """(active, backoff, unschedulable); gang members waiting in staging
-        count as unschedulable — they are parked waiting for quorum, the same
-        observable meaning."""
+        or parked for a victim cover count as unschedulable — they are
+        parked waiting, the same observable meaning."""
         with self._lock:
             staged = sum(len(s) for s in self._gang_staging.values())
+            parked = sum(len(s) for s in self._gang_parked.values())
             return (len(self._active), len(self._backoff),
-                    len(self._unschedulable) + staged)
+                    len(self._unschedulable) + staged + parked)
 
     def gang_staged_count(self) -> int:
         with self._lock:
             return sum(len(s) for s in self._gang_staging.values())
+
+    def gang_parked_count(self) -> int:
+        with self._lock:
+            return sum(len(s) for s in self._gang_parked.values())
 
     def depths(self) -> Dict[str, int]:
         """Per-tier depth dict WITHOUT the O(queue) oldest-age scan
@@ -637,7 +700,9 @@ class SchedulingQueue:
                     "backoff": len(self._backoff),
                     "unschedulable": len(self._unschedulable),
                     "gang_staged": sum(len(s)
-                                       for s in self._gang_staging.values())}
+                                       for s in self._gang_staging.values()),
+                    "gang_parked": sum(len(s)
+                                       for s in self._gang_parked.values())}
 
     def telemetry(self) -> Dict[str, float]:
         """Queue depth by tier plus the age of the oldest pod still waiting
@@ -647,11 +712,14 @@ class SchedulingQueue:
         with self._lock:
             now = self._clock.now()
             staged = sum(len(m) for m in self._gang_staging.values())
+            parked = sum(len(m) for m in self._gang_parked.values())
             waiting = itertools.chain(
                 (qp for _, _, qp in self._active),
                 (qp for _, _, qp in self._backoff),
                 self._unschedulable.values(),
                 (qp for m in self._gang_staging.values()
+                 for qp in m.values()),
+                (qp for m in self._gang_parked.values()
                  for qp in m.values()))
             oldest = min((qp.submit_ts or qp.timestamp for qp in waiting),
                          default=None)
@@ -660,6 +728,7 @@ class SchedulingQueue:
                 "backoff": len(self._backoff),
                 "unschedulable": len(self._unschedulable),
                 "gang_staged": staged,
+                "gang_parked": parked,
                 "oldest_pending_age_s": (max(0.0, now - oldest)
                                          if oldest is not None else 0.0),
             }

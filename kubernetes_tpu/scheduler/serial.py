@@ -119,6 +119,10 @@ class Scheduler:
         # gang directory (scheduler/gang.py) — installed by BatchScheduler;
         # None on the serial loop, and every hook below is gated on it
         self.gangs = None
+        # gang preemptor (scheduler/gangpreempt.py, ISSUE 14) — installed by
+        # BatchScheduler; the DELETED ingest below checks victims off its
+        # in-flight covers (gated on has_waiting: one attr read when idle)
+        self.gangpreempt = None
         self._watch = None
         # pipeline flight recorder (scheduler/flightrec.py) — installed by
         # BatchScheduler; None on the serial loop, every hook gated on it
@@ -551,6 +555,13 @@ class Scheduler:
         # (eventhandlers.go responsibleForPod); bound pods still feed the cache.
         if not pod.spec.node_name and self._fw(pod) is None:
             return
+        if etype == DELETED or pod.is_terminal():
+            # gang preemption (ISSUE 14): a terminating victim checks off
+            # its cover; the LAST one releases the parked gang to re-stage.
+            # has_waiting is one attribute read for the idle ~100%.
+            gp = self.gangpreempt
+            if gp is not None and gp.has_waiting:
+                gp.note_pod_deleted(pod.key)
         gate = self._pod_gate
         if gate is not None and not gate(etype, pod):
             # routed to another partition (dispatch layer) — but gang

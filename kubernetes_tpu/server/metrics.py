@@ -550,6 +550,10 @@ gang_vetoed_total = global_registry.counter(
 gang_orphan_released_total = global_registry.counter(
     "scheduler_gang_orphan_released_total",
     "Staged gang members released as ordinary pods (PodGroup gone)")
+gang_preempted_total = global_registry.counter(
+    "scheduler_gang_preempted_total",
+    "Gangs admitted by preemption, by reason (victim_cover = a min-cost "
+    "victim set on one ICI slice was evicted for the whole quorum)")
 gang_quorum_expired_assumes = global_registry.gauge(
     "scheduler_gang_quorum_expired_assumes",
     "Placed gang members still counted toward quorum whose cache entry "

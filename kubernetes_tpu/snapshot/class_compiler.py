@@ -29,6 +29,7 @@ import numpy as np
 
 from ..api import Pod
 from ..api.labels import DOES_NOT_EXIST, EXISTS, GT, IN, LT, NOT_IN
+from ..api.podgroup import POD_GROUP_RANK_LABEL
 from ..api.types import TAINT_NO_EXECUTE, TAINT_NO_SCHEDULE, TAINT_PREFER_NO_SCHEDULE
 from ..scheduler.framework import MAX_NODE_SCORE, NodeInfo
 
@@ -226,9 +227,18 @@ def _pod_class_signature(pod: Pod) -> tuple:
     images = tuple(sorted(
         c.image for c in list(spec.init_containers) + list(spec.containers) if c.image
     )) if any_images else ()
+    # the gang RANK label is positional metadata, not a scheduling
+    # constraint (api/podgroup.py POD_GROUP_RANK_LABEL): excluding it keeps
+    # a 250-rank gang ONE equivalence class (one filter row, one solver
+    # dispatch) — selectors keying on it are unsupported on the batched path
+    if labels and POD_GROUP_RANK_LABEL in labels:
+        label_sig = tuple(sorted(kv for kv in labels.items()
+                                 if kv[0] != POD_GROUP_RANK_LABEL))
+    else:
+        label_sig = tuple(sorted(labels.items())) if labels else ()
     return (
         pod.metadata.namespace,
-        tuple(sorted(labels.items())) if labels else (),
+        label_sig,
         spec.node_name,
         tuple(sorted(spec.node_selector.items())) if spec.node_selector else (),
         repr(aff) if aff else "",

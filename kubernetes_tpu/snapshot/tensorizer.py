@@ -155,6 +155,10 @@ class PodBatchTensors:
     gang_of_pod: Optional[np.ndarray] = None  # [P] int32
     gang_keys: Optional[List[str]] = None  # [G]
     gang_bonus: Optional[np.ndarray] = None  # [C, N] int32
+    # positional rank per gang member (pod-group.scheduling/rank, -1 absent);
+    # None when no member carries one — the rank-alignment pass (ISSUE 14)
+    # is then never invoked, keeping rank-less gang batches byte-identical
+    gang_rank: Optional[np.ndarray] = None  # [P] int32
 
     @property
     def p(self) -> int:
@@ -470,9 +474,9 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
     batch: each pod's PodGroup index plus the per-class slice-packing bonus.
     Skipped entirely while the directory is inactive (no PodGroups)."""
     ns_labels = ns_labels or {}
-    gang_of_pod = gang_keys = gang_bonus = None
+    gang_of_pod = gang_keys = gang_bonus = gang_rank = None
     if gangs is not None and gangs.active:
-        gang_of_pod, gang_keys = gangs.batch_rows(pods)
+        gang_of_pod, gang_keys, gang_rank = gangs.batch_rows(pods)
     # pod-axis reuse: re-solving the SAME pending backlog after cluster churn
     # (the incremental re-solve of BASELINE.json's ladder) skips the per-pod
     # signature/quantization loops — identity comparison against the previous
@@ -772,6 +776,7 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
         gang_of_pod=gang_of_pod,
         gang_keys=gang_keys or None,
         gang_bonus=gang_bonus,
+        gang_rank=gang_rank,
     )
     if reuse is not None:
         # the cached req vectors are only valid against the same resource-dim

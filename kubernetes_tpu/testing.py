@@ -46,12 +46,16 @@ class MakePod:
         self._pod.metadata.labels.update(labels)
         return self
 
-    def gang(self, group_name: str) -> "MakePod":
+    def gang(self, group_name: str, rank: Optional[int] = None) -> "MakePod":
         """Join the PodGroup `group_name` (in the pod's namespace) via the
-        pod-group.scheduling/name label convention (api/podgroup.py)."""
-        from .api.podgroup import POD_GROUP_LABEL
+        pod-group.scheduling/name label convention (api/podgroup.py);
+        `rank` adds the positional pod-group.scheduling/rank label the
+        rank-alignment pass consumes."""
+        from .api.podgroup import POD_GROUP_LABEL, POD_GROUP_RANK_LABEL
 
         self._pod.metadata.labels[POD_GROUP_LABEL] = group_name
+        if rank is not None:
+            self._pod.metadata.labels[POD_GROUP_RANK_LABEL] = str(rank)
         return self
 
     def req(self, requests: Dict[str, str], image: str = "", host_port: int = 0) -> "MakePod":
@@ -206,12 +210,16 @@ class MakeNode:
         self._node.metadata.labels.update(labels)
         return self
 
-    def tpu_slice(self, slice_id) -> "MakeNode":
+    def tpu_slice(self, slice_id, index: Optional[int] = None) -> "MakeNode":
         """Advertise the node's TPU slice (ICI domain) — api/podgroup.py
-        LABEL_TPU_SLICE, consumed by the gang slice-packing score."""
-        from .api.podgroup import LABEL_TPU_SLICE
+        LABEL_TPU_SLICE, consumed by the gang slice-packing score; `index`
+        adds the optional ring-position label (LABEL_TPU_SLICE_INDEX) the
+        rank-alignment pass measures neighbor distance along."""
+        from .api.podgroup import LABEL_TPU_SLICE, LABEL_TPU_SLICE_INDEX
 
         self._node.metadata.labels[LABEL_TPU_SLICE] = str(slice_id)
+        if index is not None:
+            self._node.metadata.labels[LABEL_TPU_SLICE_INDEX] = str(index)
         return self
 
     def capacity(self, cap: Dict[str, str]) -> "MakeNode":

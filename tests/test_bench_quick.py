@@ -147,6 +147,32 @@ def test_bench_quick_runs_and_emits_json():
     assert gang["placed"] == gang["pods"] > 0
     assert gang["gangs"] == 8
     assert gang["pods_per_sec"] > 0
+    # ISSUE 14: the adjacency placement-quality column — rank-aligned gang
+    # members are measurably MORE adjacent (smaller mean neighbor ring
+    # distance) than the rank-blind baseline on the same workload
+    adj = gang["adjacency"]
+    assert adj["placed_rank_blind"] == gang["pods"], adj
+    assert adj["mean_neighbor_distance"] is not None, adj
+    assert adj["mean_neighbor_distance_rank_blind"] is not None, adj
+    assert (adj["mean_neighbor_distance"]
+            < adj["mean_neighbor_distance_rank_blind"]), adj
+    # the gang-preemption rung (ISSUE 14): a parked gang with feasible
+    # lower-priority victims is placed WHOLE via a min-cost victim cover
+    # (bounded wall, conservation clean, zero mid-run compiles), and a gang
+    # with only partial room is vetoed with a narrated event and ZERO
+    # evictions
+    gpre = workloads["GangPreemption"]
+    assert "error" not in gpre, gpre
+    assert gpre["preempt_ok"] is True, gpre
+    assert gpre["placed"] == gpre["pods"] > 0, gpre
+    assert 1 <= gpre["victims"] < 16, gpre
+    assert gpre["slices_ripped"] == 1, gpre
+    assert gpre["conservation_ok"] is True, gpre
+    assert gpre["solver_compiles_during_run"] == 0, gpre
+    assert gpre["vetoed_partial"] >= 1, gpre
+    assert gpre["veto_evictions"] == 0, gpre
+    assert gpre["veto_narrated"] >= 1, gpre
+    assert gpre["adjacency_mean_neighbor_distance"] is not None, gpre
     # the partitioned scheduler (ISSUE 12): the quick A/B rung's CORRECTNESS
     # columns are tier-1-gated — conservation, zero mid-run compiles, per-
     # partition rows, dispatch-layer counters. The SPEEDUP column is
@@ -206,6 +232,15 @@ def test_bench_quick_runs_and_emits_json():
     assert pk["bound"] == pk["pods"] > 0, pk
     assert pk["lost"] == 0 and pk["double_bound"] == 0, pk
     assert pk["partitions_absorbed"] == 1, pk
+    # ISSUE 14: the gang-preemption chaos leg — a victim cover under
+    # injected bind/native.commit faults + a mid-run worker kill; the gang
+    # lands WHOLE (never half-evicted or half-bound), conservation clean
+    gcc = cc["gang_preemption"]
+    assert "error" not in gcc, gcc
+    assert gcc["ok"] is True, gcc
+    assert gcc["bound"] == gcc["pods"] > 0, gcc
+    assert gcc["lost"] == 0 and gcc["double_bound"] == 0, gcc
+    assert gcc["preempted"] >= 1, gcc
     # ISSUE 7: the breaker trip shows as a BOUNDED p99 excursion in the
     # trace (the faulted/backoff pods are the tail, under the chaos SLO
     # ceiling) while every sampled span still completed — chaos must be

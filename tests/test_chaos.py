@@ -740,6 +740,67 @@ class TestNodeDeathEndToEnd:
 # -- end-to-end chaos churn (the rung's shape, test-sized) ------------------
 
 
+def test_gang_preemption_under_bind_and_commit_faults():
+    """ISSUE 14 chaos leg at test scale: a victim cover fired under
+    injected bind/native.commit faults plus a mid-run worker kill must
+    leave NO gang half-evicted or half-bound — at quiescence the gang is
+    bound whole, every surviving pod is conserved, and victims were only
+    deleted because a full cover was proven."""
+    from kubernetes_tpu.native import hostcommit
+    from kubernetes_tpu.testing import make_pod_group
+
+    store = APIStore()
+    for s in range(2):
+        for i in range(4):
+            store.create("nodes", MakeNode(f"node-{s}-{i}")
+                         .tpu_slice(s, index=i)
+                         .capacity({"cpu": "8", "memory": "32Gi",
+                                    "pods": "110"}).obj())
+    filler_keys = []
+    for s in range(2):
+        for i in range(4):
+            low = MakePod(f"low-{s}-{i}").priority(1).req({"cpu": "6"}).obj()
+            low.spec.node_name = f"node-{s}-{i}"
+            store.create("pods", low)
+            filler_keys.append(low.key)
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=64, solver="fast",
+                           breaker_threshold=3, breaker_cooldown_s=0.1,
+                           bind_retries=3, bind_retry_base_s=0.001,
+                           pod_initial_backoff=0.01, pod_max_backoff=0.05)
+    sched.bind_chunk = 4
+    sched.sync()
+    store.create("podgroups", make_pod_group("cg", 8))
+    pods = [MakePod(f"cg-{i}").gang("cg", rank=i).priority(100)
+            .req({"cpu": "3"}).obj() for i in range(8)]
+    plans = [FaultPlan("store.bind_many", "rate", rate=0.3, seed=99),
+             FaultPlan("bind.worker", "kill", after=1)]
+    if hostcommit.available():
+        plans.append(FaultPlan("native.commit", "fail", count=2))
+    fi.arm(plans)
+    store.create_many("pods", pods, consume=True)
+    _drive(store, sched, 8, deadline_s=10.0, keys_prefix="cg-")
+    fi.disarm()
+    bound = _drive(store, sched, 8, deadline_s=10.0, keys_prefix="cg-")
+    assert bound == 8, bound
+    # the cover really fired (the gang could not fit without eviction)
+    stats = sched.gangpreempt.stats()
+    assert stats["preempted"] >= 1 and stats["victims"] >= 1, stats
+    # all-or-nothing held: the gang is fully bound, never a partial slice
+    live = {p.key: p for p in store.list("pods")[0]}
+    gang_bound = [p for k, p in live.items()
+                  if k.startswith("default/cg-") and p.spec.node_name]
+    assert len(gang_bound) == 8
+    # conservation over gang + surviving fillers (victims are deleted by
+    # design; half-deleted covers release via the deadline sweep and retry)
+    survivors = [k for k in filler_keys if k in live]
+    rep = assert_pod_conservation(store, sched,
+                                  [p.key for p in pods] + survivors)
+    assert rep["counts"]["lost"] == 0
+    assert sched.queue.gang_parked_count() == 0
+    assert sched.gangpreempt.stats()["waiting_gangs"] == 0
+
+
 def test_chaos_churn_conservation_small():
     """The ChaosChurn rung's invariant at test scale: solver faults, bind
     faults, a worker kill, and a mid-run resync — every pod exactly once."""

@@ -1,0 +1,784 @@
+"""Gang-aware preemption + rank-aware placement (ISSUE 14 acceptance).
+
+The invariants under test: a parked gang with feasible lower-priority
+victims is placed WHOLE via a min-cost victim cover on one ICI slice; a gang
+with only partial room is vetoed with a narrated event and ZERO evictions
+(including the randomized never-partially-evicted sweep); victims are never
+gang members or PDB-blocked; the parked tier releases on the last victim's
+DELETED event (or the deadline sweep); rank alignment measurably improves
+intra-gang neighbor distance without touching the node multiset; and
+gang-free batches stay byte-identical with the whole subsystem armed.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.models.gangcover import (
+    COVER_MAX_VICTIMS,
+    alignment_groups,
+    cover_curve_host,
+    cover_curves,
+    mean_neighbor_distance,
+    rank_align,
+    rank_align_host,
+    victim_order,
+)
+from kubernetes_tpu.scheduler import Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.gang import node_slice_positions
+from kubernetes_tpu.scheduler.gangpreempt import (
+    flatten_snapshot_victims,
+    pdb_blocked_mask,
+)
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.scheduler.queue import QueuedPodInfo, SchedulingQueue
+from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import (MakeNode, MakePod,
+                                    assert_pod_conservation, make_pod_group,
+                                    mutation_detector_guard)
+from kubernetes_tpu.utils import FakeClock
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    yield from mutation_detector_guard(monkeypatch)
+
+
+def _sched(store, clock=None, solver="fast", **kw):
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=1024, solver=solver,
+                           pipeline_binds=False, clock=clock, **kw)
+    sched.sync()
+    return sched
+
+
+def _sync_preemption(sched):
+    """Force synchronous victim preparation (deterministic deletes)."""
+    from kubernetes_tpu.scheduler.plugins.default_preemption import \
+        DefaultPreemption
+
+    for fw in sched.profiles.values():
+        for p in fw.post_filter_plugins:
+            if isinstance(p, DefaultPreemption):
+                p.async_preparation = False
+
+
+def _slice_cluster(store, n_slices=2, per_slice=4, cpu="8", mem="32Gi"):
+    for s in range(n_slices):
+        for i in range(per_slice):
+            store.create("nodes", MakeNode(f"node-{s}-{i}")
+                         .tpu_slice(s, index=i)
+                         .capacity({"cpu": cpu, "memory": mem,
+                                    "pods": "110"}).obj())
+
+
+def _fillers(store, n_slices=2, per_slice=4, cpu="6", prio=1, prefix="low"):
+    out = []
+    for s in range(n_slices):
+        for i in range(per_slice):
+            low = MakePod(f"{prefix}-{s}-{i}").priority(prio).req(
+                {"cpu": cpu}).obj()
+            low.spec.node_name = f"node-{s}-{i}"
+            store.create("pods", low)
+            out.append(low)
+    return out
+
+
+def _gang(store, n, cpu="3", prio=100, min_member=None, name="train",
+          ranked=True):
+    store.create("podgroups", make_pod_group(name, min_member or n))
+    pods = [MakePod(f"g-{i}").gang(name, rank=i if ranked else None)
+            .priority(prio).req({"cpu": cpu}).obj() for i in range(n)]
+    store.create_many("pods", pods, consume=True)
+    return pods
+
+
+def _gang_bound(store):
+    return sorted((p.metadata.name, p.spec.node_name)
+                  for p in store.list("pods")[0]
+                  if p.metadata.name.startswith("g-") and p.spec.node_name)
+
+
+def _drive(sched, store, want, deadline_s=15.0):
+    """Drive until `want` gang members are bound or the wall deadline hits —
+    preemption is asynchronous-by-nature (evict, park, release, re-solve)."""
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        sched.run_until_idle()
+        sched.queue.flush_backoff_completed()
+        sched.pump_events()
+        if len(_gang_bound(store)) >= want:
+            return
+        time.sleep(0.02)
+
+
+# -- kernel parity -------------------------------------------------------------
+
+
+def test_cover_curve_kernel_matches_host_oracle():
+    rng = np.random.default_rng(7)
+    for _ in range(25):
+        ns = int(rng.integers(1, 10))
+        r = int(rng.integers(1, 4))
+        k = int(rng.integers(0, 14))
+        free = rng.integers(0, 30, size=(ns, r)).astype(np.int64)
+        head = rng.integers(0, 9, size=ns).astype(np.int64)
+        elig = rng.random(ns) > 0.25
+        v_node = rng.integers(0, ns, size=k).astype(np.int64)
+        v_req = rng.integers(0, 8, size=(k, r)).astype(np.int64)
+        req = rng.integers(0, 6, size=r).astype(np.int64)
+        got = cover_curves(free, head, elig, v_node, v_req, req)
+        want = cover_curve_host(free, head, elig, v_node, v_req, req)
+        assert np.array_equal(got, want), (got, want)
+        # the curve is monotone: evicting more never shrinks capacity
+        assert (np.diff(got) >= 0).all(), got
+
+
+def test_rank_align_kernel_matches_host_oracle_and_permutes_within_groups():
+    rng = np.random.default_rng(11)
+    for _ in range(25):
+        p = int(rng.integers(1, 60))
+        gop = rng.integers(-1, 4, size=p)
+        cls = rng.integers(0, 3, size=p)
+        req = rng.integers(0, 2, size=(p, 2)).astype(np.int64)
+        gid = alignment_groups(gop, cls, req, req)
+        assign = rng.integers(-1, 8, size=p).astype(np.int64)
+        rank = rng.integers(0, 12, size=p)
+        pos = np.where(assign >= 0, (assign * 5) % 11, 2**30)
+        got = rank_align(assign, gid, rank, pos)
+        want = rank_align_host(
+            *[np.asarray(x, dtype=np.int64)
+              for x in (assign, gid, rank, pos)])
+        assert np.array_equal(got, want)
+        # a pure permutation within each (gang, class, request) group: the
+        # node multiset is untouched, so feasibility cannot change
+        for g in np.unique(gid):
+            m = gid == g
+            assert sorted(assign[m].tolist()) == sorted(got[m].tolist())
+
+
+def test_victim_order_prefers_low_priority_then_biggest_freed():
+    prio = np.array([5, 1, 1, 3])
+    freed = np.array([100, 10, 90, 50])
+    order = victim_order(prio, freed).tolist()
+    assert order == [2, 1, 3, 0]
+
+
+def test_mean_neighbor_distance_ring_wraps_and_cross_slice_penalty():
+    # ranks 0..3 at ring positions 0,1,2,7 on an 8-ring: hops 1,1,3
+    d = mean_neighbor_distance([0] * 4, [0, 1, 2, 3], [0] * 4,
+                               [0, 1, 2, 7], {0: 8})
+    assert d == pytest.approx((1 + 1 + 3) / 3)
+    # wrap: positions 0 and 7 are 1 hop apart on the ring
+    d = mean_neighbor_distance([0, 0], [0, 1], [0, 0], [0, 7], {0: 8})
+    assert d == 1.0
+    # a cross-slice pair pays the worst ring length
+    d = mean_neighbor_distance([0, 0], [0, 1], [0, 1], [0, 0], {0: 8, 1: 4})
+    assert d == 8.0
+    assert mean_neighbor_distance([], [], [], [], {}) is None
+
+
+# -- topology plumbing ---------------------------------------------------------
+
+
+def test_node_slice_positions_from_index_labels_and_fallback():
+    store = APIStore()
+    # slice 0 carries explicit ring indices (reversed vs name order)
+    for i in range(3):
+        store.create("nodes", MakeNode(f"a-{i}").tpu_slice(0, index=2 - i)
+                     .capacity({"cpu": "4"}).obj())
+    sched = _sched(store)
+    cl = build_cluster_tensors(sched.cache.update_snapshot())
+    slice_ids, pos = node_slice_positions(cl)
+    by_name = {cl.node_names[i]: int(pos[i]) for i in range(cl.n)}
+    assert by_name == {"a-0": 2, "a-1": 1, "a-2": 0}
+
+    # mixed/missing index labels: deterministic enumeration-order fallback
+    store2 = APIStore()
+    store2.create("nodes", MakeNode("b-0").tpu_slice(0).capacity(
+        {"cpu": "4"}).obj())
+    store2.create("nodes", MakeNode("b-1").tpu_slice(0, index=5).capacity(
+        {"cpu": "4"}).obj())
+    sched2 = _sched(store2)
+    cl2 = build_cluster_tensors(sched2.cache.update_snapshot())
+    _ids, pos2 = node_slice_positions(cl2)
+    assert sorted(pos2.tolist()) == [0, 1]
+
+    # no slice labels at all: (None, None)
+    store3 = APIStore()
+    store3.create("nodes", MakeNode("c-0").capacity({"cpu": "4"}).obj())
+    sched3 = _sched(store3)
+    cl3 = build_cluster_tensors(sched3.cache.update_snapshot())
+    assert node_slice_positions(cl3) == (None, None)
+
+
+# -- parked-gang queue tier ----------------------------------------------------
+
+
+def test_parked_tier_lifecycle():
+    q = SchedulingQueue(clock=FakeClock())
+    members = [QueuedPodInfo(pod=MakePod(f"m-{i}").gang("t").obj(),
+                             timestamp=1.0) for i in range(3)]
+    q.park_gang("default/t", members)
+    assert q.gang_parked_count() == 3
+    assert q.depths()["gang_parked"] == 3
+    assert q.lengths()[2] == 3  # parked counts as unschedulable-observable
+    assert q.contains("default/m-0")
+    assert set(q.tracked_keys()) == {m.key for m in members}
+    assert q.telemetry()["gang_parked"] == 3
+    # delete one member (pod deleted while parked)
+    q.delete_key("default/m-1")
+    assert q.gang_parked_count() == 2
+    # release: members re-enter the admission path (no gang hooks installed
+    # here, so they land straight in active)
+    assert q.release_parked_gang("default/t") == 2
+    assert q.gang_parked_count() == 0
+    assert q.depths()["active"] == 2
+    assert q.release_parked_gang("default/t") == 0  # idempotent
+    q.park_gang("default/t", members)
+    q.clear()
+    assert q.gang_parked_count() == 0
+
+
+# -- end-to-end: the cover places the whole gang -------------------------------
+
+
+def test_gang_preempts_min_cost_cover_and_places_whole():
+    store = APIStore()
+    _slice_cluster(store)
+    _fillers(store)  # 6cpu low-prio filler on every node, both slices
+    sched = _sched(store)
+    _sync_preemption(sched)
+    # 8 x 3cpu on one slice needs 24; free per slice is 4 x 2 = 8 -> evict
+    pods = _gang(store, 8)
+    _drive(sched, store, want=8)
+    bound = _gang_bound(store)
+    assert len(bound) == 8, bound
+    # the whole gang landed on ONE slice
+    slices = {n.split("-")[1] for _, n in bound}
+    assert len(slices) == 1, bound
+    ripped = slices.pop()
+    # exactly that slice's fillers were evicted; the other slice is intact
+    left = sorted(p.metadata.name for p in store.list("pods")[0]
+                  if p.metadata.name.startswith("low-"))
+    assert len(left) == 4, left
+    assert all(not name.startswith(f"low-{ripped}-") for name in left), left
+    stats = sched.gangpreempt.stats()
+    assert stats["preempted"] == 1
+    assert stats["victims"] == 4
+    assert stats["slices_ripped"] == 1
+    assert stats["vetoed_partial"] == 0
+    assert stats["released"] == 1
+    assert stats["waiting_gangs"] == 0
+    assert sched.queue.gang_parked_count() == 0
+    # narration: one GangPreempting event fired
+    evs = [e for e in store.list("events")[0]
+           if (e.reason or "") == "GangPreempting"]
+    assert len(evs) == 1, [e.reason for e in store.list("events")[0]]
+    assert_pod_conservation(store, sched, [p.key for p in pods])
+
+
+def test_partial_room_vetoes_with_zero_evictions():
+    store = APIStore()
+    _slice_cluster(store)
+    _fillers(store)
+    sched = _sched(store)
+    _sync_preemption(sched)
+    # 12 x 3cpu: a slice maxes at 4 x floor(8/3) = 8 even evicting EVERY
+    # filler — only partial room exists, so nothing may be evicted
+    pods = _gang(store, 12)
+    sched.run_until_idle()
+    sched.pump_events()
+    assert _gang_bound(store) == []
+    assert len(store.list("pods")[0]) == 8 + 12  # ZERO evictions
+    stats = sched.gangpreempt.stats()
+    assert stats["vetoed_partial"] >= 1
+    assert stats["preempted"] == 0 and stats["victims"] == 0
+    evs = [e for e in store.list("events")[0]
+           if (e.reason or "") == "GangPreemptionVetoed"]
+    assert evs and "partial eviction refused" in evs[0].message
+    # the gang requeued normally as a unit (backoff tier)
+    assert sched.queue.lengths()[1] == 12
+    assert_pod_conservation(store, sched, [p.key for p in pods])
+
+
+def test_cover_prefers_lower_priority_victims_across_slices():
+    store = APIStore()
+    _slice_cluster(store)
+    # both slices coverable, but slice 1's fillers are CHEAPER (prio 2 vs 5)
+    for s, prio in ((0, 5), (1, 2)):
+        for i in range(4):
+            low = MakePod(f"low-{s}-{i}").priority(prio).req(
+                {"cpu": "6"}).obj()
+            low.spec.node_name = f"node-{s}-{i}"
+            store.create("pods", low)
+    sched = _sched(store)
+    _sync_preemption(sched)
+    _gang(store, 8)
+    _drive(sched, store, want=8)
+    bound = _gang_bound(store)
+    assert len(bound) == 8
+    assert {n.split("-")[1] for _, n in bound} == {"1"}
+    left = sorted(p.metadata.name for p in store.list("pods")[0]
+                  if p.metadata.name.startswith("low-"))
+    assert left == [f"low-0-{i}" for i in range(4)]
+
+
+def test_gang_members_are_never_victims():
+    store = APIStore()
+    _slice_cluster(store, n_slices=1)
+    # the "fillers" are BOUND members of another (placed) gang: evicting
+    # part of a placed gang would strand it — they are not candidates
+    store.create("podgroups", make_pod_group("placed", 4))
+    for i in range(4):
+        low = MakePod(f"low-0-{i}").gang("placed").priority(1).req(
+            {"cpu": "6"}).obj()
+        low.spec.node_name = f"node-0-{i}"
+        store.create("pods", low)
+    sched = _sched(store)
+    _sync_preemption(sched)
+    pods = _gang(store, 8)
+    sched.run_until_idle()
+    sched.pump_events()
+    assert _gang_bound(store) == []
+    assert len(store.list("pods")[0]) == 12  # nothing evicted
+    assert sched.gangpreempt.stats()["preempted"] == 0
+    assert_pod_conservation(store, sched, [p.key for p in pods])
+
+
+def test_pdb_blocked_victims_are_excluded():
+    from kubernetes_tpu.api.policy import PodDisruptionBudget
+
+    store = APIStore()
+    _slice_cluster(store, n_slices=1)
+    fillers = _fillers(store, n_slices=1)
+    pdb = PodDisruptionBudget.from_dict({
+        "metadata": {"name": "protect-low", "namespace": "default"},
+        "spec": {"selector": {"matchLabels": {}},
+                 "minAvailable": len(fillers)},
+        "status": {"disruptionsAllowed": 0},
+    })
+    store.create("poddisruptionbudgets", pdb)
+    sched = _sched(store)
+    _sync_preemption(sched)
+    _gang(store, 8)
+    sched.run_until_idle()
+    sched.pump_events()
+    assert _gang_bound(store) == []
+    assert len([p for p in store.list("pods")[0]
+                if p.metadata.name.startswith("low-")]) == 4
+    assert sched.gangpreempt.stats()["preempted"] == 0
+
+
+def test_preemption_policy_never_skips_the_cover():
+    store = APIStore()
+    _slice_cluster(store, n_slices=1)
+    _fillers(store, n_slices=1)
+    sched = _sched(store)
+    _sync_preemption(sched)
+    store.create("podgroups", make_pod_group("train", 4))
+    pods = []
+    for i in range(4):
+        p = MakePod(f"g-{i}").gang("train", rank=i).priority(100).req(
+            {"cpu": "3"}).obj()
+        p.spec.preemption_policy = "Never"
+        pods.append(p)
+    store.create_many("pods", pods, consume=True)
+    sched.run_until_idle()
+    sched.pump_events()
+    assert _gang_bound(store) == []
+    assert len(store.list("pods")[0]) == 8
+    assert sched.gangpreempt.stats()["attempts"] == 0
+
+
+def test_parked_gang_released_by_deadline_when_deletions_stall(monkeypatch):
+    from kubernetes_tpu.scheduler.plugins.default_preemption import \
+        DefaultPreemption
+
+    clock = FakeClock()
+    store = APIStore()
+    _slice_cluster(store, n_slices=1)
+    _fillers(store, n_slices=1)
+    sched = _sched(store, clock=clock)
+    _sync_preemption(sched)
+    # deletions stall: the cover fires but no DELETED event ever arrives
+    monkeypatch.setattr(DefaultPreemption, "_delete_victims",
+                        lambda self, victims: None)
+    pods = _gang(store, 8)
+    sched.run_until_idle()
+    sched.pump_events()
+    assert sched.queue.gang_parked_count() == 8
+    assert sched.gangpreempt.stats()["preempted"] == 1
+    # before the deadline: still parked
+    sched.sweep_expired_assumes()
+    assert sched.queue.gang_parked_count() == 8
+    # past the deadline: released back to the normal retry ladder
+    clock.step(sched.gangpreempt.PARK_TIMEOUT_S + 1.0)
+    sched.sweep_expired_assumes()
+    assert sched.queue.gang_parked_count() == 0
+    assert sched.gangpreempt.stats()["expired"] == 1
+    assert sched.gangpreempt.stats()["waiting_gangs"] == 0
+    # the members are pending again (re-staged), never lost
+    assert_pod_conservation(store, sched, [p.key for p in pods])
+
+
+def test_resync_clears_parked_cover_state(monkeypatch):
+    from kubernetes_tpu.scheduler.plugins.default_preemption import \
+        DefaultPreemption
+
+    store = APIStore()
+    _slice_cluster(store, n_slices=1)
+    _fillers(store, n_slices=1)
+    sched = _sched(store)
+    _sync_preemption(sched)
+    monkeypatch.setattr(DefaultPreemption, "_delete_victims",
+                        lambda self, victims: None)
+    pods = _gang(store, 8)
+    sched.run_until_idle()
+    sched.pump_events()
+    assert sched.queue.gang_parked_count() == 8
+    sched.resync_from_store()
+    assert sched.gangpreempt.stats()["waiting_gangs"] == 0
+    assert sched.queue.gang_parked_count() == 0
+    # every member re-entered pending from the fresh LIST
+    assert_pod_conservation(store, sched, [p.key for p in pods])
+
+
+def test_two_gangs_vetoed_in_one_batch_never_share_victims():
+    """Two gangs vetoed in the SAME batch share one cover context: the
+    first cover must be consumed out of it (victims leave the pool, their
+    room folds into free), so the second gang either sees the in-flight
+    room (no double eviction — it places on a later solve) or proves a
+    DISJOINT cover. Regression: without consume_cover both gangs selected
+    the same victims, the shared DELETED events released only the first
+    gang, and the second stranded parked until the deadline sweep."""
+    store = APIStore()
+    _slice_cluster(store)
+    _fillers(store)
+    sched = _sched(store)
+    _sync_preemption(sched)
+    # two 8-member gangs, each needing a full slice after eviction — both
+    # arrive together and veto in one batch
+    store.create("podgroups", make_pod_group("a", 8))
+    store.create("podgroups", make_pod_group("b", 8))
+    pods = []
+    for name in ("a", "b"):
+        pods += [MakePod(f"g-{name}{i}").gang(name, rank=i).priority(100)
+                 .req({"cpu": "3"}).obj() for i in range(8)]
+    store.create_many("pods", pods, consume=True)
+    _drive(sched, store, want=16)
+    bound = _gang_bound(store)
+    assert len(bound) == 16, bound
+    # each gang landed whole on its OWN slice; all 8 fillers evicted
+    by_gang = {}
+    for name, node in bound:
+        by_gang.setdefault(name[2], set()).add(node.split("-")[1])
+    assert all(len(s) == 1 for s in by_gang.values()), by_gang
+    assert by_gang["a"] != by_gang["b"], by_gang
+    assert not [p for p in store.list("pods")[0]
+                if p.metadata.name.startswith("low-")]
+    stats = sched.gangpreempt.stats()
+    assert stats["preempted"] == 2 and stats["victims"] == 8, stats
+    # the distinguishing assertions: every cover released by its OWN
+    # victims' deletions — no deadline fallback, no stranded parked gang
+    assert stats["released"] == 2 and stats["expired"] == 0, stats
+    assert stats["waiting_gangs"] == 0
+    assert sched.queue.gang_parked_count() == 0
+    assert_pod_conservation(store, sched, [p.key for p in pods])
+
+
+def test_select_cover_aborts_when_any_slice_has_free_room():
+    """If SOME slice fits the quorum with zero evictions, the attempt must
+    abort entirely — evicting on a different slice when free room exists
+    deletes pods for nothing. Regression: the zero-eviction slice used to
+    be skipped with `continue` while the search went on to rip another."""
+    from types import SimpleNamespace
+
+    from kubernetes_tpu.scheduler.gangpreempt import GangPreemptor
+
+    # slice 0: two empty nodes (fits need=4 of req=3 with no eviction);
+    # slice 1: two full nodes whose victims could also cover it
+    free = np.array([[10], [10], [0], [0]], dtype=np.int64)
+    headroom = np.array([10, 10, 10, 10], dtype=np.int64)
+    slice_ids = np.array([0, 0, 1, 1], dtype=np.int64)
+    victims = [MakePod(f"v-{i}").priority(1).req({"cpu": "6"}).obj()
+               for i in range(2)]
+    ctx = {
+        "cluster": SimpleNamespace(n=4),
+        "sub": SimpleNamespace(
+            gang_of_pod=np.array([0, 0, 0, 0]),
+            class_of_pod=np.array([0, 0, 0, 0]),
+            req=np.array([[3]] * 4, dtype=np.int64),
+            tables=SimpleNamespace(filter_ok=np.ones((1, 4), dtype=bool))),
+        "free": free, "headroom": headroom, "slice_ids": slice_ids,
+        "victims": (np.array([2, 3]), np.array([1, 1]),
+                    np.array([[6], [6]], dtype=np.int64), victims),
+        "pdb_blocked": np.zeros(2, dtype=bool),
+    }
+    gp = GangPreemptor.__new__(GangPreemptor)
+    cover = gp._select_cover(gid=0, need=4, prio=100, ctx=ctx)
+    assert cover.room_exists is True
+    assert cover.victims == []
+
+
+def test_consume_cover_folds_room_and_shrinks_the_pool():
+    from types import SimpleNamespace
+
+    from kubernetes_tpu.scheduler.gangpreempt import GangPreemptor, _Cover
+
+    victims = [MakePod(f"v-{i}").priority(1).req({"cpu": "2"}).obj()
+               for i in range(3)]
+    ctx = {
+        "free": np.array([[1], [1]], dtype=np.int64),
+        "headroom": np.array([5, 5], dtype=np.int64),
+        "victims": (np.array([0, 1, 0]), np.array([1, 2, 3]),
+                    np.array([[2], [4], [6]], dtype=np.int64), victims),
+        "pdb_blocked": np.array([False, True, False]),
+    }
+    cover = _Cover(chosen=np.array([0, 2]), victims=[victims[0], victims[2]])
+    GangPreemptor.consume_cover(ctx, cover)
+    assert ctx["free"].tolist() == [[9], [1]]  # 1 + 2 + 6 on node 0
+    assert ctx["headroom"].tolist() == [7, 5]
+    v_node, v_prio, v_req, v_pods = ctx["victims"]
+    assert v_node.tolist() == [1] and v_prio.tolist() == [2]
+    assert v_pods == [victims[1]]
+    assert ctx["pdb_blocked"].tolist() == [True]
+
+
+# -- rank-aware placement ------------------------------------------------------
+
+
+def _adjacency_from_store(store, sched):
+    """Independent adjacency measurement: read bound members + topology from
+    the STORE, not the scheduler's own stats."""
+    from kubernetes_tpu.api.podgroup import pod_gang_rank, pod_group_key
+    from kubernetes_tpu.scheduler.gang import ring_lengths
+
+    cl = build_cluster_tensors(sched.cache.update_snapshot())
+    slice_ids, pos = node_slice_positions(cl)
+    node_idx = {n: i for i, n in enumerate(cl.node_names)}
+    groups, ranks, slices, poss = [], [], [], []
+    gids = {}
+    for p in store.list("pods")[0]:
+        g = pod_group_key(p)
+        if not g or not p.spec.node_name:
+            continue
+        ni = node_idx[p.spec.node_name]
+        gids.setdefault(g, len(gids))
+        groups.append(gids[g])
+        ranks.append(pod_gang_rank(p))
+        slices.append(int(slice_ids[ni]))
+        poss.append(int(pos[ni]))
+    return mean_neighbor_distance(groups, ranks, slices, poss,
+                                  ring_lengths(slice_ids, pos))
+
+
+def _rank_workload(store):
+    """A shape where greedy water-filling interleaves ranks across nodes:
+    one slice of 8 nodes, 16 ranked members, 2 per node."""
+    for i in range(8):
+        store.create("nodes", MakeNode(f"node-0-{i}").tpu_slice(0, index=i)
+                     .capacity({"cpu": "8", "memory": "32Gi",
+                                "pods": "110"}).obj())
+    return _gang(store, 16, cpu="3", ranked=True)
+
+
+def test_rank_alignment_improves_adjacency_over_rank_blind():
+    blind_store = APIStore()
+    _rank_workload(blind_store)
+    blind = _sched(blind_store, rank_align=False)
+    blind.run_until_idle()
+    blind.pump_events()
+    d_blind = _adjacency_from_store(blind_store, blind)
+
+    store = APIStore()
+    _rank_workload(store)
+    sched = _sched(store)
+    sched.run_until_idle()
+    sched.pump_events()
+    d_aligned = _adjacency_from_store(store, sched)
+
+    assert len(_gang_bound(store)) == 16
+    assert d_aligned is not None and d_blind is not None
+    # consecutive ranks share a node or sit one ring hop apart; the blind
+    # greedy order interleaves (rank 0 and 1 land ~a full node apart)
+    assert d_aligned < d_blind, (d_aligned, d_blind)
+    assert d_aligned <= 1.0, d_aligned
+    # alignment stats surfaced in the flight record's gang dict
+    recs = [r for r in sched.flightrec.records() if r.get("gang")]
+    gi = recs[-1]["gang"]
+    assert gi.get("adjacency_post") is not None
+    assert gi["adjacency_post"] <= gi.get("adjacency_pre", 1e9)
+
+
+def test_rank_alignment_keeps_the_node_multiset():
+    """The permutation must not change WHERE capacity is consumed — only
+    which member consumes it (feasibility untouched by construction)."""
+    a_store = APIStore()
+    _rank_workload(a_store)
+    a = _sched(a_store, rank_align=False)
+    a.run_until_idle()
+    a.pump_events()
+    b_store = APIStore()
+    _rank_workload(b_store)
+    b = _sched(b_store)
+    b.run_until_idle()
+    b.pump_events()
+    nodes_a = sorted(n for _, n in _gang_bound(a_store))
+    nodes_b = sorted(n for _, n in _gang_bound(b_store))
+    assert nodes_a == nodes_b
+
+
+def test_rankless_gangs_skip_the_alignment_pass():
+    store = APIStore()
+    for i in range(8):
+        store.create("nodes", MakeNode(f"node-0-{i}").tpu_slice(0, index=i)
+                     .capacity({"cpu": "8", "memory": "32Gi",
+                                "pods": "110"}).obj())
+    _gang(store, 16, cpu="3", ranked=False)
+    sched = _sched(store)
+    sched.run_until_idle()
+    sched.pump_events()
+    assert len(_gang_bound(store)) == 16
+    recs = [r for r in sched.flightrec.records() if r.get("gang")]
+    assert all("rank_aligned" not in (r["gang"] or {}) for r in recs)
+
+
+def test_rank_label_does_not_split_equivalence_classes():
+    """The positional rank label is excluded from pod_class_signature: a
+    250-rank gang must stay ONE class (one filter row, one solver
+    dispatch), or rank-aware gangs would compile per-member kernels."""
+    from kubernetes_tpu.snapshot.class_compiler import pod_class_signature
+
+    a = MakePod("x").gang("t", rank=0).req({"cpu": "1"}).obj()
+    b = MakePod("y").gang("t", rank=7).req({"cpu": "1"}).obj()
+    c = MakePod("z").gang("OTHER", rank=0).req({"cpu": "1"}).obj()
+    assert pod_class_signature(a) == pod_class_signature(b)
+    assert pod_class_signature(a) != pod_class_signature(c)
+
+
+# -- byte-identity: gang-free batches untouched --------------------------------
+
+
+@pytest.mark.parametrize("coalesce", [True, False])
+def test_gang_free_batches_byte_identical_with_subsystem_armed(coalesce):
+    """With the preemptor constructed and rank alignment on (the defaults),
+    a gang-free workload must produce byte-identical placements and event
+    streams vs the subsystem forced off — across both watch_coalesce modes
+    with the mutation detector forced (the autouse fixture)."""
+    def run(**kw):
+        store = APIStore()
+        for i in range(8):
+            store.create("nodes", MakeNode(f"n-{i}").tpu_slice(i % 2, index=i)
+                         .capacity({"cpu": "8", "memory": "32Gi",
+                                    "pods": "110"}).obj())
+        sched = _sched(store, columnar=coalesce, **kw)
+        store.create_many(
+            "pods", [MakePod(f"p-{i}").req({"cpu": "500m"}).obj()
+                     for i in range(40)], consume=True)
+        sched.run_until_idle()
+        sched.pump_events()
+        placements = sorted((p.metadata.name, p.spec.node_name)
+                            for p in store.list("pods")[0])
+        events = [(e.kind, e.type, e.obj.metadata.name)
+                  for e in store._history]
+        return placements, events
+
+    assert run() == run(rank_align=False, gang_preemption=False)
+
+
+# -- the randomized never-partially-evicted sweep ------------------------------
+
+
+def test_randomized_never_partially_evicted_sweep():
+    """Property sweep (acceptance): across random topologies, filler loads,
+    and gang shapes, a gang is only ever FULLY placed or FULLY unplaced;
+    evictions happen only when a cover was proven (and the gang then lands
+    whole); a veto evicts NOTHING; and every gang pod is conserved."""
+    rng = np.random.default_rng(1234)
+    for trial in range(6):
+        n_slices = int(rng.integers(1, 4))
+        per_slice = int(rng.integers(2, 5))
+        node_cpu = int(rng.integers(6, 13))
+        filler_cpu = int(rng.integers(2, node_cpu))
+        gang_cpu = int(rng.integers(1, 5))
+        members = int(rng.integers(2, 11))
+        gang_prio = int(rng.integers(0, 3)) * 100  # sometimes BELOW fillers
+        filler_prio = 50
+
+        store = APIStore()
+        _slice_cluster(store, n_slices=n_slices, per_slice=per_slice,
+                       cpu=str(node_cpu))
+        fillers = _fillers(store, n_slices=n_slices, per_slice=per_slice,
+                           cpu=str(filler_cpu), prio=filler_prio)
+        sched = _sched(store)
+        _sync_preemption(sched)
+        pods = _gang(store, members, cpu=str(gang_cpu), prio=gang_prio)
+        _drive(sched, store, want=members, deadline_s=6.0)
+        sched.run_until_idle()
+        sched.pump_events()
+
+        bound = _gang_bound(store)
+        ctx = dict(trial=trial, n_slices=n_slices, per_slice=per_slice,
+                   node_cpu=node_cpu, filler_cpu=filler_cpu,
+                   gang_cpu=gang_cpu, members=members, gang_prio=gang_prio,
+                   bound=len(bound), stats=sched.gangpreempt.stats())
+        # all-or-nothing: never a half-bound gang
+        assert len(bound) in (0, members), ctx
+        evicted = len(fillers) - len(
+            [p for p in store.list("pods")[0]
+             if p.metadata.name.startswith("low-")])
+        stats = sched.gangpreempt.stats()
+        if stats["preempted"] == 0:
+            # no cover fired -> not one victim may be gone
+            assert evicted == 0, ctx
+        else:
+            # a cover fired -> the gang landed WHOLE (the proof held)
+            assert len(bound) == members, ctx
+        assert_pod_conservation(store, sched, [p.key for p in pods])
+
+
+# -- surfaces ------------------------------------------------------------------
+
+
+def test_sched_stats_and_ktl_render_gang_preemption():
+    from kubernetes_tpu.cli.ktl import _render_sched_stats
+
+    store = APIStore()
+    _slice_cluster(store)
+    _fillers(store)
+    sched = _sched(store)
+    _sync_preemption(sched)
+    _gang(store, 8)
+    _drive(sched, store, want=8)
+    st = sched.sched_stats()
+    gang = st["gang"]
+    assert gang["preemption"]["preempted"] == 1
+    assert gang["preemption"]["victims"] == 4
+    assert "gang_parked" in st["queue"]
+    rendered = _render_sched_stats({"default-scheduler": st})
+    assert "gang preemption:" in rendered
+    assert "victims=4" in rendered
+    # the flight record of the preempting batch carries the cover stats
+    recs = [r for r in sched.flightrec.records()
+            if r.get("gang") and r["gang"].get("preempted")]
+    assert recs and recs[-1]["gang"]["preempt_victims"] == 4
+
+
+def test_flatten_snapshot_victims_matches_snapshot():
+    store = APIStore()
+    _slice_cluster(store, n_slices=1, per_slice=2)
+    _fillers(store, n_slices=1, per_slice=2)
+    sched = _sched(store)
+    snap = sched.cache.update_snapshot()
+    cl = build_cluster_tensors(snap)
+    v_node, v_prio, v_req, v_pods, node_victims = \
+        flatten_snapshot_victims(snap, cl.resource_dims)
+    assert len(v_pods) == 2
+    assert sorted(v_prio.tolist()) == [1, 1]
+    assert v_req.shape == (2, len(cl.resource_dims))
+    assert sum(len(v) for v in node_victims) == 2
+    assert pdb_blocked_mask(v_pods, []).tolist() == [False, False]

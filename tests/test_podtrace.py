@@ -555,7 +555,8 @@ class TestQueueTelemetry:
         stats = sched.sched_stats()
         q = stats["queue"]
         assert set(q) == {"active", "backoff", "unschedulable",
-                          "gang_staged", "oldest_pending_age_s"}
+                          "gang_staged", "gang_parked",
+                          "oldest_pending_age_s"}
         assert q["active"] == 0 and q["oldest_pending_age_s"] == 0.0
         # the gauges were fed (per pump, not per pod)
         assert m.queue_depth.value(tier="active") == 0.0

@@ -559,6 +559,102 @@ def test_jt002_quiet_on_host_readback_outside_repair_kernel():
     assert "JT002" not in rules_of(analyze_source(JT002_REPAIR_GOOD))
 
 
+# ISSUE 14: the gang victim-cover / rank-adjacency kernels' static-gate
+# discipline (models/gangcover.py). cover_curve keys on pow2 node/victim
+# buckets and rank_align_kernel on the pow2 pod axis; the guarded bug class
+# is keying either on a RAW slice size / victim count / batch length — one
+# compile per cluster shape or per cover attempt.
+
+JT001_GANGCOVER_BAD = '''
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("n_slots", "k_max"))
+def cover_curve(free, v_node, n_slots, k_max):
+    return free[:n_slots], v_node[:k_max]
+
+def cover_curves(free, v_node):
+    # raw slice-node and victim counts key the jit: a compile per slice
+    # shape AND per candidate-victim count
+    return cover_curve(free, v_node, n_slots=len(free),
+                       k_max=len(v_node))
+'''
+
+JT001_GANGCOVER_GOOD = '''
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("n_slots", "k_max"))
+def cover_curve(free, v_node, n_slots, k_max):
+    return free[:n_slots], v_node[:k_max]
+
+def cover_curves(free, v_node, ns, k):
+    # the shipped discipline: pow2 buckets over both padded axes
+    n_slots = 1 << max(0, ns - 1).bit_length()
+    k_max = 1 << max(0, k - 1).bit_length()
+    return cover_curve(free, v_node, n_slots=n_slots, k_max=k_max)
+'''
+
+
+def test_jt001_fires_on_gangcover_raw_static_keys():
+    findings = [f for f in analyze_source(JT001_GANGCOVER_BAD)
+                if f.rule == "JT001"]
+    assert len(findings) >= 1, findings
+    assert any("n_slots" in f.message or "k_max" in f.message
+               for f in findings)
+
+
+def test_jt001_quiet_on_gangcover_shipped_buckets():
+    assert "JT001" not in rules_of(analyze_source(JT001_GANGCOVER_GOOD))
+
+
+JT002_GANGCOVER_BAD = '''
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@functools.partial(jax.jit, static_argnames=("p_max",))
+def rank_align_kernel(assignment, group_id, rank, pos_key, p_max):
+    idx = jnp.arange(p_max)
+    order_rank = jnp.lexsort((idx, rank, group_id))
+    # host sort INSIDE the traced body: a device round-trip per call
+    order_pos = np.lexsort((np.asarray(idx), np.asarray(pos_key)))
+    return assignment[order_rank], order_pos
+'''
+
+JT002_GANGCOVER_GOOD = '''
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@functools.partial(jax.jit, static_argnames=("p_max",))
+def rank_align_kernel(assignment, group_id, rank, pos_key, p_max):
+    idx = jnp.arange(p_max)
+    order_rank = jnp.lexsort((idx, rank, group_id))
+    order_pos = jnp.lexsort((idx, pos_key, group_id))
+    return jnp.zeros_like(assignment).at[order_rank].set(
+        assignment[order_pos])
+
+def rank_align(assignment, group_id, rank, pos_key, p):
+    # the shipped discipline: numpy padding happens OUTSIDE the traced body
+    p_max = 1 << max(0, p - 1).bit_length()
+    a = np.asarray(assignment)
+    return rank_align_kernel(a, group_id, rank, pos_key, p_max=p_max)
+'''
+
+
+def test_jt002_fires_on_host_sort_inside_gangcover_kernel():
+    findings = [f for f in analyze_source(JT002_GANGCOVER_BAD)
+                if f.rule == "JT002"]
+    assert len(findings) >= 1, findings
+
+
+def test_jt002_quiet_on_host_padding_outside_gangcover_kernel():
+    assert "JT002" not in rules_of(analyze_source(JT002_GANGCOVER_GOOD))
+
+
 HP001_BAD = '''
 import time
 
