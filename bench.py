@@ -1165,8 +1165,11 @@ def rung_bind_commit(results):
 
         n, chunk = 20_000, 4096
 
-        def run_once(native):
-            store = APIStore(native_commit=native)
+        def run_once(native, columnar=False):
+            # columnar=False pins the DICT commit path for the legacy
+            # python-vs-native columns; the columnar legs (ISSUE 15) run
+            # the same workload through the column-write commit
+            store = APIStore(native_commit=native, columnar=columnar)
             w = store.watch(kind=("pods",), coalesce=True)
             store.create_many(
                 "pods", (MakePod(f"bc-{i}").req({"cpu": "100m"}).obj()
@@ -1202,32 +1205,62 @@ def rung_bind_commit(results):
         # Interleaved best-of-2 per mode (P,N,P,N): harness co-scheduling
         # drifts on a 2-core rig, and alternating the modes keeps the drift
         # from landing entirely on one column.
+        from kubernetes_tpu.store import columnar as _columnar_mod
+
         native_ok = hostcommit.available()
+        columnar_ok = (_columnar_mod.numpy_available()
+                       and _columnar_mod.env_enabled())
         bound, _warm = run_once(native_ok)  # warm-up (faults obmalloc arenas)
-        py_runs, nat_runs = [], []
+        # interleaved best-of-2 per mode (the BindCommit discipline), the
+        # columnar A/B leg riding the same rounds: dict-python, dict-native,
+        # columnar — the µs/pod dict-vs-columnar pair is a SAME-BOX
+        # interleaved A/B by construction (BENCH_r12 discipline: rig core
+        # counts vary across the series, so only same-box pairs compare)
+        py_runs, nat_runs, col_runs = [], [], []
         for _ in range(2):
             py_runs.append(run_once(False)[1])
             if native_ok:
                 nat_runs.append(run_once(True)[1])
+            if columnar_ok:
+                col_runs.append(run_once(native_ok, columnar=True)[1])
         dt_py = min(py_runs)
         dt = min(nat_runs) if native_ok else dt_py
-        pps = n / dt
+        dt_col = min(col_runs) if columnar_ok else None
+        us_dict = dt / n * 1e6
+        pps = n / (dt_col if dt_col is not None else dt)
         results["BindCommit_20k"] = {
-            "pods_per_sec": round(pps, 1), "wall_s": round(dt, 4),
-            "placed": bound, "pods": n, "us_per_pod": round(dt / n * 1e6, 2),
+            "pods_per_sec": round(pps, 1),
+            "wall_s": round(dt_col if dt_col is not None else dt, 4),
+            "placed": bound, "pods": n,
+            "us_per_pod": round((dt_col if dt_col is not None else dt)
+                                / n * 1e6, 2),
             "native": {
                 "available": native_ok,
                 "us_per_pod_python": round(dt_py / n * 1e6, 2),
                 "us_per_pod_native": (round(dt / n * 1e6, 2)
                                       if native_ok else None),
             },
-            "solver": ("bind_many-native" if native_ok
+            # columnar pod-row store (ISSUE 15): dict vs columnar on the
+            # SAME box, interleaved; honesty flags per the r12 discipline
+            "columnar": dict({
+                "available": columnar_ok,
+                "us_per_pod_dict": round(us_dict, 2),
+                "us_per_pod_columnar": (round(dt_col / n * 1e6, 2)
+                                        if dt_col is not None else None),
+                "speedup": (round(dt / dt_col, 2)
+                            if dt_col is not None else None),
+                "ab_comparable": True,  # interleaved same-box by design
+            }, **_rig_info()),
+            "solver": ("bind_many-columnar" if columnar_ok
+                       else "bind_many-native" if native_ok
                        else "bind_many-python")}
         print(f"{'BindCommit_20k':>28}: {pps:>9.0f} pods/s  "
               f"({bound}/{n} bound, python {dt_py / n * 1e6:.1f}us/pod"
-              + (f", native {dt / n * 1e6:.1f}us/pod" if native_ok
-                 else ", native unavailable") + ")",
-              file=sys.stderr)
+              + (f", native {us_dict:.1f}us/pod" if native_ok
+                 else ", native unavailable")
+              + (f", columnar {dt_col / n * 1e6:.2f}us/pod"
+                 if dt_col is not None else ", columnar unavailable")
+              + ")", file=sys.stderr)
     except Exception as e:
         results["BindCommit_20k"] = {"error": str(e)[:200]}
         print(f"BindCommit_20k: ERROR {e}", file=sys.stderr)

@@ -1,14 +1,17 @@
 """LK001 / LK002 — the store's lock discipline.
 
-LK001 (lock-order inversion): the module docstring of store/store.py mandates
-`_lock` (global RV) -> `_pods_lock` (pods shard), never the reverse. We build
-a per-function acquisition model over `with` statements (including the
-`_pods_pair` / `_kind_lock()` / `transaction()` composite acquirers, which
-take global-then-shard and are therefore order-safe to ENTER but count as a
-fresh global acquisition), close "may acquire" summaries over the resolved
-call graph, and flag any point where the shard is definitely held, the
-global lock is not, and a global acquisition (direct or via a call path)
-follows.
+LK001 (lock-order inversion): the module docstring of store/store.py
+mandates the RANKED chain `_lock` (global RV, rank 0) -> `_pods_lock` (pods
+shard, rank 1) -> `_nodes_lock` (nodes shard, rank 2; ISSUE 15 satellite) —
+acquire strictly in ascending rank, never backwards. We build a
+per-function acquisition model over `with` statements (including the
+`_pods_pair` / `_nodes_pair` / `_store_chain` / `_kind_lock()` /
+`transaction()` composite acquirers, which enter in rank order and are
+therefore order-safe to ENTER but count as a fresh global acquisition),
+close "may acquire" summaries over the resolved call graph, and flag any
+point where a shard is definitely held, the global lock is not, and an
+acquisition of LOWER rank (the global lock, a composite, or a lower-ranked
+shard — direct or via a call path) follows.
 
 LK001 partition extension (ISSUE 12): the partitioned dispatch layer's
 locks — `PartitionRouter._route_lock` and
@@ -43,7 +46,14 @@ from ..index import FileIndex, FuncInfo, ProjectIndex
 
 GLOBAL = ("APIStore", "_lock")
 SHARD = ("APIStore", "_pods_lock")
-PAIR = ("APIStore", "<pair>")  # global-then-shard composite (order-safe)
+NODES_SHARD = ("APIStore", "_nodes_lock")
+PAIR = ("APIStore", "<pair>")  # global-then-shard(s) composite (order-safe)
+
+# the ranked shard set (store/store.py ordering table). Generalizing LK001
+# (ISSUE 15 satellite): holding a shard of rank r, any acquisition of rank
+# < r — the global lock, a composite (which starts at the global lock), or
+# a lower-ranked shard — is an inversion.
+SHARD_RANKS = {SHARD: 1, NODES_SHARD: 2}
 
 # Partitioned-dispatch locks (ISSUE 12, scheduler/partition.py): LEAF locks
 # ordered strictly AFTER the store chain — code holding one may touch only
@@ -56,7 +66,7 @@ PART_LOCKS = frozenset({
     ("PartitionRouter", "_route_lock"),
     ("PartitionedScheduler", "_dispatch_lock"),
 })
-STORE_LOCKS = frozenset({GLOBAL, SHARD, PAIR})
+STORE_LOCKS = frozenset({GLOBAL, SHARD, NODES_SHARD, PAIR})
 
 _QUEUEISH = re.compile(r"(^|_)q$|queue", re.IGNORECASE)
 
@@ -102,8 +112,8 @@ class _FuncModel:
         # reachable-under-lock BFS)
         self.locked_calls: List[Tuple[ast.Call, Optional[FuncInfo], str]] = []
         self.blocking_sites: List[Tuple[ast.AST, str]] = []
-        # LK001 candidates: (call node, callee, lock-state description)
-        self.inversion_call_sites: List[Tuple[ast.Call, FuncInfo]] = []
+        # LK001 candidates: (call node, callee, definitely-held shard rank)
+        self.inversion_call_sites: List[Tuple[ast.Call, FuncInfo, int]] = []
         self.inversion_direct: List[Tuple[ast.AST, str]] = []
         # calls made while a partition/dispatch LEAF lock is definitely held
         # (ISSUE 12): any callee that may acquire a store lock is an LK001
@@ -116,7 +126,7 @@ def _classify_lock(expr: ast.AST, func: FuncInfo,
     cls = func.class_name or "<module>"
     if isinstance(expr, ast.Attribute):
         attr = expr.attr
-        if attr == "_pods_pair":
+        if attr in ("_pods_pair", "_nodes_pair", "_store_chain"):
             return {PAIR}
         if "lock" in attr or attr.endswith("_pair"):
             if isinstance(expr.value, ast.Name) and expr.value.id == "self":
@@ -128,6 +138,15 @@ def _classify_lock(expr: ast.AST, func: FuncInfo,
         if seg in ("_kind_lock", "transaction"):
             return {PAIR}
         return None
+    if isinstance(expr, ast.IfExp) and depth < 4:
+        # conditional lock selection (get()'s per-kind shard pick): either
+        # branch may be the acquired lock
+        toks: Set[Tuple[str, str]] = set()
+        for sub in (expr.body, expr.orelse):
+            got = _classify_lock(sub, func, depth + 1)
+            if got:
+                toks |= got
+        return toks or None
     if isinstance(expr, ast.Name) and depth < 4:
         toks: Set[Tuple[str, str]] = set()
         for rhs in _local_assignments(func.node, expr.id):
@@ -200,8 +219,16 @@ class _Walker:
 
     # lock-state queries -------------------------------------------------------
 
-    def _shard_definite(self) -> bool:
-        return any(fr == {SHARD} for fr in self.frames)
+    def _definite_shard_rank(self) -> int:
+        """Highest rank among frames that are DEFINITELY one held shard
+        (a single-token frame naming a ranked shard); 0 = none held."""
+        r = 0
+        for fr in self.frames:
+            if len(fr) == 1:
+                rank = SHARD_RANKS.get(next(iter(fr)), 0)
+                if rank > r:
+                    r = rank
+        return r
 
     def _part_definite(self) -> bool:
         return any(fr and fr <= PART_LOCKS for fr in self.frames)
@@ -248,13 +275,24 @@ class _Walker:
 
     def _note_acquisition(self, node: ast.AST,
                           toks: Set[Tuple[str, str]]) -> None:
-        self.m.direct_acquires |= ({GLOBAL, SHARD} if PAIR in toks
-                                   else toks)
-        if self._shard_definite() and not self._global_possible():
+        # a composite may be any of the pair/chain helpers: it may acquire
+        # the global lock and any shard (conservative for the call-graph
+        # closure; always order-safe to enter directly)
+        self.m.direct_acquires |= (({GLOBAL} | set(SHARD_RANKS))
+                                   if PAIR in toks else toks)
+        held = self._definite_shard_rank()
+        if held and not self._global_possible():
             if GLOBAL in toks or PAIR in toks:
                 self.m.inversion_direct.append(
-                    (node, "acquires the global RV lock while holding the "
-                           "pods shard"))
+                    (node, "acquires the global RV lock while holding a "
+                           "kind shard"))
+        if held:
+            for tok in toks:
+                if SHARD_RANKS.get(tok, held) < held:
+                    self.m.inversion_direct.append(
+                        (node, f"acquires {tok[1]} while holding a "
+                               "higher-ranked kind shard (ascending-rank "
+                               "rule, store/store.py ordering table)"))
         if self._part_definite() and toks & STORE_LOCKS:
             self.m.inversion_direct.append(
                 (node, "acquires a store lock while holding a partition/"
@@ -278,9 +316,10 @@ class _Walker:
                                   self.jitted_names, self.m.info.file)
             if desc is not None:
                 self.m.blocking_sites.append((node, desc))
-            if callee is not None and self._shard_definite() \
+            held = self._definite_shard_rank()
+            if callee is not None and held \
                     and not self._global_possible():
-                self.m.inversion_call_sites.append((node, callee))
+                self.m.inversion_call_sites.append((node, callee, held))
             if callee is not None and self._part_definite():
                 self.m.part_call_sites.append((node, callee))
 
@@ -323,15 +362,19 @@ def check(index: ProjectIndex) -> List[Finding]:
                 hint="store/store.py rule: _lock (global) -> _pods_lock "
                      "(shard), never reversed; release the shard first "
                      "(bind_many's two-phase pattern)"))
-        for call, callee in m.inversion_call_sites:
-            if GLOBAL in acquires.get(callee, ()):
+        for call, callee, held in m.inversion_call_sites:
+            acq = acquires.get(callee, set())
+            lower = GLOBAL in acq or any(
+                SHARD_RANKS.get(tok, held) < held for tok in acq)
+            if lower:
                 findings.append(Finding(
                     "LK001", info.file.rel, call.lineno,
                     f"{info.qualname}: call to {callee.qualname} can acquire "
-                    "the global RV lock while the pods shard is held",
+                    "a lower-ranked store lock while a kind shard is held",
                     hint="hoist the call out of the shard-only section or "
-                         "take the locks in docstring order (_lock -> "
-                         "_pods_lock)"))
+                         "take the locks in the ordering table's ascending "
+                         "rank (store/store.py: _lock -> _pods_lock -> "
+                         "_nodes_lock)"))
         for call, callee in m.part_call_sites:
             if acquires.get(callee, set()) & STORE_LOCKS:
                 findings.append(Finding(

@@ -12,6 +12,11 @@ taint walk:
                are CONTAINER-tainted: the returned list itself is freshly
                allocated (sorting/slicing it is fine) but its elements are
                object-tainted the moment they are indexed or iterated.
+               ISSUE 15: `<store>.pod_columns()` is an OBJECT source — the
+               columnar read path hands out live rows/views (read-only numpy
+               views + the live key/base/table lists), so writing through
+               the view (attribute or element stores, mutator calls on its
+               members) is flagged exactly like mutating an event object.
   propagation  plain data flow only: name assignment, attribute/subscript
                LOADS, tuple unpack, for-loop iteration. Calls launder taint —
                which makes every clone helper (deepcopy,
@@ -58,9 +63,11 @@ CONTAINER = "container"  # fresh container of contract-covered elements
 def _store_read_level(call: ast.Call) -> Optional[str]:
     f = call.func
     if (isinstance(f, ast.Attribute)
-            and f.attr in ("get", "list", "list_many")
+            and f.attr in ("get", "list", "list_many", "pod_columns")
             and _recv_is_store(f.value)):
-        return OBJ if f.attr == "get" else CONTAINER
+        # pod_columns() hands out the LIVE columnar view (ISSUE 15): the
+        # value itself is contract-covered, like a get() result
+        return OBJ if f.attr in ("get", "pod_columns") else CONTAINER
     return None
 
 

@@ -1378,6 +1378,16 @@ def _render_sched_stats(doc: Dict) -> str:
                 f"nodes={part.get('nodes', 0)} "
                 f"conflicts={part.get('conflicts', 0)} "
                 f"reroutes={part.get('reroutes', 0)}")
+        cols = st.get("store_columnar")
+        if cols:
+            # columnar pod-row store (ISSUE 15): diverged = rows whose bind
+            # lives in the columns only; materialized = lazy reconciliations
+            out.append(
+                f"store columnar: rows={cols.get('rows', 0)} "
+                f"bound={cols.get('bound', 0)} "
+                f"diverged={cols.get('diverged', 0)} "
+                f"materialized={cols.get('materialized_total', 0)} "
+                f"nodes_interned={cols.get('node_table', 0)}")
         brk = st.get("breaker")
         bw = st.get("bind_worker")
         if brk and (brk.get("state") != "closed" or brk.get("trips")

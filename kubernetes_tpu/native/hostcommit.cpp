@@ -498,6 +498,119 @@ fail:
   return nullptr;
 }
 
+// Columnar bind_many phase 1 (ISSUE 15; caller holds the pods shard):
+// validate each (namespace, name, node) triple against the COLUMN ARRAYS —
+// key2row lookup + node_id[row] bound check — and intern the node names,
+// with NO clone and no object walk. Outputs: rows_out/ids_out (int32,
+// caller-allocated at len(bindings); the first `count` entries are valid),
+// keys_out (list, one key string per accepted entry), errors (list of
+// (key, message), byte-identical to the Python loop in
+// store/columnar.py PodColumns.bind_prepare). Returns count.
+PyObject* hc_columnar_prepare(PyObject* key2row, PyObject* bindings,
+                              PyObject* node_ids, PyObject* node_names,
+                              PyObject* errors, PyObject* keys_out,
+                              int32_t* node_id_col, int32_t* rows_out,
+                              int32_t* ids_out) {
+  if (ensure_ready() < 0) return nullptr;
+  PyObject* fast = PySequence_Fast(bindings, "bindings must be iterable");
+  if (fast == nullptr) return nullptr;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+  PyObject** items = PySequence_Fast_ITEMS(fast);
+  PyObject* trip_owned = nullptr;
+  long count = 0;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* trip[3];
+    if (unpack_entry(items[i], 3, trip, &trip_owned,
+                     "bindings must be (namespace, name, node) triples") < 0)
+      goto fail;
+    {
+      PyObject* key = PyUnicode_FromFormat("%S/%S", trip[0], trip[1]);
+      if (key == nullptr) goto fail;
+      PyObject* row_obj = PyDict_GetItemWithError(key2row, key);
+      if (row_obj == nullptr) {
+        if (PyErr_Occurred()) {
+          Py_DECREF(key);
+          goto fail;
+        }
+        if (append_error(errors, key,
+                         PyUnicode_FromFormat("pods %U not found", key)) < 0) {
+          Py_DECREF(key);
+          goto fail;
+        }
+        Py_DECREF(key);
+        Py_CLEAR(trip_owned);
+        continue;
+      }
+      long row = PyLong_AsLong(row_obj);
+      if (row == -1 && PyErr_Occurred()) {
+        Py_DECREF(key);
+        goto fail;
+      }
+      int32_t cur = node_id_col[row];
+      if (cur >= 0) {
+        PyObject* cur_name = PyList_GetItem(node_names, (Py_ssize_t)cur);
+        if (cur_name == nullptr) {
+          Py_DECREF(key);
+          goto fail;
+        }
+        int rc = append_error(
+            errors, key,
+            PyUnicode_FromFormat("pod %U is already bound to %S", key,
+                                 cur_name));
+        Py_DECREF(key);
+        if (rc < 0) goto fail;
+        Py_CLEAR(trip_owned);
+        continue;
+      }
+      PyObject* node = trip[2];
+      long nid;
+      PyObject* nid_obj = PyDict_GetItemWithError(node_ids, node);
+      if (nid_obj == nullptr) {
+        if (PyErr_Occurred()) {
+          Py_DECREF(key);
+          goto fail;
+        }
+        nid = (long)PyList_GET_SIZE(node_names);
+        PyObject* nid_new = PyLong_FromLong(nid);
+        if (nid_new == nullptr) {
+          Py_DECREF(key);
+          goto fail;
+        }
+        // append BEFORE the dict insert: if the second step fails, the
+        // shared intern table holds only a harmless orphan list entry —
+        // the reverse order would leave a dict id past the table's end,
+        // and a LATER bind of this node name would index out of range
+        int rc = PyList_Append(node_names, node);
+        if (rc == 0) rc = PyDict_SetItem(node_ids, node, nid_new);
+        Py_DECREF(nid_new);
+        if (rc < 0) {
+          Py_DECREF(key);
+          goto fail;
+        }
+      } else {
+        nid = PyLong_AsLong(nid_obj);
+        if (nid == -1 && PyErr_Occurred()) {
+          Py_DECREF(key);
+          goto fail;
+        }
+      }
+      int rc = PyList_Append(keys_out, key);
+      Py_DECREF(key);
+      if (rc < 0) goto fail;
+      rows_out[count] = (int32_t)row;
+      ids_out[count] = (int32_t)nid;
+      count += 1;
+    }
+    Py_CLEAR(trip_owned);
+  }
+  Py_DECREF(fast);
+  return PyLong_FromLong(count);
+fail:
+  Py_XDECREF(trip_owned);
+  Py_DECREF(fast);
+  return nullptr;
+}
+
 // bind_many phase 2 (commit, caller holds global + shard): stamps a
 // contiguous RV range, swaps rows, builds one event per bind. mode: 0 =
 // share (store without isolation copies), 1 = lazy (event shares the stored

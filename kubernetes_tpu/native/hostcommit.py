@@ -64,6 +64,9 @@ def _load() -> Optional[ctypes.PyDLL]:
                 obj]
             lib.hc_assume_structural.restype = obj
             lib.hc_assume_structural.argtypes = [obj, obj, obj, obj, obj]
+            lib.hc_columnar_prepare.restype = obj
+            lib.hc_columnar_prepare.argtypes = [obj, obj, obj, obj, obj, obj,
+                                                _i32p, _i32p, _i32p]
             lib.hc_batch_rows.restype = obj
             lib.hc_batch_rows.argtypes = [obj, obj, obj, obj, obj, obj,
                                           _i32p, _i32p]
@@ -116,6 +119,27 @@ def delete_commit(pods: dict, keys, events: list, errors: list, rv: int,
     one structural clone per pod, DELETED events. Returns (final_rv, n)."""
     return _lib.hc_delete_commit(pods, keys, events, errors, rv, mode,
                                  commit_ts, cloner, etype)
+
+
+def columnar_prepare(key2row: dict, bindings, node_ids: dict,
+                     node_names: list, node_id_col: np.ndarray,
+                     errors: list) -> Tuple[np.ndarray, np.ndarray, list]:
+    """Columnar bind_many phase 1 (ISSUE 15; caller holds the pods shard):
+    the validate/intern loop of store/columnar.py PodColumns.bind_prepare
+    retargeted at the column arrays — key2row lookups + node_id[row] bound
+    checks, no clones. Returns (rows int32[count], ids int32[count], keys
+    list); mutates node_ids/node_names (the intern table) and errors exactly
+    like the Python loop. bindings must be a sequence (the store normalizes
+    iterables before calling)."""
+    n = len(bindings)
+    rows = np.empty(n, dtype=np.int32)
+    ids = np.empty(n, dtype=np.int32)
+    keys: list = []
+    if n == 0:
+        return rows, ids, keys
+    count = _lib.hc_columnar_prepare(key2row, bindings, node_ids, node_names,
+                                     errors, keys, node_id_col, rows, ids)
+    return rows[:count], ids[:count], keys
 
 
 # -- cache assume ------------------------------------------------------------

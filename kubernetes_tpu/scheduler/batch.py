@@ -1391,6 +1391,15 @@ class BatchScheduler(Scheduler):
                 "failures_logged": len(self.bind_failures),
                 "failures_dropped": self.bind_failures_dropped,
             },
+            # columnar pod-row store (ISSUE 15): rows/diverged/lazy-
+            # materialization telemetry from the store this pipeline binds
+            # into (None on the dict path) — the observable proof that the
+            # steady state stays lazy (diverged grows with binds, while
+            # materialized_total only moves when something actually reads
+            # the rows)
+            "store_columnar": (self.store.columnar_stats()
+                               if hasattr(self.store, "columnar_stats")
+                               else None),
             "recorder": {"enabled": fr.enabled, "capacity": fr.capacity,
                          "records": len(fr),
                          "self_seconds": round(fr.self_seconds, 6)},

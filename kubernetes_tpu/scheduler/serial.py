@@ -301,6 +301,11 @@ class Scheduler:
             SchedulingQueue.add_batch admission (single lock + heapify).
 
         Returns the number of per-object events ingested."""
+        # NOTE (ISSUE 15): a columnar store's bind batches carry a LAZY
+        # events sequence — len() is O(1) and materializes nothing, so the
+        # self/peer origin skips below keep the steady state allocation-free
+        # end to end (store commit AND ingest); only the foreign-batch
+        # fallthrough, which actually reads ev.obj, pays materialization.
         events = cev.events
         if cev.kind != "pods":
             for ev in events:

@@ -32,6 +32,16 @@ from .class_compiler import (
 )
 from .ipa import IPATensors, compile_ipa
 
+# The pod-carried memo slots this module owns (single source of truth —
+# ISSUE 15): `_class_sig` is the admission-primed class-signature memo
+# (pod_class_signature), `_req_sig` the spec-identity request-signature memo
+# (_req_entry below), `_req_cache` the seeded PodInfo request pair. They
+# live in pod.__dict__, so every structural/bind clone (which copies the
+# dict at the C level) carries them for free — including the columnar
+# store's lazily materialized rows (store/columnar.py captures the first
+# two as its signature-ref column and relies on exactly this contract).
+SIG_MEMO_KEYS = ("_class_sig", "_req_sig", "_req_cache")
+
 MI = 1024 * 1024
 
 

@@ -18,28 +18,43 @@ The store is thread-safe. Watch delivery is via per-subscriber unbounded queues;
 a slow watcher never blocks writers (the reference's Cacher drops/terminates slow
 watchers; we buffer instead — acceptable in-process).
 
-Concurrency (sharded locking): the store carries TWO locks so the scheduler's
-bind worker can commit whole batches without stalling every other client:
+Concurrency (sharded locking): the store carries a GLOBAL lock plus PER-KIND
+shards for its two high-traffic kinds, so the scheduler's bind worker can
+commit whole batches without stalling every other client and a kubelet
+heartbeat storm on `nodes` never queues behind a pod bind batch:
 
-  _lock      — the GLOBAL (RV) lock: resourceVersion allocation, the kind map,
-               every non-pod kind's rows, watcher registration, event history,
-               and event emission.
-  _pods_lock — the `pods` KIND SHARD: guards the pod rows only. bind_many
-               validates + clones under the shard ALONE (the expensive part),
-               so ingest/list/create traffic on other kinds proceeds
-               concurrently; the commit (contiguous RV range, row insertion,
-               event emission) then runs in ONE short critical section under
-               both locks, which keeps the List+Watch contract exact — a LIST
-               observes either none or all of the writes at the RV it returns.
+  LOCK-ORDERING TABLE (LK001 — acquire strictly in ascending rank, release
+  in any order; composite helpers below always enter in rank order):
 
-  LOCK-ORDERING RULE: _lock (RV/global) -> _pods_lock (kind shard), NEVER the
-  reverse. A thread holding the shard must not acquire the global lock
-  (bind_many RELEASES the shard between its validate and commit phases and
-  re-verifies stored-object identity instead of holding through). Reversing
-  the order deadlocks against every pod write. ENFORCED twice: statically by
-  schedlint rule LK001 (analysis/schedlint.py, tier-1-gated) and at runtime
-  by the _OrderedRLock wrappers (STORE_LOCK_ORDER_CHECK=1 / the pytest
-  autouse fixture), which raise LockOrderViolation on inversion.
+    rank | lock            | guards
+    -----+-----------------+----------------------------------------------
+      0  | _lock           | resourceVersion allocation, the kind map,
+         |                 | every non-sharded kind's rows, watcher
+         |                 | registration, event history, event emission
+      1  | _pods_lock      | the `pods` rows AND the columnar pod-row
+         |                 | table (store/columnar.py PodColumns)
+      2  | _nodes_lock     | the `nodes` rows (ISSUE 15 satellite,
+         |                 | following the pods-shard precedent)
+    leaf | partition locks | PartitionRouter._route_lock /
+         |                 | PartitionedScheduler._dispatch_lock — strictly
+         |                 | after the whole store chain
+         |                 | (scheduler/partition.py lock discipline)
+
+  bind_many validates under the pods shard ALONE (the expensive part), so
+  ingest/list/create traffic on other kinds proceeds concurrently; the
+  commit (contiguous RV range, row/column writes, event emission) then runs
+  in ONE short critical section under global + shard, which keeps the
+  List+Watch contract exact — a LIST observes either none or all of the
+  writes at the RV it returns.
+
+  GENERALIZED ORDERING RULE: a thread holding any shard must not acquire a
+  lock of LOWER rank (bind_many RELEASES the shard between its validate and
+  commit phases and re-validates raced rows instead of holding through).
+  Reversing the order deadlocks against every writer of that kind. ENFORCED
+  twice: statically by schedlint rule LK001 (analysis/rules/locks.py,
+  tier-1-gated, generalized over the ranked shard set) and at runtime by
+  the _OrderedRLock wrappers (STORE_LOCK_ORDER_CHECK=1 / the pytest autouse
+  fixture), which raise LockOrderViolation on inversion.
 
 Event allocation (clone-free commits): pod events on the bind / status /
 delete hot paths are LAZY — the Event initially SHARES the stored object
@@ -71,6 +86,26 @@ HOSTSCHED_NATIVE_COMMIT kill switch.
   while holding a store lock invites every classic lock/GIL interleaving
   (a GIL-waiting thread that needs this lock, a lock-waiting thread that
   holds the GIL). schedlint flags them like any other blocking call.
+
+Columnar pod-row store (ISSUE 15): when numpy is available (and
+STORE_COLUMNAR / APIStore(columnar=) don't opt out), the pod rows ALSO live
+in a struct-of-arrays table (store/columnar.py PodColumns: interned
+node/namespace/phase ids, rv/priority/rank int columns, gang keys and
+signature-memo refs) and bind_many commits by COLUMN WRITES — node_id[rows],
+a contiguous rv range, one diverged-bitmap set, ONE LazyBindBatch event
+marker per chunk — with ZERO per-pod dict/Event allocation on the
+steady-state path. The full Pod object of a bound row, and the per-object
+Events of the batch, materialize LAZILY (at most once) when an API read, a
+non-coalescing watcher, a history replay, or a cold field access needs them
+— the ISSUE 4 lazy-event idiom extended from events to rows. Every other
+write path (create/update/status/delete, the single bind) stays on the dict
+rows and keeps the columns coherent via PodColumns.sync/insert/remove; a
+diverged row (columns ahead of the dict object) is reconciled by
+_materialize_pod_row before any dict-path read or write touches it. The
+dict store remains bit-for-bit the oracle: STORE_COLUMNAR=0, columnar=False,
+a missing numpy, or a store without the lazy/deep-copy event contract all
+run the pure dict path end to end (tests/test_columnar_store.py pins
+placements, RV sequence, and event streams byte-identical across the two).
 """
 
 from __future__ import annotations
@@ -87,6 +122,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..api.types import Pod
 from ..chaos import faultinject as _chaos
+from . import columnar as _columnar
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
@@ -154,6 +190,113 @@ class CoalescedEvent:
     # fast path must carry it too, or propagation histograms would silently
     # exclude the NorthStar ingest path). 0.0 = tracing disabled.
     commit_ts: float = 0.0
+
+
+class LazyBindBatch:
+    """ONE history/event marker for a whole columnar bind_many chunk
+    (ISSUE 15) — the lazy-event idiom extended from events to rows. The
+    commit captures only O(batch) state: the key strings, the PRE-bind base
+    object refs (the events' `prev`), the interned node ids (plus a ref to
+    the append-only name table, so resolution is lock-free on any thread),
+    the first rv of the contiguous range, and the shared commit stamp.
+
+    Per-object Events materialize AT MOST ONCE for the whole consumer set
+    (`events()`, double-checked under a per-batch lock): each gets a fresh
+    bind clone of its base with the committed node/rv applied and a lazy
+    slot ([None, cloner]) so non-coalescing watchers receive their private
+    clones through the ordinary _materialize_event path. Field-for-field
+    the stream is identical to the dict path's; identity-wise the event
+    objects are private to the batch (never the stored row), which is
+    strictly safer under the read-only event contract. In the scheduler
+    steady state — only coalescing watchers, origin-tagged self-skip — a
+    100k-bind run never materializes any of it."""
+
+    __slots__ = ("type", "kind", "rv0", "n", "keys", "bases", "node_ids",
+                 "node_names", "cloner", "commit_ts", "_mat", "_mlock")
+
+    def __init__(self, etype: str, rv0: int, keys, bases, node_ids,
+                 node_names, cloner, commit_ts: float):
+        self.type = etype
+        self.kind = "pods"
+        self.rv0 = rv0  # rv of the FIRST event; the range is contiguous
+        self.n = len(keys)
+        self.keys = keys
+        self.bases = bases
+        self.node_ids = node_ids
+        self.node_names = node_names  # append-only intern table (shared ref)
+        self.cloner = cloner
+        self.commit_ts = commit_ts
+        self._mat = None  # materialized per-object Event list (once)
+        self._mlock = threading.Lock()
+
+    def __len__(self) -> int:
+        return self.n
+
+    @property
+    def resource_version(self) -> int:
+        """The LAST rv of the batch (watch-watermark semantics, matching
+        CoalescedEvent.resource_version)."""
+        return self.rv0 + self.n - 1
+
+    def count_since(self, since_rv: int) -> int:
+        """How many of this batch's events have rv > since_rv."""
+        if since_rv < self.rv0:
+            return self.n
+        return max(0, self.n - (since_rv - self.rv0 + 1))
+
+    def events(self) -> List["Event"]:
+        """The batch's per-object events in rv order (materialized once,
+        thread-safe: consumers iterate on their own threads outside any
+        store lock; builds touch only batch-captured refs, never the store,
+        so taking the batch lock under the store lock — replay — is safe)."""
+        mat = self._mat
+        if mat is not None:
+            return mat
+        with self._mlock:
+            if self._mat is None:
+                cloner = self.cloner
+                names = self.node_names
+                ids = self.node_ids.tolist() if hasattr(
+                    self.node_ids, "tolist") else list(self.node_ids)
+                rv = self.rv0
+                etype = self.type
+                ts = self.commit_ts
+                out = []
+                for i in range(self.n):
+                    base = self.bases[i]
+                    obj = cloner(base)
+                    obj.spec.node_name = names[ids[i]]
+                    obj.metadata.resource_version = rv + i
+                    out.append(_make_event(etype, "pods", obj, rv + i, base,
+                                           [None, cloner], ts))
+                self._mat = out
+            return self._mat
+
+    def events_since(self, since_rv: int) -> List["Event"]:
+        evs = self.events()
+        if since_rv < self.rv0:
+            return evs
+        return evs[since_rv - self.rv0 + 1:]
+
+
+class _LazyEventSeq:
+    """The `events` member of a columnar CoalescedEvent: len() is O(1) (the
+    scheduler's origin-tagged self/peer skip), iteration/indexing
+    materializes the batch once for every consumer."""
+
+    __slots__ = ("_batch",)
+
+    def __init__(self, batch: LazyBindBatch):
+        self._batch = batch
+
+    def __len__(self) -> int:
+        return self._batch.n
+
+    def __iter__(self):
+        return iter(self._batch.events())
+
+    def __getitem__(self, i):
+        return self._batch.events()[i]
 
 
 class ConflictError(Exception):
@@ -598,6 +741,27 @@ class _LockPair:
         self.a.release()
 
 
+class _LockChain:
+    """_LockPair generalized to the full ranked chain (ISSUE 15 satellite:
+    the nodes shard makes three): acquires every lock in the ordering
+    table's ascending-rank order, releases in reverse. Safe to nest under
+    any prefix of itself (all RLocks)."""
+
+    __slots__ = ("locks",)
+
+    def __init__(self, *locks):
+        self.locks = locks
+
+    def __enter__(self):
+        for lk in self.locks:
+            lk.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        for lk in reversed(self.locks):
+            lk.release()
+
+
 class APIStore:
     """The hub every component is a client of (SURVEY.md §1)."""
 
@@ -607,7 +771,8 @@ class APIStore:
                  lock_order_check: Optional[bool] = None,
                  watch_propagation: bool = True,
                  native_commit: Optional[bool] = None,
-                 history_limit: int = 200_000):
+                 columnar: Optional[bool] = None,
+                 history_limit: int = 50_000):
         import os
 
         if lock_order_check is None:
@@ -619,12 +784,18 @@ class APIStore:
             self._lock = _OrderedRLock("_lock (global RV)", 0, state)
             self._pods_lock = _OrderedRLock("_pods_lock (pods shard)", 1,
                                             state)
+            self._nodes_lock = _OrderedRLock("_nodes_lock (nodes shard)", 2,
+                                             state)
         else:
             self._lock = threading.RLock()
-            # the `pods` kind shard — see the module docstring's
-            # lock-ordering rule (_lock -> _pods_lock, never reversed)
+            # the per-kind shards — see the module docstring's lock-ordering
+            # TABLE (_lock -> _pods_lock -> _nodes_lock, ascending rank only)
             self._pods_lock = threading.RLock()
+            self._nodes_lock = threading.RLock()
         self._pods_pair = _LockPair(self._lock, self._pods_lock)
+        self._nodes_pair = _LockPair(self._lock, self._nodes_lock)
+        self._store_chain = _LockChain(self._lock, self._pods_lock,
+                                       self._nodes_lock)
         self._rv = 0  # monotonic resourceVersion, read via .rv
         if mutation_detector is None:
             mutation_detector = os.environ.get(
@@ -646,17 +817,36 @@ class APIStore:
             native_commit = os.environ.get(
                 "STORE_NATIVE_COMMIT", "").lower() not in ("0", "false")
         self._native_commit = native_commit
-        # kind -> {"namespace/name" or "name": obj}. The pods row dict exists
-        # from birth so shard-only paths never mutate the kind map.
-        self._objects: Dict[str, Dict[str, Any]] = {"pods": {}}
-        # bounded event history for watch replay (RV-ordered). The bound is
-        # the store's steady-state memory knob (ISSUE 13): each retained
-        # event pins an object clone, so a churning control plane holds
-        # ~history_limit x pod-size bytes HERE at equilibrium — the
-        # NorthStar_1M soak rung sizes it to a few churn waves (a resume
-        # older than the floor relists, the contract subscribers already
-        # handle) and the rss/alloc trend gates verify the plateau.
-        self._history: List[Event] = []
+        # columnar pod-row table (ISSUE 15, module docstring): default on
+        # when numpy is importable AND the store carries the lazy/deep-copy
+        # event contract the column commit path is written against; the
+        # env/constructor knobs and a numpy-less rig all fall back to the
+        # pure dict path (the byte-for-bit oracle).
+        if columnar is None:
+            columnar = _columnar.env_enabled()
+        self._cols = (_columnar.PodColumns(pod_bind_clone)
+                      if columnar and _columnar.numpy_available()
+                      and deep_copy_on_write and self._lazy_pod_events
+                      else None)
+        # kind -> {"namespace/name" or "name": obj}. The sharded kinds' row
+        # dicts exist from birth so shard-only paths never mutate the kind
+        # map. NOTE: a pod row may be STALE while its columnar row is
+        # diverged (bind committed by column writes only) — internal readers
+        # go through _materialize_pod_row / _materialize_pod_rows first.
+        self._objects: Dict[str, Dict[str, Any]] = {"pods": {}, "nodes": {}}
+        # bounded event history for watch replay (RV-ordered; columnar bind
+        # chunks retain ONE LazyBindBatch marker each). The bound is the
+        # store's steady-state memory knob (ISSUE 13): each retained eager
+        # event pins an object clone (a lazy batch pins only base refs), so
+        # a churning control plane holds up to ~history_limit x pod-size
+        # bytes HERE at equilibrium. The default is BOUNDED a few churn
+        # waves deep (ISSUE 15 satellite: the 200k-event watch-replay leak
+        # the first soak run caught must be impossible to reintroduce by
+        # forgetting the kwarg) — a resume older than the floor relists,
+        # the contract subscribers already handle, and the rss/alloc trend
+        # gates verify the plateau.
+        self._history: List[Any] = []
+        self._history_n = 0  # EVENT count (batch markers count their size)
         self._history_limit = history_limit
         # all events with rv > _history_floor_rv are retained
         self._history_floor_rv = 0
@@ -699,9 +889,28 @@ class APIStore:
 
     def _kind_lock(self, kind: str):
         """The lock(s) an op touching `kind` rows plus RV/history must hold:
-        the global lock alone for most kinds, global + shard (in that order)
-        for pods."""
-        return self._pods_pair if kind == "pods" else self._lock
+        the global lock alone for most kinds, global + shard (ascending rank
+        order) for the sharded kinds (pods, nodes)."""
+        if kind == "pods":
+            return self._pods_pair
+        if kind == "nodes":
+            return self._nodes_pair
+        return self._lock
+
+    def _materialize_pod_row(self, key: str) -> None:
+        """Reconcile ONE diverged columnar row into its dict object before a
+        dict-path read/write touches it (caller holds the pods shard). No-op
+        on the dict path or for clean/missing rows."""
+        if self._cols is not None:
+            self._cols.materialize_key(key, self._objects["pods"])
+
+    def _materialize_pod_rows(self) -> None:
+        """Reconcile EVERY diverged columnar row (LIST / snapshot reads;
+        caller holds the pods shard). Cost is one bind clone per row bound
+        since the last full read — exactly the clones the columnar commit
+        skipped, paid once and only when someone actually reads the rows."""
+        if self._cols is not None:
+            self._cols.materialize_all(self._objects["pods"])
 
     @staticmethod
     def object_key(obj) -> str:
@@ -811,10 +1020,8 @@ class APIStore:
         if self._mutation_detector is not None:
             self._mutation_detector.record(ev)
         self._history.append(ev)
-        if len(self._history) > self._history_limit:
-            drop = self._history_limit // 4
-            self._history_floor_rv = self._history[drop - 1].resource_version
-            del self._history[:drop]
+        self._history_n += 1
+        self._trim_history()
         # snapshot: _deliver may evict (unsubscribe) a slow watcher mid-loop
         for w in list(self._watchers):
             if ev.lazy is not None and not w.coalesce:
@@ -836,10 +1043,8 @@ class APIStore:
             for ev in events:
                 self._mutation_detector.record(ev)
         self._history.extend(events)
-        if len(self._history) > self._history_limit:
-            drop = len(self._history) - self._history_limit + self._history_limit // 4
-            self._history_floor_rv = self._history[drop - 1].resource_version
-            del self._history[:drop]
+        self._history_n += len(events)
+        self._trim_history()
         cev = None
         mat = None
         for w in list(self._watchers):
@@ -858,6 +1063,41 @@ class APIStore:
                 for ev in mat:
                     w._deliver(ev)
 
+    def _trim_history(self) -> None:
+        """Enforce the retained-event bound (caller holds _lock). History
+        items are Events or whole LazyBindBatch markers; trimming drops
+        whole items from the front until the overshoot plus a quarter of the
+        bound is gone (hysteresis: one trim per ~limit/4 events, not one per
+        event) and advances the replay floor to the last dropped rv."""
+        if self._history_n <= self._history_limit:
+            return
+        target = (self._history_n - self._history_limit
+                  + self._history_limit // 4)
+        dropped = 0
+        i = 0
+        h = self._history
+        while i < len(h) and dropped < target:
+            item = h[i]
+            dropped += item.n if type(item) is LazyBindBatch else 1
+            i += 1
+        self._history_floor_rv = h[i - 1].resource_version
+        del h[:i]
+        self._history_n -= dropped
+
+    def history_events(self, since_rv: int = -1):
+        """Flat per-object iteration of the retained history with rv >
+        since_rv — the debug/testing read surface (pod-conservation audits,
+        bind-transition counts). Columnar bind batches materialize their
+        per-object events on demand; items are read-only like any event."""
+        with self._lock:
+            items = list(self._history)
+        for item in items:
+            if type(item) is LazyBindBatch:
+                for ev in item.events_since(since_rv):
+                    yield ev
+            elif item.resource_version > since_rv:
+                yield item
+
     # -- CRUD ------------------------------------------------------------------
 
     def create(self, kind: str, obj) -> Any:
@@ -870,6 +1110,8 @@ class APIStore:
             self._rv += 1
             obj.metadata.resource_version = self._rv
             objs[key] = obj
+            if kind == "pods" and self._cols is not None:
+                self._cols.insert(key, obj)
             self._emit(ADDED, kind, obj)
             return obj
 
@@ -890,6 +1132,7 @@ class APIStore:
         events: List[Event] = []
         with self._kind_lock(kind):
             objs = self._objects.setdefault(kind, {})
+            cols = self._cols if kind == "pods" else None
             # ONE shared commit stamp for the whole batch (ISSUE 9): the
             # coalesced ingest path must carry propagation stamps too
             t_commit = self._commit_stamp()
@@ -903,6 +1146,8 @@ class APIStore:
                 self._rv += 1
                 obj.metadata.resource_version = self._rv
                 objs[key] = obj
+                if cols is not None:
+                    cols.insert(key, obj)
                 events.append(_make_event(ADDED, kind, self._event_copy(obj),
                                           self._rv, commit_ts=t_commit))
                 created += 1
@@ -912,11 +1157,20 @@ class APIStore:
     def get(self, kind: str, key: str) -> Any:
         """Returns a copy (when deep_copy_on_write) — like a REST GET, each read is a
         fresh decode, so caller mutation can never corrupt stored state.
-        Pod reads take the kind shard alone (no RV is returned, and every
-        pod-row commit holds the shard), so a bind batch in its clone phase
-        never stalls them on the global lock."""
-        lock = self._pods_lock if kind == "pods" else self._lock
+        Sharded-kind reads take the kind shard alone (no RV is returned, and
+        every row commit of that kind holds its shard), so a bind batch in
+        its validate phase never stalls them on the global lock."""
+        if kind == "pods":
+            lock = self._pods_lock
+        elif kind == "nodes":
+            lock = self._nodes_lock
+        else:
+            lock = self._lock
         with lock:
+            if kind == "pods":
+                # a columnar-bound row materializes on first read (shard
+                # alone suffices: no RV allocation, no event emission)
+                self._materialize_pod_row(key)
             try:
                 return self._copy(self._objects.get(kind, {})[key])
             except KeyError:
@@ -926,6 +1180,11 @@ class APIStore:
         with self._kind_lock(kind):
             objs = self._objects.setdefault(kind, {})
             key = self.object_key(obj)
+            if kind == "pods":
+                # the rv-conflict check below must see the row's CURRENT
+                # state, not a pre-bind base a diverged columnar row stands
+                # in front of
+                self._materialize_pod_row(key)
             if key not in objs:
                 raise NotFoundError(f"{kind} {key} not found")
             if check_rv and objs[key].metadata.resource_version != obj.metadata.resource_version:
@@ -938,6 +1197,10 @@ class APIStore:
             self._rv += 1
             obj.metadata.resource_version = self._rv
             objs[key] = obj
+            if kind == "pods" and self._cols is not None:
+                row = self._cols.key2row.get(key)
+                if row is not None:
+                    self._cols.sync(row, obj)
             self._emit(MODIFIED, kind, obj, prev=old)
             return obj
 
@@ -955,9 +1218,15 @@ class APIStore:
     def delete(self, kind: str, key: str) -> Any:
         with self._kind_lock(kind):
             objs = self._objects.get(kind, {})
+            if kind == "pods":
+                # the DELETED event's clone source must carry the committed
+                # bind a diverged columnar row holds in its columns
+                self._materialize_pod_row(key)
             if key not in objs:
                 raise NotFoundError(f"{kind} {key} not found")
             old = objs.pop(key)
+            if kind == "pods" and self._cols is not None:
+                self._cols.remove(key)
             # The DELETED event carries the object at its post-delete RV (client-go
             # convention: watchers track progress from obj.metadata.resourceVersion).
             # Pods take ONE structural clone (hot under preemption victim
@@ -984,6 +1253,8 @@ class APIStore:
         """Consistent snapshot + the RV it is current to. Items are copies (when
         deep_copy_on_write), like a REST LIST response."""
         with self._kind_lock(kind):
+            if kind == "pods":
+                self._materialize_pod_rows()
             items = list(self._objects.get(kind, {}).values())
             if predicate is not None:
                 items = [o for o in items if predicate(o)]
@@ -992,10 +1263,23 @@ class APIStore:
     def list_many(self, kinds: Iterable[str]) -> Tuple[Dict[str, List[Any]], int]:
         """Consistent multi-kind snapshot under one RV — the safe way to seed an
         informer over several kinds (a per-kind list+watch would race: an object
-        created between two lists is in neither the lists nor the replay)."""
+        created between two lists is in neither the lists nor the replay).
+        Takes the global lock plus every requested shard, in the ordering
+        table's ascending-rank order."""
         kinds = list(kinds)
-        lock = self._pods_pair if "pods" in kinds else self._lock
+        has_pods = "pods" in kinds
+        has_nodes = "nodes" in kinds
+        if has_pods and has_nodes:
+            lock = self._store_chain
+        elif has_pods:
+            lock = self._pods_pair
+        elif has_nodes:
+            lock = self._nodes_pair
+        else:
+            lock = self._lock
         with lock:
+            if has_pods:
+                self._materialize_pod_rows()
             out = {k: [self._copy(o) for o in self._objects.get(k, {}).values()] for k in kinds}
             return out, self._rv
 
@@ -1012,13 +1296,18 @@ class APIStore:
         """Hold the store locks across several operations (reentrant), making
         a read-check-write sequence atomic against other threads — the
         stand-in for the reference's etcd txn around quota check+create.
-        Default (kind=None) takes global + pods shard in the mandatory order
-        — safe for any sequence. Callers that provably never touch pod rows
-        can pass their kind to take the global lock alone, so they don't
-        stall holding it behind a bind batch's shard-only clone phase."""
-        if kind is not None and kind != "pods":
+        Default (kind=None) takes the full chain (global + every shard, in
+        the ordering table's rank order) — safe for any sequence. Callers
+        that provably touch only one kind's rows can pass it to take the
+        narrower lock set, so they don't stall holding the chain behind a
+        bind batch's shard-only validate phase."""
+        if kind == "pods":
+            return self._pods_pair
+        if kind == "nodes":
+            return self._nodes_pair
+        if kind is not None:
             return self._lock
-        return self._pods_pair
+        return self._store_chain
 
     # -- watch -----------------------------------------------------------------
 
@@ -1045,11 +1334,23 @@ class APIStore:
                     f"{self._history_floor_rv}); relist required"
                 )
             replay = []
+            replay_n = 0
             if since_rv >= 0:
-                replay = [ev for ev in self._history if ev.resource_version > since_rv]
-                if maxsize and len(replay) >= maxsize:
+                # history items are Events or whole LazyBindBatch markers;
+                # count before materializing anything (a too-old resume must
+                # not pay for events it will never deliver)
+                for item in self._history:
+                    if type(item) is LazyBindBatch:
+                        c = item.count_since(since_rv)
+                        if c:
+                            replay.append(item)
+                            replay_n += c
+                    elif item.resource_version > since_rv:
+                        replay.append(item)
+                        replay_n += 1
+                if maxsize and replay_n >= maxsize:
                     raise ResourceVersionTooOldError(
-                        f"replay of {len(replay)} events from rv {since_rv} exceeds "
+                        f"replay of {replay_n} events from rv {since_rv} exceeds "
                         f"the watch buffer ({maxsize}); relist required")
             w = Watch(self, kind, maxsize=maxsize, coalesce=coalesce,
                       ring=ring)
@@ -1060,10 +1361,17 @@ class APIStore:
             # until real commits outrun the consumer.
             w._prop_min_rv = self._rv
             w.last_delivered_rv = since_rv if since_rv >= 0 else self._rv
-            for ev in replay:
+            for item in replay:
                 # a non-coalescing subscriber arriving mid/after a lazy batch
                 # must see fully private event objects, same as live delivery
-                w._deliver(ev if coalesce else self._materialize_event(ev))
+                # (replay is always per-object — columnar batches expand)
+                if type(item) is LazyBindBatch:
+                    for ev in item.events_since(since_rv):
+                        w._deliver(ev if coalesce
+                                   else self._materialize_event(ev))
+                else:
+                    w._deliver(item if coalesce
+                               else self._materialize_event(item))
             self._watchers.append(w)
             # first successful subscription: expose this store's subscribers
             # to the render-time queue-length gauge (weakref — a collected
@@ -1247,9 +1555,43 @@ class APIStore:
             "propagation": self.watch_propagation_summary(),
         }
 
+    # -- columnar read surfaces (ISSUE 15) -------------------------------------
+
+    @property
+    def columnar(self) -> bool:
+        """True when the columnar pod-row table is engaged (numpy present,
+        not opted out, lazy/deep-copy event contract)."""
+        return self._cols is not None
+
+    def pod_columns(self):
+        """Read-only view over the live pod columns (store/columnar.py
+        PodColumnsView), or None on the dict path. The view's rows/arrays
+        are STORE-RETURNED READ-ONLY objects — the same contract as event
+        objects and get/list results (schedlint MU001 recognizes this call
+        as a taint source; the numpy members also refuse writes at runtime).
+        Take it under transaction(\"pods\") for a consistent snapshot, or
+        read it lock-free as advisory telemetry."""
+        if self._cols is None:
+            return None
+        with self._pods_lock:
+            return _columnar.PodColumnsView(self._cols)
+
+    def columnar_stats(self) -> Optional[Dict]:
+        """Columnar-table telemetry (rows, diverged count, lifetime lazy
+        materializations, intern-table sizes) — what `ktl sched stats` and
+        sched_stats()[\"store_columnar\"] render; None on the dict path."""
+        if self._cols is None:
+            return None
+        with self._pods_lock:
+            return self._cols.stats()
+
     # -- scheduling-specific transactional surfaces ----------------------------
 
     def _pod_internal(self, key: str):
+        # dict-path consumers (single bind, status writes) need the CURRENT
+        # row: reconcile a diverged columnar row first (caller holds the
+        # shard, which is all materialization needs)
+        self._materialize_pod_row(key)
         try:
             return self._objects.get("pods", {})[key]
         except KeyError:
@@ -1274,6 +1616,10 @@ class APIStore:
             self._rv += 1
             new.metadata.resource_version = self._rv
             self._objects["pods"][key] = new
+            if self._cols is not None:
+                row = self._cols.key2row.get(key)
+                if row is not None:
+                    self._cols.sync(row, new)
             self._emit_event(self._pod_event(MODIFIED, new, pod_bind_clone,
                                              prev=pod))
             # the caller's copy is distinct from both the stored object and
@@ -1310,6 +1656,10 @@ class APIStore:
             # injected transient store failure (raises/delays BEFORE any
             # lock): the caller's retry/backoff is what the chaos tests prove
             _chaos.ACTIVE.fire("store.bind_many")
+        if self._cols is not None:
+            # columnar pod-row path (ISSUE 15, module docstring): commit by
+            # column writes, zero per-pod dict/Event allocation
+            return self._bind_many_columnar(bindings, origin, t0)
         errors: List[Tuple[str, str]] = []
         prepared: List = []  # (key, old stored pod, new clone, node_name)
         pods = self._objects["pods"]
@@ -1401,6 +1751,84 @@ class APIStore:
         _metrics().store_bind_many_duration.observe(time.perf_counter() - t0)
         return bound, errors
 
+    def _bind_many_columnar(self, bindings, origin: Optional[str],
+                            t0: float) -> Tuple[int, List[Tuple[str, str]]]:
+        """bind_many on the columnar pod-row table (ISSUE 15). Same two
+        phases and the same external contract as the dict path — identical
+        RV sequence, error messages, event-stream content across both
+        coalesce modes — but the commit is COLUMN WRITES (node ids, one
+        contiguous rv range, the diverged bitmap) plus ONE LazyBindBatch
+        event marker, instead of a clone-and-swap + Event per pod. Raced
+        rows between the phases are re-validated against the row-rv
+        snapshot (every row write bumps it; delete poisons it), mirroring
+        the dict path's stored-object identity check."""
+        cols = self._cols
+        errors: List[Tuple[str, str]] = []
+        native = self._native_commit_engine()
+        if native is not None:
+            bindings = bindings if isinstance(bindings, (list, tuple)) \
+                else list(bindings)
+        with self._pods_lock:
+            rows, ids, keys, rv_snap = cols.bind_prepare(
+                bindings, errors, native)
+        if not len(rows):
+            _metrics().store_bind_many_duration.observe(
+                time.perf_counter() - t0)
+            return 0, errors
+        if native is not None and _chaos.ACTIVE is not None:
+            # same injected phase-gap boundary as the dict path (ISSUE 11):
+            # rows validated, NOTHING committed, no lock held — a mid-chunk
+            # fault leaves the columns (and the dict rows) untouched
+            _chaos.ACTIVE.fire("native.commit")
+        bound = 0
+        with self._lock:
+            with self._pods_lock:
+                rv0 = self._rv
+                t_commit = self._commit_stamp()
+                bound, keys, bases, ids = cols.commit_bind(
+                    rows, ids, keys, rv_snap, rv0, errors)
+                if bound:
+                    self._rv = rv0 + bound
+                    batch = LazyBindBatch(MODIFIED, rv0 + 1, keys, bases,
+                                          ids, cols.node_names,
+                                          pod_bind_clone, t_commit)
+                    self._emit_bind_batch(batch, origin)
+        _metrics().store_bind_many_duration.observe(time.perf_counter() - t0)
+        return bound, errors
+
+    def _emit_bind_batch(self, batch: LazyBindBatch,
+                         origin: Optional[str]) -> None:
+        """History + delivery for one columnar bind batch: ONE retained
+        marker, ONE CoalescedEvent per coalescing watcher (lazy events
+        sequence — len() without materialization), per-object watchers get
+        the materialized stream through the ordinary lazy-slot path. With
+        the mutation detector armed the batch materializes eagerly right
+        here, so emission-time fingerprints exist exactly like the dict
+        path's (the detector is a test-tier knob; the zero-alloc claim is
+        about the production steady state)."""
+        if self._mutation_detector is not None:
+            for ev in batch.events():
+                self._mutation_detector.record(ev)
+        self._history.append(batch)
+        self._history_n += batch.n
+        self._trim_history()
+        cev = None
+        mat = None
+        for w in list(self._watchers):
+            if w.coalesce:
+                if cev is None:
+                    cev = CoalescedEvent(batch.type, "pods",
+                                         _LazyEventSeq(batch),
+                                         batch.resource_version, origin,
+                                         batch.commit_ts)
+                w._deliver_coalesced(cev)
+            else:
+                if mat is None:
+                    mat = [self._materialize_event(ev)
+                           for ev in batch.events()]
+                for ev in mat:
+                    w._deliver(ev)
+
     def delete_pods(self, keys: Iterable[str],
                     origin: Optional[str] = None) -> Tuple[int, List[Tuple[str, str]]]:
         """Batched pod delete: one lock acquisition + one coalesced DELETED
@@ -1424,6 +1852,14 @@ class APIStore:
             _chaos.ACTIVE.fire("native.commit")
         with self._pods_pair:
             pods = self._objects["pods"]
+            if self._cols is not None:
+                # victims bound by a columnar batch materialize first: the
+                # DELETED events' clone source must carry the committed
+                # node/rv. Victim sets are preemption-batch sized, so the
+                # per-victim clone here is not a hot-path cost (bind_many is
+                # the 100k-rate entry; the columnar win lives there).
+                for key in keys:
+                    self._cols.materialize_key(key, pods)
             t_commit = self._commit_stamp()
             if native is not None:
                 # same three event modes as bind_many (share-mode stores
@@ -1470,6 +1906,12 @@ class APIStore:
                 for key in found:
                     del pods[key]
                 self._rv = rv
+            if self._cols is not None:
+                # drop the freed rows (no-op for error keys that never had
+                # one; second occurrence of a duplicate is already gone)
+                for key in keys:
+                    if key not in pods:
+                        self._cols.remove(key)
             self._emit_batch(DELETED, "pods", events, origin)
         return deleted, errors
 
@@ -1485,6 +1927,10 @@ class APIStore:
             self._rv += 1
             pod.metadata.resource_version = self._rv
             self._objects["pods"][key] = pod
+            if self._cols is not None:
+                row = self._cols.key2row.get(key)
+                if row is not None:
+                    self._cols.sync(row, pod)
             self._emit_event(self._pod_event(MODIFIED, pod,
                                              pod_structural_clone, prev=old))
             return pod_structural_clone(pod)

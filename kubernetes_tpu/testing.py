@@ -318,7 +318,11 @@ def pod_conservation_report(store, scheduler, keys):
     double: List[str] = []
     keyset = set(keys)
     bind_counts: Dict[str, int] = {}
-    for ev in getattr(store, "_history", ()):
+    # history_events flattens columnar LazyBindBatch markers into their
+    # per-object events (ISSUE 15); plain Event histories pass through
+    history = (store.history_events() if hasattr(store, "history_events")
+               else getattr(store, "_history", ()))
+    for ev in history:
         if ev.kind != "pods" or ev.type != "MODIFIED":
             continue
         obj, prev = ev.obj, ev.prev

@@ -140,6 +140,19 @@ def test_bench_quick_runs_and_emits_json():
         assert nat["us_per_pod_native"] > 0, bc
     else:
         assert nat["us_per_pod_native"] is None, bc
+    # the columnar pod-row store column (ISSUE 15): dict-vs-columnar µs/pod
+    # as a SAME-BOX interleaved A/B with the r12 honesty flags (cores/
+    # cpu_quota/ab_comparable published IN the column — rig core counts
+    # vary across the BENCH series, so only same-box pairs may be compared)
+    col = bc["columnar"]
+    assert {"available", "us_per_pod_dict", "us_per_pod_columnar",
+            "speedup", "cores", "cpu_quota", "ab_comparable"} <= set(col), bc
+    assert col["us_per_pod_dict"] > 0, bc
+    if col["available"]:
+        assert col["us_per_pod_columnar"] > 0 and col["speedup"] > 0, bc
+        assert col["ab_comparable"] is True, bc
+    else:
+        assert col["us_per_pod_columnar"] is None, bc
     # the gang rung (ISSUE 2): every member of every gang binds, all-or-
     # nothing never fires on the happy path
     gang = workloads["GangScheduling_2k_250"]

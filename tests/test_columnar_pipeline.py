@@ -184,8 +184,13 @@ def test_per_object_stream_identical_with_lazy_events_on_and_off():
 def test_lazy_materialized_event_objects_are_private():
     """A per-object watcher subscribed DURING a lazy batch must never hold
     the stored object itself: mutating its event objects must not corrupt
-    store state (and is caught by the detector)."""
-    store = APIStore()
+    store state (and is caught by the detector).
+
+    columnar=False: this test (and the two below) pins the DICT store's
+    lazy-event sharing contract by inspecting _objects directly — on the
+    columnar path (ISSUE 15) the dict row is intentionally stale until
+    materialization; tests/test_columnar_store.py pins that contract."""
+    store = APIStore(columnar=False)
     w = store.watch(kind=("pods",))
     store.create_many("pods", _pods(5))
     store.bind_many([("default", f"p-{i}", "node-1") for i in range(5)],
@@ -204,7 +209,7 @@ def test_non_coalescing_watcher_subscribing_mid_batch_sees_private_objects():
     lazy fast path shares the stored object; a non-coalescing watcher
     subscribing afterwards (replay) must still get fully private event
     objects with identical content."""
-    store = APIStore()
+    store = APIStore(columnar=False)  # dict-path sharing pin (see above)
     fast = store.watch(kind=("pods",), coalesce=True)
     rv0 = store.rv
     store.create_many("pods", _pods(6))
@@ -231,7 +236,8 @@ def test_mutating_lazily_materialized_event_is_caught():
     even though emission recorded only the shared form."""
     from kubernetes_tpu.store import MutationDetectedError
 
-    store = APIStore(mutation_detector=True)
+    store = APIStore(mutation_detector=True,
+                     columnar=False)  # dict-path sharing pin (see above)
     store.watch(kind=("pods",), coalesce=True)  # keeps the lazy path hot
     store.create_many("pods", _pods(3))
     store.bind_many([("default", f"p-{i}", "node-0") for i in range(3)],

@@ -684,7 +684,7 @@ def test_gang_free_batches_byte_identical_with_subsystem_armed(coalesce):
         placements = sorted((p.metadata.name, p.spec.node_name)
                             for p in store.list("pods")[0])
         events = [(e.kind, e.type, e.obj.metadata.name)
-                  for e in store._history]
+                  for e in store.history_events()]
         return placements, events
 
     assert run() == run(rank_align=False, gang_preemption=False)

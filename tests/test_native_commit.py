@@ -52,9 +52,13 @@ def _store_with_watchers(native, lazy=None, deep_copy=True, detector=None):
     # detector: deep_copy_on_write=False means no isolation contract at all
     # (delete() legitimately re-stamps the caller-shared object in place),
     # so the detector's read-only premise doesn't apply there
+    # columnar=False: this module pins the DICT commit engine's
+    # native-vs-Python parity (the columnar path would bypass the engine's
+    # bind/delete loops and leave the inspected dict rows lazily stale);
+    # the columnar twin of this suite lives in tests/test_columnar_store.py
     store = APIStore(native_commit=native, lazy_pod_events=lazy,
                      deep_copy_on_write=deep_copy,
-                     mutation_detector=detector)
+                     mutation_detector=detector, columnar=False)
     per_obj = store.watch(kind=("pods",))
     coal = store.watch(kind=("pods",), coalesce=True)
     return store, per_obj, coal

@@ -102,7 +102,7 @@ def bind_transitions(store):
     """Per-key count of unbound->bound transitions in the store's history —
     the exactly-once-binding source of truth."""
     out = {}
-    for ev in store._history:
+    for ev in store.history_events():
         if ev.kind != "pods" or ev.type != "MODIFIED":
             continue
         if ev.obj.spec.node_name and (ev.prev is None
@@ -130,7 +130,7 @@ def test_partitions_1_is_byte_identical(columnar):
                    ev.obj.key if hasattr(ev.obj, "key") else None,
                    getattr(ev.obj.spec, "node_name", None)
                    if ev.kind == "pods" else None)
-                  for ev in store._history]
+                  for ev in store.history_events()]
         return placements(store), events
 
     pl_a, ev_a = run(lambda st: BatchScheduler(

@@ -118,6 +118,85 @@ def test_lk001_quiet_on_mandated_order():
     assert "LK001" not in rules_of(analyze_source(LK001_GOOD))
 
 
+# LK001 generalized shard rule (ISSUE 15 satellite): the ordering table in
+# store/store.py ranks _lock (0) -> _pods_lock (1) -> _nodes_lock (2);
+# holding a shard, any acquisition of LOWER rank — the global lock or a
+# lower-ranked shard, direct or via a resolved call path — is an inversion.
+
+LK001_NODES_BAD = '''
+import threading
+
+class APIStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pods_lock = threading.RLock()
+        self._nodes_lock = threading.RLock()
+
+    def nodes_then_global(self):
+        with self._nodes_lock:
+            with self._lock:
+                return 1
+
+    def nodes_then_pods(self):
+        with self._nodes_lock:
+            with self._pods_lock:
+                return 2
+
+    def takes_pods_shard(self):
+        with self._pods_lock:
+            return 3
+
+    def nodes_then_pods_via_call(self):
+        with self._nodes_lock:
+            return self.takes_pods_shard()
+'''
+
+LK001_NODES_GOOD = '''
+import threading
+
+class APIStore:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._pods_lock = threading.RLock()
+        self._nodes_lock = threading.RLock()
+        self._nodes_pair = None
+        self._store_chain = None
+
+    def full_chain_order(self):
+        with self._lock:
+            with self._pods_lock:
+                with self._nodes_lock:
+                    return 1
+
+    def pods_then_nodes(self):
+        # ascending rank: legal without the global lock too
+        with self._pods_lock:
+            with self._nodes_lock:
+                return 2
+
+    def nodes_pair(self):
+        with self._nodes_pair:
+            return 3
+
+    def chain(self):
+        with self._store_chain:
+            return 4
+'''
+
+
+def test_lk001_generalized_fires_on_nodes_shard_inversions():
+    findings = [f for f in analyze_source(LK001_NODES_BAD)
+                if f.rule == "LK001"]
+    # nodes->global, nodes->pods (direct), nodes->pods (via call)
+    assert len(findings) == 3, findings
+    assert any("call to" in f.message for f in findings)
+    assert any("higher-ranked" in f.message for f in findings)
+
+
+def test_lk001_generalized_quiet_on_ascending_rank():
+    assert "LK001" not in rules_of(analyze_source(LK001_NODES_GOOD))
+
+
 # LK001 partition extension (ISSUE 12): the dispatch-layer locks
 # (PartitionRouter._route_lock / PartitionedScheduler._dispatch_lock) are
 # LEAF locks — a store-lock acquisition (direct or via any resolved call
@@ -377,6 +456,52 @@ def test_mu001_fires_on_store_and_event_mutation():
 
 def test_mu001_quiet_on_clones_reads_and_container_ops():
     assert "MU001" not in rules_of(analyze_source(MU001_GOOD))
+
+
+# MU001 columnar extension (ISSUE 15 satellite): the rows/views handed out
+# by the columnar read path (`store.pod_columns()`) are store-returned
+# READ-ONLY objects — writes through the view (element stores into its
+# arrays/lists, mutator calls on its members) taint exactly like event
+# objects; copies launder as usual.
+
+MU001_COLUMNAR_BAD = '''
+def poke_view_array(self):
+    cols = self.store.pod_columns()
+    cols.node_id[0] = 3
+
+def poke_view_table(self):
+    view = self.store.pod_columns()
+    view.node_names.append("sneaky")
+
+def poke_view_base(self):
+    view = self.store.pod_columns()
+    view.base[0].spec.node_name = "n1"
+'''
+
+MU001_COLUMNAR_GOOD = '''
+def read_counts(self):
+    cols = self.store.pod_columns()
+    return int((cols.node_id >= 0).sum())
+
+def copy_then_mutate(self):
+    cols = self.store.pod_columns()
+    mine = cols.node_id.copy()
+    mine[0] = 3
+    return mine
+
+def stats_only(self):
+    return self.store.columnar_stats()
+'''
+
+
+def test_mu001_fires_on_columnar_view_mutation():
+    findings = [f for f in analyze_source(MU001_COLUMNAR_BAD)
+                if f.rule == "MU001"]
+    assert len(findings) == 3, findings
+
+
+def test_mu001_quiet_on_columnar_reads_and_copies():
+    assert "MU001" not in rules_of(analyze_source(MU001_COLUMNAR_GOOD))
 
 
 JT001_BAD = '''
