@@ -522,9 +522,22 @@ def rung_north_star_endtoend(results):
             # below IS a mid-run retrace — the regression class JT001
             # guards statically
             compiles0 = _solver_jit_cache()
+
+            # the zero-alloc acceptance gauge (ISSUE 16): pod-object
+            # materializations across the store + scheduler-cache columnar
+            # tables during the timed window — 0 when the end-to-end
+            # columnar pipeline (rows + column assume + clone-free
+            # dispatch) never builds a per-pod Python object
+            def _pod_obj_allocs():
+                st = store.columnar_stats() or {}
+                return (st.get("materialized_total", 0)
+                        + sched.cache.columnar_materialized())
+
+            allocs0 = _pod_obj_allocs()
             t0 = time.perf_counter()
             sched.run_until_idle()
             dt = time.perf_counter() - t0
+            pod_obj_allocs = _pod_obj_allocs() - allocs0
         finally:
             # a mid-run failure must not leave the collector off for every
             # later rung (this rung records the error and the ladder
@@ -605,6 +618,10 @@ def rung_north_star_endtoend(results):
             "slo": slo,
             "instrumentation_s": round(sched.flightrec.self_seconds, 6),
             "jit_cache": jit_cache,
+            # ISSUE 16 acceptance: zero pod-object materializations in the
+            # timed window, with the row path demonstrably engaged
+            "pod_obj_allocs": pod_obj_allocs,
+            "cache_rows": sched.cache.columnar_rows(),
             "solver_compiles_during_run": compiles}
         print(f"{'NorthStar_100k_10k_endtoend':>28}: {pps:>9.0f} pods/s  "
               f"({bound}/{n_pods} BOUND through the store in {dt:.3f}s)",
@@ -1087,6 +1104,13 @@ def rung_north_star_soak(results):
                                            "p99_drift"))
                              for c in slo["skipped"])
         res = sampler.summary()
+        # the per-window zero-alloc gauge (ISSUE 16): under full churn the
+        # DELETED-event contract materializes every drained victim (honest
+        # column — the scheduling path itself allocates nothing), so the
+        # soak publishes the distribution rather than gating on zero
+        alloc_vals = [a for a in
+                      ((w.get("alloc") or {}).get("pod_obj_allocs")
+                       for w in windows) if a is not None]
         results["NorthStar_1M"] = {
             "pods_per_sec": round(churned / dt, 1), "wall_s": round(dt, 3),
             "pods": churned, "steady_pods": steady, "wave": wave,
@@ -1094,6 +1118,12 @@ def rung_north_star_soak(results):
             "windows": len(windows),
             "window_s": window_s,
             "windows_sample": windows[-3:],
+            "pod_obj_allocs": {
+                "windows_counted": len(alloc_vals),
+                "zero_windows": sum(1 for a in alloc_vals if a == 0),
+                "max_per_window": max(alloc_vals) if alloc_vals else None,
+                "total": sum(alloc_vals) if alloc_vals else None,
+            },
             "resource": res,
             "slo": slo, "soak_ok": bool(slo["pass"] and trend_real
                                         and compiles == 0),
@@ -1264,6 +1294,153 @@ def rung_bind_commit(results):
     except Exception as e:
         results["BindCommit_20k"] = {"error": str(e)[:200]}
         print(f"BindCommit_20k: ERROR {e}", file=sys.stderr)
+
+
+def rung_sched_stages(results):
+    """SchedStages_8k (ISSUE 16): per-stage same-box A/B columns for the
+    four steady-state stages the end-to-end columnar pipeline rewrote, each
+    measured columnar-vs-object under the BindCommit discipline (interleaved
+    best-of-2, GC frozen, rig honesty flags):
+
+      build_pod_batch  store sig-column memo re-seed vs object signature walk
+      assume           column insert (assume_pods_columnar) vs per-pod
+                       structural PodInfo appends (both phase-1-only; phase 2
+                       is the shared scatter either way)
+      tensorize        dirty-name diff (changed_names) vs identity walk over
+                       every node, at the steady-state delta shape (a few
+                       dirty nodes out of the fleet)
+      dispatch         clone-free handoff vs pod_bind_clone per pod
+    """
+    import gc
+
+    from kubernetes_tpu.scheduler import Framework
+    from kubernetes_tpu.scheduler.batch import BatchScheduler
+    from kubernetes_tpu.scheduler.cache import Cache
+    from kubernetes_tpu.scheduler.plugins import default_plugins
+    from kubernetes_tpu.snapshot.tensorizer import (TensorCache,
+                                                    build_cluster_tensors,
+                                                    build_pod_batch)
+    from kubernetes_tpu.store import APIStore
+    from kubernetes_tpu.store.store import pod_bind_clone
+    from kubernetes_tpu.testing import MakePod
+
+    try:
+        n_pods, n_nodes = sz(8000, floor=128), sz(256, floor=16)
+        store = APIStore()
+        nodes = _nodes(n_nodes, cpu="64", mem="256Gi")
+        for nd in nodes:
+            store.create("nodes", nd)
+        node_names = [nd.metadata.name for nd in nodes]
+        sched = BatchScheduler(store, Framework(default_plugins()),
+                               batch_size=n_pods, solver="exact",
+                               columnar=True)
+        sched.sync()
+        store.create_many(
+            "pods", (MakePod(f"ss-{i}").req({"cpu": "100m", "memory": "64Mi"})
+                     .obj() for i in range(n_pods)), consume=True)
+        sched.pump_events()
+        snap = sched.cache.update_snapshot()
+        cluster = build_cluster_tensors(snap)
+        pods = sorted(store.list("pods")[0], key=lambda p: p.key)
+        getcols = getattr(store, "pod_columns", None)
+        store_cols = getcols() if getcols else None
+
+        def strip_memos():
+            for p in pods:
+                p.__dict__.pop("_class_sig", None)
+                p.__dict__.pop("_req_sig", None)
+
+        def t_build(cols):
+            strip_memos()
+            t0 = time.perf_counter()
+            build_pod_batch(pods, snap, cluster, store_cols=cols)
+            return time.perf_counter() - t0
+
+        assume_pairs = [(p, node_names[i % n_nodes])
+                        for i, p in enumerate(pods)]
+
+        def t_assume(columnar):
+            cache = Cache()
+            for nd in nodes:
+                cache.add_node(nd)
+            t0 = time.perf_counter()
+            if columnar:
+                bad = cache.assume_pods_columnar(assume_pairs)
+            else:
+                bad = cache.assume_pods_structural(assume_pairs)
+            dt = time.perf_counter() - t0
+            assert not bad, bad[:3]
+            return dt
+
+        # steady-state delta shape: a handful of dirty nodes out of the fleet
+        k_dirty = max(1, n_nodes // 32)
+        extra = [MakePod(f"ssx-{i}").req({"cpu": "50m"}).obj()
+                 for i in range(k_dirty)]
+        sched.cache.assume_pods(
+            [(p, node_names[i]) for i, p in enumerate(extra)])
+        snap2 = sched.cache.update_snapshot()
+
+        def t_tensorize(incremental):
+            tc = TensorCache()
+            tc.cluster_tensors(snap)  # re-base off the pre-delta snapshot
+            saved = snap2.changed_names
+            if not incremental:
+                snap2.changed_names = None  # force the identity-walk oracle
+            try:
+                t0 = time.perf_counter()
+                tc.cluster_tensors(snap2)
+                return time.perf_counter() - t0
+            finally:
+                snap2.changed_names = saved
+
+        def t_dispatch(clone):
+            t0 = time.perf_counter()
+            if clone:
+                out = [pod_bind_clone(p) for p in pods]
+            else:
+                out = list(pods)
+            dt = time.perf_counter() - t0
+            assert len(out) == n_pods
+            return dt
+
+        stages = {"build_pod_batch": (t_build, store_cols, None),
+                  "assume": (t_assume, True, False),
+                  "tensorize": (t_tensorize, True, False),
+                  "dispatch": (t_dispatch, False, True)}
+        gc.collect()
+        gc.freeze()
+        gc.disable()
+        try:
+            cols_out = {}
+            for name, (fn, col_arg, obj_arg) in stages.items():
+                fn(col_arg)  # warm-up
+                col_runs, obj_runs = [], []
+                for _ in range(2):  # interleaved best-of-2 per mode
+                    col_runs.append(fn(col_arg))
+                    obj_runs.append(fn(obj_arg))
+                dt_c, dt_o = min(col_runs), min(obj_runs)
+                per = n_pods if name != "tensorize" else 1
+                unit = "us_per_pod" if name != "tensorize" else "us_per_diff"
+                cols_out[name] = {
+                    f"{unit}_columnar": round(dt_c / per * 1e6, 3),
+                    f"{unit}_object": round(dt_o / per * 1e6, 3),
+                    "speedup": round(dt_o / dt_c, 2) if dt_c > 0 else None,
+                }
+        finally:
+            gc.enable()
+            gc.unfreeze()
+        results["SchedStages_8k"] = dict({
+            "pods": n_pods, "nodes": n_nodes, "dirty_nodes": k_dirty,
+            "store_cols": store_cols is not None,
+            "stages": cols_out,
+            "ab_comparable": True,  # interleaved same-box by design
+        }, **_rig_info())
+        print(f"{'SchedStages_8k':>28}: "
+              + "  ".join(f"{k} x{v['speedup']}"
+                          for k, v in cols_out.items()), file=sys.stderr)
+    except Exception as e:
+        results["SchedStages_8k"] = {"error": str(e)[:200]}
+        print(f"SchedStages_8k: ERROR {e}", file=sys.stderr)
 
 
 def _gang_adjacency(store, sched):
@@ -2317,6 +2494,7 @@ RUNGS = [
     ("NorthStarEndToEnd", rung_north_star_endtoend),
     ("NorthStarSoak", rung_north_star_soak),
     ("BindCommit", rung_bind_commit),
+    ("SchedStages", rung_sched_stages),
     ("GangScheduling", rung_gang),
     ("GangPreemption", rung_gang_preempt),
     ("Partitioned", rung_partitioned),
@@ -2332,9 +2510,9 @@ RUNGS = [
 # stdout. Catches perf-path regressions (a broken coalesced ingest or bind
 # path fails loudly here) without the full ladder's budget.
 QUICK_RUNGS = ("SchedulingBasic", "MixedChurn", "NorthStarEndToEnd",
-               "NorthStarSoak", "BindCommit", "GangScheduling",
-               "GangPreemption", "Partitioned", "ChaosChurn",
-               "ControlPlane", "SchedLint")
+               "NorthStarSoak", "BindCommit", "SchedStages",
+               "GangScheduling", "GangPreemption", "Partitioned",
+               "ChaosChurn", "ControlPlane", "SchedLint")
 QUICK_BUDGET_S = 110.0
 
 

@@ -12,11 +12,12 @@ taint walk:
                are CONTAINER-tainted: the returned list itself is freshly
                allocated (sorting/slicing it is fine) but its elements are
                object-tainted the moment they are indexed or iterated.
-               ISSUE 15: `<store>.pod_columns()` is an OBJECT source — the
-               columnar read path hands out live rows/views (read-only numpy
-               views + the live key/base/table lists), so writing through
-               the view (attribute or element stores, mutator calls on its
-               members) is flagged exactly like mutating an event object.
+               ISSUE 15/16: `<store|cache>.pod_columns()` is an OBJECT
+               source — the columnar read paths (store rows AND scheduler
+               cache rows) hand out live views (read-only numpy views + the
+               live key/pod/table lists), so writing through the view
+               (attribute or element stores, mutator calls on its members)
+               is flagged exactly like mutating an event object.
   propagation  plain data flow only: name assignment, attribute/subscript
                LOADS, tuple unpack, for-loop iteration. Calls launder taint —
                which makes every clone helper (deepcopy,
@@ -47,13 +48,26 @@ MUTATORS = {"append", "extend", "insert", "add", "update", "pop", "popitem",
 _NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
 
 
-def _recv_is_store(expr: ast.AST) -> bool:
-    seg = None
+def _recv_segment(expr: ast.AST) -> Optional[str]:
     if isinstance(expr, ast.Attribute):
-        seg = expr.attr
-    elif isinstance(expr, ast.Name):
-        seg = expr.id
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _recv_is_store(expr: ast.AST) -> bool:
+    seg = _recv_segment(expr)
     return seg is not None and "store" in seg.lower()
+
+
+def _recv_is_columnar_owner(expr: ast.AST) -> bool:
+    """Receivers that hand out live columnar views via pod_columns():
+    stores (ISSUE 15) and scheduler caches (ISSUE 16 — Cache.pod_columns
+    returns a CacheColumnsView over the live row table)."""
+    seg = _recv_segment(expr)
+    return seg is not None and ("store" in seg.lower()
+                                or "cache" in seg.lower())
 
 
 OBJ = "obj"            # the value itself is contract-covered
@@ -62,12 +76,16 @@ CONTAINER = "container"  # fresh container of contract-covered elements
 
 def _store_read_level(call: ast.Call) -> Optional[str]:
     f = call.func
-    if (isinstance(f, ast.Attribute)
-            and f.attr in ("get", "list", "list_many", "pod_columns")
+    if not isinstance(f, ast.Attribute):
+        return None
+    if f.attr == "pod_columns" and _recv_is_columnar_owner(f.value):
+        # pod_columns() hands out the LIVE columnar view (ISSUE 15 store
+        # rows; ISSUE 16 cache rows): the value itself is contract-covered,
+        # like a get() result
+        return OBJ
+    if (f.attr in ("get", "list", "list_many")
             and _recv_is_store(f.value)):
-        # pod_columns() hands out the LIVE columnar view (ISSUE 15): the
-        # value itself is contract-covered, like a get() result
-        return OBJ if f.attr in ("get", "pod_columns") else CONTAINER
+        return OBJ if f.attr == "get" else CONTAINER
     return None
 
 

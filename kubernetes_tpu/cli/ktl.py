@@ -1525,6 +1525,7 @@ def _render_sched_top(doc: Dict) -> str:
 
             q = w.get("queue") or {}
             r = w.get("resource") or {}
+            al = w.get("alloc") or {}
             when = (_dt.datetime.fromtimestamp(w["ts"]).strftime("%H:%M:%S")
                     if "ts" in w else "-")
             rows.append([
@@ -1534,14 +1535,18 @@ def _render_sched_top(doc: Dict) -> str:
                 p99("solve"), p99("assume"), p99("bind"),
                 str(q.get("active", "-")),
                 str(q.get("backoff", "-")),
+                # the live zero-alloc gauge (ISSUE 16): per-window pod-object
+                # materializations across store + cache columnar tables; 0 is
+                # the end-to-end columnar steady state
+                str(al.get("pod_obj_allocs", "-")),
                 (w.get("breaker") or {}).get("state", "-"),
                 (f"{r['rss_mb']:.1f}" if "rss_mb" in r else "-"),
             ])
         rows.reverse()  # newest first: the dashboard reads top-down
         out.append(fmt_table(
             ["WIN", "TIME", "BATCHES", "PODS/S", "SOLVE(p99ms)",
-             "ASSUME(p99ms)", "BIND(p99ms)", "ACTIVE", "BACKOFF", "BREAKER",
-             "RSS(MB)"], rows))
+             "ASSUME(p99ms)", "BIND(p99ms)", "ACTIVE", "BACKOFF", "ALLOCS",
+             "BREAKER", "RSS(MB)"], rows))
         out.append("(newest window first; use -o json for every column)")
         out.append("")
     return "\n".join(out).rstrip()

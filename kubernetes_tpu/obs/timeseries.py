@@ -14,7 +14,10 @@ per-window chart. This module is that chart.
       window CLOSES — per-stage p50/p99 settle by nearest-rank over the
       window's per-batch samples (bounded by batches/window), probes fire
       ONCE (queue depth, breaker state, watch lag, partition counters,
-      resource-sampler columns), and the closed dict joins the ring.
+      resource-sampler columns, and — ISSUE 16 — the "alloc" probe's
+      pod_obj_allocs gauge: per-window pod-object materializations summed
+      across the store and scheduler-cache columnar tables, 0 at the
+      end-to-end columnar steady state), and the closed dict joins the ring.
       Measured settle/tap self-time accrues to stat_sink (the flight
       recorder's <2% instrumentation budget covers this layer too).
 

@@ -119,6 +119,13 @@ class BatchScheduler(Scheduler):
         # False restores the per-pod paths (the parity oracle for tests).
         self.columnar = columnar
         self.watch_coalesce = columnar
+        # Cache-row mode (ISSUE 16): eligible device batches land as columnar
+        # cache rows with zero per-pod objects. Resolved once at construction
+        # like the store's columnar switch (STORE_COLUMNAR sweeps the whole
+        # pipeline to its object-path oracle).
+        from .cachecols import available as _cachecols_available
+
+        self._cache_columnar = columnar and _cachecols_available()
         # Bind pipelining (schedule_one.go:120-132 bindingCycle-in-goroutine
         # analog): assume_pod runs synchronously so the next solve's snapshot
         # sees the capacity, while the store.bind writes flush on a worker
@@ -315,6 +322,21 @@ class BatchScheduler(Scheduler):
         """The batch pipeline body (schedule_batch owns the try/finally
         bookkeeping around it). Fills `out` with nodes/dispatched/fallback/
         gang counts for the flight record."""
+        # Materialization barrier (ISSUE 16): a CONSTRAINED batch walks the
+        # snapshot's pod lists (PTS selector counts, IPA existing-pod terms)
+        # — collapse columnar cache rows into PodInfos before the snapshot is
+        # taken so those walks see every pod. The predicate is a strict
+        # superset of batch.has_constraints (ct/st/ipa all derive from these
+        # two spec fields), checked pod-by-pod with early exit; the
+        # steady-state constraint-free batch never materializes — that IS the
+        # zero-alloc path.
+        if self.cache.columnar_rows():
+            for qp in qps:
+                spec = qp.pod.spec
+                if (spec.affinity is not None
+                        or spec.topology_spread_constraints):
+                    self.cache.materialize_columnar_rows()
+                    break
         snapshot = self.cache.update_snapshot()
         out["nodes"] = len(snapshot)
         if len(snapshot) == 0:
@@ -327,11 +349,16 @@ class BatchScheduler(Scheduler):
         clock.mark("tensorize")
         trace.step("Tensorized cluster", nodes=len(snapshot))
         pods = [qp.pod for qp in qps]
+        store_cols = None
+        if self.columnar:
+            getcols = getattr(self.store, "pod_columns", None)
+            if getcols is not None:
+                store_cols = getcols()
         batch = build_pod_batch(
             pods, snapshot, cluster, ns_labels=self._ns_labels,
             hard_pod_affinity_weight=self._hard_pod_affinity_weight(),
             reuse=self._tensor_cache, changed_nodes=changed_nodes,
-            gangs=self.gangs)
+            gangs=self.gangs, store_cols=store_cols)
 
         fallback_mask = batch.fallback_class[batch.class_of_pod]
         # Gang semantic hole CLOSED (ISSUE 8 satellite; ROADMAP direction 4
@@ -466,6 +493,18 @@ class BatchScheduler(Scheduler):
             bind_nodes: List[int] = []  # cluster node index per to_bind entry
             bind_gang: List[int] = []  # gang id per entry (gang batches only)
             use_columnar = self.columnar and batch.raw_req is not None
+            # Zero-object dispatch (ISSUE 16): a gang-free, constraint-free,
+            # port-free device batch hands the bind worker the ORIGINAL pod
+            # refs (the bind path only reads key + target node) and lands in
+            # the cache as columnar ROWS — no pod_bind_clone, no PodInfo, no
+            # per-pod allocation at all. Any gang/constraint/port in the
+            # batch keeps the structural path byte-for-byte.
+            cols_rows_ok = (use_columnar and self._cache_columnar
+                            and not has_gang
+                            and not batch.has_constraints
+                            and batch.class_has_host_ports is not None
+                            and not bool(batch.class_has_host_ports[
+                                batch.class_of_pod[device_idx]].any()))
             clone = pod_bind_clone if use_columnar else pod_structural_clone
             node_names = cluster.node_names
             sub_gang = (np.asarray(sub.gang_of_pod).tolist()
@@ -492,8 +531,9 @@ class BatchScheduler(Scheduler):
                     else:
                         rejected.append((j, qps[pi]))
                 else:
-                    to_bind.append((qps[pi], node_names[nidx],
-                                    clone(qps[pi].pod)))
+                    qp = qps[pi]
+                    to_bind.append((qp, node_names[nidx],
+                                    qp.pod if cols_rows_ok else clone(qp.pod)))
                     bind_rows.append(pi)
                     bind_nodes.append(nidx)
                     if sub_gang is not None:
@@ -505,7 +545,9 @@ class BatchScheduler(Scheduler):
                 # would hold the store lock against every consumer
                 pairs = [(assumed, node) for _qp, node, assumed in to_bind]
                 batch_has_ports = True
-                if use_columnar:
+                if cols_rows_ok:
+                    batch_has_ports = False  # port-free by the dispatch gate
+                elif use_columnar:
                     batch_has_ports = bool(
                         batch.class_has_host_ports is None
                         or batch.class_has_host_ports[
@@ -520,7 +562,12 @@ class BatchScheduler(Scheduler):
                 dispatched_hi = 0
                 sync_bind_s = 0.0
                 try:
-                    if use_columnar:
+                    if cols_rows_ok:
+                        # row-mode phase 1: the placements land as columnar
+                        # rows, zero per-pod objects; resource totals follow
+                        # as one scatter-add in _columnar_account
+                        bad = self.cache.assume_pods_columnar(pairs)
+                    elif use_columnar:
                         # structural phase only; resource totals follow as
                         # one scatter-add in _columnar_account
                         bad = self.cache.assume_pods_structural(
@@ -652,6 +699,8 @@ class BatchScheduler(Scheduler):
         # class was vetoed above (all-or-nothing cannot be enforced on the
         # per-pod path).
         if len(fallback_idx):
+            # (columnar cache rows are collapsed by schedule_pod itself
+            # before it snapshots — the serial plugins walk pod lists)
             fb0 = self.scheduled_count
             for pi in fallback_idx:
                 self._serial_one(qps[pi])
@@ -1020,6 +1069,26 @@ class BatchScheduler(Scheduler):
         import numpy as np
 
         from .framework import CycleState
+
+        if self.cache.columnar_rows():
+            # Pre-batch placements held as columnar rows have no PodInfo, so
+            # the victim walk below cannot see them. Collapse them and patch
+            # the local (pre-batch) snapshot clones in place; rows assumed by
+            # THIS batch stay out of the patch — the dry run already sees
+            # those via placed_by_node, and the next update_snapshot re-clones
+            # every touched node from the cache anyway.
+            batch_keys = {p.key for p in sub.pods}
+            mat: list = []
+            self.cache.materialize_columnar_rows(mat)
+            for node_name, pi in mat:
+                if pi.pod.key in batch_keys:
+                    continue
+                ni = snapshot.node_info_map.get(node_name)
+                if ni is not None:
+                    # raw append: phase 2 already folded the resources into
+                    # this clone; keep len(pods)+col_count exact
+                    ni.pods.append(pi)
+                    ni.col_count -= 1
 
         # post-batch capacity: fold every in-batch assignment into used state
         used = cluster.used.astype(np.int64).copy()
@@ -1400,6 +1469,11 @@ class BatchScheduler(Scheduler):
             "store_columnar": (self.store.columnar_stats()
                                if hasattr(self.store, "columnar_stats")
                                else None),
+            # cache rows (ISSUE 16): the scheduler-side half of the columnar
+            # pipeline — rows live per steady-state placement, and
+            # materialized_total only moves when a constrained batch / serial
+            # fallback / conservation check forces object rows
+            "cache_columnar": self.cache.columnar_stats(),
             "recorder": {"enabled": fr.enabled, "capacity": fr.capacity,
                          "records": len(fr),
                          "self_seconds": round(fr.self_seconds, 6)},
@@ -1434,6 +1508,33 @@ class BatchScheduler(Scheduler):
         ts.add_probe("watch", lambda: self.store.watch_lag())
         ts.add_probe("partition", self._partition_window_probe)
         ts.add_probe("resource", self._resource_window_probe)
+        # live zero-alloc gauge (ISSUE 16): per-window pod-object
+        # materializations across the columnar pipeline (store rows + cache
+        # rows). Steady state reads 0 — the end-to-end zero-object claim as
+        # a live gauge, not only a bench assertion. One tap per window close
+        # (HP001).
+        self._alloc_probe_total: Optional[int] = None
+        ts.add_probe("alloc", self._alloc_window_probe)
+
+    def _alloc_window_probe(self) -> Optional[Dict]:
+        total = 0
+        seen = False
+        getstats = getattr(self.store, "columnar_stats", None)
+        if getstats is not None:
+            st = getstats()
+            if st is not None:
+                total += int(st.get("materialized_total", 0))
+                seen = True
+        cm = getattr(self.cache, "columnar_materialized", None)
+        if cm is not None:
+            total += int(cm())
+            seen = True
+        if not seen:
+            return None  # object-path pipeline: the gauge has no meaning
+        prev = self._alloc_probe_total
+        self._alloc_probe_total = total
+        return {"pod_obj_allocs": total - prev if prev is not None else total,
+                "materialized_total": total}
 
     def _partition_window_probe(self) -> Optional[Dict]:
         if self.partition_index is None:

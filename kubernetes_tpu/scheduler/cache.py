@@ -13,9 +13,21 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..api import Node, Pod
+from ..api import Node, Pod, compute_pod_resource_request
 from ..utils import Clock
 from .framework import NodeInfo, PodInfo, Snapshot
+
+
+def _pod_req_pair(pod: Pod):
+    """The pod's (request, non_zero_request) Resource pair — the same
+    `_req_cache` memo PodInfo.__init__ and the tensorizer seed, get-or-compute
+    so removal accounting works even for a pod that never grew a PodInfo."""
+    cached = pod.__dict__.get("_req_cache")
+    if cached is None:
+        cached = (compute_pod_resource_request(pod),
+                  compute_pod_resource_request(pod, non_zero=True))
+        pod.__dict__["_req_cache"] = cached
+    return cached
 
 
 class Cache:
@@ -32,27 +44,43 @@ class Cache:
         self._snapshot: Optional[Snapshot] = None
         # image name -> shared ImageStateSummary (num_nodes mutated in place)
         self._image_entries: Dict[str, object] = {}
+        # Columnar cache rows (scheduler/cachecols.py): created lazily on the
+        # first assume_pods_columnar, so object-path schedulers never pay for
+        # (or observe) the row table.
+        self._cols = None
+        # Names of nodes touched since the last snapshot; None = a structural
+        # event (node add/remove/promote) happened and the next
+        # update_snapshot must do the full generation walk.
+        self._dirty_names: Optional[Set[str]] = set()
 
     def _next_gen(self) -> int:
         self._generation += 1
         return self._generation
 
-    def _touch(self, ni: NodeInfo) -> None:
+    def _touch(self, ni: NodeInfo, name: Optional[str] = None) -> None:
         ni.generation = self._next_gen()
+        if name is None:
+            self._dirty_names = None
+        elif self._dirty_names is not None:
+            self._dirty_names.add(name)
 
     # -- nodes -----------------------------------------------------------------
 
     def add_node(self, node: Node) -> None:
         with self._lock:
-            ni = self._nodes.get(node.metadata.name)
+            name = node.metadata.name
+            ni = self._nodes.get(name)
+            structural = ni is None or ni.node is None
             if ni is None:
                 ni = NodeInfo()
-                self._nodes[node.metadata.name] = ni
+                self._nodes[name] = ni
             elif ni.node is not None:
                 self._remove_image_counts(ni.node)
             ni.set_node(node)
             ni.image_states = self._add_image_counts(node)
-            self._touch(ni)
+            # a NEW node (or a placeholder promotion) changes the snapshot's
+            # node set — the incremental from_prev path can't represent that
+            self._touch(ni, None if structural else name)
 
     def update_node(self, node: Node) -> None:
         self.add_node(node)
@@ -64,16 +92,17 @@ class Cache:
                 return
             if ni.node is not None:
                 self._remove_image_counts(ni.node)
-            if ni.pods:
+            if ni.pods or ni.col_count:
                 # Bound pods still reference this node: keep the NodeInfo as a
                 # placeholder (node=None) so their accounting survives a node
                 # flap (reference: cache.go RemoveNode keeps nodeInfo until the
                 # last pod is removed). Snapshots skip placeholder nodes.
                 ni.node = None
-                self._touch(ni)
+                self._touch(ni, None)
             else:
                 self._nodes.pop(name, None)
             self._generation += 1  # force snapshot rebuild to drop the node
+            self._dirty_names = None  # node set changed: full snapshot walk
 
     # Image-state bookkeeping mirrors cache.go's shared imageStates map: one
     # ImageStateSummary object per image, shared by every NodeInfo that has it,
@@ -130,9 +159,28 @@ class Cache:
             self._nodes[node_name] = ni
         ni.add_pod(PodInfo(pod))
         self._pod_nodes[pod.key] = node_name
-        self._touch(ni)
+        self._touch(ni, node_name)
 
     def _remove_pod_internal(self, key: str) -> None:
+        # Columnar row? Exact inverse of the row's lifecycle: drop the row,
+        # subtract its full request pair (phase 2 scatter-added the same
+        # `_req_cache` values — the raw layout covers every dim the batch's
+        # classes declare, mirroring ni.remove_pod's full subtraction on the
+        # object path), decrement the row population.
+        cols = self._cols
+        if cols is not None:
+            got = cols.remove(key)
+            if got is not None:
+                pod, node_name = got
+                self._pod_nodes.pop(key, None)
+                ni = self._nodes.get(node_name)
+                if ni is not None:
+                    ni.col_count -= 1
+                    req, req_nz = _pod_req_pair(pod)
+                    ni.requested.sub(req)
+                    ni.non_zero_requested.sub(req_nz)
+                    self._touch(ni, node_name)
+                return
         node_name = self._pod_nodes.pop(key, None)
         if node_name is None:
             return
@@ -144,7 +192,7 @@ class Cache:
             if pi.pod.metadata.namespace == ns and pi.pod.metadata.name == name:
                 ni.remove_pod(pi.pod)
                 break
-        self._touch(ni)
+        self._touch(ni, node_name)
 
     def update_pod(self, pod: Pod) -> None:
         with self._lock:
@@ -243,6 +291,108 @@ class Cache:
                 assumed[key] = 0.0
         return failed
 
+    def assume_pods_columnar(self, pairs) -> List[Tuple[int, str]]:
+        """Row-mode phase 1: the zero-object assume. Instead of building a
+        PodInfo per placement, each pod lands as a columnar row (key, original
+        Pod ref, interned node id) plus one `col_count` increment on its
+        NodeInfo — a handful of dict/list/int32 writes, no per-pod Python
+        allocation. Phase 2 (apply_node_resource_deltas — the same GIL-free
+        commit_deltas scatter output) remains the only resource/generation
+        mutation, exactly as on the structural path.
+
+        The dispatch gate guarantees every pod in `pairs` is constraint-free
+        (no gang, no affinity/topology-spread terms, no host ports), so rows
+        never owe affinity sublists or port claims. Unlike the structural
+        path, the pod is NOT stamped with `spec.node_name`: these are the
+        store/queue ORIGINALS (MU001 — store-returned objects are read-only),
+        and the bind worker only needs key + target node. Returns (index,
+        error) for entries that failed validation."""
+        failed = []
+        with self._lock:
+            cols = self._cols
+            if cols is None:
+                from .cachecols import CacheColumns
+
+                cols = self._cols = CacheColumns()
+            pod_nodes = self._pod_nodes
+            assumed = self._assumed
+            nodes = self._nodes
+            for i, (pod, node_name) in enumerate(pairs):
+                key = pod.key
+                if key in pod_nodes:
+                    failed.append((i, f"pod {key} is already in the cache"))
+                    continue
+                ni = nodes.get(node_name)
+                if ni is None:
+                    ni = NodeInfo()
+                    nodes[node_name] = ni
+                cols.insert(key, pod, node_name)
+                ni.col_count += 1
+                pod_nodes[key] = node_name
+                assumed[key] = 0.0
+        return failed
+
+    def materialize_columnar_rows(self, out: Optional[list] = None) -> int:
+        """Collapse every columnar row into a real PodInfo on its node — the
+        escape hatch for consumers that genuinely need object rows (a
+        constrained batch's selector counts, the serial fallback's plugin
+        walks, the conservation checker). Resources are NOT re-added (phase 2
+        already scatter-added them) and rows are constraint-free by the
+        dispatch gate, so this is append + generation touch per row. Counted
+        in `materialized_total` — the live zero-alloc gauge's feed; at steady
+        state this never runs. Returns the number of rows materialized; when
+        `out` is given, appends one (node_name, PodInfo) per row so callers
+        holding a pre-materialization snapshot can patch their clones."""
+        with self._lock:
+            cols = self._cols
+            if cols is None or not cols.key2row:
+                return 0
+            rows = list(cols.iter_rows())
+            for key, pod, node_name in rows:
+                cols.remove(key)
+                ni = self._nodes.get(node_name)
+                if ni is None:
+                    continue
+                ni.col_count -= 1
+                pi = PodInfo(pod)
+                if out is not None:
+                    out.append((node_name, pi))
+                ni.pods.append(pi)
+                if (pi.required_affinity_terms or pi.preferred_affinity_terms
+                        or pi.required_anti_affinity_terms
+                        or pi.preferred_anti_affinity_terms):
+                    ni.pods_with_affinity.append(pi)
+                    if pi.required_anti_affinity_terms:
+                        ni.pods_with_required_anti_affinity.append(pi)
+                self._touch(ni, node_name)
+            cols.materialized_total += len(rows)
+            return len(rows)
+
+    def pod_columns(self):
+        """Read-only columnar view of the live cache rows (CacheColumnsView),
+        or None when no row table exists. Store-returned READ-ONLY contract:
+        the numpy column refuses writes at runtime and schedlint MU001 taints
+        everything reachable from it."""
+        with self._lock:
+            if self._cols is None:
+                return None
+            from .cachecols import CacheColumnsView
+
+            return CacheColumnsView(self._cols)
+
+    def columnar_rows(self) -> int:
+        with self._lock:
+            return self._cols.rows() if self._cols is not None else 0
+
+    def columnar_materialized(self) -> int:
+        """Lifetime row->PodInfo collapses (feeds the pod_obj_allocs gauge)."""
+        with self._lock:
+            return self._cols.materialized_total if self._cols is not None else 0
+
+    def columnar_stats(self) -> Optional[Dict]:
+        with self._lock:
+            return self._cols.stats() if self._cols is not None else None
+
     def forget_pods_structural(self, pods, check_ports: bool = True) -> None:
         """Rollback of assume_pods_structural BEFORE the matching
         apply_node_resource_deltas: undo exactly what phase 1 did — the
@@ -257,8 +407,23 @@ class Cache:
         from .framework import _host_ports
 
         with self._lock:
+            cols = self._cols
             for pod in pods:
                 key = pod.key
+                if cols is not None:
+                    got = cols.remove(key)
+                    if got is not None:
+                        # columnar row pre-phase-2: undo exactly what
+                        # assume_pods_columnar did (row + bookkeeping +
+                        # col_count) with NO resource subtraction
+                        _p, node_name = got
+                        self._pod_nodes.pop(key, None)
+                        self._assumed.pop(key, None)
+                        ni = self._nodes.get(node_name)
+                        if ni is not None:
+                            ni.col_count -= 1
+                            self._touch(ni, node_name)
+                        continue
                 node_name = self._pod_nodes.pop(key, None)
                 self._assumed.pop(key, None)
                 if node_name is None:
@@ -275,7 +440,7 @@ class Cache:
                 if check_ports:
                     for port in _host_ports(pod):
                         ni.used_ports.discard(port)
-                self._touch(ni)
+                self._touch(ni, node_name)
 
     def apply_node_resource_deltas(self, resource_dims, node_deltas,
                                    expected_gen: Optional[int] = None
@@ -317,7 +482,7 @@ class Cache:
                             res.ephemeral_storage += v
                         else:
                             res.scalar[dim] = res.scalar.get(dim, 0) + v
-                self._touch(ni)
+                self._touch(ni, node_name)
             return self._generation if clean else None
 
     def confirm_assumed_bulk(self, pairs) -> List[int]:
@@ -405,11 +570,46 @@ class Cache:
     # -- snapshotting (cache.go:186 UpdateSnapshot) ----------------------------
 
     def update_snapshot(self) -> Snapshot:
-        """Incremental: clone only NodeInfos newer than the last snapshot."""
+        """Incremental: clone only NodeInfos newer than the last snapshot.
+
+        Fast path: when every mutation since the last snapshot was tracked by
+        name (`_dirty_names` — resource pokes, pod adds/removes on existing
+        real nodes), only those names are generation-compared and the
+        snapshot derives via Snapshot.from_prev, skipping the O(all nodes)
+        walk. Any structural event (node add/remove/promote) clears the set
+        to None and the full walk below runs — producing a bit-identical
+        result, just slower. The derived snapshot carries
+        changed_names/changed_from_gen so the tensorizer can diff by the same
+        set instead of identity-walking the node list."""
         with self._lock:
             if self._snapshot is not None and self._snapshot_generation == self._generation:
                 return self._snapshot
-            prev = self._snapshot.node_info_map if self._snapshot is not None else {}
+            prev_snap = self._snapshot
+            dirty = self._dirty_names
+            if prev_snap is not None and dirty is not None:
+                changed: Dict[str, NodeInfo] = {}
+                ok = True
+                for name in dirty:
+                    ni = self._nodes.get(name)
+                    if ni is None:
+                        ok = False  # vanished without a structural event? full walk
+                        break
+                    if ni.node is None:
+                        continue  # placeholder: excluded from prev too
+                    old = prev_snap.node_info_map.get(name)
+                    if old is None:
+                        ok = False  # appeared without a structural event? full walk
+                        break
+                    if old.generation != ni.generation:
+                        changed[name] = ni.clone()
+                if ok:
+                    snap = Snapshot.from_prev(prev_snap, changed)
+                    snap.generation = self._generation
+                    self._snapshot = snap
+                    self._snapshot_generation = self._generation
+                    self._dirty_names = set()
+                    return snap
+            prev = prev_snap.node_info_map if prev_snap is not None else {}
             new_map: Dict[str, NodeInfo] = {}
             for name, ni in self._nodes.items():
                 if ni.node is None:
@@ -423,6 +623,7 @@ class Cache:
             snap.generation = self._generation
             self._snapshot = snap
             self._snapshot_generation = self._generation
+            self._dirty_names = set()
             return snap
 
     def node_count(self) -> int:

@@ -182,6 +182,7 @@ class NodeInfo:
         "used_ports",
         "image_states",
         "generation",
+        "col_count",
     )
 
     def __init__(self, node=None):
@@ -195,6 +196,13 @@ class NodeInfo:
         self.used_ports: Set[Tuple[str, str, int]] = set()  # (hostIP, proto, port)
         self.image_states: Dict[str, ImageStateSummary] = {}
         self.generation = 0
+        # Pods held as columnar cache rows (scheduler/cachecols.py) rather
+        # than PodInfo objects. Their resources are already folded into
+        # `requested`/`non_zero_requested` by the phase-2 scatter; this count
+        # keeps pod-population checks (max_pods, tensorizer pod_count) exact
+        # without materializing them. Rows are constraint-free by the
+        # dispatch gate, so the affinity/port structures never owe entries.
+        self.col_count = 0
         if node is not None:
             self.set_node(node)
 
@@ -250,6 +258,7 @@ class NodeInfo:
         ni.used_ports = set(self.used_ports)
         ni.image_states = dict(self.image_states)
         ni.generation = self.generation
+        ni.col_count = self.col_count
         return ni
 
 
@@ -261,11 +270,22 @@ def _host_ports(pod: Pod) -> Iterable[Tuple[str, str, int]]:
 
 
 class Snapshot:
-    """Immutable per-cycle view of cluster state (reference: backend/cache/snapshot.go:198)."""
+    """Immutable per-cycle view of cluster state (reference: backend/cache/snapshot.go:198).
+
+    `changed_names`/`changed_from_gen` carry the incremental-diff provenance
+    when the snapshot was derived via `from_prev`: the set of node names whose
+    NodeInfo differs from the snapshot at cache generation `changed_from_gen`.
+    Consumers holding that predecessor (TensorCache) can requantize exactly
+    those rows instead of identity-walking the full node list. A full-built
+    snapshot leaves them None (meaning: diff unknown, walk everything).
+    """
 
     def __init__(self, node_infos: Optional[Dict[str, NodeInfo]] = None):
         self.node_info_map: Dict[str, NodeInfo] = node_infos or {}
         self.node_info_list: List[NodeInfo] = list(self.node_info_map.values())
+        self._name_index: Dict[str, int] = {
+            name: i for i, name in enumerate(self.node_info_map)
+        }
         self.have_pods_with_affinity_list: List[NodeInfo] = [
             n for n in self.node_info_list if n.pods_with_affinity
         ]
@@ -273,6 +293,47 @@ class Snapshot:
             n for n in self.node_info_list if n.pods_with_required_anti_affinity
         ]
         self.generation = 0
+        self.changed_names: Optional[frozenset] = None
+        self.changed_from_gen: Optional[int] = None
+
+    @classmethod
+    def from_prev(cls, prev: "Snapshot", changed: Dict[str, NodeInfo]) -> "Snapshot":
+        """Derive a snapshot from `prev` with only `changed` nodes replaced.
+
+        Only valid when the NODE SET is unchanged (same names, same order) —
+        the cache's dirty-name tracking falls back to a full build on any
+        node add/remove/promote. List positions are patched in place via the
+        shared name index, so node ordering (and therefore every downstream
+        tensor row order) is bit-identical to a full rebuild.
+        """
+        snap = cls.__new__(cls)
+        snap.node_info_map = dict(prev.node_info_map)
+        snap.node_info_map.update(changed)
+        snap._name_index = prev._name_index  # same node set: shared, immutable
+        lst = list(prev.node_info_list)
+        affinity_dirty = False
+        for name, ni in changed.items():
+            old = prev.node_info_list[prev._name_index[name]]
+            lst[prev._name_index[name]] = ni
+            if (ni.pods_with_affinity or old.pods_with_affinity
+                    or ni.pods_with_required_anti_affinity
+                    or old.pods_with_required_anti_affinity):
+                affinity_dirty = True
+        snap.node_info_list = lst
+        if affinity_dirty:
+            snap.have_pods_with_affinity_list = [n for n in lst if n.pods_with_affinity]
+            snap.have_pods_with_required_anti_affinity_list = [
+                n for n in lst if n.pods_with_required_anti_affinity
+            ]
+        else:
+            snap.have_pods_with_affinity_list = prev.have_pods_with_affinity_list
+            snap.have_pods_with_required_anti_affinity_list = (
+                prev.have_pods_with_required_anti_affinity_list
+            )
+        snap.generation = 0
+        snap.changed_names = frozenset(changed)
+        snap.changed_from_gen = prev.generation
+        return snap
 
     def get(self, name: str) -> Optional[NodeInfo]:
         return self.node_info_map.get(name)

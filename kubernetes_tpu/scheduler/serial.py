@@ -654,6 +654,10 @@ class Scheduler:
     def _schedule_pod_traced(self, pod: Pod, snapshot: Optional[Snapshot],
                              trace) -> ScheduleResult:
         if snapshot is None:
+            # serial plugins walk snapshot pod lists — collapse any columnar
+            # cache rows (batch-scheduler row mode, scheduler/cachecols.py)
+            # before snapshotting; a no-op on the pure serial path
+            self.cache.materialize_columnar_rows()
             snapshot = self.cache.update_snapshot()
             trace.step("Snapshotting scheduler cache done")
         res = ScheduleResult()

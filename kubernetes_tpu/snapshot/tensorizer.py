@@ -274,7 +274,17 @@ class TensorCache:
             return cluster, changed
         self._assume_gen = None
         self._assume_rows = None
-        changed = [i for i in range(len(nis)) if nis[i] is not prev_nis[i]]
+        if (snapshot.changed_names is not None and self.snap is not None
+                and snapshot.changed_from_gen == self.snap.generation):
+            # The snapshot itself carries the diff (cache dirty-name tracking,
+            # Snapshot.from_prev) relative to exactly the snapshot we last
+            # tensorized — diff by the named set instead of identity-walking
+            # every node. Same rows the identity walk would find (from_prev
+            # replaces precisely the named positions, order unchanged).
+            name_index = snapshot._name_index
+            changed = sorted(name_index[nm] for nm in snapshot.changed_names)
+        else:
+            changed = [i for i in range(len(nis)) if nis[i] is not prev_nis[i]]
         cluster = self.cluster
         for i in changed:
             ni, old = nis[i], prev_nis[i]
@@ -304,7 +314,7 @@ class TensorCache:
                 _quantize(ni.requested, dims, is_request=True), dtype=np.int32)
             cluster.used_nz[i] = np.array(
                 _quantize(ni.non_zero_requested, dims, is_request=True), dtype=np.int32)
-            cluster.pod_count[i] = len(ni.pods)
+            cluster.pod_count[i] = len(ni.pods) + ni.col_count
             cluster.max_pods[i] = ni.allocatable.allowed_pod_number
             if self._raw_used is not None:
                 self._raw_used[i] = _raw_vec(ni.requested, dims)
@@ -447,7 +457,9 @@ def build_cluster_tensors(snapshot: Snapshot, extra_resource_dims: Sequence[str]
         alloc[i] = _quantize(ni.allocatable, resource_dims, is_request=False)
         used[i] = _quantize(ni.requested, resource_dims, is_request=True)
         used_nz[i] = _quantize(ni.non_zero_requested, resource_dims, is_request=True)
-        pod_count[i] = len(ni.pods)
+        # columnar cache rows count toward the node's pod population without
+        # being materialized as PodInfo objects (scheduler/cachecols.py)
+        pod_count[i] = len(ni.pods) + ni.col_count
         max_pods[i] = ni.allocatable.allowed_pod_number
 
     cols = NodeColumns(node_infos)
@@ -472,7 +484,7 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
                     hard_pod_affinity_weight: int = 1,
                     reuse: Optional[TensorCache] = None,
                     changed_nodes: Optional[List[int]] = None,
-                    gangs=None) -> PodBatchTensors:
+                    gangs=None, store_cols=None) -> PodBatchTensors:
     """Group pods into classes, compile class tables, build PTS + IPA tensors.
 
     reuse + changed_nodes (from TensorCache.cluster_tensors) enable the
@@ -482,7 +494,17 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
 
     gangs (a scheduler.gang.GangDirectory) threads group-id rows through the
     batch: each pod's PodGroup index plus the per-class slice-packing bonus.
-    Skipped entirely while the directory is inactive (no PodGroups)."""
+    Skipped entirely while the directory is inactive (no PodGroups).
+
+    store_cols (a store PodColumnsView) feeds the per-pod signature loops
+    from the store's interned sig COLUMN instead of recomputing: pods freshly
+    parsed by the watch ingest carry no `_class_sig`/`_req_sig` memos, but the
+    columnar store captured the previous parse's memo refs at sync — when the
+    column entry's identity anchors (spec, labels) still match this pod
+    object, the memos are re-seeded from the column and both the native fused
+    loop and the Python fallback hit instead of re-deriving the signatures.
+    Zero-copy read of the MU001-tainted view; never required for
+    correctness."""
     ns_labels = ns_labels or {}
     gang_of_pod = gang_keys = gang_bonus = gang_rank = None
     if gangs is not None and gangs.active:
@@ -552,6 +574,38 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
             pod.__dict__["_req_cache"] = got[1]
         return got
 
+    seed_memos = None
+    if store_cols is not None and getattr(store_cols, "sig", None) is not None:
+        _key2row = store_cols.key2row
+        _sig_col = store_cols.sig
+
+        def seed_memos(pod):
+            # Re-seed the pod's signature memos from the store's sig COLUMN
+            # (captured refs from a previous parse's __dict__ at sync) when
+            # the identity anchors still hold — the fused loop / fallback
+            # then take their memo-hit path instead of re-deriving. A miss
+            # (fresh spec, no row) is harmless: the normal derivation runs.
+            # Returns True when anything was seeded (the sweep's dry-out
+            # signal).
+            d = pod.__dict__
+            row = _key2row.get(pod.key)
+            if row is None:
+                return False
+            ent = _sig_col[row]
+            if ent is None:
+                return False
+            cs, rs = ent
+            seeded = False
+            if (cs is not None and "_class_sig" not in d and len(cs) == 3
+                    and cs[0] is pod.spec and cs[1] is pod.metadata.labels):
+                d["_class_sig"] = cs
+                seeded = True
+            if (rs is not None and "_req_sig" not in d and len(rs) == 2
+                    and rs[0] is pod.spec):
+                d["_req_sig"] = rs
+                seeded = True
+            return seeded
+
     entry_rows: List[int] = []
     if pod_axis is not None:
         rep_pods = list(pod_axis.tables.rep_pods)
@@ -576,10 +630,29 @@ def build_pod_batch(pods: Sequence[Pod], snapshot: Snapshot,
         rep_pods = []
         from ..native import hostcommit as _hostcommit
 
+        if seed_memos is not None:
+            # PRE-PASS, not a per-callback ride-along: seeded pods take the
+            # fused loop's pure C-side memo-hit path with zero Python
+            # callbacks. Adaptive dry-out: a batch whose first 64 memo-less
+            # pods find nothing in the column (the create→schedule lifecycle
+            # syncs rows before any memo exists) stops consulting it — the
+            # seed path must never cost more than the derivation it saves.
+            probed = hits = 0
+            for pod in pods:
+                d = pod.__dict__
+                if "_class_sig" in d and "_req_sig" in d:
+                    continue
+                if seed_memos(pod):
+                    hits += 1
+                probed += 1
+                if probed >= 64 and not hits:
+                    break
         if pods and _hostcommit.available():
+            def _entry_cb(pod):
+                return _req_entry(pod)[0]
             class_of_pod, entry_rows = _hostcommit.batch_rows(
                 pods, sig_to_class, rep_pods, req_cache,
-                pod_class_signature, lambda pod: _req_entry(pod)[0])
+                pod_class_signature, _entry_cb)
         else:
             class_rows: List[int] = []
             for pod in pods:

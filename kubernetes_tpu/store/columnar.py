@@ -98,7 +98,7 @@ class PodColumnsView:
 
     __slots__ = ("n", "keys", "base", "uid", "name", "ns_id", "node_id",
                  "row_rv", "phase_id", "priority", "rank", "gang", "sig",
-                 "diverged", "node_names", "namespaces", "phases")
+                 "diverged", "node_names", "namespaces", "phases", "key2row")
 
     def __init__(self, cols: "PodColumns"):
         n = cols.n
@@ -125,6 +125,10 @@ class PodColumnsView:
         self.node_names = cols.node_names
         self.namespaces = cols.namespaces
         self.phases = cols.phases
+        # live row index (key -> row into the columns above) — lets column
+        # consumers (tensorizer sig re-seed) address rows by pod key without
+        # an O(rows) scan; read-only by the view's contract
+        self.key2row = cols.key2row
 
 
 class PodColumns:

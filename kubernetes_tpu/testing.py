@@ -339,6 +339,11 @@ def pod_conservation_report(store, scheduler, keys):
     # (residual) cache is checked separately for internal duplicates only.
     seen: Dict[str, int] = {}
     for s in disjoint:
+        # collapse columnar cache rows (ISSUE 16) so the walk below counts
+        # every accounted pod, not only the materialized PodInfos
+        mz = getattr(s.cache, "materialize_columnar_rows", None)
+        if mz is not None:
+            mz()
         snap = s.cache.update_snapshot()
         for ni in snap.node_info_list:
             for pi in ni.pods:
@@ -348,6 +353,9 @@ def pod_conservation_report(store, scheduler, keys):
     double.extend(k for k, n in seen.items() if n > 1 and k not in double)
     if mirror is not None:
         mseen: Dict[str, int] = {}
+        mz = getattr(mirror.cache, "materialize_columnar_rows", None)
+        if mz is not None:
+            mz()
         snap = mirror.cache.update_snapshot()
         for ni in snap.node_info_list:
             for pi in ni.pods:

@@ -1,0 +1,246 @@
+"""End-to-end columnar scheduler parity + chaos (ISSUE 16).
+
+The tentpole extends the struct-of-arrays idiom through the WHOLE pipeline:
+cache rows (scheduler/cachecols.py), build_pod_batch fed by the store's sig
+column, assume as a pure column insert, tensorize diffing by dirty-name
+range, and clone-free dispatch. Every fast path keeps its object-path
+oracle; this module pins the byte-parity contract across the full
+STORE_COLUMNAR x watch-coalesce matrix and runs the chaos leg (mid-run
+bind-worker kill with the mutation detector forced) on the columnar path.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from kubernetes_tpu.chaos import faultinject as fi
+from kubernetes_tpu.chaos.faultinject import FaultPlan
+from kubernetes_tpu.scheduler import Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import (MakeNode, MakePod, assert_pod_conservation,
+                                    mutation_detector_guard)
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    """The columnar paths hand out live views and skip per-pod clones — the
+    whole module runs under the forced runtime mutation detector (MU001's
+    companion) so any write-through would fail the teardown check."""
+    yield from mutation_detector_guard(monkeypatch)
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    fi.disarm()
+    yield
+    fi.disarm()
+
+
+def _nodes(n, cpu="8", mem="32Gi"):
+    return [MakeNode(f"node-{i}")
+            .labels({"kubernetes.io/hostname": f"node-{i}"})
+            .capacity({"cpu": cpu, "memory": mem, "pods": "110"}).obj()
+            for i in range(n)]
+
+
+def _pods(n, prefix="p", cpu="300m", mem="700Mi"):
+    return [MakePod(f"{prefix}-{i}").req({"cpu": cpu, "memory": mem}).obj()
+            for i in range(n)]
+
+
+def _build(store_columnar, coalesce, n_nodes=6, **kw):
+    store = APIStore()
+    for n in _nodes(n_nodes, cpu="4", mem="16Gi"):
+        store.create("nodes", n)
+    kw.setdefault("batch_size", 512)
+    kw.setdefault("solver", "exact")
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           columnar=coalesce, **kw)
+    sched.sync()
+    return store, sched
+
+
+# -- the 4-way parity matrix ---------------------------------------------------
+
+
+@pytest.mark.parametrize("store_columnar", [True, False],
+                         ids=["cols", "dicts"])
+@pytest.mark.parametrize("coalesce", [True, False],
+                         ids=["coalesced", "per-pod"])
+def test_endtoend_cache_state_parity_matrix(monkeypatch, store_columnar,
+                                            coalesce):
+    """Every cell of the STORE_COLUMNAR x watch-coalesce matrix ends a run
+    with the SAME placements, the same per-node requested totals, the same
+    pod sets, and the same cluster tensors. The (cols, coalesced) cell is
+    the ISSUE 16 fast path — rows, column assume, clone-free dispatch; the
+    (dicts, per-pod) cell is the all-object oracle."""
+    from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors
+
+    monkeypatch.setenv("STORE_COLUMNAR", "1" if store_columnar else "0")
+    store, sched = _build(store_columnar, coalesce)
+    assert sched._cache_columnar == (coalesce and store_columnar)
+    store.create_many("pods", _pods(50, prefix="mx"))
+    sched.run_until_idle()
+    sched.pump_events()
+    if sched._cache_columnar:
+        # the fast cell must actually have taken row mode before collapsing
+        assert sched.cache.columnar_rows() == 50
+        assert sched.cache.materialize_columnar_rows() == 50
+    snap = sched.cache.update_snapshot()
+    cl = build_cluster_tensors(snap)
+    state = {
+        "placements": {p.key: p.spec.node_name
+                       for p in store.list("pods")[0]},
+        "nodes": {ni.node.metadata.name:
+                  (ni.requested.milli_cpu, ni.requested.memory,
+                   sorted(pi.pod.key for pi in ni.pods))
+                  for ni in snap.node_info_list},
+        "used": cl.used.tolist(),
+        "pod_count": cl.pod_count.tolist(),
+    }
+    assert all(state["placements"].values())
+    oracle = test_endtoend_cache_state_parity_matrix._oracle
+    if oracle is None:
+        test_endtoend_cache_state_parity_matrix._oracle = state
+    else:
+        assert state == oracle
+
+
+test_endtoend_cache_state_parity_matrix._oracle = None
+
+
+# -- build_pod_batch: store sig column vs object walk --------------------------
+
+
+def test_build_pod_batch_store_cols_parity():
+    """build_pod_batch fed the store's columnar view (sig-memo re-seeding +
+    native fused loop over the column) produces byte-identical tensors to
+    the pure object walk over the same pods — including pods stripped of
+    their signature memos (the fresh-watch-parse case the column exists
+    for)."""
+    from kubernetes_tpu.snapshot.tensorizer import (build_cluster_tensors,
+                                                    build_pod_batch)
+
+    store, sched = _build(True, True)
+    store.create_many("pods", _pods(40, prefix="bp"))
+    sched.pump_events()
+    snap = sched.cache.update_snapshot()
+    cluster = build_cluster_tensors(snap)
+    pods = [p for p in store.list("pods")[0]]
+    pods.sort(key=lambda p: p.key)
+    # strip memos: the column path must re-seed them, the object path must
+    # re-derive them — same answer either way
+    for p in pods:
+        p.__dict__.pop("_class_sig", None)
+        p.__dict__.pop("_req_sig", None)
+    getcols = getattr(store, "pod_columns", None)
+    cols = getcols() if getcols else None
+    a = build_pod_batch(pods, snap, cluster, store_cols=cols)
+    for p in pods:
+        p.__dict__.pop("_class_sig", None)
+        p.__dict__.pop("_req_sig", None)
+    b = build_pod_batch(pods, snap, cluster, store_cols=None)
+    assert np.array_equal(a.class_of_pod, b.class_of_pod)
+    assert np.array_equal(a.req, b.req)
+    assert np.array_equal(a.req_nz, b.req_nz)
+    assert np.array_equal(a.balanced_active, b.balanced_active)
+    assert np.array_equal(a.raw_req, b.raw_req)
+    assert np.array_equal(a.class_has_host_ports, b.class_has_host_ports)
+    assert np.array_equal(a.tables.filter_ok, b.tables.filter_ok)
+
+
+# -- tensorize: dirty-name diff vs identity walk -------------------------------
+
+
+def test_second_wave_incremental_tensors_agree():
+    """Wave 2 lands on a cache whose snapshot derives via from_prev (dirty
+    names only) and whose tensor diff walks changed_names instead of
+    identity-comparing every node: the TensorCache rows must still equal a
+    from-scratch tensorize."""
+    from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors
+
+    store, sched = _build(True, True, n_nodes=10)
+    store.create_many("pods", _pods(30, prefix="w1"))
+    sched.run_until_idle()
+    sched.pump_events()
+    snap1 = sched.cache.update_snapshot()
+    sched._tensor_cache.cluster_tensors(snap1)
+    store.create_many("pods", _pods(30, prefix="w2"))
+    sched.run_until_idle()
+    sched.pump_events()
+    snap2 = sched.cache.update_snapshot()
+    if snap2 is not snap1:
+        # the incremental path actually engaged (no structural event ran)
+        assert snap2.changed_names is not None
+    cluster, _ = sched._tensor_cache.cluster_tensors(snap2)
+    fresh = build_cluster_tensors(snap2)
+    assert np.array_equal(cluster.used, fresh.used)
+    assert np.array_equal(cluster.used_nz, fresh.used_nz)
+    assert np.array_equal(cluster.pod_count, fresh.pod_count)
+    assert all(p.spec.node_name for p in store.list("pods")[0])
+
+
+# -- zero-alloc contract -------------------------------------------------------
+
+
+def test_steady_state_batch_materializes_no_pod_objects():
+    """The acceptance gauge at test scale: a constraint-free columnar batch
+    leaves its pods as cache rows and the store's materialization counter
+    does not move while scheduling (allocs happen at ingest/bind edges, not
+    in the scheduling loop)."""
+    store, sched = _build(True, True)
+    store.create_many("pods", _pods(40, prefix="zs"))
+    sched.pump_events()
+    stats0 = store.columnar_stats()
+    sched.run_until_idle()
+    assert sched.cache.columnar_rows() == 40
+    assert sched.cache.columnar_materialized() == 0
+    stats1 = store.columnar_stats()
+    if stats0 and stats1:
+        assert (stats1["materialized_total"]
+                == stats0["materialized_total"])
+    sched.flush_binds()
+    sched.pump_events()
+    # self-bind confirms keep the rows in place — still zero materialized
+    assert sched.cache.columnar_materialized() == 0
+
+
+# -- chaos: worker kill through the row path -----------------------------------
+
+
+def test_chaos_worker_kill_conserves_pods_on_columnar_rows():
+    """ChaosChurn leg (ISSUE 16): a bind-worker kill mid-dispatch while the
+    batch's placements live as cache ROWS. The supervisor requeues the
+    chunk, the rollback path un-books rows via the column-aware structural
+    inverse, and at quiescence every pod is exactly one of
+    bound/pending/failed — none lost, none double-bound — with the mutation
+    detector forced the whole way."""
+    store, sched = _build(True, True, n_nodes=4, batch_size=64,
+                          pod_initial_backoff=0.01, pod_max_backoff=0.05)
+    store.create_many("pods", _pods(24, prefix="ck", cpu="100m", mem="64Mi"))
+    sched.pump_events()
+    fi.arm([FaultPlan("bind.worker", "kill")])
+    assert sched.schedule_batch(timeout=0.0) == 24
+    assert (sched.cache.columnar_stats() or {}).get("inserted_total", 0) > 0, \
+        "kill leg must exercise the row path"
+    t0 = time.monotonic()
+    sched.flush_binds()
+    assert time.monotonic() - t0 < 5.0
+    sched._drain_bind_results()
+    fi.disarm()
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        sched.run_until_idle()
+        sched.queue.flush_backoff_completed()
+        sched.queue.move_all_to_active_or_backoff()
+        if sum(1 for p in store.list("pods")[0] if p.spec.node_name) == 24:
+            break
+        time.sleep(0.01)
+    sched.flush_binds()
+    sched.pump_events()
+    assert sum(1 for p in store.list("pods")[0] if p.spec.node_name) == 24
+    assert_pod_conservation(store, sched,
+                            [f"default/ck-{i}" for i in range(24)])

@@ -360,7 +360,10 @@ def test_columnar_and_per_pod_pipelines_place_identically():
 def test_columnar_assume_matches_per_pod_cache_state():
     """After a batch, columnar accounting leaves the cache bit-identical to
     the per-pod path: same requested totals, same pod sets, and the next
-    snapshot's tensors match."""
+    snapshot's tensors match. Since ISSUE 16 the columnar cache holds
+    steady-state placements as ROWS (scheduler/cachecols.py) — the
+    equivalence contract is after materialize_columnar_rows collapses them
+    into PodInfos (the walk below needs object rows either way)."""
     from kubernetes_tpu.snapshot.tensorizer import build_cluster_tensors
 
     maps = []
@@ -377,6 +380,10 @@ def test_columnar_assume_matches_per_pod_cache_state():
                                         mem="700Mi"))
         sched.run_until_idle()
         sched.pump_events()
+        if columnar and sched._cache_columnar:
+            # the constraint-free batch must actually have taken row mode
+            assert sched.cache.columnar_rows() == 50
+            assert sched.cache.materialize_columnar_rows() == 50
         snap = sched.cache.update_snapshot()
         cl = build_cluster_tensors(snap)
         tensors.append((cl.used.copy(), cl.used_nz.copy(),

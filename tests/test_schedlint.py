@@ -504,6 +504,73 @@ def test_mu001_quiet_on_columnar_reads_and_copies():
     assert "MU001" not in rules_of(analyze_source(MU001_COLUMNAR_GOOD))
 
 
+# MU001 cache-rows extension (ISSUE 16 satellite): Cache.pod_columns() hands
+# out a CacheColumnsView over the live scheduler-cache row table — the same
+# read-only contract as the store view (runtime-enforced writeable=False
+# numpy + this static rule).
+
+MU001_CACHECOLS_BAD = '''
+def poke_cache_view_array(self):
+    cols = self.cache.pod_columns()
+    cols.node_id[0] = 3
+
+def poke_cache_view_pod(self):
+    view = self.cache.pod_columns()
+    view.pod[0].spec.node_name = "n1"
+
+def poke_cache_view_index(self):
+    view = self.cache.pod_columns()
+    view.key2row.pop("default/p0")
+'''
+
+MU001_CACHECOLS_GOOD = '''
+def read_cache_rows(self):
+    cols = self.cache.pod_columns()
+    return int((cols.node_id >= 0).sum())
+
+def copy_then_mutate(self):
+    cols = self.cache.pod_columns()
+    mine = cols.node_id.copy()
+    mine[0] = 3
+    return mine
+
+def stats_only(self):
+    return self.cache.columnar_stats()
+'''
+
+
+def test_mu001_fires_on_cache_view_mutation():
+    findings = [f for f in analyze_source(MU001_CACHECOLS_BAD)
+                if f.rule == "MU001"]
+    assert len(findings) == 3, findings
+
+
+def test_mu001_quiet_on_cache_view_reads_and_copies():
+    assert "MU001" not in rules_of(analyze_source(MU001_CACHECOLS_GOOD))
+
+
+def test_cache_columns_view_is_runtime_readonly():
+    """The CacheColumnsView numpy member enforces the contract at runtime,
+    like the store's PodColumnsView (ro() writeable=False pattern)."""
+    import pytest
+
+    from kubernetes_tpu.scheduler.cachecols import (CacheColumns,
+                                                    CacheColumnsView,
+                                                    numpy_available)
+    if not numpy_available():
+        pytest.skip("numpy required")
+    cols = CacheColumns()
+
+    class _P:
+        pass
+
+    cols.insert("default/p0", _P(), "node-1")
+    view = CacheColumnsView(cols)
+    with pytest.raises(ValueError):
+        view.node_id[0] = 7
+    assert view.n == 1 and view.node_names[view.node_id[0]] == "node-1"
+
+
 JT001_BAD = '''
 import functools
 import jax

@@ -761,6 +761,7 @@ class TestTimeseriesSurfaces:
                 assert ktl_main(["--server", srv.url, "sched", "top"]) == 0
             out = buf.getvalue()
             assert "WIN" in out and "PODS/S" in out and "BREAKER" in out
+            assert "ALLOCS" in out  # live zero-alloc gauge column (ISSUE 16)
             assert "resource:" in out and "clock=" in out
             buf = io.StringIO()
             with redirect_stdout(buf):
