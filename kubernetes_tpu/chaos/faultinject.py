@@ -93,6 +93,16 @@ SITES: Dict[str, str] = {
     # conservation across it).
     "partition.dispatch": "scheduler/partition.py "
                           "PartitionedScheduler._drive_pipeline (no lock)",
+    # the background rebalancer (ISSUE 17): fires in
+    # scheduler/rebalance.py Rebalancer.cycle at cycle start
+    # (key="cycle"), at every migration-wave boundary (key="wave-<i>"),
+    # and MID-WAVE between replacement create_many and victim delete_pods
+    # (key="midwave") — the conservation-critical gap: an injected fault
+    # there rolls the wave's replacements back, a kill plan leaves a
+    # transient duplicate but never a lost or double-bound pod
+    # (tests/test_rebalance.py chaos case). No lock held at any fire.
+    "rebalance.cycle": "scheduler/rebalance.py Rebalancer.cycle / wave "
+                       "boundaries + midwave gap (no lock held)",
 }
 
 # sites that fire under a lock (or inside a loop that must not stall): only

@@ -1343,6 +1343,19 @@ def _render_sched_stats(doc: Dict) -> str:
                     f"cover_cost={gp.get('cover_cost', 0)} "
                     f"slices_ripped={gp.get('slices_ripped', 0)} "
                     f"vetoed_partial={gp.get('vetoed_partial', 0)}")
+        rb = st.get("rebalance")
+        if rb:
+            # background rebalancer (ISSUE 17): frag score + bounded
+            # migration totals; rendered only once enable_rebalancer() ran
+            out.append(
+                f"rebalance: cycles={rb.get('cycles', 0)} "
+                f"noop={rb.get('noop_cycles', 0)} "
+                f"plans={rb.get('plans', 0)} "
+                f"migrations={rb.get('migrations', 0)} "
+                f"waves={rb.get('waves', 0)} "
+                f"aborts={rb.get('slo_aborts', 0)}s/"
+                f"{rb.get('fault_aborts', 0)}f "
+                f"frag={rb.get('last_frag', 0.0):.3f}")
         rep = st.get("repair")
         if rep:
             last = rep.get("last") or {}

@@ -1,0 +1,179 @@
+"""Slice defragmentation kernel (ISSUE 17, ROADMAP direction 3).
+
+The background rebalancer (scheduler/rebalance.py) periodically re-solves
+the whole allocation as one batched tensor problem — the CvxCluster insight
+that granular cluster allocation re-solves orders of magnitude faster as a
+structured batched program, applied to the one decision this orchestrator
+repeats forever: which movable pods leave a fragmented ICI slice so a whole
+slice's worth of contiguous room reappears. Two pieces live here:
+
+  fragmentation score — per resource r with nonzero total free capacity,
+      frag_r = 1 - max_slice_free_r / total_free_r: 0 when every free unit
+      sits on one slice (a gang admits without eviction), approaching 1 as
+      free capacity smears evenly across slices (the state where arriving
+      gangs can only be admitted by destroying work through preemption).
+      The cycle score is the max over resources — computed host-side from
+      the cluster tensors alone, so the steady-state probe allocates no pod
+      objects.
+
+  defrag assignment — given the candidate victims of a donor slice (in
+      caller-supplied drain order) and the free/headroom tensors of the
+      candidate target nodes, greedily re-place each victim on the
+      tightest-fitting eligible node (best-fit: minimize the summed free
+      capacity remaining after placement, ties to the lowest node index).
+      One lax.scan over the victim axis carries the (free, headroom) state
+      so every step sees the capacity its predecessors consumed — the
+      waterfill idiom (models/waterfill.py) with a placement argmin instead
+      of a water level. defrag_assign_host is the numpy oracle (bit-parity
+      pinned by tests/test_rebalance.py) and the fallback when the padded
+      tensors would not be worth uploading.
+
+The kernel takes only batch-stable statics (pow2 buckets over both padded
+axes) and does no host sync inside the traced body (JT001/JT002,
+schedlint-enforced). Everything is int32 on device (this project runs jax
+in 32-bit mode): quantized resource magnitudes (millicores / MiB) keep a
+per-node dim sum far below 2^31, and the sentinel below stays in range.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# victims considered per rebalance cycle before the plan budget even
+# applies; the rebalancer publishes a candidates_capped stat when it clips —
+# never a silent truncation
+DEFRAG_MAX_VICTIMS = 1024
+# above this padded-tensor size the per-step [V, N, R] fit masks are not
+# worth building on device; the numpy oracle computes the same plan
+_DEFRAG_KERNEL_MAX_ELEMS = 4_000_000
+
+_INT32_BIG = 2**30  # "no eligible target" sentinel for the best-fit argmin
+
+
+# -- fragmentation score ------------------------------------------------------
+
+
+def slice_fragmentation(free: np.ndarray, slice_of_node: np.ndarray,
+                        active: Optional[np.ndarray] = None,
+                        ) -> Tuple[float, np.ndarray]:
+    """(score, per_slice_free [S, R]) from the cluster free tensor
+    (alloc - used, [N, R]) and the per-node slice ids (scheduler/gang.py
+    node_slice_ids; -1 = unlabeled, excluded). Score is the max over
+    resources with nonzero total free of 1 - max_slice_free / total_free:
+    0 on a zero-frag (or single-slice, or fully-packed) cluster.
+
+    active ([R] bool) restricts the score to resources the cluster actually
+    CONSUMES (the rebalancer passes used.sum(axis=0) > 0): a dim nothing
+    requests has its free capacity spread evenly by construction — scoring
+    it would read a permanent ~1-1/S "fragmentation" no migration can ever
+    change, and the no-op steady state would never be reached."""
+    free = np.maximum(np.asarray(free, dtype=np.int64), 0)
+    sl = np.asarray(slice_of_node, dtype=np.int64)
+    labeled = sl >= 0
+    if not labeled.any():
+        return 0.0, np.zeros((0, free.shape[1]), dtype=np.int64)
+    s = int(sl[labeled].max()) + 1
+    per_slice = np.zeros((s, free.shape[1]), dtype=np.int64)
+    np.add.at(per_slice, sl[labeled], free[labeled])
+    if s < 2:
+        return 0.0, per_slice
+    total = per_slice.sum(axis=0)
+    nz = total > 0
+    if active is not None:
+        nz &= np.asarray(active, dtype=bool)
+    if not nz.any():
+        return 0.0, per_slice
+    frag = 1.0 - per_slice[:, nz].max(axis=0) / total[nz]
+    return float(frag.max()), per_slice
+
+
+# -- defrag assignment --------------------------------------------------------
+
+
+@functools.partial(jax.jit, static_argnames=("n_slots", "v_max"))
+def defrag_assign(free, headroom, target_ok, v_req, v_valid,
+                  n_slots: int, v_max: int):
+    """Target node per victim (-1 = no eligible target; the victim stays).
+    All arrays padded by the caller: free [n_slots, R] int32, headroom
+    [n_slots] int32 (remaining pod-count slots), target_ok [n_slots] bool
+    (schedulable AND not on a donor slice), v_req [v_max, R] int32 in drain
+    order, v_valid [v_max] bool (False pads). Statics are pow2 buckets only.
+    One scan step per victim: the carry is the live (free, headroom), so a
+    wave of placements never double-books a node."""
+
+    def step(carry, xs):
+        fr, hd = carry
+        vr, valid = xs
+        fits = (fr >= vr[None, :]).all(axis=1) & (hd > 0) & target_ok
+        # best-fit key: free capacity REMAINING after placement, summed
+        # across dims — the tightest bin wins, ties to the lowest index
+        waste = jnp.sum(fr - vr[None, :], axis=1)
+        key = jnp.where(fits, waste, jnp.int32(_INT32_BIG))
+        tgt = jnp.argmin(key).astype(jnp.int32)
+        place = (key[tgt] < jnp.int32(_INT32_BIG)) & valid
+        fr = fr.at[tgt].add(-vr * place)
+        hd = hd.at[tgt].add(-place.astype(hd.dtype))
+        return (fr, hd), jnp.where(place, tgt, jnp.int32(-1))
+
+    (_fr, _hd), out = jax.lax.scan(
+        step, (free, headroom), (v_req, v_valid), length=v_max)
+    return out
+
+
+def defrag_assign_host(free: np.ndarray, headroom: np.ndarray,
+                       target_ok: np.ndarray,
+                       v_req: np.ndarray) -> np.ndarray:
+    """Numpy oracle of defrag_assign (unpadded): the parity target and the
+    fallback when the padded tensors exceed the device budget. Same greedy,
+    same best-fit key, same first-min tie-break."""
+    free = np.asarray(free, dtype=np.int64).copy()
+    headroom = np.asarray(headroom, dtype=np.int64).copy()
+    target_ok = np.asarray(target_ok, dtype=bool)
+    v_req = np.asarray(v_req, dtype=np.int64)
+    out = np.full(len(v_req), -1, dtype=np.int64)
+    for k in range(len(v_req)):
+        vr = v_req[k]
+        fits = (free >= vr[None, :]).all(axis=1) & (headroom > 0) & target_ok
+        if not fits.any():
+            continue
+        waste = np.sum(free - vr[None, :], axis=1)
+        key = np.where(fits, waste, np.int64(_INT32_BIG))
+        tgt = int(np.argmin(key))
+        out[k] = tgt
+        free[tgt] -= vr
+        headroom[tgt] -= 1
+    return out
+
+
+def defrag_plan(free: np.ndarray, headroom: np.ndarray, target_ok: np.ndarray,
+                v_req: np.ndarray) -> np.ndarray:
+    """Dispatch wrapper: pads to pow2 buckets and runs the jitted scan, or
+    the numpy oracle when the padded tensors would blow the device budget.
+    Returns the [V] target node index vector as numpy int64 (-1 = stay)."""
+    v = len(v_req)
+    ns, r = free.shape
+    # pow2 buckets key the jit (JT001 discipline, models/waterfill.py idiom)
+    n_slots = 1 << max(0, ns - 1).bit_length()
+    v_max = 1 << max(0, v - 1).bit_length()
+    if v == 0:
+        return np.zeros(0, dtype=np.int64)
+    if v_max * n_slots * r > _DEFRAG_KERNEL_MAX_ELEMS:
+        return defrag_assign_host(free, headroom, target_ok, v_req)
+    free_p = np.zeros((n_slots, r), dtype=np.int32)
+    free_p[:ns] = free
+    head_p = np.zeros(n_slots, dtype=np.int32)
+    head_p[:ns] = headroom
+    ok_p = np.zeros(n_slots, dtype=bool)
+    ok_p[:ns] = target_ok
+    vr_p = np.zeros((v_max, r), dtype=np.int32)
+    vr_p[:v] = v_req
+    valid_p = np.zeros(v_max, dtype=bool)
+    valid_p[:v] = True
+    out = np.asarray(defrag_assign(free_p, head_p, ok_p, vr_p, valid_p,
+                                   n_slots=n_slots, v_max=v_max))
+    return out[:v].astype(np.int64)

@@ -213,6 +213,11 @@ class BatchScheduler(Scheduler):
         # permutation (models/gangcover.py). Both inert on gang-free runs.
         self.rank_align = rank_align
         self.gangpreempt = GangPreemptor(self) if gang_preemption else None
+        # background rebalancer (scheduler/rebalance.py, ISSUE 17):
+        # installed by enable_rebalancer(); run_until_idle's quiesce path
+        # paces it, sched_stats()["rebalance"] publishes its totals. Inert
+        # (one attr read) until installed.
+        self.rebalancer = None
 
     def schedule_batch(self, timeout: Optional[float] = 0.0) -> int:
         """Drain up to batch_size pods, solve jointly, bind. Returns #pods handled.
@@ -359,6 +364,15 @@ class BatchScheduler(Scheduler):
             hard_pod_affinity_weight=self._hard_pod_affinity_weight(),
             reuse=self._tensor_cache, changed_nodes=changed_nodes,
             gangs=self.gangs, store_cols=store_cols)
+        if store_cols is not None:
+            # bind/assume-edge sig capture (ISSUE 17 satellite): the batch
+            # build just primed _class_sig/_req_sig on these pods — write
+            # the refs back into the store's sig column so rows re-synced
+            # by later status/relist writes keep a seedable signature. ONE
+            # batched call per batch (HP001), not a per-pod ride-along.
+            cap = getattr(self.store, "capture_sig_memos", None)
+            if cap is not None:
+                cap(pods)
 
         fallback_mask = batch.fallback_class[batch.class_of_pod]
         # Gang semantic hole CLOSED (ISSUE 8 satellite; ROADMAP direction 4
@@ -1455,6 +1469,10 @@ class BatchScheduler(Scheduler):
                 "conflicts": self.partition_conflicts,
                 "reroutes": self.partition_reroutes,
             } if self.partition_index is not None else None),
+            # background rebalancer (ISSUE 17): fragmentation score +
+            # migration/wave/abort totals; None until enable_rebalancer()
+            "rebalance": (self.rebalancer.stats()
+                          if self.rebalancer is not None else None),
             "bind_worker": {
                 "restarts": self.bind_worker_restarts,
                 "failures_logged": len(self.bind_failures),
@@ -2072,6 +2090,16 @@ class BatchScheduler(Scheduler):
             self.resource_sampler.register_thread(
                 self._thread_label("sched"), self._thread)
 
+    def enable_rebalancer(self, **kwargs):
+        """Attach a background Rebalancer (scheduler/rebalance.py, ISSUE 17)
+        to this pipeline; kwargs pass through to its constructor. The
+        run_until_idle quiesce path paces it via maybe_cycle(), and
+        sched_stats()["rebalance"] publishes its totals. Returns it."""
+        from .rebalance import Rebalancer
+
+        self.rebalancer = Rebalancer(self, **kwargs)
+        return self.rebalancer
+
     def run_until_idle(self, max_cycles: int = 10_000) -> int:
         n = 0
         while n < max_cycles:
@@ -2082,6 +2110,14 @@ class BatchScheduler(Scheduler):
                 self.pump_events()
                 self.sweep_expired_assumes()
                 if self.schedule_batch(timeout=0.0) == 0:
+                    # idle: let the rebalancer take a paced defrag cycle —
+                    # migrations emit create/delete events, so loop once
+                    # more to ingest them before declaring idle for real
+                    if self.rebalancer is not None:
+                        r = self.rebalancer.maybe_cycle()
+                        if r is not None and r.get("migrations"):
+                            n += 1
+                            continue
                     break
             n += 1
         self.flush_binds()

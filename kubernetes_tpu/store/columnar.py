@@ -165,6 +165,7 @@ class PodColumns:
         self.phases: List[str] = []
         self._phase_ids: Dict[str, int] = {}
         self.materialized_total = 0  # lifetime lazy row materializations
+        self.sig_captured = 0  # lifetime capture_sig_memos column writes
         self._sig_keys = _sig_memo_keys()
 
     # -- intern tables ---------------------------------------------------------
@@ -248,10 +249,52 @@ class PodColumns:
             self.rank[row] = -1
         d = pod.__dict__
         k1, k2 = self._sig_keys
-        self.sig[row] = (d.get(k1), d.get(k2))
+        cs, rs = d.get(k1), d.get(k2)
+        cur = self.sig[row]
+        if cur is not None:
+            # a re-sync must not CLOBBER a previously captured memo ref the
+            # incoming parse lacks (ISSUE 17 satellite, the PR 15 carryover:
+            # status/relist writes hand fresh objects with empty memo slots,
+            # and the rebalancer's evict→re-place waves re-sync constantly).
+            # Keeping a stale ref is safe by construction — the tensorizer's
+            # seed_memos validates the identity anchors (spec, labels)
+            # before applying, so a ref whose spec was since replaced simply
+            # never seeds.
+            if cs is None:
+                cs = cur[0]
+            if rs is None:
+                rs = cur[1]
+        self.sig[row] = (cs, rs)
         if self.diverged[row]:
             self.diverged[row] = False
             self._diverged_n -= 1
+
+    def capture(self, key: str, pod) -> bool:
+        """Back-fill the sig column from a pod object whose memos were
+        primed OUTSIDE the store (the tensorizer's build_pod_batch, at the
+        batch's bind/assume edge): the scheduler's pod shares spec identity
+        with the stored object (structural clones share deep members), so
+        its memo refs seed future parses of this row. Only fills components
+        the column does not already have — sync() owns refreshes."""
+        row = self.key2row.get(key)
+        if row is None:
+            return False
+        d = pod.__dict__
+        k1, k2 = self._sig_keys
+        cs, rs = d.get(k1), d.get(k2)
+        if cs is None and rs is None:
+            return False
+        cur = self.sig[row]
+        if cur is not None:
+            if cur[0] is not None:
+                cs = cur[0]
+            if cur[1] is not None:
+                rs = cur[1]
+            if (cs is cur[0] and rs is cur[1]):
+                return False
+        self.sig[row] = (cs, rs)
+        self.sig_captured += 1
+        return True
 
     def remove(self, key: str) -> None:
         row = self.key2row.pop(key, None)
@@ -435,4 +478,5 @@ class PodColumns:
             "bound": int((self.node_id[: self.n] >= 0).sum()),
             "node_table": len(self.node_names),
             "phase_table": len(self.phases),
+            "sig_captured": self.sig_captured,
         }

@@ -1576,6 +1576,25 @@ class APIStore:
         with self._pods_lock:
             return _columnar.PodColumnsView(self._cols)
 
+    def capture_sig_memos(self, pods) -> int:
+        """Back-fill the columnar sig column from pod objects whose
+        signature memos were primed outside the store (ISSUE 17 satellite,
+        the PR 15 carryover). The scheduler calls this at the batch's
+        bind/assume edge, right after build_pod_batch primed
+        `_class_sig`/`_req_sig` on its queue pods: those refs anchor to the
+        same spec/labels objects the stored rows share (structural clones
+        copy __dict__ at the C level), so a row re-synced later by a
+        status/relist write keeps a seedable signature instead of starting
+        over. Returns the number of rows captured; 0 on the dict path."""
+        if self._cols is None:
+            return 0
+        captured = 0
+        with self._pods_lock:
+            for p in pods:
+                if self._cols.capture(p.key, p):
+                    captured += 1
+        return captured
+
     def columnar_stats(self) -> Optional[Dict]:
         """Columnar-table telemetry (rows, diverged count, lifetime lazy
         materializations, intern-table sizes) — what `ktl sched stats` and

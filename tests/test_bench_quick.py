@@ -328,3 +328,25 @@ def test_bench_quick_runs_and_emits_json():
     assert sl["findings"] == 0, sl
     assert sl["files"] > 100
     assert sl["wall_s"] <= 15.0, sl
+    # the defrag rung (ISSUE 17): the rebalancer A/B — on the churn-smeared
+    # cluster the SAME gang admits with ZERO preemptions and lower latency
+    # once the background rebalancer has consolidated the fillers, the
+    # migration budget is audited per cycle, conservation holds through the
+    # victim->replacement migration chain, the windowed SLO verdict passes
+    # on BOTH legs, and the timed window compiles nothing (the defrag
+    # kernel's pow2 buckets were covered by the warm-up leg)
+    df = workloads["Defrag"]
+    assert "error" not in df, df
+    assert df["defrag_ok"] is True, df
+    assert df["preemptions_on"] == 0 < df["preemptions_off"], df
+    assert df["latency_improved"] is True, df
+    assert df["migrations"] > 0, df
+    assert df["migrations"] <= df["budget_per_cycle"] * max(df["waves"], 1), df
+    assert df["budget_ok"] is True, df
+    assert df["frag_after"] < 0.25 <= df["frag_before"], df
+    assert df["conservation_ok"] is True, df
+    assert df["conservation_on"]["lost"] == 0, df
+    assert df["conservation_on"]["double_bound"] == 0, df
+    assert df["slo_pass_on"] is True and df["slo_pass_off"] is True, df
+    assert df["solver_compiles_during_run"] == 0, df
+    assert df["ab_comparable"] is True, df

@@ -1,0 +1,496 @@
+"""Global rebalancer & slice defragmenter (ISSUE 17 acceptance).
+
+The invariants under test: the jitted defrag kernel is bit-parity with its
+numpy oracle (including the forced host-fallback path); the fragmentation
+score has the right units (0 on consolidated/single-slice clusters, the
+even-split value on smeared ones, inactive dims excluded); a rebalance
+cycle consolidates a fragmented cluster within its hard migration budgets
+and never touches PDB-exhausted, gang-member, or above-ceiling pods; the
+no-op cycle on a below-threshold cluster is allocation-free (zero row
+materializations); exactly ONE rebalancer runs per store and shard
+pipelines of a partitioned scheduler are inert; a mid-wave injected fault
+rolls the wave back and a mid-wave KILL leaves pod conservation clean; and
+the sig-column capture satellite keeps re-synced rows seedable.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import kubernetes_tpu.chaos.faultinject as fi
+from kubernetes_tpu.chaos.faultinject import FaultKill, FaultPlan
+from kubernetes_tpu.models.defrag import (DEFRAG_MAX_VICTIMS, defrag_assign,
+                                          defrag_assign_host, defrag_plan,
+                                          slice_fragmentation)
+from kubernetes_tpu.scheduler import Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.scheduler.rebalance import Rebalancer, _mg_name
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import (MakeNode, MakePod,
+                                    assert_pod_conservation, make_pod_group,
+                                    mutation_detector_guard)
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    yield from mutation_detector_guard(monkeypatch)
+
+
+@pytest.fixture(autouse=True)
+def _always_disarm():
+    fi.disarm()
+    yield
+    fi.disarm()
+
+
+def _sched(store, **kw):
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=1024, solver="fast",
+                           pipeline_binds=False, **kw)
+    sched.sync()
+    return sched
+
+
+def _slice_cluster(store, n_slices=2, per_slice=4, cpu="8"):
+    for s in range(n_slices):
+        for i in range(per_slice):
+            store.create("nodes", MakeNode(f"node-{s}-{i}")
+                         .tpu_slice(s, index=i)
+                         .capacity({"cpu": cpu, "memory": "32Gi",
+                                    "pods": "110"}).obj())
+
+
+def _fill(store, name, node, cpu="3", prio=1, labels=None):
+    p = MakePod(name).priority(prio).req({"cpu": cpu}).obj()
+    if labels:
+        p.metadata.labels.update(labels)
+    p.spec.node_name = node
+    store.create("pods", p)
+    return p
+
+
+def _smear(store, n_slices=2, per_slice=4, cpu="3", prio=1):
+    """One filler per node: free capacity evenly smeared across slices."""
+    return [_fill(store, f"low-{s}-{i}", f"node-{s}-{i}", cpu=cpu, prio=prio)
+            for s in range(n_slices) for i in range(per_slice)]
+
+
+# -- kernel parity -------------------------------------------------------------
+
+
+def test_defrag_kernel_matches_host_oracle():
+    rng = np.random.default_rng(17)
+    for _ in range(30):
+        ns = int(rng.integers(1, 12))
+        r = int(rng.integers(1, 4))
+        v = int(rng.integers(0, 16))
+        free = rng.integers(0, 20, size=(ns, r)).astype(np.int64)
+        head = rng.integers(0, 6, size=ns).astype(np.int64)
+        ok = rng.random(ns) > 0.3
+        v_req = rng.integers(0, 12, size=(v, r)).astype(np.int64)
+        got = defrag_plan(free, head, ok, v_req)
+        want = defrag_assign_host(free, head, ok, v_req)
+        np.testing.assert_array_equal(got, want)
+
+
+def test_defrag_plan_host_fallback_parity(monkeypatch):
+    import kubernetes_tpu.models.defrag as defrag
+
+    rng = np.random.default_rng(3)
+    free = rng.integers(0, 20, size=(6, 3)).astype(np.int64)
+    head = rng.integers(0, 6, size=6).astype(np.int64)
+    ok = np.ones(6, dtype=bool)
+    v_req = rng.integers(0, 12, size=(5, 3)).astype(np.int64)
+    on_device = defrag_plan(free, head, ok, v_req)
+    monkeypatch.setattr(defrag, "_DEFRAG_KERNEL_MAX_ELEMS", 0)
+    np.testing.assert_array_equal(defrag_plan(free, head, ok, v_req),
+                                  on_device)
+
+
+def test_defrag_kernel_padding_invariance():
+    """Pad rows (v_valid False) and pad slots (all-zero free, target_ok
+    False) never change real rows' targets."""
+    free = np.array([[5, 5], [9, 9]], dtype=np.int32)
+    head = np.array([2, 2], dtype=np.int32)
+    ok = np.array([True, True])
+    v_req = np.array([[4, 4], [6, 6]], dtype=np.int64)
+    out = defrag_plan(free, head, ok, v_req)
+    # best fit: victim0 -> node0 (waste 2 < 8), victim1 -> node1
+    np.testing.assert_array_equal(out, [0, 1])
+
+
+def test_defrag_respects_headroom_and_mask():
+    free = np.array([[10], [10]], dtype=np.int64)
+    head = np.array([1, 0], dtype=np.int64)  # node1 has no pod slots
+    ok = np.array([True, True])
+    v_req = np.array([[2], [2]], dtype=np.int64)
+    out = defrag_plan(free, head, ok, v_req)
+    np.testing.assert_array_equal(out, [0, -1])  # node0 full after first
+    out = defrag_plan(free, np.array([5, 5]), np.array([False, False]), v_req)
+    np.testing.assert_array_equal(out, [-1, -1])
+
+
+# -- fragmentation score -------------------------------------------------------
+
+
+def test_frag_score_units():
+    # even 2-slice split: 1 - 1/2
+    free = np.array([[4], [4]], dtype=np.int64)
+    score, per = slice_fragmentation(free, np.array([0, 1]))
+    assert score == pytest.approx(0.5)
+    np.testing.assert_array_equal(per, [[4], [4]])
+    # all free on one slice: consolidated
+    score, _ = slice_fragmentation(np.array([[8], [0]], dtype=np.int64),
+                                   np.array([0, 1]))
+    assert score == 0.0
+    # single slice / unlabeled: moot
+    assert slice_fragmentation(free, np.array([0, 0]))[0] == 0.0
+    assert slice_fragmentation(free, np.array([-1, -1]))[0] == 0.0
+
+
+def test_frag_score_inactive_dims_excluded():
+    """A dim nothing consumes is evenly spread by construction and must not
+    read as fragmentation (the memory-dim trap)."""
+    free = np.array([[8, 100], [0, 100]], dtype=np.int64)
+    sl = np.array([0, 1])
+    assert slice_fragmentation(free, sl)[0] == pytest.approx(0.5)
+    active = np.array([True, False])
+    assert slice_fragmentation(free, sl, active)[0] == 0.0
+
+
+# -- end-to-end consolidation --------------------------------------------------
+
+
+def test_cycle_consolidates_fragmented_cluster():
+    store = APIStore()
+    _slice_cluster(store)
+    pods = _smear(store)
+    sched = _sched(store)
+    rb = sched.enable_rebalancer(frag_threshold=0.25, budget_per_wave=2,
+                                 budget_per_cycle=8, priority_ceiling=50)
+    r1 = rb.cycle()
+    assert r1["ran"] and r1["migrations"] == 4 and r1["waves"] == 2
+    sched.pump_events()
+    r2 = rb.cycle()
+    assert r2["migrations"] == 0 and r2["frag"] < 0.25
+    # one slice fully drained in the store
+    bound = [p.spec.node_name for p in store.list("pods")[0]]
+    assert all(n.startswith("node-1-") for n in bound)
+    # conservation through the migration chain
+    live = rb.resolve_keys([p.key for p in pods])
+    assert_pod_conservation(store, sched, live)
+    st = rb.stats()
+    assert st["migrations"] == 4 and st["plans"] == 1
+    assert sched.sched_stats()["rebalance"]["migrations"] == 4
+
+
+def test_migration_names_stay_bounded():
+    assert _mg_name("web-0", 3) == "web-0-mg3"
+    assert _mg_name("web-0-mg3", 7) == "web-0-mg7"
+    assert _mg_name("web-0-mg3x", 7) == "web-0-mg3x-mg7"
+
+
+def test_noop_cycle_is_allocation_free():
+    """Below-threshold probe must not materialize a single pod row: the
+    score comes from the cluster tensors + the sig-free columnar view."""
+    store = APIStore()
+    _slice_cluster(store)
+    # consolidated: all fillers on slice 0, slice 1 fully free
+    for i in range(4):
+        _fill(store, f"low-{i}", f"node-0-{i}", cpu="6")
+    sched = _sched(store)
+    rb = sched.enable_rebalancer(frag_threshold=0.25)
+    before = store.columnar_stats()["materialized_total"]
+    r = rb.cycle()
+    assert r["ran"] and r["migrations"] == 0
+    assert store.columnar_stats()["materialized_total"] == before
+    assert rb.stats()["noop_cycles"] == 1
+
+
+def test_unlabeled_cluster_is_noop():
+    store = APIStore()
+    for i in range(3):
+        store.create("nodes", MakeNode(f"plain-{i}").capacity(
+            {"cpu": "8", "memory": "32Gi", "pods": "110"}).obj())
+    _fill(store, "a", "plain-0", cpu="6")
+    sched = _sched(store)
+    rb = sched.enable_rebalancer()
+    r = rb.cycle()
+    assert r["ran"] and r["migrations"] == 0
+    assert rb.stats()["noop_cycles"] == 1
+
+
+# -- never-worse randomized sweep ---------------------------------------------
+
+
+def test_randomized_never_worse_sweep():
+    rng = np.random.default_rng(170)
+    for trial in range(6):
+        store = APIStore()
+        n_slices = int(rng.integers(2, 4))
+        per_slice = int(rng.integers(2, 5))
+        _slice_cluster(store, n_slices=n_slices, per_slice=per_slice)
+        keys, protected = [], {}
+        gang_named = False
+        for s in range(n_slices):
+            for i in range(per_slice):
+                if rng.random() < 0.3:
+                    continue
+                kind = rng.random()
+                name = f"p-{s}-{i}"
+                node = f"node-{s}-{i}"
+                if kind < 0.2:
+                    # above the priority ceiling: must never move
+                    p = _fill(store, name, node, cpu="3", prio=1000)
+                    protected[p.key] = node
+                elif kind < 0.4:
+                    # PDB-exhausted: must never move
+                    p = _fill(store, name, node, cpu="3",
+                              labels={"app": "guarded"})
+                    protected[p.key] = node
+                elif kind < 0.55:
+                    # gang member: must never move
+                    if not gang_named:
+                        store.create("podgroups", make_pod_group("g", 1))
+                        gang_named = True
+                    p = MakePod(name).gang("g", rank=i).priority(1).req(
+                        {"cpu": "3"}).obj()
+                    p.spec.node_name = node
+                    store.create("pods", p)
+                    protected[p.key] = node
+                else:
+                    p = _fill(store, name, node, cpu="3", prio=1)
+                keys.append(p.key)
+        from kubernetes_tpu.api import ObjectMeta, Selector
+        from kubernetes_tpu.api.policy import PodDisruptionBudget
+        pdb = PodDisruptionBudget(
+            metadata=ObjectMeta(name="guard", namespace="default"),
+            selector=Selector.from_match_labels({"app": "guarded"}),
+            max_unavailable=0, disruptions_allowed=0)
+        store.create("poddisruptionbudgets", pdb)
+        sched = _sched(store)
+        budget_cycle = int(rng.integers(1, 5))
+        rb = sched.enable_rebalancer(frag_threshold=0.05,
+                                     budget_per_wave=2,
+                                     budget_per_cycle=budget_cycle,
+                                     priority_ceiling=100)
+        r = rb.cycle()
+        assert r.get("migrations", 0) <= budget_cycle, (trial, r)
+        sched.pump_events()
+        # protected pods never moved, never renamed
+        for key, node in protected.items():
+            p = store.get("pods", key)
+            assert p.spec.node_name == node, (trial, key)
+        # conservation: nothing stranded at quiescence
+        sched.run_until_idle()
+        assert_pod_conservation(store, sched, rb.resolve_keys(keys))
+        rb.release()
+
+
+# -- ownership & partition inertness ------------------------------------------
+
+
+def test_one_rebalancer_per_store():
+    store = APIStore()
+    _slice_cluster(store)
+    _smear(store)
+    s1, s2 = _sched(store), _sched(store)
+    rb1 = Rebalancer(s1, frag_threshold=0.25, priority_ceiling=50)
+    rb2 = Rebalancer(s2, frag_threshold=0.25, priority_ceiling=50)
+    assert rb1.cycle()["ran"]
+    r = rb2.cycle()
+    assert not r["ran"] and r["reason"] == "conflict"
+    assert rb2.stats()["inert_conflict"] == 1
+    # the claim releases explicitly; the successor may then own the store
+    rb1.release()
+    s2.pump_events()
+    assert rb2.cycle()["ran"]
+    rb2.release()
+
+
+def test_shard_pipelines_are_inert():
+    store = APIStore()
+    _slice_cluster(store)
+    _smear(store)
+    sched = _sched(store)
+    rb = sched.enable_rebalancer(frag_threshold=0.25, priority_ceiling=50)
+    sched.partition_index = 0  # shard pipeline: partial view
+    r = rb.cycle()
+    assert not r["ran"] and r["reason"] == "partition"
+    assert rb.stats()["inert_partition"] == 1
+    sched.partition_index = -1  # residual full-view pipeline: owns it
+    assert rb.cycle()["ran"]
+    rb.release()
+
+
+def test_maybe_cycle_paces():
+    store = APIStore()
+    _slice_cluster(store)
+    sched = _sched(store)
+    rb = sched.enable_rebalancer(min_interval_s=3600.0)
+    assert rb.maybe_cycle() is not None
+    assert rb.maybe_cycle() is None  # within the interval
+    rb.release()
+
+
+def test_run_until_idle_admits_gang_after_defrag():
+    """The acceptance story end to end: a gang that cannot fit on any one
+    fragmented slice admits WITHOUT preemption once the idle-path
+    rebalancer consolidates a slice (gang preemption disabled so the
+    destructive path cannot race the migration path)."""
+    store = APIStore()
+    _slice_cluster(store)
+    _smear(store)  # 3 cpu used per node -> 5 free; gang needs 6
+    sched = _sched(store, gang_preemption=False)
+    sched.enable_rebalancer(frag_threshold=0.25, budget_per_wave=4,
+                            budget_per_cycle=8, priority_ceiling=50)
+    store.create("podgroups", make_pod_group("train", 4))
+    gang = [MakePod(f"g-{i}").gang("train", rank=i).priority(100)
+            .req({"cpu": "6"}).obj() for i in range(4)]
+    store.create_many("pods", gang, consume=True)
+    sched.pump_events()
+    # drive loop (the gangpreempt idiom): requeues land in the backoff
+    # tier, which run_until_idle deliberately does not flush
+    deadline = time.time() + 15.0
+    bound = {}
+    while time.time() < deadline:
+        sched.run_until_idle()
+        sched.queue.flush_backoff_completed()
+        sched.pump_events()
+        bound = {p.metadata.name: p.spec.node_name
+                 for p in store.list("pods")[0]
+                 if p.metadata.name.startswith("g-")}
+        if len(bound) == 4 and all(bound.values()):
+            break
+        time.sleep(0.02)
+    assert len(bound) == 4 and all(bound.values()), bound
+    assert sched.preemption_count == 0
+    assert sched.rebalancer.stats()["migrations"] > 0
+    sched.rebalancer.release()
+
+
+# -- chaos ---------------------------------------------------------------------
+
+
+def test_injected_cycle_fault_aborts_cleanly():
+    store = APIStore()
+    _slice_cluster(store)
+    pods = _smear(store)
+    sched = _sched(store)
+    rb = sched.enable_rebalancer(frag_threshold=0.25, priority_ceiling=50)
+    fi.arm([FaultPlan("rebalance.cycle", "fail", count=1, match="cycle")])
+    r = rb.cycle()
+    assert not r["ran"] and r["reason"] == "fault"
+    assert rb.stats()["fault_aborts"] == 1
+    assert len(store.list("pods")[0]) == len(pods)  # nothing touched
+    fi.disarm()
+    assert rb.cycle()["migrations"] > 0
+    rb.release()
+
+
+def test_midwave_fault_rolls_wave_back():
+    store = APIStore()
+    _slice_cluster(store)
+    pods = _smear(store)
+    sched = _sched(store)
+    rb = sched.enable_rebalancer(frag_threshold=0.25, budget_per_wave=2,
+                                 priority_ceiling=50)
+    fi.arm([FaultPlan("rebalance.cycle", "fail", count=1, match="midwave")])
+    r = rb.cycle()
+    assert r["ran"] and r["aborted"] and r["migrations"] == 0
+    # the wave's replacements were rolled back: original pods, original
+    # nodes, no -mg duplicates
+    names = sorted(p.metadata.name for p in store.list("pods")[0])
+    assert names == sorted(p.metadata.name for p in pods)
+    # the idle path retries once the plan is disarmed; conservation holds
+    # through the (new, successful) migration chain
+    sched.pump_events()
+    sched.run_until_idle()
+    assert_pod_conservation(store, sched,
+                            rb.resolve_keys([p.key for p in pods]))
+    rb.release()
+
+
+def test_midwave_kill_conserves_pods():
+    """A HARD kill between replacement create and victim delete leaves a
+    transient duplicate — but every submitted pod stays bound exactly once
+    (the ISSUE 17 chaos invariant)."""
+    store = APIStore()
+    _slice_cluster(store)
+    pods = _smear(store)
+    sched = _sched(store)
+    rb = sched.enable_rebalancer(frag_threshold=0.25, budget_per_wave=2,
+                                 priority_ceiling=50)
+    fi.arm([FaultPlan("rebalance.cycle", "kill", match="midwave")])
+    with pytest.raises(FaultKill):
+        rb.cycle()
+    # BEFORE any retry: every original still bound (delete never ran); the
+    # kill's only residue is the wave's duplicate replacements
+    assert_pod_conservation(store, sched, [p.key for p in pods])
+    fi.disarm()
+    sched.pump_events()
+    sched.run_until_idle()
+    assert_pod_conservation(store, sched,
+                            rb.resolve_keys([p.key for p in pods]))
+    rb.release()
+
+
+def test_slo_probe_aborts_before_wave():
+    store = APIStore()
+    _slice_cluster(store)
+    _smear(store)
+    sched = _sched(store)
+    rb = sched.enable_rebalancer(frag_threshold=0.25, priority_ceiling=50,
+                                 slo_probe=lambda: False)
+    r = rb.cycle()
+    assert r["ran"] and r["aborted"] and r["migrations"] == 0
+    assert rb.stats()["slo_aborts"] == 1
+    rb.release()
+
+
+# -- sig-column capture (satellite 1) -----------------------------------------
+
+
+def test_sync_preserves_captured_sig_components():
+    """A re-sync from a memo-less parse (status/relist writes) must not
+    clobber previously captured sig refs — and a later parse sharing the
+    anchors re-seeds from the preserved column entry."""
+    store = APIStore()
+    _slice_cluster(store)
+    p = MakePod("keep").req({"cpu": "1"}).obj()
+    store.create("pods", p)
+    stored = store.get("pods", p.key)
+    sig = (("sig",),)
+    stored.__dict__["_req_sig"] = (stored.spec, sig)
+    assert store.capture_sig_memos([stored]) == 1
+    # a fresh memo-less object re-syncs the row (update path)
+    from kubernetes_tpu.store.store import pod_structural_clone
+    fresh = pod_structural_clone(stored)
+    for k in ("_req_sig", "_class_sig", "_req_cache"):
+        fresh.__dict__.pop(k, None)
+    fresh.status.phase = "Running"
+    store.update("pods", fresh)
+    view = store.pod_columns()
+    row = view.key2row[p.key]
+    ent = view.sig[row]
+    assert ent is not None and ent[1] is not None
+    assert ent[1][1] is sig  # the captured ref survived the re-sync
+    assert store.columnar_stats()["sig_captured"] == 1
+
+
+def test_batch_path_captures_sig_memos():
+    """Scheduling a batch back-fills the store's sig column for the batch's
+    pods (the bind/assume-edge wiring)."""
+    store = APIStore()
+    _slice_cluster(store)
+    pods = [MakePod(f"pend-{i}").req({"cpu": "1"}).obj() for i in range(4)]
+    store.create_many("pods", pods, consume=True)
+    sched = _sched(store)
+    sched.run_until_idle()
+    assert store.columnar_stats()["sig_captured"] >= 4
+    view = store.pod_columns()
+    for p in pods:
+        ent = view.sig[view.key2row[p.key]]
+        assert ent is not None and ent[1] is not None, p.key

@@ -847,6 +847,120 @@ def test_jt002_quiet_on_host_padding_outside_gangcover_kernel():
     assert "JT002" not in rules_of(analyze_source(JT002_GANGCOVER_GOOD))
 
 
+JT001_DEFRAG_BAD = '''
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("n_slots", "v_max"))
+def defrag_assign(free, headroom, target_ok, v_req, v_valid, n_slots, v_max):
+    return free[:n_slots], v_req[:v_max]
+
+def defrag_plan(free, headroom, target_ok, v_req):
+    # raw node and victim counts key the jit: a compile per cluster size
+    # AND per candidate-victim count — the rebalancer would recompile on
+    # every cycle whose donor slice drains a different number of pods
+    return defrag_assign(free, headroom, target_ok, v_req, v_req,
+                         n_slots=len(free), v_max=len(v_req))
+'''
+
+JT001_DEFRAG_GOOD = '''
+import functools
+import jax
+
+@functools.partial(jax.jit, static_argnames=("n_slots", "v_max"))
+def defrag_assign(free, headroom, target_ok, v_req, v_valid, n_slots, v_max):
+    return free[:n_slots], v_req[:v_max]
+
+def defrag_plan(free, headroom, target_ok, v_req, ns, v):
+    # the shipped discipline: pow2 buckets over both padded axes, so the
+    # kernel compiles once per doubling, not once per cycle
+    n_slots = 1 << max(0, ns - 1).bit_length()
+    v_max = 1 << max(0, v - 1).bit_length()
+    return defrag_assign(free, headroom, target_ok, v_req, v_req,
+                         n_slots=n_slots, v_max=v_max)
+'''
+
+
+def test_jt001_fires_on_defrag_raw_static_keys():
+    findings = [f for f in analyze_source(JT001_DEFRAG_BAD)
+                if f.rule == "JT001"]
+    assert len(findings) >= 1, findings
+    assert any("n_slots" in f.message or "v_max" in f.message
+               for f in findings)
+
+
+def test_jt001_quiet_on_defrag_shipped_buckets():
+    assert "JT001" not in rules_of(analyze_source(JT001_DEFRAG_GOOD))
+
+
+JT002_DEFRAG_BAD = '''
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@functools.partial(jax.jit, static_argnames=("n_slots", "v_max"))
+def defrag_assign(free, headroom, target_ok, v_req, v_valid, n_slots, v_max):
+    def step(carry, xs):
+        fr, hd = carry
+        vr, valid = xs
+        fits = (fr >= vr[None, :]).all(axis=1) & (hd > 0) & target_ok
+        waste = jnp.sum(fr - vr[None, :], axis=1)
+        # host argmin INSIDE the scan body: a device round-trip per victim
+        tgt = int(np.argmin(np.where(np.asarray(fits),
+                                     np.asarray(waste), 2**30)))
+        fr = fr.at[tgt].add(-vr)
+        hd = hd.at[tgt].add(-1)
+        return (fr, hd), tgt
+    _, out = jax.lax.scan(step, (free, headroom), (v_req, v_valid),
+                          length=v_max)
+    return out
+'''
+
+JT002_DEFRAG_GOOD = '''
+import functools
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@functools.partial(jax.jit, static_argnames=("n_slots", "v_max"))
+def defrag_assign(free, headroom, target_ok, v_req, v_valid, n_slots, v_max):
+    def step(carry, xs):
+        fr, hd = carry
+        vr, valid = xs
+        fits = (fr >= vr[None, :]).all(axis=1) & (hd > 0) & target_ok
+        waste = jnp.sum(fr - vr[None, :], axis=1)
+        key = jnp.where(fits, waste, jnp.int32(2**30))
+        tgt = jnp.argmin(key).astype(jnp.int32)
+        place = (key[tgt] < jnp.int32(2**30)) & valid
+        fr = fr.at[tgt].add(-vr * place)
+        hd = hd.at[tgt].add(-place.astype(hd.dtype))
+        return (fr, hd), jnp.where(place, tgt, jnp.int32(-1))
+    _, out = jax.lax.scan(step, (free, headroom), (v_req, v_valid),
+                          length=v_max)
+    return out
+
+def defrag_plan(free, headroom, target_ok, v_req, ns, v):
+    # the shipped discipline: numpy padding happens OUTSIDE the traced body
+    n_slots = 1 << max(0, ns - 1).bit_length()
+    v_max = 1 << max(0, v - 1).bit_length()
+    free_p = np.zeros((n_slots, free.shape[1]), dtype=np.int32)
+    free_p[:ns] = free
+    return defrag_assign(free_p, headroom, target_ok, v_req, v_req,
+                         n_slots=n_slots, v_max=v_max)
+'''
+
+
+def test_jt002_fires_on_host_argmin_inside_defrag_scan():
+    findings = [f for f in analyze_source(JT002_DEFRAG_BAD)
+                if f.rule == "JT002"]
+    assert len(findings) >= 1, findings
+
+
+def test_jt002_quiet_on_host_padding_outside_defrag_kernel():
+    assert "JT002" not in rules_of(analyze_source(JT002_DEFRAG_GOOD))
+
+
 HP001_BAD = '''
 import time
 
