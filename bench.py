@@ -2737,6 +2737,134 @@ def rung_watch_fanout(results):
         print(f"ApiserverWatchFanout_5k: ERROR {e}", file=sys.stderr)
 
 
+def rung_trace_timeline(results):
+    """TraceTimeline (ISSUE 18): the NorthStar smoke window captured with
+    the trace buffer ARMED through TWO partitioned pipelines — the export
+    must validate as Chrome trace-event JSON (B/E balanced, monotonic per
+    tid, the partition pipelines on DISTINCT tracks so ≥2-core overlap is
+    visible, ≥1 evict→replace flow arrow), the critical-path components
+    must sum to the measured submit→bound latency, and the armed overhead
+    is asserted from a MEASUREMENT (the buffer's accumulated tap self-time
+    vs the timed wall, <1% with the 2ms absolute floor) published beside
+    `disabled_check_ns` (tests/test_bench_quick.py)."""
+    from kubernetes_tpu.obs import critpath, tracebuf
+    from kubernetes_tpu.scheduler import Framework
+    from kubernetes_tpu.scheduler.batch import BatchScheduler
+    from kubernetes_tpu.scheduler.plugins import default_plugins
+    from kubernetes_tpu.store import APIStore
+    from kubernetes_tpu.testing import MakePod
+
+    try:
+        n_pods = sz(10_000, floor=1000)
+        n_nodes = sz(500, floor=40)
+        # warm-up on a throwaway cluster: shard-sized jit shapes must
+        # compile before the timed window (the Partitioned rung discipline)
+        _w = _partitioned_e2e(n_pods, n_nodes, 2, "ttw")[0]
+        _w.stop()
+        del _w
+        # the disabled cost: ONE module-attribute check, measured
+        dcn = tracebuf.disabled_check_cost_ns()
+        buf = tracebuf.arm(capacity=200_000)
+        try:
+            sched, store, dt, bound = _partitioned_e2e(
+                n_pods, n_nodes, 2, "tt")
+            # the armed overhead measurement stops HERE: taps after the
+            # timed window (the flow leg below) are not its cost
+            instr_s = buf.self_seconds
+            spans = []
+            table = None
+            for pipe in sched.pipelines:
+                spans.extend(pipe.podtrace.snapshot().get("spans") or [])
+                if table is None:
+                    table = pipe.flightrec.stage_table()
+            sched.stop()
+            # evict→replace leg (separate small cluster, same armed
+            # buffer): bound owner-ref'd pods deleted, then same-owner
+            # replacements — the podtrace link path that export() renders
+            # as Perfetto flow arrows
+            fstore = APIStore()
+            for n in _nodes(8, cpu="16", mem="64Gi"):
+                fstore.create("nodes", n)
+            fsched = BatchScheduler(fstore, Framework(default_plugins()),
+                                    batch_size=1024, solver="fast")
+            fsched.sync()
+            owner = [{"kind": "ReplicaSet", "name": "rs-tt",
+                      "uid": "u-rs-tt"}]
+            firsts = []
+            for i in range(8):
+                p = MakePod(f"ttf-{i}").req({"cpu": "100m"}).obj()
+                p.metadata.owner_references = [dict(r) for r in owner]
+                firsts.append(p)
+            fstore.create_many("pods", firsts, consume=True)
+            fsched.run_until_idle()
+            fsched.flush_binds()
+            for p in firsts[:4]:
+                fstore.delete("pods", p.key)
+            fsched.run_until_idle()
+            reps = []
+            for i in range(4):
+                p = MakePod(f"ttr-{i}").req({"cpu": "100m"}).obj()
+                p.metadata.owner_references = [dict(r) for r in owner]
+                reps.append(p)
+            fstore.create_many("pods", reps, consume=True)
+            fsched.run_until_idle()
+            fsched.flush_binds()
+            flow_spans = fsched.podtrace.snapshot().get("spans") or []
+            spans.extend(flow_spans)
+            fsched.stop()
+            doc = buf.export(spans=spans)
+            val = tracebuf.validate_export(doc)
+            track_names = [ev.get("args", {}).get("name")
+                           for ev in doc["traceEvents"]
+                           if ev["ph"] == "M"
+                           and ev["name"] == "thread_name"]
+            partition_tracks = sum(
+                1 for t in track_names
+                if t and t.startswith("p") and t.endswith("-sched"))
+            cp = critpath.analyze(spans, stage_table=table)
+            overall = cp.get("overall") or {}
+            st = buf.status()
+        finally:
+            tracebuf.disarm()
+        results["TraceTimeline"] = {
+            "wall_s": round(dt, 3),
+            "pods": n_pods, "placed": bound,
+            "pods_per_sec": round(bound / dt, 1) if dt > 0 else 0.0,
+            "export_valid": val["valid"],
+            "export_errors": val["errors"][:3],
+            "events": st["trace_events_total"],
+            "dropped": st["trace_events_dropped_total"],
+            "tracks": val["tracks"],
+            "partition_tracks": partition_tracks,
+            "flow_arrows": val["flow_pairs"],
+            "counters": val["counters"],
+            # the armed budget, measured (never differenced): tap
+            # self-time accumulated during the timed window
+            "instrumentation_s": round(instr_s, 6),
+            "overhead_frac": round(instr_s / dt, 6) if dt > 0 else 0.0,
+            "disabled_check_ns": round(dcn, 2),
+            "critpath": {
+                "spans": cp.get("spans_analyzed", 0),
+                "dominant": overall.get("dominant"),
+                "dominant_share": overall.get("dominant_share"),
+                "sum_p50_ms": overall.get("sum_p50_ms"),
+                "total_p50_ms": overall.get("total_p50_ms"),
+                "sum_p99_ms": overall.get("sum_p99_ms"),
+                "total_p99_ms": overall.get("total_p99_ms"),
+            },
+        }
+        print(f"{'TraceTimeline':>28}: {st['trace_events_total']} events "
+              f"({st['trace_events_dropped_total']} dropped), "
+              f"{partition_tracks} partition tracks, "
+              f"{val['flow_pairs']} flow arrows, "
+              f"overhead {instr_s / dt * 100 if dt > 0 else 0:.3f}% "
+              f"of {dt:.2f}s, dominant={overall.get('dominant')}",
+              file=sys.stderr)
+    except Exception as e:
+        results["TraceTimeline"] = {"error": str(e)[:200]}
+        print(f"TraceTimeline: ERROR {e}", file=sys.stderr)
+
+
 RUNGS = [
     ("SchedulingBasic", rung_basic),
     ("TopologySpreading", rung_topology_spread),
@@ -2762,6 +2890,7 @@ RUNGS = [
     ("ChaosChurn", rung_chaos_churn),
     ("ControlPlane", rung_control_plane),
     ("SchedLint", rung_schedlint),
+    ("TraceTimeline", rung_trace_timeline),
     ("Transport", rung_transport),
     ("ApiserverWatchFanout", rung_watch_fanout),
 ]
@@ -2773,7 +2902,7 @@ RUNGS = [
 QUICK_RUNGS = ("SchedulingBasic", "MixedChurn", "NorthStarEndToEnd",
                "NorthStarSoak", "BindCommit", "SchedStages",
                "GangScheduling", "GangPreemption", "Defrag", "Partitioned",
-               "ChaosChurn", "ControlPlane", "SchedLint")
+               "ChaosChurn", "ControlPlane", "SchedLint", "TraceTimeline")
 QUICK_BUDGET_S = 110.0
 
 

@@ -39,6 +39,13 @@ tick, never per pod. A note_batch/note_stage call is one tap per batch by
 design; anything instrumenting inside a pod-scale loop of these files
 (someone feeding the window per pod "for accuracy") is the same 100k
 multiplier the flight recorder's budget forbids.
+
+Trace timeline (ISSUE 18): obs/tracebuf.py and obs/critpath.py carry the
+same contract — trace-buffer taps (note_batch/note_span/instant/counter)
+are per batch / per chunk / per cycle / per window, NEVER per pod outside
+a sampled-set membership check, and the analyzers iterate the ≤K-sampled
+span set only. A `tracebuf.ACTIVE.instant(...)` inside a pod-scale loop
+would turn the <1% armed budget into a per-pod ring append at 100k scale.
 """
 
 from __future__ import annotations
@@ -52,7 +59,8 @@ from ..index import ProjectIndex
 
 HOT_FILE_SUFFIXES = ("scheduler/batch.py", "scheduler/podtrace.py",
                      "controllers/base.py", "obs/timeseries.py",
-                     "obs/resource.py")
+                     "obs/resource.py", "obs/tracebuf.py",
+                     "obs/critpath.py")
 
 POD_SCALE = re.compile(
     r"^(qps|pods|pending|items|to_bind|bind_rows|bind_nodes|bind_gang|"
@@ -63,10 +71,12 @@ POD_SCALE = re.compile(
 INSTRUMENTATION_CALLS = {"observe", "observe_many", "inc", "set", "mark",
                          "record", "step", "stamp", "add_outside",
                          "note_self_time", "event", "log", "info", "warning",
-                         "debug", "error", "exception"}
+                         "debug", "error", "exception",
+                         # trace-buffer taps (obs/tracebuf.py, ISSUE 18)
+                         "instant", "counter", "note_span", "note_batch"}
 _METRICY = re.compile(r"^(m|metrics|fr|flightrec|clock|trace|recorder|"
                       r"logger|logging|log|sp|span|spans|tracer|podtrace|"
-                      r"pt|latency)$")
+                      r"pt|latency|tracebuf|_tracebuf|tb|buf|ACTIVE)$")
 
 # the membership guard that legalizes per-pod stamping: any name segment of
 # the `in` comparator matching this (self._sampled, sampled, sampled_set)

@@ -64,6 +64,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional
 
+from ..obs import tracebuf as _tracebuf
+
 # The injection-site registry: site name -> where it is wired. Unknown sites
 # in a plan are a hard arm() error — a typo'd site would otherwise silently
 # inject nothing and the chaos test would pass vacuously.
@@ -222,6 +224,12 @@ class Injector:
                     if act == "delay":
                         delay = p.delay_s
                     break
+        # trace timeline (ISSUE 18): an INJECTED action lands as an instant
+        # on the chaos track (per fire decision, outside the injector lock)
+        if action is not None and _tracebuf.ACTIVE is not None:
+            _tracebuf.ACTIVE.instant(
+                "chaos", "fault:%s" % site, cat="chaos",
+                args={"action": action, "key": key or ""})
         if action == "delay" and delay > 0:
             time.sleep(delay)  # outside the injector lock
         elif action == "kill":
@@ -232,11 +240,19 @@ class Injector:
     def should_drop(self, site: str, key: Optional[str] = None) -> bool:
         """The non-blocking form for lock-held sites: True when the armed
         plan says this fire is dropped. Never raises, never sleeps."""
+        hit = False
         with self._lock:
             for p in self._plans.get(site, ()):
                 if p._decide(key) in ("fail", "kill"):
-                    return True
-        return False
+                    hit = True
+                    break
+        if hit and _tracebuf.ACTIVE is not None:
+            # outside the injector lock; the trace ring is a leaf lock so
+            # lock-held caller sites stay LK002-clean
+            _tracebuf.ACTIVE.instant(
+                "chaos", "fault:%s" % site, cat="chaos",
+                args={"action": "drop", "key": key or ""})
+        return hit
 
     def stats(self) -> Dict[str, Dict[str, int]]:
         """{site: {fired, injected}} — what the chaos rung reports."""

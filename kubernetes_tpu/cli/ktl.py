@@ -1319,6 +1319,11 @@ def _render_sched_stats(doc: Dict) -> str:
             f"oldest_age={q.get('oldest_pending_age_s', 0.0):.1f}s   "
             f"recorder: {'on' if rec.get('enabled') else 'off'} "
             f"{rec.get('records', 0)}/{rec.get('capacity', 0)} batches")
+        tb = st.get("tracebuf") or {}
+        out.append(
+            f"trace: {'armed' if tb.get('armed') else 'disarmed'} "
+            f"events={tb.get('trace_events_total', 0)} "
+            f"dropped={tb.get('trace_events_dropped_total', 0)}")
         lat = st.get("latency") or {}
         if lat.get("count"):
             out.append(
@@ -1438,6 +1443,58 @@ def _render_sched_stats(doc: Dict) -> str:
             out.append("no batches recorded yet")
         out.append("")
     return "\n".join(out).rstrip()
+
+
+def _render_sched_why(doc: Dict) -> str:
+    """Critical-path attribution (ISSUE 18): per scheduler, the per-window
+    dominant submit->bound component with its share, the component p50/p99
+    table, and the additivity check (sum of parts vs measured total)."""
+    if not doc:
+        return ("no batch scheduler registered in the server process "
+                "(is the control plane running in-process?)")
+    out = []
+    for name, cp in sorted(doc.items()):
+        if "error" in cp and len(cp) == 1:
+            out.append(f"{name}: error: {cp['error']}")
+            continue
+        overall = cp.get("overall")
+        out.append(
+            f"{name}  spans={cp.get('spans_analyzed', 0)} "
+            f"build_ratio={cp.get('build_ratio', 0.0)}")
+        if not overall:
+            out.append("  no bound sampled spans yet")
+            continue
+        rows = [("window", "n", "dominant", "share", "sum_p50", "total_p50",
+                 "sum_p99", "total_p99")]
+        for w, roll in sorted((cp.get("windows") or {}).items(),
+                              key=lambda kv: int(kv[0])):
+            share = roll.get("dominant_share")
+            rows.append((str(w), str(roll.get("count", 0)),
+                         str(roll.get("dominant")),
+                         f"{share:.0%}" if share is not None else "-",
+                         f"{roll.get('sum_p50_ms', 0)}ms",
+                         f"{roll.get('total_p50_ms', 0)}ms",
+                         f"{roll.get('sum_p99_ms', 0)}ms",
+                         f"{roll.get('total_p99_ms', 0)}ms"))
+        widths = [max(len(r[i]) for r in rows) for i in range(len(rows[0]))]
+        for r in rows:
+            out.append("  " + "  ".join(
+                c.ljust(widths[i]) for i, c in enumerate(r)).rstrip())
+        share = overall.get("dominant_share")
+        out.append(
+            f"overall: dominant={overall.get('dominant')} "
+            + (f"({share:.0%} of submit->bound) " if share is not None
+               else "")
+            + f"p50 {overall.get('total_p50_ms', 0)}ms "
+            f"p99 {overall.get('total_p99_ms', 0)}ms")
+        comps = overall.get("components") or {}
+        for comp, row in comps.items():
+            out.append(
+                f"  {comp:<10} p50={row.get('p50_ms', 0)}ms "
+                f"p99={row.get('p99_ms', 0)}ms "
+                f"mean={row.get('mean_ms', 0)}ms"
+                + ("  (post-bound, not in sum)" if comp == "watch" else ""))
+    return "\n".join(out)
 
 
 def _render_sched_trace(doc: Dict) -> str:
@@ -1592,13 +1649,23 @@ def cmd_sched(client: RESTClient, args) -> int:
     sibling of `kubectl get --raw /debug/...`)."""
     import time as _time
 
-    if args.action not in ("stats", "trace", "slo", "top"):
+    if args.action not in ("stats", "trace", "slo", "top", "why"):
         raise CLIError(f"unknown sched action {args.action!r}")
     spec = None
     if args.action == "slo":
         from ..scheduler.slo import DEFAULT_SLO, load_slo_spec
 
         spec = load_slo_spec(args.spec) if args.spec else DEFAULT_SLO
+    if args.action == "trace" and getattr(args, "export", None):
+        # unified trace timeline (ISSUE 18): dump the Perfetto-loadable
+        # Chrome trace-event JSON; one shot, no watch loop
+        doc = client.request("GET", "/debug/trace")
+        n = len(doc.get("traceEvents") or [])
+        with open(args.export, "w") as f:
+            json.dump(doc, f)
+        print(f"wrote {n} trace events to {args.export} "
+              "(open in https://ui.perfetto.dev)")
+        return 0 if n else 1
     # -w/--watch applies to every action (the parser registers it for all
     # three); non-watch mode returns after one fetch with the action's exit
     # code (slo: 1 on any FAIL)
@@ -1617,6 +1684,13 @@ def cmd_sched(client: RESTClient, args) -> int:
             doc = client.request("GET", "/debug/timeseries")
             rendered = (json.dumps(doc, indent=2) if args.output == "json"
                         else _render_sched_top(doc))
+            rc = 0
+        elif args.action == "why":
+            # critical-path attribution (ISSUE 18): which component owns
+            # the sampled submit->bound latency, per window
+            doc = client.request("GET", "/debug/critpath")
+            rendered = (json.dumps(doc, indent=2) if args.output == "json"
+                        else _render_sched_why(doc))
             rc = 0
         elif args.action == "slo":
             from ..scheduler.slo import evaluate_slo
@@ -1991,7 +2065,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.set_defaults(fn=cmd_top)
 
     p = sub.add_parser("sched")
-    p.add_argument("action", choices=["stats", "trace", "slo", "top"])
+    p.add_argument("action", choices=["stats", "trace", "slo", "top", "why"])
     p.add_argument("-o", "--output", default="table",
                    choices=["table", "json"])
     p.add_argument("-w", "--watch", action="store_true")
@@ -1999,6 +2073,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--spec", default=None,
                    help="SLO spec JSON file (sched slo; default: the "
                         "built-in north-star spec)")
+    p.add_argument("--export", default=None, metavar="FILE",
+                   help="sched trace: write the Chrome trace-event JSON "
+                        "from /debug/trace to FILE (open in "
+                        "https://ui.perfetto.dev or chrome://tracing)")
     p.set_defaults(fn=cmd_sched)
 
     p = sub.add_parser("controller")

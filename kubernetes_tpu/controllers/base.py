@@ -22,6 +22,7 @@ import time
 import traceback
 from typing import Dict, Optional
 
+from ..obs import tracebuf as _tracebuf
 from ..obs.reconcile import ReconcileRecorder, register_controller
 from ..store import APIStore
 from ..utils import Clock
@@ -126,9 +127,15 @@ class Controller:
                 traceback.print_exc()
                 self._mark(key, now)  # retry (rate limiting elided)
         errs = self.sync_errors - errors0
+        t1 = time.perf_counter()
         self.recorder.loop(keys=len(keys), errors=errs, requeues=errs,
-                           seconds=time.perf_counter() - t0,
-                           depth=len(self._dirty))
+                           seconds=t1 - t0, depth=len(self._dirty))
+        # trace timeline (ISSUE 18): one slice per reconcile DRAIN (never
+        # per key) on this controller's track
+        if _tracebuf.ACTIVE is not None:
+            _tracebuf.ACTIVE.note_span(
+                "ctl-%s" % type(self).__name__, "reconcile", t0, t1,
+                cat="reconcile", args={"keys": len(keys), "errors": errs})
         return len(keys)
 
     def reconcile_once(self) -> int:

@@ -45,6 +45,8 @@ import weakref
 from collections import deque
 from typing import Dict, List, Optional
 
+from . import tracebuf as _tracebuf
+
 DEFAULT_INTERVAL_S = 1.0
 DEFAULT_CAPACITY = 600  # 10 min of 1s samples
 
@@ -268,6 +270,17 @@ class ResourceSampler:
             }
             self._ring.append(rec)
             self.samples_taken += 1
+        # trace timeline (ISSUE 18): one counter event per sample TICK —
+        # the RSS / GC-pause / alloc tracks under the scheduling slices
+        if _tracebuf.ACTIVE is not None:
+            _tracebuf.ACTIVE.counter(
+                "resource", "memory", {
+                    "rss_mb": rec["rss_mb"],
+                    "alloc_blocks": rec["alloc_blocks"]}, t=t0)
+            _tracebuf.ACTIVE.counter(
+                "resource", "gc", {
+                    "pause_ms": rec["gc"]["pause_s"] * 1000.0,
+                    "collections": rec["gc"]["collections"]}, t=t0)
         self.self_seconds += time.perf_counter() - t0
         return rec
 

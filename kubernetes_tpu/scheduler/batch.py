@@ -23,6 +23,7 @@ import numpy as np
 
 from ..chaos import faultinject as _chaos
 from ..chaos.faultinject import FaultKill
+from ..obs import tracebuf as _tracebuf
 from ..obs.timeseries import TimeSeriesRecorder
 from ..snapshot.tensorizer import TensorCache, build_cluster_tensors, build_pod_batch
 from ..store import (MODIFIED, APIStore, NotFoundError, is_bind_conflict,
@@ -318,6 +319,21 @@ class BatchScheduler(Scheduler):
                 scheduled=out.get("dispatched", 0)
                 + out.get("serial_scheduled", 0),
                 failed=self.failed_count - failed0)
+            # unified trace timeline (ISSUE 18): ONE tap per batch when a
+            # buffer is armed — the batch envelope + stage slices land on
+            # this pipeline's track (tid = p<i>-sched), inside the same
+            # self-time window so the cost bills to the <2% budget
+            if _tracebuf.ACTIVE is not None:
+                tb = _tracebuf.ACTIVE
+                tb.attach_clock(self.clock)
+                tb.note_batch(
+                    self._thread_label("sched"), t_end=t_fin,
+                    stages=clock.stages, pods=len(qps),
+                    scheduled=out.get("dispatched", 0)
+                    + out.get("serial_scheduled", 0),
+                    outcome=outcome,
+                    solver=out.get("solver", self.solver),
+                    breaker=self.breaker.state)
             trace.log_if_long(self.trace_threshold)
             self._update_queue_telemetry()
             fr.note_self_time(time.perf_counter() - t_fin)
@@ -931,6 +947,16 @@ class BatchScheduler(Scheduler):
             if preempt_ctx is not None and gid in preempt_gids:
                 got = self.gangpreempt.try_preempt(key, gid, members,
                                                    preempt_ctx)
+                # trace timeline (ISSUE 18): one instant per preemption
+                # ATTEMPT (per gang, never per member)
+                if _tracebuf.ACTIVE is not None:
+                    fired = got is not None and not got.get("vetoed")
+                    _tracebuf.ACTIVE.instant(
+                        self._thread_label("sched"),
+                        "gang_preempt:%s" % ("fired" if fired else "vetoed"),
+                        cat="gang",
+                        args={"gang": key,
+                              "victims": (got or {}).get("victims", 0)})
                 if got is not None and not got.get("vetoed"):
                     # cover fired: the gang is PARKED awaiting victim
                     # termination — not a scheduling failure
@@ -1495,6 +1521,9 @@ class BatchScheduler(Scheduler):
             "recorder": {"enabled": fr.enabled, "capacity": fr.capacity,
                          "records": len(fr),
                          "self_seconds": round(fr.self_seconds, 6)},
+            # trace timeline (ISSUE 18): arm/drop counters so a full ring
+            # is observable from /debug/schedstats and `ktl sched stats`
+            "tracebuf": _tracebuf.status(),
             "stages": fr.stage_table(),
             # steady-state telemetry (ISSUE 13): the recent closed windows
             # (the live feed of `ktl sched top` and the windowed SLO keys)
@@ -1804,6 +1833,13 @@ class BatchScheduler(Scheduler):
             from ..server import metrics as m
 
             m.batch_stage_duration.observe(t1 - t0, "bind")
+            # trace timeline (ISSUE 18): one slice per bind sub-batch on
+            # the bind worker's track — overlap with the next solve is
+            # visible as concurrent slices on p<i>-sched vs p<i>-bind
+            if _tracebuf.ACTIVE is not None:
+                _tracebuf.ACTIVE.note_span(
+                    self._thread_label("bind"), "bind_chunk", t0, t1,
+                    cat="bind", args={"pods": len(items)})
             self.flightrec.note_self_time(time.perf_counter() - t1)
 
     def _bind_batch_inner(self, items) -> None:

@@ -200,7 +200,67 @@ def timeseries_snapshot() -> Dict[str, Dict]:
 def schedtrace_snapshot() -> Dict[str, Dict]:
     """{scheduler name: podtrace snapshot} over every live registered
     scheduler — the sampled pod lifecycle spans GET /debug/schedtrace and
-    `ktl sched trace` serve (scheduler/podtrace.py)."""
+    `ktl sched trace` serve (scheduler/podtrace.py). Each snapshot carries
+    the trace-buffer arm/drop counters (`tracebuf`) so a full trace ring is
+    observable without exporting it (ISSUE 18)."""
+    from ..obs import tracebuf
+
+    with _registry_lock:
+        live = dict(_schedulers)
+    tb = tracebuf.status()
+    out = {}
+    for name, sched in live.items():
+        tracer = getattr(sched, "podtrace", None)
+        if tracer is None:
+            continue
+        try:
+            out[name] = dict(tracer.snapshot(), tracebuf=tb)
+        except Exception as e:  # same wedge-tolerance as schedstats
+            out[name] = {"error": str(e)}
+    return out
+
+
+def _all_spans() -> List[Dict]:
+    """Sampled spans pooled across every live registered scheduler (the
+    partitioned scheduler registers one tracer per pipeline)."""
+    with _registry_lock:
+        live = dict(_schedulers)
+    spans: List[Dict] = []
+    for _name, sched in live.items():
+        tracer = getattr(sched, "podtrace", None)
+        if tracer is None:
+            continue
+        try:
+            spans.extend(tracer.snapshot().get("spans") or [])
+        except Exception:
+            continue
+    return spans
+
+
+def trace_export() -> Dict:
+    """The armed (or last-disarmed) trace buffer as Chrome trace-event JSON
+    plus podtrace-derived evict→replace flow arrows — what GET /debug/trace
+    and `ktl sched trace --export` serve (obs/tracebuf.py, ISSUE 18)."""
+    from ..obs import tracebuf
+
+    buf = tracebuf.current()
+    if buf is None:
+        return {"traceEvents": [], "displayTimeUnit": "ms",
+                "error": "trace buffer never armed"}
+    try:
+        return buf.export(spans=_all_spans())
+    except Exception as e:  # same wedge-tolerance as schedstats
+        return {"traceEvents": [], "displayTimeUnit": "ms", "error": str(e)}
+
+
+def critpath_snapshot() -> Dict[str, Dict]:
+    """{scheduler name: critical-path analysis} over every live registered
+    scheduler: podtrace spans decomposed into additive submit→bound
+    components with the flight recorder's stage table supplying the
+    build/solve split — what GET /debug/critpath and `ktl sched why` serve
+    (obs/critpath.py, ISSUE 18)."""
+    from ..obs import critpath
+
     with _registry_lock:
         live = dict(_schedulers)
     out = {}
@@ -209,7 +269,10 @@ def schedtrace_snapshot() -> Dict[str, Dict]:
         if tracer is None:
             continue
         try:
-            out[name] = tracer.snapshot()
+            fr = getattr(sched, "flightrec", None)
+            table = fr.stage_table() if fr is not None else None
+            out[name] = critpath.analyze(
+                tracer.snapshot().get("spans") or [], stage_table=table)
         except Exception as e:  # same wedge-tolerance as schedstats
             out[name] = {"error": str(e)}
     return out

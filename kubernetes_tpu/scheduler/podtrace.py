@@ -154,8 +154,11 @@ class PodSpan:
                 if ts is not None:
                     offsets[stage] = round((ts - t0) * 1000, 3)
         total = offsets.get("bind_confirmed")
+        # t0 = absolute enqueue stamp (scheduler clock): the anchor the
+        # trace exporter (obs/tracebuf.py) uses to place span-derived flow
+        # arrows on the perf_counter timeline
         out = {"pod": self.key, "window": self.window, "pops": self.pops,
-               "complete": self.complete, "stamps_ms": offsets,
+               "complete": self.complete, "t0": t0, "stamps_ms": offsets,
                "submit_to_bound_ms": total,
                "submit_to_running_ms": offsets.get("running")}
         if self.replaces is not None:

@@ -56,6 +56,7 @@ import numpy as np
 from ..api.resources import compute_pod_resource_request
 from ..api.types import new_uid
 from ..chaos import faultinject
+from ..obs import tracebuf as _tracebuf
 from ..chaos.faultinject import FaultInjected
 from ..models.defrag import (DEFRAG_MAX_VICTIMS, defrag_plan,
                              slice_fragmentation)
@@ -188,9 +189,20 @@ class Rebalancer:
             if self._cycle_active:
                 return {"ran": False, "reason": "busy"}
             self._cycle_active = True
+        res = None
+        t0 = time.perf_counter()
         try:
-            return self._cycle_inner()
+            res = self._cycle_inner()
+            return res
         finally:
+            t1 = time.perf_counter()
+            # trace timeline (ISSUE 18): one slice per cycle (the
+            # steady-state no-op included — its near-zero width IS the
+            # "rebalance costs nothing when defragmented" evidence)
+            if _tracebuf.ACTIVE is not None:
+                _tracebuf.ACTIVE.note_span(
+                    "rebalance", "cycle", t0, t1, cat="rebalance",
+                    args=dict(res) if isinstance(res, dict) else None)
             with self._lock:
                 self._cycle_active = False
 
@@ -375,6 +387,11 @@ class Rebalancer:
         waves = 0
         for wi in range(0, len(migs), self.budget_per_wave):
             wave = migs[wi:wi + self.budget_per_wave]
+            # trace timeline (ISSUE 18): one instant per wave boundary
+            if _tracebuf.ACTIVE is not None:
+                _tracebuf.ACTIVE.instant(
+                    "rebalance", "wave-%d" % (wi // self.budget_per_wave),
+                    cat="rebalance", args={"migrations": len(wave)})
             try:
                 if faultinject.ACTIVE is not None:
                     faultinject.ACTIVE.fire(

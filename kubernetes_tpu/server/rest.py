@@ -393,7 +393,8 @@ class _Handler(BaseHTTPRequestHandler):
                  "PATCH": "patch", "DELETE": "delete"}
     _FC_EXEMPT_PATHS = ("/healthz", "/readyz", "/metrics", "/version",
                         "/configz", "/debug/schedstats", "/debug/schedtrace",
-                        "/debug/controlstats", "/debug/timeseries")
+                        "/debug/controlstats", "/debug/timeseries",
+                        "/debug/trace", "/debug/critpath")
 
     def _flow_dispatch(self, orig: "Callable[[], None]") -> None:
         """Seat-accounted dispatch. Health/metrics always pass (the probe
@@ -664,6 +665,33 @@ class _Handler(BaseHTTPRequestHandler):
             from ..scheduler.flightrec import schedtrace_snapshot
 
             body = json.dumps(schedtrace_snapshot(), default=str).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if url.path == "/debug/trace":
+            # unified trace timeline (ISSUE 18): the armed (or last)
+            # trace buffer as Chrome trace-event JSON with podtrace flow
+            # arrows — save the body and open it in https://ui.perfetto.dev
+            # or chrome://tracing. Same read-only debug family.
+            from ..scheduler.flightrec import trace_export
+
+            body = json.dumps(trace_export(), default=str).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if url.path == "/debug/critpath":
+            # critical-path attribution (ISSUE 18): sampled submit→bound
+            # latency decomposed into additive components per window — what
+            # `ktl sched why` renders. Same read-only debug family.
+            from ..scheduler.flightrec import critpath_snapshot
+
+            body = json.dumps(critpath_snapshot(), default=str).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))

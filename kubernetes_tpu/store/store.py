@@ -122,6 +122,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..api.types import Pod
 from ..chaos import faultinject as _chaos
+from ..obs import tracebuf as _tracebuf
 from . import columnar as _columnar
 
 ADDED = "ADDED"
@@ -1457,6 +1458,14 @@ class APIStore:
         dt = time.perf_counter() - t0
         with self._prop_lock:
             self._prop_settle_s += dt
+        # trace timeline (ISSUE 18): one slice per settlement PASS (a pass
+        # drains every pending dequeue op — never per event)
+        if _tracebuf.ACTIVE is not None:
+            settled = sum(len(v) for v in by_kind.values()) \
+                + sum(n for _k, _v, n in bulk)
+            _tracebuf.ACTIVE.note_span(
+                "watch", "settle", t0, t0 + dt, cat="watch",
+                args={"events": settled, "inline": inline})
         if inline:
             sink = w.stat_sink
             if sink is not None:

@@ -62,6 +62,21 @@ class Trace:
                          total_ms=round(total * 1000, 2),
                          threshold_ms=round(threshold * 1000, 2),
                          steps=steps, **self.fields)
+        # unified trace timeline (ISSUE 18): a slow trace's steps also land
+        # on the armed trace buffer, so serial-path spikes show on the same
+        # Perfetto timeline as the batch slices. Slow path only (we already
+        # crossed the logging threshold), lazy import (no obs dependency on
+        # the fast path), and perf_counter-domain traces only — a custom
+        # clock has no place on the buffer's axis.
+        from ..obs import tracebuf
+
+        if tracebuf.ACTIVE is not None and self.clock is None:
+            at = self.start
+            for s in self.steps:
+                tracebuf.ACTIVE.note_span(
+                    "slowtrace", f"{self.name}:{s.msg}", at, s.at,
+                    cat="slowtrace", args=dict(s.fields) or None)
+                at = s.at
         return True
 
     def __enter__(self) -> "Trace":

@@ -1177,6 +1177,48 @@ def schedule_batch(self, qps, m):
 
 
 # ---------------------------------------------------------------------------
+# ISSUE 18: the trace timeline (obs/tracebuf.py + obs/critpath.py) is an
+# HP001 hot path — taps are per batch / per chunk / per cycle / per window,
+# never per pod outside a sampled-set check
+# ---------------------------------------------------------------------------
+
+HP001_TRACEBUF_BAD = '''
+def feed(self, qps, tracebuf):
+    for qp in qps:
+        tracebuf.ACTIVE.instant("sched", "pod", args={"key": qp.key})
+'''
+
+HP001_TRACEBUF_GOOD = '''
+def feed(self, qps, clock, t_fin, tracebuf):
+    if tracebuf.ACTIVE is not None:
+        tb = tracebuf.ACTIVE
+        tb.note_batch("sched", t_end=t_fin, stages=clock.stages,
+                      pods=len(qps), scheduled=len(qps),
+                      outcome="scheduled", solver="fast")
+    for qp in qps:
+        if qp.key in self._sampled:
+            tracebuf.ACTIVE.instant("sched", "sampled-pod")
+'''
+
+
+@pytest.mark.parametrize("hot", ["kubernetes_tpu/obs/tracebuf.py",
+                                 "kubernetes_tpu/obs/critpath.py",
+                                 "kubernetes_tpu/scheduler/batch.py"])
+def test_hp001_fires_on_per_pod_trace_tap(hot):
+    findings = [f for f in analyze_source(HP001_TRACEBUF_BAD, filename=hot)
+                if f.rule == "HP001"]
+    assert len(findings) == 1, findings
+
+
+def test_hp001_quiet_on_per_batch_trace_tap_and_sampled_guard():
+    assert "HP001" not in rules_of(analyze_source(
+        HP001_TRACEBUF_GOOD, filename="kubernetes_tpu/obs/tracebuf.py"))
+    # the identical per-pod tap OUTSIDE the hot files stays out of scope
+    assert "HP001" not in rules_of(analyze_source(
+        HP001_TRACEBUF_BAD, filename="kubernetes_tpu/cli/ktl.py"))
+
+
+# ---------------------------------------------------------------------------
 # suppressions
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,412 @@
+"""Unified trace timeline (ISSUE 18): trace-event ring bounds and export
+integrity (B/E balanced per track, monotonic ts per tid, batch stage slices
+matching the flight record, Perfetto-format required keys), armed/disarmed
+placement byte-parity in BOTH watch_coalesce modes with the mutation
+detector forced, critical-path component additivity (parts sum to the
+span's measured submit→bound latency), evict→replace flow arrows, the
+/debug/trace + /debug/critpath endpoints, the schedtrace tracebuf counters,
+and `ktl sched why` / `ktl sched trace --export` / the stats trace line."""
+
+import io
+import json
+import time
+import urllib.request
+from contextlib import redirect_stdout
+
+import pytest
+
+from kubernetes_tpu.obs import critpath, tracebuf
+from kubernetes_tpu.obs.tracebuf import TraceBuffer, validate_export
+from kubernetes_tpu.scheduler import Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.flightrec import (
+    critpath_snapshot,
+    schedtrace_snapshot,
+    trace_export,
+)
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.testing import (
+    MakeNode,
+    MakePod,
+    mutation_detector_guard,
+)
+from kubernetes_tpu.utils.tracing import Trace
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    yield from mutation_detector_guard(monkeypatch)
+
+
+@pytest.fixture(autouse=True)
+def _trace_isolation():
+    """Every test starts and ends with no armed or lingering buffer —
+    a leaked ACTIVE would tap every other module's schedulers."""
+    tracebuf.disarm()
+    tracebuf.LAST = None
+    yield
+    tracebuf.disarm()
+    tracebuf.LAST = None
+
+
+def _nodes(n, cpu="16", mem="64Gi"):
+    return [MakeNode(f"node-{i}").capacity(
+        {"cpu": cpu, "memory": mem, "pods": "110"}).obj() for i in range(n)]
+
+
+def _sched(store, **kw):
+    sched = BatchScheduler(store, Framework(default_plugins()),
+                           batch_size=1024, solver="fast", **kw)
+    sched.sync()
+    return sched
+
+
+def _placements(store):
+    return sorted((p.key, p.spec.node_name, p.metadata.resource_version)
+                  for p in store.list("pods")[0] if p.spec.node_name)
+
+
+# -- ring + event unit surface --------------------------------------------------
+
+
+class TestRing:
+    def test_ring_bounded_under_3x_capacity_churn(self):
+        buf = TraceBuffer(capacity=100)
+        for i in range(300):
+            buf.instant("churn", f"e{i}")
+        st = buf.status()
+        assert st["trace_events_total"] == 300
+        assert st["trace_events_dropped_total"] == 200
+        doc = buf.export()
+        body = [ev for ev in doc["traceEvents"] if ev["ph"] != "M"]
+        assert len(body) == 100
+        # the ring keeps the most RECENT window
+        assert body[-1]["name"] == "e299"
+        assert validate_export(doc)["valid"]
+
+    def test_arm_disarm_and_status(self):
+        assert not tracebuf.enabled()
+        assert tracebuf.status()["armed"] is False
+        buf = tracebuf.arm(capacity=16)
+        assert tracebuf.enabled() and tracebuf.ACTIVE is buf
+        buf.instant("t", "x")
+        assert tracebuf.status()["trace_events_total"] == 1
+        got = tracebuf.disarm()
+        assert got is buf and not tracebuf.enabled()
+        # the finished capture stays readable (LAST serves /debug/trace)
+        assert tracebuf.current() is buf
+        assert tracebuf.status()["armed"] is False
+        assert tracebuf.status()["trace_events_total"] == 1
+
+    def test_disabled_check_is_one_attribute_load(self):
+        ns = tracebuf.disabled_check_cost_ns(n=20_000, passes=3)
+        assert 0.0 < ns < 10_000  # nanoseconds per check, not micro
+
+    def test_batch_slices_sum_to_stage_seconds(self):
+        buf = TraceBuffer(capacity=1000)
+        stages = {"ingest": 0.001, "solve": 0.040, "assume": 0.002,
+                  "dispatch": 0.0005}
+        t_end = time.perf_counter()
+        buf.note_batch("sched", t_end=t_end, stages=stages, pods=50,
+                       scheduled=50, outcome="scheduled", solver="fast")
+        doc = buf.export()
+        slices = [ev for ev in doc["traceEvents"]
+                  if ev["ph"] == "X" and ev["cat"] == "stage"]
+        assert {ev["name"] for ev in slices} == set(stages)
+        total_us = sum(ev["dur"] for ev in slices)
+        assert total_us == pytest.approx(sum(stages.values()) * 1e6,
+                                         rel=1e-6)
+        # the B/E envelope spans exactly the stage total
+        b = next(ev for ev in doc["traceEvents"] if ev["ph"] == "B")
+        e = next(ev for ev in doc["traceEvents"] if ev["ph"] == "E")
+        assert e["ts"] - b["ts"] == pytest.approx(total_us, rel=1e-6)
+        assert b["args"]["pods"] == 50
+
+    def test_breaker_transition_emits_instant_once(self):
+        buf = TraceBuffer(capacity=100)
+        t = time.perf_counter()
+        for i, state in enumerate((None, "open", "open", None)):
+            buf.note_batch("sched", t_end=t + i, stages={"solve": 0.01},
+                           pods=1, scheduled=1, outcome="scheduled",
+                           solver="fast", breaker=state)
+        names = [ev["name"] for ev in buf.export()["traceEvents"]
+                 if ev["ph"] == "i"]
+        assert names == ["breaker:closed->open", "breaker:open->closed"]
+
+    def test_validate_catches_unbalanced_and_ts_regression(self):
+        bad = {"traceEvents": [
+            {"name": "b", "ph": "B", "ts": 2.0, "pid": 1, "tid": 1},
+            {"name": "i", "ph": "i", "ts": 1.0, "pid": 1, "tid": 1},
+        ]}
+        res = validate_export(bad)
+        assert not res["valid"]
+        assert any("unbalanced" in e for e in res["errors"])
+        assert any("regressed" in e for e in res["errors"])
+        assert not validate_export({"traceEvents": [{"ph": "X"}]})["valid"]
+        assert not validate_export({})["valid"]
+
+    def test_every_event_carries_required_keys(self):
+        buf = TraceBuffer(capacity=100)
+        buf.note_batch("sched", t_end=time.perf_counter(),
+                       stages={"solve": 0.01}, pods=1, scheduled=1,
+                       outcome="scheduled", solver="fast")
+        buf.instant("chaos", "fault:x")
+        buf.counter("resource", "memory", {"rss_mb": 10.0})
+        buf.note_span("bind", "bind_chunk", 0.0, 0.001, cat="bind")
+        for ev in buf.export()["traceEvents"]:
+            for field in ("name", "ph", "ts", "pid", "tid"):
+                assert field in ev, ev
+
+
+# -- critical-path decomposition ------------------------------------------------
+
+
+def _span(window=0, **stamps_ms):
+    total = stamps_ms.get("bind_confirmed")
+    return {"pod": f"ns/p-{id(stamps_ms) % 97}", "window": window,
+            "pops": 1, "complete": True, "t0": 100.0,
+            "stamps_ms": dict(stamps_ms, enqueue=0.0),
+            "submit_to_bound_ms": total, "submit_to_running_ms": None}
+
+
+class TestCritPath:
+    def test_components_sum_exactly_to_submit_to_bound(self):
+        table = {"tensorize": {"total_ms": 10.0},
+                 "build_pod_batch": {"total_ms": 30.0},
+                 "solve": {"total_ms": 60.0}}
+        ratio = critpath.build_ratio(table)
+        assert ratio == pytest.approx(0.4)
+        sp = _span(pop=2.0, solve=12.0, assume=13.0, dispatch=13.5,
+                   bind_confirmed=16.0, watch_delivered=18.0)
+        comps = critpath.decompose(sp, ratio)
+        core = {k: v for k, v in comps.items() if k != "watch"}
+        assert sum(core.values()) == pytest.approx(16.0, abs=1e-9)
+        assert comps["build"] == pytest.approx((12.0 - 2.0) * 0.4)
+        assert comps["watch"] == pytest.approx(2.0)
+
+    def test_missing_stamps_fold_into_next_edge(self):
+        # no assume/dispatch stamps: bind absorbs the whole tail, the sum
+        # property survives
+        sp = _span(pop=1.0, solve=5.0, bind_confirmed=9.0)
+        comps = critpath.decompose(sp, 0.0)
+        assert "assume" not in comps and "dispatch" not in comps
+        core = {k: v for k, v in comps.items() if k != "watch"}
+        assert sum(core.values()) == pytest.approx(9.0)
+
+    def test_unbound_span_skipped(self):
+        assert critpath.decompose({"stamps_ms": {"enqueue": 0.0},
+                                   "submit_to_bound_ms": None}, 0.0) is None
+
+    def test_analyze_groups_by_window_and_names_dominant(self):
+        spans = [_span(window=0, pop=50.0, solve=55.0, bind_confirmed=60.0)
+                 for _ in range(10)]
+        spans += [_span(window=1, pop=1.0, solve=40.0, bind_confirmed=42.0)
+                  for _ in range(10)]
+        out = critpath.analyze(spans)
+        assert out["spans_analyzed"] == 20
+        assert out["windows"][0]["dominant"] == "queue_wait"
+        assert out["windows"][1]["dominant"] == "solve"
+        for roll in out["windows"].values():
+            # mean additivity is exact; p50 within the 10% acceptance band
+            assert roll["sum_mean_ms"] == pytest.approx(
+                roll["total_mean_ms"], rel=1e-9)
+            assert roll["sum_p50_ms"] == pytest.approx(
+                roll["total_p50_ms"], rel=0.10)
+        share = out["overall"]["dominant_share"]
+        assert share is not None and 0.0 < share <= 1.0
+
+
+# -- armed/disarmed placement parity (both watch_coalesce modes) ----------------
+
+
+@pytest.mark.parametrize("coalesce", [True, False])
+def test_armed_disarmed_placement_byte_parity(coalesce):
+    """Arming the trace buffer must never steer scheduling: placements,
+    resource versions and store dumps are byte-identical with the buffer
+    armed vs disarmed, in BOTH watch_coalesce modes, with the mutation
+    detector forced (autouse)."""
+    def run(armed):
+        buf = None
+        if armed:
+            buf = tracebuf.arm(capacity=50_000)
+        else:
+            tracebuf.disarm()
+            tracebuf.LAST = None
+        try:
+            store = APIStore()
+            for n in _nodes(8):
+                store.create("nodes", n)
+            sched = _sched(store, columnar=coalesce)
+            sched.watch_coalesce = coalesce
+            store.create_many("pods", [
+                MakePod(f"p-{i}").req({"cpu": "200m", "memory": "256Mi"})
+                .obj() for i in range(128)], consume=True)
+            sched.run_until_idle()
+            sched.flush_binds()
+            store.check_mutations()
+            return _placements(store), sched.scheduled_count, buf
+        finally:
+            tracebuf.disarm()
+    on_placed, on_count, buf = run(True)
+    off_placed, off_count, _none = run(False)
+    assert on_count == off_count == 128
+    assert on_placed == off_placed
+    # the armed leg actually captured the window
+    assert buf is not None and buf.events_total > 0
+    res = validate_export(buf.export())
+    assert res["valid"], res["errors"]
+
+
+# -- end-to-end: capture, critpath, flows, endpoints, CLI -----------------------
+
+
+def _e2e_capture(n_pods=96):
+    tracebuf.arm(capacity=50_000)
+    store = APIStore()
+    for n in _nodes(6):
+        store.create("nodes", n)
+    sched = _sched(store)
+    store.create_many("pods", [
+        MakePod(f"p-{i}").req({"cpu": "100m"}).obj()
+        for i in range(n_pods)], consume=True)
+    sched.run_until_idle()
+    sched.flush_binds()
+    return store, sched
+
+
+def test_e2e_stage_slices_match_flight_record():
+    _store, sched = _e2e_capture()
+    doc = tracebuf.ACTIVE.export()
+    slice_ms = sum(ev["dur"] for ev in doc["traceEvents"]
+                   if ev["ph"] == "X" and ev.get("cat") == "stage") / 1000.0
+    rec_ms = sum(sum(r["stages"].values()) for r in sched.flightrec.records())
+    assert rec_ms > 0
+    # same source dict (clock.stages), so only ms-rounding separates them
+    assert slice_ms == pytest.approx(rec_ms, rel=0.02, abs=0.5)
+    res = validate_export(doc)
+    assert res["valid"], res["errors"]
+
+
+def test_e2e_critpath_sums_within_tolerance():
+    _store, sched = _e2e_capture()
+    spans = [sp for sp in sched.podtrace.snapshot()["spans"]
+             if sp.get("submit_to_bound_ms") is not None]
+    assert spans
+    ratio = critpath.build_ratio(sched.flightrec.stage_table())
+    for sp in spans:
+        comps = critpath.decompose(sp, ratio)
+        core = sum(v for k, v in comps.items() if k != "watch")
+        assert core == pytest.approx(sp["submit_to_bound_ms"], abs=0.01)
+    out = critpath.analyze(spans, stage_table=sched.flightrec.stage_table())
+    overall = out["overall"]
+    assert overall["dominant"] in critpath.COMPONENTS
+    assert overall["sum_mean_ms"] == pytest.approx(
+        overall["total_mean_ms"], rel=1e-6, abs=0.02)
+    # the acceptance band: quantile sums within 10%
+    assert overall["sum_p50_ms"] == pytest.approx(
+        overall["total_p50_ms"], rel=0.10, abs=0.5)
+    assert overall["sum_p99_ms"] == pytest.approx(
+        overall["total_p99_ms"], rel=0.10, abs=0.5)
+
+
+def test_e2e_evict_replace_flow_arrows():
+    tracebuf.arm(capacity=50_000)
+    store = APIStore()
+    for n in _nodes(6):
+        store.create("nodes", n)
+    sched = _sched(store)
+    owner = [{"kind": "ReplicaSet", "name": "rs-flow", "uid": "u-rs-flow"}]
+    first = []
+    for i in range(8):
+        p = MakePod(f"flow-{i}").req({"cpu": "100m"}).obj()
+        p.metadata.owner_references = [dict(r) for r in owner]
+        first.append(p)
+    store.create_many("pods", first, consume=True)
+    sched.run_until_idle()
+    sched.flush_binds()
+    for p in first[:4]:
+        store.delete("pods", p.key)
+    sched.run_until_idle()  # DELETED events -> podtrace.note_deleted
+    reps = []
+    for i in range(4):
+        p = MakePod(f"flow-rep-{i}").req({"cpu": "100m"}).obj()
+        p.metadata.owner_references = [dict(r) for r in owner]
+        reps.append(p)
+    store.create_many("pods", reps, consume=True)
+    sched.run_until_idle()
+    sched.flush_binds()
+    spans = sched.podtrace.snapshot()["spans"]
+    assert any(sp.get("replaces") for sp in spans)
+    doc = tracebuf.ACTIVE.export(spans=spans)
+    res = validate_export(doc)
+    assert res["valid"], res["errors"]
+    assert res["flow_pairs"] >= 1
+    flows = [ev for ev in doc["traceEvents"] if ev["ph"] in ("s", "f")]
+    assert all(ev["name"] == "replace" for ev in flows)
+
+
+def test_log_if_long_lands_on_armed_buffer():
+    buf = tracebuf.arm(capacity=1000)
+    tr = Trace("SlowPath", pods=3)
+    tr.step("first")
+    tr.step("second", n=2)
+    assert tr.log_if_long(0.0)
+    names = [ev["name"] for ev in buf.export()["traceEvents"]
+             if ev.get("cat") == "slowtrace"]
+    assert names == ["SlowPath:first", "SlowPath:second"]
+    # disarmed: same call is log-only (no buffer, no error)
+    tracebuf.disarm()
+    assert Trace("SlowPath").log_if_long(0.0)
+
+
+def test_snapshot_counters_endpoints_and_cli(tmp_path):
+    from kubernetes_tpu.cli.ktl import main as ktl_main
+    from kubernetes_tpu.server import APIServer
+
+    store, sched = _e2e_capture(n_pods=32)
+    srv = APIServer(store).start()
+    try:
+        name = sched._bind_origin
+        snap = schedtrace_snapshot()
+        tb = snap[name]["tracebuf"]
+        assert tb["armed"] is True
+        assert tb["trace_events_total"] > 0
+        assert tb["trace_events_dropped_total"] == 0
+        assert sched.sched_stats()["tracebuf"]["armed"] is True
+        with urllib.request.urlopen(f"{srv.url}/debug/trace") as resp:
+            doc = json.loads(resp.read())
+        res = validate_export(doc)
+        assert res["valid"], res["errors"]
+        with urllib.request.urlopen(f"{srv.url}/debug/critpath") as resp:
+            cp = json.loads(resp.read())
+        assert cp[name]["overall"]["dominant"] in critpath.COMPONENTS
+        # ktl sched why: per-window dominant component table
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert ktl_main(["--server", srv.url, "sched", "why"]) == 0
+        out = buf.getvalue()
+        assert "dominant" in out and cp[name]["overall"]["dominant"] in out
+        # ktl sched stats: the one-line trace status
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert ktl_main(["--server", srv.url, "sched", "stats"]) == 0
+        assert "trace: armed" in buf.getvalue()
+        # ktl sched trace --export: writes a Perfetto-loadable file
+        dest = tmp_path / "trace.json"
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            assert ktl_main(["--server", srv.url, "sched", "trace",
+                             "--export", str(dest)]) == 0
+        exported = json.loads(dest.read_text())
+        assert validate_export(exported)["valid"]
+        assert str(dest) in buf.getvalue()
+    finally:
+        srv.stop()
+
+
+def test_trace_export_unarmed_is_graceful():
+    doc = trace_export()
+    assert doc["traceEvents"] == []
+    assert "error" in doc
+    assert critpath_snapshot() == {} or isinstance(critpath_snapshot(), dict)
