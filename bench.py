@@ -655,16 +655,20 @@ def rung_north_star_endtoend(results):
             # keeps the drift from landing entirely on one column. The main
             # 1p run above stays the official 1p number; its wall joins the
             # 1p sample set here.
+            from kubernetes_tpu.obs import ResourceSampler
+            judge = len(os.sched_getaffinity(0)) >= 2
             best = None
             walls_1p, walls_2p = [dt], []
             for i in range(2):
+                samp = ResourceSampler(interval_s=0.05) if judge else None
                 c, st2c, d2, b2 = _partitioned_e2e(
-                    n_pods, n_nodes, 2, f"e2eb{i}")
+                    n_pods, n_nodes, 2, f"e2eb{i}", sampler=samp)
                 walls_2p.append(d2)
+                osum = samp.summary() if samp is not None else None
                 if best is None or d2 < best[2]:
                     if best is not None:
                         best[0].stop()
-                    best = (c, st2c, d2, b2)  # rebind drops the old best
+                    best = (c, st2c, d2, b2, osum)  # rebind drops old best
                 else:
                     c.stop()
                     del c, st2c
@@ -673,7 +677,7 @@ def rung_north_star_endtoend(results):
                 _s1.stop()
                 del _s1, _st1
                 walls_1p.append(d1)
-            coord, store2, dt2, bound2 = best
+            coord, store2, dt2, bound2, osum2 = best
             compiles_2p = sum(
                 v - compiles2_0.get(k, 0)
                 for k, v in _solver_jit_cache().items() if v >= 0)
@@ -702,6 +706,12 @@ def rung_north_star_endtoend(results):
                 "walls_2p": [round(w, 3) for w in walls_2p],
                 "cores": cores,
                 "ab_comparable": cores >= 2,
+                # measured concurrency (ISSUE 19 satellite): overlap_cpu_s
+                # sampled inside the winning 2p window; None = 1-core rig
+                "overlap_cpu_s": (osum2["overlap_cpu_s"] if osum2
+                                  else None),
+                "concurrency_verdict": _overlap_verdict(
+                    osum2["overlap_cpu_s"] if osum2 else None, dt2),
                 "concurrent_drive": coord.concurrent_drive,
                 "bind_wait_share_1p": share_1p,
                 "bind_wait_share_2p": share_2p,
@@ -728,11 +738,16 @@ def rung_north_star_endtoend(results):
         print(f"NorthStar_100k_10k_endtoend: ERROR {e}", file=sys.stderr)
 
 
-def _partitioned_e2e(n_pods, n_nodes, partitions, prefix, batch_size=None):
+def _partitioned_e2e(n_pods, n_nodes, partitions, prefix, batch_size=None,
+                     sampler=None):
     """One end-to-end bind run (fresh store, GC-frozen timed window) through
     a 1-partition BatchScheduler or an N-partition PartitionedScheduler —
     the shared body of the Partitioned_2x rung and the NorthStar A/B column
-    (ISSUE 12). Returns (sched, store, dt, bound)."""
+    (ISSUE 12). Returns (sched, store, dt, bound). sampler: an
+    obs/resource.py ResourceSampler started around the timed window only —
+    the >=2-core A/B re-judge (ISSUE 19 satellite) reads its overlap_cpu_s
+    to judge the speedup column from MEASURED parallelism, not wall ratios.
+    """
     import gc
 
     from kubernetes_tpu.scheduler import Framework
@@ -762,11 +777,16 @@ def _partitioned_e2e(n_pods, n_nodes, partitions, prefix, batch_size=None):
     gc.collect()
     gc.freeze()
     gc.disable()
+    if sampler is not None:
+        sched.attach_resource_sampler(sampler)
+        sampler.start()
     try:
         t0 = time.perf_counter()
         sched.run_until_idle()
         dt = time.perf_counter() - t0
     finally:
+        if sampler is not None:
+            sampler.stop()
         gc.enable()
         gc.unfreeze()
     sched.flush_binds()
@@ -796,6 +816,11 @@ def rung_partitioned(results):
             _w.stop()
             del _w
         compiles0 = _solver_jit_cache()
+        # >=2-core re-judge (ISSUE 19 satellite): a per-thread CPU sampler
+        # rides every 2p timed window so the speedup column is judged from
+        # measured overlap_cpu_s, never inferred from wall ratios
+        from kubernetes_tpu.obs import ResourceSampler
+        judge = len(os.sched_getaffinity(0)) >= 2
         # interleaved best-of-2 per mode (the BindCommit discipline): the
         # co-scheduled rig drifts, alternating keeps the drift off one column
         runs_1p = []  # (wall, bound) pairs — picked together, never mixed
@@ -807,17 +832,19 @@ def rung_partitioned(results):
             _s1.stop()
             del _s1, _st1
             runs_1p.append((d1, b1i))
+            samp = ResourceSampler(interval_s=0.05) if judge else None
             c2, stc2, d2, b2 = _partitioned_e2e(
-                n_pods, n_nodes, 2, f"pb{i}")
+                n_pods, n_nodes, 2, f"pb{i}", sampler=samp)
             walls_2p.append(d2)
+            osum = samp.summary() if samp is not None else None
             if best2 is None or d2 < best2[2]:
                 if best2 is not None:
                     best2[0].stop()
-                best2 = (c2, stc2, d2, b2, f"pb{i}")
+                best2 = (c2, stc2, d2, b2, f"pb{i}", osum)
             else:
                 c2.stop()
                 del c2, stc2
-        s2, st2, _d2, b2, pfx2 = best2
+        s2, st2, _d2, b2, pfx2, osum2 = best2
         dt1, b1 = min(runs_1p)
         walls_1p = [w for w, _b in runs_1p]
         dt2 = min(walls_2p)
@@ -841,6 +868,11 @@ def rung_partitioned(results):
             # (ROADMAP direction 3 judges scaling on a >=2-core rig)
             "cores": cores,
             "ab_comparable": cores >= 2,
+            # measured concurrency (ISSUE 19 satellite): cpu beyond wall
+            # inside the winning 2p window; None = 1-core rig, not judged
+            "overlap_cpu_s": (osum2["overlap_cpu_s"] if osum2 else None),
+            "concurrency_verdict": _overlap_verdict(
+                osum2["overlap_cpu_s"] if osum2 else None, dt2),
             "concurrent_drive": s2.concurrent_drive,
             "conflicts": s2.conflicts_total,
             "reroutes": s2.reroutes_total,
@@ -918,6 +950,16 @@ def _rig_info():
         except Exception:
             pass
     return {"cores": cores, "cpu_quota": quota}
+
+
+def _overlap_verdict(overlap_cpu_s, wall_s):
+    """The >=2-core A/B judge (ISSUE 19 satellite): a speedup column is
+    believable only when MEASURED cpu-beyond-wall says the pipelines truly
+    ran in parallel — wall-clock ratios on a co-scheduled rig can say
+    anything. None = not judged (no sampler / 1-core rig)."""
+    if overlap_cpu_s is None or wall_s <= 0:
+        return None
+    return "parallel" if overlap_cpu_s >= 0.05 * wall_s else "serialized"
 
 
 def rung_north_star_soak(results):
@@ -2196,6 +2238,69 @@ def rung_chaos_churn(results):
         except Exception as e:  # the leg must not void the main chaos run
             fi.disarm()
             gp = {"error": str(e)[:200]}
+        # --- mp worker-kill leg (ISSUE 19 satellite): the same churn
+        # through a 2-process MPScheduler with worker 1 HARD-KILLED
+        # (SIGKILL — a process failure domain, not an exception) mid-run by
+        # the process.worker chaos site. The supervisor must detect the
+        # death, respawn the slot, resync the estate, and conserve every
+        # pod; the dead worker's in-flight intents die with its queue and
+        # the rv re-validation absorbs anything already submitted.
+        mpk = {}
+        try:
+            from kubernetes_tpu.scheduler.mpsched import MPScheduler
+            from kubernetes_tpu.store import shm as _shm_mod
+
+            if not _shm_mod.available():
+                mpk = {"skipped": "shared memory unavailable"}
+            else:
+                mstore = APIStore()
+                for n in _nodes(n_nodes, cpu="16", mem="64Gi"):
+                    mstore.create("nodes", n)
+                msched = MPScheduler(mstore, processes=2)
+                mkeys = [f"default/mpk-{i}" for i in range(n_pods)]
+                mpods = mk("mpk", n_pods)
+                fi.arm([fi.FaultPlan("process.worker", "kill",
+                                     match="worker-1", after=1)])
+                t0m = time.perf_counter()
+                deadline_m = t0m + (30.0 if SMOKE else 120.0)
+                try:
+                    sent = 0
+                    mbound = 0
+                    while time.perf_counter() < deadline_m:
+                        if sent < n_pods:
+                            mstore.create_many(
+                                "pods", mpods[sent:sent + per_wave],
+                                consume=True)
+                            sent += per_wave
+                        msched.run_until_idle()
+                        mbound = sum(1 for pd in mstore.list("pods")[0]
+                                     if pd.metadata.name.startswith("mpk-")
+                                     and pd.spec.node_name)
+                        if mbound >= n_pods and sent >= n_pods:
+                            break
+                        time.sleep(0.02)
+                finally:
+                    fi.disarm()
+                msched.run_until_idle()
+                msched.flush_binds()
+                mrep = pod_conservation_report(mstore, msched, mkeys)
+                mc = mrep["counts"]
+                mst = msched.sched_stats()["processes"]
+                mpk = {"pods": n_pods, "bound": mc["bound"],
+                       "lost": mc["lost"],
+                       "double_bound": mc["double_bound"],
+                       "worker_restarts": mst["worker_restarts"],
+                       "stale_intents": mst["stale_intents"],
+                       "bind_conflicts": mst["bind_conflicts"],
+                       "rounds": mst["rounds"],
+                       "wall_s": round(time.perf_counter() - t0m, 3),
+                       "ok": (mc["bound"] == len(mkeys) and mc["lost"] == 0
+                              and mc["double_bound"] == 0
+                              and mst["worker_restarts"] >= 1)}
+                msched.stop()
+        except Exception as e:  # the leg must not void the main chaos run
+            fi.disarm()
+            mpk = {"error": str(e)[:200]}
         results["ChaosChurn_20k"] = {
             "pods_per_sec": round(n_pods / dt, 1), "wall_s": round(dt, 3),
             "placed": c["bound"], "pods": len(keys),
@@ -2214,6 +2319,7 @@ def rung_chaos_churn(results):
                                                  {}).get("injected", 0),
             "native_commit": native_leg,
             "partition_kill": pk,
+            "mp_worker_kill": mpk,
             "gang_preemption": gp,
             "solver": "fast+breaker+chaos"}
         print(f"{'ChaosChurn_20k':>28}: {n_pods / dt:>9.0f} pods/s  "
@@ -2233,6 +2339,19 @@ def rung_chaos_churn(results):
                   f"(absorbed={pk['partitions_absorbed']}, "
                   f"conflicts={pk['conflicts']}, "
                   f"reroutes={pk['reroutes']}, {pk['wall_s']}s)",
+                  file=sys.stderr)
+        if "error" in mpk:
+            print(f"    mp worker-kill leg: ERROR {mpk['error']}",
+                  file=sys.stderr)
+        elif "skipped" in mpk:
+            print(f"    mp worker-kill leg: SKIPPED {mpk['skipped']}",
+                  file=sys.stderr)
+        else:
+            print(f"    mp worker-kill leg: {mpk['bound']}/{mpk['pods']} "
+                  f"conserved after SIGKILLing worker 1 "
+                  f"(restarts={mpk['worker_restarts']}, "
+                  f"stale_intents={mpk['stale_intents']}, "
+                  f"rounds={mpk['rounds']}, {mpk['wall_s']}s)",
                   file=sys.stderr)
         if "error" in gp:
             print(f"    gang-preemption leg: ERROR {gp['error']}",
@@ -2865,6 +2984,191 @@ def rung_trace_timeline(results):
         print(f"TraceTimeline: ERROR {e}", file=sys.stderr)
 
 
+
+def rung_multiprocess(results):
+    """MultiProcess_2w (ISSUE 19): the tentpole rung — the SAME
+    constraint-free bind workload through an MPScheduler with TWO worker
+    PROCESSES reading the store's pod columns from shared memory, solving
+    locally, and submitting integer bind intents the owner arbitrates
+    through bind_many + rv re-validation. Publishes conservation, the
+    measured overlap (owner cpu + worker-reported cpu beyond wall — on a
+    1-core rig that is ~0 and ab_comparable says so), 0 mid-run solver
+    compiles (plain pods never touch the jit solvers), and the shm
+    unlink-clean check (no named segment outlives stop())."""
+    from kubernetes_tpu.scheduler.mpsched import MPScheduler
+    from kubernetes_tpu.store import APIStore
+    from kubernetes_tpu.store import shm
+    from kubernetes_tpu.testing import MakePod, pod_conservation_report
+
+    try:
+        if not shm.available():
+            results["MultiProcess_2w"] = {
+                "skipped": "shared memory unavailable"}
+            print("MultiProcess_2w: SKIPPED (no shared memory)",
+                  file=sys.stderr)
+            return
+        n_pods = sz(20_000, floor=2000)
+        n_nodes = sz(1000, floor=64)
+        leaked_before = set(shm.leaked_segments())
+        store = APIStore()
+        for n in _nodes(n_nodes, cpu="16", mem="64Gi"):
+            store.create("nodes", n)
+        sched = MPScheduler(store, processes=2)
+        CH = 10_000
+        pending = [MakePod(f"mpb-{i}").req(
+            {"cpu": "500m", "memory": "1Gi"}).obj() for i in range(n_pods)]
+        keys = [pd.key for pd in pending]
+        for lo in range(0, n_pods, CH):
+            store.create_many("pods", pending[lo:lo + CH], consume=True)
+        compiles0 = _solver_jit_cache()
+        tms0 = os.times()
+        t0 = time.perf_counter()
+        sched.run_until_idle()
+        dt = time.perf_counter() - t0
+        tms1 = os.times()
+        sched.flush_binds()
+        compiles = sum(v - compiles0.get(k, 0)
+                       for k, v in _solver_jit_cache().items() if v >= 0)
+        st = sched.sched_stats()
+        procs = st["processes"]
+        rep = pod_conservation_report(store, sched, keys)
+        c = rep["counts"]
+        # overlap, measured: owner-process cpu (user+sys deltas) plus the
+        # workers' self-reported process_time, minus wall — cpu beyond wall
+        # can only come from processes genuinely running in parallel
+        owner_cpu = ((tms1.user - tms0.user) + (tms1.system - tms0.system))
+        worker_cpu = procs["worker_cpu_s"]
+        overlap = round(max(0.0, owner_cpu + worker_cpu - dt), 6)
+        sched.stop()
+        leaked_after = [seg for seg in shm.leaked_segments()
+                        if seg not in leaked_before]
+        rig = _rig_info()
+        cores = rig["cores"]
+        ok = (c["lost"] == 0 and c["double_bound"] == 0
+              and c["bound"] == n_pods)
+        results["MultiProcess_2w"] = dict({
+            "pods_per_sec": round(c["bound"] / dt, 1) if dt > 0 else 0.0,
+            "wall_s": round(dt, 3),
+            "pods": n_pods, "nodes": n_nodes, "placed": c["bound"],
+            "processes": procs["configured"],
+            "rounds": procs["rounds"],
+            "stale_intents": procs["stale_intents"],
+            "bind_conflicts": procs["bind_conflicts"],
+            "worker_restarts": procs["worker_restarts"],
+            "owner_cpu_s": round(owner_cpu, 4),
+            "worker_cpu_s": round(worker_cpu, 4),
+            "overlap_cpu_s": overlap,
+            "concurrency_verdict": (_overlap_verdict(overlap, dt)
+                                    if cores >= 2 else None),
+            "ab_comparable": cores >= 2,
+            "conservation": c,
+            "conservation_ok": ok,
+            "solver_compiles_during_run": compiles,
+            "shm_leaked_segments": leaked_after,
+            "shm_unlink_clean": not leaked_after,
+            "per_worker": procs["workers"],
+            "residual": procs["residual"],
+            "solver": "ffd+mp2"}, **rig)
+        print(f"{'MultiProcess_2w':>28}: {c['bound'] / dt:>9.0f} pods/s  "
+              f"({c['bound']}/{n_pods} bound via 2 worker processes, "
+              f"rounds={procs['rounds']} "
+              f"stale={procs['stale_intents']} "
+              f"conflicts={procs['bind_conflicts']}, "
+              f"overlap {overlap:.2f}s cpu/{dt:.2f}s wall, "
+              f"shm clean={not leaked_after})", file=sys.stderr)
+    except Exception as e:
+        results["MultiProcess_2w"] = {"error": str(e)[:200]}
+        print(f"MultiProcess_2w: ERROR {e}", file=sys.stderr)
+
+
+def rung_watch_fanout_store(results):
+    """WatchFanout (ISSUE 19 satellite): the STORE's watch bus fanned out
+    to a subscriber sweep — half lossy observability rings, half
+    small-buffer cache watchers that the eviction path terminates when
+    they fall behind — under create churn. Publishes the propagation-p99
+    curve (commit->dequeue, settled per point) and the <=10s SLO verdict
+    at EVERY point: fan-out scale must degrade the tail gracefully, never
+    cliff it."""
+    from kubernetes_tpu.scheduler.slo import CONTROL_PLANE_SLO
+    from kubernetes_tpu.store import APIStore
+    from kubernetes_tpu.testing import MakePod
+
+    try:
+        slo_s = CONTROL_PLANE_SLO["watch_propagation_p99_s"]
+        n_events = sz(512, floor=128)
+        sweep = (sz(32, floor=8), sz(256, floor=16), sz(1024, floor=32))
+        curve = []
+        ok_all = True
+        for n_subs in sweep:
+            store = APIStore()
+            watches = []
+            for i in range(n_subs):
+                if i % 2 == 0:
+                    # observability consumer: lossy ring survives overflow
+                    w = store.watch(kind="pods", ring=True, maxsize=48)
+                else:
+                    # cache consumer: small buffer, falls behind -> evicted
+                    w = store.watch(kind="pods", maxsize=48)
+                watches.append(w)
+            store.clear_watch_propagation()
+            pods = [MakePod(f"wf{n_subs}-{i}").req({"cpu": "100m"}).obj()
+                    for i in range(n_events)]
+            t0 = time.perf_counter()
+            CH = 64
+            for lo in range(0, n_events, CH):
+                store.create_many("pods", pods[lo:lo + CH], consume=True)
+                # drain a rotating half each wave: mixed consumer speeds —
+                # the undrained half's non-ring watchers fall behind and
+                # evict, the rings drop oldest and survive
+                off = (lo // CH) % 2
+                for w in watches[off::2]:
+                    if not w.terminated:
+                        w.drain()
+            for w in watches:
+                if not w.terminated:
+                    w.drain()
+            dt = time.perf_counter() - t0
+            wtel = store.watch_telemetry()
+            prop = wtel["propagation"]
+            evicted = sum(1 for w in watches if w.terminated)
+            ring_dropped = sum(w.ring_dropped for w in watches)
+            point_ok = (prop["count"] > 0
+                        and (prop["p99_s"] or 0.0) <= slo_s)
+            ok_all = ok_all and point_ok
+            curve.append({
+                "subscribers": n_subs,
+                "events": n_events,
+                "wall_s": round(dt, 3),
+                "deliveries": prop["count"],
+                "propagation_p50_s": prop["p50_s"],
+                "propagation_p99_s": prop["p99_s"],
+                "evicted": evicted,
+                "ring_dropped": ring_dropped,
+                "dropped": wtel["dropped"],
+                "slo_ok": point_ok,
+            })
+            for w in watches:
+                w.stop()
+            del store, watches
+        results["WatchFanout"] = dict({
+            "points": curve,
+            "slo_s": slo_s,
+            "slo_ok": ok_all,
+            "max_p99_s": max((pt["propagation_p99_s"] or 0.0)
+                             for pt in curve),
+            "subscribers_max": max(pt["subscribers"] for pt in curve),
+        }, **_rig_info())
+        print(f"{'WatchFanout':>28}: p99 curve "
+              + " ".join(f"{pt['subscribers']}sub="
+                         f"{(pt['propagation_p99_s'] or 0.0) * 1000:.1f}ms"
+                         for pt in curve)
+              + f"  (SLO<= {slo_s:.0f}s: {'PASS' if ok_all else 'FAIL'})",
+              file=sys.stderr)
+    except Exception as e:
+        results["WatchFanout"] = {"error": str(e)[:200]}
+        print(f"WatchFanout: ERROR {e}", file=sys.stderr)
+
+
 RUNGS = [
     ("SchedulingBasic", rung_basic),
     ("TopologySpreading", rung_topology_spread),
@@ -2888,6 +3192,8 @@ RUNGS = [
     ("AffinityQuality", rung_affinity_quality),
     ("Partitioned", rung_partitioned),
     ("ChaosChurn", rung_chaos_churn),
+    ("MultiProcess", rung_multiprocess),
+    ("WatchFanout", rung_watch_fanout_store),
     ("ControlPlane", rung_control_plane),
     ("SchedLint", rung_schedlint),
     ("TraceTimeline", rung_trace_timeline),
@@ -2902,8 +3208,9 @@ RUNGS = [
 QUICK_RUNGS = ("SchedulingBasic", "MixedChurn", "NorthStarEndToEnd",
                "NorthStarSoak", "BindCommit", "SchedStages",
                "GangScheduling", "GangPreemption", "Defrag", "Partitioned",
-               "ChaosChurn", "ControlPlane", "SchedLint", "TraceTimeline")
-QUICK_BUDGET_S = 110.0
+               "ChaosChurn", "MultiProcess", "WatchFanout", "ControlPlane",
+               "SchedLint", "TraceTimeline")
+QUICK_BUDGET_S = 135.0
 
 
 def cpu_fallback(reason: str) -> int:

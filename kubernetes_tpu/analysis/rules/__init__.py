@@ -1,8 +1,8 @@
 """schedlint rule modules. Each exposes `check(index) -> List[Finding]`."""
 
-from . import hotpath, jit, locks, mutation
+from . import hotpath, jit, locks, mproc, mutation
 
-ALL_RULE_MODULES = (locks, mutation, jit, hotpath)
+ALL_RULE_MODULES = (locks, mutation, jit, hotpath, mproc)
 
 RULE_DOCS = {
     "LK001": "lock-order inversion: the pods shard must never be held when "
@@ -16,5 +16,9 @@ RULE_DOCS = {
     "JT002": "host-sync / numpy call inside a jit-traced body",
     "HP001": "per-pod instrumentation inside a batch loop of "
              "scheduler/batch.py (per BATCH, never per pod)",
+    "MP001": "Pod/PodInfo object crosses a process boundary (mp queue "
+             "put/send) — columns or integer keys only",
+    "MP002": "SharedMemory/ShmArena create without a paired close+unlink "
+             "on a finally/stop path (leaks a named /dev/shm segment)",
     "SL001": "schedlint suppression without a written reason",
 }

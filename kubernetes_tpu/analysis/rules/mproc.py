@@ -1,0 +1,176 @@
+"""MP001 / MP002 — cross-process hygiene for the multi-process scheduler.
+
+The mpsched boundary contract (scheduler/mpworker.py docstring): only ints
+and small tuples of ints cross a process boundary. A Pod/PodInfo shoved
+into an mp queue pickles the whole object graph — slow, and the copy
+silently diverges from the live store, so any decision made on it is
+stale the moment it arrives. MP001 flags pod-shaped values inside
+`.put(...)` / `.put_nowait(...)` / `.send(...)` arguments in any module
+that touches multiprocessing or the shm arenas.
+
+MP002 polices segment lifecycle: every module that CREATES shared memory
+(`SharedMemory(..., create=True)` or an `ShmArena(...)` construction)
+must also contain the paired teardown — a `.close()` / `.unlink()` /
+`.shm_close()` call reachable from a cleanup context, meaning inside a
+`finally:` block or inside a function whose name marks it as the stop
+path (close/stop/shutdown/teardown/__exit__/__del__). A create without
+that pairing leaks a named /dev/shm segment past process exit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..findings import Finding
+from ..index import ProjectIndex
+
+_SEND_METHODS = ("put", "put_nowait", "send")
+
+# names that denote a pod object (not a scalar extracted FROM one:
+# `pod.key` launders — see _podlike)
+_POD_NAMES = frozenset({
+    "pod", "pods", "qp", "qps", "podinfo", "pod_info", "queued_pod",
+    "queued_pods", "pending_pod", "pending_pods",
+})
+
+_CLEANUP_FUNC_MARKERS = (
+    "close", "stop", "shutdown", "teardown", "__exit__", "__del__",
+)
+
+_CLEANUP_CALLS = ("close", "unlink", "shm_close")
+
+
+def _imports_mp(fi) -> bool:
+    for target in fi.imports.values():
+        if "multiprocessing" in target or "shared_memory" in target:
+            return True
+        if target == "shm" or target.endswith(".shm"):
+            return True
+    return False
+
+
+def _name_is_podlike(name: str) -> bool:
+    low = name.lower().lstrip("_")
+    return (low in _POD_NAMES or low.endswith("podinfo")
+            or low.endswith("pod_info"))
+
+
+def _podlike(expr: ast.AST):
+    """Return the offending name if this expression carries a pod OBJECT
+    across the boundary, else None. Field access (`pod.key`, `pod.rv`)
+    and calls (`key_of(pod)`, `str(pod)`) extract/launder — only the bare
+    object, or a container literal holding one, is flagged."""
+    if isinstance(expr, ast.Name):
+        return expr.id if _name_is_podlike(expr.id) else None
+    if isinstance(expr, ast.Attribute):
+        # `self.pod` / `qp.pod` is the object; `pod.key` is a field
+        return expr.attr if _name_is_podlike(expr.attr) else None
+    if isinstance(expr, (ast.Tuple, ast.List, ast.Set)):
+        for elt in expr.elts:
+            hit = _podlike(elt)
+            if hit:
+                return hit
+        return None
+    if isinstance(expr, ast.Dict):
+        for v in expr.values:
+            if v is not None:
+                hit = _podlike(v)
+                if hit:
+                    return hit
+        return None
+    if isinstance(expr, ast.Starred):
+        return _podlike(expr.value)
+    if isinstance(expr, ast.Subscript):
+        # pods[i] is still a pod object
+        return _podlike(expr.value)
+    return None
+
+
+def _is_create_site(node: ast.Call) -> str:
+    """'' if not a shared-memory create; else a short label for it."""
+    func = node.func
+    name = None
+    if isinstance(func, ast.Name):
+        name = func.id
+    elif isinstance(func, ast.Attribute):
+        name = func.attr
+    if name == "ShmArena":
+        return "ShmArena"
+    if name == "SharedMemory":
+        for kw in node.keywords:
+            if kw.arg == "create" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value is True:
+                return "SharedMemory(create=True)"
+    return ""
+
+
+def _has_cleanup(fi) -> bool:
+    # a cleanup call inside any finally: block
+    for node in ast.walk(fi.tree):
+        if isinstance(node, ast.Try) and node.finalbody:
+            for stmt in node.finalbody:
+                for sub in ast.walk(stmt):
+                    if (isinstance(sub, ast.Call)
+                            and isinstance(sub.func, ast.Attribute)
+                            and sub.func.attr in _CLEANUP_CALLS):
+                        return True
+    # or inside a function whose NAME is the stop path
+    for info in fi.functions:
+        low = info.name.lower()
+        if not any(m in low for m in _CLEANUP_FUNC_MARKERS):
+            continue
+        for sub in ast.walk(info.node):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _CLEANUP_CALLS):
+                return True
+    return False
+
+
+def check(index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in index.files:
+        mp_file = _imports_mp(fi)
+
+        if mp_file:
+            for info in fi.functions:
+                for node in ast.walk(info.node):
+                    if not (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in _SEND_METHODS):
+                        continue
+                    args = list(node.args) \
+                        + [kw.value for kw in node.keywords if kw.arg is None
+                           or kw.arg not in ("timeout", "block")]
+                    for arg in args:
+                        hit = _podlike(arg)
+                        if hit:
+                            findings.append(Finding(
+                                "MP001", fi.rel, node.lineno,
+                                f"{info.qualname}: pod object `{hit}` "
+                                f"crosses a process boundary via "
+                                f".{node.func.attr}() — pickling a "
+                                f"Pod/PodInfo ships a stale copy",
+                                hint="send column rows / integer keys "
+                                     "only; the owner re-reads the live "
+                                     "store (mpworker.py protocol)"))
+                            break
+
+        create_sites = []
+        for node in ast.walk(fi.tree):
+            if isinstance(node, ast.Call):
+                label = _is_create_site(node)
+                if label:
+                    create_sites.append((node.lineno, label))
+        if create_sites and not _has_cleanup(fi):
+            for lineno, label in create_sites:
+                findings.append(Finding(
+                    "MP002", fi.rel, lineno,
+                    f"{label} created here but this module has no paired "
+                    f"close/unlink on a finally or stop path — the named "
+                    f"/dev/shm segment outlives the process",
+                    hint="pair every create with .close()+unlink on the "
+                         "owner's stop()/finally path (store/shm.py "
+                         "ShmArena.close is the one-call teardown)"))
+    return findings

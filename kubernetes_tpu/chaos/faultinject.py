@@ -105,6 +105,19 @@ SITES: Dict[str, str] = {
     # (tests/test_rebalance.py chaos case). No lock held at any fire.
     "rebalance.cycle": "scheduler/rebalance.py Rebalancer.cycle / wave "
                        "boundaries + midwave gap (no lock held)",
+    # the multi-process scheduler (ISSUE 19): fires in the OWNER process,
+    # once per worker per round before that worker's round is dispatched
+    # (scheduler/mpsched.py MPScheduler._dispatch_round; no lock held;
+    # key = "worker-<i>", so `match=` scopes a plan to one worker slot).
+    # fail/rate plans skip that worker's round (its pods stay pending and
+    # re-offer next round — counted in dispatch_faults); a kill plan
+    # SIGKILLs the real worker PROCESS — the supervisor detects the death,
+    # remaps the slot to survivors, respawns, and reconciles via
+    # resync_from_store (ChaosChurn_20k's mp_worker_kill leg proves pod
+    # conservation across it).
+    "process.worker": "scheduler/mpsched.py MPScheduler._dispatch_round "
+                      "(owner side, no lock held; kill = SIGKILL the "
+                      "worker process)",
 }
 
 # sites that fire under a lock (or inside a loop that must not stall): only

@@ -1396,6 +1396,38 @@ def _render_sched_stats(doc: Dict) -> str:
                 f"nodes={part.get('nodes', 0)} "
                 f"conflicts={part.get('conflicts', 0)} "
                 f"reroutes={part.get('reroutes', 0)}")
+        procs = st.get("processes")
+        if procs:
+            # multi-process mode (ISSUE 19): owner arbitration counters +
+            # one row per worker process; thread mode shows the fallback
+            # reason so a 1-core rig's "why no processes?" is answerable
+            if procs.get("mode") != "mp":
+                out.append(
+                    f"processes: mode=thread configured="
+                    f"{procs.get('configured')} "
+                    f"fallback={procs.get('fallback')}")
+            else:
+                res = procs.get("residual") or {}
+                out.append(
+                    f"processes: mode=mp n={procs.get('configured')} "
+                    f"rounds={procs.get('rounds', 0)} "
+                    f"stale_intents={procs.get('stale_intents', 0)} "
+                    f"bind_conflicts={procs.get('bind_conflicts', 0)} "
+                    f"restarts={procs.get('worker_restarts', 0)} "
+                    f"faults={procs.get('dispatch_faults', 0)} "
+                    f"cpu={procs.get('worker_cpu_s', 0.0):.2f}s "
+                    f"residual={res.get('scheduled', 0)}sched/"
+                    f"{res.get('parked', 0)}parked")
+                wrows = [[str(w.get("index")), str(w.get("pid")),
+                          str(w.get("state")), str(w.get("binds", 0)),
+                          str(w.get("conflicts", 0)),
+                          str(w.get("restarts", 0)),
+                          str(w.get("faults", 0))]
+                         for w in (procs.get("workers") or [])]
+                if wrows:
+                    out.append(fmt_table(
+                        ["WORKER", "PID", "STATE", "BINDS", "CONFLICTS",
+                         "RESTARTS", "FAULTS"], wrows))
         cols = st.get("store_columnar")
         if cols:
             # columnar pod-row store (ISSUE 15): diverged = rows whose bind

@@ -1613,6 +1613,60 @@ class APIStore:
         with self._pods_lock:
             return self._cols.stats()
 
+    def enable_shm(self) -> Optional[str]:
+        """Back the columnar numeric segments with a shared-memory arena
+        (ISSUE 19, store/shm.py): existing columns migrate into named
+        /dev/shm segments a worker process can map read-only by the
+        returned base name. Idempotent (returns the live arena's name);
+        None on the dict path or when shm/numpy is unavailable. The store
+        process stays the ONLY writer — everything still mutates under the
+        pods shard exactly as before, just into shared bytes."""
+        if self._cols is None:
+            return None
+        from . import shm as _shm
+
+        if not _shm.available():
+            return None
+        with self._pods_lock:
+            if self._cols._arena is not None:
+                return self._cols._arena.base_name
+            arena = _shm.ShmArena(_shm.POD_COLS_SCHEMA,
+                                  capacity=len(self._cols.keys))
+            try:
+                self._cols.attach_arena(arena)
+            except Exception:
+                arena.close()
+                raise
+            return arena.base_name
+
+    @property
+    def shm_name(self) -> Optional[str]:
+        """The live pod-column arena's base name (None until enable_shm)."""
+        cols = self._cols
+        return cols._arena.base_name if cols is not None and \
+            cols._arena is not None else None
+
+    def shm_close(self) -> None:
+        """Detach + unlink the pod-column arena (idempotent). Whoever called
+        enable_shm() owns calling this on its stop/finally path so a
+        teardown never leaks /dev/shm segments — schedlint MP002's
+        close+unlink half. The columns fall back to private numpy arrays
+        with contents preserved."""
+        if self._cols is None:
+            return
+        with self._pods_lock:
+            arena = self._cols._arena
+            if arena is None:
+                return
+            cols = self._cols
+            cap = len(cols.keys)
+            for attr in cols._SHM_ATTRS:
+                shared = getattr(cols, attr)
+                setattr(cols, attr,
+                        _columnar.np.array(shared[:cap], copy=True))
+            cols._arena = None
+            arena.close()
+
     # -- scheduling-specific transactional surfaces ----------------------------
 
     def _pod_internal(self, key: str):

@@ -225,6 +225,15 @@ def test_bench_quick_runs_and_emits_json():
     assert sum(r["nodes"] for r in px["per_partition"]) == px["nodes"], px
     assert px["speedup_vs_1p"] > 0 and px["pods_per_sec_1p"] > 0, px
     assert isinstance(px["ab_comparable"], bool), px
+    # ISSUE 19: the >=2-core re-judge — on a judged rig the concurrency
+    # verdict comes from MEASURED overlap_cpu_s, not wall ratios; on a
+    # 1-core rig both columns honestly say "not judged"
+    assert "overlap_cpu_s" in px and "concurrency_verdict" in px, px
+    if px["ab_comparable"]:
+        assert px["overlap_cpu_s"] is not None, px
+        assert px["concurrency_verdict"] in ("parallel", "serialized"), px
+    else:
+        assert px["concurrency_verdict"] is None, px
     # the NorthStar A/B column: same-box 1p-vs-2p, zero mid-run compiles
     # per partition, every pod bound through the partitioned path too
     nsp = ns["partitioned"]
@@ -232,6 +241,8 @@ def test_bench_quick_runs_and_emits_json():
     assert nsp["placed_2p"] == ns["pods"], nsp
     assert nsp["solver_compiles_during_run"] == 0, nsp
     assert len(nsp["per_partition"]) == 2, nsp
+    # ISSUE 19: the measured-overlap columns ride the NorthStar A/B too
+    assert "overlap_cpu_s" in nsp and "concurrency_verdict" in nsp, nsp
     # the jit-retrace guard (ISSUE 5): the end-to-end rung's timed window
     # must compile NOTHING — the warm-up covered every bucket, so a nonzero
     # count here is retrace churn (the JT001 bug class, tens of seconds per
@@ -276,6 +287,18 @@ def test_bench_quick_runs_and_emits_json():
     assert gcc["bound"] == gcc["pods"] > 0, gcc
     assert gcc["lost"] == 0 and gcc["double_bound"] == 0, gcc
     assert gcc["preempted"] >= 1, gcc
+    # ISSUE 19: the mp worker-kill leg — worker process 1 SIGKILLed by the
+    # process.worker chaos site mid-run; the supervisor detected the death,
+    # respawned the slot, resynced the estate, and every pod is conserved
+    # (the dead worker's in-flight intents died with its queue, anything
+    # already submitted fell to rv re-validation / bind-conflict absorption)
+    mpk = cc["mp_worker_kill"]
+    if "skipped" not in mpk:
+        assert "error" not in mpk, mpk
+        assert mpk["ok"] is True, mpk
+        assert mpk["bound"] == mpk["pods"] > 0, mpk
+        assert mpk["lost"] == 0 and mpk["double_bound"] == 0, mpk
+        assert mpk["worker_restarts"] >= 1, mpk
     # ISSUE 7: the breaker trip shows as a BOUNDED p99 excursion in the
     # trace (the faulted/backoff pods are the tail, under the chaos SLO
     # ceiling) while every sampled span still completed — chaos must be
@@ -283,6 +306,41 @@ def test_bench_quick_runs_and_emits_json():
     assert cc["trace_ok"] is True, cc
     assert cc["trace"]["spans"] > 0, cc
     assert cc["trace"]["complete"] == cc["trace"]["spans"], cc
+    # ISSUE 19 tentpole rung: two worker PROCESSES solving over shm column
+    # shards, bind intents arbitrated by the owner — every pod conserved,
+    # zero mid-run compiles in the owner, and every named /dev/shm segment
+    # unlinked on stop (the MP002 contract, enforced at runtime)
+    mp = workloads["MultiProcess_2w"]
+    if "skipped" not in mp:
+        assert "error" not in mp, mp
+        assert mp["conservation_ok"] is True, mp
+        assert mp["placed"] == mp["pods"] > 0, mp
+        assert mp["processes"] == 2, mp
+        assert mp["rounds"] >= 1, mp
+        assert mp["solver_compiles_during_run"] == 0, mp
+        assert mp["shm_unlink_clean"] is True, mp
+        assert mp["shm_leaked_segments"] == [], mp
+        assert len(mp["per_worker"]) == 2, mp
+        assert sum(w["binds"] for w in mp["per_worker"]) > 0, mp
+        # the verdict column is honest: judged only on a >=2-core rig
+        assert "concurrency_verdict" in mp, mp
+        if mp["cores"] < 2:
+            assert mp["concurrency_verdict"] is None, mp
+    # ISSUE 19 satellite: watch fan-out at scale — the subscriber sweep
+    # must hold the propagation-p99 SLO at every point, with the ring
+    # eviction path genuinely exercised (slow ring consumers evict, never
+    # stall the store's mutation path)
+    wf = workloads["WatchFanout"]
+    if "skipped" not in wf:
+        assert "error" not in wf, wf
+        assert wf["slo_ok"] is True, wf
+        assert wf["max_p99_s"] <= wf["slo_s"], wf
+        assert len(wf["points"]) == 3, wf
+        for pt in wf["points"]:
+            assert pt["slo_ok"] is True, pt
+            assert pt["deliveries"] > 0, pt
+        assert any(pt["ring_dropped"] > 0 or pt["evicted"] > 0
+                   for pt in wf["points"]), wf["points"]
     assert cc["latency"]["count"] > 0, cc
     assert cc["latency"]["p99_s"] >= cc["latency"]["p50_s"] > 0, cc
     assert cc["slo"]["pass"] is True, cc

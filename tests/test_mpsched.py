@@ -1,0 +1,455 @@
+"""Multi-process scheduler (ISSUE 19): shared-memory column shards +
+cross-process bind arbitration.
+
+The load-bearing guarantees:
+  (a) processes=1 (and every capability fallback) is BYTE-IDENTICAL to a
+      standalone BatchScheduler — placements, RV sequence, and event
+      streams, across both watch_coalesce modes, with the mutation
+      detector forced;
+  (b) worker processes exchange ONLY integers with the owner (store rows,
+      node rows, rv snapshots); the owner re-validates every snapshot
+      against the live columns and commits through bind_many, so a raced
+      intent is absorbed exactly-once — never double-bound;
+  (c) a SIGKILLed worker is a failure domain: the supervisor detects the
+      death, respawns the slot, reconciles the estate, and every pod is
+      conserved;
+  (d) stop() is unlink-clean — zero named /dev/shm segments survive it
+      (schedlint MP002).
+"""
+
+import os
+
+import pytest
+
+from kubernetes_tpu.chaos import faultinject as fi
+from kubernetes_tpu.scheduler import Framework
+from kubernetes_tpu.scheduler.batch import BatchScheduler
+from kubernetes_tpu.scheduler.mpsched import (
+    MPScheduler,
+    pod_is_plain,
+)
+from kubernetes_tpu.scheduler.plugins import default_plugins
+from kubernetes_tpu.store import APIStore
+from kubernetes_tpu.store import shm
+from kubernetes_tpu.testing import (
+    MakeNode,
+    MakePod,
+    assert_pod_conservation,
+    mutation_detector_guard,
+)
+
+HOST = "kubernetes.io/hostname"
+
+pytestmark = pytest.mark.skipif(
+    not shm.available(), reason="shared memory / numpy unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _force_mutation_detector(monkeypatch):
+    # every store here runs with the detector ON and is checked at teardown
+    # — worker processes read the same rows the owner mutates through
+    # bind_many, exactly the sharing the detector patrols on the owner side
+    yield from mutation_detector_guard(monkeypatch)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    yield
+    fi.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_segments():
+    yield
+    leaked = shm.leaked_segments()
+    assert leaked == [], f"test leaked shm segments: {leaked}"
+
+
+def fw_factory():
+    return Framework(default_plugins())
+
+
+def make_nodes(n, cpu="16"):
+    return [MakeNode(f"node-{i}").labels({HOST: f"node-{i}"}).capacity(
+        {"cpu": cpu, "memory": "64Gi", "pods": "110"}).obj()
+        for i in range(n)]
+
+
+def make_pods(n, pfx="p", cpu="500m"):
+    return [MakePod(f"{pfx}-{i}").req(
+        {"cpu": cpu, "memory": "1Gi"}).obj() for i in range(n)]
+
+
+def drain(sched):
+    sched.run_until_idle()
+    sched.flush_binds()
+
+
+def placements(store):
+    return sorted((p.key, p.spec.node_name) for p in store.list("pods")[0])
+
+
+def bind_transitions(store):
+    """Per-key count of unbound->bound transitions in the store's history —
+    the exactly-once-binding source of truth."""
+    out = {}
+    for ev in store.history_events():
+        if ev.kind != "pods" or ev.type != "MODIFIED":
+            continue
+        if ev.obj.spec.node_name and (ev.prev is None
+                                      or not ev.prev.spec.node_name):
+            out[ev.obj.key] = out.get(ev.obj.key, 0) + 1
+    return out
+
+
+def mp_sched(store, processes=2, **kw):
+    s = MPScheduler(store, fw_factory, processes=processes, **kw)
+    assert s.mode == "mp", s.fallback
+    return s
+
+
+# ---------------------------------------------------------------------------
+# (a) processes=1 byte-parity + the fallback matrix
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("columnar", [True, False])
+def test_processes_1_is_byte_identical(columnar):
+    def run(build):
+        store = APIStore()
+        for n in make_nodes(24):
+            store.create("nodes", n)
+        s = build(store)
+        s.sync()
+        store.create_many("pods", make_pods(300), consume=True)
+        drain(s)
+        events = [(ev.type, ev.kind, ev.resource_version,
+                   ev.obj.key if hasattr(ev.obj, "key") else None,
+                   getattr(ev.obj.spec, "node_name", None)
+                   if ev.kind == "pods" else None)
+                  for ev in store.history_events()]
+        s.stop()
+        return placements(store), events
+
+    pl_a, ev_a = run(lambda st: BatchScheduler(
+        st, fw_factory(), batch_size=256, solver="fast", columnar=columnar))
+    pl_b, ev_b = run(lambda st: MPScheduler(
+        st, fw_factory, processes=1, batch_size=256, solver="fast",
+        columnar=columnar))
+    assert pl_a == pl_b
+    assert ev_a == ev_b
+    assert len(pl_a) == 300 and all(node for _k, node in pl_a)
+
+
+def test_fallback_matrix(monkeypatch):
+    store = APIStore()
+    # explicit request for 1 process
+    s = MPScheduler(store, fw_factory, processes=1)
+    assert (s.mode, s.fallback) == ("thread", "requested")
+    # env kill-switch
+    monkeypatch.setenv("SCHED_PROCESSES", "0")
+    s = MPScheduler(store, fw_factory)
+    assert (s.mode, s.fallback) == ("thread", "requested")
+    monkeypatch.delenv("SCHED_PROCESSES")
+    # 1-core rig auto-falls-back without an explicit ask
+    monkeypatch.setattr("kubernetes_tpu.scheduler.mpsched"
+                        ".default_processes", lambda: 1)
+    s = MPScheduler(store, fw_factory)
+    assert (s.mode, s.fallback) == ("thread", "1-core-auto")
+    # no shared memory on the host
+    monkeypatch.setattr(shm, "available", lambda: False)
+    s = MPScheduler(store, fw_factory, processes=2)
+    assert (s.mode, s.fallback) == ("thread", "no-shm")
+    monkeypatch.undo()
+    # dict-path store (no columns to share)
+    dstore = APIStore(columnar=False)
+    s = MPScheduler(dstore, fw_factory, processes=2)
+    assert (s.mode, s.fallback) == ("thread", "no-columnar-store")
+    # every fallback is a REAL scheduler: stats carry the reason
+    st = s.sched_stats()["processes"]
+    assert st["mode"] == "thread" and st["fallback"] == "no-columnar-store"
+
+
+def test_pod_is_plain_gate():
+    assert pod_is_plain(MakePod("a").req({"cpu": "1"}).obj())
+    assert not pod_is_plain(
+        MakePod("b").req({"cpu": "1"}).node_selector({HOST: "x"}).obj())
+
+
+# ---------------------------------------------------------------------------
+# (b) the mp path: conservation, arbitration, exactly-once
+# ---------------------------------------------------------------------------
+
+
+def test_mp_conservation_with_constrained_residual():
+    store = APIStore()
+    for n in make_nodes(24):
+        store.create("nodes", n)
+    sched = mp_sched(store, processes=2)
+    try:
+        sched.sync()
+        plain = make_pods(300)
+        # pin to the TAIL nodes: FFD fills low-index nodes with plain
+        # pods first, and a saturated target would make these legitimately
+        # unschedulable instead of residual-scheduled
+        pinned = [MakePod(f"sel-{i}").req({"cpu": "100m"})
+                  .node_selector({HOST: f"node-{18 + i}"}).obj()
+                  for i in range(6)]
+        store.create_many("pods", plain + pinned, consume=True)
+        keys = [p.key for p in plain + pinned]
+        drain(sched)
+        assert_pod_conservation(store, sched, keys)
+        pl = placements(store)
+        assert len(pl) == 306 and all(node for _k, node in pl)
+        # the pinned pods went through the residual thread path (workers
+        # never see constraints), plain pods through the worker processes
+        st = sched.sched_stats()["processes"]
+        assert st["mode"] == "mp" and st["rounds"] >= 1
+        assert sum(w["binds"] for w in st["workers"]) == 300
+        assert st["residual"]["scheduled"] == 6
+        for w in st["workers"]:
+            assert w["state"] == "live" and w["pid"] > 0
+        # exactly-once: one unbound->bound transition per pod
+        assert all(n == 1 for n in bind_transitions(store).values())
+    finally:
+        sched.stop()
+    assert shm.leaked_segments() == []
+
+
+def test_stale_intent_revalidation_absorbs_out_of_band_bind():
+    """An intent whose rv snapshot no longer matches the live columns must
+    be dropped at arbitration (stale_intents), never committed — the
+    deterministic version of the worker-solved-against-old-state race."""
+    store = APIStore()
+    for n in make_nodes(8):
+        store.create("nodes", n)
+    sched = mp_sched(store, processes=2)
+    stolen = {}
+    orig_arbitrate = sched._arbitrate
+
+    def arbitrate(w, chunk):
+        if not stolen and chunk:
+            bi = chunk[0][0]
+            key = sched._round_keys[bi]
+            ns, name = key.split("/", 1)
+            # bind it out from under the arbitration — the live columns
+            # move, the worker's rv snapshot is now stale
+            bound, errs = store.bind_many([(ns, name, "node-0")],
+                                          origin="thief")
+            assert bound == 1 and not errs
+            stolen["key"] = key
+        return orig_arbitrate(w, chunk)
+
+    sched._arbitrate = arbitrate
+    try:
+        sched.sync()
+        pods = make_pods(60, pfx="st")
+        store.create_many("pods", pods, consume=True)
+        drain(sched)
+        assert stolen, "no intents arrived"
+        assert sched.stale_intents >= 1
+        assert_pod_conservation(store, sched, [p.key for p in pods])
+        # the raced pod was bound EXACTLY once — by the thief
+        assert all(n == 1 for n in bind_transitions(store).values())
+    finally:
+        sched.stop()
+
+
+def test_bind_conflict_is_absorbed_exactly_once():
+    """A conflict surfacing from bind_many itself (the intent passed rv
+    re-validation but lost the commit race) increments bind_conflicts and
+    resolves the pod — it is never retried into a double bind."""
+    store = APIStore()
+    for n in make_nodes(8):
+        store.create("nodes", n)
+    sched = mp_sched(store, processes=2)
+    orig_bind_many = store.bind_many
+    stolen = {}
+
+    def bind_many(bindings, origin=None, **kw):
+        if origin == sched._origin and not stolen and bindings:
+            ns, name, _node = bindings[0]
+            # win the race for the first pod of the owner's first commit
+            orig_bind_many([(ns, name, "node-1")], origin="thief")
+            stolen["key"] = f"{ns}/{name}"
+        return orig_bind_many(bindings, origin=origin, **kw)
+
+    store.bind_many = bind_many
+    try:
+        sched.sync()
+        pods = make_pods(60, pfx="cf")
+        store.create_many("pods", pods, consume=True)
+        drain(sched)
+        assert stolen, "owner never committed a batch"
+        assert sched.bind_conflicts >= 1
+        assert_pod_conservation(store, sched, [p.key for p in pods])
+        assert all(n == 1 for n in bind_transitions(store).values())
+    finally:
+        del store.bind_many
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# (c) worker failure domain
+# ---------------------------------------------------------------------------
+
+
+def test_sigkilled_worker_is_detected_respawned_and_conserved():
+    store = APIStore()
+    for n in make_nodes(16):
+        store.create("nodes", n)
+    sched = mp_sched(store, processes=2)
+    try:
+        sched.sync()
+        pods = make_pods(200, pfx="kk")
+        store.create_many("pods", pods, consume=True)
+        fi.arm([fi.FaultPlan("process.worker", "kill", count=1,
+                             match="worker-0")])
+        try:
+            drain(sched)
+        finally:
+            fi.disarm()
+        drain(sched)
+        st = sched.sched_stats()["processes"]
+        assert st["worker_restarts"] >= 1
+        restarted = [w for w in st["workers"] if w["restarts"] >= 1]
+        assert restarted and all(w["state"] == "live"
+                                 for w in st["workers"])
+        assert_pod_conservation(store, sched, [p.key for p in pods])
+        assert all(n == 1 for n in bind_transitions(store).values())
+    finally:
+        sched.stop()
+
+
+# ---------------------------------------------------------------------------
+# (d) unlink-clean teardown + observability surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_stop_is_unlink_clean_and_idempotent():
+    store = APIStore()
+    for n in make_nodes(4):
+        store.create("nodes", n)
+    sched = mp_sched(store, processes=2)
+    sched.sync()
+    store.create_many("pods", make_pods(20, pfx="uc"), consume=True)
+    drain(sched)
+    assert any(seg.startswith("ktpu-") for seg in shm.leaked_segments())
+    sched.stop()
+    sched.stop()  # idempotent
+    assert shm.leaked_segments() == []
+    # the store survives its arena: columns copied back private
+    assert store.pod_columns() is not None
+    assert len(placements(store)) == 20
+
+
+def test_sched_stats_shape_renders_in_ktl():
+    from kubernetes_tpu.cli.ktl import _render_sched_stats
+
+    store = APIStore()
+    for n in make_nodes(4):
+        store.create("nodes", n)
+    sched = mp_sched(store, processes=2)
+    try:
+        sched.sync()
+        store.create_many("pods", make_pods(10, pfx="rr"), consume=True)
+        drain(sched)
+        st = sched.sched_stats()
+        procs = st["processes"]
+        for k in ("mode", "configured", "rounds", "stale_intents",
+                  "bind_conflicts", "dispatch_faults", "worker_restarts",
+                  "worker_cpu_s", "workers", "residual"):
+            assert k in procs, k
+        for w in procs["workers"]:
+            for k in ("index", "pid", "state", "binds", "conflicts",
+                      "restarts", "faults"):
+                assert k in w, k
+        text = _render_sched_stats({sched._origin: st})
+        assert "processes: mode=mp" in text
+        assert "WORKER" in text and "RESTARTS" in text
+    finally:
+        sched.stop()
+    # the thread fallback renders its reason too
+    s = MPScheduler(store, fw_factory, processes=1)
+    text = _render_sched_stats({"t": s.sched_stats()})
+    assert "mode=thread" in text and "fallback=requested" in text
+
+
+# ---------------------------------------------------------------------------
+# shm arena: grow-by-remap, read-only readers, seqlock
+# ---------------------------------------------------------------------------
+
+
+def test_arena_grow_by_remap_keeps_readers_live():
+    arena = shm.ShmArena(shm.NODE_COLS_SCHEMA, capacity=4,
+                         base_name=shm.fresh_base_name("t1"))
+    try:
+        reader = shm.ShmArenaReader(arena.base_name, shm.NODE_COLS_SCHEMA)
+        try:
+            arena.arrays["alloc_cpu"][:3] = (7, 8, 9)
+            arena.publish(3)
+            reader.refresh()
+            assert reader.nrows == 3
+            assert list(reader.arrays["alloc_cpu"][:3]) == [7, 8, 9]
+            gen0 = arena.generation
+            arena.grow(100)  # pow2 remap: new segment, old unlinked
+            assert arena.generation > gen0
+            assert arena.capacity >= 100
+            arena.arrays["alloc_cpu"][50] = 123
+            arena.publish(51)
+            reader.refresh()  # follows the ctl generation to the new map
+            assert reader.nrows == 51
+            assert int(reader.arrays["alloc_cpu"][50]) == 123
+            assert int(reader.arrays["alloc_cpu"][1]) == 8  # copied over
+        finally:
+            reader.close()
+    finally:
+        arena.close()
+    assert shm.leaked_segments() == []
+
+
+def test_reader_mappings_are_read_only():
+    arena = shm.ShmArena(shm.BATCH_COLS_SCHEMA, capacity=4,
+                         base_name=shm.fresh_base_name("t2"))
+    try:
+        reader = shm.ShmArenaReader(arena.base_name, shm.BATCH_COLS_SCHEMA)
+        try:
+            with pytest.raises(ValueError):
+                reader.arrays["cpu"][0] = 1
+        finally:
+            reader.close()
+    finally:
+        arena.close()
+
+
+def test_store_enable_shm_roundtrip_and_close():
+    store = APIStore()
+    base = store.enable_shm()
+    assert base is not None and store.shm_name == base
+    assert store.enable_shm() == base  # idempotent
+    store.create_many("pods", make_pods(10, pfx="sr"), consume=True)
+    reader = shm.ShmArenaReader(base, shm.POD_COLS_SCHEMA)
+    try:
+        assert reader.nrows == 10
+        # fresh unbound rows: node_id sentinel, live row_rv
+        assert all(int(v) == -1 for v in reader.arrays["node_id"][:10])
+        assert all(int(v) >= 0 for v in reader.arrays["row_rv"][:10])
+    finally:
+        reader.close()
+    store.shm_close()
+    assert store.shm_name is None
+    assert shm.leaked_segments() == []
+    # the columns survive privately after the arena is gone
+    assert store.pod_columns().n == 10
+
+
+def test_default_processes_honors_environment():
+    # the resolution chain is __init__'s: SCHED_PROCESSES wins over cores
+    store = APIStore()
+    os.environ["SCHED_PROCESSES"] = "2"
+    try:
+        s = MPScheduler(store, fw_factory)
+        assert s.processes == 2 and s.mode == "mp"
+        s.stop()
+    finally:
+        del os.environ["SCHED_PROCESSES"]

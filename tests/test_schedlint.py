@@ -1307,3 +1307,117 @@ def test_cli_json_exit_codes(tmp_path):
     doc = json.loads(proc.stdout)
     assert doc["stats"]["findings"] == 1
     assert doc["findings"][0]["rule"] == "PARSE"
+
+
+# ---------------------------------------------------------------------------
+# MP001 / MP002 — cross-process hygiene (ISSUE 19)
+# ---------------------------------------------------------------------------
+
+MP001_BAD = """
+import multiprocessing
+
+def dispatch(out_q, pod, qps):
+    out_q.put(("work", pod))            # bare pod object
+
+def relay(conn, batch):
+    conn.send([qp for qp in batch])     # laundered? no: comprehension is
+                                        # not flagged, but the next line is
+def relay2(conn, pods):
+    conn.send(pods)                     # the whole pod list
+
+def nested(out_q, qp):
+    out_q.put_nowait({"item": (1, qp)}) # pod buried in a container
+"""
+
+MP001_GOOD = """
+import multiprocessing
+
+def dispatch(out_q, pod, rows):
+    out_q.put(("work", pod.key, 3))     # field access extracts a scalar
+    out_q.put(("rows", rows))
+    out_q.put_nowait(("bind", [(1, 2, 3), (4, 5, 6)]))
+
+def relay(conn, pod):
+    conn.send(key_of(pod))              # a call launders (returns a key)
+"""
+
+
+def test_mp001_fires_on_pod_objects_crossing_process_boundary():
+    findings = [f for f in analyze_source(MP001_BAD) if f.rule == "MP001"]
+    assert len(findings) == 3, findings
+    assert {f.line for f in findings} == {5, 11, 14}
+
+
+def test_mp001_quiet_on_keys_rows_and_laundered_fields():
+    assert "MP001" not in rules_of(analyze_source(MP001_GOOD))
+
+
+def test_mp001_quiet_without_multiprocessing_import():
+    # a plain thread-safe queue in a non-mp module is not a process
+    # boundary — the rule must not fire on ordinary producer/consumer code
+    src = """
+import queue
+
+def feed(q, pod):
+    q.put(pod)
+"""
+    assert "MP001" not in rules_of(analyze_source(src))
+
+
+MP002_BAD = """
+from multiprocessing import shared_memory
+
+class Seg:
+    def start(self):
+        self.seg = shared_memory.SharedMemory(
+            name="x", create=True, size=64)
+
+    def run(self):
+        return bytes(self.seg.buf[:8])
+"""
+
+MP002_GOOD = """
+from multiprocessing import shared_memory
+
+class Seg:
+    def start(self):
+        self.seg = shared_memory.SharedMemory(
+            name="x", create=True, size=64)
+
+    def stop(self):
+        self.seg.close()
+        self.seg.unlink()
+"""
+
+MP002_GOOD_FINALLY = """
+from multiprocessing import shared_memory
+
+def once():
+    seg = shared_memory.SharedMemory(name="x", create=True, size=64)
+    try:
+        return bytes(seg.buf[:8])
+    finally:
+        seg.close()
+        seg.unlink()
+"""
+
+MP002_GOOD_ATTACH = """
+from multiprocessing import shared_memory
+
+def read(name):
+    # attach (create=False default) is the READER side: it must never
+    # unlink, so the rule does not demand a teardown pairing here
+    seg = shared_memory.SharedMemory(name=name)
+    return bytes(seg.buf[:8])
+"""
+
+
+def test_mp002_fires_on_create_without_teardown():
+    findings = [f for f in analyze_source(MP002_BAD) if f.rule == "MP002"]
+    assert len(findings) == 1, findings
+
+
+def test_mp002_quiet_on_stop_path_and_finally_teardown():
+    assert "MP002" not in rules_of(analyze_source(MP002_GOOD))
+    assert "MP002" not in rules_of(analyze_source(MP002_GOOD_FINALLY))
+    assert "MP002" not in rules_of(analyze_source(MP002_GOOD_ATTACH))
