@@ -1212,10 +1212,19 @@ def rung_schedlint(results):
         dt = time.perf_counter() - t0
         results["SchedLint_tree"] = {
             "wall_s": round(dt, 3), "findings": len(findings),
-            "suppressed": stats["suppressed"], "files": stats["files"]}
+            "suppressed": stats["suppressed"], "files": stats["files"],
+            # interprocedural closure shape (ISSUE 20): edge count and the
+            # deepest chain any rule actually walked, so a regression in
+            # resolution (edges collapsing to ~0) or a blow-up (depth
+            # hitting the cap) is visible in BENCH history
+            "callgraph_edges": stats["callgraph_edges"],
+            "resolve_depth": stats["resolve_depth"],
+            # the published hard budget tests/test_bench_quick.py asserts
+            "budget_s": 15.0}
         print(f"{'SchedLint_tree':>28}: {stats['files']} files, "
-              f"{len(findings)} findings, {stats['suppressed']} suppressed "
-              f"in {dt:.2f}s", file=sys.stderr)
+              f"{len(findings)} findings, {stats['suppressed']} suppressed, "
+              f"{stats['callgraph_edges']} call edges (depth "
+              f"{stats['resolve_depth']}) in {dt:.2f}s", file=sys.stderr)
     except Exception as e:
         results["SchedLint_tree"] = {"error": str(e)[:200]}
         print(f"SchedLint_tree: ERROR {e}", file=sys.stderr)

@@ -3,11 +3,20 @@ maps, inline suppressions, and name-based call resolution.
 
 The resolution strategy is deliberately project-native rather than sound:
 `self.m()` resolves within the enclosing class (then its in-tree bases),
-bare names resolve through module-level defs and `from x import y` maps, and
-`obj.m()` resolves only when exactly ONE class in the analyzed tree defines
-`m` — ambiguous names stay unresolved and the rules treat them as opaque.
-That trades missed paths for near-zero false positives, which is what lets
-the whole-tree run gate tier-1 at zero findings.
+bare names resolve through module-level defs and `from x import y` maps,
+`mod.f()` resolves through the file's import map when `mod` names an
+analyzed module (ISSUE 20 — the interprocedural closure; disable with
+`module_qualified=False` to get the legacy per-file resolver), and `obj.m()`
+resolves only when exactly ONE class in the analyzed tree defines `m` —
+ambiguous names stay unresolved and the rules treat them as opaque. That
+trades missed paths for near-zero false positives, which is what lets the
+whole-tree run gate tier-1 at zero findings.
+
+`ProjectIndex.callgraph` is the whole-program view built on top of that
+resolver: a bounded-depth, cycle-safe transitive call graph (depth and
+fan-out caps published in stats) that the rules use to see through helpers
+— a blocking call or per-pod allocation one function deep is reported with
+the resolved call chain.
 """
 
 from __future__ import annotations
@@ -127,20 +136,27 @@ def _collect_defs(fi: FileIndex) -> None:
 class ProjectIndex:
     """The analyzed tree: every parsed file plus cross-file lookup tables."""
 
-    def __init__(self):
+    def __init__(self, module_qualified: bool = True):
         self.files: List[FileIndex] = []
         self.errors: List[Tuple[str, str]] = []  # (path, parse error)
+        # ISSUE 20: module-qualified resolution (`mod.f()` through the
+        # import map). False = the legacy per-file resolver, kept so the
+        # pinned interprocedural regression can prove the old false
+        # negative stays fixed.
+        self.module_qualified = module_qualified
         # lookup tables (built by _finish)
         self.module_files: Dict[str, FileIndex] = {}
         self.methods_by_name: Dict[str, List[FuncInfo]] = {}
         self.module_funcs: Dict[Tuple[str, str], FuncInfo] = {}
         self.classes_by_name: Dict[str, List[ClassInfo]] = {}
+        self._callgraph: Optional["CallGraph"] = None
 
     # -- construction ----------------------------------------------------------
 
     @classmethod
-    def from_paths(cls, paths: List[str]) -> "ProjectIndex":
-        idx = cls()
+    def from_paths(cls, paths: List[str],
+                   module_qualified: bool = True) -> "ProjectIndex":
+        idx = cls(module_qualified=module_qualified)
         for path in paths:
             if os.path.isdir(path):
                 before = len(idx.files) + len(idx.errors)
@@ -165,9 +181,21 @@ class ProjectIndex:
 
     @classmethod
     def from_source(cls, source: str, filename: str = "fixture.py",
-                    module: str = "fixture") -> "ProjectIndex":
-        idx = cls()
+                    module: str = "fixture",
+                    module_qualified: bool = True) -> "ProjectIndex":
+        idx = cls(module_qualified=module_qualified)
         idx.add_source(source, filename, module)
+        idx._finish()
+        return idx
+
+    @classmethod
+    def from_sources(cls, sources: List[Tuple[str, str, str]],
+                     module_qualified: bool = True) -> "ProjectIndex":
+        """Multi-file fixture entry point: (source, filename, module)
+        triples — the interprocedural tests need at least two modules."""
+        idx = cls(module_qualified=module_qualified)
+        for source, filename, module in sources:
+            idx.add_source(source, filename, module)
         idx._finish()
         return idx
 
@@ -259,6 +287,15 @@ class ProjectIndex:
                 got = self._method_in_class(caller.class_name, func.attr)
                 if got is not None:
                     return got
+            # mod.f() (ISSUE 20): when the receiver chain names an analyzed
+            # module — through the import map or literally — that module is
+            # AUTHORITATIVE: resolve its top-level def or stay opaque (a
+            # class/constant attribute must not fall through to the
+            # unique-method guess)
+            if self.module_qualified:
+                mod = self._qualified_module(fi, func)
+                if mod is not None:
+                    return self.module_funcs.get((mod, func.attr))
             # obj.m(): unique method name across the analyzed tree
             if func.attr in self._LIBRARY_METHODS:
                 return None
@@ -266,6 +303,39 @@ class ProjectIndex:
             if len(candidates) == 1:
                 return candidates[0]
         return None
+
+    def _qualified_module(self, fi: FileIndex,
+                          func: ast.Attribute) -> Optional[str]:
+        """The analyzed module a call receiver chain denotes, if any:
+        `shm.attach(...)` via `from ..store import shm`, an alias
+        (`import x.y as z`), or the literal dotted chain."""
+        segs: List[str] = []
+        node = func.value
+        while isinstance(node, ast.Attribute):
+            segs.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        segs.append(node.id)
+        segs.reverse()
+        imp = fi.imports.get(segs[0])
+        candidates = []
+        if imp is not None:
+            candidates.append(".".join([imp] + segs[1:]))
+        candidates.append(".".join(segs))
+        for mod in candidates:
+            if mod in self.module_files and mod != fi.module:
+                return mod
+        return None
+
+    # -- interprocedural closure (ISSUE 20) ------------------------------------
+
+    @property
+    def callgraph(self) -> "CallGraph":
+        """The bounded-depth whole-program call graph, built lazily once."""
+        if self._callgraph is None:
+            self._callgraph = CallGraph(self)
+        return self._callgraph
 
     # -- suppression check -----------------------------------------------------
 
@@ -293,6 +363,106 @@ class ProjectIndex:
             if fi.path == path:
                 return fi
         return None
+
+
+class CallGraph:
+    """Bounded-depth transitive call graph over the analyzed tree.
+
+    Direct edges come from `resolve_call` (so every edge is a resolution
+    the rules would trust anyway); the closure helpers are cycle-safe BFS
+    walks bounded by DEPTH_CAP levels, and a function contributing more
+    than FANOUT_CAP distinct callees stops growing (both caps — and how
+    often the fan-out cap actually bit — are published in stats, so a cap
+    silently truncating coverage shows up in BENCH rather than nowhere).
+    """
+
+    DEPTH_CAP = 12    # max call-chain length any closure follows
+    FANOUT_CAP = 64   # max distinct callees expanded per function
+
+    def __init__(self, index: ProjectIndex):
+        self.index = index
+        # func -> [(call node, callee)] with distinct callees capped
+        self.edges: Dict[FuncInfo, List[Tuple[ast.Call, FuncInfo]]] = {}
+        self.edge_count = 0
+        self.fanout_capped = 0
+        self.max_depth_seen = 0
+        self._build()
+
+    def _build(self) -> None:
+        skip = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                ast.Lambda)
+        for fi in self.index.files:
+            for info in fi.functions:
+                outs: List[Tuple[ast.Call, FuncInfo]] = []
+                distinct: Set[FuncInfo] = set()
+                capped = False
+                stack = list(info.node.body)
+                while stack:
+                    node = stack.pop()
+                    if isinstance(node, skip):
+                        continue
+                    if isinstance(node, ast.Call):
+                        callee = self.index.resolve_call(fi, info, node)
+                        if callee is not None and callee is not info:
+                            if callee in distinct:
+                                outs.append((node, callee))
+                            elif len(distinct) < self.FANOUT_CAP:
+                                distinct.add(callee)
+                                outs.append((node, callee))
+                            else:
+                                capped = True
+                    stack.extend(ast.iter_child_nodes(node))
+                if capped:
+                    self.fanout_capped += 1
+                self.edges[info] = outs
+                self.edge_count += len(distinct)
+
+    def callees(self, info: FuncInfo) -> List[Tuple[ast.Call, FuncInfo]]:
+        return self.edges.get(info, [])
+
+    def reachable_from(self, roots: List[FuncInfo],
+                       depth: Optional[int] = None,
+                       follow=None) -> Dict[FuncInfo, List[FuncInfo]]:
+        """Every function reachable from `roots` (roots excluded unless
+        re-reached), mapped to one full call chain [root, ..., func].
+        `follow(caller, call, callee)` may veto individual edges."""
+        cap = self.DEPTH_CAP if depth is None else min(depth, self.DEPTH_CAP)
+        chains: Dict[FuncInfo, List[FuncInfo]] = {}
+        frontier = [(r, [r]) for r in roots]
+        seen: Set[FuncInfo] = set(roots)
+        level = 0
+        while frontier and level < cap:
+            level += 1
+            nxt: List[Tuple[FuncInfo, List[FuncInfo]]] = []
+            for cur, chain in frontier:
+                for call, callee in self.edges.get(cur, ()):
+                    if callee in seen:
+                        continue
+                    if follow is not None and \
+                            not follow(cur, call, callee):
+                        continue
+                    seen.add(callee)
+                    chains[callee] = chain + [callee]
+                    nxt.append((callee, chains[callee]))
+            frontier = nxt
+        if chains:
+            deepest = max(len(c) - 1 for c in chains.values())
+            if deepest > self.max_depth_seen:
+                self.max_depth_seen = deepest
+        return chains
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "edges": self.edge_count,
+            "depth_cap": self.DEPTH_CAP,
+            "fanout_cap": self.FANOUT_CAP,
+            "fanout_capped": self.fanout_capped,
+            "resolve_depth": self.max_depth_seen,
+        }
+
+
+def render_chain(chain: List[FuncInfo]) -> str:
+    return " -> ".join(f.qualname for f in chain)
 
 
 def _module_name(path: str) -> str:

@@ -1,8 +1,8 @@
 """schedlint rule modules. Each exposes `check(index) -> List[Finding]`."""
 
-from . import hotpath, jit, locks, mproc, mutation
+from . import alloc, hotpath, jit, locks, mproc, mutation, seqlock
 
-ALL_RULE_MODULES = (locks, mutation, jit, hotpath, mproc)
+ALL_RULE_MODULES = (locks, mutation, jit, hotpath, mproc, alloc, seqlock)
 
 RULE_DOCS = {
     "LK001": "lock-order inversion: the pods shard must never be held when "
@@ -20,5 +20,16 @@ RULE_DOCS = {
              "put/send) — columns or integer keys only",
     "MP002": "SharedMemory/ShmArena create without a paired close+unlink "
              "on a finally/stop path (leaks a named /dev/shm segment)",
+    "AL001": "pod-object allocation (ctor/clone/.copy()/dict()) on the "
+             "zero-alloc steady-state schedule/bind path outside a "
+             "declared gate or materialization barrier (pod_obj_allocs==0)",
+    "AL002": "comprehension materializing pod objects per element on the "
+             "zero-alloc steady-state path",
+    "SEQ001": "shm seqlock reader breaks the torn-read protocol (missing "
+              "version re-check after the read, or a raw view of the "
+              "shared segment escapes the retry scope)",
+    "SEQ002": "shm seqlock writer breaks the publish protocol (data-word "
+              "write without the version bump on both sides, or arena "
+              "column writes with no publish() in the same function)",
     "SL001": "schedlint suppression without a written reason",
 }

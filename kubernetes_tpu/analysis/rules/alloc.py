@@ -1,0 +1,278 @@
+"""AL001 / AL002 — steady-state allocation discipline (ISSUE 20).
+
+The static complement of the runtime `pod_obj_allocs == 0` gauge (PR 15's
+zero per-pod-object-allocation steady state, the property behind the +13%
+same-box A/B): on the designated hot paths — `scheduler/batch.py`'s
+schedule path, the whole of `scheduler/cachecols.py`, and
+`store/columnar.py`'s bind path — pod OBJECTS must not be built. Column
+writes, interning, and integer/array work are the steady state; a
+`Pod(...)` / `PodInfo(...)` construction, a clone helper
+(`pod_structural_clone` / `pod_bind_clone` / `deepcopy`), a `.copy()` or
+`dict(...)` of a pod, or a comprehension materializing any of those is a
+finding — unless it sits behind a DECLARED gate:
+
+  * a fallback/materialization gate predicate in an enclosing `if` /
+    ternary test (GATE_PREDICATES: `cols_rows_ok`, `use_columnar`,
+    `fallback`, `materialize`, `numpy`/`available` feature probes, ...) —
+    the shipped shape `qp.pod if cols_rows_ok else clone(qp.pod)` is the
+    canonical gated clone;
+  * a materialization-barrier function (name matching
+    `materialize`/`fallback`/`serial`): those functions ARE the declared
+    exit from the zero-alloc regime (`materialize_columnar_rows`,
+    `_serial_one`), so their bodies are exempt and the closure does not
+    descend into them;
+  * an `except` handler — error paths are not steady state;
+  * or an explicit `# schedlint: allow(AL001) <reason>`.
+
+AL001 anchors on the allocation expression; AL002 on dict/list/set
+comprehensions (and generator expressions) whose element expression
+materializes a pod object. Both carry a "via call chain" form: an
+allocation inside a helper reachable from a hot root through ungated
+resolved calls (bounded depth) is reported with the chain.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..findings import Finding
+from ..index import FuncInfo, ProjectIndex, render_chain
+from .mproc import _name_is_podlike
+
+# (file suffix, function names) designating the zero-alloc hot roots;
+# None = every function in the file
+AL_HOT: Tuple[Tuple[str, Optional[frozenset]], ...] = (
+    ("scheduler/batch.py", frozenset({"schedule_batch",
+                                      "_schedule_batch_inner"})),
+    ("scheduler/cachecols.py", None),
+    ("store/columnar.py", frozenset({"bind_prepare", "commit_bind"})),
+)
+
+# the registered fallback/materialization gate predicates: an enclosing
+# if/ternary test naming one of these declares "we are leaving (or probing
+# for) the zero-alloc regime here"
+GATE_PREDICATES = re.compile(
+    r"cols_rows_ok|use_columnar|columnar|fallback|materiali[sz]e|numpy|"
+    r"available|native|degraded|constrained")
+
+# functions that ARE the declared materialization barrier / fallback path —
+# plus the terminal/event paths (preempt, reject, requeue, rollback, veto,
+# failure handling, event emission): pods leaving the steady state owe real
+# objects by contract. Exempt wholesale; the hot closure does not descend
+# into them.
+_BARRIER_FUNC = re.compile(
+    r"materiali[sz]e|fallback|serial|preempt|reject|requeue|rollback|"
+    r"veto|fail|event")
+
+# pod-object constructors and clone helpers
+_POD_CTOR = re.compile(r"^(Pod|PodInfo|QueuedPodInfo|V1Pod)$")
+_CLONE_FUNC = re.compile(r"(^|_)clone($|_)|clone$|^deepcopy$")
+
+_VIA_DEPTH = 2  # how deep the hot closure follows ungated helpers
+
+_NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+_COMPS = (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+
+
+def _simple_callee_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _alloc_desc(call: ast.Call) -> Optional[str]:
+    """AL001 form: does this call build a pod object?"""
+    name = _simple_callee_name(call)
+    if name is None:
+        return None
+    if _POD_CTOR.match(name):
+        return f"pod object construction {name}(...)"
+    if _CLONE_FUNC.search(name):
+        return f"pod clone {name}(...)"
+    if name == "copy" and isinstance(call.func, ast.Attribute):
+        recv = call.func.value
+        seg = recv.attr if isinstance(recv, ast.Attribute) else (
+            recv.id if isinstance(recv, ast.Name) else None)
+        if seg is not None and _name_is_podlike(seg):
+            return f".copy() of pod object `{seg}`"
+        return None
+    if name in ("dict", "to_dict") and call.args:
+        hit = call.args[0]
+        seg = hit.attr if isinstance(hit, ast.Attribute) else (
+            hit.id if isinstance(hit, ast.Name) else None)
+        if seg is not None and _name_is_podlike(seg):
+            return f"dict(...) materialization of pod object `{seg}`"
+    return None
+
+
+def _comp_desc(comp: ast.AST) -> Optional[str]:
+    """AL002 form: a comprehension whose element materializes pod
+    objects (one allocation per element = one per pod)."""
+    elts = []
+    if isinstance(comp, ast.DictComp):
+        elts = [comp.key, comp.value]
+    elif isinstance(comp, _COMPS):
+        elts = [comp.elt]
+    for e in elts:
+        for node in ast.walk(e):
+            if isinstance(node, ast.Call):
+                desc = _alloc_desc(node)
+                if desc:
+                    kind = type(comp).__name__
+                    return f"{kind} materializes a pod object per element " \
+                           f"({desc})"
+    return None
+
+
+def _gate_test(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and GATE_PREDICATES.search(node.id):
+            return True
+        if isinstance(node, ast.Attribute) and \
+                GATE_PREDICATES.search(node.attr):
+            return True
+    return False
+
+
+class _AllocScan:
+    """One function's ungated allocation forms and outgoing ungated calls
+    (the closure follows only calls on the steady-state straight line)."""
+
+    def __init__(self):
+        self.allocs: List[Tuple[ast.AST, str, str]] = []  # node, rule, desc
+        self.calls: List[ast.Call] = []
+
+    def scan(self, info: FuncInfo) -> "_AllocScan":
+        for stmt in info.node.body:
+            self._stmt(stmt, False)
+        return self
+
+    def _stmt(self, stmt: ast.stmt, gated: bool) -> None:
+        if isinstance(stmt, _NESTED):
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            g = gated or _gate_test(stmt.test)
+            self._expr(stmt.test, gated)
+            for s in stmt.body:
+                self._stmt(s, g)
+            for s in stmt.orelse:
+                self._stmt(s, g)
+            return
+        if isinstance(stmt, ast.Try):
+            for s in stmt.body:
+                self._stmt(s, gated)
+            for h in stmt.handlers:
+                for s in h.body:
+                    self._stmt(s, True)  # error path: not steady state
+            for s in stmt.orelse:
+                self._stmt(s, gated)
+            for s in stmt.finalbody:
+                self._stmt(s, gated)
+            return
+        for _field, value in ast.iter_fields(stmt):
+            if isinstance(value, ast.expr):
+                self._expr(value, gated)
+            elif isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.stmt):
+                        self._stmt(v, gated)
+                    elif isinstance(v, ast.expr):
+                        self._expr(v, gated)
+
+    def _expr(self, node: ast.AST, gated: bool) -> None:
+        if isinstance(node, _NESTED):
+            return
+        if isinstance(node, ast.IfExp):
+            g = gated or _gate_test(node.test)
+            self._expr(node.test, gated)
+            self._expr(node.body, g)
+            self._expr(node.orelse, g)
+            return
+        if isinstance(node, _COMPS):
+            desc = _comp_desc(node)
+            if desc and not gated:
+                self.allocs.append((node, "AL002", desc))
+        elif isinstance(node, ast.Call):
+            desc = _alloc_desc(node)
+            if desc is not None:
+                if not gated:
+                    self.allocs.append((node, "AL001", desc))
+            elif not gated:
+                self.calls.append(node)
+        for child in ast.iter_child_nodes(node):
+            self._expr(child, gated)
+
+
+def _hot_roots(index: ProjectIndex) -> List[FuncInfo]:
+    roots: List[FuncInfo] = []
+    for fi in index.files:
+        norm = fi.path.replace("\\", "/")
+        for sfx, names in AL_HOT:
+            if not norm.endswith(sfx):
+                continue
+            for info in fi.functions:
+                if names is None or info.name in names:
+                    roots.append(info)
+    return roots
+
+
+def check(index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    roots = _hot_roots(index)
+    if not roots:
+        return findings
+
+    scans: Dict[FuncInfo, _AllocScan] = {}
+
+    def scan_of(info: FuncInfo) -> _AllocScan:
+        got = scans.get(info)
+        if got is None:
+            got = scans[info] = _AllocScan().scan(info)
+        return got
+
+    hint = ("the steady-state schedule/bind path must not build pod "
+            "objects (pod_obj_allocs == 0, PR 15): write columns, intern "
+            "strings, carry integer rows — or put the allocation behind a "
+            "registered gate predicate (cols_rows_ok / use_columnar / "
+            "fallback / numpy probe) or a materialize*/fallback/serial "
+            "barrier function")
+
+    root_set = set(roots)
+    for info in roots:
+        for node, rule, desc in scan_of(info).allocs:
+            findings.append(Finding(
+                rule, info.file.rel, node.lineno,
+                f"{info.qualname}: {desc} on the zero-alloc steady-state "
+                f"path", hint=hint))
+
+    # via-call-chain form: ungated calls out of the hot roots, bounded
+    # depth, never through a barrier function (those declare the exit
+    # from the zero-alloc regime)
+    ungated_calls: Dict[FuncInfo, set] = {}
+
+    def _follow(caller: FuncInfo, call: ast.Call, callee: FuncInfo) -> bool:
+        if callee in root_set or _BARRIER_FUNC.search(callee.name):
+            return False
+        allowed = ungated_calls.get(caller)
+        if allowed is None:
+            allowed = ungated_calls[caller] = {
+                id(c) for c in scan_of(caller).calls}
+        return id(call) in allowed
+
+    reached = index.callgraph.reachable_from(
+        roots, depth=_VIA_DEPTH, follow=_follow)
+    for info, chain in sorted(reached.items(),
+                              key=lambda kv: (len(kv[1]),
+                                              kv[0].qualname)):
+        for node, rule, desc in scan_of(info).allocs:
+            findings.append(Finding(
+                rule, info.file.rel, node.lineno,
+                f"{info.qualname}: {desc} reachable from the zero-alloc "
+                f"steady-state path via call chain {render_chain(chain)}",
+                hint=hint))
+    return findings

@@ -55,7 +55,7 @@ import re
 from typing import List, Optional
 
 from ..findings import Finding
-from ..index import ProjectIndex
+from ..index import FuncInfo, ProjectIndex, render_chain
 
 HOT_FILE_SUFFIXES = ("scheduler/batch.py", "scheduler/podtrace.py",
                      "controllers/base.py", "obs/timeseries.py",
@@ -81,6 +81,17 @@ _METRICY = re.compile(r"^(m|metrics|fr|flightrec|clock|trace|recorder|"
 # the membership guard that legalizes per-pod stamping: any name segment of
 # the `in` comparator matching this (self._sampled, sampled, sampled_set)
 _SAMPLED = re.compile(r"sampled")
+
+# terminal-path helpers (failure/requeue/rollback/serial-fallback handlers)
+# are exempt from the interprocedural form: every pod on those paths owes a
+# terminal status by contract, so per-pod narration there is the design,
+# not the multiplier bug
+_TERMINAL_PATH = re.compile(
+    r"fail|error|serial|fallback|reject|requeue|veto|evict|preempt|"
+    r"rollback|cancel")
+
+# how deep the via-call-chain form follows hot-file helpers
+_VIA_DEPTH = 3
 
 
 def _root_name(expr: ast.AST) -> Optional[str]:
@@ -187,12 +198,44 @@ def _scan_loop_body(node: ast.AST, guarded: bool, hits: List) -> None:
         _scan_loop_body(child, guarded, hits)
 
 
+def _scan_loop_calls(node: ast.AST, guarded: bool, calls: List) -> None:
+    """Collect the Call nodes in a loop body with the sampled-guard state
+    at each site (guarded calls are legal whatever their callee does)."""
+    if isinstance(node, ast.If) and _has_sampled_guard(node.test):
+        for child in node.body:
+            _scan_loop_calls(child, True, calls)
+        for child in node.orelse:
+            _scan_loop_calls(child, guarded, calls)
+        return
+    if isinstance(node, ast.Call) and not guarded:
+        calls.append(node)
+    for child in ast.iter_child_nodes(node):
+        _scan_loop_calls(child, guarded, calls)
+
+
+def _func_instrumentation(info: FuncInfo) -> List:
+    """Unguarded instrumentation calls anywhere in a function body (the
+    sampled-set guard exception applies exactly as in the loop scan)."""
+    hits: List = []
+    for stmt in info.node.body:
+        _scan_loop_body(stmt, False, hits)
+    return hits
+
+
 def check(index: ProjectIndex) -> List[Finding]:
     findings: List[Finding] = []
+    hot_files = []
+    hot_infos = set()
     for fi in index.files:
         norm = fi.path.replace("\\", "/")
-        if not any(norm.endswith(sfx) for sfx in HOT_FILE_SUFFIXES):
-            continue
+        if any(norm.endswith(sfx) for sfx in HOT_FILE_SUFFIXES):
+            hot_files.append(fi)
+            hot_infos.update(fi.functions)
+
+    def _follow(_caller, _call, callee):
+        return callee in hot_infos and not _TERMINAL_PATH.search(callee.name)
+
+    for fi in hot_files:
         for info in fi.functions:
             for loop in ast.walk(info.node):
                 if not isinstance(loop, ast.For) or \
@@ -214,4 +257,43 @@ def check(index: ProjectIndex) -> List[Finding]:
                              "stamp behind the sampled-set membership check "
                              "(`if key in ...sampled...:`); see "
                              "scheduler/flightrec.py + scheduler/podtrace.py"))
+
+                # interprocedural form (ISSUE 20): an unguarded call from
+                # the pod-scale loop into a hot-file helper that instruments
+                # unconditionally is the same multiplier, one hop removed
+                calls: List = []
+                _scan_loop_calls(loop.iter, False, calls)
+                for stmt in loop.body + loop.orelse:
+                    _scan_loop_calls(stmt, False, calls)
+                reported = set()
+                for call in calls:
+                    callee = index.resolve_call(fi, info, call)
+                    if callee is None or not _follow(info, call, callee):
+                        continue
+                    offender = chain = None
+                    if _func_instrumentation(callee):
+                        offender, chain = callee, [info, callee]
+                    else:
+                        reached = index.callgraph.reachable_from(
+                            [callee], depth=_VIA_DEPTH, follow=_follow)
+                        for f2, ch in sorted(
+                                reached.items(),
+                                key=lambda kv: len(kv[1])):
+                            if _func_instrumentation(f2):
+                                offender, chain = f2, [info] + ch
+                                break
+                    if offender is None or call.lineno in reported:
+                        continue
+                    reported.add(call.lineno)
+                    ihits = _func_instrumentation(offender)
+                    findings.append(Finding(
+                        "HP001", fi.rel, call.lineno,
+                        f"{info.qualname}: per-pod call reaches {ihits[0][1]}"
+                        f" in {offender.qualname} via call chain "
+                        f"{render_chain(chain)} — instrumentation one helper"
+                        " deep still multiplies per pod",
+                        hint="instrument per BATCH, or guard the call behind"
+                             " the sampled-set membership check; terminal-"
+                             "path helpers (fail/requeue/serial) are exempt"
+                             " by name"))
     return findings

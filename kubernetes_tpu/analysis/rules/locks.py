@@ -70,6 +70,12 @@ STORE_LOCKS = frozenset({GLOBAL, SHARD, NODES_SHARD, PAIR})
 
 _QUEUEISH = re.compile(r"(^|_)q$|queue", re.IGNORECASE)
 
+# subprocess entry points that block until the child runs (ISSUE 20
+# satellite: the pinned interprocedural regression hides one of these a
+# helper deep under a store lock)
+_SUBPROCESS_CALLS = frozenset({
+    "run", "call", "check_call", "check_output", "Popen"})
+
 # GIL-releasing native entry points (ctypes CDLL wrappers in
 # native/hostsched.py): blocking under LK002 — the call drops the GIL until
 # the C kernel returns. The PyDLL commit engine (native/hostcommit.py
@@ -175,6 +181,9 @@ def _blocking_desc(call: ast.Call, func: FuncInfo, index: ProjectIndex,
         if f.attr == "sleep" and isinstance(f.value, ast.Name) \
                 and f.value.id == "time":
             return "time.sleep()"
+        if f.attr in _SUBPROCESS_CALLS and isinstance(f.value, ast.Name) \
+                and fi.imports.get(f.value.id, f.value.id) == "subprocess":
+            return f"subprocess.{f.attr}() (blocks on the child process)"
         if f.attr == "join" and not call.args and not call.keywords:
             return "blocking .join()"
         if f.attr in ("get", "put") and recv_seg \
@@ -197,6 +206,9 @@ def _blocking_desc(call: ast.Call, func: FuncInfo, index: ProjectIndex,
                     "drops the GIL — store/store.py NATIVE LOCK RULE)")
         if f.id == "sleep" and fi.imports.get("sleep", "").startswith("time"):
             return "time.sleep()"
+        if f.id in _SUBPROCESS_CALLS \
+                and fi.imports.get(f.id, "").startswith("subprocess"):
+            return f"subprocess.{f.id}() (blocks on the child process)"
         if f.id in jitted_names:
             return f"jitted-solver call ({f.id})"
         # a local callable loaded from an `on_event` attribute (the store's
@@ -387,22 +399,34 @@ def check(index: ProjectIndex) -> List[Finding]:
                          "under the lock, release, then call the store/"
                          "queue/cache"))
 
-    # LK002: functions reachable from any lock region, with one example path
-    reachable: Dict[FuncInfo, str] = {}
+    # LK002: functions reachable from any lock region, carrying the FULL
+    # resolved call chain (ISSUE 20 — the interprocedural closure), bounded
+    # by the shared callgraph depth cap
+    cg = index.callgraph
+    reachable: Dict[FuncInfo, Tuple[str, List[str]]] = {}
     frontier: List[FuncInfo] = []
     for info, m in models.items():
         for _call, callee, lock_desc in m.locked_calls:
             if callee is not None and callee not in reachable:
-                reachable[callee] = (f"called under {lock_desc} "
-                                     f"in {info.qualname}")
+                reachable[callee] = (lock_desc,
+                                     [info.qualname, callee.qualname])
                 frontier.append(callee)
-    while frontier:
-        cur = frontier.pop()
-        for _call, callee in models.get(cur, _FuncModel(cur)).calls:
-            if callee is not None and callee not in reachable:
-                reachable[callee] = (f"reachable from lock-holding path via "
-                                     f"{cur.qualname}")
-                frontier.append(callee)
+    depth = 1
+    while frontier and depth < cg.DEPTH_CAP:
+        depth += 1
+        nxt: List[FuncInfo] = []
+        for cur in frontier:
+            lock_desc, chain = reachable[cur]
+            for _call, callee in models.get(cur, _FuncModel(cur)).calls:
+                if callee is not None and callee not in reachable:
+                    reachable[callee] = (lock_desc,
+                                         chain + [callee.qualname])
+                    nxt.append(callee)
+        frontier = nxt
+    if reachable:
+        deepest = max(len(c) - 1 for _d, c in reachable.values())
+        if deepest > cg.max_depth_seen:
+            cg.max_depth_seen = deepest
 
     seen: Set[Tuple[str, int]] = set()
     for info, m in models.items():
@@ -416,7 +440,12 @@ def check(index: ProjectIndex) -> List[Finding]:
             if key in seen:
                 continue
             seen.add(key)
-            origin = "while holding a lock" if direct else via
+            if direct:
+                origin = "while holding a lock"
+            else:
+                lock_desc, chain = via
+                origin = (f"reachable under {lock_desc} via call chain "
+                          f"{' -> '.join(chain)}")
             findings.append(Finding(
                 "LK002", info.file.rel, node.lineno,
                 f"{info.qualname}: {desc} {origin}",

@@ -20,10 +20,10 @@ that pairing leaks a named /dev/shm segment past process exit.
 from __future__ import annotations
 
 import ast
-from typing import List
+from typing import List, Optional, Tuple
 
 from ..findings import Finding
-from ..index import ProjectIndex
+from ..index import FuncInfo, ProjectIndex, render_chain
 
 _SEND_METHODS = ("put", "put_nowait", "send")
 
@@ -39,6 +39,9 @@ _CLEANUP_FUNC_MARKERS = (
 )
 
 _CLEANUP_CALLS = ("close", "unlink", "shm_close")
+
+# how deep the chain-based cleanup/boundary searches follow helpers
+_VIA_DEPTH = 3
 
 
 def _imports_mp(fi) -> bool:
@@ -105,57 +108,122 @@ def _is_create_site(node: ast.Call) -> str:
     return ""
 
 
-def _has_cleanup(fi) -> bool:
+def _contains_cleanup_call(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr in _CLEANUP_CALLS):
+            return True
+    return False
+
+
+def _stop_path_funcs(fi) -> List[FuncInfo]:
+    return [info for info in fi.functions
+            if any(m in info.name.lower() for m in _CLEANUP_FUNC_MARKERS)]
+
+
+def _has_cleanup(fi, index: ProjectIndex) -> bool:
     # a cleanup call inside any finally: block
     for node in ast.walk(fi.tree):
         if isinstance(node, ast.Try) and node.finalbody:
             for stmt in node.finalbody:
-                for sub in ast.walk(stmt):
-                    if (isinstance(sub, ast.Call)
-                            and isinstance(sub.func, ast.Attribute)
-                            and sub.func.attr in _CLEANUP_CALLS):
-                        return True
+                if _contains_cleanup_call(stmt):
+                    return True
     # or inside a function whose NAME is the stop path
-    for info in fi.functions:
-        low = info.name.lower()
-        if not any(m in low for m in _CLEANUP_FUNC_MARKERS):
-            continue
-        for sub in ast.walk(info.node):
-            if (isinstance(sub, ast.Call)
-                    and isinstance(sub.func, ast.Attribute)
-                    and sub.func.attr in _CLEANUP_CALLS):
+    roots = _stop_path_funcs(fi)
+    for info in roots:
+        if _contains_cleanup_call(info.node):
+            return True
+    # or (ISSUE 20) a helper deep: follow the resolved call graph from the
+    # stop-path functions — `stop()` delegating to a teardown helper in
+    # another module still pairs the create
+    if roots:
+        reached = index.callgraph.reachable_from(roots, depth=_VIA_DEPTH)
+        for f2 in reached:
+            if _contains_cleanup_call(f2.node):
                 return True
     return False
 
 
+def _podlike_send_sites(info: FuncInfo) -> List[Tuple[ast.Call, str]]:
+    """`.put/.put_nowait/.send` sites in a function whose argument carries
+    a pod object."""
+    out: List[Tuple[ast.Call, str]] = []
+    for node in ast.walk(info.node):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SEND_METHODS):
+            continue
+        args = list(node.args) \
+            + [kw.value for kw in node.keywords if kw.arg is None
+               or kw.arg not in ("timeout", "block")]
+        for arg in args:
+            hit = _podlike(arg)
+            if hit:
+                out.append((node, hit))
+                break
+    return out
+
+
+def _call_passes_podlike(call: ast.Call) -> Optional[str]:
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        hit = _podlike(arg)
+        if hit:
+            return hit
+    return None
+
+
 def check(index: ProjectIndex) -> List[Finding]:
     findings: List[Finding] = []
+    mp_infos = {info for fi in index.files if _imports_mp(fi)
+                for info in fi.functions}
+    seen_sites = set()
+
     for fi in index.files:
         mp_file = _imports_mp(fi)
 
         if mp_file:
             for info in fi.functions:
-                for node in ast.walk(info.node):
-                    if not (isinstance(node, ast.Call)
-                            and isinstance(node.func, ast.Attribute)
-                            and node.func.attr in _SEND_METHODS):
-                        continue
-                    args = list(node.args) \
-                        + [kw.value for kw in node.keywords if kw.arg is None
-                           or kw.arg not in ("timeout", "block")]
-                    for arg in args:
-                        hit = _podlike(arg)
-                        if hit:
-                            findings.append(Finding(
-                                "MP001", fi.rel, node.lineno,
-                                f"{info.qualname}: pod object `{hit}` "
-                                f"crosses a process boundary via "
-                                f".{node.func.attr}() — pickling a "
-                                f"Pod/PodInfo ships a stale copy",
-                                hint="send column rows / integer keys "
-                                     "only; the owner re-reads the live "
-                                     "store (mpworker.py protocol)"))
-                            break
+                for node, hit in _podlike_send_sites(info):
+                    seen_sites.add((fi.rel, node.lineno))
+                    findings.append(Finding(
+                        "MP001", fi.rel, node.lineno,
+                        f"{info.qualname}: pod object `{hit}` "
+                        f"crosses a process boundary via "
+                        f".{node.func.attr}() — pickling a "
+                        f"Pod/PodInfo ships a stale copy",
+                        hint="send column rows / integer keys "
+                             "only; the owner re-reads the live "
+                             "store (mpworker.py protocol)"))
+
+            # interprocedural form (ISSUE 20): a pod handed from an
+            # mp-touching function into a helper OUTSIDE the mp file gate
+            # that then puts/sends it is the same pickle, laundered through
+            # one call — follow edges that pass a pod object
+            def _follow(_caller, call, callee):
+                return (callee not in mp_infos
+                        and _call_passes_podlike(call) is not None)
+
+            for info in fi.functions:
+                reached = index.callgraph.reachable_from(
+                    [info], depth=_VIA_DEPTH, follow=_follow)
+                for f2, chain in sorted(reached.items(),
+                                        key=lambda kv: len(kv[1])):
+                    for node, hit in _podlike_send_sites(f2):
+                        key = (f2.file.rel, node.lineno)
+                        if key in seen_sites:
+                            continue
+                        seen_sites.add(key)
+                        findings.append(Finding(
+                            "MP001", f2.file.rel, node.lineno,
+                            f"{f2.qualname}: pod object `{hit}` crosses a "
+                            f"process boundary via .{node.func.attr}(), "
+                            f"reached via call chain {render_chain(chain)} "
+                            f"— the helper hides the pickle from the "
+                            f"boundary module",
+                            hint="send column rows / integer keys only; "
+                                 "the owner re-reads the live store "
+                                 "(mpworker.py protocol)"))
 
         create_sites = []
         for node in ast.walk(fi.tree):
@@ -163,13 +231,15 @@ def check(index: ProjectIndex) -> List[Finding]:
                 label = _is_create_site(node)
                 if label:
                     create_sites.append((node.lineno, label))
-        if create_sites and not _has_cleanup(fi):
+        if create_sites and not _has_cleanup(fi, index):
             for lineno, label in create_sites:
                 findings.append(Finding(
                     "MP002", fi.rel, lineno,
                     f"{label} created here but this module has no paired "
-                    f"close/unlink on a finally or stop path — the named "
-                    f"/dev/shm segment outlives the process",
+                    f"close/unlink on a finally or stop path (searched the "
+                    f"resolved call graph {_VIA_DEPTH} levels deep "
+                    f"from the stop-path functions) — the "
+                    f"named /dev/shm segment outlives the process",
                     hint="pair every create with .close()+unlink on the "
                          "owner's stop()/finally path (store/shm.py "
                          "ShmArena.close is the one-call teardown)"))

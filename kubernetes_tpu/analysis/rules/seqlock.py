@@ -1,0 +1,283 @@
+"""SEQ001 / SEQ002 — the shm seqlock protocol (ISSUE 20).
+
+`store/shm.py`'s arena header carries a version word (`_H_VER`): the
+writer bumps it to ODD, writes the protected row count (`_H_NROWS`), and
+bumps it back to EVEN (`ShmArena.publish`); a reader snapshots the
+version, reads the data, and RE-CHECKS the version — an odd or changed
+version means the read tore mid-publish and must retry
+(`ShmArenaReader.nrows`). These rules police that protocol in the files
+that own it (`store/shm.py`, `scheduler/mpsched.py`,
+`scheduler/mpworker.py`):
+
+SEQ001 (reader side)
+  * a function that reads the version word and then protected data but
+    never re-checks the version AFTER the data read (a `v0 % 2 == 0`
+    parity test alone is not a re-check — the version must be READ again
+    and compared) has the torn-read bug;
+  * a raw numpy view of the shared segment (a header/column subscript, or
+    anything rooted in an `.arrays` map) must not outlive the retry
+    scope: returning it or storing it on `self` from a retry-protocol
+    function escapes a view whose contents the next publish will shear.
+    Laundering through `int()`/`float()`/`.copy()`/`.tolist()`/`list()`
+    (a value copy) is the fix — `np.asarray` is NOT laundering, it
+    aliases.
+
+SEQ002 (writer side)
+  * a write to the protected row-count word needs the version bump on
+    BOTH sides (the publish() shape) — a bump on only one side leaves a
+    window where a reader sees a torn count with an even version;
+  * arena column-array writes (`arrs["cpu"][i] = ...`) must be followed
+    by a `.publish(...)` in the same function — columns written but never
+    published are invisible to every reader (or worse, half-visible
+    under the OLD count).
+
+Fresh-segment builders (`grow`, `_alloc_segment`) legitimately write
+without the bracket — readers cannot map a generation before the control
+word flips — and carry `# schedlint: allow(SEQ002)` suppressions saying
+exactly that, which keeps the exemption documented where it lives.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from ..findings import Finding
+from ..index import FuncInfo, ProjectIndex
+
+SEQ_FILE_SUFFIXES = ("store/shm.py", "scheduler/mpsched.py",
+                     "scheduler/mpworker.py")
+
+_VER = re.compile(r"VER")
+_NROWS = re.compile(r"NROWS")
+_ARRAYS_SEG = re.compile(r"^(arrays|arrs|narrs)$")
+
+# value-copy wrappers that launder a raw view into a private value
+_LAUNDER_CALLS = frozenset({"int", "float", "bool", "str", "len", "list",
+                            "tuple", "dict", "set", "array"})
+_LAUNDER_METHODS = frozenset({"copy", "tolist", "item", "sum", "all",
+                              "any", "min", "max"})
+
+_NESTED = (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)
+
+
+def _subscript_index_matches(node: ast.Subscript, pat) -> bool:
+    sl = node.slice
+    if isinstance(sl, ast.Name):
+        return bool(pat.search(sl.id))
+    if isinstance(sl, ast.Attribute):
+        return bool(pat.search(sl.attr))
+    return False
+
+
+def _root_segments(node: ast.AST) -> List[str]:
+    segs: List[str] = []
+    while True:
+        if isinstance(node, ast.Attribute):
+            segs.append(node.attr)
+            node = node.value
+        elif isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Name):
+            segs.append(node.id)
+            return segs
+        else:
+            return segs
+
+
+def _walk_no_nested(root: ast.AST):
+    stack = list(ast.iter_child_nodes(root))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, _NESTED):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _FuncSeq:
+    """Seqlock-relevant facts about one function."""
+
+    def __init__(self, info: FuncInfo, arrays_names: Set[str]):
+        self.info = info
+        self.ver_reads: List[ast.Subscript] = []
+        self.ver_writes: List[ast.AST] = []
+        self.nrows_reads: List[ast.Subscript] = []
+        self.nrows_writes: List[ast.AST] = []
+        self.col_writes: List[ast.AST] = []
+        self.has_publish = False
+        self.recheck = False
+        self.arrays_names = arrays_names
+        self._collect()
+
+    def _is_arrays_rooted(self, node: ast.AST) -> bool:
+        segs = _root_segments(node)
+        return any(_ARRAYS_SEG.match(s) for s in segs) or \
+            any(s in self.arrays_names for s in segs)
+
+    def _collect(self) -> None:
+        node = self.info.node
+        for sub in _walk_no_nested(node):
+            if isinstance(sub, ast.Subscript):
+                store = isinstance(sub.ctx, (ast.Store, ast.AugStore)) \
+                    if hasattr(ast, "AugStore") else \
+                    isinstance(sub.ctx, ast.Store)
+                if _subscript_index_matches(sub, _VER):
+                    (self.ver_writes if store else
+                     self.ver_reads).append(sub)
+                elif _subscript_index_matches(sub, _NROWS):
+                    (self.nrows_writes if store else
+                     self.nrows_reads).append(sub)
+                elif store and self._is_arrays_rooted(sub.value):
+                    self.col_writes.append(sub)
+            elif isinstance(sub, ast.AugAssign) and \
+                    isinstance(sub.target, ast.Subscript):
+                if _subscript_index_matches(sub.target, _VER):
+                    self.ver_writes.append(sub.target)
+                elif _subscript_index_matches(sub.target, _NROWS):
+                    self.nrows_writes.append(sub.target)
+            elif isinstance(sub, ast.Call):
+                f = sub.func
+                if isinstance(f, ast.Attribute) and f.attr == "publish":
+                    self.has_publish = True
+            elif isinstance(sub, ast.Compare):
+                for part in [sub.left] + list(sub.comparators):
+                    for n2 in ast.walk(part):
+                        if isinstance(n2, ast.Subscript) and \
+                                _subscript_index_matches(n2, _VER):
+                            self.recheck = True
+
+
+def _collect_arrays_names(info: FuncInfo) -> Set[str]:
+    """Local names bound to an `.arrays` map (ba = reader.arrays) — their
+    subscripts are raw shared views."""
+    out: Set[str] = set()
+    for sub in _walk_no_nested(info.node):
+        if isinstance(sub, ast.Assign) and \
+                isinstance(sub.value, ast.Attribute) and \
+                _ARRAYS_SEG.match(sub.value.attr):
+            for tgt in sub.targets:
+                if isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _is_laundered(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Call):
+        f = expr.func
+        if isinstance(f, ast.Name) and f.id in _LAUNDER_CALLS:
+            return True
+        if isinstance(f, ast.Attribute) and f.attr in _LAUNDER_METHODS:
+            return True
+    return False
+
+
+def _raw_view_expr(expr: ast.AST, seq: "_FuncSeq",
+                   raw_names: Set[str]) -> Optional[str]:
+    """A short label when `expr` is (or aliases) a raw shared view."""
+    if _is_laundered(expr):
+        return None
+    if isinstance(expr, ast.Name) and expr.id in raw_names:
+        return expr.id
+    if isinstance(expr, ast.Subscript):
+        segs = _root_segments(expr.value)
+        if any(_ARRAYS_SEG.match(s) for s in segs) or \
+                any(s in seq.arrays_names for s in segs) or \
+                any("hdr" in s for s in segs):
+            return ".".join(reversed(segs))
+    if isinstance(expr, ast.Attribute) and _ARRAYS_SEG.match(expr.attr):
+        return expr.attr
+    return None
+
+
+def check(index: ProjectIndex) -> List[Finding]:
+    findings: List[Finding] = []
+    for fi in index.files:
+        norm = fi.path.replace("\\", "/")
+        if not any(norm.endswith(sfx) for sfx in SEQ_FILE_SUFFIXES):
+            continue
+        for info in fi.functions:
+            arrays_names = _collect_arrays_names(info)
+            seq = _FuncSeq(info, arrays_names)
+
+            # SEQ001: version read + protected data read, no re-check
+            if seq.ver_reads and seq.nrows_reads and not seq.recheck:
+                findings.append(Finding(
+                    "SEQ001", fi.rel, seq.nrows_reads[0].lineno,
+                    f"{info.qualname}: reads the seqlock version word but "
+                    f"never re-checks it AFTER the data read — a publish "
+                    f"racing this read tears the value undetected",
+                    hint="retry loop: v0 = hdr[_H_VER]; read; accept only "
+                         "if v0 is even and hdr[_H_VER] == v0 still "
+                         "(store/shm.py ShmArenaReader.nrows)"))
+
+            # SEQ001: raw views escaping a retry-protocol function
+            if seq.ver_reads:
+                raw_names: Set[str] = set()
+                for sub in _walk_no_nested(info.node):
+                    if isinstance(sub, ast.Assign):
+                        label = _raw_view_expr(sub.value, seq, raw_names)
+                        if label:
+                            for tgt in sub.targets:
+                                if isinstance(tgt, ast.Name):
+                                    raw_names.add(tgt.id)
+                        escape = label if label else None
+                        for tgt in sub.targets:
+                            if isinstance(tgt, ast.Attribute) and \
+                                    isinstance(tgt.value, ast.Name) and \
+                                    tgt.value.id == "self" and escape:
+                                findings.append(Finding(
+                                    "SEQ001", fi.rel, sub.lineno,
+                                    f"{info.qualname}: raw shared-segment "
+                                    f"view `{escape}` stored on self — it "
+                                    f"outlives the retry scope and the "
+                                    f"next publish shears it",
+                                    hint="launder the value (int()/.copy()/"
+                                         ".tolist()) inside the retry "
+                                         "scope; np.asarray aliases, it "
+                                         "does not copy"))
+                    elif isinstance(sub, ast.Return) and \
+                            sub.value is not None:
+                        label = _raw_view_expr(sub.value, seq, raw_names)
+                        if label:
+                            findings.append(Finding(
+                                "SEQ001", fi.rel, sub.lineno,
+                                f"{info.qualname}: returns raw shared-"
+                                f"segment view `{label}` — it outlives the "
+                                f"retry scope and the next publish shears "
+                                f"it",
+                                hint="launder the value (int()/.copy()/"
+                                     ".tolist()) inside the retry scope; "
+                                     "np.asarray aliases, it does not "
+                                     "copy"))
+
+            # SEQ002: protected-word write without the both-sides bump
+            for w in seq.nrows_writes:
+                before = any(v.lineno < w.lineno for v in seq.ver_writes)
+                after = any(v.lineno > w.lineno for v in seq.ver_writes)
+                if not (before and after):
+                    findings.append(Finding(
+                        "SEQ002", fi.rel, w.lineno,
+                        f"{info.qualname}: writes the protected row-count "
+                        f"word without the version bump on BOTH sides — a "
+                        f"reader can accept a torn count under an even "
+                        f"version",
+                        hint="publish() shape: hdr[_H_VER] += 1; "
+                             "hdr[_H_NROWS] = n; hdr[_H_VER] += 1 "
+                             "(store/shm.py)"))
+
+            # SEQ002: column writes never published
+            if seq.col_writes and not seq.has_publish and \
+                    not seq.nrows_writes:
+                findings.append(Finding(
+                    "SEQ002", fi.rel, seq.col_writes[0].lineno,
+                    f"{info.qualname}: writes arena column arrays but "
+                    f"never calls .publish(...) — the rows are invisible "
+                    f"(or half-visible under the old count) to every "
+                    f"reader",
+                    hint="write the columns, then publish(n) — the "
+                         "version bump pair makes the new rows visible "
+                         "atomically (scheduler/mpsched.py "
+                         "_publish_round)"))
+    return findings

@@ -2,17 +2,25 @@
 
 Usage:
     python -m kubernetes_tpu.analysis.schedlint [--json] [paths...]
-    ktl vet [-o json] [paths...]          (same engine, CLI-integrated)
+    ktl vet [-o json] [--diff [REF]] [--lock-graph] [paths...]
 
 Walks the given paths (default: the kubernetes_tpu package), parses every
-.py file once, and runs the rule suite:
+.py file once, builds the bounded interprocedural call graph
+(module-qualified resolution, DEPTH_CAP/FANOUT_CAP published in stats),
+and runs the rule suite:
 
     LK001  lock-order inversion (store global RV lock vs pods shard)
-    LK002  blocking call while a lock is held
+    LK002  blocking call while a lock is held (direct or via call chain)
     MU001  mutation of store-returned / event objects
     JT001  per-batch-varying value into a jit static_argnames parameter
     JT002  host-sync / numpy call inside a jit body
-    HP001  per-pod instrumentation inside batch loops (scheduler/batch.py)
+    HP001  per-pod instrumentation inside batch loops (direct or via chain)
+    MP001  pod object crossing a process boundary (direct or via helper)
+    MP002  multiprocess resource without a reachable cleanup path
+    AL001  pod-object allocation on the zero-alloc steady-state path
+    AL002  comprehension materializing pod objects on the steady-state path
+    SEQ001 shm seqlock reader without a version re-check / raw-view escape
+    SEQ002 shm seqlock writer without the version bump on both sides
     SL001  suppression without a written reason
 
 Inline suppressions: `# schedlint: allow(RULE) <reason>` on the finding line
@@ -20,6 +28,16 @@ Inline suppressions: `# schedlint: allow(RULE) <reason>` on the finding line
 suppression is itself a finding (SL001), so every exception to an invariant
 is documented where it lives. Exit status: 0 clean, 1 findings, 2 usage or
 parse failure.
+
+`--diff [REF]` (default HEAD) narrows the findings to the files changed
+against REF plus everything that imports or calls into them (the
+reverse closure over the import map and the resolved call graph) — the
+whole-program index is still built, so interprocedural findings keep
+their chains. `--lock-graph` renders the runtime lock-graph witness
+(store/lockgraph.py): from a LOCK_GRAPH_EXPORT JSON if present, else by
+exercising a scratch store in-process. JSON output carries a `baseline`
+stats block: findings by rule, every suppression with its written
+reason, and parse errors.
 """
 
 from __future__ import annotations
@@ -77,11 +95,36 @@ def run(index: ProjectIndex) -> Tuple[List[Finding], Dict]:
         kept.append(Finding("PARSE", path, 1, err,
                             hint="fix the path/syntax; exit code 2"))
     kept.sort(key=lambda f: (f.file, f.line, f.rule))
+
+    by_rule: Dict[str, int] = {}
+    for f in kept:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    sup_records = [
+        {"file": fi.rel, "line": sup.line,
+         "rules": sorted(sup.rules) or ["*"], "reason": sup.reason}
+        for fi in index.files
+        for sup in sorted(fi.suppressions.values(), key=lambda s: s.line)]
+
+    cg = index.callgraph
     stats = {
         "files": len(index.files),
         "parse_errors": len(index.errors),
         "findings": len(kept),
         "suppressed": suppressed,
+        "callgraph_edges": cg.edge_count,
+        "resolve_depth": cg.max_depth_seen,
+        "callgraph": cg.stats(),
+        # the baseline block: what the tree looks like to the analyzer
+        # RIGHT NOW — findings per rule, every suppression with its
+        # written reason, parse errors. CI diffs this against the
+        # committed expectation instead of grepping rendered text.
+        "baseline": {
+            "findings_by_rule": by_rule,
+            "suppressions": sup_records,
+            "suppression_count": len(sup_records),
+            "parse_errors": [{"path": p, "error": e}
+                             for p, e in index.errors],
+        },
     }
     return kept, stats
 
@@ -117,6 +160,122 @@ def analyze_source(source: str, filename: str = "fixture.py",
     return run(ProjectIndex.from_source(source, filename, module))[0]
 
 
+def analyze_sources(sources: List[Tuple[str, str, str]],
+                    module_qualified: bool = True) -> List[Finding]:
+    """Multi-file fixture entry point: (source, filename, module) triples.
+    `module_qualified=False` pins the pre-interprocedural resolver (the
+    LK002-via-helper regression runs the same fixture both ways)."""
+    return run(ProjectIndex.from_sources(
+        sources, module_qualified=module_qualified))[0]
+
+
+# -- --diff scope ----------------------------------------------------------
+
+
+def _git_lines(repo: str, *args: str) -> List[str]:
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "-C", repo, *args],
+            capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return []
+    if out.returncode != 0:
+        return []
+    return [ln.strip() for ln in out.stdout.splitlines() if ln.strip()]
+
+
+def changed_files(ref: str = "HEAD",
+                  repo: Optional[str] = None) -> List[str]:
+    """Absolute paths of .py files changed against `ref` (worktree diff
+    plus untracked files)."""
+    repo = repo or os.path.dirname(package_root())
+    rels = set(_git_lines(repo, "diff", "--name-only", ref, "--"))
+    rels.update(_git_lines(repo, "ls-files", "--others",
+                           "--exclude-standard"))
+    return sorted(os.path.join(repo, r) for r in rels if r.endswith(".py"))
+
+
+def diff_scope(index: ProjectIndex, changed: List[str]) -> set:
+    """The rel-paths of every indexed file in the diff blast radius: the
+    changed files plus the transitive reverse closure over (a) the import
+    map and (b) the resolved call graph — if A imports or calls into a
+    changed module, A's findings may have changed too, so it is in scope."""
+    real = {os.path.realpath(p) for p in changed}
+    changed_mods = {fi.module for fi in index.files
+                    if os.path.realpath(fi.path) in real}
+
+    # forward deps per module: imports that resolve in-index, plus call
+    # edges (the call graph sees through `from x import f` re-exports)
+    fwd: Dict[str, set] = {fi.module: set() for fi in index.files}
+    for fi in index.files:
+        for target in fi.imports.values():
+            for mod in (target, target.rpartition(".")[0]):
+                if mod and mod != fi.module and mod in index.module_files:
+                    fwd[fi.module].add(mod)
+    for caller, outs in index.callgraph.edges.items():
+        for _call, callee in outs:
+            if callee.module != caller.module:
+                fwd[caller.module].add(callee.module)
+
+    rev: Dict[str, set] = {}
+    for mod, deps in fwd.items():
+        for dep in deps:
+            rev.setdefault(dep, set()).add(mod)
+
+    scope = set(changed_mods)
+    frontier = list(changed_mods)
+    while frontier:
+        mod = frontier.pop()
+        for dependent in rev.get(mod, ()):
+            if dependent not in scope:
+                scope.add(dependent)
+                frontier.append(dependent)
+    return {fi.rel for fi in index.files if fi.module in scope}
+
+
+# -- --lock-graph ----------------------------------------------------------
+
+
+def _witness_from_export(path: str):
+    from ..store.lockgraph import LockGraphWitness
+
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    w = LockGraphWitness()
+    for e in doc.get("edges", []):
+        w.edges[(e["held"], e["acquired"])] = dict(e)
+    return w, doc.get("order_table")
+
+
+def lock_graph_report(export: Optional[str] = None) -> Tuple[str, bool]:
+    """Render the runtime lock-graph witness. Prefers a JSON export (the
+    `export` arg, else $LOCK_GRAPH_EXPORT) written by a real tier-1 run;
+    with neither, exercises a scratch in-process store so the canonical
+    ascending edges are witnessed. Returns (text, clean)."""
+    path = export or os.environ.get("LOCK_GRAPH_EXPORT")
+    if path and os.path.isfile(path):
+        w, table = _witness_from_export(path)
+        report = w.diff(table)
+        return (f"[from export {path}]\n" + w.render(table),
+                report["clean"])
+
+    from ..store.lockgraph import LockGraphWitness
+    from ..store.store import APIStore
+
+    w = LockGraphWitness()
+    store = APIStore(lock_order_check=True)
+    for lk in (store._lock, store._pods_lock, store._nodes_lock):
+        lk._witness = w
+    # walk the full legal ordering once: global RV -> pods shard ->
+    # nodes shard, witnessing every ascending edge
+    with store._lock, store._pods_lock, store._nodes_lock:
+        pass
+    report = w.diff()
+    return ("[in-process scratch store]\n" + w.render(), report["clean"])
+
+
 def render_text(findings: List[Finding], stats: Dict) -> str:
     lines = [f.render() for f in findings]
     lines.append(
@@ -137,6 +296,16 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser.add_argument("--json", action="store_true",
                         help="machine-readable findings on stdout")
     parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument("--diff", nargs="?", const="HEAD", default=None,
+                        metavar="REF",
+                        help="narrow findings to files changed vs REF "
+                             "(default HEAD) plus their reverse "
+                             "import/call dependents; the whole-program "
+                             "index is still built")
+    parser.add_argument("--lock-graph", action="store_true",
+                        help="render the runtime lock-graph witness "
+                             "(from $LOCK_GRAPH_EXPORT if set, else a "
+                             "scratch in-process store)")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -146,11 +315,38 @@ def main(argv: Optional[List[str]] = None) -> int:
             print(f"{rule}  {doc}")
         return 0
 
-    findings, stats = run_paths(args.paths or None)
+    if args.lock_graph:
+        text, clean = lock_graph_report()
+        print(text)
+        return 0 if clean else 1
+
+    t0 = time.perf_counter()
+    index = ProjectIndex.from_paths(
+        list(args.paths) if args.paths else [package_root()])
+    findings, stats = run(index)
+
+    if args.diff is not None:
+        changed = changed_files(args.diff)
+        scope = diff_scope(index, changed)
+        findings = [f for f in findings
+                    if f.file in scope or f.rule == "PARSE"]
+        stats["findings"] = len(findings)
+        stats["diff"] = {
+            "ref": args.diff,
+            "changed_files": len(changed),
+            "scope_files": len(scope),
+        }
+    stats["wall_s"] = round(time.perf_counter() - t0, 3)
+
     if args.json:
         print(json.dumps({"findings": [f.as_dict() for f in findings],
                           "stats": stats}, indent=2))
     else:
+        if args.diff is not None:
+            d = stats["diff"]
+            print(f"schedlint --diff {d['ref']}: {d['changed_files']} "
+                  f"changed file(s), {d['scope_files']} in scope "
+                  f"(reverse import/call closure)")
         print(render_text(findings, stats))
     return exit_code(findings)
 

@@ -1844,16 +1844,23 @@ def cmd_controller(client: RESTClient, args) -> int:
 
 
 def cmd_vet(client: RESTClient, args) -> int:
-    """ktl vet [-o json] [paths...] — run schedlint (the project-native
-    static analyzer, analysis/schedlint.py) over the tree. The `go vet` of
-    this control plane: nonzero exit on any unsuppressed finding, so CI and
-    pre-commit hooks can gate on it. Entirely local (no apiserver)."""
+    """ktl vet [-o json] [--diff [REF]] [--lock-graph] [paths...] — run
+    schedlint (the project-native static analyzer, analysis/schedlint.py)
+    over the tree. The `go vet` of this control plane: nonzero exit on any
+    unsuppressed finding, so CI and pre-commit hooks can gate on it.
+    Entirely local (no apiserver). `--diff` narrows findings to the files
+    changed vs REF plus their reverse import/call dependents; `--lock-graph`
+    renders the runtime lock-graph witness instead of analyzing."""
     from ..analysis import schedlint
 
     # delegate to the module CLI so the two entry points share one
     # output/exit-code contract (only the flag spelling differs)
-    return schedlint.main(
-        (["--json"] if args.output == "json" else []) + list(args.paths))
+    flags = ["--json"] if args.output == "json" else []
+    if args.lock_graph:
+        flags.append("--lock-graph")
+    if args.diff is not None:
+        flags.extend(["--diff", args.diff])
+    return schedlint.main(flags + list(args.paths))
 
 
 def cmd_wait(client: RESTClient, args) -> int:
@@ -2124,6 +2131,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="files/dirs to analyze (default: the package)")
     p.add_argument("-o", "--output", default="table",
                    choices=["table", "json"])
+    p.add_argument("--diff", nargs="?", const="HEAD", default=None,
+                   metavar="REF",
+                   help="narrow findings to files changed vs REF (default "
+                        "HEAD) plus reverse import/call dependents")
+    p.add_argument("--lock-graph", action="store_true",
+                   help="render the runtime lock-graph witness")
     p.set_defaults(fn=cmd_vet)
 
     p = sub.add_parser("wait")

@@ -175,6 +175,9 @@ class ShmArena:
         hdr = np.ndarray((HEADER_WORDS,), dtype=np.int64, buffer=seg.buf)
         hdr[_H_MAGIC] = MAGIC
         hdr[_H_GEN] = generation
+        # schedlint: allow(SEQ002) fresh segment: no reader can map this
+        # generation until the control word flips, so the header/column
+        # writes here need no version bracket (the first publish() does)
         hdr[_H_NROWS] = 0
         hdr[_H_CAP] = capacity
         hdr[_H_VER] = 0
@@ -201,6 +204,9 @@ class ShmArena:
         for name, _dt in self.schema:
             src = old_arrays[name]
             self.arrays[name][: len(src)] = src
+        # schedlint: allow(SEQ002) grow-by-remap writes into the NEW
+        # generation's segment, invisible to readers until ctl[1] flips
+        # below — the version bracket is only needed once it is live
         self._hdr[_H_NROWS] = old_nrows
         ctl = np.ndarray((CTL_WORDS,), dtype=np.int64, buffer=self._ctl.buf)
         ctl[1] = gen

@@ -681,15 +681,27 @@ class _OrderedRLock:
     Rule: acquiring a lock of LOWER rank than one already held (global=0 <
     shard=1) raises LockOrderViolation — unless the thread already holds the
     lock (reentrant acquires never deadlock). The stack is per-store, so two
-    independent stores never alias ranks."""
+    independent stores never alias ranks.
 
-    __slots__ = ("_lock", "_rank", "_name", "_state")
+    ISSUE 20: every fresh acquisition made while another ordered lock is
+    held also RECORDS the edge (held -> acquired) into the lock-graph
+    witness (store/lockgraph.py) — the whole tier-1 run becomes an actual
+    acquisition-edge set that is diffed against the LK001 ordering table
+    at session teardown, and `ktl vet --lock-graph` renders it. The record
+    hot path is one dict hit; stacks are captured only on an edge's first
+    sighting."""
 
-    def __init__(self, name: str, rank: int, state: _LockOrderState):
+    __slots__ = ("_lock", "_rank", "_name", "_state", "_witness")
+
+    def __init__(self, name: str, rank: int, state: _LockOrderState,
+                 witness=None):
+        from .lockgraph import WITNESS
+
         self._lock = threading.RLock()
         self._rank = rank
         self._name = name
         self._state = state
+        self._witness = WITNESS if witness is None else witness
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         stack = self._state.stack
@@ -701,6 +713,10 @@ class _OrderedRLock:
                         f"{held._name}: store/store.py mandates _lock "
                         "(global RV) -> _pods_lock (pods shard), never "
                         "reversed (schedlint LK001)")
+            if stack:
+                top = stack[-1]
+                self._witness.record(top._name, top._rank,
+                                     self._name, self._rank)
         ok = self._lock.acquire(blocking, timeout)
         if ok:
             stack.append(self)

@@ -28,3 +28,26 @@ def _store_lock_order_check(monkeypatch):
     LK001, store/store.py _OrderedRLock) — acquisition orders the static
     pass cannot prove are caught by the tests that exercise them."""
     monkeypatch.setenv("STORE_LOCK_ORDER_CHECK", "1")
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lock_graph_witness_gate():
+    """ISSUE 20: the lock-graph witness records every ordered-lock
+    acquisition edge made by the WHOLE tier-1 run (store/lockgraph.py,
+    recorded by _OrderedRLock under the autouse STORE_LOCK_ORDER_CHECK).
+    At session teardown the witnessed graph is diffed against the LK001
+    ordering table — a never-before-seen inversion edge or a cycle fails
+    the run loudly with the first-seen acquisition stacks. Set
+    LOCK_GRAPH_EXPORT=<path> to also export the graph as JSON (the input
+    `ktl vet --lock-graph` renders)."""
+    from kubernetes_tpu.store.lockgraph import WITNESS
+
+    yield
+    report = WITNESS.diff()
+    export = os.environ.get("LOCK_GRAPH_EXPORT")
+    if export:
+        WITNESS.export(export)
+    if not report["clean"]:  # pragma: no cover - only on a real inversion
+        raise AssertionError(
+            "lock-graph witness diff against the LK001 ordering table is "
+            "DIRTY:\n" + WITNESS.render())

@@ -385,7 +385,16 @@ def test_bench_quick_runs_and_emits_json():
     assert "error" not in sl, sl
     assert sl["findings"] == 0, sl
     assert sl["files"] > 100
-    assert sl["wall_s"] <= 15.0, sl
+    # ISSUE 20: the rung publishes its own hard budget and the
+    # interprocedural closure shape — wall time must fit the published
+    # budget, the resolved call graph must be substantial (a resolver
+    # regression collapsing it to ~nothing would silently blind LK002/
+    # HP001/MP001/AL001's via-chain forms), and some rule must actually
+    # have walked a multi-level chain
+    assert sl["budget_s"] == 15.0, sl
+    assert sl["wall_s"] <= sl["budget_s"], sl
+    assert sl["callgraph_edges"] > 500, sl
+    assert sl["resolve_depth"] >= 2, sl
     # the defrag rung (ISSUE 17): the rebalancer A/B — on the churn-smeared
     # cluster the SAME gang admits with ZERO preemptions and lower latency
     # once the background rebalancer has consolidated the fillers, the

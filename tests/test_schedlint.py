@@ -16,6 +16,7 @@ import pytest
 
 from kubernetes_tpu.analysis.schedlint import (
     analyze_source,
+    analyze_sources,
     package_root,
     run_paths,
 )
@@ -32,12 +33,12 @@ def test_tree_is_clean_and_suppressions_carry_reasons():
     # invariant violation — both fail the gate
     assert findings == [], "\n".join(f.render() for f in findings)
     # the shipped tree documents its intentional exceptions inline (the
-    # Watch._deliver* wake pings; waterfill's raw-headroom jit fallback
-    # moved into the bucket_j_max helper in ISSUE 8, where the accepted
-    # recompile is documented in the docstring instead of an allow — JT001
-    # anchors witnesses inside one function and the raw value now crosses a
-    # helper return)
-    assert stats["suppressed"] >= 2
+    # Watch._deliver* wake pings, LK002; shm.py's fresh-segment header
+    # writes, SEQ002 — generations invisible until the control word flips)
+    assert stats["suppressed"] >= 4
+    # ISSUE 20: the interprocedural closure actually resolved something
+    assert stats["callgraph_edges"] > 500, stats
+    assert stats["resolve_depth"] >= 2, stats
 
 
 def test_wall_time_stays_cheap():
@@ -1421,3 +1422,659 @@ def test_mp002_quiet_on_stop_path_and_finally_teardown():
     assert "MP002" not in rules_of(analyze_source(MP002_GOOD))
     assert "MP002" not in rules_of(analyze_source(MP002_GOOD_FINALLY))
     assert "MP002" not in rules_of(analyze_source(MP002_GOOD_ATTACH))
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20 tentpole: the interprocedural closure. The pinned LK002
+# regression first — a blocking call ONE HELPER DEEP in another module,
+# under a store lock, resolved through a module-qualified call
+# (`helpers.pause(...)`). The legacy resolver (module_qualified=False)
+# cannot see through it (top-level functions are not in the unique-method
+# map), so the bug sails through; the whole-program resolver reports it
+# with the full call chain, no suppression needed.
+# ---------------------------------------------------------------------------
+
+LK002_VIA_HELPERS_MOD = '''
+import subprocess
+import time
+
+def pause_for_settle():
+    time.sleep(0.5)
+
+def spawn_flush(cmd):
+    subprocess.run(cmd, check=True)
+'''
+
+LK002_VIA_STORE_MOD = '''
+import threading
+
+from fixturepkg import helpers
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def locked_settle(self):
+        with self._lock:
+            helpers.pause_for_settle()
+
+    def locked_flush(self):
+        with self._lock:
+            helpers.spawn_flush(["sync"])
+'''
+
+LK002_VIA_STORE_GOOD_MOD = '''
+import threading
+
+from fixturepkg import helpers
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def settle_outside(self):
+        with self._lock:
+            payload = 1
+        helpers.pause_for_settle()
+        helpers.spawn_flush(["sync"])
+        return payload
+'''
+
+
+def _lk002_via_sources(store_src):
+    return [
+        (LK002_VIA_HELPERS_MOD, "fixturepkg/helpers.py",
+         "fixturepkg.helpers"),
+        (store_src, "fixturepkg/store_mod.py", "fixturepkg.store_mod"),
+    ]
+
+
+def test_lk002_pinned_regression_old_resolver_misses_the_helper():
+    # the documented MISS: before the module-qualified resolver, the
+    # blocking helper in another module was invisible — zero findings
+    findings = analyze_sources(_lk002_via_sources(LK002_VIA_STORE_MOD),
+                               module_qualified=False)
+    assert "LK002" not in rules_of(findings), findings
+
+
+def test_lk002_pinned_regression_new_resolver_reports_the_chain():
+    findings = [f for f in
+                analyze_sources(_lk002_via_sources(LK002_VIA_STORE_MOD))
+                if f.rule == "LK002"]
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 2, msgs
+    assert "time.sleep" in msgs and "subprocess.run" in msgs
+    assert "blocks on the child process" in msgs
+    # the full resolved path is printed, both ends module-qualified
+    assert ("via call chain fixturepkg.store_mod.Store.locked_settle "
+            "-> fixturepkg.helpers.pause_for_settle") in msgs
+    assert ("via call chain fixturepkg.store_mod.Store.locked_flush "
+            "-> fixturepkg.helpers.spawn_flush") in msgs
+    # green suppression-free: the findings anchor in the helper module
+    assert all(f.file == "fixturepkg/helpers.py" for f in findings)
+
+
+def test_lk002_quiet_when_helper_called_outside_the_lock():
+    findings = analyze_sources(
+        _lk002_via_sources(LK002_VIA_STORE_GOOD_MOD))
+    assert "LK002" not in rules_of(findings), findings
+
+
+LK002_SUBPROCESS_BAD = '''
+import subprocess
+import threading
+
+class Store:
+    def __init__(self):
+        self._lock = threading.RLock()
+
+    def fork_under_lock(self, cmd):
+        with self._lock:
+            return subprocess.check_output(cmd)
+'''
+
+
+def test_lk002_fires_on_direct_subprocess_under_lock():
+    findings = [f for f in analyze_source(LK002_SUBPROCESS_BAD)
+                if f.rule == "LK002"]
+    assert len(findings) == 1, findings
+    assert "subprocess.check_output()" in findings[0].message
+    assert "blocks on the child process" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: HP001's via-call-chain form — unguarded per-pod call into a
+# hot-file helper that instruments unconditionally, one or two hops deep
+# ---------------------------------------------------------------------------
+
+HP001_VIA_BAD = '''
+class Batcher:
+    def _note_pod(self, qp, m):
+        m.batch_stage_duration.observe(0.1, "pod")
+
+    def _account(self, qp, m):
+        self._note_pod(qp, m)
+
+    def schedule_batch(self, qps, m):
+        for qp in qps:
+            self._account(qp, m)
+'''
+
+HP001_VIA_GOOD = '''
+class Batcher:
+    def _note_pod(self, qp, m):
+        m.batch_stage_duration.observe(0.1, "pod")
+
+    def _requeue_failed(self, qp, m):
+        m.batch_stage_duration.observe(0.1, "requeue")
+
+    def _lookup(self, qp):
+        return qp.key
+
+    def schedule_batch(self, qps, m):
+        for qp in qps:
+            k = self._lookup(qp)
+            self._requeue_failed(qp, m)
+            if qp.key in self._sampled:
+                self._note_pod(qp, m)
+'''
+
+
+def test_hp001_fires_via_call_chain_into_hot_helper():
+    findings = [f for f in analyze_source(HP001_VIA_BAD, filename=_HOT)
+                if f.rule == "HP001"]
+    assert len(findings) == 1, findings
+    msg = findings[0].message
+    assert "via call chain" in msg
+    assert ("schedule_batch -> " in msg and "_account" in msg
+            and "_note_pod" in msg), msg
+    assert ".observe()" in msg
+
+
+def test_hp001_via_chain_quiet_on_terminal_path_and_sampled_guard():
+    # _requeue_failed is a terminal-path helper by name; the _note_pod
+    # call sits behind the sampled-set membership guard; _lookup does not
+    # instrument — none of the three is the multiplier bug
+    assert "HP001" not in rules_of(
+        analyze_source(HP001_VIA_GOOD, filename=_HOT))
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: MP001's via-helper form — a pod handed from the mp boundary
+# module into a helper module that does the .put() — the pickle is the
+# same, laundered through one call
+# ---------------------------------------------------------------------------
+
+MP001_VIA_MP_MOD = '''
+import multiprocessing
+
+from fixturepkg import shiputil
+
+def dispatch(out_q, pod):
+    shiputil.ship(out_q, pod)
+'''
+
+MP001_VIA_HELPER_MOD = '''
+def ship(out_q, pod):
+    out_q.put(("work", pod))
+'''
+
+MP001_VIA_GOOD_MP_MOD = '''
+import multiprocessing
+
+from fixturepkg import shiputil
+
+def dispatch(out_q, pod):
+    shiputil.ship(out_q, pod.key)
+'''
+
+MP001_VIA_GOOD_HELPER_MOD = '''
+def ship(out_q, key):
+    out_q.put(("work", key))
+'''
+
+
+def test_mp001_fires_via_helper_in_another_module():
+    findings = [f for f in analyze_sources([
+        (MP001_VIA_MP_MOD, "fixturepkg/mpmod.py", "fixturepkg.mpmod"),
+        (MP001_VIA_HELPER_MOD, "fixturepkg/shiputil.py",
+         "fixturepkg.shiputil"),
+    ]) if f.rule == "MP001"]
+    assert len(findings) == 1, findings
+    msg = findings[0].message
+    assert "reached via call chain" in msg
+    assert ("fixturepkg.mpmod.dispatch -> fixturepkg.shiputil.ship"
+            in msg), msg
+    assert findings[0].file == "fixturepkg/shiputil.py"
+
+
+def test_mp001_via_helper_quiet_when_only_the_key_crosses():
+    findings = analyze_sources([
+        (MP001_VIA_GOOD_MP_MOD, "fixturepkg/mpmod.py", "fixturepkg.mpmod"),
+        (MP001_VIA_GOOD_HELPER_MOD, "fixturepkg/shiputil.py",
+         "fixturepkg.shiputil"),
+    ])
+    assert "MP001" not in rules_of(findings), findings
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: AL001/AL002 — steady-state allocation discipline (the static
+# complement of the pod_obj_allocs == 0 runtime gauge)
+# ---------------------------------------------------------------------------
+
+AL001_BAD = '''
+def schedule_batch(qps, rows):
+    for qp in qps:
+        pod = PodInfo(qp.key)
+        snap = qp.pod.copy()
+        rows.append((pod, snap))
+'''
+
+AL001_VIA_BAD = '''
+def _expand(qp):
+    return PodInfo(qp.key)
+
+def schedule_batch(qps, rows):
+    for qp in qps:
+        rows.append(_expand(qp))
+'''
+
+AL_GOOD = '''
+def schedule_batch(qps, cols_rows_ok, rows):
+    for qp in qps:
+        pod = qp.pod if cols_rows_ok else pod_bind_clone(qp.pod)
+        rows.append(qp.row)
+    try:
+        commit(rows)
+    except ValueError:
+        failed = PodInfo(rows[0])
+        _requeue_one(failed)
+    return rows
+
+def materialize_columnar_rows(rows):
+    return [PodInfo(r) for r in rows]
+'''
+
+AL002_BAD = '''
+def schedule_batch(qps):
+    snapshot = [PodInfo(qp.key) for qp in qps]
+    return snapshot
+'''
+
+AL002_GOOD = '''
+def schedule_batch(qps, use_columnar):
+    if not use_columnar:
+        return [PodInfo(qp.key) for qp in qps]
+    return [qp.row for qp in qps]
+'''
+
+_AL_HOT = "kubernetes_tpu/scheduler/batch.py"
+
+
+def test_al001_fires_on_steady_state_pod_allocation():
+    findings = [f for f in analyze_source(AL001_BAD, filename=_AL_HOT)
+                if f.rule == "AL001"]
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 2, msgs
+    assert "PodInfo(...)" in msgs
+    assert ".copy() of pod object" in msgs
+    assert "zero-alloc steady-state path" in msgs
+
+
+def test_al001_fires_via_call_chain_through_ungated_helper():
+    findings = [f for f in analyze_source(AL001_VIA_BAD, filename=_AL_HOT)
+                if f.rule == "AL001"]
+    assert len(findings) == 1, findings
+    msg = findings[0].message
+    assert "via call chain" in msg
+    assert "schedule_batch -> " in msg and "_expand" in msg, msg
+
+
+def test_al_quiet_behind_gates_barriers_and_except_paths():
+    # the gated ternary clone, the except-handler PodInfo (error paths are
+    # not steady state), the requeue helper call out of the handler, and
+    # the materialize* barrier function's comprehension are all declared
+    # exits from the zero-alloc regime
+    findings = analyze_source(AL_GOOD, filename=_AL_HOT)
+    assert "AL001" not in rules_of(findings), findings
+    assert "AL002" not in rules_of(findings), findings
+
+
+def test_al002_fires_on_pod_materializing_comprehension():
+    findings = [f for f in analyze_source(AL002_BAD, filename=_AL_HOT)
+                if f.rule == "AL002"]
+    assert len(findings) == 1, findings
+    assert "materializes a pod object per element" in findings[0].message
+
+
+def test_al002_quiet_behind_a_gate_predicate():
+    assert "AL002" not in rules_of(
+        analyze_source(AL002_GOOD, filename=_AL_HOT))
+
+
+def test_al_rules_scoped_to_the_designated_hot_paths():
+    # the identical allocation outside the designated files/functions is
+    # not AL's business ...
+    assert rules_of(analyze_source(
+        AL001_BAD, filename="kubernetes_tpu/cli/ktl.py")).isdisjoint(
+            {"AL001", "AL002"})
+    # ... and cachecols.py is hot WHOLESALE (every function is a root)
+    findings = [f for f in analyze_source(
+        AL002_BAD.replace("schedule_batch", "refresh_rows"),
+        filename="kubernetes_tpu/scheduler/cachecols.py")
+        if f.rule == "AL002"]
+    assert len(findings) == 1, findings
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: SEQ001/SEQ002 — the shm seqlock protocol
+# ---------------------------------------------------------------------------
+
+SEQ001_BAD = '''
+class Reader:
+    def nrows(self):
+        v0 = int(self._hdr[_H_VER])
+        n = int(self._hdr[_H_NROWS])
+        return n
+'''
+
+SEQ001_GOOD = '''
+class Reader:
+    def nrows(self):
+        for _ in range(64):
+            v0 = int(self._hdr[_H_VER])
+            n = int(self._hdr[_H_NROWS])
+            if v0 % 2 == 0 and int(self._hdr[_H_VER]) == v0:
+                return n
+        raise RuntimeError("torn read")
+'''
+
+SEQ001_ESCAPE_BAD = '''
+class Reader:
+    def column(self, name):
+        v0 = int(self._hdr[_H_VER])
+        arrs = self.arrays
+        self._cached_view = arrs[name]
+        return arrs[name]
+'''
+
+SEQ001_ESCAPE_GOOD = '''
+class Reader:
+    def column(self, name):
+        for _ in range(64):
+            v0 = int(self._hdr[_H_VER])
+            arrs = self.arrays
+            out = arrs[name].copy()
+            if v0 % 2 == 0 and int(self._hdr[_H_VER]) == v0:
+                return out
+        raise RuntimeError("torn read")
+'''
+
+SEQ002_BAD = '''
+class Arena:
+    def publish(self, n):
+        self._hdr[_H_NROWS] = n
+        self._hdr[_H_VER] += 1
+'''
+
+SEQ002_COLS_BAD = '''
+class Arena:
+    def write_row(self, i, cpu):
+        arrs = self.arrays
+        arrs["cpu"][i] = cpu
+'''
+
+SEQ002_GOOD = '''
+class Arena:
+    def publish(self, n):
+        self._hdr[_H_VER] += 1
+        self._hdr[_H_NROWS] = n
+        self._hdr[_H_VER] += 1
+
+    def append_row(self, i, cpu, n):
+        arrs = self.arrays
+        arrs["cpu"][i] = cpu
+        self.publish(n)
+'''
+
+_SEQ_FILE = "kubernetes_tpu/store/shm.py"
+
+
+def test_seq001_fires_on_missing_version_recheck():
+    findings = [f for f in analyze_source(SEQ001_BAD, filename=_SEQ_FILE)
+                if f.rule == "SEQ001"]
+    assert len(findings) == 1, findings
+    assert "never re-checks" in findings[0].message
+
+
+def test_seq001_quiet_on_the_retry_loop_shape():
+    assert "SEQ001" not in rules_of(
+        analyze_source(SEQ001_GOOD, filename=_SEQ_FILE))
+
+
+def test_seq001_fires_on_raw_view_escaping_the_retry_scope():
+    findings = [f for f in
+                analyze_source(SEQ001_ESCAPE_BAD, filename=_SEQ_FILE)
+                if f.rule == "SEQ001"]
+    msgs = "\n".join(f.message for f in findings)
+    # stored on self AND returned raw — both escapes
+    assert len(findings) == 2, msgs
+    assert "stored on self" in msgs and "returns raw" in msgs
+
+
+def test_seq001_quiet_when_the_value_is_laundered_in_scope():
+    assert "SEQ001" not in rules_of(
+        analyze_source(SEQ001_ESCAPE_GOOD, filename=_SEQ_FILE))
+
+
+def test_seq002_fires_on_one_sided_version_bump():
+    findings = [f for f in analyze_source(SEQ002_BAD, filename=_SEQ_FILE)
+                if f.rule == "SEQ002"]
+    assert len(findings) == 1, findings
+    assert "BOTH sides" in findings[0].message
+
+
+def test_seq002_fires_on_unpublished_column_writes():
+    findings = [f for f in
+                analyze_source(SEQ002_COLS_BAD, filename=_SEQ_FILE)
+                if f.rule == "SEQ002"]
+    assert len(findings) == 1, findings
+    assert "never calls .publish" in findings[0].message
+
+
+def test_seq002_quiet_on_the_publish_shape():
+    assert "SEQ002" not in rules_of(
+        analyze_source(SEQ002_GOOD, filename=_SEQ_FILE))
+
+
+def test_seq_rules_scoped_to_the_seqlock_files():
+    findings = analyze_source(SEQ002_BAD,
+                              filename="kubernetes_tpu/store/store.py")
+    assert rules_of(findings).isdisjoint({"SEQ001", "SEQ002"}), findings
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: the runtime lock-graph witness (store/lockgraph.py)
+# ---------------------------------------------------------------------------
+
+
+def test_lock_graph_witness_reports_seeded_inversion_with_both_stacks():
+    from kubernetes_tpu.store.lockgraph import LockGraphWitness
+    from kubernetes_tpu.store.store import _LockOrderState, _OrderedRLock
+
+    # a deliberate inversion in a scratch SAME-RANK pair (equal rank
+    # passes the runtime assertion, so both orders get witnessed),
+    # isolated from the process-wide witness and lock stack
+    w = LockGraphWitness()
+    state = _LockOrderState()
+    a = _OrderedRLock("scratch_a", 0, state, witness=w)
+    b = _OrderedRLock("scratch_b", 0, state, witness=w)
+
+    def forward_order():
+        with a:
+            with b:
+                pass
+
+    def reversed_order():
+        with b:
+            with a:
+                pass
+
+    forward_order()
+    reversed_order()
+
+    table = {"scratch_a": 0, "scratch_b": 1}
+    report = w.diff(table)
+    assert not report["clean"]
+    assert len(report["violations"]) == 1, report["violations"]
+    v = report["violations"][0]
+    assert v["edge"] == "scratch_b -> scratch_a"
+    # BOTH first-seen stacks: the offending edge's and its reverse's
+    assert "reversed_order" in v["stack"]
+    assert v["reverse_stack"] and "forward_order" in v["reverse_stack"]
+    # both orders witnessed = a cycle, each edge carrying its first stack
+    assert len(report["cycles"]) == 1, report["cycles"]
+    assert "scratch_a" in report["cycles"][0]["cycle"]
+    assert len(report["cycles"][0]["stacks"]) == 2
+    text = w.render(table)
+    assert "INVERSION" in text and "first acquisition stack" in text
+    assert "CYCLE" in text
+
+
+def test_lock_graph_witness_clean_on_the_mandated_order():
+    from kubernetes_tpu.store.lockgraph import (ORDER_TABLE,
+                                                LockGraphWitness)
+    from kubernetes_tpu.store.store import _LockOrderState, _OrderedRLock
+
+    w = LockGraphWitness()
+    state = _LockOrderState()
+    names = sorted(ORDER_TABLE, key=ORDER_TABLE.get)
+    locks = [_OrderedRLock(n, ORDER_TABLE[n], state, witness=w)
+             for n in names]
+    for lk in locks:
+        lk.acquire()
+    for lk in reversed(locks):
+        lk.release()
+    report = w.diff()
+    assert report["clean"], report
+    assert report["edges"] == len(names) - 1
+    assert "CLEAN against the LK001 ordering table" in w.render()
+
+
+def test_lock_graph_export_roundtrip_renders_the_inversion(tmp_path):
+    from kubernetes_tpu.analysis.schedlint import lock_graph_report
+    from kubernetes_tpu.store.lockgraph import LockGraphWitness
+    from kubernetes_tpu.store.store import _LockOrderState, _OrderedRLock
+
+    w = LockGraphWitness()
+    state = _LockOrderState()
+    a = _OrderedRLock("scratch_a", 0, state, witness=w)
+    b = _OrderedRLock("scratch_b", 0, state, witness=w)
+    with b:
+        with a:
+            pass
+    path = tmp_path / "lockgraph.json"
+    w.export(str(path), {"scratch_a": 0, "scratch_b": 1})
+    text, clean = lock_graph_report(str(path))
+    assert not clean
+    assert "INVERSION" in text and "scratch_b -> scratch_a" in text
+
+
+def test_lock_graph_report_scratch_store_walks_the_mandated_chain():
+    from kubernetes_tpu.analysis.schedlint import lock_graph_report
+
+    text, clean = lock_graph_report()
+    assert clean, text
+    assert "CLEAN against the LK001 ordering table" in text
+
+
+def test_store_acquisitions_record_into_the_process_witness():
+    # the autouse STORE_LOCK_ORDER_CHECK fixture arms every test store;
+    # exercising one must land its edges in the process-wide witness the
+    # session-teardown gate diffs (tests/conftest.py)
+    from kubernetes_tpu.store.lockgraph import WITNESS
+    from kubernetes_tpu.store.store import APIStore
+
+    store = APIStore()
+    with store._lock:
+        with store._pods_lock:
+            pass
+    key = ("_lock (global RV)", "_pods_lock (pods shard)")
+    assert key in WITNESS.edges
+    assert WITNESS.diff()["clean"]
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: --diff scope and the baseline stats block
+# ---------------------------------------------------------------------------
+
+
+def test_diff_scope_merges_reverse_import_and_call_deps():
+    from kubernetes_tpu.analysis.index import ProjectIndex
+    from kubernetes_tpu.analysis.schedlint import diff_scope
+
+    idx = ProjectIndex.from_sources([
+        (LK002_VIA_HELPERS_MOD, "fixturepkg/helpers.py",
+         "fixturepkg.helpers"),
+        (LK002_VIA_STORE_GOOD_MOD, "fixturepkg/store_mod.py",
+         "fixturepkg.store_mod"),
+        ("def standalone():\n    return 1\n", "fixturepkg/other.py",
+         "fixturepkg.other"),
+    ])
+    scope = diff_scope(idx, ["fixturepkg/helpers.py"])
+    # the changed file itself + the module that imports/calls into it;
+    # the unrelated module stays out of scope
+    assert "fixturepkg/helpers.py" in scope
+    assert "fixturepkg/store_mod.py" in scope
+    assert "fixturepkg/other.py" not in scope
+
+
+def test_cli_json_carries_baseline_and_callgraph_stats(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    bad = tmp_path / "bad.py"
+    bad.write_text(MU001_BAD)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.analysis.schedlint",
+         "--json", str(bad)],
+        capture_output=True, text=True, cwd=repo, timeout=120)
+    doc = json.loads(proc.stdout)
+    base = doc["stats"]["baseline"]
+    assert base["findings_by_rule"] == {"MU001": 4}
+    assert base["suppression_count"] == 0
+    assert base["parse_errors"] == []
+    cg = doc["stats"]["callgraph"]
+    assert cg["depth_cap"] == 12 and cg["fanout_cap"] == 64
+    assert doc["stats"]["callgraph_edges"] == cg["edges"]
+
+    sup = tmp_path / "sup.py"
+    sup.write_text(SUPPRESSED_WITH_REASON)
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.analysis.schedlint",
+         "--json", str(sup)],
+        capture_output=True, text=True, cwd=repo, timeout=120)
+    doc = json.loads(proc.stdout)
+    base = doc["stats"]["baseline"]
+    assert base["suppression_count"] == 1
+    assert base["suppressions"][0]["rules"] == ["LK002"]
+    assert "documented exception" in base["suppressions"][0]["reason"]
+
+
+def test_cli_diff_mode_scopes_and_reports(tmp_path):
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "kubernetes_tpu.analysis.schedlint",
+         "--json", "--diff", "HEAD"],
+        capture_output=True, text=True, cwd=repo, timeout=300)
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    doc = json.loads(proc.stdout)
+    assert doc["stats"]["diff"]["ref"] == "HEAD"
+    assert doc["stats"]["diff"]["scope_files"] <= doc["stats"]["files"]
+    assert doc["stats"]["findings"] == 0
